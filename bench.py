@@ -1,0 +1,114 @@
+"""Headline benchmark: K-Means iteration throughput on TPU.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "points*dims/sec/chip", "vs_baseline": N}
+
+Measures the fused SPMD iteration (assign + reduce + SSE) on the headline
+configuration family from BASELINE.json (uniform points, D=128, k=1024),
+with compile/warmup excluded (the reference times cold, kmeans_spark.py:
+575-579 — SURVEY.md §6 flags this).
+
+``vs_baseline`` is measured against an on-host re-enactment of the
+reference's per-point executor loop (``assign_partition``,
+kmeans_spark.py:147-159: np.linalg.norm per point + argmin), scaled by
+BASELINE.json's 8 Spark workers with PERFECT linear scaling assumed — a
+deliberately generous baseline (real Spark adds shuffle/serialization
+overhead on top, and its reduceByKey pass is not even counted here).
+
+Env overrides: BENCH_N, BENCH_D, BENCH_K, BENCH_ITERS, BENCH_DTYPE.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def baseline_throughput(d: int, k: int, workers: int = 8,
+                        sample: int = 512) -> float:
+    """Reference-style per-point loop throughput, points*dims/sec for
+    `workers` perfectly-scaled workers (kmeans_spark.py:147-159)."""
+    rng = np.random.default_rng(0)
+    pts = rng.uniform(-1, 1, size=(sample, d))
+    centroids = rng.uniform(-1, 1, size=(k, d))
+    # Warm the BLAS path once.
+    _ = np.linalg.norm(centroids - pts[0], axis=1)
+    start = time.perf_counter()
+    for p in pts:
+        dist = np.linalg.norm(centroids - p, axis=1)
+        _ = int(np.argmin(dist))
+    elapsed = time.perf_counter() - start
+    per_point = elapsed / sample
+    return workers * d / per_point
+
+
+def main() -> None:
+    import jax
+
+    backend = jax.default_backend()
+    on_accel = backend not in ("cpu",)
+    n = int(os.environ.get("BENCH_N", 2_000_000 if on_accel else 100_000))
+    d = int(os.environ.get("BENCH_D", 128))
+    k = int(os.environ.get("BENCH_K", 1024))
+    iters = int(os.environ.get("BENCH_ITERS", 5))
+    dtype = np.dtype(os.environ.get("BENCH_DTYPE", "float32"))
+
+    log(f"bench: backend={backend} devices={len(jax.devices())} "
+        f"N={n} D={d} k={k} iters={iters} dtype={dtype}")
+
+    from kmeans_tpu.models.kmeans import _get_step_fns
+    from kmeans_tpu.parallel import distributed as dist
+    from kmeans_tpu.parallel.mesh import make_mesh, mesh_shape
+    from kmeans_tpu.parallel.sharding import choose_chunk_size, shard_points
+
+    rng = np.random.default_rng(42)
+    X = rng.uniform(-1, 1, size=(n, d)).astype(dtype)
+    init = X[rng.choice(n, size=k, replace=False)]
+
+    mesh = make_mesh()
+    data_shards, model_shards = mesh_shape(mesh)
+    chunk = choose_chunk_size(-(-n // data_shards), k, d)
+    points, weights = shard_points(X, mesh, chunk)
+    cents = jax.device_put(dist.pad_centroids(init, model_shards),
+                           dist.centroid_sharding(mesh))
+    step_fn, _ = _get_step_fns(mesh, chunk, "matmul")
+
+    # Warmup: compile + one extra steady-state step.  Synchronization is via
+    # a scalar transfer (float(sse)) — block_until_ready is not a reliable
+    # barrier on tunneled/experimental PJRT platforms.
+    t0 = time.perf_counter()
+    float(step_fn(points, weights, cents).sse)
+    log(f"bench: compile+first step {time.perf_counter() - t0:.1f}s")
+    float(step_fn(points, weights, cents).sse)
+
+    start = time.perf_counter()
+    for _ in range(iters):
+        stats = step_fn(points, weights, cents)
+        float(stats.sse)
+    per_iter = (time.perf_counter() - start) / iters
+    log(f"bench: {per_iter*1e3:.1f} ms/iter, sse={float(stats.sse):.4e}")
+
+    n_chips = max(1, len(jax.devices()))
+    throughput = n * d / per_iter / n_chips
+
+    base = baseline_throughput(d, k)
+    log(f"bench: baseline (8 ideal Spark workers) {base:.3e} pts*dims/s")
+
+    print(json.dumps({
+        "metric": f"kmeans_iter_throughput_N{n}_D{d}_k{k}",
+        "value": round(throughput, 1),
+        "unit": "points*dims/sec/chip",
+        "vs_baseline": round(throughput * n_chips / base, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

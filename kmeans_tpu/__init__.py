@@ -1,0 +1,23 @@
+"""kmeans_tpu — a TPU-native distributed K-Means framework.
+
+A ground-up JAX/XLA re-design of the capabilities of the PySpark reference
+implementation ``ersanjay16/Assignment--2-Group7-distributed-K-means``
+(``kmeans_spark.py``): the per-partition nearest-centroid assignment and the
+``reduceByKey`` centroid/SSE aggregation become a single jit-compiled
+pairwise-distance + one-hot scatter-sum step, and the Spark driver's
+broadcast/shuffle/collect loop becomes a ``jax.lax.psum`` over a TPU device
+mesh (``jax.sharding.Mesh`` + ``jax.shard_map``).
+
+Public API (capability parity with the reference's face-sheet "KEY API",
+``kmeans_spark.py:37-47`` — ``KMeans(k, max_iter, tolerance, seed,
+compute_sse)`` with ``.fit`` / ``.predict`` / ``.centroids`` /
+``.sse_history``), plus TPU-native extensions (meshes, dtype control,
+kmeans++ init, checkpointing, profiling).
+"""
+
+from kmeans_tpu.models.kmeans import KMeans
+from kmeans_tpu.parallel.mesh import make_mesh
+
+__version__ = "0.1.0"
+
+__all__ = ["KMeans", "make_mesh", "__version__"]

@@ -1,0 +1,5 @@
+"""Dataset generation and loading for tests and benchmarks."""
+
+from kmeans_tpu.data.synthetic import make_blobs, make_uniform
+
+__all__ = ["make_blobs", "make_uniform"]

@@ -1,0 +1,50 @@
+"""Synthetic dataset generators (no sklearn runtime dependency).
+
+The reference keeps sklearn strictly test-side ("ZERO runtime dependency",
+requirements.txt:25-26; README.md:13) and builds fixtures with
+``make_blobs`` (kmeans_spark.py:366/468/515/555) and ``np.random.randn``
+(kmeans_spark.py:415).  This module provides equivalent generators for the
+framework's own benchmarks; the pytest suite still uses sklearn's
+``make_blobs`` as the fixture source where oracle parity matters.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import numpy as np
+
+
+def make_blobs(n_samples: int, centers: Union[int, np.ndarray] = 3,
+               n_features: int = 2, cluster_std: float = 1.0,
+               center_box: Tuple[float, float] = (-10.0, 10.0),
+               random_state: int = 0,
+               dtype=np.float64) -> Tuple[np.ndarray, np.ndarray]:
+    """Isotropic Gaussian blobs, API-compatible subset of sklearn's."""
+    rng = np.random.default_rng(random_state)
+    if isinstance(centers, (int, np.integer)):
+        centers = rng.uniform(center_box[0], center_box[1],
+                              size=(int(centers), n_features))
+    centers = np.asarray(centers, dtype=np.float64)
+    k = centers.shape[0]
+    labels = rng.integers(0, k, size=n_samples)
+    X = centers[labels] + rng.normal(
+        scale=cluster_std, size=(n_samples, centers.shape[1]))
+    return X.astype(dtype), labels.astype(np.int64)
+
+
+def make_uniform(n_samples: int, n_features: int,
+                 low: float = -1.0, high: float = 1.0,
+                 random_state: int = 0, dtype=np.float32) -> np.ndarray:
+    """Uniform cloud — the headline-bench distribution (BASELINE.json)."""
+    rng = np.random.default_rng(random_state)
+    return rng.uniform(low, high,
+                       size=(n_samples, n_features)).astype(dtype)
+
+
+def make_gaussian(n_samples: int, n_features: int, random_state: int = 0,
+                  dtype=np.float32) -> np.ndarray:
+    """Standard-normal cloud (the reference's stress fixture,
+    kmeans_spark.py:414-415)."""
+    rng = np.random.RandomState(random_state)
+    return rng.randn(n_samples, n_features).astype(dtype)

@@ -1,0 +1,13 @@
+"""Model layer: the user-facing K-Means estimator (reference L3).
+
+The reference's single "model" is K-means itself (``class KMeans``,
+kmeans_spark.py:19-352); this package holds its TPU-native re-design plus
+initialization strategies (Forgy parity + kmeans++ superset) and a mini-batch
+variant.
+"""
+
+from kmeans_tpu.models.kmeans import KMeans
+from kmeans_tpu.models.minibatch import MiniBatchKMeans
+from kmeans_tpu.models.init import forgy_init, kmeanspp_init
+
+__all__ = ["KMeans", "MiniBatchKMeans", "forgy_init", "kmeanspp_init"]

@@ -1,0 +1,85 @@
+"""Centroid initialization strategies.
+
+* ``forgy_init`` — capability parity with the reference's
+  ``_initialize_centroids`` (kmeans_spark.py:58-82): sample k distinct points,
+  seeded, without replacement (``rdd.takeSample(False, k, seed)``,
+  kmeans_spark.py:72); raise if fewer than k points; all-finite validation.
+* ``kmeanspp_init`` — beyond-reference superset: D² weighting (Arthur &
+  Vassilvitskii 2007), distance updates jit-compiled on device so the O(nkD)
+  work runs on the MXU; only the per-step categorical draw happens host-side.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kmeans_tpu.parallel.sharding import global_sample_rows
+from kmeans_tpu.utils.validation import check_finite_array
+
+
+def forgy_init(X: np.ndarray, k: int, seed: int) -> np.ndarray:
+    """Seeded sample of k distinct rows (kmeans_spark.py:58-82 semantics)."""
+    centroids = global_sample_rows(X, X.shape[0], k, seed)
+    # Same message as the reference's finite guard (kmeans_spark.py:79-80).
+    check_finite_array(centroids, "Data contains NaN or Inf values")
+    return centroids
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))
+def _update_mind2(x: jax.Array, mind2: jax.Array, c: jax.Array) -> jax.Array:
+    d2 = jnp.sum((x - c[None, :]) ** 2, axis=-1)
+    return jnp.minimum(mind2, d2)
+
+
+def kmeanspp_init(X: np.ndarray, k: int, seed: int) -> np.ndarray:
+    """k-means++ seeding; device-accelerated distance maintenance."""
+    n = X.shape[0]
+    if n < k:
+        raise ValueError(
+            f"Not enough data points ({n}) to initialize {k} clusters")
+    # Full scan (not just the chosen rows): a NaN anywhere poisons the D^2
+    # distance weights, so the guard must cover all of X here.
+    check_finite_array(X, "Data contains NaN or Inf values")
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(X)
+    centers = np.empty((k, X.shape[1]), dtype=X.dtype)
+    centers[0] = X[rng.integers(n)]
+    mind2 = jnp.full((n,), jnp.inf, dtype=x.dtype)
+    for i in range(1, k):
+        mind2 = _update_mind2(x, mind2, jnp.asarray(centers[i - 1]))
+        p = np.asarray(mind2, dtype=np.float64)
+        p = np.maximum(p, 0.0)
+        total = p.sum()
+        if not np.isfinite(total) or total <= 0:
+            idx = rng.integers(n)           # degenerate: all points coincide
+        else:
+            idx = rng.choice(n, p=p / total)
+        centers[i] = X[idx]
+    return centers
+
+
+INITIALIZERS = {"forgy": forgy_init, "random": forgy_init,
+                "k-means++": kmeanspp_init, "kmeans++": kmeanspp_init}
+
+
+def resolve_init(init, X: np.ndarray, k: int, seed: int) -> np.ndarray:
+    """Dispatch: strategy name, callable, or an explicit (k, D) array."""
+    if callable(init):
+        return np.asarray(init(X, k, seed), dtype=X.dtype)
+    if isinstance(init, str):
+        try:
+            fn = INITIALIZERS[init]
+        except KeyError:
+            raise ValueError(f"unknown init strategy: {init!r}; "
+                             f"options: {sorted(INITIALIZERS)}") from None
+        return np.asarray(fn(X, k, seed), dtype=X.dtype)
+    arr = np.asarray(init, dtype=X.dtype)
+    if arr.shape != (k, X.shape[1]):
+        raise ValueError(f"explicit init must have shape ({k}, "
+                         f"{X.shape[1]}), got {arr.shape}")
+    check_finite_array(arr, "Data contains NaN or Inf values")
+    return arr

@@ -1,0 +1,397 @@
+"""TPU-native distributed K-Means estimator.
+
+Re-designs the reference's ``class KMeans`` (kmeans_spark.py:19-352) for
+JAX/TPU while preserving its behavioral contract:
+
+* Constructor ``KMeans(k, max_iter, tolerance, seed, compute_sse)``
+  (kmeans_spark.py:37-47) with the same validation errors (:49-56).
+* ``fit`` semantics (kmeans_spark.py:239-319): seeded Forgy init with finite
+  validation; per iteration assign -> update; optional SSE with monotonicity
+  warning (>1e-6 rise, :283-286) — SSE measured against the iteration's
+  STARTING centroids, exactly like the reference's second pass (:279 uses the
+  pre-update broadcast); NaN/Inf hard error (:289-290); max-centroid-shift
+  convergence (:293-313); per-iteration logging incl. cluster sizes
+  (:296-304); empty-cluster recovery (:190-204).
+* ``predict`` guard + argmin labels (kmeans_spark.py:321-352) — eager here
+  (the reference returns a lazy RDD and unpersists its broadcast before
+  evaluation, a latent bug; SURVEY.md §2.1 C9).
+* Attributes ``centroids`` / ``sse_history`` / ``iterations_run`` — with
+  ``iterations_run`` actually maintained (declared but never written in the
+  reference, kmeans_spark.py:47; SURVEY.md §2.1).
+
+Deliberate divergences (documented per SURVEY.md §7 stage 2):
+* Empty-cluster resampling is DETERMINISTIC — seeded per iteration via
+  ``np.random.default_rng([seed, iteration])`` instead of the reference's
+  ``seed=int(time.time())`` (kmeans_spark.py:196).
+* The reference's dead farthest-point policy (``_reinitialize_empty_cluster``,
+  kmeans_spark.py:84-129) is implemented and LIVE (``empty_cluster=
+  'farthest'``) — it costs nothing because the farthest point is fused into
+  the assignment pass.
+
+Execution model: data stays sharded on the mesh's data axis for the whole fit
+(the ``rdd.cache()`` analogue, kmeans_spark.py:256); each iteration is ONE
+jitted SPMD step (see parallel.distributed) returning replicated global
+statistics; the host loop does only the O(k*D) centroid division, convergence
+test, and logging — mirroring the reference's driver role (:181-188) minus
+all the broadcast/shuffle/collect traffic.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kmeans_tpu.ops.assign import StepStats, pairwise_sq_dists
+from kmeans_tpu.parallel import distributed as dist
+from kmeans_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh, mesh_shape
+from kmeans_tpu.parallel.sharding import (choose_chunk_size, pad_points,
+                                          shard_points)
+from kmeans_tpu.models.init import resolve_init
+from kmeans_tpu.utils.logging import IterationLogger
+from kmeans_tpu.utils.validation import check_finite_array, validate_params
+from kmeans_tpu.utils import checkpoint as ckpt
+
+_EMPTY_POLICIES = ("resample", "farthest", "keep")
+
+# shard_map step/predict functions, keyed by everything that forces a rebuild.
+_STEP_CACHE: dict = {}
+
+# Module-level jit so repeated transform() calls share one trace cache.
+_pairwise_jit = jax.jit(pairwise_sq_dists, static_argnames=("mode",))
+
+
+def _get_step_fns(mesh: Mesh, chunk_size: int, mode: str):
+    key = (mesh, chunk_size, mode)
+    if key not in _STEP_CACHE:
+        _STEP_CACHE[key] = (
+            dist.make_step_fn(mesh, chunk_size=chunk_size, mode=mode),
+            dist.make_predict_fn(mesh, chunk_size=chunk_size, mode=mode),
+        )
+    return _STEP_CACHE[key]
+
+
+class KMeans:
+    """Distributed K-Means on a TPU mesh (scikit-learn-style API).
+
+    Parameters (first five = the reference's full config surface,
+    kmeans_spark.py:37-47):
+
+    k : number of clusters.
+    max_iter : maximum iterations.
+    tolerance : convergence threshold on the max centroid shift.
+    seed : random seed (init AND deterministic empty-cluster resampling).
+    compute_sse : record ``sse_history`` + emit monotonicity warnings.
+        Unlike the reference — where this costs a second full data pass
+        (kmeans_spark.py:237, README.md:39-41) — SSE is fused into the
+        assignment pass, so the flag only controls bookkeeping.
+
+    TPU-native extensions:
+
+    init : 'forgy' (reference parity) | 'k-means++' | callable | (k,D) array.
+    empty_cluster : 'resample' (reference live path, made deterministic) |
+        'farthest' (reference's dead policy, made live) | 'keep'.
+    dtype : compute dtype (default float32; float64 needs jax x64).
+    mesh : a ``jax.sharding.Mesh``, or None to auto-build one over all
+        devices with ``model_shards`` centroid shards.
+    model_shards : size of the centroid-sharding (TP) axis for auto meshes.
+    chunk_size : points per scan chunk (None = auto, VMEM-budgeted).
+    distance_mode : 'matmul' (MXU form) | 'direct' (exact; small problems).
+    verbose : reference-style per-iteration prints (kmeans_spark.py:296-304).
+    """
+
+    def __init__(self, k: int = 3, max_iter: int = 100,
+                 tolerance: float = 1e-4, seed: int = 42,
+                 compute_sse: bool = False, *,
+                 init: Union[str, np.ndarray, callable] = "forgy",
+                 empty_cluster: str = "resample",
+                 dtype=None,
+                 mesh: Optional[Mesh] = None,
+                 model_shards: int = 1,
+                 chunk_size: Optional[int] = None,
+                 distance_mode: str = "matmul",
+                 verbose: bool = True):
+        self.k = k
+        self.max_iter = max_iter
+        self.tolerance = tolerance
+        self.seed = seed
+        self.compute_sse = compute_sse
+        self.init = init
+        if empty_cluster not in _EMPTY_POLICIES:
+            raise ValueError(f"empty_cluster must be one of {_EMPTY_POLICIES},"
+                             f" got {empty_cluster!r}")
+        self.empty_cluster = empty_cluster
+        self.dtype = np.dtype(dtype) if dtype is not None else np.dtype(np.float32)
+        self.mesh = mesh
+        self.model_shards = model_shards
+        self.chunk_size = chunk_size
+        self.distance_mode = distance_mode
+        self.verbose = verbose
+
+        self.centroids: Optional[np.ndarray] = None   # kmeans_spark.py:44
+        self.sse_history: List[float] = []            # kmeans_spark.py:45
+        self.cluster_sizes_: Optional[np.ndarray] = None
+        validate_params(k, max_iter, tolerance)       # kmeans_spark.py:46
+        self.iterations_run = 0                       # kmeans_spark.py:47
+
+    # ------------------------------------------------------------------ mesh
+
+    def _resolve_mesh(self) -> Mesh:
+        if self.mesh is None:
+            self.mesh = make_mesh(model=self.model_shards)
+        return self.mesh
+
+    def _chunk_for(self, n: int, d: int) -> int:
+        data_shards, model_shards = mesh_shape(self._resolve_mesh())
+        return self.chunk_size or choose_chunk_size(
+            -(-n // data_shards), max(self.k, model_shards), d)
+
+    def _setup(self, n: int, d: int):
+        """Resolve mesh + chunk + step functions WITHOUT moving any data."""
+        mesh = self._resolve_mesh()
+        _, model_shards = mesh_shape(mesh)
+        chunk = self._chunk_for(n, d)
+        step_fn, predict_fn = _get_step_fns(mesh, chunk, self.distance_mode)
+        return mesh, model_shards, step_fn, predict_fn, chunk
+
+    def _prepare(self, X: np.ndarray):
+        """Shard the data; build (or fetch cached) step functions."""
+        n, d = X.shape
+        mesh, model_shards, step_fn, predict_fn, chunk = self._setup(n, d)
+        points, weights = shard_points(X, mesh, chunk)
+        return mesh, model_shards, points, weights, step_fn, predict_fn, chunk
+
+    def _put_centroids(self, centroids: np.ndarray, mesh: Mesh,
+                       model_shards: int) -> jax.Array:
+        padded = dist.pad_centroids(
+            centroids.astype(self.dtype), model_shards)
+        return jax.device_put(padded, dist.centroid_sharding(mesh))
+
+    # ------------------------------------------------------------------- fit
+
+    def fit(self, X, *, resume: bool = False) -> "KMeans":
+        """Fit on (n, D) array-like.  Returns self (kmeans_spark.py:239-319).
+
+        ``resume=True`` continues from the current ``centroids`` /
+        ``iterations_run`` (e.g. after ``KMeans.load``) instead of
+        re-initializing — a capability the reference lacks (no checkpointing,
+        SURVEY.md §5).
+        """
+        X = np.ascontiguousarray(np.asarray(X, dtype=self.dtype))
+        if X.ndim != 2:
+            raise ValueError(f"X must be 2-D (n, D), got shape {X.shape}")
+        n, d = X.shape
+
+        log = IterationLogger(self.verbose)
+        mesh, model_shards, points, weights, step_fn, _, _ = self._prepare(X)
+
+        start_iter = 0
+        if resume and self.centroids is not None:
+            centroids = np.asarray(self.centroids, dtype=self.dtype)
+            start_iter = self.iterations_run
+        else:
+            # Forgy/k-means++/explicit init (kmeans_spark.py:58-82, :259).
+            centroids = resolve_init(self.init, X, self.k, self.seed)
+            self.sse_history = []
+            self.iterations_run = 0
+
+        log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
+
+        cents_dev = self._put_centroids(centroids, mesh, model_shards)
+        for iteration in range(start_iter, self.max_iter):
+            stats: StepStats = step_fn(points, weights, cents_dev)
+            # Host does exactly the driver's O(k*D) work
+            # (kmeans_spark.py:181-188) — in float64 for stable division.
+            sums = np.asarray(stats.sums, dtype=np.float64)[: self.k]
+            counts = np.asarray(stats.counts, dtype=np.float64)[: self.k]
+            nonempty = counts > 0
+            new_centroids = np.where(
+                nonempty[:, None],
+                sums / np.maximum(counts, 1.0)[:, None],
+                centroids.astype(np.float64))
+            new_centroids = self._handle_empty(
+                new_centroids, nonempty, X, stats, iteration, log)
+            new_centroids = new_centroids.astype(self.dtype)
+
+            if self.compute_sse:          # SSE vs starting centroids (:279)
+                sse = float(stats.sse)
+                self.sse_history.append(sse)
+                if len(self.sse_history) > 1 and \
+                        sse > self.sse_history[-2] + 1e-6:
+                    log.warn_sse_increase(self.sse_history[-2], sse)
+
+            # Numerical-stability guard (kmeans_spark.py:289-290).
+            if not np.all(np.isfinite(new_centroids)):
+                raise ValueError(
+                    f"NaN or Inf detected in centroids at iteration "
+                    f"{iteration + 1}")
+
+            shifts = np.linalg.norm(
+                new_centroids.astype(np.float64) -
+                centroids.astype(np.float64), axis=1)
+            max_shift = float(np.max(shifts))       # kmeans_spark.py:293-294
+
+            sizes = counts.astype(np.int64)
+            log.iteration(iteration, max_shift, sizes,
+                          self.sse_history[-1] if
+                          (self.compute_sse and self.sse_history) else None)
+
+            centroids = new_centroids                # kmeans_spark.py:307
+            self.centroids = np.asarray(centroids)
+            self.cluster_sizes_ = sizes
+            self.iterations_run = iteration + 1      # fixes SURVEY §2.1 bug
+
+            if max_shift < self.tolerance:           # kmeans_spark.py:310-313
+                log.converged(iteration + 1)
+                break
+            cents_dev = self._put_centroids(centroids, mesh, model_shards)
+        return self
+
+    def _handle_empty(self, new_centroids: np.ndarray, nonempty: np.ndarray,
+                      X: np.ndarray, stats: StepStats, iteration: int,
+                      log: IterationLogger) -> np.ndarray:
+        """Empty-cluster recovery (kmeans_spark.py:190-204 / :84-129)."""
+        empty_ids = np.flatnonzero(~nonempty)
+        if empty_ids.size == 0:
+            return new_centroids
+        log.warn_empty(empty_ids.size)               # kmeans_spark.py:192
+        if self.empty_cluster == "keep":             # fallback :201-204
+            return new_centroids
+        filled = list(empty_ids)
+        if self.empty_cluster == "farthest":
+            # The reference's dead policy (:84-129), fused & live: the point
+            # farthest from its nearest centroid replaces the first empty.
+            far = np.asarray(stats.farthest_point, dtype=np.float64)
+            if float(stats.farthest_dist) >= 0:
+                new_centroids[filled[0]] = far[: X.shape[1]]
+                filled = filled[1:]
+        if filled:
+            # Deterministic replacement sampling — the reference's live
+            # policy (:191-204) minus its time.time() seed (:195-196).
+            rng = np.random.default_rng([self.seed, iteration + 1])
+            take = min(len(filled), X.shape[0])
+            idx = rng.choice(X.shape[0], size=take, replace=False)
+            for slot, row in zip(filled[:take], idx):
+                new_centroids[slot] = X[row]
+            # Under-returned samples keep the old centroid (:201-204),
+            # already present in new_centroids.
+        return new_centroids
+
+    # --------------------------------------------------------------- predict
+
+    def predict(self, X) -> np.ndarray:
+        """Labels for (n, D) array-like -> int32 (n,).
+
+        Guard matches kmeans_spark.py:337-338; computation is the eager
+        sharded analogue of the reference's lazy mapPartitions (:343-350).
+        """
+        if self.centroids is None:
+            raise ValueError("Model must be fitted before prediction")
+        X = np.ascontiguousarray(np.asarray(X, dtype=self.dtype))
+        n = X.shape[0]
+        mesh, model_shards, points, _, _, predict_fn, _ = self._prepare(X)
+        cents_dev = self._put_centroids(
+            np.asarray(self.centroids), mesh, model_shards)
+        labels = predict_fn(points, cents_dev)
+        return np.asarray(labels)[:n]
+
+    def fit_predict(self, X) -> np.ndarray:
+        return self.fit(X).predict(X)
+
+    def transform(self, X) -> np.ndarray:
+        """Euclidean distances to each centroid, (n, k) — sklearn-style."""
+        if self.centroids is None:
+            raise ValueError("Model must be fitted before prediction")
+        X = jnp.asarray(np.asarray(X, dtype=self.dtype))
+        c = jnp.asarray(np.asarray(self.centroids, dtype=self.dtype))
+        d2 = _pairwise_jit(X, c, mode=self.distance_mode)
+        return np.sqrt(np.asarray(d2))
+
+    def score(self, X) -> float:
+        """Negative SSE of X under the fitted centroids (sklearn convention)."""
+        if self.centroids is None:
+            raise ValueError("Model must be fitted before prediction")
+        X = np.ascontiguousarray(np.asarray(X, dtype=self.dtype))
+        mesh, model_shards, points, weights, step_fn, _, _ = self._prepare(X)
+        cents_dev = self._put_centroids(
+            np.asarray(self.centroids), mesh, model_shards)
+        stats = step_fn(points, weights, cents_dev)
+        return -float(stats.sse)
+
+    # ---------------------------------------------------- sklearn-style sugar
+
+    @property
+    def cluster_centers_(self) -> Optional[np.ndarray]:
+        return self.centroids
+
+    @property
+    def n_iter_(self) -> int:
+        return self.iterations_run
+
+    @property
+    def inertia_(self) -> Optional[float]:
+        return self.sse_history[-1] if self.sse_history else None
+
+    # ------------------------------------------------------------ checkpoint
+
+    def _state_dict(self) -> dict:
+        """Serializable state: constructor config + fitted attributes.
+        ``init`` round-trips as a strategy name or explicit array; a callable
+        init is recorded as 'forgy' (irrelevant on resume — centroids are
+        restored, so init never re-runs)."""
+        state = {
+            "model_class": type(self).__name__,
+            "centroids": np.asarray(self.centroids)
+            if self.centroids is not None else np.zeros((0, 0)),
+            "k": self.k, "max_iter": self.max_iter,
+            "tolerance": self.tolerance, "seed": self.seed,
+            "compute_sse": self.compute_sse,
+            "empty_cluster": self.empty_cluster,
+            "distance_mode": self.distance_mode,
+            "model_shards": self.model_shards,
+            "chunk_size": self.chunk_size,
+            "verbose": self.verbose,
+            "sse_history": list(map(float, self.sse_history)),
+            "iterations_run": self.iterations_run,
+            "dtype": str(self.dtype),
+        }
+        if isinstance(self.init, str):
+            state["init"] = self.init
+        elif not callable(self.init):
+            state["init_array"] = np.asarray(self.init)
+        return state
+
+    def _restore_state(self, state: dict) -> None:
+        cents = state["centroids"]
+        self.centroids = cents if cents.size else None
+        self.sse_history = list(state["sse_history"])
+        self.iterations_run = int(state["iterations_run"])
+
+    def save(self, path) -> None:
+        """Checkpoint fitted state (beyond-reference; SURVEY.md §5)."""
+        ckpt.save_state(path, self._state_dict())
+
+    @classmethod
+    def load(cls, path) -> "KMeans":
+        state = ckpt.load_state(path)
+        init = state.get("init_array", state.get("init", "forgy"))
+        model = cls(k=state["k"], max_iter=state["max_iter"],
+                    tolerance=state["tolerance"], seed=state["seed"],
+                    compute_sse=state["compute_sse"], init=init,
+                    empty_cluster=state["empty_cluster"],
+                    distance_mode=state["distance_mode"],
+                    model_shards=state["model_shards"],
+                    chunk_size=state["chunk_size"],
+                    verbose=state["verbose"],
+                    dtype=np.dtype(state["dtype"]),
+                    **cls._load_kwargs(state))
+        model._restore_state(state)
+        return model
+
+    @classmethod
+    def _load_kwargs(cls, state: dict) -> dict:
+        """Subclass hook for extra constructor kwargs."""
+        return {}

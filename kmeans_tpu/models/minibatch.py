@@ -1,0 +1,109 @@
+"""Mini-batch K-Means (beyond-reference superset).
+
+The reference has only full-batch Lloyd iterations (kmeans_spark.py:266-313).
+This variant (Sculley 2010-style) reuses the same fused SPMD step on a seeded
+per-iteration sample and applies per-center count-weighted incremental
+updates — useful when N is far larger than one pass per iteration justifies.
+Shares every guard and logging behavior with :class:`KMeans`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kmeans_tpu.models.kmeans import KMeans
+from kmeans_tpu.models.init import resolve_init
+from kmeans_tpu.utils.logging import IterationLogger
+
+
+class MiniBatchKMeans(KMeans):
+    def __init__(self, k: int = 3, max_iter: int = 100,
+                 tolerance: float = 1e-4, seed: int = 42,
+                 compute_sse: bool = False, *, batch_size: int = 4096,
+                 **kwargs):
+        super().__init__(k, max_iter, tolerance, seed, compute_sse, **kwargs)
+        if batch_size <= 0:
+            raise ValueError(f"batch_size must be positive, got {batch_size}")
+        self.batch_size = batch_size
+
+    def fit(self, X, *, resume: bool = False) -> "MiniBatchKMeans":
+        X = np.ascontiguousarray(np.asarray(X, dtype=self.dtype))
+        if X.ndim != 2:
+            raise ValueError(f"X must be 2-D (n, D), got shape {X.shape}")
+        n, d = X.shape
+        bs = min(self.batch_size, n)
+        log = IterationLogger(self.verbose)
+
+        if resume and self.centroids is not None:
+            centroids = np.asarray(self.centroids, dtype=np.float64)
+            start_iter = self.iterations_run
+            seen = np.asarray(self._seen, dtype=np.float64)
+        else:
+            centroids = resolve_init(
+                self.init, X, self.k, self.seed).astype(np.float64)
+            self.sse_history = []
+            self.iterations_run = 0
+            start_iter = 0
+            seen = np.zeros(self.k)    # lifetime per-center counts
+
+        log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
+
+        mesh, model_shards, step_fn, _, chunk = self._setup(bs, d)
+        from kmeans_tpu.parallel.sharding import shard_points
+        for iteration in range(start_iter, self.max_iter):
+            # Per-iteration derived RNG: batch i is a pure function of
+            # (seed, i), so a checkpointed run resumes the SAME batch
+            # sequence an uninterrupted run would see.
+            rng = np.random.default_rng([self.seed, iteration])
+            batch = X[rng.choice(n, size=bs, replace=False)]
+            pts, w = shard_points(batch, mesh, chunk)
+            stats = step_fn(pts, w, self._put_centroids(
+                centroids.astype(self.dtype), mesh, model_shards))
+            sums = np.asarray(stats.sums, dtype=np.float64)[: self.k]
+            counts = np.asarray(stats.counts, dtype=np.float64)[: self.k]
+
+            seen += counts
+            eta = np.divide(counts, np.maximum(seen, 1.0))[:, None]
+            batch_mean = sums / np.maximum(counts, 1.0)[:, None]
+            new_centroids = np.where(
+                counts[:, None] > 0,
+                (1.0 - eta) * centroids + eta * batch_mean, centroids)
+
+            if not np.all(np.isfinite(new_centroids)):
+                raise ValueError(
+                    f"NaN or Inf detected in centroids at iteration "
+                    f"{iteration + 1}")
+            if self.compute_sse:
+                sse = float(stats.sse) * (n / bs)   # scaled batch estimate
+                self.sse_history.append(sse)
+
+            max_shift = float(np.max(np.linalg.norm(
+                new_centroids - centroids, axis=1)))
+            log.iteration(iteration, max_shift, counts.astype(np.int64),
+                          self.sse_history[-1] if
+                          (self.compute_sse and self.sse_history) else None)
+
+            centroids = new_centroids
+            self.centroids = centroids.astype(self.dtype)
+            self.cluster_sizes_ = counts.astype(np.int64)
+            self.iterations_run = iteration + 1
+            self._seen = seen.copy()
+            if max_shift < self.tolerance:
+                log.converged(iteration + 1)
+                break
+        return self
+
+    def _state_dict(self) -> dict:
+        state = super()._state_dict()
+        state["batch_size"] = self.batch_size
+        state["seen_counts"] = np.asarray(getattr(self, "_seen",
+                                                  np.zeros(self.k)))
+        return state
+
+    def _restore_state(self, state: dict) -> None:
+        super()._restore_state(state)
+        self._seen = np.asarray(state["seen_counts"])
+
+    @classmethod
+    def _load_kwargs(cls, state: dict) -> dict:
+        return {"batch_size": state["batch_size"]}

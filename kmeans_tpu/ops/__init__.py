@@ -1,0 +1,26 @@
+"""Compute kernels: pairwise distances, fused assign+reduce, SSE.
+
+This package replaces the reference's L1 layer — the per-point NumPy closures
+shipped to Spark executors (``kmeans_spark.py:147-159`` assign,
+``kmeans_spark.py:224-235`` SSE, ``kmeans_spark.py:103-119`` farthest-point) —
+with fully vectorized, jit-compiled TPU kernels that batch over points AND
+centroids, feed the MXU via the matmul distance form, and fuse the SSE /
+farthest-point statistics into the same data pass (the reference pays a second
+full pass for SSE, ``kmeans_spark.py:237``).
+"""
+
+from kmeans_tpu.ops.assign import (
+    StepStats,
+    assign_chunk,
+    assign_labels,
+    assign_reduce,
+    pairwise_sq_dists,
+)
+
+__all__ = [
+    "StepStats",
+    "assign_chunk",
+    "assign_labels",
+    "assign_reduce",
+    "pairwise_sq_dists",
+]

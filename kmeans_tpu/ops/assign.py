@@ -1,0 +1,194 @@
+"""Fused assignment + reduction kernels (the K-Means "hot loop") for TPU.
+
+Reference behavior being reproduced (see ``/root/reference/kmeans_spark.py``):
+
+* ``assign_partition`` (kmeans_spark.py:147-159): per point, distances to all
+  centroids via ``np.linalg.norm(centroids - point, axis=1)`` then
+  ``np.argmin`` — O(N*k*D) executed point-at-a-time from Python.
+* ``reduceByKey(lambda a,b: (a[0]+b[0], a[1]+b[1]))`` (kmeans_spark.py:169-171):
+  per-cluster sums of point vectors and counts.
+* ``compute_partition_sse`` (kmeans_spark.py:224-235): a SECOND full pass
+  accumulating ``min_distance**2``.
+* ``find_farthest_point`` (kmeans_spark.py:103-119): max-over-points of the
+  min-distance (used by the farthest-point empty-cluster policy).
+
+TPU-first redesign: one pass, fully batched.  Squared distances use the
+``||x||^2 + ||c||^2 - 2 x @ c.T`` matmul form so the O(N*k*D) FLOPs land on
+the MXU; cluster sums use a one-hot (chunk,k) @ (chunk,D) matmul (again MXU)
+instead of a shuffle; SSE and the farthest point are accumulated in the SAME
+pass at ~zero marginal cost (the reference pays a second data pass,
+kmeans_spark.py:237).  Points are processed in fixed-size chunks under
+``lax.scan`` so the (chunk, k) distance tile stays small enough for VMEM-
+friendly fusion at any N — no data-dependent shapes anywhere, everything
+jit-compiles once.
+
+Tie-breaking: ``jnp.argmin`` returns the lowest index on ties, matching
+NumPy's rule used by the reference (kmeans_spark.py:156) — required for
+trajectory-level sklearn parity (SURVEY.md §7 hard part b).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class StepStats(NamedTuple):
+    """Globally-reducible statistics of one assignment pass.
+
+    This is the TPU-native replacement for everything the reference's driver
+    collects per iteration: the ``reduceByKey`` output (sums + counts,
+    kmeans_spark.py:169-173), the SSE scalar (kmeans_spark.py:237), and the
+    farthest-point candidate (kmeans_spark.py:122-129).  Every field is a
+    dense, fixed-shape array, so combining shards is a plain ``psum`` /
+    ``all_gather`` instead of a keyed shuffle.
+    """
+
+    sums: jax.Array            # (k, D) per-cluster coordinate sums
+    counts: jax.Array          # (k,)  per-cluster point counts
+    sse: jax.Array             # ()    sum of min squared distances
+    farthest_dist: jax.Array   # ()    max over points of min distance^2
+    farthest_point: jax.Array  # (D,)  the point achieving farthest_dist
+
+
+def _accum_dtype(dtype) -> jnp.dtype:
+    """Accumulate in at least float32 (float64 stays float64 under x64)."""
+    return jnp.promote_types(dtype, jnp.float32)
+
+
+def pairwise_sq_dists(x: jax.Array, centroids: jax.Array,
+                      mode: str = "matmul") -> jax.Array:
+    """Squared Euclidean distances, (n, k) for x:(n, D), centroids:(k, D).
+
+    ``mode='matmul'`` uses the expanded form — one (n,D)@(D,k) matmul, the
+    MXU-friendly shape (do NOT translate the reference's per-point
+    ``norm(centroids - point)``, kmeans_spark.py:153).  ``mode='direct'``
+    materializes (n,k,D) differences — numerically exact (no cancellation),
+    used for small problems / parity testing.
+    """
+    acc = _accum_dtype(x.dtype)
+    if mode == "direct":
+        diff = x[:, None, :].astype(acc) - centroids[None, :, :].astype(acc)
+        return jnp.sum(diff * diff, axis=-1)
+    if mode != "matmul":
+        raise ValueError(f"unknown distance mode: {mode!r}")
+    x = x.astype(acc)
+    c = centroids.astype(acc)
+    x2 = jnp.sum(x * x, axis=-1, keepdims=True)            # (n, 1)
+    c2 = jnp.sum(c * c, axis=-1)[None, :]                  # (1, k)
+    xc = jax.lax.dot_general(
+        x, c, (((1,), (1,)), ((), ())),
+        preferred_element_type=acc)                        # (n, k) on the MXU
+    # Clamp: cancellation in the expanded form can produce tiny negatives.
+    return jnp.maximum(x2 + c2 - 2.0 * xc, 0.0)
+
+
+def assign_chunk(x: jax.Array, centroids: jax.Array, mode: str = "matmul"):
+    """Nearest centroid per point: (labels int32 (n,), min sq-dist (n,))."""
+    d2 = pairwise_sq_dists(x, centroids, mode=mode)
+    best = jnp.argmin(d2, axis=1).astype(jnp.int32)   # lowest index on ties
+    mind2 = jnp.min(d2, axis=1)
+    return best, mind2
+
+
+def _scan_chunks(points: jax.Array, weights: jax.Array, chunk_size: int):
+    """Reshape (n, D) -> (n_chunks, chunk, D); n must be pre-padded."""
+    n, d = points.shape
+    if n % chunk_size != 0:
+        raise ValueError(
+            f"points length {n} not a multiple of chunk_size {chunk_size}; "
+            "pad first (kmeans_tpu.parallel.sharding.pad_points)")
+    n_chunks = n // chunk_size
+    return (points.reshape(n_chunks, chunk_size, d),
+            weights.reshape(n_chunks, chunk_size))
+
+
+def init_stats(k: int, d: int, acc) -> StepStats:
+    """Zeroed accumulator (farthest seeded at -1.0, kmeans_spark.py:106)."""
+    return StepStats(
+        sums=jnp.zeros((k, d), acc),
+        counts=jnp.zeros((k,), acc),
+        sse=jnp.zeros((), acc),
+        farthest_dist=jnp.full((), -1.0, acc),
+        farthest_point=jnp.zeros((d,), acc),
+    )
+
+
+def accumulate_chunk(carry: StepStats, xc: jax.Array, wc: jax.Array,
+                     centroids: jax.Array, *, mode: str = "matmul",
+                     select_fn=None) -> StepStats:
+    """Fold one (chunk, D) tile of points into the running StepStats.
+
+    The single shared accumulation body for BOTH the single-device kernel
+    (``assign_reduce``) and the SPMD step (parallel.distributed): distances
+    on the MXU, one-hot matmul sums/counts (the dense replacement for the
+    reference's keyed shuffle, kmeans_spark.py:169-171), fused SSE (the
+    reference's second pass, :237) and fused farthest-point tracking (the
+    dead ``_reinitialize_empty_cluster`` policy, :84-129, live and free).
+
+    ``select_fn(best_local, mind2_local) -> (mine_mask, mind2_global)`` is
+    the hook the centroid-sharded (model-axis) path uses to reconstruct the
+    global argmin across shards; None means this device owns every centroid.
+    """
+    acc = carry.sums.dtype
+    k = centroids.shape[0]
+    best, mind2 = assign_chunk(xc, centroids, mode=mode)
+    if select_fn is None:
+        mine = jnp.ones_like(wc)
+        mind2_g = mind2
+    else:
+        mine, mind2_g = select_fn(best, mind2)
+        mine = mine.astype(acc)
+    onehot = (best[:, None] == jnp.arange(k, dtype=jnp.int32)[None, :])
+    onehot = onehot.astype(acc) * (wc * mine)[:, None]     # (c, k), padded=0
+    sums = carry.sums + jax.lax.dot_general(
+        onehot, xc.astype(acc), (((0,), (0,)), ((), ())),
+        preferred_element_type=acc)                        # (k, D) on the MXU
+    counts = carry.counts + jnp.sum(onehot, axis=0)
+    sse = carry.sse + jnp.sum(mind2_g * wc)
+    masked = jnp.where(wc > 0, mind2_g, -jnp.inf)
+    i = jnp.argmax(masked)
+    far_d, far_p = masked[i], xc[i].astype(acc)
+    better = far_d > carry.farthest_dist
+    return StepStats(
+        sums, counts, sse,
+        jnp.where(better, far_d, carry.farthest_dist),
+        jnp.where(better, far_p, carry.farthest_point))
+
+
+@functools.partial(jax.jit, static_argnames=("chunk_size", "mode"))
+def assign_reduce(points: jax.Array, weights: jax.Array,
+                  centroids: jax.Array, *, chunk_size: int,
+                  mode: str = "matmul") -> StepStats:
+    """One fused pass: assign every point, reduce all per-iteration stats.
+
+    ``weights`` is 1.0 for real points and 0.0 for padding rows (padding keeps
+    shapes static across shards/chunks); padded rows contribute nothing to any
+    statistic.  See ``accumulate_chunk`` for the accumulation semantics.
+    """
+    k, d = centroids.shape
+    acc = _accum_dtype(points.dtype)
+    xs = _scan_chunks(points, weights.astype(acc), chunk_size)
+
+    def body(carry, chunk):
+        xc, wc = chunk
+        return accumulate_chunk(carry, xc, wc, centroids, mode=mode), None
+
+    stats, _ = lax.scan(body, init_stats(k, d, acc), xs)
+    return stats
+
+
+@functools.partial(jax.jit, static_argnames=("chunk_size", "mode"))
+def assign_labels(points: jax.Array, centroids: jax.Array, *,
+                  chunk_size: int, mode: str = "matmul") -> jax.Array:
+    """Labels only — the kernel behind ``predict`` (kmeans_spark.py:343-348)."""
+    n, d = points.shape
+    pad = (-n) % chunk_size
+    pts = jnp.pad(points, ((0, pad), (0, 0)))
+    xs = pts.reshape(-1, chunk_size, d)
+    labels = lax.map(lambda xc: assign_chunk(xc, centroids, mode=mode)[0], xs)
+    return labels.reshape(-1)[:n]

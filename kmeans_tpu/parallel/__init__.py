@@ -1,0 +1,34 @@
+"""Distributed layer: device meshes, shardings, and the SPMD training step.
+
+This package replaces the reference's L2 layer wholesale — the six Spark
+primitives it leaned on (broadcast kmeans_spark.py:268/340, reduceByKey :169,
+collect :122/173, sum :237, takeSample :72/126/196, cache/unpersist :256/317;
+inventory in SURVEY.md §2.3) — with a ``jax.sharding.Mesh`` + ``shard_map``
+SPMD step:
+
+* broadcast      -> replicated sharding (maintained by XLA, free on-chip)
+* reduceByKey    -> dense one-hot scatter-add + ``lax.psum`` over the data axis
+* collect to driver -> disappears (the psum result is already replicated)
+* rdd.sum        -> ``lax.psum`` of a scalar (fused into the same step)
+* takeSample     -> seeded host-side choice over the global index space
+* cache          -> arrays simply stay device-resident across iterations
+
+Mesh axes: ``data`` shards the N points (the DP axis — the reference's only
+parallelism, partition count at kmeans_spark.py:418/568); the optional
+``model`` axis shards the (k, D) centroid table for large k*D (the TP/EP
+analogue, a capability the reference lacks — SURVEY.md §2.3).
+"""
+
+from kmeans_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+from kmeans_tpu.parallel.sharding import pad_points, shard_points
+from kmeans_tpu.parallel.distributed import make_step_fn, make_predict_fn
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "make_mesh",
+    "make_step_fn",
+    "make_predict_fn",
+    "pad_points",
+    "shard_points",
+]

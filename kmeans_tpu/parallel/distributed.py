@@ -1,0 +1,189 @@
+"""The SPMD training/predict step: ``shard_map`` over a (data, model) mesh.
+
+This is the heart of the Spark replacement (SURVEY.md §2.3 backend row): the
+reference's per-iteration communication pattern — broadcast centroids out
+(kmeans_spark.py:268), keyed partial-sum shuffle (:169-171), gather to driver
+(:173), optional scalar all-reduce for SSE (:237) — collapses into ONE jitted
+step whose only collectives are a ``psum`` of a dense (k, D+1) accumulator and
+(for the farthest-point policy) a tiny ``all_gather`` of per-shard candidates.
+The psum result is replicated on every shard, so the reference's
+driver-gather/re-broadcast round-trip disappears entirely.
+
+Axes:
+* ``data`` — points sharded on N.  The reference's only parallelism
+  (partition count, kmeans_spark.py:418/568) and the moral equivalent of
+  sequence/context parallelism for this workload (SURVEY.md §5: the long axis
+  IS N; no attention -> no ring schedule obligation).
+* ``model`` — centroids sharded on k (row-block).  Beyond-reference TP/EP
+  capability for large k*D tables: each shard scores points against its
+  centroid block only; the global argmin is reconstructed from an
+  ``all_gather`` of per-block minima over the model axis.  Tie-breaking
+  remains "global lowest index" because blocks are ordered and both argmins
+  pick lowest-first.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kmeans_tpu.ops.assign import (StepStats, _accum_dtype, accumulate_chunk,
+                                   init_stats, pairwise_sq_dists)
+from kmeans_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, mesh_shape
+
+# Sentinel coordinate for centroid-table padding rows (when k doesn't divide
+# the model axis).  Large enough that no real point ever selects a padding
+# row, small enough that its squared norm stays finite in float32.
+PAD_CENTROID_VALUE = 1e12
+
+
+def pad_centroids(centroids: np.ndarray, model_shards: int) -> np.ndarray:
+    """Pad the (k, D) table to a multiple of the model axis with sentinels."""
+    k = centroids.shape[0]
+    pad = (-k) % model_shards
+    if pad == 0:
+        return centroids
+    filler = np.full((pad, centroids.shape[1]), PAD_CENTROID_VALUE,
+                     dtype=centroids.dtype)
+    return np.concatenate([centroids, filler], axis=0)
+
+
+def _model_axis_select(model_shards: int):
+    """select_fn for accumulate_chunk: reconstruct the global argmin across
+    the model (centroid-sharded) axis.  Tie-breaking stays "global lowest
+    index": argmin over the gathered per-shard minima picks the lowest shard,
+    and each shard's local argmin picks its lowest local index."""
+    if model_shards <= 1:
+        return None
+    m_idx = lax.axis_index(MODEL_AXIS)
+
+    def select(best_local, mind2_local):
+        minds = lax.all_gather(mind2_local, MODEL_AXIS)     # (m, c)
+        owner = jnp.argmin(minds, axis=0)
+        return owner == m_idx, jnp.min(minds, axis=0)
+
+    return select
+
+
+def _local_stats(points, weights, centroids_block, *, chunk_size, mode,
+                 model_shards: int):
+    """Per-(data,model)-shard pass: scan chunks via the shared
+    ``accumulate_chunk`` body.  Returned ``sums``/``counts`` cover only this
+    shard's centroid block (embedded later); ``sse``/farthest use the GLOBAL
+    min distance reconstructed across the model axis."""
+    k_local, d = centroids_block.shape
+    acc = _accum_dtype(points.dtype)
+    n_chunks = points.shape[0] // chunk_size
+    xs = (points.reshape(n_chunks, chunk_size, d),
+          weights.astype(acc).reshape(n_chunks, chunk_size))
+    select = _model_axis_select(model_shards)
+
+    def body(carry, chunk):
+        xc, wc = chunk
+        return accumulate_chunk(carry, xc, wc, centroids_block, mode=mode,
+                                select_fn=select), None
+
+    stats, _ = lax.scan(body, init_stats(k_local, d, acc), xs)
+    return stats
+
+
+def make_step_fn(mesh: Mesh, *, chunk_size: int,
+                 mode: str = "matmul") -> Callable:
+    """Build the jitted SPMD step: (points, weights, centroids) -> StepStats.
+
+    ``points``/``weights`` sharded P(data)/P(data); ``centroids`` sharded
+    P(model) on k (replicated when the model axis is size 1).  All returned
+    stats are fully replicated — every host can run the convergence check
+    identically, exactly like the reference's driver but with no gather
+    (SURVEY.md §5 backend mapping).
+    """
+    data_shards, model_shards = mesh_shape(mesh)
+
+    def step(points, weights, centroids_block):
+        k_local, d = centroids_block.shape
+        st = _local_stats(points, weights, centroids_block,
+                          chunk_size=chunk_size, mode=mode,
+                          model_shards=model_shards)
+        m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
+        # Embed this shard's centroid block into the full table, then one
+        # psum over BOTH axes yields replicated global sums/counts.
+        k = k_local * model_shards
+        off = jnp.asarray(m_idx * k_local, jnp.int32)
+        sums_full = lax.dynamic_update_slice(
+            jnp.zeros((k, d), st.sums.dtype), st.sums,
+            (off, jnp.int32(0)))
+        counts_full = lax.dynamic_update_slice(
+            jnp.zeros((k,), st.counts.dtype), st.counts, (off,))
+        axes = (DATA_AXIS, MODEL_AXIS)
+        sums_full = lax.psum(sums_full, axes)
+        counts_full = lax.psum(counts_full, axes)
+        # sse is identical on every model shard -> divide the double-count out.
+        sse = lax.psum(st.sse, axes) / model_shards
+        # Farthest point: gather the per-shard candidates, take the argmax —
+        # deterministic (first max wins), no averaging of tied points.
+        far_ds = lax.all_gather(st.farthest_dist, axes)        # (ndev,)
+        far_ps = lax.all_gather(st.farthest_point, axes)       # (ndev, D)
+        j = jnp.argmax(far_ds)
+        return StepStats(sums_full, counts_full, sse, far_ds[j], far_ps[j])
+
+    mapped = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(MODEL_AXIS, None)),
+        out_specs=StepStats(P(None, None), P(None), P(), P(), P(None)),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+def make_predict_fn(mesh: Mesh, *, chunk_size: int,
+                    mode: str = "matmul") -> Callable:
+    """Build the jitted SPMD label assignment: (points, centroids) -> labels.
+
+    Replaces ``predict``'s lazy per-partition closure (kmeans_spark.py:343-350)
+    with an eager sharded argmin; the returned labels are sharded along the
+    data axis (global indices into the un-padded centroid table).
+    """
+    data_shards, model_shards = mesh_shape(mesh)
+
+    def predict(points, centroids_block):
+        k_local, d = centroids_block.shape
+        n_local = points.shape[0]
+        n_chunks = n_local // chunk_size
+        xs = points.reshape(n_chunks, chunk_size, d)
+        m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
+
+        def body(_, xc):
+            d2 = pairwise_sq_dists(xc, centroids_block, mode=mode)
+            best_l = jnp.argmin(d2, axis=1).astype(jnp.int32)
+            if model_shards > 1:
+                mind2_l = jnp.min(d2, axis=1)
+                minds = lax.all_gather(mind2_l, MODEL_AXIS)
+                owner = jnp.argmin(minds, axis=0)
+                mine = (owner == m_idx)
+                contrib = jnp.where(mine, m_idx * k_local + best_l, 0)
+                best = lax.psum(contrib, MODEL_AXIS).astype(jnp.int32)
+            else:
+                best = best_l
+            return None, best
+
+        _, labels = lax.scan(body, None, xs)
+        return labels.reshape(-1)
+
+    mapped = jax.shard_map(
+        predict, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(MODEL_AXIS, None)),
+        out_specs=P(DATA_AXIS),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+def centroid_sharding(mesh: Optional[Mesh]):
+    """NamedSharding for the (k_padded, D) centroid table (row-block on k)."""
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, P(MODEL_AXIS, None))

@@ -1,0 +1,50 @@
+"""Device mesh construction.
+
+The reference's "cluster" is Spark local mode with parallelism simulated by
+partition count (``repartition(4)`` kmeans_spark.py:418, ``numPartitions``
+:568; SURVEY.md §4).  Here the cluster is a ``jax.sharding.Mesh``: the same
+code runs on one real TPU chip, a CPU-simulated N-device mesh
+(``--xla_force_host_platform_device_count``), or a multi-host slice — XLA
+routes the collectives over ICI within a slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"    # shards the N points (DP — the reference's partitions)
+MODEL_AXIS = "model"  # shards the k centroids (TP/EP analogue; optional)
+
+
+def make_mesh(data: Optional[int] = None, model: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a (data, model) mesh over the available devices.
+
+    ``data=None`` uses every device not consumed by ``model``.  A 1-device
+    mesh is valid (the single-chip case) — the SPMD step is identical, the
+    collectives just become no-ops.
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n = len(devs)
+    if model <= 0:
+        raise ValueError(f"model axis size must be positive, got {model}")
+    if n % model != 0:
+        raise ValueError(f"{n} devices not divisible by model={model}")
+    if data is None:
+        data = n // model
+    if data * model > n:
+        raise ValueError(f"mesh {data}x{model} needs {data*model} devices, "
+                         f"have {n}")
+    grid = np.array(devs[: data * model]).reshape(data, model)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def mesh_shape(mesh: Optional[Mesh]) -> tuple[int, int]:
+    """(data, model) axis sizes; (1, 1) for the un-meshed single-device case."""
+    if mesh is None:
+        return (1, 1)
+    return (mesh.shape.get(DATA_AXIS, 1), mesh.shape.get(MODEL_AXIS, 1))

@@ -1,0 +1,81 @@
+"""Host->mesh data placement: padding, sharding, and global sampling.
+
+Replaces the reference's data-distribution story: ``sc.parallelize`` +
+``repartition`` + ``cache`` (kmeans_spark.py:369/418/568, README.md:71).
+Points go on device ONCE, sharded along the data axis, and stay resident for
+the whole fit (the moral equivalent of ``rdd.cache()``, kmeans_spark.py:256 —
+except there is nothing to "unpersist": the array's lifetime is its Python
+lifetime).
+
+Padding: shard and chunk sizes must be static under jit, so N is padded up to
+``data_shards * chunk`` rows with a 0/1 weight mask; padded rows are inert in
+every statistic (see ops.assign.assign_reduce).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kmeans_tpu.parallel.mesh import DATA_AXIS, mesh_shape
+
+
+def choose_chunk_size(n_local: int, k: int, d: int,
+                      budget_elems: int = 1 << 21) -> int:
+    """Pick the scan chunk so the (chunk, k) distance tile stays VMEM-friendly.
+
+    ~2^21 accumulator elements (8 MB in f32) per tile by default; rounded to a
+    multiple of 8 (f32 sublane) and at least 128 (lane width) so the tile maps
+    cleanly onto the TPU's (8, 128) register tiling.
+    """
+    chunk = max(128, min(n_local, budget_elems // max(k, 1)))
+    chunk = min(chunk, max(n_local, 128))
+    return int(max(8, (chunk // 8) * 8))
+
+
+def pad_points(x: np.ndarray, multiple: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad rows of (n, D) to a multiple; return (padded, 0/1 weights)."""
+    n = x.shape[0]
+    pad = (-n) % multiple
+    w = np.ones(n + pad, dtype=x.dtype)
+    if pad:
+        x = np.concatenate([x, np.zeros((pad, x.shape[1]), dtype=x.dtype)])
+        w[n:] = 0.0
+    return x, w
+
+
+def shard_points(x: np.ndarray, mesh: Optional[Mesh],
+                 chunk_size: int) -> Tuple[jax.Array, jax.Array]:
+    """Pad and place (points, weights) sharded along the mesh's data axis.
+
+    With ``mesh=None`` the arrays are committed to the default device —
+    the single-chip path, same downstream code.
+    """
+    data_shards, _ = mesh_shape(mesh)
+    x_pad, w_pad = pad_points(np.asarray(x), data_shards * chunk_size)
+    if mesh is None:
+        return jnp.asarray(x_pad), jnp.asarray(w_pad)
+    xsh = NamedSharding(mesh, P(DATA_AXIS, None))
+    wsh = NamedSharding(mesh, P(DATA_AXIS))
+    return (jax.device_put(x_pad, xsh), jax.device_put(w_pad, wsh))
+
+
+def global_sample_rows(x_source: np.ndarray, n_rows: int, k: int,
+                       seed: int) -> np.ndarray:
+    """Sample k distinct rows from the global index space, seeded.
+
+    The host-side replacement for ``rdd.takeSample(False, k, seed)``
+    (kmeans_spark.py:72) — same capability (without replacement, seeded,
+    deterministic), no distributed job needed because sampling happens on the
+    original host array before sharding.
+    """
+    if n_rows < k:
+        raise ValueError(
+            f"Not enough data points ({n_rows}) to initialize {k} clusters")
+    rng = np.random.RandomState(seed)
+    idx = rng.choice(n_rows, size=k, replace=False)
+    return np.asarray(x_source)[idx]

@@ -1,0 +1,14 @@
+"""Utilities: validation, iteration logging, checkpointing, profiling."""
+
+from kmeans_tpu.utils.validation import validate_params, check_finite_array
+from kmeans_tpu.utils.logging import IterationLogger
+from kmeans_tpu.utils import checkpoint
+from kmeans_tpu.utils.profiling import Timer
+
+__all__ = [
+    "validate_params",
+    "check_finite_array",
+    "IterationLogger",
+    "checkpoint",
+    "Timer",
+]

@@ -1,0 +1,49 @@
+"""Model checkpoint / resume.
+
+The reference has NO model serialization of any kind (SURVEY.md §5: centroids
+live only as an in-memory attribute, kmeans_spark.py:44/307).  This module is
+the deliberate cheap superset the survey recommends: fitted state (centroids,
+SSE history, hyperparameters, iteration counter) round-trips through a single
+``.npz`` file, enabling mid-training resume via ``KMeans.fit(..., resume=...)``
+as well as fitted-model save/load.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict
+
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def _normalize(path) -> Path:
+    """np.savez appends '.npz' to suffix-less paths; make load agree."""
+    path = Path(path)
+    return path if path.suffix == ".npz" else path.with_name(path.name
+                                                             + ".npz")
+
+
+def save_state(path, state: Dict[str, Any]) -> None:
+    """Write a checkpoint dict; arrays as npz payloads, rest as JSON."""
+    path = _normalize(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    arrays = {k: np.asarray(v) for k, v in state.items()
+              if isinstance(v, np.ndarray)}
+    meta = {k: v for k, v in state.items() if k not in arrays}
+    meta["__format_version__"] = FORMAT_VERSION
+    np.savez(path, __meta__=json.dumps(meta), **arrays)
+
+
+def load_state(path) -> Dict[str, Any]:
+    with np.load(_normalize(path), allow_pickle=False) as z:
+        state: Dict[str, Any] = json.loads(str(z["__meta__"]))
+        ver = state.pop("__format_version__", None)
+        if ver != FORMAT_VERSION:
+            raise ValueError(f"unsupported checkpoint version: {ver}")
+        for k in z.files:
+            if k != "__meta__":
+                state[k] = z[k]
+    return state

@@ -1,0 +1,63 @@
+"""Per-iteration observability.
+
+Reproduces the reference's print-based metrics surface (SURVEY.md §5):
+startup config echo (kmeans_spark.py:262-263), per-iteration line with SSE /
+max shift / cluster sizes with an explicit flush (kmeans_spark.py:296-304),
+convergence announcement (:311), empty-cluster and SSE-rise warnings
+(:192, :285).  For large k the full cluster-size list is summarized instead
+of printed verbatim (the reference prints all k sizes, which is unreadable
+at k=1024).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Optional, Sequence
+
+
+class IterationLogger:
+    def __init__(self, verbose: bool = True, max_sizes_listed: int = 32):
+        self.verbose = verbose
+        self.max_sizes_listed = max_sizes_listed
+
+    def _emit(self, msg: str) -> None:
+        if self.verbose:
+            print(msg)
+            sys.stdout.flush()          # kmeans_spark.py:264/304 flushes too
+
+    def startup(self, k: int, max_iter: int, tolerance: float,
+                compute_sse: bool) -> None:
+        self._emit(f"Starting K-Means with k={k}, max_iter={max_iter}, "
+                   f"tolerance={tolerance}")
+        self._emit("SSE computation: "
+                   + ("ENABLED" if compute_sse else
+                      "DISABLED (for performance)"))
+
+    def _sizes_repr(self, sizes: Sequence[int]) -> str:
+        if len(sizes) <= self.max_sizes_listed:
+            return str([int(s) for s in sizes])
+        import numpy as np
+        a = np.asarray(sizes)
+        return (f"[k={len(sizes)}: min={a.min()}, median={int(np.median(a))}, "
+                f"max={a.max()}, empty={int((a == 0).sum())}]")
+
+    def iteration(self, iteration: int, max_shift: float,
+                  sizes: Sequence[int], sse: Optional[float]) -> None:
+        if sse is not None:           # format matches kmeans_spark.py:299-303
+            self._emit(f"Iteration {iteration + 1}: SSE = {sse:.4f}, "
+                       f"Max Shift = {max_shift:.6f}, "
+                       f"Cluster Sizes = {self._sizes_repr(sizes)}")
+        else:
+            self._emit(f"Iteration {iteration + 1}: "
+                       f"Max Shift = {max_shift:.6f}, "
+                       f"Cluster Sizes = {self._sizes_repr(sizes)}")
+
+    def converged(self, iterations: int) -> None:
+        self._emit(f"Converged after {iterations} iterations")
+
+    def warn_empty(self, n_empty: int) -> None:
+        self._emit(f"  WARNING: {n_empty} empty cluster(s) detected. "
+                   "Reinitializing...")
+
+    def warn_sse_increase(self, prev: float, cur: float) -> None:
+        self._emit(f"  WARNING: SSE increased from {prev:.4f} to {cur:.4f}")

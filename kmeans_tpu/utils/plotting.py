@@ -1,0 +1,40 @@
+"""Benchmark plot artifacts.
+
+Reproduces the reference's speedup-graph generator (kmeans_spark.py:594-619):
+matplotlib Agg, ideal (y=x) vs actual curves, markers and labels to match.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, Sequence
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def save_speedup_graph(shard_counts: Sequence[int],
+                       speedups: Dict[int, float], path) -> Path:
+    """Ideal-vs-actual speedup plot (kmeans_spark.py:601-617 layout)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    xs = np.array(list(shard_counts))
+    actual = np.array([speedups[n] for n in shard_counts])
+
+    plt.figure(figsize=(10, 6))
+    plt.plot(xs, xs, "b-", marker="o", linewidth=2, markersize=8,
+             label="Ideal")
+    plt.plot(xs, actual, color="orange", marker="s", linewidth=2,
+             markersize=8, label="Actual")
+    plt.xlabel("Number of Shards", fontsize=12)
+    plt.ylabel("Speedup", fontsize=12)
+    plt.title("Speedup vs Number of Shards", fontsize=14, fontweight="bold")
+    plt.legend(fontsize=11)
+    plt.grid(True, alpha=0.3)
+    plt.xticks(xs)
+    plt.savefig(path, dpi=150, bbox_inches="tight")
+    plt.close()
+    return path

@@ -1,0 +1,60 @@
+"""Timing and profiling hooks.
+
+The reference's only instrumentation is wall-clock ``time.time()`` pairs
+around ``fit`` (kmeans_spark.py:427-429, :575-579) with a derived
+avg-time-per-iteration.  Here timing is a first-class utility with proper
+device synchronization (``block_until_ready`` — JAX dispatch is async, so
+naive wall-clock under-measures), warmup exclusion (the reference times cold,
+including JVM/compile warmup, kmeans_spark.py:575), and an optional
+``jax.profiler`` trace context for TPU timeline capture.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+import jax
+
+
+class Timer:
+    """Accumulating wall-clock timer with device sync."""
+
+    def __init__(self):
+        self.total = 0.0
+        self.count = 0
+
+    @contextlib.contextmanager
+    def measure(self, sync_on=None):
+        start = time.perf_counter()
+        yield
+        if sync_on is not None:
+            jax.block_until_ready(sync_on)
+        self.total += time.perf_counter() - start
+        self.count += 1
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]):
+    """``jax.profiler`` trace scope; no-op when log_dir is None."""
+    if log_dir is None:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def timed_call(fn, *args, warmup: int = 1, iters: int = 3):
+    """(mean_seconds, last_result) of fn(*args), excluding warmup runs."""
+    result = None
+    for _ in range(warmup):
+        result = jax.block_until_ready(fn(*args))
+    start = time.perf_counter()
+    for _ in range(iters):
+        result = jax.block_until_ready(fn(*args))
+    return (time.perf_counter() - start) / iters, result

@@ -1,0 +1,28 @@
+"""Parameter and numerical validation.
+
+Mirrors the reference's validation surface: constructor checks raising
+``ValueError`` (``_validate_parameters``, kmeans_spark.py:49-56 — k, max_iter,
+tolerance positive), all-finite checks on the initial sample
+(kmeans_spark.py:79-80) and on every iteration's new centroids
+(kmeans_spark.py:289-290).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def validate_params(k: int, max_iter: int, tolerance: float) -> None:
+    """Raise ValueError on non-positive hyperparameters (kmeans_spark.py:49-56)."""
+    if k <= 0:
+        raise ValueError(f"k must be positive, got {k}")
+    if max_iter <= 0:
+        raise ValueError(f"max_iter must be positive, got {max_iter}")
+    if tolerance <= 0:
+        raise ValueError(f"tolerance must be positive, got {tolerance}")
+
+
+def check_finite_array(arr, message: str) -> None:
+    """Raise ValueError if the array contains NaN/Inf (kmeans_spark.py:79/289)."""
+    if not np.all(np.isfinite(np.asarray(arr))):
+        raise ValueError(message)

@@ -1,0 +1,63 @@
+"""Test harness configuration.
+
+The reference's "multi-node without a cluster" story is Spark local mode with
+parallelism simulated by partition count (SURVEY.md §4).  Ours is the JAX
+equivalent: an 8-virtual-device CPU platform
+(``--xla_force_host_platform_device_count=8``) so every sharding/collective
+path runs in CI without TPU hardware; the same code runs unchanged on a real
+TPU mesh.  x64 is enabled so parity tests can run in float64 like the
+NumPy-based reference; the framework itself defaults to float32.
+"""
+
+import os
+
+# Force the CPU platform for tests (the session environment may pin
+# JAX_PLATFORMS to a real accelerator); override with
+# KMEANS_TPU_TEST_PLATFORM=tpu to run the suite on hardware.
+os.environ["JAX_PLATFORMS"] = os.environ.get(
+    "KMEANS_TPU_TEST_PLATFORM", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The config update (not just the env var) matters: a sitecustomize may have
+# imported jax before this conftest ran, baking the session's JAX_PLATFORMS
+# into the config default.
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from kmeans_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh1():
+    """Single-device mesh — the un-parallel baseline."""
+    return make_mesh(data=1, model=1, devices=jax.devices()[:1])
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    """8-way data-parallel mesh (the reference's 4-partition sim, doubled)."""
+    return make_mesh(data=8, model=1)
+
+
+@pytest.fixture(scope="session")
+def mesh4x2():
+    """Data x model mesh: 4-way DP, 2-way centroid (TP) sharding."""
+    return make_mesh(data=4, model=2)
+
+
+@pytest.fixture()
+def blobs_small():
+    """The reference's T1 fixture: 1000 pts, 3 centers, 2-D, rs=42
+    (kmeans_spark.py:366)."""
+    from sklearn.datasets import make_blobs
+    X, y = make_blobs(n_samples=1000, centers=3, n_features=2,
+                      random_state=42)
+    return X, y

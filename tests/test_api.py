@@ -1,0 +1,124 @@
+"""API-surface tests, including the gaps the reference left untested
+(SURVEY.md §4: predict, validation error paths, n<k, NaN rejection).
+"""
+
+import numpy as np
+import pytest
+
+from kmeans_tpu import KMeans
+from kmeans_tpu.models import MiniBatchKMeans, kmeanspp_init
+
+
+@pytest.fixture()
+def small_X():
+    rng = np.random.default_rng(3)
+    return rng.normal(size=(120, 4))
+
+
+# --- constructor validation (kmeans_spark.py:49-56) -------------------------
+
+@pytest.mark.parametrize("kwargs", [dict(k=0), dict(k=-2),
+                                    dict(max_iter=0), dict(tolerance=0.0),
+                                    dict(tolerance=-1e-4)])
+def test_invalid_params_raise(kwargs):
+    with pytest.raises(ValueError, match="must be positive"):
+        KMeans(**kwargs)
+
+
+def test_invalid_empty_policy_raises():
+    with pytest.raises(ValueError, match="empty_cluster"):
+        KMeans(empty_cluster="nope")
+
+
+# --- init edge cases (kmeans_spark.py:58-82) --------------------------------
+
+def test_fewer_points_than_k_raises(mesh8):
+    X = np.zeros((2, 3))
+    with pytest.raises(ValueError, match="Not enough data points"):
+        KMeans(k=5, mesh=mesh8, verbose=False).fit(X)
+
+
+def test_nan_data_raises(mesh8, small_X):
+    X = small_X.copy()
+    X[7, 1] = np.nan
+    # The reference rejects NaN when it lands in the init sample
+    # (kmeans_spark.py:79-80) or, failing that, via the per-iteration finite
+    # guard (:289-290).  We accept either message.
+    with pytest.raises(ValueError, match="NaN or Inf"):
+        KMeans(k=100, mesh=mesh8, verbose=False).fit(X)
+
+
+def test_explicit_init_shape_checked(small_X, mesh8):
+    with pytest.raises(ValueError, match="explicit init"):
+        KMeans(k=3, init=np.zeros((2, 4)), mesh=mesh8,
+               verbose=False).fit(small_X)
+
+
+def test_unknown_init_raises(small_X, mesh8):
+    with pytest.raises(ValueError, match="unknown init"):
+        KMeans(k=3, init="zzz", mesh=mesh8, verbose=False).fit(small_X)
+
+
+def test_kmeanspp_init_runs(small_X, mesh8):
+    km = KMeans(k=4, init="k-means++", mesh=mesh8, verbose=False)
+    km.fit(small_X)
+    assert km.centroids.shape == (4, 4)
+    c = kmeanspp_init(small_X, 4, seed=0)
+    assert len(np.unique(c, axis=0)) == 4
+
+
+# --- predict / transform / score (kmeans_spark.py:321-352) ------------------
+
+def test_predict_before_fit_raises():
+    with pytest.raises(ValueError,
+                       match="Model must be fitted before prediction"):
+        KMeans(k=3).predict(np.zeros((4, 2)))
+
+
+def test_predict_labels_in_range(small_X, mesh8):
+    km = KMeans(k=5, mesh=mesh8, verbose=False).fit(small_X)
+    labels = km.predict(small_X)
+    assert labels.shape == (len(small_X),)
+    assert labels.min() >= 0 and labels.max() < 5
+
+
+def test_fit_predict_and_sklearn_aliases(small_X, mesh8):
+    km = KMeans(k=4, compute_sse=True, mesh=mesh8, verbose=False)
+    labels = km.fit_predict(small_X)
+    assert labels.shape == (len(small_X),)
+    np.testing.assert_array_equal(km.cluster_centers_, km.centroids)
+    assert km.n_iter_ == km.iterations_run >= 1
+    assert km.inertia_ == km.sse_history[-1]
+
+
+def test_transform_shape_and_score(small_X, mesh8):
+    km = KMeans(k=4, mesh=mesh8, verbose=False).fit(small_X)
+    d = km.transform(small_X)
+    assert d.shape == (len(small_X), 4)
+    # score = negative SSE under current centroids
+    assert km.score(small_X) == pytest.approx(
+        -np.sum(np.min(d, axis=1) ** 2), rel=1e-5)
+
+
+def test_non_2d_input_raises(mesh8):
+    with pytest.raises(ValueError, match="2-D"):
+        KMeans(k=2, mesh=mesh8, verbose=False).fit(np.zeros(8))
+
+
+# --- minibatch --------------------------------------------------------------
+
+def test_minibatch_converges_near_fullbatch(mesh8):
+    from sklearn.datasets import make_blobs
+    X, _ = make_blobs(n_samples=4000, centers=3, n_features=2,
+                      cluster_std=0.4, random_state=7)
+    full = KMeans(k=3, seed=0, mesh=mesh8, verbose=False).fit(X)
+    mb = MiniBatchKMeans(k=3, seed=0, max_iter=60, batch_size=512,
+                         mesh=mesh8, verbose=False).fit(X)
+    a = np.array(sorted(full.centroids.tolist()))
+    b = np.array(sorted(mb.centroids.tolist()))
+    np.testing.assert_allclose(a, b, atol=0.3)
+
+
+def test_minibatch_invalid_batch_size():
+    with pytest.raises(ValueError, match="batch_size"):
+        MiniBatchKMeans(batch_size=0)

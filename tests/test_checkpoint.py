@@ -1,0 +1,85 @@
+"""Checkpoint / resume (beyond-reference capability, SURVEY.md §5:
+the reference has no model serialization at all)."""
+
+import numpy as np
+from sklearn.datasets import make_blobs
+
+from kmeans_tpu import KMeans
+
+
+def _data():
+    X, _ = make_blobs(n_samples=2000, centers=4, n_features=3,
+                      random_state=9)
+    return X.astype(np.float64)
+
+
+def test_save_load_roundtrip(tmp_path, mesh8):
+    X = _data()
+    km = KMeans(k=4, seed=1, compute_sse=True, mesh=mesh8,
+                dtype=np.float64, verbose=False).fit(X)
+    p = tmp_path / "model.npz"
+    km.save(p)
+    back = KMeans.load(p)
+    np.testing.assert_array_equal(back.centroids, km.centroids)
+    assert back.sse_history == km.sse_history
+    assert back.iterations_run == km.iterations_run
+    # A loaded model predicts without refitting.
+    np.testing.assert_array_equal(back.predict(X[:50]), km.predict(X[:50]))
+
+
+def test_suffixless_path_roundtrips(tmp_path, mesh8):
+    X = _data()
+    km = KMeans(k=4, seed=1, mesh=mesh8, dtype=np.float64,
+                verbose=False).fit(X)
+    km.save(tmp_path / "ckpt")            # no .npz suffix — np.savez adds it
+    back = KMeans.load(tmp_path / "ckpt")
+    np.testing.assert_array_equal(back.centroids, km.centroids)
+
+
+def test_load_preserves_extended_hyperparams(tmp_path, mesh8):
+    X = _data()
+    km = KMeans(k=4, seed=1, empty_cluster="farthest",
+                distance_mode="direct", chunk_size=64, verbose=False,
+                mesh=mesh8, dtype=np.float64).fit(X)
+    km.save(tmp_path / "m.npz")
+    back = KMeans.load(tmp_path / "m.npz")
+    assert back.empty_cluster == "farthest"
+    assert back.distance_mode == "direct"
+    assert back.chunk_size == 64
+    assert back.verbose is False
+
+
+def test_minibatch_resume_matches_uninterrupted(tmp_path, mesh8):
+    from kmeans_tpu.models import MiniBatchKMeans
+    X = _data()
+    kw = dict(k=4, tolerance=1e-12, seed=3, batch_size=256, mesh=mesh8,
+              dtype=np.float64, verbose=False)
+    full = MiniBatchKMeans(max_iter=20, **kw).fit(X)
+    part = MiniBatchKMeans(max_iter=8, **kw).fit(X)
+    part.save(tmp_path / "mb.npz")
+    resumed = MiniBatchKMeans.load(tmp_path / "mb.npz")
+    resumed.max_iter = 20
+    resumed.mesh = mesh8
+    resumed.fit(X, resume=True)
+    np.testing.assert_allclose(resumed.centroids, full.centroids, atol=1e-12)
+
+
+def test_resume_matches_uninterrupted(tmp_path, mesh8):
+    X = _data()
+    # Uninterrupted 30-iteration run.
+    full = KMeans(k=4, max_iter=30, tolerance=1e-12, seed=1, mesh=mesh8,
+                  compute_sse=True, dtype=np.float64, verbose=False).fit(X)
+    # 10 iterations, checkpoint, load, resume to 30.
+    part = KMeans(k=4, max_iter=10, tolerance=1e-12, seed=1, mesh=mesh8,
+                  compute_sse=True, dtype=np.float64, verbose=False).fit(X)
+    p = tmp_path / "ckpt.npz"
+    part.save(p)
+    resumed = KMeans.load(p)
+    resumed.max_iter = 30
+    resumed.mesh = mesh8
+    resumed.verbose = False
+    resumed.fit(X, resume=True)
+    np.testing.assert_allclose(resumed.centroids, full.centroids, atol=1e-12)
+    assert resumed.iterations_run == full.iterations_run
+    np.testing.assert_allclose(resumed.sse_history, full.sse_history,
+                               rtol=1e-12)

@@ -1,0 +1,41 @@
+"""Test C capability: SSE monotone convergence (kmeans_spark.py:457-500).
+
+5000 pts / 4 centers / 5-D, k=4, max_iter=30, tol=1e-5, compute_sse=True;
+walk ``sse_history`` asserting no increase beyond 1e-6 (the reference's
+numerical slack, kmeans_spark.py:487-494).
+"""
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+
+from kmeans_tpu import KMeans
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_sse_monotonically_decreases(mesh8, dtype):
+    X, _ = make_blobs(n_samples=5000, centers=4, n_features=5,
+                      random_state=42)
+    km = KMeans(k=4, max_iter=30, tolerance=1e-5, seed=42, compute_sse=True,
+                mesh=mesh8, dtype=dtype, verbose=False).fit(X)
+    h = km.sse_history
+    assert len(h) >= 2
+    for i in range(1, len(h)):
+        assert h[i] <= h[i - 1] + 1e-6, \
+            f"SSE increased from {h[i-1]} to {h[i]} at iteration {i+1}"
+
+
+def test_sse_history_empty_when_disabled(mesh8):
+    X, _ = make_blobs(n_samples=500, centers=3, n_features=2,
+                      random_state=42)
+    km = KMeans(k=3, compute_sse=False, mesh=mesh8, verbose=False).fit(X)
+    assert km.sse_history == []          # flag semantics, kmeans_spark.py:277
+    assert km.iterations_run >= 1        # fixed reference bug (SURVEY §2.1)
+
+
+def test_converges_before_max_iter(mesh8):
+    X, _ = make_blobs(n_samples=2000, centers=3, n_features=2,
+                      random_state=0, cluster_std=0.3)
+    km = KMeans(k=3, max_iter=100, tolerance=1e-4, seed=1, mesh=mesh8,
+                verbose=False).fit(X)
+    assert km.iterations_run < 100       # early stop, kmeans_spark.py:310-313

@@ -1,0 +1,95 @@
+"""Test A capability: correctness parity against the sklearn gold standard.
+
+Reproduces the reference's T1 (``test_a_correctness``, kmeans_spark.py:
+355-399) as a REAL assertion (the reference swallows its own AssertionError,
+:391-397, so its exit code never reflects failure — SURVEY.md §4): 1000
+points / 3 centers / 2-D make_blobs(random_state=42); sorted centroids within
+``atol=1e-4`` of sklearn.
+
+One strengthening over the reference: T1 only matched sklearn because two
+DIFFERENT random inits (Spark ``takeSample`` vs sklearn's weighted
+``RandomState.choice``) happened to converge to the same optimum on this easy
+fixture.  Here the trajectory-parity tests pin the SAME explicit init on both
+implementations (both APIs accept an init array), so centroid equality is a
+property of the algorithm, not of fixture luck; a separate test covers
+default-init quality parity via SSE.
+"""
+
+import numpy as np
+import pytest
+from sklearn.cluster import KMeans as SklearnKMeans
+
+from kmeans_tpu import KMeans
+
+
+def _sorted(c):
+    return np.array(sorted(np.asarray(c).tolist()))
+
+
+def _shared_init(X, k, seed=42):
+    rng = np.random.RandomState(seed)
+    return X[rng.choice(X.shape[0], size=k, replace=False)]
+
+
+@pytest.mark.parametrize("mode", ["matmul", "direct"])
+def test_parity_with_sklearn(blobs_small, mesh8, mode):
+    # Run BOTH to the exact Lloyd fixed point (tiny tol) — sklearn scales its
+    # tol by data variance, so matching loose tolerances compares stopping
+    # criteria, not the algorithm.  At the fixed point the comparison is
+    # sharp and the reference's atol=1e-4 (kmeans_spark.py:392) is easy.
+    X, _ = blobs_small
+    init = _shared_init(X, 3)
+    ours = KMeans(k=3, max_iter=300, tolerance=1e-12, seed=42,
+                  compute_sse=True, init=init, mesh=mesh8, dtype=np.float64,
+                  distance_mode=mode, verbose=False).fit(X)
+    ref = SklearnKMeans(n_clusters=3, init=init, n_init=1, max_iter=300,
+                        random_state=42, tol=1e-14).fit(X)
+    np.testing.assert_allclose(
+        _sorted(ours.centroids), _sorted(ref.cluster_centers_), atol=1e-4)
+
+
+def test_default_init_quality_parity(blobs_small, mesh8):
+    # Default seeded Forgy init vs sklearn's default run: same fixture, SSE
+    # within 1% — the robust version of the reference's luck-dependent check.
+    X, _ = blobs_small
+    ours = KMeans(k=3, max_iter=100, tolerance=1e-4, seed=0,
+                  compute_sse=True, mesh=mesh8, dtype=np.float64,
+                  verbose=False).fit(X)
+    ref = SklearnKMeans(n_clusters=3, n_init=10, random_state=0).fit(X)
+    assert ours.inertia_ <= ref.inertia_ * 1.01
+
+
+def test_parity_float32_single_device(blobs_small, mesh1):
+    # The TPU-realistic dtype still matches the oracle on this fixture.
+    X, _ = blobs_small
+    init = _shared_init(X, 3)
+    ours = KMeans(k=3, max_iter=300, tolerance=1e-7, seed=42,
+                  compute_sse=True, init=init, mesh=mesh1,
+                  dtype=np.float32, verbose=False).fit(X)
+    ref = SklearnKMeans(n_clusters=3, init=init, n_init=1, max_iter=300,
+                        random_state=42, tol=1e-14).fit(X)
+    np.testing.assert_allclose(
+        _sorted(ours.centroids), _sorted(ref.cluster_centers_), atol=1e-3)
+
+
+def test_final_sse_matches_sklearn_inertia(blobs_small, mesh8):
+    X, _ = blobs_small
+    init = _shared_init(X, 3)
+    ours = KMeans(k=3, seed=42, compute_sse=True, init=init, mesh=mesh8,
+                  dtype=np.float64, verbose=False).fit(X)
+    ref = SklearnKMeans(n_clusters=3, init=init, n_init=1,
+                        random_state=42, tol=1e-4).fit(X)
+    # Our recorded SSE is measured against each iteration's STARTING
+    # centroids (reference semantics, kmeans_spark.py:279); at convergence
+    # the assignment is stable so it equals sklearn's inertia_.
+    assert ours.inertia_ == pytest.approx(ref.inertia_, rel=1e-4)
+
+
+def test_predict_self_consistent(blobs_small, mesh8):
+    X, _ = blobs_small
+    ours = KMeans(k=3, seed=42, mesh=mesh8, dtype=np.float64,
+                  verbose=False).fit(X)
+    labels = ours.predict(X)
+    # Internal consistency: every point is closest to its assigned centroid.
+    d = ours.transform(X)
+    np.testing.assert_array_equal(labels, np.argmin(d, axis=1))

@@ -1,0 +1,75 @@
+"""Sharding correctness: mesh layouts must not change the math.
+
+The reference never verifies that different partition counts give the same
+answer (SURVEY.md §4); here it's a hard invariant: 1-device, 8-way DP, and
+4x2 DP x TP (centroid-sharded) runs must agree, including the padded-k path
+when k doesn't divide the model axis.
+"""
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+
+from kmeans_tpu import KMeans
+from kmeans_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def data():
+    X, _ = make_blobs(n_samples=3000, centers=5, n_features=8,
+                      random_state=11)
+    return X
+
+
+def _fit(mesh, data, **kw):
+    km = KMeans(k=5, max_iter=25, seed=42, compute_sse=True, mesh=mesh,
+                dtype=np.float64, verbose=False, **kw)
+    return km.fit(data)
+
+
+def test_dp_matches_single_device(data, mesh1, mesh8):
+    a = _fit(mesh1, data)
+    b = _fit(mesh8, data)
+    np.testing.assert_allclose(a.centroids, b.centroids, atol=1e-9)
+    np.testing.assert_allclose(a.sse_history, b.sse_history, rtol=1e-12)
+    assert a.iterations_run == b.iterations_run
+
+
+def test_tp_matches_dp(data, mesh8, mesh4x2):
+    a = _fit(mesh8, data)
+    b = _fit(mesh4x2, data)     # k=5 doesn't divide model=2 -> padded table
+    np.testing.assert_allclose(a.centroids, b.centroids, atol=1e-9)
+    np.testing.assert_allclose(a.sse_history, b.sse_history, rtol=1e-12)
+
+
+def test_tp_predict_matches(data, mesh8, mesh4x2):
+    a = _fit(mesh8, data)
+    b = _fit(mesh4x2, data)
+    np.testing.assert_array_equal(a.predict(data), b.predict(data))
+
+
+def test_uneven_shard_padding(mesh8):
+    # N deliberately prime: shards can't be even -> exercises pad path.
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(1009, 3))
+    km = KMeans(k=4, mesh=mesh8, dtype=np.float64, verbose=False).fit(X)
+    assert int(km.cluster_sizes_.sum()) == 1009   # padding rows inert
+
+
+def test_various_mesh_shapes(data):
+    import jax
+    for shape in [(2, 1), (2, 2), (1, 8), (8, 1)]:
+        mesh = make_mesh(data=shape[0], model=shape[1],
+                         devices=jax.devices()[: shape[0] * shape[1]])
+        km = _fit(mesh, data)
+        assert np.all(np.isfinite(km.centroids))
+
+
+def test_mesh_validation():
+    import jax
+    with pytest.raises(ValueError, match="divisible"):
+        make_mesh(model=3, devices=jax.devices()[:8])
+    with pytest.raises(ValueError, match="positive"):
+        make_mesh(model=0)
+    with pytest.raises(ValueError, match="needs"):
+        make_mesh(data=16, model=1)

@@ -1,0 +1,52 @@
+"""Test D capability: empty-cluster handling (kmeans_spark.py:503-540).
+
+3 tight blobs (cluster_std=0.5), deliberately k=6 to force empties; passes if
+fit completes with all-finite centroids.  Also covers the policies the
+reference could not test: the deterministic resample divergence and the
+farthest-point policy (dead code in the reference, kmeans_spark.py:84-129,
+live here).
+"""
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+
+from kmeans_tpu import KMeans
+
+
+@pytest.fixture()
+def tight_blobs():
+    X, _ = make_blobs(n_samples=800, centers=3, n_features=2,
+                      cluster_std=0.5, random_state=42)
+    return X
+
+
+@pytest.mark.parametrize("policy", ["resample", "farthest", "keep"])
+def test_overclustered_fit_stays_finite(tight_blobs, mesh8, policy):
+    km = KMeans(k=6, max_iter=30, tolerance=1e-4, seed=42, compute_sse=True,
+                empty_cluster=policy, mesh=mesh8, verbose=False)
+    km.fit(tight_blobs)
+    assert km.centroids.shape == (6, 2)
+    assert np.all(np.isfinite(km.centroids))     # kmeans_spark.py:529-535
+
+
+def test_resample_is_deterministic(tight_blobs, mesh8):
+    # Deliberate divergence from the reference's time.time() seed
+    # (kmeans_spark.py:195-196): two identical runs now agree exactly.
+    runs = [KMeans(k=6, max_iter=30, seed=42, mesh=mesh8,
+                   verbose=False).fit(tight_blobs).centroids
+            for _ in range(2)]
+    np.testing.assert_array_equal(runs[0], runs[1])
+
+
+def test_farthest_policy_uses_a_data_point(mesh8):
+    # Force an empty cluster with an explicit-array init: two centroids on
+    # the data, one far away that captures nothing.
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 2)).astype(np.float64)
+    init = np.array([[0.0, 0.0], [0.5, 0.5], [1e3, 1e3]])
+    km = KMeans(k=3, max_iter=1, init=init, empty_cluster="farthest",
+                mesh=mesh8, dtype=np.float64, verbose=False).fit(X)
+    # The empty slot was refilled with an actual data point.
+    replaced = km.centroids[2]
+    assert np.any(np.all(np.isclose(X, replaced[None, :], atol=1e-9), axis=1))

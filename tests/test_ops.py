@@ -1,0 +1,110 @@
+"""Unit tests for the fused assign+reduce kernels against a NumPy oracle.
+
+The reference has no kernel-level tests (its closures are only exercised
+end-to-end, SURVEY.md §4); these cover the gap: distances, argmin
+tie-breaking (NumPy lowest-index rule, kmeans_spark.py:156), one-hot
+reduction, padded-row inertness, SSE fusion, and farthest-point fusion
+(the reference's dead policy, kmeans_spark.py:103-119).
+"""
+
+import numpy as np
+import pytest
+
+from kmeans_tpu.ops.assign import (assign_chunk, assign_labels,
+                                   assign_reduce, pairwise_sq_dists)
+
+
+def _numpy_oracle(X, C):
+    """Per-point loop, exactly the reference's semantics
+    (kmeans_spark.py:147-159, :169-188, :224-235, :103-119)."""
+    k, d = C.shape
+    sums = np.zeros((k, d))
+    counts = np.zeros(k)
+    sse = 0.0
+    far_d, far_p = -1.0, None
+    labels = []
+    for p in X:
+        dist = np.linalg.norm(C - p, axis=1)
+        i = int(np.argmin(dist))
+        labels.append(i)
+        sums[i] += p
+        counts[i] += 1
+        sse += float(np.min(dist)) ** 2
+        if np.min(dist) ** 2 > far_d:
+            far_d, far_p = float(np.min(dist)) ** 2, p
+    return np.array(labels), sums, counts, sse, far_d, far_p
+
+
+@pytest.fixture()
+def xc():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(257, 5))
+    C = rng.normal(size=(7, 5))
+    return X, C
+
+
+@pytest.mark.parametrize("mode", ["matmul", "direct"])
+def test_pairwise_sq_dists(xc, mode):
+    X, C = xc
+    expected = ((X[:, None, :] - C[None, :, :]) ** 2).sum(-1)
+    got = np.asarray(pairwise_sq_dists(X, C, mode=mode))
+    np.testing.assert_allclose(got, expected, rtol=1e-10, atol=1e-10)
+
+
+def test_assign_chunk_matches_oracle(xc):
+    X, C = xc
+    labels, *_ = _numpy_oracle(X, C)
+    best, mind2 = assign_chunk(X, C)
+    np.testing.assert_array_equal(np.asarray(best), labels)
+
+
+def test_argmin_tie_breaks_to_lowest_index():
+    # Two identical centroids: NumPy's argmin (and the reference,
+    # kmeans_spark.py:156) picks index 0.
+    X = np.array([[1.0, 1.0], [2.0, 0.0]])
+    C = np.array([[1.0, 1.0], [1.0, 1.0], [5.0, 5.0]])
+    best, _ = assign_chunk(X, C)
+    np.testing.assert_array_equal(np.asarray(best), [0, 0])
+
+
+@pytest.mark.parametrize("mode", ["matmul", "direct"])
+def test_assign_reduce_matches_oracle(xc, mode):
+    X, C = xc
+    _, sums, counts, sse, far_d, far_p = _numpy_oracle(X, C)
+    # Pad to a chunk multiple with zero-weight rows.
+    chunk = 64
+    pad = (-len(X)) % chunk
+    Xp = np.concatenate([X, np.zeros((pad, X.shape[1]))])
+    w = np.concatenate([np.ones(len(X)), np.zeros(pad)])
+    stats = assign_reduce(Xp, w, C, chunk_size=chunk, mode=mode)
+    np.testing.assert_allclose(np.asarray(stats.sums), sums, atol=1e-8)
+    np.testing.assert_allclose(np.asarray(stats.counts), counts)
+    np.testing.assert_allclose(float(stats.sse), sse, rtol=1e-10)
+    np.testing.assert_allclose(float(stats.farthest_dist), far_d, rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(stats.farthest_point), far_p,
+                               atol=1e-12)
+
+
+def test_padding_rows_are_inert(xc):
+    X, C = xc
+    chunk = 128
+    pad = (-len(X)) % chunk
+    Xp = np.concatenate([X, 1e6 * np.ones((pad, X.shape[1]))])  # poison rows
+    w = np.concatenate([np.ones(len(X)), np.zeros(pad)])
+    stats = assign_reduce(Xp, w, C, chunk_size=chunk)
+    assert float(stats.counts.sum()) == len(X)
+    assert float(stats.farthest_dist) < 1e6  # poison never wins farthest
+
+
+def test_assign_labels_handles_any_length(xc):
+    X, C = xc
+    labels, *_ = _numpy_oracle(X, C)
+    got = assign_labels(X, C, chunk_size=100)
+    assert got.shape == (len(X),)
+    np.testing.assert_array_equal(np.asarray(got), labels)
+
+
+def test_chunk_size_must_divide():
+    X = np.zeros((10, 2))
+    with pytest.raises(ValueError, match="multiple of chunk_size"):
+        assign_reduce(X, np.ones(10), np.zeros((2, 2)), chunk_size=64)

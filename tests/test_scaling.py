@@ -1,0 +1,51 @@
+"""Test E capability: strong-scaling run + speedup graph artifact
+(kmeans_spark.py:543-621): 50k x 10, k=5, max_iter=10, swept over shard
+counts, speedup = t[1]/t[n], matplotlib Agg plot of ideal-vs-actual saved to
+``speedup_graph.png``.
+
+On the CI's virtual CPU devices the timing is not meaningful (8 "devices"
+share the same cores), so the assertions cover completion, result
+equivalence across shard counts, and artifact generation; real speedup
+numbers come from `bench.py` on TPU hardware.
+"""
+
+import time
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+
+from kmeans_tpu import KMeans
+from kmeans_tpu.parallel.mesh import make_mesh
+from kmeans_tpu.utils.plotting import save_speedup_graph
+
+SHARD_COUNTS = [1, 2, 4, 8]
+
+
+@pytest.mark.slow
+def test_speedup_sweep_and_graph(tmp_path):
+    X, _ = make_blobs(n_samples=50_000, centers=5, n_features=10,
+                      random_state=42)
+    X = X.astype(np.float32)
+    times, results = {}, {}
+    for n in SHARD_COUNTS:
+        mesh = make_mesh(data=n, model=1, devices=jax.devices()[:n])
+        km = KMeans(k=5, max_iter=10, tolerance=1e-4, seed=42,
+                    compute_sse=False, mesh=mesh, verbose=False)
+        km.fit(X)               # warmup (compile) — the reference times cold
+        km2 = KMeans(k=5, max_iter=10, tolerance=1e-4, seed=42,
+                     compute_sse=False, mesh=mesh, verbose=False)
+        start = time.perf_counter()
+        km2.fit(X)
+        times[n] = time.perf_counter() - start
+        results[n] = np.array(sorted(km2.centroids.tolist()))
+
+    for n in SHARD_COUNTS[1:]:  # same answer at every parallelism degree
+        np.testing.assert_allclose(results[1], results[n], atol=1e-3)
+
+    speedups = {n: times[1] / times[n] for n in SHARD_COUNTS}
+    out = tmp_path / "speedup_graph.png"
+    save_speedup_graph(SHARD_COUNTS, speedups, out)
+    assert out.exists() and out.stat().st_size > 0
