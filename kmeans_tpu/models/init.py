@@ -7,6 +7,9 @@
 * ``kmeanspp_init`` — beyond-reference superset: D² weighting (Arthur &
   Vassilvitskii 2007), distance updates jit-compiled on device so the O(nkD)
   work runs on the MXU; only the per-step categorical draw happens host-side.
+
+All entry points accept either a host ``(n, D)`` array or a
+``parallel.sharding.ShardedDataset`` (row access via ``.take``).
 """
 
 from __future__ import annotations
@@ -17,13 +20,38 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from kmeans_tpu.parallel.sharding import global_sample_rows
 from kmeans_tpu.utils.validation import check_finite_array
 
 
-def forgy_init(X: np.ndarray, k: int, seed: int) -> np.ndarray:
+class _ArraySource:
+    """Adapter giving a host ndarray the ShardedDataset row-access API."""
+
+    def __init__(self, X: np.ndarray):
+        self._X = np.asarray(X)
+        self.n, self.d = self._X.shape
+        self.dtype = self._X.dtype
+
+    def take(self, idx):
+        return self._X[idx]
+
+    @property
+    def host(self):
+        return self._X
+
+
+def as_source(X):
+    return X if hasattr(X, "take") and hasattr(X, "n") else _ArraySource(X)
+
+
+def forgy_init(X, k: int, seed: int) -> np.ndarray:
     """Seeded sample of k distinct rows (kmeans_spark.py:58-82 semantics)."""
-    centroids = global_sample_rows(X, X.shape[0], k, seed)
+    src = as_source(X)
+    if src.n < k:
+        raise ValueError(
+            f"Not enough data points ({src.n}) to initialize {k} clusters")
+    rng = np.random.RandomState(seed)
+    idx = rng.choice(src.n, size=k, replace=False)
+    centroids = np.asarray(src.take(idx))
     # Same message as the reference's finite guard (kmeans_spark.py:79-80).
     check_finite_array(centroids, "Data contains NaN or Inf values")
     return centroids
@@ -35,8 +63,14 @@ def _update_mind2(x: jax.Array, mind2: jax.Array, c: jax.Array) -> jax.Array:
     return jnp.minimum(mind2, d2)
 
 
-def kmeanspp_init(X: np.ndarray, k: int, seed: int) -> np.ndarray:
+def kmeanspp_init(X, k: int, seed: int) -> np.ndarray:
     """k-means++ seeding; device-accelerated distance maintenance."""
+    src = as_source(X)
+    host = getattr(src, "host", None)
+    if host is None:
+        raise ValueError("k-means++ init requires host data; pass a NumPy "
+                         "array (not a pre-sharded ShardedDataset)")
+    X = host
     n = X.shape[0]
     if n < k:
         raise ValueError(
@@ -66,20 +100,24 @@ INITIALIZERS = {"forgy": forgy_init, "random": forgy_init,
                 "k-means++": kmeanspp_init, "kmeans++": kmeanspp_init}
 
 
-def resolve_init(init, X: np.ndarray, k: int, seed: int) -> np.ndarray:
+def resolve_init(init, X, k: int, seed: int) -> np.ndarray:
     """Dispatch: strategy name, callable, or an explicit (k, D) array."""
+    src = as_source(X)
+    dtype = np.dtype(str(src.dtype))
     if callable(init):
-        return np.asarray(init(X, k, seed), dtype=X.dtype)
+        host = getattr(src, "host", None)
+        return np.asarray(init(host if host is not None else src, k, seed),
+                          dtype=dtype)
     if isinstance(init, str):
         try:
             fn = INITIALIZERS[init]
         except KeyError:
             raise ValueError(f"unknown init strategy: {init!r}; "
                              f"options: {sorted(INITIALIZERS)}") from None
-        return np.asarray(fn(X, k, seed), dtype=X.dtype)
-    arr = np.asarray(init, dtype=X.dtype)
-    if arr.shape != (k, X.shape[1]):
+        return np.asarray(fn(src, k, seed), dtype=dtype)
+    arr = np.asarray(init, dtype=dtype)
+    if arr.shape != (k, src.d):
         raise ValueError(f"explicit init must have shape ({k}, "
-                         f"{X.shape[1]}), got {arr.shape}")
+                         f"{src.d}), got {arr.shape}")
     check_finite_array(arr, "Data contains NaN or Inf values")
     return arr

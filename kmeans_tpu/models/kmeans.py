@@ -48,8 +48,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from kmeans_tpu.ops.assign import StepStats, pairwise_sq_dists
 from kmeans_tpu.parallel import distributed as dist
 from kmeans_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh, mesh_shape
-from kmeans_tpu.parallel.sharding import (choose_chunk_size, pad_points,
-                                          shard_points)
+from kmeans_tpu.parallel.sharding import (ShardedDataset, choose_chunk_size,
+                                          to_device)
 from kmeans_tpu.models.init import resolve_init
 from kmeans_tpu.utils.logging import IterationLogger
 from kmeans_tpu.utils.validation import check_finite_array, validate_params
@@ -113,6 +113,7 @@ class KMeans:
                  model_shards: int = 1,
                  chunk_size: Optional[int] = None,
                  distance_mode: str = "matmul",
+                 host_loop: bool = True,
                  verbose: bool = True):
         self.k = k
         self.max_iter = max_iter
@@ -129,6 +130,7 @@ class KMeans:
         self.model_shards = model_shards
         self.chunk_size = chunk_size
         self.distance_mode = distance_mode
+        self.host_loop = host_loop
         self.verbose = verbose
 
         self.centroids: Optional[np.ndarray] = None   # kmeans_spark.py:44
@@ -157,12 +159,43 @@ class KMeans:
         step_fn, predict_fn = _get_step_fns(mesh, chunk, self.distance_mode)
         return mesh, model_shards, step_fn, predict_fn, chunk
 
-    def _prepare(self, X: np.ndarray):
-        """Shard the data; build (or fetch cached) step functions."""
-        n, d = X.shape
-        mesh, model_shards, step_fn, predict_fn, chunk = self._setup(n, d)
-        points, weights = shard_points(X, mesh, chunk)
-        return mesh, model_shards, points, weights, step_fn, predict_fn, chunk
+    def cache(self, X) -> ShardedDataset:
+        """Upload X once as a device-resident ShardedDataset (the
+        ``rdd.cache()`` analogue, kmeans_spark.py:256).  Pass the result to
+        ``fit``/``predict``/``score`` to skip re-uploading on every call."""
+        X = np.asarray(X, dtype=self.dtype)
+        if X.ndim != 2:
+            raise ValueError(f"X must be 2-D (n, D), got shape {X.shape}")
+        return to_device(X, self._resolve_mesh(),
+                         self._chunk_for(*X.shape), self.dtype)
+
+    def _dataset(self, X) -> ShardedDataset:
+        """Accept an (n, D) array-like or an already-cached ShardedDataset."""
+        if isinstance(X, ShardedDataset):
+            if X.mesh is not None and self.mesh is not None \
+                    and X.mesh is not self.mesh:
+                raise ValueError(
+                    "ShardedDataset was placed on a different mesh")
+            if X.mesh is not None:
+                self.mesh = X.mesh        # adopt the dataset's mesh
+            if X.dtype != self.dtype:
+                raise ValueError(f"ShardedDataset dtype {X.dtype} != model "
+                                 f"dtype {self.dtype}")
+            return X
+        return self.cache(X)
+
+    def _prepare(self, X):
+        """Place the data; build (or fetch cached) step functions.
+
+        Step functions are built for the dataset's OWN chunk size (its
+        padding commits to it), which may differ from what ``_chunk_for``
+        would pick for this model's k."""
+        ds = self._dataset(X)
+        mesh = self._resolve_mesh()
+        _, model_shards = mesh_shape(mesh)
+        step_fn, predict_fn = _get_step_fns(mesh, ds.chunk,
+                                            self.distance_mode)
+        return ds, mesh, model_shards, step_fn, predict_fn
 
     def _put_centroids(self, centroids: np.ndarray, mesh: Mesh,
                        model_shards: int) -> jax.Array:
@@ -173,20 +206,16 @@ class KMeans:
     # ------------------------------------------------------------------- fit
 
     def fit(self, X, *, resume: bool = False) -> "KMeans":
-        """Fit on (n, D) array-like.  Returns self (kmeans_spark.py:239-319).
+        """Fit on (n, D) array-like or a cached ShardedDataset.
+        Returns self (kmeans_spark.py:239-319).
 
         ``resume=True`` continues from the current ``centroids`` /
         ``iterations_run`` (e.g. after ``KMeans.load``) instead of
         re-initializing — a capability the reference lacks (no checkpointing,
         SURVEY.md §5).
         """
-        X = np.ascontiguousarray(np.asarray(X, dtype=self.dtype))
-        if X.ndim != 2:
-            raise ValueError(f"X must be 2-D (n, D), got shape {X.shape}")
-        n, d = X.shape
-
         log = IterationLogger(self.verbose)
-        mesh, model_shards, points, weights, step_fn, _, _ = self._prepare(X)
+        ds, mesh, model_shards, step_fn, _ = self._prepare(X)
 
         start_iter = 0
         if resume and self.centroids is not None:
@@ -194,15 +223,19 @@ class KMeans:
             start_iter = self.iterations_run
         else:
             # Forgy/k-means++/explicit init (kmeans_spark.py:58-82, :259).
-            centroids = resolve_init(self.init, X, self.k, self.seed)
+            centroids = resolve_init(self.init, ds, self.k, self.seed)
             self.sse_history = []
             self.iterations_run = 0
 
         log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
 
+        if not self.host_loop:
+            return self._fit_on_device(ds, centroids, start_iter, mesh,
+                                       model_shards, log)
+
         cents_dev = self._put_centroids(centroids, mesh, model_shards)
         for iteration in range(start_iter, self.max_iter):
-            stats: StepStats = step_fn(points, weights, cents_dev)
+            stats: StepStats = step_fn(ds.points, ds.weights, cents_dev)
             # Host does exactly the driver's O(k*D) work
             # (kmeans_spark.py:181-188) — in float64 for stable division.
             sums = np.asarray(stats.sums, dtype=np.float64)[: self.k]
@@ -213,7 +246,7 @@ class KMeans:
                 sums / np.maximum(counts, 1.0)[:, None],
                 centroids.astype(np.float64))
             new_centroids = self._handle_empty(
-                new_centroids, nonempty, X, stats, iteration, log)
+                new_centroids, nonempty, ds, stats, iteration, log)
             new_centroids = new_centroids.astype(self.dtype)
 
             if self.compute_sse:          # SSE vs starting centroids (:279)
@@ -250,8 +283,54 @@ class KMeans:
             cents_dev = self._put_centroids(centroids, mesh, model_shards)
         return self
 
+    def _fit_on_device(self, ds, centroids, start_iter, mesh, model_shards,
+                       log) -> "KMeans":
+        """Whole-fit-in-one-dispatch path (``host_loop=False``): every
+        iteration runs inside a device-side ``lax.while_loop`` — no
+        per-iteration host synchronization.  See
+        parallel.distributed.make_fit_fn for semantics and trade-offs."""
+        iters_left = self.max_iter - start_iter
+        key = (mesh, ds.chunk, self.distance_mode, self.k, iters_left,
+               float(self.tolerance), self.empty_cluster, "fit")
+        if key not in _STEP_CACHE:
+            _STEP_CACHE[key] = dist.make_fit_fn(
+                mesh, chunk_size=ds.chunk, mode=self.distance_mode,
+                k_real=self.k, max_iter=iters_left,
+                tolerance=float(self.tolerance),
+                empty_policy=self.empty_cluster)
+        fit_fn = _STEP_CACHE[key]
+        cents_dev = self._put_centroids(centroids, mesh, model_shards)
+        cents, n_iters, sse_hist, shift_hist, counts = fit_fn(
+            ds.points, ds.weights, cents_dev)
+        n_iters = int(n_iters)
+        self.centroids = np.asarray(cents, dtype=self.dtype)
+        if not np.all(np.isfinite(self.centroids)):   # kmeans_spark.py:289
+            raise ValueError(
+                f"NaN or Inf detected in centroids at iteration "
+                f"{start_iter + n_iters}")
+        self.cluster_sizes_ = np.asarray(counts, dtype=np.int64)
+        self.iterations_run = start_iter + n_iters
+        sse_hist = np.asarray(sse_hist, dtype=np.float64)[:n_iters]
+        shift_hist = np.asarray(shift_hist, dtype=np.float64)[:n_iters]
+        if self.compute_sse:
+            for sse in sse_hist:
+                self.sse_history.append(float(sse))
+                if len(self.sse_history) > 1 and \
+                        self.sse_history[-1] > self.sse_history[-2] + 1e-6:
+                    log.warn_sse_increase(self.sse_history[-2],
+                                          self.sse_history[-1])
+        # Per-iteration prints don't exist in one-dispatch mode; emit the
+        # final state in the reference's line format instead.
+        log.iteration(self.iterations_run - 1, float(shift_hist[-1])
+                      if n_iters else 0.0, list(self.cluster_sizes_),
+                      self.sse_history[-1] if
+                      (self.compute_sse and self.sse_history) else None)
+        if n_iters and shift_hist[-1] < self.tolerance:
+            log.converged(self.iterations_run)
+        return self
+
     def _handle_empty(self, new_centroids: np.ndarray, nonempty: np.ndarray,
-                      X: np.ndarray, stats: StepStats, iteration: int,
+                      ds: ShardedDataset, stats: StepStats, iteration: int,
                       log: IterationLogger) -> np.ndarray:
         """Empty-cluster recovery (kmeans_spark.py:190-204 / :84-129)."""
         empty_ids = np.flatnonzero(~nonempty)
@@ -266,16 +345,17 @@ class KMeans:
             # farthest from its nearest centroid replaces the first empty.
             far = np.asarray(stats.farthest_point, dtype=np.float64)
             if float(stats.farthest_dist) >= 0:
-                new_centroids[filled[0]] = far[: X.shape[1]]
+                new_centroids[filled[0]] = far[: ds.d]
                 filled = filled[1:]
         if filled:
             # Deterministic replacement sampling — the reference's live
             # policy (:191-204) minus its time.time() seed (:195-196).
             rng = np.random.default_rng([self.seed, iteration + 1])
-            take = min(len(filled), X.shape[0])
-            idx = rng.choice(X.shape[0], size=take, replace=False)
-            for slot, row in zip(filled[:take], idx):
-                new_centroids[slot] = X[row]
+            take = min(len(filled), ds.n)
+            idx = rng.choice(ds.n, size=take, replace=False)
+            rows = ds.take(idx)
+            for slot, row in zip(filled[:take], rows):
+                new_centroids[slot] = row
             # Under-returned samples keep the old centroid (:201-204),
             # already present in new_centroids.
         return new_centroids
@@ -290,13 +370,11 @@ class KMeans:
         """
         if self.centroids is None:
             raise ValueError("Model must be fitted before prediction")
-        X = np.ascontiguousarray(np.asarray(X, dtype=self.dtype))
-        n = X.shape[0]
-        mesh, model_shards, points, _, _, predict_fn, _ = self._prepare(X)
+        ds, mesh, model_shards, _, predict_fn = self._prepare(X)
         cents_dev = self._put_centroids(
             np.asarray(self.centroids), mesh, model_shards)
-        labels = predict_fn(points, cents_dev)
-        return np.asarray(labels)[:n]
+        labels = predict_fn(ds.points, cents_dev)
+        return np.asarray(labels)[: ds.n]
 
     def fit_predict(self, X) -> np.ndarray:
         return self.fit(X).predict(X)
@@ -314,11 +392,10 @@ class KMeans:
         """Negative SSE of X under the fitted centroids (sklearn convention)."""
         if self.centroids is None:
             raise ValueError("Model must be fitted before prediction")
-        X = np.ascontiguousarray(np.asarray(X, dtype=self.dtype))
-        mesh, model_shards, points, weights, step_fn, _, _ = self._prepare(X)
+        ds, mesh, model_shards, step_fn, _ = self._prepare(X)
         cents_dev = self._put_centroids(
             np.asarray(self.centroids), mesh, model_shards)
-        stats = step_fn(points, weights, cents_dev)
+        stats = step_fn(ds.points, ds.weights, cents_dev)
         return -float(stats.sse)
 
     # ---------------------------------------------------- sklearn-style sugar
@@ -353,6 +430,7 @@ class KMeans:
             "distance_mode": self.distance_mode,
             "model_shards": self.model_shards,
             "chunk_size": self.chunk_size,
+            "host_loop": self.host_loop,
             "verbose": self.verbose,
             "sse_history": list(map(float, self.sse_history)),
             "iterations_run": self.iterations_run,
@@ -385,6 +463,7 @@ class KMeans:
                     distance_mode=state["distance_mode"],
                     model_shards=state["model_shards"],
                     chunk_size=state["chunk_size"],
+                    host_loop=state.get("host_loop", True),
                     verbose=state["verbose"],
                     dtype=np.dtype(state["dtype"]),
                     **cls._load_kwargs(state))

@@ -27,6 +27,12 @@ class MiniBatchKMeans(KMeans):
         self.batch_size = batch_size
 
     def fit(self, X, *, resume: bool = False) -> "MiniBatchKMeans":
+        from kmeans_tpu.parallel.sharding import ShardedDataset
+        if isinstance(X, ShardedDataset):
+            if X.host is None:
+                raise ValueError("MiniBatchKMeans needs host data to draw "
+                                 "batches; pass a NumPy array")
+            X = X.host
         X = np.ascontiguousarray(np.asarray(X, dtype=self.dtype))
         if X.ndim != 2:
             raise ValueError(f"X must be 2-D (n, D), got shape {X.shape}")

@@ -140,6 +140,109 @@ def make_step_fn(mesh: Mesh, *, chunk_size: int,
     return jax.jit(mapped)
 
 
+def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
+                k_real: int, max_iter: int, tolerance: float,
+                empty_policy: str = "keep", history_sse: bool = True):
+    """Build a FULLY ON-DEVICE training loop: one dispatch runs all
+    iterations under ``lax.while_loop``.
+
+    The reference's driver round-trips to the cluster 2-3 times per iteration
+    (broadcast/collect/sample, SURVEY.md §3.1); the host-loop ``KMeans.fit``
+    already collapses that to one dispatch per iteration; this collapses the
+    WHOLE fit to one dispatch — no per-iteration host sync at all, which
+    matters when dispatch latency is comparable to compute (remote/tunneled
+    chips, small problems).  Trade-offs (mirroring the reference's own
+    ``compute_sse`` speed/observability toggle, kmeans_spark.py:34):
+
+    * no per-iteration host logging (the SSE/shift history is returned as
+      fixed-size arrays instead);
+    * centroid division happens in the accumulation dtype on device (the
+      host loop divides in float64);
+    * empty-cluster policy must be device-expressible: 'keep' (retain old
+      centroid, the reference's fallback :201-204) or 'farthest' (refill the
+      first empty slot with the fused farthest point, the :84-129 policy;
+      multiple empties drain across iterations).  'resample' requires host
+      data access -> use the host loop.
+
+    Returns ``fit(points, weights, centroids0) ->
+    (centroids, n_iters, sse_history[max_iter], shift_history[max_iter],
+    counts)`` with everything replicated.
+    """
+    if empty_policy not in ("keep", "farthest"):
+        raise ValueError(
+            f"on-device loop supports empty_cluster 'keep' or 'farthest', "
+            f"got {empty_policy!r} (use the host loop for 'resample')")
+    data_shards, model_shards = mesh_shape(mesh)
+
+    def fit(points, weights, centroids_block):
+        k_local, d = centroids_block.shape
+        acc = _accum_dtype(points.dtype)
+        k_pad = k_local * model_shards
+        m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
+        real = jnp.arange(k_pad) < k_real          # mask off sentinel rows
+
+        def global_stats(cents_block):
+            st = _local_stats(points, weights, cents_block,
+                              chunk_size=chunk_size, mode=mode,
+                              model_shards=model_shards)
+            off = jnp.asarray(m_idx * k_local, jnp.int32)
+            sums = lax.psum(lax.dynamic_update_slice(
+                jnp.zeros((k_pad, d), acc), st.sums, (off, jnp.int32(0))),
+                (DATA_AXIS, MODEL_AXIS))
+            counts = lax.psum(lax.dynamic_update_slice(
+                jnp.zeros((k_pad,), acc), st.counts, (off,)),
+                (DATA_AXIS, MODEL_AXIS))
+            sse = lax.psum(st.sse, (DATA_AXIS, MODEL_AXIS)) / model_shards
+            far_ds = lax.all_gather(st.farthest_dist,
+                                    (DATA_AXIS, MODEL_AXIS))
+            far_ps = lax.all_gather(st.farthest_point,
+                                    (DATA_AXIS, MODEL_AXIS))
+            j = jnp.argmax(far_ds)
+            return sums, counts, sse, far_ps[j]
+
+        def body(state):
+            i, cents_full, _, sse_hist, shift_hist, _ = state
+            cents_block = lax.dynamic_slice(
+                cents_full, (jnp.asarray(m_idx * k_local, jnp.int32),
+                             jnp.int32(0)), (k_local, d))
+            sums, counts, sse, far_p = global_stats(cents_block)
+            mean = sums / jnp.maximum(counts, 1.0)[:, None]
+            new = jnp.where((counts > 0)[:, None], mean.astype(acc),
+                            cents_full)
+            if empty_policy == "farthest":
+                is_empty = (counts <= 0) & real
+                first_empty = jnp.argmax(is_empty)
+                refill = jnp.where(jnp.any(is_empty),
+                                   far_p[:d].astype(acc), new[first_empty])
+                new = new.at[first_empty].set(refill)
+            shifts = jnp.sqrt(jnp.sum((new - cents_full) ** 2, axis=1))
+            max_shift = jnp.max(jnp.where(real, shifts, 0.0))
+            sse_hist = sse_hist.at[i].set(sse)
+            shift_hist = shift_hist.at[i].set(max_shift)
+            return i + 1, new, max_shift, sse_hist, shift_hist, counts
+
+        def cond(state):
+            i, _, max_shift, *_ = state
+            return (i < max_iter) & ((i == 0) | (max_shift >= tolerance))
+
+        cents0 = lax.all_gather(centroids_block, MODEL_AXIS,
+                                tiled=True).astype(acc) \
+            if model_shards > 1 else centroids_block.astype(acc)
+        state = (jnp.int32(0), cents0, jnp.asarray(jnp.inf, acc),
+                 jnp.zeros((max_iter,), acc), jnp.zeros((max_iter,), acc),
+                 jnp.zeros((k_pad,), acc))
+        i, cents, _, sse_hist, shift_hist, counts = lax.while_loop(
+            cond, body, state)
+        return cents[:k_real], i, sse_hist, shift_hist, counts[:k_real]
+
+    mapped = jax.shard_map(
+        fit, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(MODEL_AXIS, None)),
+        out_specs=(P(None, None), P(), P(), P(), P(None)),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
 def make_predict_fn(mesh: Mesh, *, chunk_size: int,
                     mode: str = "matmul") -> Callable:
     """Build the jitted SPMD label assignment: (points, centroids) -> labels.

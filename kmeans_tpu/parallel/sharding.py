@@ -64,6 +64,63 @@ def shard_points(x: np.ndarray, mesh: Optional[Mesh],
     return (jax.device_put(x_pad, xsh), jax.device_put(w_pad, wsh))
 
 
+class ShardedDataset:
+    """Device-resident, mesh-sharded points — the ``rdd.cache()`` analogue.
+
+    The reference re-reads its cached RDD every pass but pays Spark's
+    broadcast/shuffle machinery per iteration (kmeans_spark.py:256);
+    here the padded (points, weights) arrays are uploaded ONCE, stay sharded
+    on the mesh's data axis for their whole lifetime, and every
+    fit/predict/score against them is pure device compute.  Keeping a
+    host-side reference (when constructed from a NumPy array) makes
+    row-sampling — Forgy init (kmeans_spark.py:72) and empty-cluster
+    resampling (:196) — free instead of a device gather.
+    """
+
+    def __init__(self, points: jax.Array, weights: jax.Array, n: int,
+                 chunk: int, mesh: Optional[Mesh],
+                 host: Optional[np.ndarray] = None):
+        self.points = points
+        self.weights = weights
+        self.n = n
+        self.d = points.shape[1]
+        self.chunk = chunk
+        self.mesh = mesh
+        self._host = host
+
+    @property
+    def dtype(self):
+        return np.dtype(str(self.points.dtype))
+
+    @property
+    def host(self) -> Optional[np.ndarray]:
+        """Host copy of the (un-padded) data, when constructed from one."""
+        return self._host
+
+    def take(self, idx) -> np.ndarray:
+        """Gather rows by global index (all indices must be < n)."""
+        if self._host is not None:
+            return np.asarray(self._host[idx])
+        return np.asarray(self.points[np.asarray(idx)])
+
+
+def to_device(X, mesh: Optional[Mesh], chunk: int, dtype) -> ShardedDataset:
+    """Upload (n, D) host data once; pass-through if already a ShardedDataset
+    on a compatible (mesh, chunk)."""
+    if isinstance(X, ShardedDataset):
+        if mesh is not None and X.mesh is not mesh:
+            raise ValueError("ShardedDataset was placed on a different mesh")
+        if np.dtype(dtype) != X.dtype:
+            raise ValueError(f"ShardedDataset dtype {X.dtype} != model "
+                             f"dtype {np.dtype(dtype)}")
+        return X
+    X = np.ascontiguousarray(np.asarray(X, dtype=dtype))
+    if X.ndim != 2:
+        raise ValueError(f"X must be 2-D (n, D), got shape {X.shape}")
+    points, weights = shard_points(X, mesh, chunk)
+    return ShardedDataset(points, weights, X.shape[0], chunk, mesh, host=X)
+
+
 def global_sample_rows(x_source: np.ndarray, n_rows: int, k: int,
                        seed: int) -> np.ndarray:
     """Sample k distinct rows from the global index space, seeded.

@@ -1,0 +1,62 @@
+"""ShardedDataset (device-resident data, the ``rdd.cache()`` analogue,
+kmeans_spark.py:256): upload once, reuse across fit/predict/score."""
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+
+from kmeans_tpu import KMeans, ShardedDataset
+
+
+@pytest.fixture(scope="module")
+def data():
+    X, _ = make_blobs(n_samples=2500, centers=4, n_features=6,
+                      random_state=3)
+    return X.astype(np.float64)
+
+
+def test_cached_dataset_matches_array_fit(data, mesh8):
+    km_a = KMeans(k=4, seed=1, compute_sse=True, mesh=mesh8,
+                  dtype=np.float64, verbose=False).fit(data)
+    km_b = KMeans(k=4, seed=1, compute_sse=True, mesh=mesh8,
+                  dtype=np.float64, verbose=False)
+    ds = km_b.cache(data)
+    assert isinstance(ds, ShardedDataset) and ds.n == len(data)
+    km_b.fit(ds)
+    np.testing.assert_array_equal(km_a.centroids, km_b.centroids)
+    assert km_a.sse_history == km_b.sse_history
+    # Reuse for predict and score without re-upload.
+    np.testing.assert_array_equal(km_a.predict(data), km_b.predict(ds))
+    assert km_a.score(data) == pytest.approx(km_b.score(ds))
+
+
+def test_dataset_device_loop(data, mesh8):
+    km = KMeans(k=4, seed=1, empty_cluster="keep", mesh=mesh8,
+                dtype=np.float64, host_loop=False, verbose=False)
+    ds = km.cache(data)
+    km.fit(ds)
+    assert np.all(np.isfinite(km.centroids))
+
+
+def test_dataset_dtype_mismatch_raises(data, mesh8):
+    km32 = KMeans(k=4, mesh=mesh8, dtype=np.float32, verbose=False)
+    ds64 = KMeans(k=4, mesh=mesh8, dtype=np.float64,
+                  verbose=False).cache(data)
+    with pytest.raises(ValueError, match="dtype"):
+        km32.fit(ds64)
+
+
+def test_dataset_mesh_mismatch_raises(data, mesh8, mesh4x2):
+    ds = KMeans(k=4, mesh=mesh8, dtype=np.float64, verbose=False).cache(data)
+    km = KMeans(k=4, mesh=mesh4x2, dtype=np.float64, verbose=False)
+    with pytest.raises(ValueError, match="different mesh"):
+        km.fit(ds)
+
+
+def test_dataset_take(data, mesh8):
+    ds = KMeans(k=4, mesh=mesh8, dtype=np.float64, verbose=False).cache(data)
+    idx = np.array([0, 5, 2499])
+    np.testing.assert_array_equal(ds.take(idx), data[idx])
+    # Device-only gather path (host reference dropped).
+    ds._host = None
+    np.testing.assert_allclose(ds.take(idx), data[idx])

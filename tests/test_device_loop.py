@@ -1,0 +1,62 @@
+"""On-device training loop (``host_loop=False``): the whole fit runs in one
+dispatch under ``lax.while_loop``.  Must agree with the host loop — same
+trajectory, same iteration count, same SSE history — across mesh layouts and
+device-expressible empty-cluster policies.
+"""
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+
+from kmeans_tpu import KMeans
+
+
+@pytest.fixture(scope="module")
+def data():
+    X, _ = make_blobs(n_samples=3000, centers=5, n_features=8,
+                      random_state=11)
+    return X
+
+
+def _fit(mesh, data, host_loop, **kw):
+    kw.setdefault("empty_cluster", "keep")
+    km = KMeans(k=5, max_iter=25, seed=42, compute_sse=True, mesh=mesh,
+                dtype=np.float64, host_loop=host_loop, verbose=False, **kw)
+    return km.fit(data)
+
+
+@pytest.mark.parametrize("mesh_name", ["mesh1", "mesh8", "mesh4x2"])
+def test_device_loop_matches_host_loop(data, mesh_name, request):
+    mesh = request.getfixturevalue(mesh_name)
+    host = _fit(mesh, data, True)
+    dev = _fit(mesh, data, False)
+    assert dev.iterations_run == host.iterations_run
+    np.testing.assert_allclose(dev.centroids, host.centroids, atol=1e-9)
+    np.testing.assert_allclose(dev.sse_history, host.sse_history, rtol=1e-9)
+
+
+def test_device_loop_farthest_policy(mesh8):
+    # Over-clustered fixture (the reference's T4 scenario) with the
+    # farthest-point refill running fully on device.
+    X, _ = make_blobs(n_samples=800, centers=3, n_features=2,
+                      cluster_std=0.5, random_state=42)
+    km = KMeans(k=6, max_iter=30, seed=42, compute_sse=True,
+                empty_cluster="farthest", mesh=mesh8, host_loop=False,
+                verbose=False).fit(X)
+    assert np.all(np.isfinite(km.centroids))
+    assert km.centroids.shape == (6, 2)
+
+
+def test_device_loop_rejects_resample(mesh8, data):
+    km = KMeans(k=5, empty_cluster="resample", mesh=mesh8,
+                host_loop=False, verbose=False)
+    with pytest.raises(ValueError, match="host loop"):
+        km.fit(data)
+
+
+def test_device_loop_early_convergence(mesh8):
+    X, _ = make_blobs(n_samples=2000, centers=3, n_features=2,
+                      random_state=0, cluster_std=0.3)
+    km = KMeans(k=3, max_iter=100, tolerance=1e-4, seed=1, mesh=mesh8,
+                empty_cluster="keep", host_loop=False, verbose=False).fit(X)
+    assert 1 <= km.iterations_run < 100
