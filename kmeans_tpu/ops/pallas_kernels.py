@@ -1,0 +1,169 @@
+"""Pallas/Mosaic fused K-Means kernel (the framework's native-kernel tier).
+
+The reference has zero native components (SURVEY.md §2: its only compiled
+code is NumPy/BLAS and the Spark JVM), so per SURVEY.md §7 stage 6 the
+Pallas kernel IS the native tier here: one hand-scheduled TPU kernel that
+fuses the whole per-iteration pass — distance matmul (MXU), running
+argmin over centroid tiles (VPU), one-hot scatter-sum matmul (MXU), and
+count accumulation — without ever materializing an (N, k) distance matrix
+in HBM.  The k-tiling keeps the working set in VMEM even for k where the
+XLA scan path's (chunk, k) tile would spill (the k=3000 GloVe-class configs
+in BASELINE.json).
+
+Outputs per call: ``labels`` (N,1) int32, ``mind2`` (N,1) — min squared
+distance per point (feeding SSE and the farthest-point policy on the
+outside) — plus ``sums`` (k, D) and ``counts`` (1, k) accumulated across
+the sequential grid.
+
+Tie-breaking matches NumPy/the reference (kmeans_spark.py:156): within a
+centroid tile ``jnp.argmin`` picks the lowest index; across tiles a strict
+``<`` keeps the earlier (lower-index) tile's winner.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Sentinel for padded centroid rows: far from any real point, finite in f32.
+_PAD_VALUE = 1e12
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _round_up(a: int, b: int) -> int:
+    return _cdiv(a, b) * b
+
+
+def _kernel(x_ref, w_ref, c_ref, labels_ref, mind2_ref, sums_ref,
+            counts_ref, *, k_tiles: int, tile_k: int, mm_dtype):
+    i = pl.program_id(0)
+    x = x_ref[:, :]                                    # (tile_n, D)
+    w = w_ref[:, :]                                    # (tile_n, 1)
+    tile_n = x.shape[0]
+    x2 = jnp.sum(x * x, axis=1, keepdims=True)         # (tile_n, 1)
+
+    def scan_k(kt, carry):
+        best, mind2 = carry
+        c = c_ref[pl.ds(kt * tile_k, tile_k), :]       # (tile_k, D)
+        c2 = jnp.sum(c * c, axis=1)[None, :]           # (1, tile_k)
+        xc = jax.lax.dot_general(
+            x.astype(mm_dtype), c.astype(mm_dtype),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (tile_n, tile_k) MXU
+        d2 = jnp.maximum(x2 + c2 - 2.0 * xc, 0.0)
+        local_best = jnp.argmin(d2, axis=1).astype(jnp.int32)
+        local_min = jnp.min(d2, axis=1)
+        upd = local_min < mind2                        # strict: earlier tile
+        best = jnp.where(upd, kt * tile_k + local_best, best)  # wins ties
+        return best, jnp.where(upd, local_min, mind2)
+
+    best0 = jnp.zeros((tile_n,), jnp.int32)
+    mind20 = jnp.full((tile_n,), jnp.inf, jnp.float32)
+    best, mind2 = jax.lax.fori_loop(0, k_tiles, scan_k, (best0, mind20))
+
+    labels_ref[:, :] = best[:, None]
+    mind2_ref[:, :] = mind2[:, None]
+
+    # Zero the cross-grid accumulators on the first tile (TPU grids run
+    # sequentially, so += across grid steps is well-defined).
+    @pl.when(i == 0)
+    def _():
+        sums_ref[:, :] = jnp.zeros_like(sums_ref)
+        counts_ref[:, :] = jnp.zeros_like(counts_ref)
+
+    def accum_k(kt, _):
+        ids = kt * tile_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, tile_k), 1)                 # (1, tile_k)
+        onehot = (best[:, None] == ids).astype(jnp.float32) * w
+        sums_ref[pl.ds(kt * tile_k, tile_k), :] += jax.lax.dot_general(
+            onehot.astype(mm_dtype), x.astype(mm_dtype),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (tile_k, D) MXU
+        counts_ref[:, pl.ds(kt * tile_k, tile_k)] += jnp.sum(
+            onehot, axis=0, keepdims=True)
+        return 0
+
+    jax.lax.fori_loop(0, k_tiles, accum_k, 0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("tile_n", "tile_k", "bf16", "interpret"))
+def fused_assign_reduce(points: jax.Array, weights: jax.Array,
+                        centroids: jax.Array, *, tile_n: int = 512,
+                        tile_k: int = 512, bf16: bool = False,
+                        interpret: bool = False
+                        ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                   jax.Array]:
+    """(labels (n,), mind2 (n,), sums (k, D), counts (k,)) in one kernel.
+
+    Caller contract: ``points`` rows beyond the real data must carry
+    ``weights == 0`` (their labels/mind2 outputs are garbage and must be
+    masked by the caller, as ``assign_reduce`` padding does).  Internally
+    pads D to the 128-lane boundary (zero columns change nothing) and k to
+    a ``tile_k`` multiple with far-away sentinel rows (never selected).
+    """
+    n, d = points.shape
+    k = centroids.shape[0]
+    f32 = jnp.float32
+    x = points.astype(f32)
+    c = centroids.astype(f32)
+    w = weights.astype(f32)
+
+    tile_n = min(tile_n, _round_up(max(n, 8), 8))
+    n_pad = _round_up(n, tile_n)
+    d_pad = _round_up(d, 128)
+    tile_k = min(tile_k, _round_up(max(k, 128), 128))
+    k_pad = _round_up(k, tile_k)
+    if n_pad != n:
+        x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
+        w = jnp.pad(w, (0, n_pad - n))
+    if d_pad != d:
+        x = jnp.pad(x, ((0, 0), (0, d_pad - d)))
+        c = jnp.pad(c, ((0, 0), (0, d_pad - d)))
+    if k_pad != k:
+        c = jnp.pad(c, ((0, k_pad - k), (0, 0)),
+                    constant_values=_PAD_VALUE)
+
+    grid = (n_pad // tile_n,)
+    k_tiles = k_pad // tile_k
+    kernel = functools.partial(_kernel, k_tiles=k_tiles, tile_k=tile_k,
+                               mm_dtype=jnp.bfloat16 if bf16 else f32)
+    labels, mind2, sums, counts = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_n, d_pad), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_n, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k_pad, d_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_n, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_n, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k_pad, d_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((n_pad, 1), f32),
+            jax.ShapeDtypeStruct((k_pad, d_pad), f32),
+            jax.ShapeDtypeStruct((1, k_pad), f32),
+        ],
+        interpret=interpret,
+    )(x, w[:, None], c)
+    return (labels[:n, 0], mind2[:n, 0], sums[:k, :d], counts[0, :k])

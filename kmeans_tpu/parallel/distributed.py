@@ -71,12 +71,43 @@ def _model_axis_select(model_shards: int):
     return select
 
 
+PALLAS_MODES = ("pallas", "pallas_bf16")
+
+
+def _pallas_local_stats(points, weights, centroids_block, *, mode: str):
+    """Shard-local pass via the fused Pallas kernel (ops.pallas_kernels):
+    one Mosaic kernel per shard instead of the XLA scan.  f32 compute
+    (bf16 matmuls for 'pallas_bf16'); falls back to the Pallas interpreter
+    off-TPU so the same code path is CI-testable."""
+    from kmeans_tpu.ops.pallas_kernels import fused_assign_reduce
+    acc = _accum_dtype(points.dtype)
+    interpret = jax.default_backend() != "tpu"
+    labels, mind2, sums, counts = fused_assign_reduce(
+        points, weights, centroids_block,
+        bf16=(mode == "pallas_bf16"), interpret=interpret)
+    w = weights.astype(jnp.float32)
+    sse = jnp.sum(mind2 * w).astype(acc)
+    masked = jnp.where(w > 0, mind2, -jnp.inf)
+    i = jnp.argmax(masked)
+    far_d = jnp.where(jnp.any(w > 0), masked[i], -1.0).astype(acc)
+    far_p = points[i].astype(acc)
+    return StepStats(sums.astype(acc), counts.astype(acc), sse, far_d,
+                     far_p), labels
+
+
 def _local_stats(points, weights, centroids_block, *, chunk_size, mode,
                  model_shards: int):
     """Per-(data,model)-shard pass: scan chunks via the shared
-    ``accumulate_chunk`` body.  Returned ``sums``/``counts`` cover only this
-    shard's centroid block (embedded later); ``sse``/farthest use the GLOBAL
-    min distance reconstructed across the model axis."""
+    ``accumulate_chunk`` body (or one fused Pallas kernel for the 'pallas'
+    modes).  Returned ``sums``/``counts`` cover only this shard's centroid
+    block (embedded later); ``sse``/farthest use the GLOBAL min distance
+    reconstructed across the model axis."""
+    if mode in PALLAS_MODES:
+        if model_shards > 1:
+            raise ValueError("pallas modes do not support centroid (model-"
+                             "axis) sharding yet; use mode='matmul'")
+        return _pallas_local_stats(points, weights, centroids_block,
+                                   mode=mode)[0]
     k_local, d = centroids_block.shape
     acc = _accum_dtype(points.dtype)
     n_chunks = points.shape[0] // chunk_size
@@ -256,6 +287,16 @@ def make_predict_fn(mesh: Mesh, *, chunk_size: int,
     def predict(points, centroids_block):
         k_local, d = centroids_block.shape
         n_local = points.shape[0]
+        if mode in PALLAS_MODES:
+            if model_shards > 1:
+                raise ValueError("pallas modes do not support centroid "
+                                 "(model-axis) sharding yet")
+            from kmeans_tpu.ops.pallas_kernels import fused_assign_reduce
+            labels, *_ = fused_assign_reduce(
+                points, jnp.ones((n_local,), jnp.float32), centroids_block,
+                bf16=(mode == "pallas_bf16"),
+                interpret=jax.default_backend() != "tpu")
+            return labels
         n_chunks = n_local // chunk_size
         xs = points.reshape(n_chunks, chunk_size, d)
         m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0

@@ -1,0 +1,41 @@
+"""End-to-end KMeans with distance_mode='pallas' (interpret mode on CPU):
+must reproduce the XLA path's trajectory on DP meshes and reject model-axis
+sharding cleanly."""
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+
+from kmeans_tpu import KMeans
+from kmeans_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def data():
+    X, _ = make_blobs(n_samples=1500, centers=4, n_features=6,
+                      random_state=2)
+    return X.astype(np.float32)
+
+
+def test_pallas_mode_matches_matmul(data, mesh8):
+    a = KMeans(k=4, max_iter=15, seed=42, compute_sse=True, mesh=mesh8,
+               distance_mode="matmul", verbose=False).fit(data)
+    b = KMeans(k=4, max_iter=15, seed=42, compute_sse=True, mesh=mesh8,
+               distance_mode="pallas", verbose=False).fit(data)
+    assert a.iterations_run == b.iterations_run
+    np.testing.assert_allclose(a.centroids, b.centroids, atol=1e-4)
+    np.testing.assert_allclose(a.sse_history, b.sse_history, rtol=1e-5)
+    np.testing.assert_array_equal(a.predict(data), b.predict(data))
+
+
+def test_pallas_mode_device_loop(data, mesh8):
+    km = KMeans(k=4, max_iter=15, seed=42, empty_cluster="keep", mesh=mesh8,
+                distance_mode="pallas", host_loop=False, verbose=False)
+    km.fit(data)
+    assert np.all(np.isfinite(km.centroids))
+
+
+def test_pallas_rejects_model_sharding(data, mesh4x2):
+    km = KMeans(k=4, mesh=mesh4x2, distance_mode="pallas", verbose=False)
+    with pytest.raises(ValueError, match="model"):
+        km.fit(data)
