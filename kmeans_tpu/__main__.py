@@ -1,0 +1,27 @@
+"""CLI entry point: ``python -m kmeans_tpu <command>``.
+
+The reference has no CLI layer (SURVEY.md §1: no argparse, the ``__main__``
+block takes no arguments); this is a thin superset exposing the narrative
+suite and the benchmark harness.
+"""
+
+import sys
+
+
+def main() -> int:
+    args = sys.argv[1:]
+    cmd = args[0] if args and not args[0].startswith("-") else "suite"
+    rest = args[1:] if args and not args[0].startswith("-") else args
+    if cmd == "suite":
+        from kmeans_tpu.suite import main as suite_main
+        return suite_main(rest)
+    if cmd == "bench":
+        from kmeans_tpu.benchmarks import main as bench_main
+        return bench_main(rest)
+    print(f"unknown command {cmd!r}; available: suite, bench",
+          file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,131 @@
+"""Benchmark harness for the BASELINE.json north-star configs.
+
+Measures steady-state iteration throughput (points*dims/sec/chip) for each
+config, with compile/warmup excluded (the reference times cold,
+kmeans_spark.py:575-579 — SURVEY.md §6 flags this) and synchronization via
+scalar transfer (block_until_ready is not a reliable barrier on tunneled
+PJRT platforms).
+
+Configs (BASELINE.json): make_blobs 10k x 2 k=5 · blobs 1M x 16 k=64 ·
+uniform 10M x 128 k=1024 (headline) · MNIST-shaped 60k x 784 k=10 ·
+GloVe-shaped 400k x 100 k=3000.  The image has no network access, so the
+MNIST/GloVe configs use distribution-matched synthetic data (pixel-like
+clipped mixtures / heavy-tailed embedding clouds) at the exact shapes.
+
+Run: ``python -m kmeans_tpu bench [--configs small,blobs1m] [--iters N]``
+Each config prints one JSON line; a markdown table row set is printed at the
+end for BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Dict
+
+import numpy as np
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_config_data(name: str, rng: np.random.Generator) -> np.ndarray:
+    from kmeans_tpu.data.synthetic import make_blobs, make_uniform
+    if name == "small":        # make_blobs 10k x 2, k=5 (reference-scale)
+        return make_blobs(10_000, 5, 2, random_state=42,
+                          dtype=np.float32)[0]
+    if name == "blobs1m":      # 1M x 16, k=64
+        return make_blobs(1_000_000, 64, 16, random_state=42,
+                          dtype=np.float32)[0]
+    if name == "uniform10m":   # headline: 10M x 128, k=1024
+        return make_uniform(10_000_000, 128, random_state=42)
+    if name == "mnist":        # MNIST-shaped: 60k x 784 pixels in [0, 1]
+        centers = rng.uniform(0, 1, size=(10, 784)).astype(np.float32)
+        labels = rng.integers(0, 10, size=60_000)
+        X = centers[labels] + 0.15 * rng.standard_normal(
+            (60_000, 784)).astype(np.float32)
+        return np.clip(X, 0.0, 1.0)
+    if name == "glove":        # GloVe-shaped: 400k x 100, heavy-tailed
+        X = rng.standard_t(df=4, size=(400_000, 100)).astype(np.float32)
+        return X / np.sqrt((X * X).mean())
+    raise ValueError(f"unknown config {name!r}")
+
+
+CONFIG_K = {"small": 5, "blobs1m": 64, "uniform10m": 1024, "mnist": 10,
+            "glove": 3000}
+DEFAULT_CONFIGS = ["small", "blobs1m", "mnist", "glove", "uniform10m"]
+
+
+def bench_config(name: str, iters: int, mode: str) -> Dict:
+    import jax
+    from kmeans_tpu.models.kmeans import _get_step_fns
+    from kmeans_tpu.parallel import distributed as dist
+    from kmeans_tpu.parallel.mesh import make_mesh, mesh_shape
+    from kmeans_tpu.parallel.sharding import (choose_chunk_size,
+                                              shard_points)
+
+    rng = np.random.default_rng(42)
+    X = make_config_data(name, rng)
+    n, d = X.shape
+    k = CONFIG_K[name]
+    mesh = make_mesh()
+    data_shards, model_shards = mesh_shape(mesh)
+    chunk = choose_chunk_size(-(-n // data_shards), k, d)
+    points, weights = shard_points(X, mesh, chunk)
+    init = X[rng.choice(n, size=k, replace=False)]
+    cents = jax.device_put(dist.pad_centroids(init, model_shards),
+                           dist.centroid_sharding(mesh))
+    step_fn, _ = _get_step_fns(mesh, chunk, mode)
+
+    t0 = time.perf_counter()
+    float(step_fn(points, weights, cents).sse)       # compile + first step
+    _log(f"[{name}] compile+first step {time.perf_counter() - t0:.1f}s")
+    float(step_fn(points, weights, cents).sse)       # steady-state warm
+
+    start = time.perf_counter()
+    for _ in range(iters):
+        stats = step_fn(points, weights, cents)
+        sse = float(stats.sse)                       # sync barrier
+    per_iter = (time.perf_counter() - start) / iters
+    n_chips = max(1, len(jax.devices()))
+    result = {
+        "config": name, "n": n, "d": d, "k": k, "mode": mode,
+        "iters": iters, "ms_per_iter": round(per_iter * 1e3, 2),
+        "throughput_pd_per_sec_per_chip": round(n * d / per_iter / n_chips,
+                                                1),
+        "sse": sse,
+    }
+    print(json.dumps(result), flush=True)
+    return result
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="kmeans_tpu benchmarks")
+    parser.add_argument("--configs", default=",".join(DEFAULT_CONFIGS))
+    parser.add_argument("--iters", type=int, default=5)
+    parser.add_argument("--mode", default="matmul",
+                        help="matmul | matmul_bf16 | pallas | pallas_bf16")
+    args = parser.parse_args(argv)
+
+    results = []
+    for name in args.configs.split(","):
+        try:
+            results.append(bench_config(name.strip(), args.iters,
+                                        args.mode))
+        except Exception as e:           # noqa: BLE001 — keep suite going
+            _log(f"[{name}] FAILED: {e}")
+
+    _log("\n| config | N | D | k | ms/iter | points*dims/s/chip |")
+    _log("|---|---|---|---|---|---|")
+    for r in results:
+        _log(f"| {r['config']} | {r['n']:,} | {r['d']} | {r['k']} | "
+             f"{r['ms_per_iter']} | {r['throughput_pd_per_sec_per_chip']:.3e}"
+             f" |")
+    return 0 if results else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
