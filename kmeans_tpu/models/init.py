@@ -34,9 +34,16 @@ class _ArraySource:
     def take(self, idx):
         return self._X[idx]
 
+    def positive_rows(self):
+        return np.arange(self.n)
+
     @property
     def host(self):
         return self._X
+
+    @property
+    def host_weights(self):
+        return None
 
 
 def as_source(X):
@@ -44,13 +51,19 @@ def as_source(X):
 
 
 def forgy_init(X, k: int, seed: int) -> np.ndarray:
-    """Seeded sample of k distinct rows (kmeans_spark.py:58-82 semantics)."""
+    """Seeded sample of k distinct rows (kmeans_spark.py:58-82 semantics).
+
+    With sample weights present, sampling is uniform over the POSITIVE-
+    weight rows only (a zero-weight row must never seed a centroid — it
+    would start an empty cluster)."""
     src = as_source(X)
-    if src.n < k:
+    candidates = src.positive_rows()
+    if len(candidates) < k:
         raise ValueError(
-            f"Not enough data points ({src.n}) to initialize {k} clusters")
+            f"Not enough data points ({len(candidates)}) to initialize "
+            f"{k} clusters")
     rng = np.random.RandomState(seed)
-    idx = rng.choice(src.n, size=k, replace=False)
+    idx = candidates[rng.choice(len(candidates), size=k, replace=False)]
     centroids = np.asarray(src.take(idx))
     # Same message as the reference's finite guard (kmeans_spark.py:79-80).
     check_finite_array(centroids, "Data contains NaN or Inf values")
@@ -68,31 +81,86 @@ def kmeanspp_init(X, k: int, seed: int) -> np.ndarray:
     src = as_source(X)
     host = getattr(src, "host", None)
     if host is None:
-        raise ValueError("k-means++ init requires host data; pass a NumPy "
-                         "array (not a pre-sharded ShardedDataset)")
+        # Pre-sharded device-only data: run the on-device variant.
+        return kmeanspp_device_init(src, k, seed)
     X = host
     n = X.shape[0]
-    if n < k:
+    sw = getattr(src, "host_weights", None)
+    w = (np.ones(n) if sw is None
+         else np.asarray(sw, dtype=np.float64))
+    if int((w > 0).sum()) < k:
         raise ValueError(
-            f"Not enough data points ({n}) to initialize {k} clusters")
+            f"Not enough data points ({int((w > 0).sum())}) to initialize "
+            f"{k} clusters")
     # Full scan (not just the chosen rows): a NaN anywhere poisons the D^2
     # distance weights, so the guard must cover all of X here.
     check_finite_array(X, "Data contains NaN or Inf values")
     rng = np.random.default_rng(seed)
     x = jnp.asarray(X)
     centers = np.empty((k, X.shape[1]), dtype=X.dtype)
-    centers[0] = X[rng.integers(n)]
+    centers[0] = X[rng.choice(n, p=w / w.sum())]   # first draw ~ weights
     mind2 = jnp.full((n,), jnp.inf, dtype=x.dtype)
     for i in range(1, k):
         mind2 = _update_mind2(x, mind2, jnp.asarray(centers[i - 1]))
-        p = np.asarray(mind2, dtype=np.float64)
-        p = np.maximum(p, 0.0)
+        # D^2 weighting scaled by sample weights: p ~ w * mind2.
+        p = w * np.maximum(np.asarray(mind2, dtype=np.float64), 0.0)
         total = p.sum()
         if not np.isfinite(total) or total <= 0:
-            idx = rng.integers(n)           # degenerate: all points coincide
+            idx = rng.choice(n, p=w / w.sum())  # degenerate: coincident pts
         else:
             idx = rng.choice(n, p=p / total)
         centers[i] = X[idx]
+    return centers
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _kmeanspp_device(points: jax.Array, weights: jax.Array, k: int,
+                     seed) -> jax.Array:
+    """Whole k-means++ seeding in ONE dispatch, GSPMD-parallel over sharded
+    points.  The categorical D²-draw uses the Gumbel-max trick — an argmax
+    over (log p + gumbel noise), which XLA parallelizes across shards the
+    same way every other reduction here is — so no host round-trip and no
+    gather of the (n,) distance vector ever happens."""
+    n, d = points.shape
+    key = jax.random.PRNGKey(seed)
+    neg_inf = jnp.array(-jnp.inf, points.dtype)
+
+    w_logits = jnp.where(weights > 0, jnp.log(jnp.maximum(weights, 1e-38)),
+                         neg_inf)
+
+    def draw(logits, subkey):
+        g = jax.random.gumbel(subkey, (n,), dtype=points.dtype)
+        # Degenerate fallback (all remaining mass zero): weight-proportional
+        # over the real rows.
+        logits = jnp.where(jnp.any(jnp.isfinite(logits)), logits, w_logits)
+        return jnp.argmax(logits + g)
+
+    idx0 = draw(w_logits, jax.random.fold_in(key, 0))  # first ~ weights
+    centers0 = jnp.zeros((k, d), points.dtype).at[0].set(points[idx0])
+    mind20 = jnp.full((n,), jnp.inf, points.dtype)
+
+    def body(i, carry):
+        centers, mind2 = carry
+        c = centers[i - 1]
+        d2 = jnp.sum((points - c[None, :]) ** 2, axis=1)
+        mind2 = jnp.minimum(mind2, d2)
+        p = weights * mind2                 # D^2 x sample-weight mass
+        logits = jnp.where(p > 0, jnp.log(p), neg_inf)
+        idx = draw(logits, jax.random.fold_in(key, i))
+        return centers.at[i].set(points[idx]), mind2
+
+    centers, _ = jax.lax.fori_loop(1, k, body, (centers0, mind20))
+    return centers
+
+
+def kmeanspp_device_init(ds, k: int, seed: int) -> np.ndarray:
+    """k-means++ on a ShardedDataset — fully on-device (see
+    ``_kmeanspp_device``); used automatically when no host copy exists."""
+    if ds.n < k:
+        raise ValueError(
+            f"Not enough data points ({ds.n}) to initialize {k} clusters")
+    centers = np.asarray(_kmeanspp_device(ds.points, ds.weights, k, seed))
+    check_finite_array(centers, "Data contains NaN or Inf values")
     return centers
 
 

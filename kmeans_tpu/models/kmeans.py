@@ -159,15 +159,17 @@ class KMeans:
         step_fn, predict_fn = _get_step_fns(mesh, chunk, self.distance_mode)
         return mesh, model_shards, step_fn, predict_fn, chunk
 
-    def cache(self, X) -> ShardedDataset:
+    def cache(self, X, sample_weight=None) -> ShardedDataset:
         """Upload X once as a device-resident ShardedDataset (the
         ``rdd.cache()`` analogue, kmeans_spark.py:256).  Pass the result to
-        ``fit``/``predict``/``score`` to skip re-uploading on every call."""
+        ``fit``/``predict``/``score`` to skip re-uploading on every call.
+        Optional ``sample_weight`` (n,) makes every statistic weighted."""
         X = np.asarray(X, dtype=self.dtype)
         if X.ndim != 2:
             raise ValueError(f"X must be 2-D (n, D), got shape {X.shape}")
         return to_device(X, self._resolve_mesh(),
-                         self._chunk_for(*X.shape), self.dtype)
+                         self._chunk_for(*X.shape), self.dtype,
+                         sample_weight=sample_weight)
 
     def _dataset(self, X) -> ShardedDataset:
         """Accept an (n, D) array-like or an already-cached ShardedDataset."""
@@ -205,16 +207,24 @@ class KMeans:
 
     # ------------------------------------------------------------------- fit
 
-    def fit(self, X, *, resume: bool = False) -> "KMeans":
+    def fit(self, X, *, sample_weight=None,
+            resume: bool = False) -> "KMeans":
         """Fit on (n, D) array-like or a cached ShardedDataset.
         Returns self (kmeans_spark.py:239-319).
 
-        ``resume=True`` continues from the current ``centroids`` /
-        ``iterations_run`` (e.g. after ``KMeans.load``) instead of
-        re-initializing — a capability the reference lacks (no checkpointing,
-        SURVEY.md §5).
+        ``sample_weight`` (n,) weights every statistic (sums, counts, SSE) —
+        sklearn-style, beyond the reference.  ``resume=True`` continues from
+        the current ``centroids`` / ``iterations_run`` (e.g. after
+        ``KMeans.load``) instead of re-initializing — a capability the
+        reference lacks (no checkpointing, SURVEY.md §5).
         """
         log = IterationLogger(self.verbose)
+        if sample_weight is not None:
+            if isinstance(X, ShardedDataset):
+                raise ValueError("pass sample_weight when caching the "
+                                 "dataset, not on a pre-built "
+                                 "ShardedDataset")
+            X = self.cache(X, sample_weight=sample_weight)
         ds, mesh, model_shards, step_fn, _ = self._prepare(X)
 
         start_iter = 0
@@ -350,9 +360,13 @@ class KMeans:
         if filled:
             # Deterministic replacement sampling — the reference's live
             # policy (:191-204) minus its time.time() seed (:195-196).
+            # Only positive-weight rows are candidates: a zero-weight
+            # replacement would leave the cluster empty forever.
             rng = np.random.default_rng([self.seed, iteration + 1])
-            take = min(len(filled), ds.n)
-            idx = rng.choice(ds.n, size=take, replace=False)
+            candidates = ds.positive_rows()
+            take = min(len(filled), len(candidates))
+            idx = candidates[rng.choice(len(candidates), size=take,
+                                        replace=False)]
             rows = ds.take(idx)
             for slot, row in zip(filled[:take], rows):
                 new_centroids[slot] = row

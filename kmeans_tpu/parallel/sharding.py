@@ -48,15 +48,20 @@ def pad_points(x: np.ndarray, multiple: int) -> Tuple[np.ndarray, np.ndarray]:
     return x, w
 
 
-def shard_points(x: np.ndarray, mesh: Optional[Mesh],
-                 chunk_size: int) -> Tuple[jax.Array, jax.Array]:
+def shard_points(x: np.ndarray, mesh: Optional[Mesh], chunk_size: int,
+                 sample_weight: Optional[np.ndarray] = None
+                 ) -> Tuple[jax.Array, jax.Array]:
     """Pad and place (points, weights) sharded along the mesh's data axis.
 
-    With ``mesh=None`` the arrays are committed to the default device —
+    ``sample_weight`` (n,) is folded into the padding mask (padding rows stay
+    0).  With ``mesh=None`` the arrays are committed to the default device —
     the single-chip path, same downstream code.
     """
     data_shards, _ = mesh_shape(mesh)
-    x_pad, w_pad = pad_points(np.asarray(x), data_shards * chunk_size)
+    x = np.asarray(x)
+    x_pad, w_pad = pad_points(x, data_shards * chunk_size)
+    if sample_weight is not None:
+        w_pad[: x.shape[0]] *= sample_weight.astype(w_pad.dtype)
     if mesh is None:
         return jnp.asarray(x_pad), jnp.asarray(w_pad)
     xsh = NamedSharding(mesh, P(DATA_AXIS, None))
@@ -79,7 +84,8 @@ class ShardedDataset:
 
     def __init__(self, points: jax.Array, weights: jax.Array, n: int,
                  chunk: int, mesh: Optional[Mesh],
-                 host: Optional[np.ndarray] = None):
+                 host: Optional[np.ndarray] = None,
+                 host_weights: Optional[np.ndarray] = None):
         self.points = points
         self.weights = weights
         self.n = n
@@ -87,6 +93,7 @@ class ShardedDataset:
         self.chunk = chunk
         self.mesh = mesh
         self._host = host
+        self._host_weights = host_weights
 
     @property
     def dtype(self):
@@ -97,6 +104,19 @@ class ShardedDataset:
         """Host copy of the (un-padded) data, when constructed from one."""
         return self._host
 
+    @property
+    def host_weights(self) -> Optional[np.ndarray]:
+        """Host copy of the per-point sample weights (None = all ones)."""
+        return self._host_weights
+
+    def positive_rows(self) -> np.ndarray:
+        """Indices of rows with weight > 0 (candidates for seeding and
+        empty-cluster resampling — zero-weight rows must never become
+        centroids)."""
+        if self._host_weights is None:
+            return np.arange(self.n)
+        return np.flatnonzero(self._host_weights > 0)
+
     def take(self, idx) -> np.ndarray:
         """Gather rows by global index (all indices must be < n)."""
         if self._host is not None:
@@ -104,21 +124,39 @@ class ShardedDataset:
         return np.asarray(self.points[np.asarray(idx)])
 
 
-def to_device(X, mesh: Optional[Mesh], chunk: int, dtype) -> ShardedDataset:
+def to_device(X, mesh: Optional[Mesh], chunk: int, dtype,
+              sample_weight=None) -> ShardedDataset:
     """Upload (n, D) host data once; pass-through if already a ShardedDataset
-    on a compatible (mesh, chunk)."""
+    on a compatible (mesh, chunk).
+
+    ``sample_weight`` (n,) folds per-point weights into the padding mask —
+    weighted counts/sums/SSE come for free from the same fused step (a
+    capability the reference lacks; sklearn-style).
+    """
     if isinstance(X, ShardedDataset):
         if mesh is not None and X.mesh is not mesh:
             raise ValueError("ShardedDataset was placed on a different mesh")
         if np.dtype(dtype) != X.dtype:
             raise ValueError(f"ShardedDataset dtype {X.dtype} != model "
                              f"dtype {np.dtype(dtype)}")
+        if sample_weight is not None:
+            raise ValueError("pass sample_weight when caching the dataset, "
+                             "not on a pre-built ShardedDataset")
         return X
     X = np.ascontiguousarray(np.asarray(X, dtype=dtype))
     if X.ndim != 2:
         raise ValueError(f"X must be 2-D (n, D), got shape {X.shape}")
-    points, weights = shard_points(X, mesh, chunk)
-    return ShardedDataset(points, weights, X.shape[0], chunk, mesh, host=X)
+    sw = None
+    if sample_weight is not None:
+        sw = np.asarray(sample_weight, dtype=X.dtype)
+        if sw.shape != (X.shape[0],):
+            raise ValueError(f"sample_weight must have shape "
+                             f"({X.shape[0]},), got {sw.shape}")
+        if np.any(sw < 0) or not np.all(np.isfinite(sw)):
+            raise ValueError("sample_weight must be finite and >= 0")
+    points, weights = shard_points(X, mesh, chunk, sample_weight=sw)
+    return ShardedDataset(points, weights, X.shape[0], chunk, mesh, host=X,
+                          host_weights=sw)
 
 
 def global_sample_rows(x_source: np.ndarray, n_rows: int, k: int,

@@ -1,0 +1,106 @@
+"""sample_weight support and the on-device distributed k-means++
+(both beyond-reference capabilities)."""
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+
+from kmeans_tpu import KMeans
+
+
+def test_sample_weight_equivalent_to_repetition(mesh8):
+    # Weighting a point by 3 == including it 3 times.
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(300, 3))
+    w = rng.integers(1, 4, size=300).astype(np.float64)
+    X_rep = np.repeat(X, w.astype(int), axis=0)
+    init = X[:4]
+    a = KMeans(k=4, init=init, max_iter=40, mesh=mesh8, dtype=np.float64,
+               compute_sse=True, verbose=False).fit(X, sample_weight=w)
+    b = KMeans(k=4, init=init, max_iter=40, mesh=mesh8, dtype=np.float64,
+               compute_sse=True, verbose=False).fit(X_rep)
+    np.testing.assert_allclose(a.centroids, b.centroids, atol=1e-9)
+    np.testing.assert_allclose(a.sse_history, b.sse_history, rtol=1e-9)
+
+
+def test_sample_weight_validation(mesh8):
+    X = np.zeros((10, 2))
+    km = KMeans(k=2, mesh=mesh8, verbose=False)
+    with pytest.raises(ValueError, match="shape"):
+        km.fit(X, sample_weight=np.ones(5))
+    with pytest.raises(ValueError, match="finite"):
+        km.fit(X, sample_weight=np.full(10, -1.0))
+
+
+def test_zero_weight_points_ignored(mesh8):
+    rng = np.random.default_rng(1)
+    X = np.concatenate([rng.normal(size=(200, 2)),
+                        rng.normal(loc=100.0, size=(50, 2))])
+    w = np.concatenate([np.ones(200), np.zeros(50)])
+    km = KMeans(k=3, seed=0, mesh=mesh8, dtype=np.float64,
+                init=X[:3], verbose=False).fit(X, sample_weight=w)
+    # No centroid should land in the zero-weight far cluster.
+    assert np.all(np.abs(km.centroids) < 50)
+
+
+def test_weighted_inits_never_seed_zero_weight_rows(mesh8):
+    rng = np.random.default_rng(4)
+    X = np.concatenate([rng.normal(size=(100, 2)),
+                        rng.normal(loc=500.0, size=(40, 2))])
+    w = np.concatenate([np.ones(100), np.zeros(40)])
+    for init in ("forgy", "k-means++"):
+        km = KMeans(k=5, init=init, seed=11, mesh=mesh8, dtype=np.float64,
+                    verbose=False).fit(X, sample_weight=w)
+        # All centroids near the weighted cluster; the far (zero-weight)
+        # cluster — despite its huge D^2 — is never seeded.
+        assert np.all(np.abs(km.centroids) < 100), init
+
+
+def test_sample_weight_on_prebuilt_dataset_raises(mesh8):
+    X = np.zeros((10, 2))
+    km = KMeans(k=2, mesh=mesh8, verbose=False)
+    ds = km.cache(X)
+    with pytest.raises(ValueError, match="when caching"):
+        km.fit(ds, sample_weight=np.ones(10))
+
+
+def test_empty_resample_avoids_zero_weight_rows(mesh8):
+    rng = np.random.default_rng(6)
+    X = np.concatenate([rng.normal(size=(100, 2)),
+                        rng.normal(loc=500.0, size=(100, 2))])
+    w = np.concatenate([np.ones(100), np.zeros(100)])
+    # Force an empty cluster: one init centroid parked far away.
+    init = np.array([[0.0, 0.0], [1.0, 1.0], [-1e3, -1e3]])
+    km = KMeans(k=3, init=init, max_iter=5, empty_cluster="resample",
+                mesh=mesh8, dtype=np.float64, verbose=False)
+    km.fit(X, sample_weight=w)
+    # The refilled centroid must come from positive-weight rows.
+    assert np.all(np.abs(km.centroids) < 100)
+
+
+def test_device_kmeanspp_on_sharded_data(mesh8):
+    X, _ = make_blobs(n_samples=2000, centers=5, n_features=4,
+                      cluster_std=0.3, random_state=0)
+    X = X.astype(np.float64)
+    km = KMeans(k=5, init="k-means++", seed=7, mesh=mesh8,
+                dtype=np.float64, compute_sse=True, verbose=False)
+    ds = km.cache(X)
+    ds._host = None            # force the device-only path
+    km.fit(ds)
+    assert np.all(np.isfinite(km.centroids))
+    # k-means++ on well-separated blobs should find the true optimum:
+    # compare against a strong sklearn run.
+    from sklearn.cluster import KMeans as SK
+    ref = SK(n_clusters=5, n_init=10, random_state=0).fit(X)
+    assert km.sse_history[-1] <= ref.inertia_ * 1.05
+
+
+def test_device_kmeanspp_distinct_centers(mesh8):
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(500, 6))
+    km = KMeans(k=8, init="k-means++", seed=3, mesh=mesh8,
+                dtype=np.float64, verbose=False)
+    ds = km.cache(X)
+    ds._host = None
+    km.fit(ds)
+    assert len(np.unique(km.centroids.round(9), axis=0)) == 8
