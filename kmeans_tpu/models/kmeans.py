@@ -38,6 +38,7 @@ all the broadcast/shuffle/collect traffic.
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional, Union
 
 import jax
@@ -136,6 +137,7 @@ class KMeans:
         self.centroids: Optional[np.ndarray] = None   # kmeans_spark.py:44
         self.sse_history: List[float] = []            # kmeans_spark.py:45
         self.cluster_sizes_: Optional[np.ndarray] = None
+        self.iter_times_: List[float] = []            # wall secs/iteration
         validate_params(k, max_iter, tolerance)       # kmeans_spark.py:46
         self.iterations_run = 0                       # kmeans_spark.py:47
 
@@ -207,8 +209,8 @@ class KMeans:
 
     # ------------------------------------------------------------------- fit
 
-    def fit(self, X, *, sample_weight=None,
-            resume: bool = False) -> "KMeans":
+    def fit(self, X, *, sample_weight=None, resume: bool = False,
+            profile_dir: Optional[str] = None) -> "KMeans":
         """Fit on (n, D) array-like or a cached ShardedDataset.
         Returns self (kmeans_spark.py:239-319).
 
@@ -217,7 +219,16 @@ class KMeans:
         the current ``centroids`` / ``iterations_run`` (e.g. after
         ``KMeans.load``) instead of re-initializing — a capability the
         reference lacks (no checkpointing, SURVEY.md §5).
+        ``profile_dir`` captures a ``jax.profiler`` device trace of the fit
+        (the reference's only instrumentation is wall-clock pairs,
+        SURVEY.md §5); per-iteration wall times land in ``iter_times_``
+        either way.
         """
+        from kmeans_tpu.utils import profiling
+        with profiling.trace(profile_dir):
+            return self._fit(X, sample_weight=sample_weight, resume=resume)
+
+    def _fit(self, X, *, sample_weight, resume) -> "KMeans":
         log = IterationLogger(self.verbose)
         if sample_weight is not None:
             if isinstance(X, ShardedDataset):
@@ -236,6 +247,7 @@ class KMeans:
             centroids = resolve_init(self.init, ds, self.k, self.seed)
             self.sse_history = []
             self.iterations_run = 0
+            self.iter_times_ = []
 
         log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
 
@@ -245,6 +257,7 @@ class KMeans:
 
         cents_dev = self._put_centroids(centroids, mesh, model_shards)
         for iteration in range(start_iter, self.max_iter):
+            iter_start = time.perf_counter()
             stats: StepStats = step_fn(ds.points, ds.weights, cents_dev)
             # Host does exactly the driver's O(k*D) work
             # (kmeans_spark.py:181-188) — in float64 for stable division.
@@ -286,6 +299,7 @@ class KMeans:
             self.centroids = np.asarray(centroids)
             self.cluster_sizes_ = sizes
             self.iterations_run = iteration + 1      # fixes SURVEY §2.1 bug
+            self.iter_times_.append(time.perf_counter() - iter_start)
 
             if max_shift < self.tolerance:           # kmeans_spark.py:310-313
                 log.converged(iteration + 1)
@@ -310,9 +324,14 @@ class KMeans:
                 empty_policy=self.empty_cluster)
         fit_fn = _STEP_CACHE[key]
         cents_dev = self._put_centroids(centroids, mesh, model_shards)
+        fit_start = time.perf_counter()
         cents, n_iters, sse_hist, shift_hist, counts = fit_fn(
             ds.points, ds.weights, cents_dev)
         n_iters = int(n_iters)
+        elapsed = time.perf_counter() - fit_start
+        # One dispatch for the whole fit: only the mean per-iteration wall
+        # time is observable from the host.
+        self.iter_times_.extend([elapsed / max(n_iters, 1)] * n_iters)
         self.centroids = np.asarray(cents, dtype=self.dtype)
         if not np.all(np.isfinite(self.centroids)):   # kmeans_spark.py:289
             raise ValueError(

@@ -123,6 +123,16 @@ class ShardedDataset:
             return np.asarray(self._host[idx])
         return np.asarray(self.points[np.asarray(idx)])
 
+    def reshard(self, mesh: Optional[Mesh],
+                chunk: Optional[int] = None) -> "ShardedDataset":
+        """Re-place the data on a different mesh / chunking — the
+        ``rdd.repartition`` analogue (kmeans_spark.py:418).  Goes through
+        the host copy when available, else gathers from device."""
+        host = self._host if self._host is not None else \
+            np.asarray(self.points)[: self.n]
+        return to_device(host, mesh, chunk or self.chunk, self.dtype,
+                         sample_weight=self._host_weights)
+
 
 def to_device(X, mesh: Optional[Mesh], chunk: int, dtype,
               sample_weight=None) -> ShardedDataset:

@@ -1,0 +1,53 @@
+"""Profiling/observability: per-iteration wall times, jax.profiler trace
+capture, and dataset resharding (the ``repartition`` analogue)."""
+
+import os
+
+import numpy as np
+from sklearn.datasets import make_blobs
+
+from kmeans_tpu import KMeans
+from kmeans_tpu.parallel.mesh import make_mesh
+
+
+def _data():
+    X, _ = make_blobs(n_samples=1200, centers=3, n_features=4,
+                      random_state=0)
+    return X.astype(np.float64)
+
+
+def test_iter_times_recorded(mesh8):
+    X = _data()
+    km = KMeans(k=3, mesh=mesh8, dtype=np.float64, verbose=False).fit(X)
+    assert len(km.iter_times_) == km.iterations_run
+    assert all(t > 0 for t in km.iter_times_)
+
+
+def test_iter_times_device_loop(mesh8):
+    X = _data()
+    km = KMeans(k=3, empty_cluster="keep", host_loop=False, mesh=mesh8,
+                dtype=np.float64, verbose=False).fit(X)
+    assert len(km.iter_times_) == km.iterations_run
+
+
+def test_profile_trace_written(tmp_path, mesh8):
+    X = _data()
+    km = KMeans(k=3, mesh=mesh8, dtype=np.float64, verbose=False)
+    km.fit(X, profile_dir=str(tmp_path / "trace"))
+    produced = []
+    for root, _, files in os.walk(tmp_path / "trace"):
+        produced.extend(files)
+    assert produced                     # profiler wrote trace artifacts
+
+
+def test_reshard(mesh8):
+    import jax
+    X = _data()
+    km = KMeans(k=3, mesh=mesh8, dtype=np.float64, verbose=False)
+    ds = km.cache(X)
+    mesh2 = make_mesh(data=2, model=1, devices=jax.devices()[:2])
+    ds2 = ds.reshard(mesh2)
+    assert ds2.n == ds.n and ds2.mesh is mesh2
+    km2 = KMeans(k=3, mesh=mesh2, dtype=np.float64, verbose=False).fit(ds2)
+    km.fit(ds)
+    np.testing.assert_allclose(km.centroids, km2.centroids, atol=1e-9)
