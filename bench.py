@@ -3,19 +3,25 @@
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "points*dims/sec/chip", "vs_baseline": N}
 
-Measures the fused SPMD iteration (assign + reduce + SSE) on the headline
-configuration family from BASELINE.json (uniform points, D=128, k=1024),
-with compile/warmup excluded (the reference times cold, kmeans_spark.py:
-575-579 — SURVEY.md §6 flags this).
+Measures the STEADY-STATE per-iteration cost of the fused SPMD training
+step on the headline configuration family from BASELINE.json (uniform
+points, D=128, k=1024).  Method: the whole training loop runs on device
+(``lax.while_loop``, one dispatch — parallel.distributed.make_fit_fn), and
+the per-iteration cost is the marginal time between a 2-iteration and a
+(2+iters)-iteration fit, which cancels dispatch latency and host/transfer
+overhead exactly.  Compile time is excluded (the reference times cold,
+kmeans_spark.py:575-579 — SURVEY.md §6 flags this); synchronization is via
+scalar transfer (block_until_ready is not a reliable barrier on tunneled
+PJRT platforms).
 
-``vs_baseline`` is measured against an on-host re-enactment of the
-reference's per-point executor loop (``assign_partition``,
-kmeans_spark.py:147-159: np.linalg.norm per point + argmin), scaled by
-BASELINE.json's 8 Spark workers with PERFECT linear scaling assumed — a
-deliberately generous baseline (real Spark adds shuffle/serialization
-overhead on top, and its reduceByKey pass is not even counted here).
+``vs_baseline`` compares against an on-host re-enactment of the reference's
+per-point executor loop (``assign_partition``, kmeans_spark.py:147-159:
+np.linalg.norm per point + argmin), scaled by BASELINE.json's 8 Spark
+workers with PERFECT linear scaling assumed — a deliberately generous
+baseline (real Spark adds shuffle/serialization overhead on top, and its
+reduceByKey pass is not even counted here).
 
-Env overrides: BENCH_N, BENCH_D, BENCH_K, BENCH_ITERS, BENCH_DTYPE.
+Env overrides: BENCH_N, BENCH_D, BENCH_K, BENCH_ITERS, BENCH_MODE.
 """
 
 from __future__ import annotations
@@ -50,6 +56,14 @@ def baseline_throughput(d: int, k: int, workers: int = 8,
     return workers * d / per_point
 
 
+def timed_fit(fit_fn, points, weights, cents) -> float:
+    """Wall seconds for one fit dispatch (scalar-transfer synchronized)."""
+    start = time.perf_counter()
+    out = fit_fn(points, weights, cents)
+    int(out[1])                                    # n_iters -> sync barrier
+    return time.perf_counter() - start
+
+
 def main() -> None:
     import jax
 
@@ -58,20 +72,19 @@ def main() -> None:
     n = int(os.environ.get("BENCH_N", 2_000_000 if on_accel else 100_000))
     d = int(os.environ.get("BENCH_D", 128))
     k = int(os.environ.get("BENCH_K", 1024))
-    iters = int(os.environ.get("BENCH_ITERS", 5))
-    dtype = np.dtype(os.environ.get("BENCH_DTYPE", "float32"))
+    iters = int(os.environ.get("BENCH_ITERS", 20))
+    mode = os.environ.get("BENCH_MODE", "matmul")
 
     log(f"bench: backend={backend} devices={len(jax.devices())} "
-        f"N={n} D={d} k={k} iters={iters} dtype={dtype}")
+        f"N={n} D={d} k={k} iters={iters} mode={mode}")
 
-    from kmeans_tpu.models.kmeans import _get_step_fns
     from kmeans_tpu.parallel import distributed as dist
     from kmeans_tpu.parallel.mesh import make_mesh, mesh_shape
     from kmeans_tpu.parallel.sharding import choose_chunk_size, shard_points
 
     rng = np.random.default_rng(42)
-    X = rng.uniform(-1, 1, size=(n, d)).astype(dtype)
-    init = X[rng.choice(n, size=k, replace=False)]
+    X = rng.uniform(-1, 1, size=(n, d)).astype(np.float32)
+    init = X[rng.choice(n, size=k, replace=False)].copy()
 
     mesh = make_mesh()
     data_shards, model_shards = mesh_shape(mesh)
@@ -79,22 +92,25 @@ def main() -> None:
     points, weights = shard_points(X, mesh, chunk)
     cents = jax.device_put(dist.pad_centroids(init, model_shards),
                            dist.centroid_sharding(mesh))
-    step_fn, _ = _get_step_fns(mesh, chunk, "matmul")
 
-    # Warmup: compile + one extra steady-state step.  Synchronization is via
-    # a scalar transfer (float(sse)) — block_until_ready is not a reliable
-    # barrier on tunneled/experimental PJRT platforms.
+    def build(max_iter: int):
+        return dist.make_fit_fn(mesh, chunk_size=chunk, mode=mode, k_real=k,
+                                max_iter=max_iter, tolerance=1e-30,
+                                empty_policy="keep")
+
+    fit_small, fit_big = build(2), build(2 + iters)
     t0 = time.perf_counter()
-    float(step_fn(points, weights, cents).sse)
-    log(f"bench: compile+first step {time.perf_counter() - t0:.1f}s")
-    float(step_fn(points, weights, cents).sse)
+    timed_fit(fit_small, points, weights, cents)
+    timed_fit(fit_big, points, weights, cents)
+    log(f"bench: compile+warmup {time.perf_counter() - t0:.1f}s")
 
-    start = time.perf_counter()
-    for _ in range(iters):
-        stats = step_fn(points, weights, cents)
-        float(stats.sse)
-    per_iter = (time.perf_counter() - start) / iters
-    log(f"bench: {per_iter*1e3:.1f} ms/iter, sse={float(stats.sse):.4e}")
+    t_small = min(timed_fit(fit_small, points, weights, cents)
+                  for _ in range(2))
+    t_big = min(timed_fit(fit_big, points, weights, cents)
+                for _ in range(2))
+    per_iter = max((t_big - t_small) / iters, 1e-9)
+    log(f"bench: fit(2)={t_small*1e3:.0f} ms, fit({2+iters})="
+        f"{t_big*1e3:.0f} ms -> {per_iter*1e3:.2f} ms/iter steady-state")
 
     n_chips = max(1, len(jax.devices()))
     throughput = n * d / per_iter / n_chips

@@ -229,7 +229,9 @@ class KMeans:
             return self._fit(X, sample_weight=sample_weight, resume=resume)
 
     def _fit(self, X, *, sample_weight, resume) -> "KMeans":
-        log = IterationLogger(self.verbose)
+        # Multi-host: only process 0 narrates (every host computes the same
+        # replicated statistics, so logs would be identical k-fold spam).
+        log = IterationLogger(self.verbose and jax.process_index() == 0)
         if sample_weight is not None:
             if isinstance(X, ShardedDataset):
                 raise ValueError("pass sample_weight when caching the "

@@ -38,7 +38,8 @@ class MiniBatchKMeans(KMeans):
             raise ValueError(f"X must be 2-D (n, D), got shape {X.shape}")
         n, d = X.shape
         bs = min(self.batch_size, n)
-        log = IterationLogger(self.verbose)
+        import jax
+        log = IterationLogger(self.verbose and jax.process_index() == 0)
 
         if resume and self.centroids is not None:
             centroids = np.asarray(self.centroids, dtype=np.float64)

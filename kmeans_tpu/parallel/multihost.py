@@ -1,0 +1,50 @@
+"""Multi-host (multi-process) setup helpers.
+
+The reference delegates multi-node execution to the Spark driver/executor
+runtime (outside its repo; SURVEY.md §2.3).  Here multi-host is the same
+SPMD program: every host runs the identical jitted step over the global
+``Mesh``; XLA routes the ``psum``/``all_gather`` over ICI within a slice
+and DCN across slices.  Because every statistic the host loop consumes
+(sums, counts, SSE) is REPLICATED by the psum, each host's driver loop
+computes the identical centroid update and convergence decision — no
+cross-host coordination code is needed beyond this initialization.
+
+Typical multi-host entry:
+
+    from kmeans_tpu.parallel.multihost import initialize
+    initialize()                       # jax.distributed handshake
+    mesh = make_mesh()                 # global devices, all hosts
+    km = KMeans(k=1024, mesh=mesh)
+    km.fit(X_local_shard_or_full)      # same code as single host
+
+Data loading: each host may pass the full array (simplest; placement
+shards it) or use `jax.make_array_from_process_local_data` for
+host-sharded loading of very large datasets.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """``jax.distributed.initialize`` wrapper; no-op if already initialized
+    or running single-process (so the same script runs everywhere)."""
+    if jax.process_count() > 1:
+        return                          # already initialized
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+    except (ValueError, RuntimeError):
+        # Single-process run (no coordinator env) — nothing to do.
+        pass
+
+
+def is_primary() -> bool:
+    """True on the process that should own logging/artifact writes."""
+    return jax.process_index() == 0
