@@ -15,6 +15,7 @@ All entry points accept either a host ``(n, D)`` array or a
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -76,26 +77,15 @@ def _update_mind2(x: jax.Array, mind2: jax.Array, c: jax.Array) -> jax.Array:
     return jnp.minimum(mind2, d2)
 
 
-def kmeanspp_init(X, k: int, seed: int) -> np.ndarray:
-    """k-means++ seeding; device-accelerated distance maintenance."""
-    src = as_source(X)
-    host = getattr(src, "host", None)
-    if host is None:
-        # Pre-sharded device-only data: run the on-device variant.
-        return kmeanspp_device_init(src, k, seed)
-    X = host
+def _weighted_kmeanspp_host(X: np.ndarray, w: np.ndarray, k: int,
+                            rng: np.random.Generator) -> np.ndarray:
+    """Core weighted D²-seeding loop over a host array (device-accelerated
+    distance maintenance); also the final reduction step of kmeans||."""
     n = X.shape[0]
-    sw = getattr(src, "host_weights", None)
-    w = (np.ones(n) if sw is None
-         else np.asarray(sw, dtype=np.float64))
     if int((w > 0).sum()) < k:
         raise ValueError(
             f"Not enough data points ({int((w > 0).sum())}) to initialize "
             f"{k} clusters")
-    # Full scan (not just the chosen rows): a NaN anywhere poisons the D^2
-    # distance weights, so the guard must cover all of X here.
-    check_finite_array(X, "Data contains NaN or Inf values")
-    rng = np.random.default_rng(seed)
     x = jnp.asarray(X)
     centers = np.empty((k, X.shape[1]), dtype=X.dtype)
     centers[0] = X[rng.choice(n, p=w / w.sum())]   # first draw ~ weights
@@ -111,6 +101,23 @@ def kmeanspp_init(X, k: int, seed: int) -> np.ndarray:
             idx = rng.choice(n, p=p / total)
         centers[i] = X[idx]
     return centers
+
+
+def kmeanspp_init(X, k: int, seed: int) -> np.ndarray:
+    """k-means++ seeding; device-accelerated distance maintenance."""
+    src = as_source(X)
+    host = getattr(src, "host", None)
+    if host is None:
+        # Pre-sharded device-only data: run the on-device variant.
+        return kmeanspp_device_init(src, k, seed)
+    X = host
+    sw = getattr(src, "host_weights", None)
+    w = (np.ones(X.shape[0]) if sw is None
+         else np.asarray(sw, dtype=np.float64))
+    # Full scan (not just the chosen rows): a NaN anywhere poisons the D^2
+    # distance weights, so the guard must cover all of X here.
+    check_finite_array(X, "Data contains NaN or Inf values")
+    return _weighted_kmeanspp_host(X, w, k, np.random.default_rng(seed))
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
@@ -164,8 +171,116 @@ def kmeanspp_device_init(ds, k: int, seed: int) -> np.ndarray:
     return centers
 
 
+@functools.partial(jax.jit, static_argnames=("cap",))
+def _parallel_round(points, weights, mind2, phi, key, ell, cap: int):
+    """One kmeans|| oversampling round, fully on device: Bernoulli-sample
+    each point with prob min(1, ell*w*d²/phi), return up to ``cap`` sampled
+    indices (+ validity mask) and the mind2 folded with the PREVIOUS round's
+    candidates is expected already folded by the caller."""
+    p = jnp.minimum(1.0, ell * weights * mind2 /
+                    jnp.maximum(phi, jnp.finfo(mind2.dtype).tiny))
+    u = jax.random.uniform(key, mind2.shape, dtype=mind2.dtype)
+    sampled = (u < p) & (weights > 0)
+    # Up to cap winners; among sampled points the u-order is an arbitrary
+    # (seed-determined) subset, which is what the cap needs.
+    score = jnp.where(sampled, 1.0 + u, 0.0)
+    vals, idx = jax.lax.top_k(score, cap)
+    return idx, vals > 0
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))
+def _fold_candidates(points, mind2, cands, valid):
+    """mind2 <- min(mind2, d²(points, c)) for each valid candidate row."""
+    def body(m, cv):
+        c, v = cv
+        d2 = jnp.sum((points - c[None, :]) ** 2, axis=1)
+        return jnp.where(v, jnp.minimum(m, d2), m), None
+
+    mind2, _ = jax.lax.scan(body, mind2, (cands, valid))
+    return mind2
+
+
+def kmeans_parallel_init(X, k: int, seed: int, *, rounds: int = 5,
+                         oversampling: Optional[float] = None) -> np.ndarray:
+    """kmeans|| seeding (Bahmani et al. 2012) — the distributed-scale
+    initializer.  Each round Bernoulli-samples ~l = oversampling*k
+    candidates proportional to current D² cost, fully on device over the
+    sharded points; candidates are then weighted by the size of their
+    nearest-candidate cell (ONE fused assign_reduce pass) and reduced to k
+    seeds with weighted k-means++ on the host.  O(rounds) passes over the
+    data instead of k-means++'s O(k)."""
+    from kmeans_tpu.ops.assign import assign_reduce
+
+    src = as_source(X)
+    candidates_idx = src.positive_rows()
+    if len(candidates_idx) < k:
+        raise ValueError(
+            f"Not enough data points ({len(candidates_idx)}) to initialize "
+            f"{k} clusters")
+    if getattr(src, "host", None) is not None:
+        check_finite_array(src.host, "Data contains NaN or Inf values")
+
+    points = getattr(src, "points", None)
+    weights = getattr(src, "weights", None)
+    if points is None:                   # plain host array source
+        points = jnp.asarray(src.host)
+        weights = (jnp.ones(src.n, points.dtype)
+                   if src.host_weights is None
+                   else jnp.asarray(src.host_weights, points.dtype))
+
+    ell = float(oversampling if oversampling is not None else 2 * k)
+    cap = int(min(max(2 * k, 256), 2048))
+    rounds = max(rounds, -(-int(1.5 * k) // cap))  # ensure >= 1.5k samples
+    key = jax.random.PRNGKey(seed)
+    rng = np.random.default_rng(seed)
+
+    # Seed candidate: one weight-proportional draw.
+    first = int(candidates_idx[rng.integers(len(candidates_idx))])
+    cand_rows = [np.asarray(src.take(np.array([first])))]
+    cand_valid = [np.ones(1, bool)]
+    mind2 = jnp.full((points.shape[0],), jnp.inf, points.dtype)
+    mind2 = _fold_candidates(points, mind2,
+                             jnp.asarray(cand_rows[0]),
+                             jnp.ones(1, bool))
+
+    for r in range(rounds):
+        phi = jnp.sum(jnp.where(weights > 0, mind2 * weights, 0.0))
+        idx, valid = _parallel_round(points, weights, mind2, phi,
+                                     jax.random.fold_in(key, r), ell, cap)
+        idx_np = np.asarray(idx)
+        valid_np = np.asarray(valid)
+        rows = np.asarray(points[idx])        # gather on device, then host
+        cand_rows.append(rows)
+        cand_valid.append(valid_np)
+        mind2 = _fold_candidates(points, mind2, jnp.asarray(rows),
+                                 jnp.asarray(valid_np))
+
+    cands = np.concatenate(cand_rows)[np.concatenate(cand_valid)]
+    cands = np.unique(cands, axis=0)
+    if len(cands) < k:                       # tiny data: backfill uniformly
+        extra = src.take(candidates_idx[rng.choice(
+            len(candidates_idx), size=k - len(cands), replace=False)])
+        cands = np.concatenate([cands, np.asarray(extra)])
+
+    # Weight candidates by their nearest-candidate cell mass: one fused
+    # pass of the SAME step kernel with candidates as "centroids".
+    chunk = 512
+    pad = (-points.shape[0]) % chunk
+    pts_pad = jnp.pad(points, ((0, pad), (0, 0)))
+    w_pad = jnp.pad(weights, (0, pad))
+    stats = assign_reduce(pts_pad, w_pad, jnp.asarray(cands),
+                          chunk_size=chunk)
+    cell_mass = np.maximum(np.asarray(stats.counts, np.float64), 1e-12)
+
+    centers = _weighted_kmeanspp_host(cands.astype(np.float64), cell_mass,
+                                      k, rng)
+    return centers.astype(np.asarray(cands).dtype)
+
+
 INITIALIZERS = {"forgy": forgy_init, "random": forgy_init,
-                "k-means++": kmeanspp_init, "kmeans++": kmeanspp_init}
+                "k-means++": kmeanspp_init, "kmeans++": kmeanspp_init,
+                "k-means||": kmeans_parallel_init,
+                "kmeans||": kmeans_parallel_init}
 
 
 def resolve_init(init, X, k: int, seed: int) -> np.ndarray:

@@ -95,6 +95,48 @@ def test_device_kmeanspp_on_sharded_data(mesh8):
     assert km.sse_history[-1] <= ref.inertia_ * 1.05
 
 
+def test_kmeans_parallel_init_quality(mesh8):
+    # kmeans|| on well-separated blobs should land near the true optimum.
+    X, _ = make_blobs(n_samples=4000, centers=6, n_features=5,
+                      cluster_std=0.3, random_state=1)
+    X = X.astype(np.float64)
+    km = KMeans(k=6, init="kmeans||", seed=3, mesh=mesh8, dtype=np.float64,
+                compute_sse=True, verbose=False).fit(X)
+    from sklearn.cluster import KMeans as SK
+    ref = SK(n_clusters=6, n_init=10, random_state=0).fit(X)
+    assert km.sse_history[-1] <= ref.inertia_ * 1.05
+
+
+def test_kmeans_parallel_init_weighted_excludes_zero(mesh8):
+    rng = np.random.default_rng(9)
+    X = np.concatenate([rng.normal(size=(300, 2)),
+                        rng.normal(loc=500.0, size=(100, 2))])
+    w = np.concatenate([np.ones(300), np.zeros(100)])
+    km = KMeans(k=4, init="k-means||", seed=2, mesh=mesh8,
+                dtype=np.float64, verbose=False).fit(X, sample_weight=w)
+    assert np.all(np.abs(km.centroids) < 100)
+
+
+def test_kmeans_parallel_init_on_sharded_data(mesh8):
+    X, _ = make_blobs(n_samples=3000, centers=5, n_features=4,
+                      cluster_std=0.4, random_state=4)
+    X = X.astype(np.float64)
+    km = KMeans(k=5, init="kmeans||", seed=7, mesh=mesh8, dtype=np.float64,
+                compute_sse=True, verbose=False)
+    ds = km.cache(X)
+    km.fit(ds)
+    assert np.all(np.isfinite(km.centroids))
+    assert len(np.unique(km.centroids.round(9), axis=0)) == 5
+
+
+def test_kmeans_parallel_tiny_data_backfills(mesh8):
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(12, 3))
+    km = KMeans(k=8, init="kmeans||", seed=1, mesh=mesh8, dtype=np.float64,
+                verbose=False).fit(X)
+    assert km.centroids.shape == (8, 3)
+
+
 def test_device_kmeanspp_distinct_centers(mesh8):
     rng = np.random.default_rng(2)
     X = rng.normal(size=(500, 6))
