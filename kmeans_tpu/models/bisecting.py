@@ -1,0 +1,219 @@
+"""Bisecting (divisive hierarchical) K-Means on a TPU mesh.
+
+A beyond-reference model family (the reference implements flat K-Means only,
+``class KMeans``, kmeans_spark.py:19-352): start from one cluster holding all
+points and repeatedly split the "worst" cluster with a 2-means fit until k
+clusters exist — sklearn's ``BisectingKMeans`` capability, re-designed
+TPU-first.
+
+The TPU-native trick is **static-shape subproblems via weight masking**:
+each 2-means split runs over the FULL sharded dataset with the non-members'
+sample weights set to 0 (``ShardedDataset.with_weights`` — one tiny (n,)
+upload; the (n, D) points never move).  Zero-weight rows contribute nothing
+to any statistic (ops.assign), the shapes every jitted step was compiled for
+never change, and no data-dependent gather/compaction is ever needed — the
+exact failure mode a literal port (boolean-mask the member rows) would hit
+under XLA.
+
+The split criterion uses the fused per-cluster SSE (``StepStats.
+sse_per_cluster``), which the shared assignment pass produces at ~zero
+marginal cost — the same "fuse the metric into the pass you already make"
+move the flat model uses for total SSE vs the reference's second data pass
+(kmeans_spark.py:208-237).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from kmeans_tpu.models.kmeans import KMeans
+from kmeans_tpu.utils.logging import IterationLogger
+
+_STRATEGIES = ("biggest_sse", "largest_cluster")
+
+
+class BisectingKMeans(KMeans):
+    """Divisive hierarchical K-Means (sklearn ``BisectingKMeans`` analogue).
+
+    Same constructor surface as :class:`KMeans` plus:
+
+    bisecting_strategy : 'biggest_sse' (split the cluster with the largest
+        within-cluster SSE — sklearn's ``biggest_inertia``) |
+        'largest_cluster' (split the heaviest cluster).
+
+    Attributes after ``fit``: ``centroids`` (k, D); ``labels_`` (n,) — the
+    HIERARCHICAL memberships produced by the successive splits;
+    ``cluster_sse_`` (k,) per-leaf SSE; ``cluster_sizes_`` (k,) weighted
+    sizes; ``sse_history`` — total SSE after each split (when
+    ``compute_sse``); ``iterations_run`` — number of splits performed.
+
+    ``predict`` is inherited flat nearest-centroid assignment over the final
+    leaves; for points seen in ``fit`` it can differ from ``labels_`` on
+    boundary points, because bisecting membership follows the split tree
+    (same caveat as sklearn's tree-walking predict vs its labels_).
+    """
+
+    def __init__(self, k: int = 3, max_iter: int = 100,
+                 tolerance: float = 1e-4, seed: int = 42,
+                 compute_sse: bool = False, *,
+                 bisecting_strategy: str = "biggest_sse",
+                 **kwargs):
+        if bisecting_strategy not in _STRATEGIES:
+            raise ValueError(f"bisecting_strategy must be one of "
+                             f"{_STRATEGIES}, got {bisecting_strategy!r}")
+        self.bisecting_strategy = bisecting_strategy
+        kwargs.setdefault("empty_cluster", "resample")
+        super().__init__(k=k, max_iter=max_iter, tolerance=tolerance,
+                         seed=seed, compute_sse=compute_sse, **kwargs)
+        self.labels_: Optional[np.ndarray] = None
+        self.cluster_sse_: Optional[np.ndarray] = None
+
+    # ------------------------------------------------------------------- fit
+
+    def _inner_init(self):
+        """Init strategy for the per-split 2-means (array/callable inits are
+        k-specific and cannot seed a k=2 subproblem)."""
+        return self.init if isinstance(self.init, str) else "k-means++"
+
+    def _fit(self, X, *, sample_weight, resume) -> "BisectingKMeans":
+        if resume:
+            raise ValueError("BisectingKMeans does not support resume=True "
+                             "(splits are not checkpointable mid-tree)")
+        verbose = self.verbose and jax.process_index() == 0
+        log = IterationLogger(verbose)
+        if sample_weight is not None:
+            from kmeans_tpu.parallel.sharding import ShardedDataset
+            if isinstance(X, ShardedDataset):
+                raise ValueError("pass sample_weight when caching the "
+                                 "dataset, not on a pre-built ShardedDataset")
+            X = self.cache(X, sample_weight=sample_weight)
+        ds, mesh, model_shards, step_fn, predict_fn = self._prepare(X)
+
+        n = ds.n
+        # Validate the data ONCE up front (same message as the reference's
+        # finite guard, kmeans_spark.py:79-80); the per-split inner fits
+        # skip their init-time full-array re-scans.
+        if ds.host is not None:
+            from kmeans_tpu.utils.validation import check_finite_array
+            check_finite_array(ds.host, "Data contains NaN or Inf values")
+        base_w = (np.ones(n, dtype=np.float64) if ds.host_weights is None
+                  else np.asarray(ds.host_weights, dtype=np.float64))
+        if int((base_w > 0).sum()) < self.k:
+            raise ValueError(
+                f"Not enough data points ({int((base_w > 0).sum())}) to "
+                f"initialize {self.k} clusters")
+
+        log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
+        self.sse_history = []
+        self.iter_times_ = []
+
+        labels = np.zeros(n, dtype=np.int32)
+        # Per-leaf state, keyed by leaf id (ids stay contiguous 0..n_leaves-1:
+        # child 0 of a split keeps the parent's id, child 1 takes the next
+        # free id).
+        cents = {0: None}
+        sse = {0: np.inf}          # root is always the first split target
+        wsize = {0: float(base_w.sum())}
+        members = {0: int((base_w > 0).sum())}
+
+        import time as _time
+        for split in range(self.k - 1):
+            t0 = _time.perf_counter()
+            splittable = [c for c in cents
+                          if members[c] >= 2 and
+                          (np.isinf(sse[c]) or sse[c] > 0)]
+            if not splittable:
+                raise RuntimeError(
+                    f"Cannot bisect further: {len(cents)} clusters exist but "
+                    f"no cluster has >= 2 distinct members (k={self.k})")
+            crit = sse if self.bisecting_strategy == "biggest_sse" else wsize
+            target = max(splittable, key=lambda c: crit[c])
+
+            w_child = (base_w * (labels == target)).astype(self.dtype)
+            ds_t = ds.with_weights(w_child)
+            inner = KMeans(
+                k=2, max_iter=self.max_iter, tolerance=self.tolerance,
+                seed=int(np.random.SeedSequence(
+                    [self.seed, split]).generate_state(1)[0] % (2 ** 31)),
+                compute_sse=False, init=self._inner_init(),
+                empty_cluster="resample", dtype=self.dtype, mesh=mesh,
+                chunk_size=ds.chunk, distance_mode=self.distance_mode,
+                host_loop=True, verbose=False)
+            inner._validate_init = False     # X validated once above
+            inner.fit(ds_t)
+
+            two = self._put_centroids(np.asarray(inner.centroids), mesh,
+                                      model_shards)
+            # Hierarchical membership: every current member goes to its
+            # nearest child (consistent tie-breaks with the eval pass below).
+            child = np.asarray(predict_fn(ds.points, two))[:n]
+            new_id = len(cents)
+            mask = labels == target
+            labels[mask & (child == 1)] = new_id
+
+            # One fused pass gives both children's exact post-fit SSE and
+            # weighted sizes (StepStats.sse_per_cluster) — the split
+            # criterion's bookkeeping costs one pass, not two.
+            stats = step_fn(ds_t.points, ds_t.weights, two)
+            sse_pc = np.asarray(stats.sse_per_cluster, np.float64)[:2]
+            counts = np.asarray(stats.counts, np.float64)[:2]
+            cents[target] = np.asarray(inner.centroids)[0]
+            cents[new_id] = np.asarray(inner.centroids)[1]
+            sse[target], sse[new_id] = sse_pc[0], sse_pc[1]
+            wsize[target], wsize[new_id] = counts[0], counts[1]
+            pos = base_w > 0
+            members[target] = int((pos & (labels == target)).sum())
+            members[new_id] = int((pos & (labels == new_id)).sum())
+
+            self.iter_times_.append(_time.perf_counter() - t0)
+            total = float(sum(v for v in sse.values() if np.isfinite(v)))
+            if self.compute_sse:
+                self.sse_history.append(total)
+            if verbose:
+                log._emit(
+                    f"Split {split + 1}: cluster {target} -> "
+                    f"({target}, {new_id}), sizes = "
+                    f"({counts[0]:.0f}, {counts[1]:.0f})"
+                    + (f", total SSE = {total:.4f}"
+                       if self.compute_sse else ""))
+            self.iterations_run = split + 1
+
+        k_out = len(cents)
+        if k_out == 1:
+            # k=1: the single "leaf" centroid is the weighted mean — one
+            # pass against a zero centroid yields exactly the global sums.
+            zero = self._put_centroids(
+                np.zeros((1, ds.d), dtype=self.dtype), mesh, model_shards)
+            stats = step_fn(ds.points, ds.weights, zero)
+            s = np.asarray(stats.sums, np.float64)[0]
+            c = float(np.asarray(stats.counts, np.float64)[0])
+            cents[0] = (s / max(c, 1.0)).astype(self.dtype)
+            sse[0] = float(np.asarray(stats.sse_per_cluster, np.float64)[0]
+                           - np.dot(s, s) / max(c, 1.0))
+            wsize[0] = c
+            if self.compute_sse:
+                self.sse_history.append(max(sse[0], 0.0))
+
+        self.centroids = np.stack(
+            [np.asarray(cents[i], dtype=self.dtype) for i in range(k_out)])
+        if not np.all(np.isfinite(self.centroids)):  # kmeans_spark.py:289-290
+            raise ValueError("NaN or Inf detected in centroids")
+        self.labels_ = labels
+        self.cluster_sse_ = np.array([sse[i] for i in range(k_out)])
+        self.cluster_sizes_ = np.array([wsize[i] for i in range(k_out)])
+        return self
+
+    # ------------------------------------------------------------ checkpoint
+
+    def _state_dict(self) -> dict:
+        state = super()._state_dict()
+        state["bisecting_strategy"] = self.bisecting_strategy
+        return state
+
+    @classmethod
+    def _load_kwargs(cls, state: dict) -> dict:
+        return {"bisecting_strategy": state.get("bisecting_strategy",
+                                                "biggest_sse")}

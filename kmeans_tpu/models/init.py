@@ -51,7 +51,7 @@ def as_source(X):
     return X if hasattr(X, "take") and hasattr(X, "n") else _ArraySource(X)
 
 
-def forgy_init(X, k: int, seed: int) -> np.ndarray:
+def forgy_init(X, k: int, seed: int, *, validate: bool = True) -> np.ndarray:
     """Seeded sample of k distinct rows (kmeans_spark.py:58-82 semantics).
 
     With sample weights present, sampling is uniform over the POSITIVE-
@@ -67,7 +67,8 @@ def forgy_init(X, k: int, seed: int) -> np.ndarray:
     idx = candidates[rng.choice(len(candidates), size=k, replace=False)]
     centroids = np.asarray(src.take(idx))
     # Same message as the reference's finite guard (kmeans_spark.py:79-80).
-    check_finite_array(centroids, "Data contains NaN or Inf values")
+    if validate:
+        check_finite_array(centroids, "Data contains NaN or Inf values")
     return centroids
 
 
@@ -103,8 +104,13 @@ def _weighted_kmeanspp_host(X: np.ndarray, w: np.ndarray, k: int,
     return centers
 
 
-def kmeanspp_init(X, k: int, seed: int) -> np.ndarray:
-    """k-means++ seeding; device-accelerated distance maintenance."""
+def kmeanspp_init(X, k: int, seed: int, *, validate: bool = True
+                  ) -> np.ndarray:
+    """k-means++ seeding; device-accelerated distance maintenance.
+
+    ``validate=False`` skips the full-array finite scan — for callers that
+    already validated the data once and re-seed repeatedly over the same
+    array (e.g. BisectingKMeans' per-split 2-means fits)."""
     src = as_source(X)
     host = getattr(src, "host", None)
     if host is None:
@@ -116,7 +122,8 @@ def kmeanspp_init(X, k: int, seed: int) -> np.ndarray:
          else np.asarray(sw, dtype=np.float64))
     # Full scan (not just the chosen rows): a NaN anywhere poisons the D^2
     # distance weights, so the guard must cover all of X here.
-    check_finite_array(X, "Data contains NaN or Inf values")
+    if validate:
+        check_finite_array(X, "Data contains NaN or Inf values")
     return _weighted_kmeanspp_host(X, w, k, np.random.default_rng(seed))
 
 
@@ -172,11 +179,11 @@ def kmeanspp_device_init(ds, k: int, seed: int) -> np.ndarray:
 
 
 @functools.partial(jax.jit, static_argnames=("cap",))
-def _parallel_round(points, weights, mind2, phi, key, ell, cap: int):
+def _parallel_round(weights, mind2, phi, key, ell, cap: int):
     """One kmeans|| oversampling round, fully on device: Bernoulli-sample
-    each point with prob min(1, ell*w*d²/phi), return up to ``cap`` sampled
-    indices (+ validity mask) and the mind2 folded with the PREVIOUS round's
-    candidates is expected already folded by the caller."""
+    each point with prob min(1, ell*w*d²/phi); returns up to ``cap`` sampled
+    indices plus a validity mask.  The caller is responsible for folding the
+    returned candidates into ``mind2`` before the next round."""
     p = jnp.minimum(1.0, ell * weights * mind2 /
                     jnp.maximum(phi, jnp.finfo(mind2.dtype).tiny))
     u = jax.random.uniform(key, mind2.shape, dtype=mind2.dtype)
@@ -201,7 +208,8 @@ def _fold_candidates(points, mind2, cands, valid):
 
 
 def kmeans_parallel_init(X, k: int, seed: int, *, rounds: int = 5,
-                         oversampling: Optional[float] = None) -> np.ndarray:
+                         oversampling: Optional[float] = None,
+                         validate: bool = True) -> np.ndarray:
     """kmeans|| seeding (Bahmani et al. 2012) — the distributed-scale
     initializer.  Each round Bernoulli-samples ~l = oversampling*k
     candidates proportional to current D² cost, fully on device over the
@@ -217,7 +225,7 @@ def kmeans_parallel_init(X, k: int, seed: int, *, rounds: int = 5,
         raise ValueError(
             f"Not enough data points ({len(candidates_idx)}) to initialize "
             f"{k} clusters")
-    if getattr(src, "host", None) is not None:
+    if validate and getattr(src, "host", None) is not None:
         check_finite_array(src.host, "Data contains NaN or Inf values")
 
     points = getattr(src, "points", None)
@@ -229,13 +237,21 @@ def kmeans_parallel_init(X, k: int, seed: int, *, rounds: int = 5,
                    else jnp.asarray(src.host_weights, points.dtype))
 
     ell = float(oversampling if oversampling is not None else 2 * k)
-    cap = int(min(max(2 * k, 256), 2048))
+    # cap may not exceed the (padded) point count — lax.top_k requires it.
+    cap = int(min(max(2 * k, 256), 2048, points.shape[0]))
     rounds = max(rounds, -(-int(1.5 * k) // cap))  # ensure >= 1.5k samples
     key = jax.random.PRNGKey(seed)
     rng = np.random.default_rng(seed)
 
-    # Seed candidate: one weight-proportional draw.
-    first = int(candidates_idx[rng.integers(len(candidates_idx))])
+    # Seed candidate: one weight-proportional draw (matching the first draw
+    # of _weighted_kmeanspp_host / _kmeanspp_device).
+    sw = getattr(src, "host_weights", None)
+    if sw is None:
+        first = int(candidates_idx[rng.integers(len(candidates_idx))])
+    else:
+        pw = np.asarray(sw, dtype=np.float64)[candidates_idx]
+        first = int(candidates_idx[rng.choice(len(candidates_idx),
+                                              p=pw / pw.sum())])
     cand_rows = [np.asarray(src.take(np.array([first])))]
     cand_valid = [np.ones(1, bool)]
     mind2 = jnp.full((points.shape[0],), jnp.inf, points.dtype)
@@ -245,15 +261,12 @@ def kmeans_parallel_init(X, k: int, seed: int, *, rounds: int = 5,
 
     for r in range(rounds):
         phi = jnp.sum(jnp.where(weights > 0, mind2 * weights, 0.0))
-        idx, valid = _parallel_round(points, weights, mind2, phi,
+        idx, valid = _parallel_round(weights, mind2, phi,
                                      jax.random.fold_in(key, r), ell, cap)
-        idx_np = np.asarray(idx)
-        valid_np = np.asarray(valid)
-        rows = np.asarray(points[idx])        # gather on device, then host
-        cand_rows.append(rows)
-        cand_valid.append(valid_np)
-        mind2 = _fold_candidates(points, mind2, jnp.asarray(rows),
-                                 jnp.asarray(valid_np))
+        rows_dev = points[idx]                # gather stays on device
+        cand_rows.append(np.asarray(rows_dev))
+        cand_valid.append(np.asarray(valid))
+        mind2 = _fold_candidates(points, mind2, rows_dev, valid)
 
     cands = np.concatenate(cand_rows)[np.concatenate(cand_valid)]
     cands = np.unique(cands, axis=0)
@@ -283,8 +296,13 @@ INITIALIZERS = {"forgy": forgy_init, "random": forgy_init,
                 "kmeans||": kmeans_parallel_init}
 
 
-def resolve_init(init, X, k: int, seed: int) -> np.ndarray:
-    """Dispatch: strategy name, callable, or an explicit (k, D) array."""
+def resolve_init(init, X, k: int, seed: int, *,
+                 validate: bool = True) -> np.ndarray:
+    """Dispatch: strategy name, callable, or an explicit (k, D) array.
+
+    ``validate=False`` skips redundant full-array finite scans in the named
+    strategies (data already validated by the caller); custom callables
+    manage their own validation."""
     src = as_source(X)
     dtype = np.dtype(str(src.dtype))
     if callable(init):
@@ -297,7 +315,7 @@ def resolve_init(init, X, k: int, seed: int) -> np.ndarray:
         except KeyError:
             raise ValueError(f"unknown init strategy: {init!r}; "
                              f"options: {sorted(INITIALIZERS)}") from None
-        return np.asarray(fn(src, k, seed), dtype=dtype)
+        return np.asarray(fn(src, k, seed, validate=validate), dtype=dtype)
     arr = np.asarray(init, dtype=dtype)
     if arr.shape != (k, src.d):
         raise ValueError(f"explicit init must have shape ({k}, "

@@ -140,6 +140,9 @@ class KMeans:
         self.iter_times_: List[float] = []            # wall secs/iteration
         validate_params(k, max_iter, tolerance)       # kmeans_spark.py:46
         self.iterations_run = 0                       # kmeans_spark.py:47
+        # Internal: skip init-time full-array finite scans when the caller
+        # (e.g. BisectingKMeans) already validated the data once.
+        self._validate_init = True
 
     # ------------------------------------------------------------------ mesh
 
@@ -246,7 +249,8 @@ class KMeans:
             start_iter = self.iterations_run
         else:
             # Forgy/k-means++/explicit init (kmeans_spark.py:58-82, :259).
-            centroids = resolve_init(self.init, ds, self.k, self.seed)
+            centroids = resolve_init(self.init, ds, self.k, self.seed,
+                                     validate=self._validate_init)
             self.sse_history = []
             self.iterations_run = 0
             self.iter_times_ = []

@@ -53,6 +53,7 @@ class StepStats(NamedTuple):
     sse: jax.Array             # ()    sum of min squared distances
     farthest_dist: jax.Array   # ()    max over points of min distance^2
     farthest_point: jax.Array  # (D,)  the point achieving farthest_dist
+    sse_per_cluster: jax.Array  # (k,) per-cluster sum of min sq distances
 
 
 def _accum_dtype(dtype) -> jnp.dtype:
@@ -122,6 +123,7 @@ def init_stats(k: int, d: int, acc) -> StepStats:
         sse=jnp.zeros((), acc),
         farthest_dist=jnp.full((), -1.0, acc),
         farthest_point=jnp.zeros((d,), acc),
+        sse_per_cluster=jnp.zeros((k,), acc),
     )
 
 
@@ -160,6 +162,11 @@ def accumulate_chunk(carry: StepStats, xc: jax.Array, wc: jax.Array,
         preferred_element_type=acc)                        # (k, D) on the MXU
     counts = carry.counts + jnp.sum(onehot, axis=0)
     sse = carry.sse + jnp.sum(mind2_g * wc)
+    # Per-cluster SSE: the same one-hot (already weight- and ownership-
+    # scaled) contracted against the min distances — a (k, c) matvec, ~free
+    # next to the two matmuls above.  Feeds BisectingKMeans' split criterion.
+    sse_pc = carry.sse_per_cluster + jnp.einsum(
+        "ck,c->k", onehot, mind2_g.astype(acc))
     masked = jnp.where(wc > 0, mind2_g, -jnp.inf)
     i = jnp.argmax(masked)
     far_d, far_p = masked[i], xc[i].astype(acc)
@@ -167,7 +174,8 @@ def accumulate_chunk(carry: StepStats, xc: jax.Array, wc: jax.Array,
     return StepStats(
         sums, counts, sse,
         jnp.where(better, far_d, carry.farthest_dist),
-        jnp.where(better, far_p, carry.farthest_point))
+        jnp.where(better, far_p, carry.farthest_point),
+        sse_pc)
 
 
 @functools.partial(jax.jit, static_argnames=("chunk_size", "mode"))

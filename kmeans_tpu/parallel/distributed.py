@@ -87,12 +87,14 @@ def _pallas_local_stats(points, weights, centroids_block, *, mode: str):
         bf16=(mode == "pallas_bf16"), interpret=interpret)
     w = weights.astype(jnp.float32)
     sse = jnp.sum(mind2 * w).astype(acc)
+    sse_pc = jax.ops.segment_sum(
+        mind2 * w, labels, num_segments=centroids_block.shape[0]).astype(acc)
     masked = jnp.where(w > 0, mind2, -jnp.inf)
     i = jnp.argmax(masked)
     far_d = jnp.where(jnp.any(w > 0), masked[i], -1.0).astype(acc)
     far_p = points[i].astype(acc)
     return StepStats(sums.astype(acc), counts.astype(acc), sse, far_d,
-                     far_p), labels
+                     far_p, sse_pc), labels
 
 
 def _local_stats(points, weights, centroids_block, *, chunk_size, mode,
@@ -151,9 +153,14 @@ def make_step_fn(mesh: Mesh, *, chunk_size: int,
             (off, jnp.int32(0)))
         counts_full = lax.dynamic_update_slice(
             jnp.zeros((k,), st.counts.dtype), st.counts, (off,))
+        sse_pc_full = lax.dynamic_update_slice(
+            jnp.zeros((k,), st.sse_per_cluster.dtype), st.sse_per_cluster,
+            (off,))
         axes = (DATA_AXIS, MODEL_AXIS)
         sums_full = lax.psum(sums_full, axes)
         counts_full = lax.psum(counts_full, axes)
+        # Ownership-masked per shard -> a plain psum, no double-count.
+        sse_pc_full = lax.psum(sse_pc_full, axes)
         # sse is identical on every model shard -> divide the double-count out.
         sse = lax.psum(st.sse, axes) / model_shards
         # Farthest point: gather the per-shard candidates, take the argmax —
@@ -161,12 +168,14 @@ def make_step_fn(mesh: Mesh, *, chunk_size: int,
         far_ds = lax.all_gather(st.farthest_dist, axes)        # (ndev,)
         far_ps = lax.all_gather(st.farthest_point, axes)       # (ndev, D)
         j = jnp.argmax(far_ds)
-        return StepStats(sums_full, counts_full, sse, far_ds[j], far_ps[j])
+        return StepStats(sums_full, counts_full, sse, far_ds[j], far_ps[j],
+                         sse_pc_full)
 
     mapped = jax.shard_map(
         step, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(MODEL_AXIS, None)),
-        out_specs=StepStats(P(None, None), P(None), P(), P(), P(None)),
+        out_specs=StepStats(P(None, None), P(None), P(), P(), P(None),
+                            P(None)),
         check_vma=False)
     return jax.jit(mapped)
 

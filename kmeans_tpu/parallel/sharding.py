@@ -123,6 +123,32 @@ class ShardedDataset:
             return np.asarray(self._host[idx])
         return np.asarray(self.points[np.asarray(idx)])
 
+    def with_weights(self, sample_weight: np.ndarray) -> "ShardedDataset":
+        """Same device-resident points, different per-point weights.
+
+        Only the small (n,) weight vector is re-placed — the (n, D) points
+        array is SHARED with this dataset, so masked subproblems (e.g.
+        ``BisectingKMeans`` fitting a 2-means on one cluster's members by
+        zero-weighting everyone else) cost one tiny upload instead of a full
+        re-shard.  ``sample_weight`` is absolute (it replaces, not scales,
+        the current weights); padding rows stay 0.
+        """
+        sw = np.asarray(sample_weight, dtype=self.dtype)
+        if sw.shape != (self.n,):
+            raise ValueError(
+                f"sample_weight must have shape ({self.n},), got {sw.shape}")
+        if np.any(sw < 0) or not np.all(np.isfinite(sw)):
+            raise ValueError("sample_weight must be finite and >= 0")
+        w_pad = np.zeros(self.points.shape[0], dtype=self.dtype)
+        w_pad[: self.n] = sw
+        if self.mesh is None:
+            w_dev = jnp.asarray(w_pad)
+        else:
+            w_dev = jax.device_put(
+                w_pad, NamedSharding(self.mesh, P(DATA_AXIS)))
+        return ShardedDataset(self.points, w_dev, self.n, self.chunk,
+                              self.mesh, host=self._host, host_weights=sw)
+
     def reshard(self, mesh: Optional[Mesh],
                 chunk: Optional[int] = None) -> "ShardedDataset":
         """Re-place the data on a different mesh / chunking — the

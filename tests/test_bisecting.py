@@ -1,0 +1,147 @@
+"""BisectingKMeans: divisive hierarchical clustering (beyond-reference model
+family; the reference implements flat K-Means only, kmeans_spark.py:19-352).
+
+Quality oracle is sklearn's BisectingKMeans — trajectories are not comparable
+(different inner seeding), so assertions are on clustering QUALITY (SSE
+within a small factor of sklearn's) and structural invariants, not on
+centroid parity.
+"""
+
+import numpy as np
+import pytest
+from sklearn.cluster import BisectingKMeans as SkBisecting
+from sklearn.datasets import make_blobs
+
+from kmeans_tpu import BisectingKMeans, KMeans
+
+
+@pytest.fixture()
+def blobs6():
+    X, y = make_blobs(n_samples=1200, centers=6, n_features=4,
+                      cluster_std=0.7, random_state=7)
+    return np.asarray(X, dtype=np.float64), y
+
+
+def _sse(X, centroids, labels):
+    return float(np.sum((X - centroids[labels]) ** 2))
+
+
+def test_finds_k_clusters_and_invariants(blobs6, mesh8):
+    X, _ = blobs6
+    model = BisectingKMeans(k=6, max_iter=50, compute_sse=True, seed=3,
+                            mesh=mesh8, verbose=False)
+    model.fit(X)
+    assert model.centroids.shape == (6, 4)
+    assert model.labels_.shape == (X.shape[0],)
+    assert set(np.unique(model.labels_)) == set(range(6))
+    assert model.iterations_run == 5            # k-1 splits
+    # Weighted sizes sum to n and match the hierarchical label histogram.
+    assert np.isclose(model.cluster_sizes_.sum(), X.shape[0])
+    hist = np.bincount(model.labels_, minlength=6)
+    np.testing.assert_allclose(model.cluster_sizes_, hist)
+    # Per-leaf SSE is consistent with the hierarchical labels/centroids.
+    total = _sse(X, model.centroids.astype(np.float64), model.labels_)
+    assert np.isclose(model.cluster_sse_.sum(), total, rtol=1e-5)
+
+
+def test_quality_vs_sklearn(blobs6, mesh8):
+    X, _ = blobs6
+    ours = BisectingKMeans(k=6, max_iter=50, seed=0, mesh=mesh8,
+                           verbose=False).fit(X)
+    sk = SkBisecting(n_clusters=6, random_state=0, n_init=1).fit(X)
+    ours_sse = _sse(X, ours.centroids.astype(np.float64),
+                    ours.predict(X))
+    assert ours_sse <= 1.1 * sk.inertia_ + 1e-9
+
+
+def test_sse_history_decreases_per_split(blobs6, mesh8):
+    X, _ = blobs6
+    model = BisectingKMeans(k=5, compute_sse=True, seed=1, mesh=mesh8,
+                            verbose=False).fit(X)
+    assert len(model.sse_history) == 4
+    # Each split can only reduce the total SSE (children fit their members
+    # at least as well as the parent centroid did).
+    diffs = np.diff(model.sse_history)
+    assert np.all(diffs <= 1e-6)
+
+
+def test_largest_cluster_strategy(blobs6, mesh8):
+    X, _ = blobs6
+    model = BisectingKMeans(k=4, bisecting_strategy="largest_cluster",
+                            seed=2, mesh=mesh8, verbose=False).fit(X)
+    assert model.centroids.shape == (4, 4)
+    assert set(np.unique(model.labels_)) == set(range(4))
+
+
+def test_sample_weight_masks_points(mesh8):
+    rng = np.random.default_rng(0)
+    X = np.concatenate([rng.normal(0, 0.1, (100, 2)),
+                        rng.normal(5, 0.1, (100, 2)),
+                        rng.normal((0, 9), 0.1, (50, 2))])
+    w = np.ones(250)
+    w[200:] = 0.0            # third blob carries no weight
+    model = BisectingKMeans(k=2, seed=0, mesh=mesh8, verbose=False,
+                            dtype=np.float64)
+    model.fit(X, sample_weight=w)
+    cents = model.centroids[np.argsort(model.centroids[:, 0])]
+    np.testing.assert_allclose(cents[0], [0, 0], atol=0.1)
+    np.testing.assert_allclose(cents[1], [5, 5], atol=0.1)
+
+
+def test_k1_is_weighted_mean(mesh8):
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(97, 3))
+    model = BisectingKMeans(k=1, compute_sse=True, mesh=mesh8,
+                            verbose=False, dtype=np.float64).fit(X)
+    np.testing.assert_allclose(model.centroids[0], X.mean(axis=0),
+                               atol=1e-8)
+    expect = float(np.sum((X - X.mean(axis=0)) ** 2))
+    assert np.isclose(model.sse_history[-1], expect, rtol=1e-6)
+
+
+def test_unsplittable_raises(mesh8):
+    X = np.zeros((8, 2))      # eight identical points: one distinct location
+    with pytest.raises(RuntimeError, match="Cannot bisect"):
+        BisectingKMeans(k=3, mesh=mesh8, verbose=False).fit(X)
+
+
+def test_resume_unsupported(blobs6, mesh8):
+    X, _ = blobs6
+    model = BisectingKMeans(k=3, mesh=mesh8, verbose=False).fit(X)
+    with pytest.raises(ValueError, match="resume"):
+        model.fit(X, resume=True)
+
+
+def test_checkpoint_roundtrip(tmp_path, blobs6, mesh8):
+    X, _ = blobs6
+    model = BisectingKMeans(k=4, seed=5, mesh=mesh8, verbose=False,
+                            bisecting_strategy="largest_cluster").fit(X)
+    path = tmp_path / "bisect.npz"
+    model.save(path)
+    loaded = BisectingKMeans.load(path)
+    assert isinstance(loaded, BisectingKMeans)
+    assert loaded.bisecting_strategy == "largest_cluster"
+    np.testing.assert_allclose(loaded.centroids, model.centroids)
+    labels = loaded.predict(X[:50])
+    np.testing.assert_array_equal(labels, model.predict(X[:50]))
+
+
+def test_per_cluster_sse_matches_oracle(mesh8):
+    """StepStats.sse_per_cluster (the fused field the split criterion uses)
+    against a NumPy oracle."""
+    from kmeans_tpu.ops.assign import assign_reduce
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(256, 5))
+    C = rng.normal(size=(7, 5))
+    d2 = ((X[:, None, :] - C[None, :, :]) ** 2).sum(-1)
+    lab = d2.argmin(1)
+    oracle = np.array([d2[lab == j, j].sum() for j in range(7)])
+
+    import jax.numpy as jnp
+    stats = assign_reduce(jnp.asarray(X), jnp.ones(256), jnp.asarray(C),
+                          chunk_size=64)
+    np.testing.assert_allclose(np.asarray(stats.sse_per_cluster), oracle,
+                               rtol=1e-6)
+    assert np.isclose(np.asarray(stats.sse_per_cluster).sum(),
+                      float(stats.sse), rtol=1e-6)

@@ -129,6 +129,29 @@ def test_kmeans_parallel_init_on_sharded_data(mesh8):
     assert len(np.unique(km.centroids.round(9), axis=0)) == 5
 
 
+def test_kmeans_parallel_host_array_smaller_than_cap():
+    # Regression: a plain (unpadded) host array with n < the top_k cap
+    # (always >= 256) must not crash the per-round candidate selection.
+    from kmeans_tpu.models.init import kmeans_parallel_init
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(100, 3))
+    centers = kmeans_parallel_init(X, 4, seed=0)
+    assert centers.shape == (4, 3)
+    assert np.all(np.isfinite(centers))
+
+
+def test_kmeans_parallel_first_draw_is_weight_proportional():
+    # With all the weight mass on one blob, the seeding must land there.
+    rng = np.random.default_rng(6)
+    X = np.concatenate([rng.normal(0, 0.1, (200, 2)),
+                        rng.normal(50, 0.1, (200, 2))])
+    w = np.concatenate([np.zeros(200), np.ones(200)])
+    km = KMeans(k=2, init="kmeans||", seed=2, dtype=np.float64,
+                verbose=False)
+    km.fit(X, sample_weight=w)
+    assert np.all(km.centroids[:, 0] > 40)
+
+
 def test_kmeans_parallel_tiny_data_backfills(mesh8):
     rng = np.random.default_rng(0)
     X = rng.normal(size=(12, 3))
