@@ -1,5 +1,6 @@
 """Dataset generation and loading for tests and benchmarks."""
 
 from kmeans_tpu.data.synthetic import make_blobs, make_uniform
+from kmeans_tpu.data.io import from_npy, from_raw
 
-__all__ = ["make_blobs", "make_uniform"]
+__all__ = ["make_blobs", "make_uniform", "from_npy", "from_raw"]

@@ -1,0 +1,137 @@
+"""Out-of-core dataset ingestion: shard-local reads from memory-mapped files.
+
+The reference's data-distribution story is driver-centric: the driver holds
+the full array and ``sc.parallelize`` ships partitions to executors
+(kmeans_spark.py:369/418/568).  That caps dataset size at driver RAM and
+pays a full host->cluster copy.  The TPU-native design inverts it: the file
+is memory-mapped, and **each device shard's rows are read (and padded)
+lazily inside ``jax.make_array_from_callback``** — the host never
+materializes more than one shard's slice at a time, and on multi-host
+meshes each host touches only the bytes its local devices own (the same
+pattern orbax/t5x use for checkpoint ingestion).
+
+Supports ``.npy`` (via ``np.load(mmap_mode='r')``) and raw binary with an
+explicit shape/dtype.  The returned ``ShardedDataset`` keeps the mmap as
+its host handle, so seeded row sampling (Forgy init, kmeans_spark.py:72;
+empty-cluster resampling, :196) reads only the k sampled rows from disk.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kmeans_tpu.parallel.mesh import DATA_AXIS, mesh_shape
+from kmeans_tpu.parallel.sharding import (ShardedDataset, choose_chunk_size,
+                                          to_device)
+
+
+def _sharded_from_source(read_rows, n: int, d: int, mesh: Mesh,
+                         chunk: int, dtype,
+                         sample_weight: Optional[np.ndarray],
+                         host_handle) -> ShardedDataset:
+    """Build a ShardedDataset whose shards pull rows via ``read_rows(lo, hi)``
+    — each callback materializes only its own slice."""
+    data_shards, _ = mesh_shape(mesh)
+    dtype = np.dtype(dtype)
+    n_pad = math.ceil(n / (data_shards * chunk)) * (data_shards * chunk)
+
+    sw = None
+    if sample_weight is not None:
+        sw = np.asarray(sample_weight, dtype=dtype)
+        if sw.shape != (n,):
+            raise ValueError(
+                f"sample_weight must have shape ({n},), got {sw.shape}")
+        if np.any(sw < 0) or not np.all(np.isfinite(sw)):
+            raise ValueError("sample_weight must be finite and >= 0")
+
+    x_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+    w_sharding = NamedSharding(mesh, P(DATA_AXIS))
+
+    def x_cb(index) -> np.ndarray:
+        rows = index[0]
+        lo, hi = rows.start or 0, rows.stop if rows.stop is not None else n_pad
+        real_hi = min(hi, n)
+        out = np.zeros((hi - lo, d), dtype=dtype)
+        if real_hi > lo:
+            out[: real_hi - lo] = read_rows(lo, real_hi)
+        return out
+
+    def w_cb(index) -> np.ndarray:
+        rows = index[0]
+        lo, hi = rows.start or 0, rows.stop if rows.stop is not None else n_pad
+        real_hi = min(hi, n)
+        out = np.zeros((hi - lo,), dtype=dtype)
+        if real_hi > lo:
+            out[: real_hi - lo] = (1.0 if sw is None
+                                   else sw[lo:real_hi])
+        return out
+
+    points = jax.make_array_from_callback((n_pad, d), x_sharding, x_cb)
+    weights = jax.make_array_from_callback((n_pad,), w_sharding, w_cb)
+    return ShardedDataset(points, weights, n, chunk, mesh,
+                          host=host_handle, host_weights=sw)
+
+
+def _resolve_chunk(n: int, d: int, k_hint: int, mesh: Mesh,
+                   chunk_size: Optional[int]) -> int:
+    data_shards, model_shards = mesh_shape(mesh)
+    return chunk_size or choose_chunk_size(
+        -(-n // data_shards), max(k_hint, model_shards), d)
+
+
+def from_npy(path, mesh: Mesh, *, chunk_size: Optional[int] = None,
+             dtype=np.float32, k_hint: int = 16,
+             sample_weight: Optional[np.ndarray] = None) -> ShardedDataset:
+    """Shard a 2-D ``.npy`` file onto the mesh without loading it whole.
+
+    ``k_hint`` feeds the automatic chunk-size choice (the (chunk, k)
+    distance tile is the working set); pass the k you plan to fit, or set
+    ``chunk_size`` explicitly.  With ``mesh=None`` this falls back to a
+    plain in-memory upload (single-device paths have no per-shard slicing
+    to exploit).
+    """
+    mm = np.load(path, mmap_mode="r")
+    if mm.ndim != 2:
+        raise ValueError(f"expected a 2-D array in {path}, got shape "
+                         f"{mm.shape}")
+    n, d = mm.shape
+    if mesh is None:
+        return to_device(np.asarray(mm, dtype=dtype), None,
+                         chunk_size or choose_chunk_size(n, k_hint, d),
+                         dtype, sample_weight=sample_weight)
+    chunk = _resolve_chunk(n, d, k_hint, mesh, chunk_size)
+
+    def read_rows(lo: int, hi: int) -> np.ndarray:
+        return np.asarray(mm[lo:hi], dtype=dtype)
+
+    return _sharded_from_source(read_rows, n, d, mesh, chunk, dtype,
+                                sample_weight, host_handle=mm)
+
+
+def from_raw(path, shape: Tuple[int, int], mesh: Mesh, *,
+             file_dtype=np.float32, chunk_size: Optional[int] = None,
+             dtype=np.float32, k_hint: int = 16,
+             offset: int = 0,
+             sample_weight: Optional[np.ndarray] = None) -> ShardedDataset:
+    """Shard a headerless binary file of ``shape`` row-major ``file_dtype``
+    values (e.g. exported feature matrices) onto the mesh, reading each
+    shard's byte range only."""
+    n, d = shape
+    mm = np.memmap(path, dtype=file_dtype, mode="r", offset=offset,
+                   shape=(n, d))
+    if mesh is None:
+        return to_device(np.asarray(mm, dtype=dtype), None,
+                         chunk_size or choose_chunk_size(n, k_hint, d),
+                         dtype, sample_weight=sample_weight)
+    chunk = _resolve_chunk(n, d, k_hint, mesh, chunk_size)
+
+    def read_rows(lo: int, hi: int) -> np.ndarray:
+        return np.asarray(mm[lo:hi], dtype=dtype)
+
+    return _sharded_from_source(read_rows, n, d, mesh, chunk, dtype,
+                                sample_weight, host_handle=mm)

@@ -29,7 +29,7 @@ from typing import Optional
 import jax
 import numpy as np
 
-from kmeans_tpu.models.kmeans import KMeans
+from kmeans_tpu.models.kmeans import KMeans, _get_step_fns
 from kmeans_tpu.utils.logging import IterationLogger
 
 _STRATEGIES = ("biggest_sse", "largest_cluster")
@@ -43,6 +43,11 @@ class BisectingKMeans(KMeans):
     bisecting_strategy : 'biggest_sse' (split the cluster with the largest
         within-cluster SSE — sklearn's ``biggest_inertia``) |
         'largest_cluster' (split the heaviest cluster).
+
+    ``empty_cluster`` is forwarded to the per-split 2-means fits
+    (default 'resample').  ``host_loop`` is accepted for signature
+    compatibility but has no effect: the split tree is inherently
+    host-driven, and each inner 2-means runs the per-iteration host loop.
 
     Attributes after ``fit``: ``centroids`` (k, D); ``labels_`` (n,) — the
     HIERARCHICAL memberships produced by the successive splits;
@@ -84,12 +89,7 @@ class BisectingKMeans(KMeans):
                              "(splits are not checkpointable mid-tree)")
         verbose = self.verbose and jax.process_index() == 0
         log = IterationLogger(verbose)
-        if sample_weight is not None:
-            from kmeans_tpu.parallel.sharding import ShardedDataset
-            if isinstance(X, ShardedDataset):
-                raise ValueError("pass sample_weight when caching the "
-                                 "dataset, not on a pre-built ShardedDataset")
-            X = self.cache(X, sample_weight=sample_weight)
+        X = self._apply_sample_weight(X, sample_weight)
         ds, mesh, model_shards, step_fn, predict_fn = self._prepare(X)
 
         n = ds.n
@@ -139,8 +139,9 @@ class BisectingKMeans(KMeans):
                 seed=int(np.random.SeedSequence(
                     [self.seed, split]).generate_state(1)[0] % (2 ** 31)),
                 compute_sse=False, init=self._inner_init(),
-                empty_cluster="resample", dtype=self.dtype, mesh=mesh,
-                chunk_size=ds.chunk, distance_mode=self.distance_mode,
+                empty_cluster=self.empty_cluster, dtype=self.dtype,
+                mesh=mesh, chunk_size=ds.chunk,
+                distance_mode=self.distance_mode,
                 host_loop=True, verbose=False)
             inner._validate_init = False     # X validated once above
             inner.fit(ds_t)
@@ -184,18 +185,25 @@ class BisectingKMeans(KMeans):
         k_out = len(cents)
         if k_out == 1:
             # k=1: the single "leaf" centroid is the weighted mean — one
-            # pass against a zero centroid yields exactly the global sums.
+            # pass against a zero centroid yields exactly the global sums;
+            # a second pass against the mean gives its SSE directly.  Both
+            # the variance identity sum(w|x|^2) - |s|^2/W and the matmul
+            # distance form cancel catastrophically in f32 for data offset
+            # from the origin, so the SSE pass uses the exact 'direct'
+            # distance mode (k=1 makes its (chunk, 1, D) tile trivial).
             zero = self._put_centroids(
                 np.zeros((1, ds.d), dtype=self.dtype), mesh, model_shards)
             stats = step_fn(ds.points, ds.weights, zero)
             s = np.asarray(stats.sums, np.float64)[0]
             c = float(np.asarray(stats.counts, np.float64)[0])
             cents[0] = (s / max(c, 1.0)).astype(self.dtype)
-            sse[0] = float(np.asarray(stats.sse_per_cluster, np.float64)[0]
-                           - np.dot(s, s) / max(c, 1.0))
+            mean = self._put_centroids(cents[0][None, :], mesh, model_shards)
+            step_exact, _ = _get_step_fns(mesh, ds.chunk, "direct")
+            stats = step_exact(ds.points, ds.weights, mean)
+            sse[0] = float(np.asarray(stats.sse_per_cluster, np.float64)[0])
             wsize[0] = c
             if self.compute_sse:
-                self.sse_history.append(max(sse[0], 0.0))
+                self.sse_history.append(sse[0])
 
         self.centroids = np.stack(
             [np.asarray(cents[i], dtype=self.dtype) for i in range(k_out)])

@@ -231,16 +231,21 @@ class KMeans:
         with profiling.trace(profile_dir):
             return self._fit(X, sample_weight=sample_weight, resume=resume)
 
+    def _apply_sample_weight(self, X, sample_weight):
+        """Fold an explicit (n,) sample_weight into a fresh cached dataset
+        (weights can only be attached at caching time)."""
+        if sample_weight is None:
+            return X
+        if isinstance(X, ShardedDataset):
+            raise ValueError("pass sample_weight when caching the "
+                             "dataset, not on a pre-built ShardedDataset")
+        return self.cache(X, sample_weight=sample_weight)
+
     def _fit(self, X, *, sample_weight, resume) -> "KMeans":
         # Multi-host: only process 0 narrates (every host computes the same
         # replicated statistics, so logs would be identical k-fold spam).
         log = IterationLogger(self.verbose and jax.process_index() == 0)
-        if sample_weight is not None:
-            if isinstance(X, ShardedDataset):
-                raise ValueError("pass sample_weight when caching the "
-                                 "dataset, not on a pre-built "
-                                 "ShardedDataset")
-            X = self.cache(X, sample_weight=sample_weight)
+        X = self._apply_sample_weight(X, sample_weight)
         ds, mesh, model_shards, step_fn, _ = self._prepare(X)
 
         start_iter = 0

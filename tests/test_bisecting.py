@@ -99,6 +99,28 @@ def test_k1_is_weighted_mean(mesh8):
     assert np.isclose(model.sse_history[-1], expect, rtol=1e-6)
 
 
+def test_k1_sse_no_cancellation_far_from_origin(mesh8):
+    # Regression: SSE for the k=1 leaf must be computed against the mean
+    # directly, not via the variance identity sum(w|x|^2) - |s|^2/W, which
+    # cancels catastrophically in float32 for offset data.
+    rng = np.random.default_rng(4)
+    X = rng.normal(loc=5000.0, size=(2048, 8)).astype(np.float32)
+    model = BisectingKMeans(k=1, compute_sse=True, mesh=mesh8,
+                            verbose=False).fit(X)
+    mu = X.astype(np.float64).mean(axis=0)
+    expect = float(np.sum((X.astype(np.float64) - mu) ** 2))
+    assert model.cluster_sse_[0] >= 0
+    assert np.isclose(model.sse_history[-1], expect, rtol=1e-3)
+
+
+def test_empty_cluster_forwarded_to_inner_fits(blobs6, mesh8):
+    X, _ = blobs6
+    model = BisectingKMeans(k=4, empty_cluster="farthest", seed=0,
+                            mesh=mesh8, verbose=False).fit(X)
+    assert model.centroids.shape == (4, 4)
+    assert np.all(np.isfinite(model.centroids))
+
+
 def test_unsplittable_raises(mesh8):
     X = np.zeros((8, 2))      # eight identical points: one distinct location
     with pytest.raises(RuntimeError, match="Cannot bisect"):

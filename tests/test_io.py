@@ -1,0 +1,90 @@
+"""Out-of-core ingestion (data.io): shard-local mmap reads -> ShardedDataset.
+
+The reference's data distribution is driver-resident ``sc.parallelize``
+(kmeans_spark.py:369/418/568) — bounded by driver RAM.  These tests verify
+the mmap-backed path produces bit-identical datasets and fits to the
+in-memory path.
+"""
+
+import numpy as np
+import pytest
+
+from kmeans_tpu import KMeans
+from kmeans_tpu.data.io import from_npy, from_raw
+
+
+@pytest.fixture()
+def npy_file(tmp_path):
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(1003, 7)).astype(np.float32)   # n % shards != 0
+    path = tmp_path / "points.npy"
+    np.save(path, X)
+    return path, X
+
+
+def test_from_npy_matches_in_memory(npy_file, mesh8):
+    path, X = npy_file
+    ds = from_npy(path, mesh8, dtype=np.float32, k_hint=5)
+    km_file = KMeans(k=5, seed=42, compute_sse=True, verbose=False,
+                     dtype=np.float32).fit(ds)
+    km_mem = KMeans(k=5, seed=42, compute_sse=True, verbose=False,
+                    dtype=np.float32, mesh=mesh8,
+                    chunk_size=ds.chunk).fit(X)
+    np.testing.assert_allclose(km_file.centroids, km_mem.centroids,
+                               rtol=1e-5)
+    assert km_file.iterations_run == km_mem.iterations_run
+    np.testing.assert_allclose(km_file.sse_history, km_mem.sse_history,
+                               rtol=1e-5)
+
+
+def test_from_npy_padding_is_inert(npy_file, mesh8):
+    path, X = npy_file
+    ds = from_npy(path, mesh8, k_hint=5)
+    assert ds.n == 1003
+    pts = np.asarray(ds.points)
+    w = np.asarray(ds.weights)
+    np.testing.assert_allclose(pts[:1003], X, rtol=0)
+    assert np.all(pts[1003:] == 0)
+    assert np.all(w[:1003] == 1.0) and np.all(w[1003:] == 0.0)
+
+
+def test_from_npy_sample_weight(npy_file, mesh8):
+    path, X = npy_file
+    sw = np.linspace(0.1, 2.0, 1003)
+    ds = from_npy(path, mesh8, k_hint=3, sample_weight=sw)
+    np.testing.assert_allclose(np.asarray(ds.weights)[:1003],
+                               sw.astype(np.float32), rtol=1e-6)
+    # Row sampling reads from the mmap handle.
+    rows = ds.take(np.array([0, 500, 1002]))
+    np.testing.assert_allclose(rows, X[[0, 500, 1002]], rtol=0)
+
+
+def test_from_npy_rejects_bad_shapes(tmp_path, mesh8):
+    path = tmp_path / "bad.npy"
+    np.save(path, np.zeros((4, 3, 2)))
+    with pytest.raises(ValueError, match="2-D"):
+        from_npy(path, mesh8)
+    sw_path = tmp_path / "ok.npy"
+    np.save(sw_path, np.zeros((10, 2)))
+    with pytest.raises(ValueError, match="sample_weight"):
+        from_npy(sw_path, mesh8, sample_weight=np.ones(7))
+
+
+def test_from_npy_no_mesh_fallback(npy_file):
+    path, X = npy_file
+    ds = from_npy(path, None, k_hint=5)
+    np.testing.assert_allclose(np.asarray(ds.points)[:1003], X, rtol=0)
+
+
+def test_from_raw_matches_npy(tmp_path, mesh8):
+    rng = np.random.default_rng(12)
+    X = rng.normal(size=(257, 4)).astype(np.float64)
+    raw = tmp_path / "points.bin"
+    X.tofile(raw)
+    ds = from_raw(raw, (257, 4), mesh8, file_dtype=np.float64,
+                  dtype=np.float32, k_hint=4)
+    np.testing.assert_allclose(np.asarray(ds.points)[:257],
+                               X.astype(np.float32), rtol=0)
+    km = KMeans(k=4, seed=0, verbose=False).fit(ds)
+    assert km.centroids.shape == (4, 4)
+    assert np.all(np.isfinite(km.centroids))
