@@ -18,10 +18,11 @@ kmeans++ init, checkpointing, profiling).
 from kmeans_tpu.models.kmeans import KMeans
 from kmeans_tpu.models.minibatch import MiniBatchKMeans
 from kmeans_tpu.models.bisecting import BisectingKMeans
+from kmeans_tpu.models.spherical import SphericalKMeans
 from kmeans_tpu.parallel.mesh import make_mesh
 from kmeans_tpu.parallel.sharding import ShardedDataset
 
 __version__ = "0.1.0"
 
-__all__ = ["KMeans", "MiniBatchKMeans", "BisectingKMeans", "ShardedDataset",
-           "make_mesh", "__version__"]
+__all__ = ["KMeans", "MiniBatchKMeans", "BisectingKMeans",
+           "SphericalKMeans", "ShardedDataset", "make_mesh", "__version__"]

@@ -9,7 +9,8 @@ variant.
 from kmeans_tpu.models.kmeans import KMeans
 from kmeans_tpu.models.minibatch import MiniBatchKMeans
 from kmeans_tpu.models.bisecting import BisectingKMeans
+from kmeans_tpu.models.spherical import SphericalKMeans
 from kmeans_tpu.models.init import forgy_init, kmeanspp_init
 
-__all__ = ["KMeans", "MiniBatchKMeans", "BisectingKMeans", "forgy_init",
-           "kmeanspp_init"]
+__all__ = ["KMeans", "MiniBatchKMeans", "BisectingKMeans",
+           "SphericalKMeans", "forgy_init", "kmeanspp_init"]

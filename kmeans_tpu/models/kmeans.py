@@ -256,6 +256,8 @@ class KMeans:
             # Forgy/k-means++/explicit init (kmeans_spark.py:58-82, :259).
             centroids = resolve_init(self.init, ds, self.k, self.seed,
                                      validate=self._validate_init)
+            centroids = self._postprocess_centroids(
+                np.asarray(centroids, dtype=np.float64)).astype(self.dtype)
             self.sse_history = []
             self.iterations_run = 0
             self.iter_times_ = []
@@ -281,6 +283,8 @@ class KMeans:
                 centroids.astype(np.float64))
             new_centroids = self._handle_empty(
                 new_centroids, nonempty, ds, stats, iteration, log)
+            new_centroids = self._postprocess_centroids(
+                new_centroids, prev=centroids.astype(np.float64))
             new_centroids = new_centroids.astype(self.dtype)
 
             if self.compute_sse:          # SSE vs starting centroids (:279)
@@ -368,6 +372,16 @@ class KMeans:
         if n_iters and shift_hist[-1] < self.tolerance:
             log.converged(self.iterations_run)
         return self
+
+    def _postprocess_centroids(self, centroids: np.ndarray,
+                               prev: Optional[np.ndarray] = None
+                               ) -> np.ndarray:
+        """Subclass hook applied to freshly-computed centroids (after init
+        and after each mean update + empty-cluster handling, before the
+        shift/convergence test).  ``prev`` is the previous iteration's
+        centroids (None at init).  SphericalKMeans projects onto the unit
+        sphere here; the base model is plain Lloyd's — identity."""
+        return centroids
 
     def _handle_empty(self, new_centroids: np.ndarray, nonempty: np.ndarray,
                       ds: ShardedDataset, stats: StepStats, iteration: int,

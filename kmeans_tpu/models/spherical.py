@@ -1,0 +1,106 @@
+"""Spherical K-Means: cosine-similarity clustering on a TPU mesh.
+
+A beyond-reference model family (the reference is Euclidean-only,
+kmeans_spark.py:153) aimed at embedding workloads — the GloVe-class configs
+in BASELINE.json cluster word vectors, where direction matters and magnitude
+is noise.
+
+TPU-first design: for unit vectors, squared Euclidean distance is
+``2 - 2*cos`` — a monotone transform of cosine similarity — so maximizing
+cosine similarity IS minimizing the Euclidean distance the existing fused
+MXU kernel already computes.  The whole model is therefore two projections
+around the unchanged SPMD step:
+
+* points are L2-normalized ONCE at caching time (rows with zero norm are
+  left at the origin: they have no direction, and are equidistant-by-cosine
+  from everything);
+* centroids are re-projected onto the unit sphere after every mean update
+  (the spherical Lloyd step: mean direction = normalized weighted sum),
+  via the ``_postprocess_centroids`` hook.
+
+No new kernel, no new collective, no second code path — the distance
+matmul, one-hot scatter-sum, psum, empty-cluster policies, checkpointing,
+and mesh sharding are all inherited.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kmeans_tpu.models.kmeans import KMeans
+
+
+def _normalize_rows(x: np.ndarray) -> np.ndarray:
+    norms = np.linalg.norm(x, axis=-1, keepdims=True)
+    return x / np.maximum(norms, np.finfo(np.float64).tiny)
+
+
+class SphericalKMeans(KMeans):
+    """K-Means on the unit sphere (cosine-similarity clustering).
+
+    Same constructor surface as :class:`KMeans`.  ``host_loop=False`` is
+    rejected: the sphere projection runs in the host loop's update hook
+    (the on-device ``lax.while_loop`` fit has no projection step).
+
+    Semantics:
+
+    * ``fit``/``predict``/``score`` L2-normalize their inputs, so callers
+      may pass raw (un-normalized) vectors.
+    * ``centroids`` are unit-norm mean directions.
+    * ``sse_history``/``inertia_``/``score`` are sums of ``2 - 2*cos`` —
+      the squared chordal distance on normalized data (monotone in total
+      cosine similarity).
+    * ``transform`` returns chordal distances to each centroid; cosine
+      similarity is ``1 - d**2 / 2``.
+    """
+
+    def __init__(self, k: int = 3, max_iter: int = 100,
+                 tolerance: float = 1e-4, seed: int = 42,
+                 compute_sse: bool = False, **kwargs):
+        if not kwargs.pop("host_loop", True):
+            raise ValueError("SphericalKMeans requires host_loop=True (the "
+                             "sphere projection runs in the host loop)")
+        super().__init__(k=k, max_iter=max_iter, tolerance=tolerance,
+                         seed=seed, compute_sse=compute_sse, **kwargs)
+
+    def cache(self, X, sample_weight=None):
+        """Upload L2-normalized rows (zero rows stay at the origin)."""
+        X = _normalize_rows(np.asarray(X, dtype=np.float64))
+        ds = super().cache(X.astype(self.dtype),
+                           sample_weight=sample_weight)
+        ds._unit_rows = True         # marks data as cosine-ready
+        return ds
+
+    def _dataset(self, X):
+        """Reject pre-built ShardedDatasets that did not go through this
+        model's normalizing ``cache`` — raw magnitudes would silently break
+        the cosine semantics (centroids sphere-projected, points not)."""
+        from kmeans_tpu.parallel.sharding import ShardedDataset
+        if isinstance(X, ShardedDataset) and \
+                not getattr(X, "_unit_rows", False):
+            raise ValueError(
+                "SphericalKMeans requires row-normalized data: cache it "
+                "with SphericalKMeans.cache(X) (or pass the raw array) "
+                "instead of a ShardedDataset built elsewhere")
+        return super()._dataset(X)
+
+    def _postprocess_centroids(self, centroids: np.ndarray,
+                               prev=None) -> np.ndarray:
+        """The spherical Lloyd step: mean direction = normalized mean.
+
+        A zero mean (perfectly cancelling members) has no direction; that
+        cluster keeps its previous centroid direction for this iteration
+        (an origin centroid would wrongly capture every point more than 60
+        degrees from all real centroids, since d^2 to the origin is 1 for
+        unit points).  At init (``prev=None``) rows are data points and
+        only an all-zero data row can be zero — it is left as-is.
+        """
+        norms = np.linalg.norm(centroids, axis=1, keepdims=True)
+        unit = _normalize_rows(centroids)
+        fallback = centroids if prev is None else prev
+        return np.where(norms > 0, unit, fallback)
+
+    def transform(self, X) -> np.ndarray:
+        """Chordal distances ``sqrt(2 - 2*cos)`` to each centroid, (n, k)."""
+        X = _normalize_rows(np.asarray(X, dtype=np.float64))
+        return super().transform(X.astype(self.dtype))

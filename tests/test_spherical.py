@@ -1,0 +1,122 @@
+"""SphericalKMeans: cosine-similarity clustering (beyond-reference model
+family; the reference is Euclidean-only, kmeans_spark.py:153).
+
+For unit vectors, chordal distance^2 = 2 - 2*cos, so the assertions check
+direction-based invariants: scale invariance of labels, unit-norm
+centroids, and recovery of known directional clusters.
+"""
+
+import numpy as np
+import pytest
+
+from kmeans_tpu import SphericalKMeans
+
+
+def _directional_data(seed=0, n_per=150):
+    """Three tight cones around orthogonal directions, random magnitudes."""
+    rng = np.random.default_rng(seed)
+    dirs = np.eye(3)
+    X, y = [], []
+    for j, d in enumerate(dirs):
+        v = d[None, :] + rng.normal(scale=0.05, size=(n_per, 3))
+        r = rng.uniform(0.1, 100.0, size=(n_per, 1))   # magnitude is noise
+        X.append(v * r)
+        y.append(np.full(n_per, j))
+    return np.concatenate(X), np.concatenate(y)
+
+
+def test_recovers_directional_clusters(mesh8):
+    X, y = _directional_data()
+    km = SphericalKMeans(k=3, seed=1, compute_sse=True, mesh=mesh8,
+                         verbose=False, dtype=np.float64).fit(X)
+    # Unit-norm centroids, one per axis direction.
+    np.testing.assert_allclose(np.linalg.norm(km.centroids, axis=1), 1.0,
+                               atol=1e-9)
+    axes = np.argmax(km.centroids, axis=1)
+    assert set(axes) == {0, 1, 2}
+    assert np.max(km.centroids) > 0.99
+    # Labels agree with the true cones up to permutation.
+    labels = km.predict(X)
+    for j in range(3):
+        vals = labels[y == j]
+        assert len(np.unique(vals)) == 1
+
+
+def test_scale_invariance(mesh8):
+    X, _ = _directional_data(seed=3)
+    rng = np.random.default_rng(4)
+    scales = rng.uniform(0.01, 1000.0, size=(X.shape[0], 1))
+    km = SphericalKMeans(k=3, seed=2, mesh=mesh8, verbose=False,
+                         dtype=np.float64)
+    km.fit(X)
+    np.testing.assert_array_equal(km.predict(X), km.predict(X * scales))
+
+
+def test_sse_is_chordal_and_monotone(mesh8):
+    X, _ = _directional_data(seed=5)
+    km = SphericalKMeans(k=3, seed=0, compute_sse=True, mesh=mesh8,
+                         verbose=False, dtype=np.float64).fit(X)
+    hist = np.asarray(km.sse_history)
+    assert np.all(np.diff(hist) <= 1e-6)
+    # SSE equals sum of 2 - 2*cos(x, nearest centroid).
+    Xn = X / np.linalg.norm(X, axis=1, keepdims=True)
+    cos = Xn @ km.centroids.T
+    expect = float(np.sum(2.0 - 2.0 * cos.max(axis=1)))
+    assert np.isclose(hist[-1], expect, rtol=1e-5)
+
+
+def test_transform_chordal_vs_cosine(mesh8):
+    X, _ = _directional_data(seed=6)
+    km = SphericalKMeans(k=3, seed=0, mesh=mesh8, verbose=False,
+                         dtype=np.float64).fit(X)
+    D = km.transform(X[:20])
+    Xn = X[:20] / np.linalg.norm(X[:20], axis=1, keepdims=True)
+    cos = Xn @ km.centroids.T
+    np.testing.assert_allclose(1.0 - D ** 2 / 2.0, cos, atol=1e-6)
+
+
+def test_zero_rows_tolerated(mesh8):
+    X, _ = _directional_data(seed=7)
+    X[10] = 0.0                   # no direction
+    km = SphericalKMeans(k=3, seed=0, mesh=mesh8, verbose=False,
+                         dtype=np.float64).fit(X)
+    assert np.all(np.isfinite(km.centroids))
+    labels = km.predict(X)
+    assert labels.shape == (X.shape[0],)
+
+
+def test_host_loop_false_rejected():
+    with pytest.raises(ValueError, match="host_loop"):
+        SphericalKMeans(k=3, host_loop=False)
+
+
+def test_foreign_sharded_dataset_rejected(mesh8):
+    from kmeans_tpu import KMeans
+    X, _ = _directional_data(seed=10)
+    foreign = KMeans(k=3, mesh=mesh8, dtype=np.float64).cache(X)
+    km = SphericalKMeans(k=3, mesh=mesh8, verbose=False, dtype=np.float64)
+    with pytest.raises(ValueError, match="row-normalized"):
+        km.fit(foreign)
+    own = km.cache(X)                  # normalizing cache is accepted
+    km.fit(own)
+    assert np.all(np.isfinite(km.centroids))
+
+
+def test_zero_mean_keeps_previous_direction(mesh8):
+    km = SphericalKMeans(k=2, mesh=mesh8, verbose=False, dtype=np.float64)
+    new = np.array([[0.0, 0.0], [3.0, 4.0]])
+    prev = np.array([[0.0, 1.0], [1.0, 0.0]])
+    out = km._postprocess_centroids(new, prev=prev)
+    np.testing.assert_allclose(out[0], [0.0, 1.0])   # kept old direction
+    np.testing.assert_allclose(out[1], [0.6, 0.8])   # normalized mean
+
+
+def test_checkpoint_roundtrip(tmp_path, mesh8):
+    X, _ = _directional_data(seed=8)
+    km = SphericalKMeans(k=3, seed=9, mesh=mesh8, verbose=False,
+                         dtype=np.float64).fit(X)
+    km.save(tmp_path / "sph.npz")
+    loaded = SphericalKMeans.load(tmp_path / "sph.npz")
+    assert isinstance(loaded, SphericalKMeans)
+    np.testing.assert_allclose(loaded.centroids, km.centroids)
+    np.testing.assert_array_equal(loaded.predict(X[:10]), km.predict(X[:10]))
