@@ -44,8 +44,9 @@ class BisectingKMeans(KMeans):
         within-cluster SSE — sklearn's ``biggest_inertia``) |
         'largest_cluster' (split the heaviest cluster).
 
-    ``empty_cluster`` is forwarded to the per-split 2-means fits
-    (default 'resample').  ``host_loop`` is accepted for signature
+    ``empty_cluster`` and ``n_init`` are forwarded to the per-split 2-means
+    fits (sklearn's ``BisectingKMeans`` applies ``n_init`` per bisection the
+    same way; default 'resample' / 1).  ``host_loop`` is accepted for signature
     compatibility but has no effect: the split tree is inherently
     host-driven, and each inner 2-means runs the per-iteration host loop.
 
@@ -139,6 +140,7 @@ class BisectingKMeans(KMeans):
                 seed=int(np.random.SeedSequence(
                     [self.seed, split]).generate_state(1)[0] % (2 ** 31)),
                 compute_sse=False, init=self._inner_init(),
+                n_init=self.n_init,
                 empty_cluster=self.empty_cluster, dtype=self.dtype,
                 mesh=mesh, chunk_size=ds.chunk,
                 distance_mode=self.distance_mode,

@@ -93,6 +93,15 @@ class KMeans:
     TPU-native extensions:
 
     init : 'forgy' (reference parity) | 'k-means++' | callable | (k,D) array.
+    n_init : number of independent restarts (sklearn-style; the reference
+        draws once).  Restart 0 uses ``seed`` exactly (so n_init=1 is
+        bit-identical to the reference trajectory); further restarts use
+        seeds derived via ``np.random.SeedSequence(seed)``.  The winner is
+        the restart whose FINAL centroids score the lowest inertia
+        (one extra fused pass per restart).  With ``host_loop=False`` and an
+        unsharded centroid table, all restarts run BATCHED in one dispatch —
+        the restart axis is vmapped straight onto the MXU
+        (parallel.distributed.make_multi_fit_fn).
     empty_cluster : 'resample' (reference live path, made deterministic) |
         'farthest' (reference's dead policy, made live) | 'keep'.
     dtype : compute dtype (default float32; float64 needs jax x64).
@@ -108,6 +117,7 @@ class KMeans:
                  tolerance: float = 1e-4, seed: int = 42,
                  compute_sse: bool = False, *,
                  init: Union[str, np.ndarray, callable] = "forgy",
+                 n_init: int = 1,
                  empty_cluster: str = "resample",
                  dtype=None,
                  mesh: Optional[Mesh] = None,
@@ -122,6 +132,9 @@ class KMeans:
         self.seed = seed
         self.compute_sse = compute_sse
         self.init = init
+        if int(n_init) < 1:
+            raise ValueError(f"n_init must be >= 1, got {n_init}")
+        self.n_init = int(n_init)
         if empty_cluster not in _EMPTY_POLICIES:
             raise ValueError(f"empty_cluster must be one of {_EMPTY_POLICIES},"
                              f" got {empty_cluster!r}")
@@ -241,29 +254,89 @@ class KMeans:
                              "dataset, not on a pre-built ShardedDataset")
         return self.cache(X, sample_weight=sample_weight)
 
+    def _restart_seeds(self) -> list:
+        """Per-restart init seeds.  Restart 0 is ``seed`` itself (n_init=1
+        stays bit-identical to the reference trajectory); the rest are
+        SeedSequence-derived.  An explicit (k, D) init array makes every
+        restart identical, so it collapses to one (sklearn does the same)."""
+        if not isinstance(self.init, str) and not callable(self.init):
+            return [self.seed]
+        extra = np.random.SeedSequence(self.seed).generate_state(
+            self.n_init - 1) if self.n_init > 1 else []
+        return [self.seed] + [int(s) for s in extra]
+
+    def _init_centroids(self, ds, seed: int) -> np.ndarray:
+        # Forgy/k-means++/explicit init (kmeans_spark.py:58-82, :259).
+        centroids = resolve_init(self.init, ds, self.k, seed,
+                                 validate=self._validate_init)
+        return self._postprocess_centroids(
+            np.asarray(centroids, dtype=np.float64)).astype(self.dtype)
+
+    def _final_inertia(self, ds, mesh, model_shards, step_fn) -> float:
+        """True SSE of the CURRENT centroids — one fused pass (sklearn's
+        restart-selection rule; ``sse_history[-1]`` lags one iteration by
+        reference semantics, kmeans_spark.py:279)."""
+        stats = step_fn(ds.points, ds.weights, self._put_centroids(
+            np.asarray(self.centroids), mesh, model_shards))
+        return float(stats.sse)
+
     def _fit(self, X, *, sample_weight, resume) -> "KMeans":
         # Multi-host: only process 0 narrates (every host computes the same
         # replicated statistics, so logs would be identical k-fold spam).
         log = IterationLogger(self.verbose and jax.process_index() == 0)
         X = self._apply_sample_weight(X, sample_weight)
         ds, mesh, model_shards, step_fn, _ = self._prepare(X)
+        log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
+        self.best_restart_ = 0
+        self.restart_inertias_ = None
 
-        start_iter = 0
         if resume and self.centroids is not None:
             centroids = np.asarray(self.centroids, dtype=self.dtype)
-            start_iter = self.iterations_run
-        else:
-            # Forgy/k-means++/explicit init (kmeans_spark.py:58-82, :259).
-            centroids = resolve_init(self.init, ds, self.k, self.seed,
-                                     validate=self._validate_init)
-            centroids = self._postprocess_centroids(
-                np.asarray(centroids, dtype=np.float64)).astype(self.dtype)
+            return self._run_restart(ds, mesh, model_shards, step_fn,
+                                     centroids, self.iterations_run,
+                                     self.seed, log)
+
+        seeds = self._restart_seeds()
+
+        # Batched restarts: one dispatch for the whole n_init sweep.
+        if len(seeds) > 1 and not self.host_loop and model_shards == 1 \
+                and self.empty_cluster in ("keep", "farthest"):
+            return self._fit_on_device_multi(ds, seeds, mesh, log)
+
+        best = None
+        inertias = []
+        for r, seed in enumerate(seeds):
+            centroids = self._init_centroids(ds, seed)
             self.sse_history = []
             self.iterations_run = 0
             self.iter_times_ = []
+            self._run_restart(ds, mesh, model_shards, step_fn, centroids,
+                              0, seed, log)
+            if len(seeds) == 1:
+                return self
+            inertia = self._final_inertia(ds, mesh, model_shards, step_fn)
+            log.restart(r, len(seeds), inertia)
+            inertias.append(inertia)
+            if best is None or inertia < best["inertia"]:
+                best = {"inertia": inertia, "restart": r,
+                        "centroids": self.centroids,
+                        "sse_history": self.sse_history,
+                        "iterations_run": self.iterations_run,
+                        "cluster_sizes_": self.cluster_sizes_,
+                        "iter_times_": self.iter_times_}
+        self.centroids = best["centroids"]
+        self.sse_history = best["sse_history"]
+        self.iterations_run = best["iterations_run"]
+        self.cluster_sizes_ = best["cluster_sizes_"]
+        self.iter_times_ = best["iter_times_"]
+        self.best_restart_ = best["restart"]
+        self.restart_inertias_ = np.asarray(inertias, dtype=np.float64)
+        return self
 
-        log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
-
+    def _run_restart(self, ds, mesh, model_shards, step_fn, centroids,
+                     start_iter, seed, log) -> "KMeans":
+        """One restart: the reference's full fit loop (kmeans_spark.py:
+        239-319), host- or device-side per ``host_loop``."""
         if not self.host_loop:
             return self._fit_on_device(ds, centroids, start_iter, mesh,
                                        model_shards, log)
@@ -282,7 +355,8 @@ class KMeans:
                 sums / np.maximum(counts, 1.0)[:, None],
                 centroids.astype(np.float64))
             new_centroids = self._handle_empty(
-                new_centroids, nonempty, ds, stats, iteration, log)
+                new_centroids, nonempty, ds, stats, iteration, log,
+                seed=seed)
             new_centroids = self._postprocess_centroids(
                 new_centroids, prev=centroids.astype(np.float64))
             new_centroids = new_centroids.astype(self.dtype)
@@ -342,8 +416,17 @@ class KMeans:
         fit_start = time.perf_counter()
         cents, n_iters, sse_hist, shift_hist, counts = fit_fn(
             ds.points, ds.weights, cents_dev)
-        n_iters = int(n_iters)
-        elapsed = time.perf_counter() - fit_start
+        self._finish_device_fit(cents, int(n_iters), start_iter, sse_hist,
+                                shift_hist, counts,
+                                time.perf_counter() - fit_start, log)
+        return self
+
+    def _finish_device_fit(self, cents, n_iters: int, start_iter: int,
+                           sse_hist, shift_hist, counts, elapsed: float,
+                           log: IterationLogger) -> None:
+        """Shared postlude of the one-dispatch fit paths: ingest the
+        device-side histories, run the reference's guards/logging
+        (kmeans_spark.py:283-313) on the host."""
         # One dispatch for the whole fit: only the mean per-iteration wall
         # time is observable from the host.
         self.iter_times_.extend([elapsed / max(n_iters, 1)] * n_iters)
@@ -371,6 +454,38 @@ class KMeans:
                       (self.compute_sse and self.sse_history) else None)
         if n_iters and shift_hist[-1] < self.tolerance:
             log.converged(self.iterations_run)
+
+    def _fit_on_device_multi(self, ds, seeds, mesh, log) -> "KMeans":
+        """All ``n_init`` restarts in ONE dispatch: the restart axis is
+        vmapped through the whole training loop on device
+        (parallel.distributed.make_multi_fit_fn) and the winner — lowest
+        true final inertia — is selected on device too."""
+        R = len(seeds)
+        key = (mesh, ds.chunk, self.distance_mode, self.k, self.max_iter,
+               float(self.tolerance), self.empty_cluster, R, "multifit")
+        if key not in _STEP_CACHE:
+            _STEP_CACHE[key] = dist.make_multi_fit_fn(
+                mesh, chunk_size=ds.chunk, mode=self.distance_mode,
+                k_real=self.k, max_iter=self.max_iter,
+                tolerance=float(self.tolerance),
+                empty_policy=self.empty_cluster, n_init=R)
+        fit_fn = _STEP_CACHE[key]
+        inits = np.stack([self._init_centroids(ds, s) for s in seeds])
+        cents_dev = jax.device_put(
+            inits, NamedSharding(mesh, P(None, None, None)))
+        self.sse_history = []
+        self.iterations_run = 0
+        self.iter_times_ = []
+        fit_start = time.perf_counter()
+        cents, n_iters, sse_hist, shift_hist, counts, best, finals = fit_fn(
+            ds.points, ds.weights, cents_dev)
+        self.best_restart_ = int(best)
+        self.restart_inertias_ = np.asarray(finals, dtype=np.float64)
+        self._finish_device_fit(cents, int(n_iters), 0, sse_hist, shift_hist,
+                                counts, time.perf_counter() - fit_start, log)
+        log.restart(self.best_restart_, R,
+                    float(self.restart_inertias_[self.best_restart_]),
+                    winner=True)
         return self
 
     def _postprocess_centroids(self, centroids: np.ndarray,
@@ -385,8 +500,13 @@ class KMeans:
 
     def _handle_empty(self, new_centroids: np.ndarray, nonempty: np.ndarray,
                       ds: ShardedDataset, stats: StepStats, iteration: int,
-                      log: IterationLogger) -> np.ndarray:
-        """Empty-cluster recovery (kmeans_spark.py:190-204 / :84-129)."""
+                      log: IterationLogger, *,
+                      seed: Optional[int] = None) -> np.ndarray:
+        """Empty-cluster recovery (kmeans_spark.py:190-204 / :84-129).
+        ``seed`` is the active restart's seed (defaults to ``self.seed``) so
+        restarts resample independently."""
+        if seed is None:
+            seed = self.seed
         empty_ids = np.flatnonzero(~nonempty)
         if empty_ids.size == 0:
             return new_centroids
@@ -406,7 +526,7 @@ class KMeans:
             # policy (:191-204) minus its time.time() seed (:195-196).
             # Only positive-weight rows are candidates: a zero-weight
             # replacement would leave the cluster empty forever.
-            rng = np.random.default_rng([self.seed, iteration + 1])
+            rng = np.random.default_rng([seed, iteration + 1])
             candidates = ds.positive_rows()
             take = min(len(filled), len(candidates))
             idx = candidates[rng.choice(len(candidates), size=take,
@@ -436,6 +556,9 @@ class KMeans:
 
     def fit_predict(self, X) -> np.ndarray:
         return self.fit(X).predict(X)
+
+    def fit_transform(self, X) -> np.ndarray:
+        return self.fit(X).transform(X)
 
     def transform(self, X) -> np.ndarray:
         """Euclidean distances to each centroid, (n, k) — sklearn-style."""
@@ -484,6 +607,7 @@ class KMeans:
             "k": self.k, "max_iter": self.max_iter,
             "tolerance": self.tolerance, "seed": self.seed,
             "compute_sse": self.compute_sse,
+            "n_init": self.n_init,
             "empty_cluster": self.empty_cluster,
             "distance_mode": self.distance_mode,
             "model_shards": self.model_shards,
@@ -517,6 +641,7 @@ class KMeans:
         model = cls(k=state["k"], max_iter=state["max_iter"],
                     tolerance=state["tolerance"], seed=state["seed"],
                     compute_sse=state["compute_sse"], init=init,
+                    n_init=int(state.get("n_init", 1)),
                     empty_cluster=state["empty_cluster"],
                     distance_mode=state["distance_mode"],
                     model_shards=state["model_shards"],

@@ -22,6 +22,10 @@ class MiniBatchKMeans(KMeans):
                  compute_sse: bool = False, *, batch_size: int = 4096,
                  **kwargs):
         super().__init__(k, max_iter, tolerance, seed, compute_sse, **kwargs)
+        if self.n_init != 1:
+            raise ValueError("MiniBatchKMeans does not support n_init > 1; "
+                             "run restarts explicitly and keep the best "
+                             "inertia")
         if batch_size <= 0:
             raise ValueError(f"batch_size must be positive, got {batch_size}")
         self.batch_size = batch_size

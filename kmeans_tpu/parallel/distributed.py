@@ -283,6 +283,121 @@ def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
     return jax.jit(mapped)
 
 
+def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
+                      k_real: int, max_iter: int, tolerance: float,
+                      empty_policy: str = "keep", n_init: int):
+    """Build a BATCHED on-device training loop: ``n_init`` independent
+    restarts run in ONE dispatch, vmapped over the restart axis.
+
+    This is the TPU-native answer to sklearn's ``n_init`` (the reference has
+    no restarts at all — one Forgy draw, kmeans_spark.py:58-82): instead of
+    R sequential fits, the restart axis becomes a batch dimension of every
+    kernel — the (chunk, k) distance matmul turns into (R, chunk, k), which
+    *raises* MXU utilization for small k, and the whole sweep still costs a
+    single dispatch.  Restarts converge independently: a converged restart is
+    frozen (its centroids stop moving, its stats stop being recorded) while
+    the ``lax.while_loop`` keeps running until every restart is done or
+    ``max_iter`` is hit.
+
+    Selection: after the loop, ONE extra vmapped pass scores every restart's
+    FINAL centroids (true final inertia — sklearn's selection rule; the
+    in-loop SSE history lags one iteration by reference semantics,
+    kmeans_spark.py:279) and the argmin restart wins.
+
+    Restrictions: ``model`` axis must be size 1 (restarts and centroid-table
+    sharding both multiply the k axis; compose them later if a k-sharded
+    multi-restart config ever matters), and ``empty_policy`` must be
+    device-expressible ('keep' / 'farthest') like ``make_fit_fn``.
+
+    Returns ``fit(points, weights, centroids0[R,k,D]) -> (best_centroids,
+    n_iters_best, sse_hist_best, shift_hist_best, counts_best, best_idx,
+    final_inertias[R])`` with everything replicated.
+    """
+    if empty_policy not in ("keep", "farthest"):
+        raise ValueError(
+            f"on-device loop supports empty_cluster 'keep' or 'farthest', "
+            f"got {empty_policy!r} (use the host loop for 'resample')")
+    data_shards, model_shards = mesh_shape(mesh)
+    if model_shards > 1:
+        raise ValueError("multi-restart device loop requires model axis of "
+                         "size 1 (got {}); restarts are run sequentially "
+                         "under centroid sharding".format(model_shards))
+
+    def fit(points, weights, cents0):
+        # cents0: (R, k, d), replicated on every shard.
+        acc = _accum_dtype(points.dtype)
+        R, k, d = cents0.shape
+
+        def local(c):
+            return _local_stats(points, weights, c, chunk_size=chunk_size,
+                                mode=mode, model_shards=1)
+
+        def all_stats(cents):
+            """Global per-restart stats: vmap the shard-local pass over R
+            (no collectives inside the vmap), then psum the stacked
+            accumulators over the data axis."""
+            st = jax.vmap(local)(cents)
+            sums = lax.psum(st.sums, DATA_AXIS)            # (R, k, d)
+            counts = lax.psum(st.counts, DATA_AXIS)        # (R, k)
+            sse = lax.psum(st.sse, DATA_AXIS)              # (R,)
+            far_ds = lax.all_gather(st.farthest_dist, DATA_AXIS)   # (s, R)
+            far_ps = lax.all_gather(st.farthest_point, DATA_AXIS)  # (s, R, d)
+            owner = jnp.argmax(far_ds, axis=0)             # (R,)
+            far_p = jnp.take_along_axis(
+                far_ps, owner[None, :, None], axis=0)[0]   # (R, d)
+            return sums, counts, sse, far_p
+
+        def body(state):
+            i, cents, done, n_iters, sse_hist, shift_hist, counts_out = state
+            sums, counts, sse, far_p = all_stats(cents)
+            mean = sums / jnp.maximum(counts, 1.0)[..., None]
+            new = jnp.where((counts > 0)[..., None], mean.astype(acc), cents)
+            if empty_policy == "farthest":
+                def refill(new_r, far_r, counts_r):
+                    is_empty = counts_r <= 0
+                    fe = jnp.argmax(is_empty)
+                    val = jnp.where(jnp.any(is_empty), far_r.astype(acc),
+                                    new_r[fe])
+                    return new_r.at[fe].set(val)
+                new = jax.vmap(refill)(new, far_p, counts)
+            shifts = jnp.sqrt(jnp.sum((new - cents) ** 2, axis=2))
+            max_shift = jnp.max(shifts, axis=1)            # (R,)
+            # Frozen restarts keep their centroids and recorded stats.
+            new = jnp.where(done[:, None, None], cents, new)
+            sse_hist = sse_hist.at[:, i].set(jnp.where(done, 0.0, sse))
+            shift_hist = shift_hist.at[:, i].set(
+                jnp.where(done, 0.0, max_shift))
+            counts_out = jnp.where(done[:, None], counts_out, counts)
+            n_iters = jnp.where(done, n_iters, i + 1)
+            done = done | (max_shift < tolerance)
+            return i + 1, new, done, n_iters, sse_hist, shift_hist, counts_out
+
+        def cond(state):
+            i, _, done, *_ = state
+            return (i < max_iter) & ~jnp.all(done)
+
+        state = (jnp.int32(0), cents0.astype(acc),
+                 jnp.zeros((R,), bool), jnp.zeros((R,), jnp.int32),
+                 jnp.zeros((R, max_iter), acc), jnp.zeros((R, max_iter), acc),
+                 jnp.zeros((R, k), acc))
+        _, cents, _, n_iters, sse_hist, shift_hist, counts_out = \
+            lax.while_loop(cond, body, state)
+
+        # Selection pass: true final inertia of each restart's centroids.
+        _, _, final_sse, _ = all_stats(cents)
+        best = jnp.argmin(final_sse)
+        return (cents[best, :k_real], n_iters[best], sse_hist[best],
+                shift_hist[best], counts_out[best, :k_real], best, final_sse)
+
+    mapped = jax.shard_map(
+        fit, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None, None, None)),
+        out_specs=(P(None, None), P(), P(None), P(None), P(None), P(),
+                   P(None)),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
 def make_predict_fn(mesh: Mesh, *, chunk_size: int,
                     mode: str = "matmul") -> Callable:
     """Build the jitted SPMD label assignment: (points, centroids) -> labels.

@@ -55,6 +55,12 @@ class IterationLogger:
     def converged(self, iterations: int) -> None:
         self._emit(f"Converged after {iterations} iterations")
 
+    def restart(self, restart: int, total: int, inertia: float,
+                winner: bool = False) -> None:
+        tag = "best of" if winner else "of"
+        self._emit(f"Restart {restart + 1} {tag} {total}: "
+                   f"final inertia = {inertia:.4f}")
+
     def warn_empty(self, n_empty: int) -> None:
         self._emit(f"  WARNING: {n_empty} empty cluster(s) detected. "
                    "Reinitializing...")

@@ -1,0 +1,141 @@
+"""Multi-restart (``n_init``) semantics.
+
+A beyond-reference capability (the reference draws Forgy once,
+kmeans_spark.py:58-82): n_init independent restarts, winner = lowest TRUE
+final inertia.  Two execution paths must agree: sequential restarts in the
+host loop, and the batched one-dispatch device sweep
+(parallel.distributed.make_multi_fit_fn, vmapped over the restart axis).
+"""
+
+import numpy as np
+import pytest
+
+from kmeans_tpu import KMeans, MiniBatchKMeans
+
+
+def blobs(n_per=100, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = np.array([[0.0, 0.0], [10.0, 10.0], [20.0, 0.0]])
+    X = np.concatenate([c + 0.3 * rng.normal(size=(n_per, 2))
+                        for c in centers])
+    return X.astype(np.float32)
+
+
+def final_inertia(km, X):
+    return -km.score(X)
+
+
+def test_n_init_picks_lowest_inertia():
+    X = blobs()
+    km = KMeans(k=3, max_iter=50, seed=7, n_init=6, verbose=False)
+    km.fit(X)
+    assert km.restart_inertias_.shape == (6,)
+    assert km.best_restart_ == int(np.argmin(km.restart_inertias_))
+    got = final_inertia(km, X)
+    assert got == pytest.approx(km.restart_inertias_.min(), rel=1e-5)
+    # The sweep can never be worse than the single reference draw.
+    single = KMeans(k=3, max_iter=50, seed=7, verbose=False).fit(X)
+    assert got <= final_inertia(single, X) + 1e-6
+
+
+def test_n_init_deterministic():
+    X = blobs()
+    a = KMeans(k=3, max_iter=30, seed=3, n_init=4, verbose=False).fit(X)
+    b = KMeans(k=3, max_iter=30, seed=3, n_init=4, verbose=False).fit(X)
+    np.testing.assert_array_equal(a.centroids, b.centroids)
+    assert a.best_restart_ == b.best_restart_
+    np.testing.assert_array_equal(a.restart_inertias_, b.restart_inertias_)
+
+
+def test_device_multi_matches_host_multi():
+    X = blobs()
+    kw = dict(k=3, max_iter=50, seed=11, n_init=5, empty_cluster="keep",
+              verbose=False)
+    host = KMeans(host_loop=True, **kw).fit(X)
+    dev = KMeans(host_loop=False, **kw).fit(X)
+    assert dev.best_restart_ == host.best_restart_
+    np.testing.assert_allclose(
+        np.sort(dev.restart_inertias_), np.sort(host.restart_inertias_),
+        rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(dev.centroids)[np.lexsort(dev.centroids.T)],
+        np.asarray(host.centroids)[np.lexsort(host.centroids.T)],
+        atol=1e-3)
+
+
+def test_device_multi_farthest_policy():
+    # 3 tight blobs, k=6 forces empties (reference T4 shape,
+    # kmeans_spark.py:513-524); the batched loop must stay finite.
+    X = blobs()
+    km = KMeans(k=6, max_iter=30, seed=5, n_init=3,
+                empty_cluster="farthest", host_loop=False,
+                verbose=False).fit(X)
+    assert np.all(np.isfinite(km.centroids))
+    assert km.restart_inertias_.shape == (3,)
+
+
+def test_restart0_matches_single_seed():
+    # Restart 0 uses `seed` itself, so a single-restart fit with the same
+    # seed lands on the same final inertia as restart 0 of the sweep.
+    X = blobs()
+    multi = KMeans(k=3, max_iter=50, seed=13, n_init=4, verbose=False).fit(X)
+    single = KMeans(k=3, max_iter=50, seed=13, verbose=False).fit(X)
+    assert multi.restart_inertias_[0] == pytest.approx(
+        final_inertia(single, X), rel=1e-5)
+
+
+def test_explicit_init_collapses_to_one_restart():
+    X = blobs()
+    init = X[[0, 100, 200]]
+    km = KMeans(k=3, max_iter=30, n_init=5, init=init, verbose=False).fit(X)
+    assert km.restart_inertias_ is None        # single effective restart
+    assert km.best_restart_ == 0
+
+
+def test_n_init_with_sse_history():
+    X = blobs()
+    km = KMeans(k=3, max_iter=50, seed=7, n_init=3, compute_sse=True,
+                verbose=False).fit(X)
+    # History belongs to the winning restart and is monotone.
+    assert len(km.sse_history) == km.iterations_run
+    diffs = np.diff(km.sse_history)
+    assert np.all(diffs <= 1e-6)
+
+
+def test_invalid_n_init_raises():
+    with pytest.raises(ValueError, match="n_init"):
+        KMeans(k=3, n_init=0)
+
+
+def test_minibatch_rejects_multi_restart():
+    with pytest.raises(ValueError, match="n_init"):
+        MiniBatchKMeans(k=3, n_init=2)
+
+
+def test_bisecting_forwards_n_init():
+    # n_init applies per bisection (sklearn semantics): the multi-restart
+    # tree can never end up with higher total SSE than the single-draw one.
+    from kmeans_tpu import BisectingKMeans
+    X = blobs()
+    kw = dict(k=4, max_iter=30, seed=2, compute_sse=True, verbose=False)
+    single = BisectingKMeans(n_init=1, **kw).fit(X)
+    multi = BisectingKMeans(n_init=4, **kw).fit(X)
+    assert multi.sse_history[-1] <= single.sse_history[-1] + 1e-6
+    assert np.all(np.isfinite(multi.centroids))
+
+
+def test_fit_transform():
+    X = blobs()
+    km = KMeans(k=3, max_iter=30, verbose=False)
+    D = km.fit_transform(X)
+    assert D.shape == (X.shape[0], 3)
+    np.testing.assert_allclose(D, km.transform(X), atol=1e-6)
+
+
+def test_checkpoint_roundtrips_n_init(tmp_path):
+    X = blobs()
+    km = KMeans(k=3, max_iter=20, seed=1, n_init=3, verbose=False).fit(X)
+    km.save(tmp_path / "m.npz")
+    loaded = KMeans.load(tmp_path / "m.npz")
+    assert loaded.n_init == 3
+    np.testing.assert_array_equal(loaded.centroids, km.centroids)
