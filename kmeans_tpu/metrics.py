@@ -1,0 +1,197 @@
+"""Clustering quality metrics, chunked for TPU.
+
+Beyond-reference capability (the reference's only quality metric is total
+SSE, ``_compute_sse``, kmeans_spark.py:208-237): the standard internal
+cluster-validity scores, designed the same way as the training step —
+fixed-size chunks under ``lax.scan``, distances in the matmul form so the
+O(n²D) / O(nkD) work lands on the MXU, per-cluster reductions as one-hot
+matmuls instead of segment gathers.
+
+All functions take host arrays, run jitted on the default backend, and are
+validated against scikit-learn's implementations in
+``tests/test_metrics.py`` (sklearn stays a test-only oracle, the
+reference's own policy — README.md:13).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from kmeans_tpu.ops.assign import pairwise_sq_dists
+
+__all__ = ["silhouette_score", "silhouette_samples",
+           "davies_bouldin_score", "calinski_harabasz_score"]
+
+
+def _as_arrays(X, labels):
+    X = np.ascontiguousarray(np.asarray(X, dtype=np.float32))
+    labels = np.ascontiguousarray(np.asarray(labels, dtype=np.int32))
+    if X.ndim != 2:
+        raise ValueError(f"X must be 2-D (n, D), got shape {X.shape}")
+    if labels.shape != (X.shape[0],):
+        raise ValueError(f"labels must have shape ({X.shape[0]},), got "
+                         f"{labels.shape}")
+    k = int(labels.max()) + 1 if labels.size else 0
+    if k < 2:
+        raise ValueError("metrics need at least 2 clusters "
+                         f"(got {k} distinct labels)")
+    return X, labels, k
+
+
+def _pad_chunks(X, labels, chunk: int):
+    n = X.shape[0]
+    pad = (-n) % chunk
+    Xp = np.pad(X, ((0, pad), (0, 0)))
+    # Padding rows get label -1: their one-hot row is all-zero, so they
+    # contribute to nothing.
+    lp = np.pad(labels, (0, pad), constant_values=-1)
+    return jnp.asarray(Xp), jnp.asarray(lp), n
+
+
+@functools.partial(jax.jit, static_argnames=("k", "chunk"))
+def _cluster_moments(Xp, lp, k: int, chunk: int):
+    """Per-cluster (count, coordinate-sum) in one chunked pass."""
+    d = Xp.shape[1]
+    xs = (Xp.reshape(-1, chunk, d), lp.reshape(-1, chunk))
+
+    def body(carry, args):
+        sums, counts = carry
+        xc, lc = args
+        onehot = (lc[:, None] == jnp.arange(k)[None, :]).astype(jnp.float32)
+        sums = sums + jnp.einsum("ck,cd->kd", onehot, xc)
+        counts = counts + jnp.sum(onehot, axis=0)
+        return (sums, counts), None
+
+    (sums, counts), _ = lax.scan(
+        body, (jnp.zeros((k, d)), jnp.zeros((k,))), xs)
+    return sums, counts
+
+
+@functools.partial(jax.jit, static_argnames=("k", "chunk"))
+def _scatter_to_centroids(Xp, lp, centroids, k: int, chunk: int):
+    """Per-cluster sums of EUCLIDEAN distance and squared distance from
+    each member to its own centroid — one chunked pass."""
+    d = Xp.shape[1]
+    xs = (Xp.reshape(-1, chunk, d), lp.reshape(-1, chunk))
+
+    def body(carry, args):
+        s1, s2 = carry
+        xc, lc = args
+        d2 = pairwise_sq_dists(xc, centroids)              # (chunk, k)
+        onehot = (lc[:, None] == jnp.arange(k)[None, :]).astype(jnp.float32)
+        own_d2 = jnp.sum(d2 * onehot, axis=1)              # (chunk,)
+        dist = jnp.sqrt(own_d2)
+        s1 = s1 + jnp.einsum("ck,c->k", onehot, dist)
+        s2 = s2 + jnp.einsum("ck,c->k", onehot, own_d2)
+        return (s1, s2), None
+
+    (s1, s2), _ = lax.scan(body, (jnp.zeros((k,)), jnp.zeros((k,))), xs)
+    return s1, s2
+
+
+def davies_bouldin_score(X, labels) -> float:
+    """Davies-Bouldin index (lower is better).
+
+    DB = mean_i max_{j!=i} (s_i + s_j) / d(c_i, c_j) with s_i the mean
+    Euclidean distance of cluster i's members to its centroid.
+    """
+    X, labels, k = _as_arrays(X, labels)
+    chunk = min(2048, max(256, X.shape[0]))
+    Xp, lp, n = _pad_chunks(X, labels, chunk)
+    sums, counts = _cluster_moments(Xp, lp, k, chunk)
+    counts = np.asarray(counts, np.float64)
+    centroids = np.asarray(sums, np.float64) / np.maximum(counts, 1.0)[:, None]
+    s1, _ = _scatter_to_centroids(Xp, lp, jnp.asarray(centroids, jnp.float32),
+                                  k, chunk)
+    scatter = np.asarray(s1, np.float64) / np.maximum(counts, 1.0)
+    cd = np.sqrt(np.maximum(np.asarray(
+        pairwise_sq_dists(jnp.asarray(centroids, jnp.float32),
+                          jnp.asarray(centroids, jnp.float32), mode="direct"),
+        np.float64), 0.0))
+    ratio = (scatter[:, None] + scatter[None, :]) / np.where(cd > 0, cd, np.inf)
+    np.fill_diagonal(ratio, 0.0)
+    return float(np.mean(ratio.max(axis=1)))
+
+
+def calinski_harabasz_score(X, labels) -> float:
+    """Calinski-Harabasz index / variance-ratio criterion (higher is
+    better): (between-group SS / (k-1)) / (within-group SS / (n-k))."""
+    X, labels, k = _as_arrays(X, labels)
+    chunk = min(2048, max(256, X.shape[0]))
+    Xp, lp, n = _pad_chunks(X, labels, chunk)
+    sums, counts = _cluster_moments(Xp, lp, k, chunk)
+    counts = np.asarray(counts, np.float64)
+    sums = np.asarray(sums, np.float64)
+    centroids = sums / np.maximum(counts, 1.0)[:, None]
+    _, s2 = _scatter_to_centroids(Xp, lp, jnp.asarray(centroids, jnp.float32),
+                                  k, chunk)
+    wss = float(np.sum(np.asarray(s2, np.float64)))
+    mean = sums.sum(axis=0) / n
+    bss = float(np.sum(counts * np.sum((centroids - mean) ** 2, axis=1)))
+    if wss == 0.0:
+        return 1.0                                  # sklearn's degenerate case
+    return float(bss * (n - k) / (wss * (k - 1)))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "chunk"))
+def _silhouette_pass(Xp, lp, counts, k: int, chunk: int):
+    """Per-point silhouette values in chunked passes over the full (n, n)
+    distance structure — each chunk materializes only (chunk, n) distances
+    (matmul form, MXU) and reduces them to per-cluster sums with a one-hot
+    (n, k) matmul before the next chunk starts."""
+    d = Xp.shape[1]
+    onehot_all = (lp[:, None] == jnp.arange(k)[None, :]).astype(jnp.float32)
+    xs = (Xp.reshape(-1, chunk, d), lp.reshape(-1, chunk))
+
+    def body(_, args):
+        xc, lc = args
+        d2 = pairwise_sq_dists(xc, Xp)                     # (chunk, n)
+        dist = jnp.sqrt(d2)
+        # Per-cluster distance sums: (chunk, n) @ (n, k) on the MXU.
+        csums = dist @ onehot_all                          # (chunk, k)
+        own = jnp.take_along_axis(csums, lc[:, None].clip(0), axis=1)[:, 0]
+        own_count = counts[lc.clip(0)]
+        # a: mean distance to OWN cluster, self excluded (|C|-1 denominator).
+        a = own / jnp.maximum(own_count - 1.0, 1.0)
+        # b: min over OTHER clusters of mean distance.
+        mean_other = csums / jnp.maximum(counts, 1.0)[None, :]
+        mask_own = (lc[:, None] == jnp.arange(k)[None, :])
+        mean_other = jnp.where(mask_own | (counts[None, :] == 0),
+                               jnp.inf, mean_other)
+        b = jnp.min(mean_other, axis=1)
+        s = jnp.where(own_count <= 1.0, 0.0,
+                      (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30))
+        return None, s
+
+    _, s = lax.scan(body, None, xs)
+    return s.reshape(-1)
+
+
+def silhouette_samples(X, labels) -> np.ndarray:
+    """Per-point silhouette coefficient (b - a) / max(a, b); singleton
+    clusters score 0 (sklearn convention)."""
+    X, labels, k = _as_arrays(X, labels)
+    chunk = min(1024, max(128, X.shape[0]))
+    Xp, lp, n = _pad_chunks(X, labels, chunk)
+    _, counts = _cluster_moments(Xp, lp, k, chunk)
+    s = _silhouette_pass(Xp, lp, counts, k, chunk)
+    return np.asarray(s, dtype=np.float64)[:n]
+
+
+def silhouette_score(X, labels, *, sample_size: Optional[int] = None,
+                     seed: int = 0) -> float:
+    """Mean silhouette coefficient over all points (or a seeded
+    ``sample_size`` subsample for large n — the full score is O(n²D))."""
+    X = np.asarray(X)
+    labels = np.asarray(labels)
+    if sample_size is not None and sample_size < X.shape[0]:
+        idx = np.random.default_rng(seed).choice(
+            X.shape[0], size=sample_size, replace=False)
+        X, labels = X[idx], labels[idx]
+    return float(np.mean(silhouette_samples(X, labels)))

@@ -62,6 +62,8 @@ class BisectingKMeans(KMeans):
     (same caveat as sklearn's tree-walking predict vs its labels_).
     """
 
+    _PARAM_NAMES = KMeans._PARAM_NAMES + ("bisecting_strategy",)
+
     def __init__(self, k: int = 3, max_iter: int = 100,
                  tolerance: float = 1e-4, seed: int = 42,
                  compute_sse: bool = False, *,

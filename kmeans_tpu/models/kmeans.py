@@ -581,6 +581,25 @@ class KMeans:
 
     # ---------------------------------------------------- sklearn-style sugar
 
+    _PARAM_NAMES = ("k", "max_iter", "tolerance", "seed", "compute_sse",
+                    "init", "n_init", "empty_cluster", "dtype", "mesh",
+                    "model_shards", "chunk_size", "distance_mode",
+                    "host_loop", "verbose")
+
+    def get_params(self, deep: bool = True) -> dict:
+        """Constructor parameters as a dict (sklearn estimator protocol —
+        enables ``sklearn.base.clone`` and pipeline interop)."""
+        return {name: getattr(self, name) for name in self._PARAM_NAMES}
+
+    def set_params(self, **params) -> "KMeans":
+        for name, value in params.items():
+            if name not in self._PARAM_NAMES:
+                raise ValueError(f"unknown parameter {name!r} for "
+                                 f"{type(self).__name__}; valid: "
+                                 f"{sorted(self._PARAM_NAMES)}")
+            setattr(self, name, value)
+        return self
+
     @property
     def cluster_centers_(self) -> Optional[np.ndarray]:
         return self.centroids

@@ -17,6 +17,8 @@ from kmeans_tpu.utils.logging import IterationLogger
 
 
 class MiniBatchKMeans(KMeans):
+    _PARAM_NAMES = KMeans._PARAM_NAMES + ("batch_size",)
+
     def __init__(self, k: int = 3, max_iter: int = 100,
                  tolerance: float = 1e-4, seed: int = 42,
                  compute_sse: bool = False, *, batch_size: int = 4096,

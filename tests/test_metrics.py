@@ -1,0 +1,110 @@
+"""Cluster-validity metrics vs the sklearn oracle (test-only dependency,
+the reference's own policy — README.md:13)."""
+
+import numpy as np
+import pytest
+from sklearn import metrics as skm
+
+from kmeans_tpu import KMeans
+from kmeans_tpu.metrics import (calinski_harabasz_score,
+                                davies_bouldin_score, silhouette_samples,
+                                silhouette_score)
+
+
+@pytest.fixture(scope="module")
+def labeled_blobs():
+    rng = np.random.default_rng(0)
+    centers = np.array([[0.0, 0.0, 0.0], [6.0, 6.0, 0.0], [0.0, 8.0, 4.0],
+                        [9.0, 0.0, 9.0]])
+    X = np.concatenate([c + rng.normal(size=(150, 3)) for c in centers])
+    X = X.astype(np.float32)
+    labels = KMeans(k=4, seed=1, verbose=False).fit(X).predict(X)
+    return X, labels
+
+
+def test_silhouette_matches_sklearn(labeled_blobs):
+    X, labels = labeled_blobs
+    ours = silhouette_score(X, labels)
+    ref = skm.silhouette_score(X.astype(np.float64), labels)
+    assert ours == pytest.approx(ref, abs=2e-3)
+
+
+def test_silhouette_samples_match_sklearn(labeled_blobs):
+    X, labels = labeled_blobs
+    ours = silhouette_samples(X, labels)
+    ref = skm.silhouette_samples(X.astype(np.float64), labels)
+    np.testing.assert_allclose(ours, ref, atol=5e-3)
+
+
+def test_silhouette_subsample_close(labeled_blobs):
+    X, labels = labeled_blobs
+    full = silhouette_score(X, labels)
+    sub = silhouette_score(X, labels, sample_size=300, seed=3)
+    assert sub == pytest.approx(full, abs=0.1)
+
+
+def test_davies_bouldin_matches_sklearn(labeled_blobs):
+    X, labels = labeled_blobs
+    ours = davies_bouldin_score(X, labels)
+    ref = skm.davies_bouldin_score(X.astype(np.float64), labels)
+    assert ours == pytest.approx(ref, rel=1e-3)
+
+
+def test_calinski_harabasz_matches_sklearn(labeled_blobs):
+    X, labels = labeled_blobs
+    ours = calinski_harabasz_score(X, labels)
+    ref = skm.calinski_harabasz_score(X.astype(np.float64), labels)
+    assert ours == pytest.approx(ref, rel=1e-3)
+
+
+def test_singleton_cluster_scores_zero():
+    # One isolated point forms its own cluster -> its silhouette is 0.
+    X = np.array([[0.0, 0.0], [0.1, 0.0], [0.0, 0.1], [50.0, 50.0]],
+                 dtype=np.float32)
+    labels = np.array([0, 0, 0, 1], dtype=np.int32)
+    s = silhouette_samples(X, labels)
+    assert s[3] == 0.0
+    ref = skm.silhouette_samples(X.astype(np.float64), labels)
+    np.testing.assert_allclose(s, ref, atol=1e-5)
+
+
+def test_metrics_reject_single_cluster():
+    X = np.zeros((10, 2), dtype=np.float32)
+    labels = np.zeros(10, dtype=np.int32)
+    for fn in (silhouette_score, davies_bouldin_score,
+               calinski_harabasz_score):
+        with pytest.raises(ValueError, match="2 clusters"):
+            fn(X, labels)
+
+
+def test_metrics_reject_bad_shapes():
+    X = np.zeros((10, 2), dtype=np.float32)
+    with pytest.raises(ValueError, match="labels"):
+        silhouette_score(X, np.zeros(9, dtype=np.int32))
+    with pytest.raises(ValueError, match="2-D"):
+        davies_bouldin_score(np.zeros(10), np.zeros(10, dtype=np.int32))
+
+
+def test_get_set_params_roundtrip():
+    from kmeans_tpu import BisectingKMeans, MiniBatchKMeans
+    km = KMeans(k=7, n_init=3, distance_mode="direct", verbose=False)
+    params = km.get_params()
+    assert params["k"] == 7 and params["n_init"] == 3
+    clone = KMeans(**params)
+    assert clone.get_params() == params
+    km.set_params(k=9, tolerance=1e-6)
+    assert km.k == 9 and km.tolerance == 1e-6
+    with pytest.raises(ValueError, match="unknown parameter"):
+        km.set_params(bogus=1)
+    assert MiniBatchKMeans(batch_size=128).get_params()["batch_size"] == 128
+    assert BisectingKMeans().get_params()["bisecting_strategy"] == \
+        "biggest_sse"
+
+
+def test_better_clustering_scores_better(labeled_blobs):
+    X, good = labeled_blobs
+    rng = np.random.default_rng(7)
+    bad = rng.integers(0, 4, size=len(good)).astype(np.int32)
+    assert silhouette_score(X, good) > silhouette_score(X, bad)
+    assert davies_bouldin_score(X, good) < davies_bouldin_score(X, bad)
+    assert calinski_harabasz_score(X, good) > calinski_harabasz_score(X, bad)
