@@ -24,6 +24,7 @@ import numpy as np
 from jax import lax
 
 from kmeans_tpu.ops.assign import pairwise_sq_dists
+from kmeans_tpu.utils.validation import check_finite_array
 
 __all__ = ["silhouette_score", "silhouette_samples",
            "davies_bouldin_score", "calinski_harabasz_score"]
@@ -31,16 +32,23 @@ __all__ = ["silhouette_score", "silhouette_samples",
 
 def _as_arrays(X, labels):
     X = np.ascontiguousarray(np.asarray(X, dtype=np.float32))
-    labels = np.ascontiguousarray(np.asarray(labels, dtype=np.int32))
+    labels = np.asarray(labels)
     if X.ndim != 2:
         raise ValueError(f"X must be 2-D (n, D), got shape {X.shape}")
     if labels.shape != (X.shape[0],):
         raise ValueError(f"labels must have shape ({X.shape[0]},), got "
                          f"{labels.shape}")
-    k = int(labels.max()) + 1 if labels.size else 0
-    if k < 2:
-        raise ValueError("metrics need at least 2 clusters "
-                         f"(got {k} distinct labels)")
+    check_finite_array(X, "Input data contains NaN or Inf values")
+    # Compact to 0..k-1 over the ids actually present (sklearn's
+    # LabelEncoder behavior): gapped ids — an emptied cluster under
+    # ``empty_cluster='keep'``, or DBSCAN-style ``-1`` noise — must not
+    # become phantom origin clusters in the one-hot reductions.
+    uniq, enc = np.unique(labels, return_inverse=True)
+    k = int(uniq.size)
+    if k < 2 or k >= X.shape[0]:
+        raise ValueError("metrics need 2 <= n_labels <= n_samples - 1 "
+                         f"(got {k} distinct labels, {X.shape[0]} samples)")
+    labels = np.ascontiguousarray(enc.astype(np.int32))
     return X, labels, k
 
 
@@ -179,7 +187,8 @@ def silhouette_samples(X, labels) -> np.ndarray:
     X, labels, k = _as_arrays(X, labels)
     chunk = min(1024, max(128, X.shape[0]))
     Xp, lp, n = _pad_chunks(X, labels, chunk)
-    _, counts = _cluster_moments(Xp, lp, k, chunk)
+    counts = jnp.asarray(np.bincount(labels, minlength=k)
+                         .astype(np.float32))
     s = _silhouette_pass(Xp, lp, counts, k, chunk)
     return np.asarray(s, dtype=np.float64)[:n]
 

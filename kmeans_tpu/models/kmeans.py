@@ -592,12 +592,27 @@ class KMeans:
         return {name: getattr(self, name) for name in self._PARAM_NAMES}
 
     def set_params(self, **params) -> "KMeans":
-        for name, value in params.items():
+        for name in params:
             if name not in self._PARAM_NAMES:
                 raise ValueError(f"unknown parameter {name!r} for "
                                  f"{type(self).__name__}; valid: "
                                  f"{sorted(self._PARAM_NAMES)}")
-            setattr(self, name, value)
+        # Route through __init__ so new values get exactly the constructor's
+        # validation and normalization (empty_cluster whitelist, n_init >= 1,
+        # dtype -> np.dtype, ...), then restore every non-parameter attribute
+        # (fitted state) — including subclass state — that __init__ reset.
+        merged = self.get_params()
+        merged.update(params)
+        saved = dict(self.__dict__)
+        try:
+            self.__init__(**merged)
+        except Exception:
+            self.__dict__.clear()
+            self.__dict__.update(saved)
+            raise
+        for name, value in saved.items():
+            if name not in self._PARAM_NAMES:
+                self.__dict__[name] = value
         return self
 
     @property

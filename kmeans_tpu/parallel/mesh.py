@@ -4,8 +4,8 @@ The reference's "cluster" is Spark local mode with parallelism simulated by
 partition count (``repartition(4)`` kmeans_spark.py:418, ``numPartitions``
 :568; SURVEY.md §4).  Here the cluster is a ``jax.sharding.Mesh``: the same
 code runs on one real TPU chip, a CPU-simulated N-device mesh
-(``--xla_force_host_platform_device_count``), or a multi-host slice — XLA
-routes the collectives over ICI within a slice and DCN across slices.
+(``force_cpu_devices``), or a multi-host slice — XLA routes the collectives
+over ICI within a slice and DCN across slices.
 """
 
 from __future__ import annotations
@@ -41,6 +41,43 @@ def make_mesh(data: Optional[int] = None, model: int = 1,
                          f"have {n}")
     grid = np.array(devs[: data * model]).reshape(data, model)
     return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def force_cpu_devices(n: Optional[int] = None) -> None:
+    """Force the CPU platform with ``n`` virtual devices, re-initializing the
+    backend if one is already live.
+
+    ``n=None`` honors an ``--xla_force_host_platform_device_count`` already
+    present in ``XLA_FLAGS`` (falling back to 1) so externally configured
+    simulations keep working.
+
+    This is the JAX analogue of the reference simulating a cluster with Spark
+    local-mode partitions (kmeans_spark.py:418,568): sharding/collective code
+    paths run on one machine without ``n`` real chips.  ``jax_num_cpu_devices``
+    (not ``XLA_FLAGS``) is used because the config value is re-read every time
+    a CPU client is created, whereas the flag is parsed only at first backend
+    initialization; the ``clear_backends`` handles a platform plugin already
+    registered by the session (e.g. a sitecustomize that imports jax at
+    interpreter start).
+    """
+    import os
+    import re
+
+    import jax.extend.backend
+
+    if n is None:
+        m = re.search(r"--xla_force_host_platform_device_count=(\d+)",
+                      os.environ.get("XLA_FLAGS", ""))
+        n = int(m.group(1)) if m else 1
+    if n <= 0:
+        raise ValueError(f"device count must be positive, got {n}")
+    # In-process only (jax.config, not os.environ): an env write would leak
+    # the CPU pin into every subprocess the caller later spawns.
+    # clear_backends first: jax_num_cpu_devices refuses to update while a
+    # backend is live, and the config is re-read at the next client creation.
+    jax.extend.backend.clear_backends()
+    jax.config.update("jax_num_cpu_devices", n)
+    jax.config.update("jax_platforms", "cpu")
 
 
 def mesh_shape(mesh: Optional[Mesh]) -> tuple[int, int]:

@@ -22,7 +22,6 @@ Differences from the reference, on purpose:
 from __future__ import annotations
 
 import argparse
-import os
 import sys
 import time
 from pathlib import Path
@@ -206,16 +205,19 @@ def main(argv=None) -> int:
     parser.add_argument("--only", default=None,
                         help="comma-separated subset of a,b,c,d,e")
     args = parser.parse_args(argv)
+    if args.devices is not None and args.devices <= 0:
+        parser.error(f"--devices must be positive, got {args.devices}")
 
-    if args.devices:
-        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
-                                   f" --xla_force_host_platform_device_count"
-                                   f"={args.devices}").strip()
     import jax
-    if args.platform:
-        jax.config.update("jax_platforms", args.platform)
 
-    from kmeans_tpu.parallel.mesh import make_mesh
+    from kmeans_tpu.parallel.mesh import force_cpu_devices, make_mesh
+
+    if args.platform == "cpu":
+        force_cpu_devices(args.devices)       # None honors XLA_FLAGS, else 1
+    elif args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    elif args.devices is not None and len(jax.devices()) < args.devices:
+        force_cpu_devices(args.devices)
 
     _banner("DISTRIBUTED K-MEANS (TPU) - PRODUCTION TEST SUITE")
     print(f"JAX backend: {jax.default_backend()}, "

@@ -73,7 +73,7 @@ def test_metrics_reject_single_cluster():
     labels = np.zeros(10, dtype=np.int32)
     for fn in (silhouette_score, davies_bouldin_score,
                calinski_harabasz_score):
-        with pytest.raises(ValueError, match="2 clusters"):
+        with pytest.raises(ValueError, match="2 <= n_labels"):
             fn(X, labels)
 
 
@@ -108,3 +108,37 @@ def test_better_clustering_scores_better(labeled_blobs):
     assert silhouette_score(X, good) > silhouette_score(X, bad)
     assert davies_bouldin_score(X, good) < davies_bouldin_score(X, bad)
     assert calinski_harabasz_score(X, good) > calinski_harabasz_score(X, bad)
+
+
+def test_gapped_labels_match_sklearn():
+    """Non-contiguous label ids (an emptied cluster, DBSCAN-style -1 noise)
+    must be compacted like sklearn's LabelEncoder, not become phantom
+    origin clusters."""
+    rng = np.random.default_rng(0)
+    X = np.concatenate([rng.normal(0, 1, (50, 4)),
+                        rng.normal(8, 1, (50, 4))]).astype(np.float32)
+    gapped = np.array([0] * 50 + [3] * 50)
+    assert davies_bouldin_score(X, gapped) == pytest.approx(
+        skm.davies_bouldin_score(X, gapped), rel=1e-4)
+    assert calinski_harabasz_score(X, gapped) == pytest.approx(
+        skm.calinski_harabasz_score(X, gapped), rel=1e-4)
+    noisy = gapped.copy()
+    noisy[0] = -1                      # becomes its own singleton cluster
+    np.testing.assert_allclose(silhouette_samples(X, noisy),
+                               skm.silhouette_samples(X, noisy), atol=5e-3)
+
+
+def test_set_params_revalidates_and_preserves_fit():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(100, 3)).astype(np.float32)
+    km = KMeans(k=3, verbose=False).fit(X)
+    before = km.centroids.copy()
+    with pytest.raises(ValueError, match="empty_cluster"):
+        km.set_params(empty_cluster="typo")
+    assert km.empty_cluster == "resample"          # unchanged on failure
+    np.testing.assert_array_equal(km.centroids, before)
+    with pytest.raises(ValueError, match="n_init"):
+        km.set_params(n_init=0)
+    km.set_params(dtype="float64")
+    assert km.dtype == np.dtype(np.float64)        # normalized like __init__
+    np.testing.assert_array_equal(km.centroids, before)   # fit preserved
