@@ -98,8 +98,8 @@ def _kernel(x_ref, w_ref, c_ref, labels_ref, mind2_ref, sums_ref,
 @functools.partial(jax.jit,
                    static_argnames=("tile_n", "tile_k", "bf16", "interpret"))
 def fused_assign_reduce(points: jax.Array, weights: jax.Array,
-                        centroids: jax.Array, *, tile_n: int = 512,
-                        tile_k: int = 512, bf16: bool = False,
+                        centroids: jax.Array, *, tile_n: int = 1024,
+                        tile_k: int = 1024, bf16: bool = False,
                         interpret: bool = False
                         ) -> Tuple[jax.Array, jax.Array, jax.Array,
                                    jax.Array]:

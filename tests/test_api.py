@@ -122,3 +122,19 @@ def test_minibatch_converges_near_fullbatch(mesh8):
 def test_minibatch_invalid_batch_size():
     with pytest.raises(ValueError, match="batch_size"):
         MiniBatchKMeans(batch_size=0)
+
+
+def test_set_params_revalidates_and_preserves_fit():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(100, 3)).astype(np.float32)
+    km = KMeans(k=3, verbose=False).fit(X)
+    before = km.centroids.copy()
+    with pytest.raises(ValueError, match="empty_cluster"):
+        km.set_params(empty_cluster="typo")
+    assert km.empty_cluster == "resample"          # unchanged on failure
+    np.testing.assert_array_equal(km.centroids, before)
+    with pytest.raises(ValueError, match="n_init"):
+        km.set_params(n_init=0)
+    km.set_params(dtype="float64")
+    assert km.dtype == np.dtype(np.float64)        # normalized like __init__
+    np.testing.assert_array_equal(km.centroids, before)   # fit preserved

@@ -126,19 +126,3 @@ def test_gapped_labels_match_sklearn():
     noisy[0] = -1                      # becomes its own singleton cluster
     np.testing.assert_allclose(silhouette_samples(X, noisy),
                                skm.silhouette_samples(X, noisy), atol=5e-3)
-
-
-def test_set_params_revalidates_and_preserves_fit():
-    rng = np.random.default_rng(0)
-    X = rng.normal(size=(100, 3)).astype(np.float32)
-    km = KMeans(k=3, verbose=False).fit(X)
-    before = km.centroids.copy()
-    with pytest.raises(ValueError, match="empty_cluster"):
-        km.set_params(empty_cluster="typo")
-    assert km.empty_cluster == "resample"          # unchanged on failure
-    np.testing.assert_array_equal(km.centroids, before)
-    with pytest.raises(ValueError, match="n_init"):
-        km.set_params(n_init=0)
-    km.set_params(dtype="float64")
-    assert km.dtype == np.dtype(np.float64)        # normalized like __init__
-    np.testing.assert_array_equal(km.centroids, before)   # fit preserved
