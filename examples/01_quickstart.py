@@ -1,0 +1,26 @@
+"""Quickstart: fit, predict, inspect — the reference's core workflow.
+
+Mirrors the usage shown in the reference's README (``README.md:30-54``):
+construct, fit, predict, read ``centroids`` / ``sse_history`` — except the
+data is a plain NumPy array instead of an RDD and the execution is a fused
+SPMD step on whatever devices are visible (TPU chips, or CPU).
+
+Run: ``python examples/01_quickstart.py``
+"""
+
+import numpy as np
+
+from kmeans_tpu import KMeans
+from kmeans_tpu.data.synthetic import make_blobs
+
+X, _ = make_blobs(50_000, centers=8, n_features=16, random_state=0,
+                  dtype=np.float32)
+
+km = KMeans(k=8, max_iter=100, tolerance=1e-4, seed=42, compute_sse=True)
+km.fit(X)
+
+print("\ncentroids:", km.centroids.shape)
+print("iterations:", km.iterations_run)
+print("final SSE:", km.sse_history[-1])
+print("labels:", km.labels_[:10], "...")
+print("score (negative SSE):", km.score(X))

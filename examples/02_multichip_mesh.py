@@ -1,0 +1,37 @@
+"""Distributed fit over an explicit device mesh (DP x TP).
+
+The reference scales by Spark partition count (``repartition(4)``,
+``kmeans_spark.py:418``); here the analogue is a ``jax.sharding.Mesh`` with
+a ``data`` axis (points sharded over N) and an optional ``model`` axis (the
+(k, D) centroid table row-sharded — useful when k*D is large).  The same
+script runs unchanged on real TPU chips or on virtual CPU devices.
+
+Run (8 virtual CPU devices):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/02_multichip_mesh.py
+"""
+
+import jax
+import numpy as np
+
+from kmeans_tpu import KMeans, make_mesh
+from kmeans_tpu.data.synthetic import make_blobs
+
+devs = jax.devices()
+print(f"{len(devs)} devices: {devs[0].platform}")
+
+# data x model mesh: DP over points, TP over the centroid table.
+model = 2 if len(devs) % 2 == 0 and len(devs) > 1 else 1
+mesh = make_mesh(data=len(devs) // model, model=model)
+print("mesh:", dict(mesh.shape))
+
+X, _ = make_blobs(200_000, centers=32, n_features=64, random_state=1,
+                  dtype=np.float32)
+
+km = KMeans(k=32, seed=42, compute_sse=True, mesh=mesh)
+ds = km.cache(X)          # upload + shard once (the rdd.cache() analogue)
+km.fit(ds)
+print("iterations:", km.iterations_run, "SSE:", km.sse_history[-1])
+
+labels = km.predict(ds)   # reuses the device-resident shards
+print("cluster sizes:", np.bincount(labels, minlength=32))

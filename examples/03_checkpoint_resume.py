@@ -1,0 +1,35 @@
+"""Checkpoint / resume (beyond-reference capability, SURVEY.md §5).
+
+The reference keeps centroids only in memory (``kmeans_spark.py:44``).
+Here a fit can be checkpointed and resumed exactly — including the
+mini-batch sampler's RNG continuity — so long jobs survive preemption.
+
+Run: ``python examples/03_checkpoint_resume.py``
+"""
+
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+from kmeans_tpu import KMeans
+from kmeans_tpu.data.synthetic import make_blobs
+
+X, _ = make_blobs(100_000, centers=16, n_features=32, random_state=2,
+                  dtype=np.float32)
+
+ckpt = Path(tempfile.mkdtemp()) / "kmeans.ckpt"
+
+# Phase 1: run a few iterations, then "get preempted".
+km = KMeans(k=16, max_iter=3, seed=42, compute_sse=True, verbose=False)
+km.fit(X)
+km.save(ckpt)
+print(f"saved after {km.iterations_run} iterations, "
+      f"SSE={km.sse_history[-1]:.1f}")
+
+# Phase 2: reload and continue to convergence from the saved state.
+km2 = KMeans.load(ckpt)
+km2.set_params(max_iter=100)
+km2.fit(X, resume=True)
+print(f"resumed -> converged after {km2.iterations_run} total iterations, "
+      f"SSE={km2.sse_history[-1]:.1f}")

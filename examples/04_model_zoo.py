@@ -1,0 +1,26 @@
+"""The model family: KMeans, MiniBatchKMeans, BisectingKMeans,
+SphericalKMeans — all sharing the same fused TPU step.
+
+Run: ``python examples/04_model_zoo.py``
+"""
+
+import numpy as np
+
+from kmeans_tpu import (BisectingKMeans, KMeans, MiniBatchKMeans,
+                        SphericalKMeans)
+from kmeans_tpu.data.synthetic import make_blobs
+from kmeans_tpu.metrics import silhouette_score
+
+X, _ = make_blobs(30_000, centers=6, n_features=24, random_state=3,
+                  dtype=np.float32)
+
+for cls, kwargs in [
+    (KMeans, dict(n_init=4, init="kmeans++")),   # multi-restart + smart init
+    (MiniBatchKMeans, dict(batch_size=2048)),    # sampled incremental updates
+    (BisectingKMeans, {}),                       # divisive hierarchical
+    (SphericalKMeans, {}),                       # cosine-similarity clustering
+]:
+    model = cls(k=6, seed=42, verbose=False, **kwargs).fit(X)
+    sil = silhouette_score(X, model.predict(X), sample_size=5_000, seed=0)
+    print(f"{cls.__name__:18s} iters={model.iterations_run:3d} "
+          f"silhouette={sil:.3f}")

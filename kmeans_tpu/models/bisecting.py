@@ -76,7 +76,6 @@ class BisectingKMeans(KMeans):
         kwargs.setdefault("empty_cluster", "resample")
         super().__init__(k=k, max_iter=max_iter, tolerance=tolerance,
                          seed=seed, compute_sse=compute_sse, **kwargs)
-        self.labels_: Optional[np.ndarray] = None
         self.cluster_sse_: Optional[np.ndarray] = None
 
     # ------------------------------------------------------------------- fit
@@ -148,6 +147,7 @@ class BisectingKMeans(KMeans):
                 distance_mode=self.distance_mode,
                 host_loop=True, verbose=False)
             inner._validate_init = False     # X validated once above
+            inner._eager_labels = False      # membership computed below
             inner.fit(ds_t)
 
             two = self._put_centroids(np.asarray(inner.centroids), mesh,

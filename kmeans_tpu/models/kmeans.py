@@ -151,11 +151,16 @@ class KMeans:
         self.sse_history: List[float] = []            # kmeans_spark.py:45
         self.cluster_sizes_: Optional[np.ndarray] = None
         self.iter_times_: List[float] = []            # wall secs/iteration
+        self._fit_ds = None                           # retained for labels_
+        self._labels_cache: Optional[np.ndarray] = None
         validate_params(k, max_iter, tolerance)       # kmeans_spark.py:46
         self.iterations_run = 0                       # kmeans_spark.py:47
         # Internal: skip init-time full-array finite scans when the caller
         # (e.g. BisectingKMeans) already validated the data once.
         self._validate_init = True
+        # Internal: inner/worker fits (e.g. BisectingKMeans' per-split
+        # 2-means) skip the eager labels_ pass — the parent never reads it.
+        self._eager_labels = True
 
     # ------------------------------------------------------------------ mesh
 
@@ -242,7 +247,15 @@ class KMeans:
         """
         from kmeans_tpu.utils import profiling
         with profiling.trace(profile_dir):
-            return self._fit(X, sample_weight=sample_weight, resume=resume)
+            self._fit(X, sample_weight=sample_weight, resume=resume)
+        # Materialize labels_ eagerly (sklearn semantics) — one extra fused
+        # assignment pass, after which the device-resident dataset reference
+        # is released so fit() never leaves HBM pinned.
+        if self._eager_labels:
+            _ = self.labels_
+        else:
+            self._fit_ds = None
+        return self
 
     def _apply_sample_weight(self, X, sample_weight):
         """Fold an explicit (n,) sample_weight into a fresh cached dataset
@@ -286,6 +299,7 @@ class KMeans:
         log = IterationLogger(self.verbose and jax.process_index() == 0)
         X = self._apply_sample_weight(X, sample_weight)
         ds, mesh, model_shards, step_fn, _ = self._prepare(X)
+        self._fit_ds, self._labels_cache = ds, None   # feeds lazy labels_
         log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
         self.best_restart_ = 0
         self.restart_inertias_ = None
@@ -555,7 +569,9 @@ class KMeans:
         return np.asarray(labels)[: ds.n]
 
     def fit_predict(self, X) -> np.ndarray:
-        return self.fit(X).predict(X)
+        # labels_ is materialized by fit() from the same X — reusing it
+        # avoids a second upload + assignment pass.
+        return self.fit(X).labels_
 
     def fit_transform(self, X) -> np.ndarray:
         return self.fit(X).transform(X)
@@ -626,6 +642,49 @@ class KMeans:
     @property
     def inertia_(self) -> Optional[float]:
         return self.sse_history[-1] if self.sse_history else None
+
+    @property
+    def labels_(self) -> np.ndarray:
+        """Training-set labels under the fitted centroids (sklearn parity;
+        the reference exposes labels only through ``predict``,
+        kmeans_spark.py:321-352).  ``fit`` materializes these eagerly with
+        one fused assignment pass and then releases its dataset reference,
+        so device memory is never pinned past the end of ``fit``."""
+        if self._labels_cache is None:
+            if self.centroids is None or self._fit_ds is None:
+                raise AttributeError(
+                    "labels_ is only available after fit()")
+            self._labels_cache = self.predict(self._fit_ds)
+            self._fit_ds = None
+        return self._labels_cache
+
+    @labels_.setter
+    def labels_(self, value) -> None:
+        self._labels_cache = value
+
+    def __getstate__(self) -> dict:
+        """Pickle/deepcopy support: device-bound objects (the retained
+        dataset and the ``jax.sharding.Mesh`` of Device handles) are
+        dropped; an unpickled model lazily rebuilds a mesh on next use via
+        ``_resolve_mesh``.  ``labels_`` survives — ``fit`` materializes it
+        eagerly."""
+        state = dict(self.__dict__)
+        state["_fit_ds"] = None
+        state["mesh"] = None
+        return state
+
+    def __deepcopy__(self, memo):
+        """In-process deepcopy keeps the (copyable, user-configured) mesh —
+        only cross-process pickling must drop device handles."""
+        import copy as _copy
+        new = self.__class__.__new__(self.__class__)
+        memo[id(self)] = new
+        for name, value in self.__dict__.items():
+            if name in ("mesh", "_fit_ds"):
+                new.__dict__[name] = value     # share device-bound objects
+            else:
+                new.__dict__[name] = _copy.deepcopy(value, memo)
+        return new
 
     # ------------------------------------------------------------ checkpoint
 

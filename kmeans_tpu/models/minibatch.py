@@ -44,6 +44,7 @@ class MiniBatchKMeans(KMeans):
             raise ValueError(f"X must be 2-D (n, D), got shape {X.shape}")
         n, d = X.shape
         bs = min(self.batch_size, n)
+        self._fit_ds, self._labels_cache = X, None    # feeds lazy labels_
         import jax
         log = IterationLogger(self.verbose and jax.process_index() == 0)
 
@@ -104,6 +105,7 @@ class MiniBatchKMeans(KMeans):
             if max_shift < self.tolerance:
                 log.converged(iteration + 1)
                 break
+        _ = self.labels_          # eager, full-X pass (sklearn semantics)
         return self
 
     def _state_dict(self) -> dict:

@@ -138,3 +138,70 @@ def test_set_params_revalidates_and_preserves_fit():
     km.set_params(dtype="float64")
     assert km.dtype == np.dtype(np.float64)        # normalized like __init__
     np.testing.assert_array_equal(km.centroids, before)   # fit preserved
+
+
+def test_labels_matches_predict_and_releases_dataset():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(200, 4)).astype(np.float32)
+    km = KMeans(k=4, seed=1, verbose=False).fit(X)
+    np.testing.assert_array_equal(km.labels_, km.predict(X))
+    assert km._fit_ds is None            # device reference released
+    np.testing.assert_array_equal(km.labels_, km.predict(X))  # cached
+
+
+def test_labels_before_fit_raises():
+    with pytest.raises(AttributeError, match="after fit"):
+        KMeans(k=2, verbose=False).labels_
+
+
+def test_labels_minibatch():
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(500, 8)).astype(np.float32)
+    mb = MiniBatchKMeans(k=3, seed=0, batch_size=128, max_iter=5,
+                         verbose=False).fit(X)
+    np.testing.assert_array_equal(mb.labels_, mb.predict(X))
+
+
+def test_labels_refreshed_by_refit():
+    rng = np.random.default_rng(5)
+    X1 = rng.normal(size=(150, 3)).astype(np.float32)
+    X2 = rng.normal(size=(90, 3)).astype(np.float32) + 10.0
+    km = KMeans(k=3, seed=2, verbose=False).fit(X1)
+    _ = km.labels_
+    km.fit(X2)
+    assert km.labels_.shape == (90,)
+    np.testing.assert_array_equal(km.labels_, km.predict(X2))
+
+
+def test_fitted_model_pickles_and_deepcopies():
+    import copy
+    import pickle
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(120, 4)).astype(np.float32)
+    km = KMeans(k=3, seed=0, verbose=False).fit(X)
+    km2 = pickle.loads(pickle.dumps(km))
+    np.testing.assert_array_equal(km2.labels_, km.labels_)
+    np.testing.assert_array_equal(km2.predict(X), km.predict(X))
+    km3 = copy.deepcopy(km)
+    np.testing.assert_array_equal(km3.centroids, km.centroids)
+
+
+def test_deepcopy_preserves_mesh_and_fit():
+    import copy
+    from kmeans_tpu import make_mesh
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(160, 4)).astype(np.float32)
+    mesh = make_mesh(data=4, model=2)
+    km = KMeans(k=3, seed=0, verbose=False, mesh=mesh).fit(X)
+    km2 = copy.deepcopy(km)
+    assert km2.mesh is mesh                       # user mesh survives
+    np.testing.assert_array_equal(km2.predict(X), km.predict(X))
+
+
+def test_fit_predict_reuses_eager_labels():
+    rng = np.random.default_rng(8)
+    X = rng.normal(size=(140, 3)).astype(np.float32)
+    km = KMeans(k=3, seed=1, verbose=False)
+    labels = km.fit_predict(X)
+    assert labels is km._labels_cache             # no second pass
+    np.testing.assert_array_equal(labels, km.predict(X))

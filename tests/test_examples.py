@@ -1,0 +1,37 @@
+"""Examples stay importable/runnable.
+
+Each example is compiled (syntax + top-level structure) and the fastest one
+is executed end-to-end as a subprocess smoke test on the CPU test platform.
+"""
+
+import os
+import py_compile
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+EXAMPLES = sorted((Path(__file__).parent.parent / "examples").glob("*.py"))
+
+
+def test_examples_exist():
+    assert len(EXAMPLES) >= 5
+
+
+@pytest.mark.parametrize("path", EXAMPLES, ids=lambda p: p.name)
+def test_example_compiles(path):
+    py_compile.compile(str(path), doraise=True)
+
+
+def test_quickstart_runs():
+    quickstart = next(p for p in EXAMPLES if "quickstart" in p.name)
+    repo_root = quickstart.parent.parent
+    env = dict(os.environ, PYTHONPATH=str(repo_root),
+               JAX_PLATFORMS="cpu")   # hermetic: don't grab the TPU
+    proc = subprocess.run(
+        [sys.executable, str(quickstart)],
+        capture_output=True, text=True, timeout=600,
+        cwd=repo_root, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "final SSE" in proc.stdout
