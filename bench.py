@@ -94,9 +94,12 @@ def main() -> None:
                            dist.centroid_sharding(mesh))
 
     def build(max_iter: int):
+        # history_sse=False mirrors the reference's stress-bench semantics
+        # (T2 runs compute_sse=False, kmeans_spark.py:424) — and the
+        # baseline loop below doesn't compute SSE either.
         return dist.make_fit_fn(mesh, chunk_size=chunk, mode=mode, k_real=k,
                                 max_iter=max_iter, tolerance=1e-30,
-                                empty_policy="keep")
+                                empty_policy="keep", history_sse=False)
 
     fit_small, fit_big = build(2), build(2 + iters)
     t0 = time.perf_counter()

@@ -418,13 +418,15 @@ class KMeans:
         parallel.distributed.make_fit_fn for semantics and trade-offs."""
         iters_left = self.max_iter - start_iter
         key = (mesh, ds.chunk, self.distance_mode, self.k, iters_left,
-               float(self.tolerance), self.empty_cluster, "fit")
+               float(self.tolerance), self.empty_cluster, self.compute_sse,
+               "fit")
         if key not in _STEP_CACHE:
             _STEP_CACHE[key] = dist.make_fit_fn(
                 mesh, chunk_size=ds.chunk, mode=self.distance_mode,
                 k_real=self.k, max_iter=iters_left,
                 tolerance=float(self.tolerance),
-                empty_policy=self.empty_cluster)
+                empty_policy=self.empty_cluster,
+                history_sse=self.compute_sse)
         fit_fn = _STEP_CACHE[key]
         cents_dev = self._put_centroids(centroids, mesh, model_shards)
         fit_start = time.perf_counter()
@@ -476,13 +478,15 @@ class KMeans:
         true final inertia — is selected on device too."""
         R = len(seeds)
         key = (mesh, ds.chunk, self.distance_mode, self.k, self.max_iter,
-               float(self.tolerance), self.empty_cluster, R, "multifit")
+               float(self.tolerance), self.empty_cluster, R,
+               self.compute_sse, "multifit")
         if key not in _STEP_CACHE:
             _STEP_CACHE[key] = dist.make_multi_fit_fn(
                 mesh, chunk_size=ds.chunk, mode=self.distance_mode,
                 k_real=self.k, max_iter=self.max_iter,
                 tolerance=float(self.tolerance),
-                empty_policy=self.empty_cluster, n_init=R)
+                empty_policy=self.empty_cluster, n_init=R,
+                history_sse=self.compute_sse)
         fit_fn = _STEP_CACHE[key]
         inits = np.stack([self._init_centroids(ds, s) for s in seeds])
         cents_dev = jax.device_put(
@@ -668,6 +672,9 @@ class KMeans:
         dropped; an unpickled model lazily rebuilds a mesh on next use via
         ``_resolve_mesh``.  ``labels_`` survives — ``fit`` materializes it
         eagerly."""
+        if self._labels_cache is None and self._fit_ds is not None \
+                and self.centroids is not None:
+            _ = self.labels_      # materialize before dropping the dataset
         state = dict(self.__dict__)
         state["_fit_ds"] = None
         state["mesh"] = None

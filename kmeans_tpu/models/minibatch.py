@@ -62,50 +62,96 @@ class MiniBatchKMeans(KMeans):
 
         log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
 
-        mesh, model_shards, step_fn, _, chunk = self._setup(bs, d)
-        from kmeans_tpu.parallel.sharding import shard_points
         for iteration in range(start_iter, self.max_iter):
             # Per-iteration derived RNG: batch i is a pure function of
             # (seed, i), so a checkpointed run resumes the SAME batch
             # sequence an uninterrupted run would see.
             rng = np.random.default_rng([self.seed, iteration])
             batch = X[rng.choice(n, size=bs, replace=False)]
-            pts, w = shard_points(batch, mesh, chunk)
-            stats = step_fn(pts, w, self._put_centroids(
-                centroids.astype(self.dtype), mesh, model_shards))
-            sums = np.asarray(stats.sums, dtype=np.float64)[: self.k]
-            counts = np.asarray(stats.counts, dtype=np.float64)[: self.k]
-
-            seen += counts
-            eta = np.divide(counts, np.maximum(seen, 1.0))[:, None]
-            batch_mean = sums / np.maximum(counts, 1.0)[:, None]
-            new_centroids = np.where(
-                counts[:, None] > 0,
-                (1.0 - eta) * centroids + eta * batch_mean, centroids)
-
-            if not np.all(np.isfinite(new_centroids)):
-                raise ValueError(
-                    f"NaN or Inf detected in centroids at iteration "
-                    f"{iteration + 1}")
-            if self.compute_sse:
-                sse = float(stats.sse) * (n / bs)   # scaled batch estimate
-                self.sse_history.append(sse)
-
-            max_shift = float(np.max(np.linalg.norm(
-                new_centroids - centroids, axis=1)))
-            log.iteration(iteration, max_shift, counts.astype(np.int64),
-                          self.sse_history[-1] if
-                          (self.compute_sse and self.sse_history) else None)
-
-            centroids = new_centroids
-            self.centroids = centroids.astype(self.dtype)
-            self.cluster_sizes_ = counts.astype(np.int64)
-            self.iterations_run = iteration + 1
-            self._seen = seen.copy()
+            centroids, seen, max_shift = self._incremental_update(
+                batch, centroids, seen, iteration, log, sse_scale=n / bs)
             if max_shift < self.tolerance:
                 log.converged(iteration + 1)
                 break
-        _ = self.labels_          # eager, full-X pass (sklearn semantics)
+        # labels_ stays LAZY here (first access runs one full-X pass):
+        # mini-batch training deliberately avoids full-N passes, and
+        # _fit_ds is the host array — no device memory is pinned.
+        return self
+
+    def _incremental_update(self, batch: np.ndarray, centroids: np.ndarray,
+                            seen: np.ndarray, iteration: int,
+                            log: IterationLogger, sse_scale: float = 1.0):
+        """One Sculley update from one batch: fused stats on device, then
+        per-center count-weighted interpolation on the host.  Shared by
+        ``fit`` (seeded internal batches) and ``partial_fit`` (caller-
+        provided batches)."""
+        bs, d = batch.shape
+        mesh, model_shards, step_fn, _, chunk = self._setup(bs, d)
+        from kmeans_tpu.parallel.sharding import shard_points
+        pts, w = shard_points(batch, mesh, chunk)
+        stats = step_fn(pts, w, self._put_centroids(
+            centroids.astype(self.dtype), mesh, model_shards))
+        sums = np.asarray(stats.sums, dtype=np.float64)[: self.k]
+        counts = np.asarray(stats.counts, dtype=np.float64)[: self.k]
+
+        seen += counts
+        eta = np.divide(counts, np.maximum(seen, 1.0))[:, None]
+        batch_mean = sums / np.maximum(counts, 1.0)[:, None]
+        new_centroids = np.where(
+            counts[:, None] > 0,
+            (1.0 - eta) * centroids + eta * batch_mean, centroids)
+
+        if not np.all(np.isfinite(new_centroids)):
+            raise ValueError(
+                f"NaN or Inf detected in centroids at iteration "
+                f"{iteration + 1}")
+        if self.compute_sse:
+            sse = float(stats.sse) * sse_scale   # scaled batch estimate
+            self.sse_history.append(sse)
+
+        max_shift = float(np.max(np.linalg.norm(
+            new_centroids - centroids, axis=1)))
+        log.iteration(iteration, max_shift, counts.astype(np.int64),
+                      self.sse_history[-1] if
+                      (self.compute_sse and self.sse_history) else None)
+
+        self.centroids = new_centroids.astype(self.dtype)
+        self.cluster_sizes_ = counts.astype(np.int64)
+        self.iterations_run = iteration + 1
+        self._seen = seen.copy()
+        return new_centroids, seen, max_shift
+
+    def partial_fit(self, X, *, sample_weight=None) -> "MiniBatchKMeans":
+        """One incremental update from a caller-provided batch (sklearn's
+        streaming API — beyond the reference, which has no incremental
+        path).  First call initializes centroids from the batch; subsequent
+        calls keep refining with lifetime per-center counts."""
+        if sample_weight is not None:
+            raise ValueError("partial_fit does not support sample_weight; "
+                             "fold weights into batch construction")
+        X = np.ascontiguousarray(np.asarray(X, dtype=self.dtype))
+        if X.ndim != 2:
+            raise ValueError(f"X must be 2-D (n, D), got shape {X.shape}")
+        import jax
+        log = IterationLogger(self.verbose and jax.process_index() == 0)
+        if self.centroids is None:
+            centroids = resolve_init(
+                self.init, X, self.k, self.seed).astype(np.float64)
+            self.sse_history = []
+            self.iterations_run = 0
+            self._seen = np.zeros(self.k)
+        else:
+            centroids = np.asarray(self.centroids, dtype=np.float64)
+            if X.shape[1] != centroids.shape[1]:
+                raise ValueError(
+                    f"X has {X.shape[1]} features, but model was fitted "
+                    f"with {centroids.shape[1]}")
+        seen = np.asarray(self._seen, dtype=np.float64)
+        self._incremental_update(X, centroids, seen,
+                                 self.iterations_run, log)
+        # labels for THIS batch under the updated centroids (sklearn
+        # semantics: partial_fit leaves labels_ of the last batch).
+        self._fit_ds, self._labels_cache = X, None
         return self
 
     def _state_dict(self) -> dict:

@@ -95,11 +95,16 @@ def pairwise_sq_dists(x: jax.Array, centroids: jax.Array,
     return jnp.maximum(x2 + c2 - 2.0 * xc, 0.0)
 
 
-def assign_chunk(x: jax.Array, centroids: jax.Array, mode: str = "matmul"):
-    """Nearest centroid per point: (labels int32 (n,), min sq-dist (n,))."""
+def assign_chunk(x: jax.Array, centroids: jax.Array, mode: str = "matmul",
+                 need_min: bool = True):
+    """Nearest centroid per point: (labels int32 (n,), min sq-dist (n,)).
+
+    ``need_min=False`` skips the min-distance reduction (None returned) —
+    the analogue of the reference's ``compute_sse=False`` fast path
+    (kmeans_spark.py:34: SSE off avoids extra work per iteration)."""
     d2 = pairwise_sq_dists(x, centroids, mode=mode)
     best = jnp.argmin(d2, axis=1).astype(jnp.int32)   # lowest index on ties
-    mind2 = jnp.min(d2, axis=1)
+    mind2 = jnp.min(d2, axis=1) if need_min else None
     return best, mind2
 
 
@@ -129,7 +134,9 @@ def init_stats(k: int, d: int, acc) -> StepStats:
 
 def accumulate_chunk(carry: StepStats, xc: jax.Array, wc: jax.Array,
                      centroids: jax.Array, *, mode: str = "matmul",
-                     select_fn=None) -> StepStats:
+                     select_fn=None, need_sse: bool = True,
+                     need_farthest: bool = True,
+                     need_sse_pc: bool = True) -> StepStats:
     """Fold one (chunk, D) tile of points into the running StepStats.
 
     The single shared accumulation body for BOTH the single-device kernel
@@ -142,10 +149,18 @@ def accumulate_chunk(carry: StepStats, xc: jax.Array, wc: jax.Array,
     ``select_fn(best_local, mind2_local) -> (mine_mask, mind2_global)`` is
     the hook the centroid-sharded (model-axis) path uses to reconstruct the
     global argmin across shards; None means this device owns every centroid.
+
+    The ``need_*`` flags skip the optional statistics' VPU work entirely
+    (the corresponding StepStats fields stay at their init values) — the
+    TPU analogue of the reference's ``compute_sse=False`` fast path
+    (kmeans_spark.py:34).  With all three off and no select_fn, even the
+    min-distance reduction over the (chunk, k) tile is elided.
     """
     acc = carry.sums.dtype
     k = centroids.shape[0]
-    best, mind2 = assign_chunk(xc, centroids, mode=mode)
+    need_min = (need_sse or need_farthest or need_sse_pc
+                or select_fn is not None)
+    best, mind2 = assign_chunk(xc, centroids, mode=mode, need_min=need_min)
     if select_fn is None:
         mine = jnp.ones_like(wc)
         mind2_g = mind2
@@ -161,21 +176,23 @@ def accumulate_chunk(carry: StepStats, xc: jax.Array, wc: jax.Array,
         onehot.astype(mm), xc.astype(mm), (((0,), (0,)), ((), ())),
         preferred_element_type=acc)                        # (k, D) on the MXU
     counts = carry.counts + jnp.sum(onehot, axis=0)
-    sse = carry.sse + jnp.sum(mind2_g * wc)
+    sse = carry.sse + jnp.sum(mind2_g * wc) if need_sse else carry.sse
     # Per-cluster SSE: the same one-hot (already weight- and ownership-
     # scaled) contracted against the min distances — a (k, c) matvec, ~free
     # next to the two matmuls above.  Feeds BisectingKMeans' split criterion.
     sse_pc = carry.sse_per_cluster + jnp.einsum(
-        "ck,c->k", onehot, mind2_g.astype(acc))
-    masked = jnp.where(wc > 0, mind2_g, -jnp.inf)
-    i = jnp.argmax(masked)
-    far_d, far_p = masked[i], xc[i].astype(acc)
-    better = far_d > carry.farthest_dist
-    return StepStats(
-        sums, counts, sse,
-        jnp.where(better, far_d, carry.farthest_dist),
-        jnp.where(better, far_p, carry.farthest_point),
-        sse_pc)
+        "ck,c->k", onehot, mind2_g.astype(acc)) if need_sse_pc \
+        else carry.sse_per_cluster
+    if need_farthest:
+        masked = jnp.where(wc > 0, mind2_g, -jnp.inf)
+        i = jnp.argmax(masked)
+        far_d, far_p = masked[i], xc[i].astype(acc)
+        better = far_d > carry.farthest_dist
+        far_d = jnp.where(better, far_d, carry.farthest_dist)
+        far_p = jnp.where(better, far_p, carry.farthest_point)
+    else:
+        far_d, far_p = carry.farthest_dist, carry.farthest_point
+    return StepStats(sums, counts, sse, far_d, far_p, sse_pc)
 
 
 @functools.partial(jax.jit, static_argnames=("chunk_size", "mode"))

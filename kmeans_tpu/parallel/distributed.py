@@ -98,12 +98,14 @@ def _pallas_local_stats(points, weights, centroids_block, *, mode: str):
 
 
 def _local_stats(points, weights, centroids_block, *, chunk_size, mode,
-                 model_shards: int):
+                 model_shards: int, need_sse: bool = True,
+                 need_farthest: bool = True, need_sse_pc: bool = True):
     """Per-(data,model)-shard pass: scan chunks via the shared
     ``accumulate_chunk`` body (or one fused Pallas kernel for the 'pallas'
     modes).  Returned ``sums``/``counts`` cover only this shard's centroid
     block (embedded later); ``sse``/farthest use the GLOBAL min distance
-    reconstructed across the model axis."""
+    reconstructed across the model axis.  The ``need_*`` flags elide the
+    optional statistics' compute (see ``accumulate_chunk``)."""
     if mode in PALLAS_MODES:
         if model_shards > 1:
             raise ValueError("pallas modes do not support centroid (model-"
@@ -120,7 +122,9 @@ def _local_stats(points, weights, centroids_block, *, chunk_size, mode,
     def body(carry, chunk):
         xc, wc = chunk
         return accumulate_chunk(carry, xc, wc, centroids_block, mode=mode,
-                                select_fn=select), None
+                                select_fn=select, need_sse=need_sse,
+                                need_farthest=need_farthest,
+                                need_sse_pc=need_sse_pc), None
 
     stats, _ = lax.scan(body, init_stats(k_local, d, acc), xs)
     return stats
@@ -213,6 +217,12 @@ def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
             f"on-device loop supports empty_cluster 'keep' or 'farthest', "
             f"got {empty_policy!r} (use the host loop for 'resample')")
     data_shards, model_shards = mesh_shape(mesh)
+    # Elide unneeded per-iteration statistics (the reference's own
+    # compute_sse speed/observability trade, kmeans_spark.py:34): skipping
+    # the SSE/min-distance reductions and farthest tracking saves real VPU
+    # time per (chunk, k) tile when the caller doesn't consume them.
+    need_sse = bool(history_sse)
+    need_farthest = (empty_policy == "farthest")
 
     def fit(points, weights, centroids_block):
         k_local, d = centroids_block.shape
@@ -224,7 +234,9 @@ def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
         def global_stats(cents_block):
             st = _local_stats(points, weights, cents_block,
                               chunk_size=chunk_size, mode=mode,
-                              model_shards=model_shards)
+                              model_shards=model_shards, need_sse=need_sse,
+                              need_farthest=need_farthest,
+                              need_sse_pc=False)
             off = jnp.asarray(m_idx * k_local, jnp.int32)
             sums = lax.psum(lax.dynamic_update_slice(
                 jnp.zeros((k_pad, d), acc), st.sums, (off, jnp.int32(0))),
@@ -232,13 +244,18 @@ def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
             counts = lax.psum(lax.dynamic_update_slice(
                 jnp.zeros((k_pad,), acc), st.counts, (off,)),
                 (DATA_AXIS, MODEL_AXIS))
-            sse = lax.psum(st.sse, (DATA_AXIS, MODEL_AXIS)) / model_shards
-            far_ds = lax.all_gather(st.farthest_dist,
-                                    (DATA_AXIS, MODEL_AXIS))
-            far_ps = lax.all_gather(st.farthest_point,
-                                    (DATA_AXIS, MODEL_AXIS))
-            j = jnp.argmax(far_ds)
-            return sums, counts, sse, far_ps[j]
+            sse = (lax.psum(st.sse, (DATA_AXIS, MODEL_AXIS)) / model_shards
+                   if need_sse else st.sse)
+            if need_farthest:
+                far_ds = lax.all_gather(st.farthest_dist,
+                                        (DATA_AXIS, MODEL_AXIS))
+                far_ps = lax.all_gather(st.farthest_point,
+                                        (DATA_AXIS, MODEL_AXIS))
+                j = jnp.argmax(far_ds)
+                far_p = far_ps[j]
+            else:
+                far_p = st.farthest_point
+            return sums, counts, sse, far_p
 
         def body(state):
             i, cents_full, _, sse_hist, shift_hist, _ = state
@@ -285,7 +302,8 @@ def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
 
 def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                       k_real: int, max_iter: int, tolerance: float,
-                      empty_policy: str = "keep", n_init: int):
+                      empty_policy: str = "keep", n_init: int,
+                      history_sse: bool = True):
     """Build a BATCHED on-device training loop: ``n_init`` independent
     restarts run in ONE dispatch, vmapped over the restart axis.
 
@@ -328,28 +346,36 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
         acc = _accum_dtype(points.dtype)
         R, k, d = cents0.shape
 
-        def local(c):
-            return _local_stats(points, weights, c, chunk_size=chunk_size,
-                                mode=mode, model_shards=1)
+        need_farthest = (empty_policy == "farthest")
 
-        def all_stats(cents):
+        def all_stats(cents, need_sse):
             """Global per-restart stats: vmap the shard-local pass over R
             (no collectives inside the vmap), then psum the stacked
-            accumulators over the data axis."""
+            accumulators over the data axis.  Optional statistics are
+            elided per the need flags (see ``accumulate_chunk``)."""
+            def local(c):
+                return _local_stats(points, weights, c,
+                                    chunk_size=chunk_size, mode=mode,
+                                    model_shards=1, need_sse=need_sse,
+                                    need_farthest=need_farthest,
+                                    need_sse_pc=False)
             st = jax.vmap(local)(cents)
             sums = lax.psum(st.sums, DATA_AXIS)            # (R, k, d)
             counts = lax.psum(st.counts, DATA_AXIS)        # (R, k)
-            sse = lax.psum(st.sse, DATA_AXIS)              # (R,)
-            far_ds = lax.all_gather(st.farthest_dist, DATA_AXIS)   # (s, R)
-            far_ps = lax.all_gather(st.farthest_point, DATA_AXIS)  # (s, R, d)
-            owner = jnp.argmax(far_ds, axis=0)             # (R,)
-            far_p = jnp.take_along_axis(
-                far_ps, owner[None, :, None], axis=0)[0]   # (R, d)
+            sse = lax.psum(st.sse, DATA_AXIS) if need_sse else st.sse
+            if need_farthest:
+                far_ds = lax.all_gather(st.farthest_dist, DATA_AXIS)
+                far_ps = lax.all_gather(st.farthest_point, DATA_AXIS)
+                owner = jnp.argmax(far_ds, axis=0)         # (R,)
+                far_p = jnp.take_along_axis(
+                    far_ps, owner[None, :, None], axis=0)[0]   # (R, d)
+            else:
+                far_p = st.farthest_point
             return sums, counts, sse, far_p
 
         def body(state):
             i, cents, done, n_iters, sse_hist, shift_hist, counts_out = state
-            sums, counts, sse, far_p = all_stats(cents)
+            sums, counts, sse, far_p = all_stats(cents, history_sse)
             mean = sums / jnp.maximum(counts, 1.0)[..., None]
             new = jnp.where((counts > 0)[..., None], mean.astype(acc), cents)
             if empty_policy == "farthest":
@@ -383,8 +409,9 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
         _, cents, _, n_iters, sse_hist, shift_hist, counts_out = \
             lax.while_loop(cond, body, state)
 
-        # Selection pass: true final inertia of each restart's centroids.
-        _, _, final_sse, _ = all_stats(cents)
+        # Selection pass: true final inertia of each restart's centroids
+        # (SSE always computed here — it IS the selection criterion).
+        _, _, final_sse, _ = all_stats(cents, True)
         best = jnp.argmin(final_sse)
         return (cents[best, :k_real], n_iters[best], sse_hist[best],
                 shift_hist[best], counts_out[best, :k_real], best, final_sse)

@@ -205,3 +205,47 @@ def test_fit_predict_reuses_eager_labels():
     labels = km.fit_predict(X)
     assert labels is km._labels_cache             # no second pass
     np.testing.assert_array_equal(labels, km.predict(X))
+
+
+def test_partial_fit_streaming():
+    rng = np.random.default_rng(9)
+    centers = np.array([[0.0, 0.0], [8.0, 8.0], [-8.0, 8.0]], np.float32)
+    mb = MiniBatchKMeans(k=3, seed=0, verbose=False)
+    for i in range(20):
+        batch = (centers[rng.integers(0, 3, 256)]
+                 + rng.normal(size=(256, 2)).astype(np.float32))
+        mb.partial_fit(batch)
+    assert mb.iterations_run == 20
+    assert np.all(np.isfinite(mb.centroids))
+    # Each true center has a fitted centroid nearby.
+    d = np.linalg.norm(mb.centroids[None] - centers[:, None], axis=2)
+    assert d.min(axis=1).max() < 1.0
+    assert mb.labels_.shape == (256,)        # labels of the LAST batch
+    np.testing.assert_array_equal(mb.labels_, mb.predict(batch))
+
+
+def test_partial_fit_first_call_initializes():
+    rng = np.random.default_rng(10)
+    X = rng.normal(size=(300, 5)).astype(np.float32)
+    mb = MiniBatchKMeans(k=4, seed=1, verbose=False).partial_fit(X)
+    assert mb.centroids.shape == (4, 5)
+    assert mb.iterations_run == 1
+    with pytest.raises(ValueError, match="2-D"):
+        mb.partial_fit(X[0])
+
+
+def test_partial_fit_feature_mismatch_raises():
+    mb = MiniBatchKMeans(k=2, seed=0, verbose=False)
+    mb.partial_fit(np.zeros((50, 4), np.float32) +
+                   np.arange(50, dtype=np.float32)[:, None])
+    with pytest.raises(ValueError, match="4"):
+        mb.partial_fit(np.zeros((50, 6), np.float32))
+
+
+def test_pickle_after_partial_fit_keeps_labels():
+    import pickle
+    rng = np.random.default_rng(11)
+    batch = rng.normal(size=(200, 3)).astype(np.float32)
+    mb = MiniBatchKMeans(k=3, seed=0, verbose=False).partial_fit(batch)
+    mb2 = pickle.loads(pickle.dumps(mb))
+    np.testing.assert_array_equal(mb2.labels_, mb.predict(batch))
