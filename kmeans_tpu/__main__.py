@@ -18,7 +18,10 @@ def main() -> int:
     if cmd == "bench":
         from kmeans_tpu.benchmarks import main as bench_main
         return bench_main(rest)
-    print(f"unknown command {cmd!r}; available: suite, bench",
+    if cmd == "fit":
+        from kmeans_tpu.cli import main as fit_main
+        return fit_main(rest)
+    print(f"unknown command {cmd!r}; available: suite, bench, fit",
           file=sys.stderr)
     return 2
 

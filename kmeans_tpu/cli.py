@@ -1,0 +1,106 @@
+"""``python -m kmeans_tpu fit`` — cluster an on-disk matrix from the shell.
+
+The reference has no CLI at all (SURVEY.md §1: its ``__main__`` takes no
+arguments); this is a superset utility: point it at a ``.npy`` (or ``.npz``
+key) of shape (n, D), get centroids/labels/summary artifacts back.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+_MODELS = ("kmeans", "minibatch", "bisecting", "spherical")
+
+
+def _load_matrix(path: str, npz_key: str) -> np.ndarray:
+    p = Path(path)
+    if not p.exists():
+        raise FileNotFoundError(f"no such file: {p}")
+    if p.suffix == ".npz":
+        with np.load(p) as z:
+            key = npz_key or list(z.keys())[0]
+            return np.asarray(z[key])
+    return np.load(p)
+
+
+def _build_model(args):
+    from kmeans_tpu import (BisectingKMeans, KMeans, MiniBatchKMeans,
+                            SphericalKMeans)
+    common = dict(k=args.k, max_iter=args.max_iter, tolerance=args.tolerance,
+                  seed=args.seed, compute_sse=args.sse, init=args.init,
+                  n_init=args.n_init, verbose=not args.quiet)
+    if args.model == "minibatch":
+        # MiniBatchKMeans rejects n_init > 1 itself (clear error).
+        return MiniBatchKMeans(batch_size=args.batch_size, **common)
+    if args.model == "bisecting":
+        return BisectingKMeans(**common)      # n_init applies per split
+    if args.model == "spherical":
+        return SphericalKMeans(**common)
+    return KMeans(**common)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kmeans_tpu fit",
+        description="Cluster an (n, D) .npy/.npz matrix on TPU/CPU devices")
+    parser.add_argument("data", help="path to .npy or .npz with (n, D) floats")
+    parser.add_argument("--npz-key", default="",
+                        help=".npz array name (default: first key)")
+    parser.add_argument("--k", type=int, required=True)
+    parser.add_argument("--model", choices=_MODELS, default="kmeans")
+    parser.add_argument("--max-iter", type=int, default=100)
+    parser.add_argument("--tolerance", type=float, default=1e-4)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--init", default="forgy",
+                        help="forgy | kmeans++ | kmeans|| (default forgy)")
+    parser.add_argument("--n-init", type=int, default=1)
+    parser.add_argument("--batch-size", type=int, default=4096,
+                        help="minibatch model only")
+    parser.add_argument("--sse", action="store_true",
+                        help="track SSE per iteration")
+    parser.add_argument("--out-dir", default=".",
+                        help="where centroids.npy/labels.npy/summary.json go")
+    parser.add_argument("--no-labels", action="store_true",
+                        help="skip writing per-point labels")
+    parser.add_argument("--quiet", action="store_true")
+    args = parser.parse_args(argv)
+
+    X = _load_matrix(args.data, args.npz_key)
+    if X.ndim != 2:
+        print(f"error: expected (n, D) matrix, got shape {X.shape}",
+              file=sys.stderr)
+        return 2
+    model = _build_model(args)
+
+    start = time.perf_counter()
+    model.fit(np.asarray(X, dtype=np.float32))
+    elapsed = time.perf_counter() - start
+
+    out = Path(args.out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    np.save(out / "centroids.npy", model.centroids)
+    if not args.no_labels:
+        np.save(out / "labels.npy", model.labels_)
+    summary = {
+        "model": args.model, "n": int(X.shape[0]), "d": int(X.shape[1]),
+        "k": args.k, "iterations": model.iterations_run,
+        "fit_seconds": round(elapsed, 3),
+        "inertia": model.inertia_,
+        "sse_history": [float(s) for s in model.sse_history],
+        "cluster_sizes": [int(c) for c in model.cluster_sizes_]
+        if model.cluster_sizes_ is not None else None,
+    }
+    (out / "summary.json").write_text(json.dumps(summary, indent=2))
+    if not args.quiet:
+        print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

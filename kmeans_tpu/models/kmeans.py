@@ -250,8 +250,12 @@ class KMeans:
             self._fit(X, sample_weight=sample_weight, resume=resume)
         # Materialize labels_ eagerly (sklearn semantics) — one extra fused
         # assignment pass, after which the device-resident dataset reference
-        # is released so fit() never leaves HBM pinned.
-        if self._eager_labels:
+        # is released so fit() never leaves HBM pinned.  Multi-host
+        # process-local datasets are skipped: their labels span
+        # non-addressable devices (predict each host's local rows instead).
+        addressable = not isinstance(self._fit_ds, ShardedDataset) or \
+            self._fit_ds.points.is_fully_addressable
+        if self._eager_labels and addressable:
             _ = self.labels_
         else:
             self._fit_ds = None
@@ -566,6 +570,13 @@ class KMeans:
         """
         if self.centroids is None:
             raise ValueError("Model must be fitted before prediction")
+        if isinstance(X, ShardedDataset) and \
+                not X.points.is_fully_addressable:
+            raise ValueError(
+                "predict on a multi-host process-local dataset is not "
+                "supported (labels would span non-addressable devices and "
+                "per-process padding is interleaved); call predict on each "
+                "process's local rows instead")
         ds, mesh, model_shards, _, predict_fn = self._prepare(X)
         cents_dev = self._put_centroids(
             np.asarray(self.centroids), mesh, model_shards)

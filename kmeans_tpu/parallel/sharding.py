@@ -76,6 +76,19 @@ def shard_points(x: np.ndarray, mesh: Optional[Mesh], chunk_size: int,
     return (jax.device_put(x_pad, xsh), jax.device_put(w_pad, wsh))
 
 
+
+def _validate_sample_weight(sample_weight, n: int, dtype) -> np.ndarray:
+    """Shared validation for every weight entry point: shape (n,), finite,
+    non-negative; cast to the dataset dtype."""
+    sw = np.asarray(sample_weight, dtype=dtype)
+    if sw.shape != (n,):
+        raise ValueError(
+            f"sample_weight must have shape ({n},), got {sw.shape}")
+    if np.any(sw < 0) or not np.all(np.isfinite(sw)):
+        raise ValueError("sample_weight must be finite and >= 0")
+    return sw
+
+
 class ShardedDataset:
     """Device-resident, mesh-sharded points — the ``rdd.cache()`` analogue.
 
@@ -124,10 +137,20 @@ class ShardedDataset:
             return np.arange(self.n)
         return np.flatnonzero(self._host_weights > 0)
 
+    def _require_addressable(self, op: str) -> None:
+        if not self.points.is_fully_addressable:
+            raise ValueError(
+                f"{op} needs a host copy or a fully-addressable array; on "
+                "multi-host process-local datasets use init='kmeans++' "
+                "(on-device D2 seeding) or an explicit init array, and "
+                "empty_cluster='keep' or 'farthest' (host 'resample' "
+                "cannot gather rows)")
+
     def take(self, idx) -> np.ndarray:
         """Gather rows by global index (all indices must be < n)."""
         if self._host is not None:
             return np.asarray(self._host[idx])
+        self._require_addressable("row gather")
         return np.asarray(self.points[np.asarray(idx)])
 
     def with_weights(self, sample_weight: np.ndarray) -> "ShardedDataset":
@@ -140,12 +163,8 @@ class ShardedDataset:
         re-shard.  ``sample_weight`` is absolute (it replaces, not scales,
         the current weights); padding rows stay 0.
         """
-        sw = np.asarray(sample_weight, dtype=self.dtype)
-        if sw.shape != (self.n,):
-            raise ValueError(
-                f"sample_weight must have shape ({self.n},), got {sw.shape}")
-        if np.any(sw < 0) or not np.all(np.isfinite(sw)):
-            raise ValueError("sample_weight must be finite and >= 0")
+        self._require_addressable("with_weights")
+        sw = _validate_sample_weight(sample_weight, self.n, self.dtype)
         w_pad = np.zeros(self.points.shape[0], dtype=self.dtype)
         w_pad[: self.n] = sw
         if self.mesh is None:
@@ -161,6 +180,8 @@ class ShardedDataset:
         """Re-place the data on a different mesh / chunking — the
         ``rdd.repartition`` analogue (kmeans_spark.py:418).  Goes through
         the host copy when available, else gathers from device."""
+        if self._host is None:
+            self._require_addressable("reshard")
         host = self._host if self._host is not None else \
             np.asarray(self.points)[: self.n]
         return to_device(host, mesh, chunk or self.chunk, self.dtype,
@@ -191,12 +212,7 @@ def to_device(X, mesh: Optional[Mesh], chunk: int, dtype,
         raise ValueError(f"X must be 2-D (n, D), got shape {X.shape}")
     sw = None
     if sample_weight is not None:
-        sw = np.asarray(sample_weight, dtype=X.dtype)
-        if sw.shape != (X.shape[0],):
-            raise ValueError(f"sample_weight must have shape "
-                             f"({X.shape[0]},), got {sw.shape}")
-        if np.any(sw < 0) or not np.all(np.isfinite(sw)):
-            raise ValueError("sample_weight must be finite and >= 0")
+        sw = _validate_sample_weight(sample_weight, X.shape[0], X.dtype)
     points, weights = shard_points(X, mesh, chunk, sample_weight=sw)
     return ShardedDataset(points, weights, X.shape[0], chunk, mesh, host=X,
                           host_weights=sw)
@@ -217,3 +233,86 @@ def global_sample_rows(x_source: np.ndarray, n_rows: int, k: int,
     rng = np.random.RandomState(seed)
     idx = rng.choice(n_rows, size=k, replace=False)
     return np.asarray(x_source)[idx]
+
+
+def process_local_layout(local_counts, local_shards: int,
+                         chunk: int) -> Tuple[int, int]:
+    """Padded per-process row layout for host-sharded loading.
+
+    Every process must contribute an identically-shaped block (XLA global
+    arrays are uniform), so each pads to the LARGEST process's share,
+    rounded up so every data shard holds a whole number of scan chunks.
+    Returns (rows_per_shard, rows_per_process).
+    """
+    max_local = int(np.max(np.asarray(local_counts)))
+    rows_per_shard = -(-max_local // local_shards)          # ceil
+    rows_per_shard = -(-rows_per_shard // chunk) * chunk    # chunk multiple
+    rows_per_shard = max(rows_per_shard, chunk)
+    return rows_per_shard, rows_per_shard * local_shards
+
+
+def from_process_local(X_local, mesh: Mesh, *,
+                       chunk_size: Optional[int] = None,
+                       dtype=np.float32, k_hint: int = 16,
+                       sample_weight: Optional[np.ndarray] = None
+                       ) -> ShardedDataset:
+    """Build a globally-sharded dataset where EACH PROCESS contributes only
+    its own rows — no host ever materializes the full array.
+
+    This is the multi-host data path the reference delegates to Spark's
+    driver-side ``parallelize`` (kmeans_spark.py:369/418: the driver holds
+    all N rows); here each host loads its share and
+    ``jax.make_array_from_process_local_data`` assembles the global
+    data-axis-sharded array, with per-process padding carried as
+    zero-weight rows (invisible to every statistic).
+
+    Single-process: exact equivalent of ``to_device`` (host copy kept).
+    Multi-host notes: the result has no host copy, so use
+    ``init='kmeans++'`` (on-device D² seeding) or an explicit init array —
+    Forgy row-gather needs host data and raises a pointed error; run
+    ``predict`` on each process's local rows rather than on this dataset.
+    """
+    if mesh is None:
+        raise ValueError("from_process_local requires a mesh")
+    X_local = np.ascontiguousarray(np.asarray(X_local, dtype=dtype))
+    if X_local.ndim != 2:
+        raise ValueError(f"X_local must be 2-D (n, D), got {X_local.shape}")
+    n_local, d = X_local.shape
+    data_shards, _ = mesh_shape(mesh)
+    if jax.process_count() == 1:
+        chunk = chunk_size or choose_chunk_size(
+            -(-n_local // max(1, data_shards)), k_hint, d)
+        return to_device(X_local, mesh, chunk, dtype,
+                         sample_weight=sample_weight)
+
+    from jax.experimental import multihost_utils
+    nproc = jax.process_count()
+    if data_shards % nproc:
+        raise ValueError(
+            f"data axis ({data_shards}) must be divisible by the process "
+            f"count ({nproc}) for process-local loading")
+    counts = np.asarray(multihost_utils.process_allgather(
+        np.asarray([n_local], dtype=np.int64))).reshape(-1)
+    n_global = int(counts.sum())
+    # Chunk from the allgathered MAX count — every process must compute the
+    # identical chunk (and therefore identical global shape and identical
+    # jitted program); deriving it from n_local would diverge on uneven
+    # shards.
+    local_shards = data_shards // nproc
+    chunk = chunk_size or choose_chunk_size(
+        -(-int(counts.max()) // local_shards), k_hint, d)
+    _, rows_per_proc = process_local_layout(counts, local_shards, chunk)
+    x_pad = np.zeros((rows_per_proc, d), dtype=X_local.dtype)
+    x_pad[:n_local] = X_local
+    w_pad = np.zeros((rows_per_proc,), dtype=X_local.dtype)
+    if sample_weight is not None:
+        w_pad[:n_local] = _validate_sample_weight(sample_weight, n_local,
+                                                  X_local.dtype)
+    else:
+        w_pad[:n_local] = 1.0
+    n_pad_global = rows_per_proc * nproc
+    pts = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(DATA_AXIS, None)), x_pad, (n_pad_global, d))
+    w = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(DATA_AXIS)), w_pad, (n_pad_global,))
+    return ShardedDataset(pts, w, n_global, chunk, mesh)

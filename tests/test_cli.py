@@ -1,0 +1,62 @@
+"""The ``python -m kmeans_tpu fit`` CLI."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kmeans_tpu.cli import main as cli_main
+
+
+@pytest.fixture()
+def data_file(tmp_path):
+    rng = np.random.default_rng(0)
+    centers = rng.normal(scale=8, size=(4, 6)).astype(np.float32)
+    X = (centers[rng.integers(0, 4, 2000)]
+         + rng.normal(size=(2000, 6)).astype(np.float32))
+    path = tmp_path / "points.npy"
+    np.save(path, X)
+    return path
+
+
+def test_fit_cli_kmeans(data_file, tmp_path):
+    out = tmp_path / "out"
+    rc = cli_main([str(data_file), "--k", "4", "--sse", "--quiet",
+                   "--out-dir", str(out)])
+    assert rc == 0
+    centroids = np.load(out / "centroids.npy")
+    labels = np.load(out / "labels.npy")
+    summary = json.loads((out / "summary.json").read_text())
+    assert centroids.shape == (4, 6)
+    assert labels.shape == (2000,) and labels.max() < 4
+    assert summary["iterations"] >= 1
+    assert summary["sse_history"] == sorted(summary["sse_history"],
+                                            reverse=True)
+
+
+@pytest.mark.parametrize("model", ["minibatch", "bisecting", "spherical"])
+def test_fit_cli_model_families(data_file, tmp_path, model):
+    out = tmp_path / model
+    rc = cli_main([str(data_file), "--k", "3", "--model", model, "--quiet",
+                   "--out-dir", str(out), "--max-iter", "10"])
+    assert rc == 0
+    assert np.load(out / "centroids.npy").shape == (3, 6)
+
+
+def test_fit_cli_bad_shape(tmp_path):
+    path = tmp_path / "bad.npy"
+    np.save(path, np.zeros(7, np.float32))
+    assert cli_main([str(path), "--k", "2", "--quiet"]) == 2
+
+
+def test_fit_cli_npz(data_file, tmp_path):
+    X = np.load(data_file)
+    npz = tmp_path / "data.npz"
+    np.savez(npz, features=X)
+    out = tmp_path / "npz_out"
+    rc = cli_main([str(npz), "--npz-key", "features", "--k", "2", "--quiet",
+                   "--out-dir", str(out), "--max-iter", "5"])
+    assert rc == 0

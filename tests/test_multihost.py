@@ -1,0 +1,79 @@
+"""Process-local (multi-host) data loading.
+
+True multi-process runs need a coordinator; here we test the single-process
+equivalence contract, the padded layout math, and the multi-host error
+guidance paths.
+"""
+
+import numpy as np
+import pytest
+
+from kmeans_tpu import KMeans, make_mesh
+from kmeans_tpu.parallel.multihost import initialize, is_primary
+from kmeans_tpu.parallel.sharding import (from_process_local,
+                                          process_local_layout)
+
+
+def test_layout_math():
+    # 3 processes with uneven rows, 2 local shards, chunk 8:
+    # max=21 -> ceil(21/2)=11 -> chunk-rounded 16 -> 32 rows/process.
+    rows_per_shard, rows_per_proc = process_local_layout([21, 5, 13], 2, 8)
+    assert rows_per_shard == 16 and rows_per_proc == 32
+    # Degenerate: empty process still gets one chunk per shard.
+    assert process_local_layout([0], 4, 8) == (8, 32)
+
+
+def test_single_process_equivalence(mesh8):
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1000, 8)).astype(np.float32)
+    ds = from_process_local(X, mesh8, k_hint=5)
+    assert ds.n == 1000 and ds.host is not None    # to_device passthrough
+    km = KMeans(k=5, seed=0, verbose=False, mesh=mesh8).fit(ds)
+    km_ref = KMeans(k=5, seed=0, verbose=False, mesh=mesh8).fit(X)
+    np.testing.assert_allclose(km.centroids, km_ref.centroids, atol=1e-5)
+
+
+def test_requires_mesh():
+    with pytest.raises(ValueError, match="requires a mesh"):
+        from_process_local(np.zeros((10, 2), np.float32), None)
+
+
+def test_initialize_noop_single_process():
+    initialize()                 # must not raise without a coordinator
+    assert is_primary()
+
+
+class _FakeNonAddressable:
+    """Minimal stand-in for a multi-host global array."""
+
+    def __init__(self, real):
+        self._real = real
+        self.is_fully_addressable = False
+
+    def __getattr__(self, name):
+        return getattr(self._real, name)
+
+
+def _make_nonaddressable_ds(mesh):
+    from kmeans_tpu.parallel.sharding import to_device
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(256, 4)).astype(np.float32)
+    ds = to_device(X, mesh, 32, np.float32)
+    ds._host = None
+    ds._host_weights = None
+    ds.points = _FakeNonAddressable(ds.points)
+    return ds, X
+
+
+def test_nonaddressable_guards(mesh8):
+    ds, X = _make_nonaddressable_ds(mesh8)
+    with pytest.raises(ValueError, match="row gather"):
+        ds.take([0, 1])
+    with pytest.raises(ValueError, match="with_weights"):
+        ds.with_weights(np.ones(ds.n, np.float32))
+    with pytest.raises(ValueError, match="reshard"):
+        ds.reshard(mesh8)
+    km = KMeans(k=2, seed=0, verbose=False, mesh=mesh8)
+    km.centroids = np.zeros((2, 4), np.float32)
+    with pytest.raises(ValueError, match="local rows"):
+        km.predict(ds)
