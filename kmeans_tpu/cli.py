@@ -24,7 +24,13 @@ def _load_matrix(path: str, npz_key: str) -> np.ndarray:
         raise FileNotFoundError(f"no such file: {p}")
     if p.suffix == ".npz":
         with np.load(p) as z:
-            key = npz_key or list(z.keys())[0]
+            keys = list(z.keys())
+            if not keys:
+                raise ValueError(f"{p} contains no arrays")
+            key = npz_key or keys[0]
+            if key not in keys:
+                raise KeyError(f"{p} has no array {key!r}; "
+                               f"available: {keys}")
             return np.asarray(z[key])
     return np.load(p)
 
@@ -71,16 +77,24 @@ def main(argv=None) -> int:
     parser.add_argument("--quiet", action="store_true")
     args = parser.parse_args(argv)
 
-    X = _load_matrix(args.data, args.npz_key)
+    try:
+        X = _load_matrix(args.data, args.npz_key)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     if X.ndim != 2:
         print(f"error: expected (n, D) matrix, got shape {X.shape}",
               file=sys.stderr)
         return 2
     model = _build_model(args)
 
+    X = np.asarray(X, dtype=np.float32)
     start = time.perf_counter()
-    model.fit(np.asarray(X, dtype=np.float32))
+    model.fit(X)
     elapsed = time.perf_counter() - start
+    # Real final inertia even without --sse (one fused pass).
+    inertia = model.inertia_ if model.inertia_ is not None \
+        else -model.score(X)
 
     out = Path(args.out_dir)
     out.mkdir(parents=True, exist_ok=True)
@@ -91,7 +105,7 @@ def main(argv=None) -> int:
         "model": args.model, "n": int(X.shape[0]), "d": int(X.shape[1]),
         "k": args.k, "iterations": model.iterations_run,
         "fit_seconds": round(elapsed, 3),
-        "inertia": model.inertia_,
+        "inertia": float(inertia),
         "sse_history": [float(s) for s in model.sse_history],
         "cluster_sizes": [int(c) for c in model.cluster_sizes_]
         if model.cluster_sizes_ is not None else None,

@@ -255,9 +255,15 @@ class KMeans:
         # non-addressable devices (predict each host's local rows instead).
         addressable = not isinstance(self._fit_ds, ShardedDataset) or \
             self._fit_ds.points.is_fully_addressable
+        self._labels_error = None
         if self._eager_labels and addressable:
             _ = self.labels_
         else:
+            if not addressable:
+                self._labels_error = (
+                    "labels_ is not available for a multi-host "
+                    "process-local fit (labels would span non-addressable "
+                    "devices); call predict on each process's local rows")
             self._fit_ds = None
         return self
 
@@ -303,6 +309,15 @@ class KMeans:
         log = IterationLogger(self.verbose and jax.process_index() == 0)
         X = self._apply_sample_weight(X, sample_weight)
         ds, mesh, model_shards, step_fn, _ = self._prepare(X)
+        if not ds.points.is_fully_addressable and \
+                self.empty_cluster == "resample":
+            # Fail FAST: 'resample' needs host row gathers that a
+            # process-local dataset cannot serve — otherwise the fit would
+            # crash only when (if) the first empty cluster appears.
+            raise ValueError(
+                "empty_cluster='resample' cannot gather rows from a "
+                "multi-host process-local dataset; use "
+                "empty_cluster='keep' or 'farthest'")
         self._fit_ds, self._labels_cache = ds, None   # feeds lazy labels_
         log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
         self.best_restart_ = 0
@@ -666,6 +681,8 @@ class KMeans:
         one fused assignment pass and then releases its dataset reference,
         so device memory is never pinned past the end of ``fit``."""
         if self._labels_cache is None:
+            if getattr(self, "_labels_error", None):
+                raise AttributeError(self._labels_error)
             if self.centroids is None or self._fit_ds is None:
                 raise AttributeError(
                     "labels_ is only available after fit()")

@@ -29,20 +29,49 @@ from typing import Optional
 import jax
 
 
+_CLUSTER_ENV_VARS = (
+    "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS", "SLURM_JOB_ID", "OMPI_COMM_WORLD_SIZE",
+    "CLOUD_TPU_TASK_ID", "TPU_WORKER_ID",
+)
+
+
+def _cluster_env_present() -> bool:
+    """True when the environment indicates this process belongs to a
+    multi-process cluster job (jax.distributed auto-detection sources)."""
+    import os
+    return any(os.environ.get(v) for v in _CLUSTER_ENV_VARS)
+
+
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None) -> None:
     """``jax.distributed.initialize`` wrapper; no-op if already initialized
     or running single-process (so the same script runs everywhere)."""
-    if jax.process_count() > 1:
+    # NOTE: probe via jax.distributed.is_initialized(), NOT
+    # jax.process_count() — the latter initializes the XLA backends, which
+    # would make the distributed handshake below impossible.
+    if jax.distributed.is_initialized():
         return                          # already initialized
+    explicit = any(a is not None for a in
+                   (coordinator_address, num_processes, process_id))
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id)
-    except (ValueError, RuntimeError):
-        # Single-process run (no coordinator env) — nothing to do.
-        pass
+    except RuntimeError:
+        # "must be called before any JAX computations" — backends already
+        # initialized.  If the caller passed explicit coordinates, or the
+        # environment says this is one process of a cluster job, swallowing
+        # would silently downgrade EVERY host to a wrong single-process
+        # fit — raise.  Otherwise this is a plain single-process program
+        # calling initialize() late, which is harmless.
+        if explicit or _cluster_env_present():
+            raise
+    except ValueError:
+        if explicit:
+            raise
+        # No coordinator configured anywhere: a plain single-process run.
 
 
 def is_primary() -> bool:

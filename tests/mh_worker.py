@@ -1,0 +1,77 @@
+"""Worker for the two-process multi-host integration test (not a test
+module itself — spawned by tests/test_multihost.py).
+
+Each process loads ONLY its own slice of the blob dataset, builds the
+global data-sharded array with ``from_process_local``, fits with a shared
+explicit init, and writes its view of the result for the parent to
+compare.  Also smoke-tests the on-device kmeans++ init (the documented
+multi-host seeding path).
+"""
+
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+
+proc_id = int(sys.argv[1])
+nproc = int(sys.argv[2])
+port = sys.argv[3]
+out_dir = Path(sys.argv[4])
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# Initialize the distributed runtime BEFORE anything touches jax backends
+# (package imports may call jax.devices(), which pins single-process mode).
+from kmeans_tpu.parallel.multihost import initialize, is_primary  # noqa: E402
+
+initialize(coordinator_address=f"127.0.0.1:{port}",
+           num_processes=nproc, process_id=proc_id)
+assert jax.process_count() == nproc
+
+from kmeans_tpu import KMeans  # noqa: E402
+from kmeans_tpu.parallel.mesh import make_mesh  # noqa: E402
+from kmeans_tpu.parallel.sharding import from_process_local  # noqa: E402
+
+# Deterministic global dataset; UNEVEN split across processes (exercises
+# the padded per-process layout).
+rng = np.random.default_rng(0)
+centers = np.array([[0, 0, 0, 0], [10, 10, 0, 0],
+                    [-10, 0, 10, 0], [0, -10, 0, 10]], np.float32)
+X = (centers[rng.integers(0, 4, 3000)]
+     + rng.normal(size=(3000, 4)).astype(np.float32))
+split = 1900                       # proc 0: 1900 rows, proc 1: 1100 rows
+X_local = X[:split] if proc_id == 0 else X[split:]
+init = X[rng.choice(3000, size=4, replace=False)]
+
+mesh = make_mesh()
+ds = from_process_local(X_local, mesh, k_hint=4)
+assert ds.n == 3000, ds.n
+
+km = KMeans(k=4, seed=0, init=init, empty_cluster="keep",
+            compute_sse=True, verbose=is_primary()).fit(ds)
+assert km._labels_cache is None    # eager labels skipped on multi-host
+try:
+    km.labels_
+    raise SystemExit("labels_ should raise on a process-local fit")
+except AttributeError as e:
+    assert "local rows" in str(e), e
+
+# The default 'resample' empty policy must be rejected up front.
+try:
+    KMeans(k=4, seed=0, init=init, verbose=False).fit(ds)
+    raise SystemExit("resample policy should be rejected")
+except ValueError as e:
+    assert "keep" in str(e), e
+
+# kmeans++ on-device seeding must also work with no host copy.
+km2 = KMeans(k=4, seed=0, init="kmeans++", empty_cluster="keep",
+             verbose=False).fit(ds)
+assert np.all(np.isfinite(km2.centroids))
+
+np.save(out_dir / f"centroids_{proc_id}.npy", km.centroids)
+np.save(out_dir / f"sse_{proc_id}.npy", np.asarray(km.sse_history))
+print(f"proc {proc_id}: OK iters={km.iterations_run}", flush=True)

@@ -60,3 +60,25 @@ def test_fit_cli_npz(data_file, tmp_path):
     rc = cli_main([str(npz), "--npz-key", "features", "--k", "2", "--quiet",
                    "--out-dir", str(out), "--max-iter", "5"])
     assert rc == 0
+
+
+def test_fit_cli_missing_file(tmp_path, capsys):
+    assert cli_main([str(tmp_path / "nope.npy"), "--k", "2",
+                     "--quiet"]) == 2
+    assert "error:" in capsys.readouterr().err
+
+
+def test_fit_cli_bad_npz_key(data_file, tmp_path, capsys):
+    npz = tmp_path / "d.npz"
+    np.savez(npz, a=np.load(data_file))
+    assert cli_main([str(npz), "--npz-key", "missing", "--k", "2",
+                     "--quiet"]) == 2
+    assert "available" in capsys.readouterr().err
+
+
+def test_fit_cli_inertia_without_sse(data_file, tmp_path):
+    out = tmp_path / "nosse"
+    assert cli_main([str(data_file), "--k", "4", "--quiet",
+                     "--out-dir", str(out)]) == 0
+    summary = json.loads((out / "summary.json").read_text())
+    assert summary["inertia"] is not None and summary["inertia"] > 0

@@ -77,3 +77,61 @@ def test_nonaddressable_guards(mesh8):
     km.centroids = np.zeros((2, 4), np.float32)
     with pytest.raises(ValueError, match="local rows"):
         km.predict(ds)
+
+
+def _free_port():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_fit_matches_single_process(tmp_path):
+    """REAL multi-process run: 2 jax.distributed processes (Gloo collectives
+    over CPU devices), uneven per-process rows, from_process_local +
+    explicit init.  Both processes must agree exactly with each other and
+    match a single-process fit of the same data within fp tolerance."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).parent.parent
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = f"{repo}:{env.get('PYTHONPATH', '')}"
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [subprocess.Popen(
+        [sys.executable, str(repo / "tests" / "mh_worker.py"),
+         str(i), "2", str(port), str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for i in range(2)]
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-3000:]
+
+    c0 = np.load(tmp_path / "centroids_0.npy")
+    c1 = np.load(tmp_path / "centroids_1.npy")
+    np.testing.assert_array_equal(c0, c1)     # replicated stats -> identical
+
+    # Single-process reference on the concatenated data, same init.
+    rng = np.random.default_rng(0)
+    centers = np.array([[0, 0, 0, 0], [10, 10, 0, 0],
+                        [-10, 0, 10, 0], [0, -10, 0, 10]], np.float32)
+    X = (centers[rng.integers(0, 4, 3000)]
+         + rng.normal(size=(3000, 4)).astype(np.float32))
+    init = X[rng.choice(3000, size=4, replace=False)]
+    km = KMeans(k=4, seed=0, init=init, empty_cluster="keep",
+                compute_sse=True, verbose=False).fit(X)
+    np.testing.assert_allclose(c0, km.centroids, atol=1e-3)
+    sse0 = np.load(tmp_path / "sse_0.npy")
+    np.testing.assert_allclose(sse0, np.asarray(km.sse_history), rtol=1e-5)
+
+
+def test_resample_rejected_up_front(mesh8):
+    ds, X = _make_nonaddressable_ds(mesh8)
+    km = KMeans(k=2, seed=0, verbose=False, mesh=mesh8,
+                init=X[:2].copy())          # explicit init: no row gather
+    with pytest.raises(ValueError, match="keep"):
+        km.fit(ds)
