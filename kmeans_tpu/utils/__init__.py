@@ -1,9 +1,11 @@
-"""Utilities: validation, iteration logging, checkpointing, profiling."""
+"""Utilities: validation, iteration logging, checkpointing, profiling,
+determinism checking (debug)."""
 
 from kmeans_tpu.utils.validation import validate_params, check_finite_array
 from kmeans_tpu.utils.logging import IterationLogger
 from kmeans_tpu.utils import checkpoint
 from kmeans_tpu.utils.profiling import Timer
+from kmeans_tpu.utils.debug import check_determinism
 
 __all__ = [
     "validate_params",
@@ -11,4 +13,5 @@ __all__ = [
     "IterationLogger",
     "checkpoint",
     "Timer",
+    "check_determinism",
 ]

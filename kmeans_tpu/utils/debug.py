@@ -1,0 +1,93 @@
+"""Determinism / reproducibility checking.
+
+The reference's closest analogue to a race detector is its numerical
+sanitizers plus one DELIBERATE nondeterminism: the empty-cluster resample
+is time-seeded (``seed=int(time.time())``, kmeans_spark.py:195-196), so
+identical runs can diverge.  This framework makes every path deterministic
+(derived seeds, fixed reduction orders within a given mesh/chunk
+configuration) — and this module provides the checker that PROVES it for a
+given setup, the SPMD equivalent of running a data-race detector over a
+parallel program.
+
+What it checks: two independent fits with identical configuration must
+produce bit-identical centroid trajectories, SSE histories, and labels.
+What it deliberately does NOT promise: bit-identity ACROSS different
+meshes/chunk sizes (psum/accumulation order changes — compare those with a
+tolerance instead; see tests/test_distributed.py's invariance tests).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+
+class DeterminismReport(dict):
+    """Dict with a readable summary (keys: deterministic, runs, details)."""
+
+    def __str__(self) -> str:  # pragma: no cover - cosmetic
+        status = "DETERMINISTIC" if self["deterministic"] else "DIVERGED"
+        return f"<{status} over {self['runs']} runs: {self['details']}>"
+
+
+def check_determinism(model_factory: Callable[[], object], X,
+                      *, runs: int = 2,
+                      sample_weight: Optional[np.ndarray] = None
+                      ) -> DeterminismReport:
+    """Fit ``runs`` fresh models from ``model_factory`` on the same data and
+    compare full trajectories bit-for-bit.
+
+    ``model_factory`` must build a NEW, identically-configured model each
+    call (e.g. ``lambda: KMeans(k=8, seed=0, verbose=False)``).  Returns a
+    report; ``report["deterministic"]`` is the verdict, and
+    ``report["details"]`` names the first field that diverged (centroids,
+    sse_history, iterations, labels) for debugging.
+    """
+    if runs < 2:
+        raise ValueError(f"runs must be >= 2, got {runs}")
+    X = np.asarray(X)
+    ref = None
+    for r in range(runs):
+        model = model_factory()
+        if getattr(model, "verbose", False):
+            raise ValueError("use verbose=False models (log output is not "
+                             "part of the determinism contract)")
+        fit_kwargs = {}
+        if sample_weight is not None:
+            import inspect
+            if "sample_weight" not in inspect.signature(
+                    model.fit).parameters:
+                raise ValueError(
+                    f"{type(model).__name__}.fit does not accept "
+                    "sample_weight; omit it for this model")
+            fit_kwargs["sample_weight"] = sample_weight
+        model.fit(X.copy(), **fit_kwargs)
+        snap = {
+            "centroids": np.asarray(model.centroids).copy(),
+            "sse_history": np.asarray(model.sse_history, dtype=np.float64),
+            "iterations": model.iterations_run,
+            "labels": np.asarray(model.predict(X)).copy(),
+        }
+        if ref is None:
+            ref = snap
+            continue
+        for field in ("iterations",):
+            if snap[field] != ref[field]:
+                return DeterminismReport(
+                    deterministic=False, runs=r + 1,
+                    details=f"{field} diverged on run {r}: "
+                            f"{ref[field]} vs {snap[field]}")
+        for field in ("centroids", "sse_history", "labels"):
+            if snap[field].shape != ref[field].shape or \
+                    not np.array_equal(snap[field], ref[field]):
+                where = ""
+                if snap[field].shape == ref[field].shape:
+                    bad = np.flatnonzero(
+                        (snap[field] != ref[field]).reshape(-1))
+                    where = f" (first mismatch at flat index {bad[0]})"
+                return DeterminismReport(
+                    deterministic=False, runs=r + 1,
+                    details=f"{field} diverged on run {r}{where}")
+    return DeterminismReport(deterministic=True, runs=runs,
+                             details="all trajectories bit-identical")

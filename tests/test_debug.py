@@ -1,0 +1,82 @@
+"""Determinism checker (utils.debug) — the SPMD 'race detector' analogue.
+
+The reference's empty-cluster path is deliberately time-seeded and thus
+non-reproducible (kmeans_spark.py:195-196, SURVEY.md §4); this framework
+replaces it with derived seeds, and these tests prove the determinism
+contract holds (and that the checker can DETECT a nondeterministic model).
+"""
+
+import numpy as np
+import pytest
+
+from kmeans_tpu import KMeans, MiniBatchKMeans
+from kmeans_tpu.data.synthetic import make_blobs
+from kmeans_tpu.utils.debug import check_determinism
+
+
+@pytest.fixture()
+def X():
+    return make_blobs(3000, centers=5, n_features=6, random_state=3,
+                      dtype=np.float32)[0]
+
+
+def test_kmeans_deterministic(X, mesh8):
+    report = check_determinism(
+        lambda: KMeans(k=5, seed=7, compute_sse=True, verbose=False,
+                       mesh=mesh8), X)
+    assert report["deterministic"], report
+
+
+def test_empty_cluster_resample_deterministic(mesh8):
+    # Forced empties (3 tight blobs, k=6 — the reference's T4 fixture,
+    # kmeans_spark.py:513-524) with the 'resample' policy: deterministic
+    # here, UNLIKE the reference's time-seeded resample.
+    X = make_blobs(800, centers=3, n_features=2, cluster_std=0.5,
+                   random_state=42, dtype=np.float32)[0]
+    report = check_determinism(
+        lambda: KMeans(k=6, seed=42, empty_cluster="resample",
+                       verbose=False, mesh=mesh8), X)
+    assert report["deterministic"], report
+
+
+def test_minibatch_deterministic(X):
+    report = check_determinism(
+        lambda: MiniBatchKMeans(k=5, seed=3, batch_size=256, max_iter=8,
+                                verbose=False), X)
+    assert report["deterministic"], report
+
+
+def test_detects_nondeterminism(X):
+    import itertools
+    counter = itertools.count()
+
+    def factory():
+        # Different seed each run — the checker must catch the divergence.
+        return KMeans(k=5, seed=next(counter), verbose=False)
+
+    report = check_determinism(factory, X)
+    assert not report["deterministic"]
+    assert "diverged" in report["details"]
+
+
+def test_rejects_bad_args(X):
+    with pytest.raises(ValueError, match="runs"):
+        check_determinism(lambda: KMeans(k=2, verbose=False), X, runs=1)
+    with pytest.raises(ValueError, match="verbose"):
+        check_determinism(lambda: KMeans(k=2), X)
+
+
+def test_sample_weight_unsupported_model_clear_error(X):
+    with pytest.raises(ValueError, match="sample_weight"):
+        check_determinism(
+            lambda: MiniBatchKMeans(k=3, seed=0, verbose=False), X,
+            sample_weight=np.ones(X.shape[0], np.float32))
+
+
+def test_sample_weight_supported(X, mesh8):
+    w = np.ones(X.shape[0], np.float32)
+    w[: 100] = 2.0
+    report = check_determinism(
+        lambda: KMeans(k=5, seed=2, verbose=False, mesh=mesh8), X,
+        sample_weight=w)
+    assert report["deterministic"], report
