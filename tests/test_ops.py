@@ -108,3 +108,26 @@ def test_chunk_size_must_divide():
     X = np.zeros((10, 2))
     with pytest.raises(ValueError, match="multiple of chunk_size"):
         assign_reduce(X, np.ones(10), np.zeros((2, 2)), chunk_size=64)
+
+
+def test_sse_accumulation_accuracy_at_scale():
+    """SURVEY.md §7 hard part (a): fp32 SSE accumulation order could lose
+    the ±1e-4 (relative) parity budget at large N.  XLA's tree reductions
+    keep the fused f32 SSE within the ±1e-4 relative parity budget
+    (measured 3.3e-6 at 2M x 128 on TPU v5e; typical error at this CI
+    shape is 5e-6..6e-5 across seeds, so the budget is asserted, not the
+    lucky seed)."""
+    import jax.numpy as jnp
+
+    n, d, k, chunk = 200_000, 32, 64, 20_000
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-1, 1, size=(n, d)).astype(np.float32)
+    C = X[:k].copy()
+    stats = assign_reduce(jnp.asarray(X), jnp.ones((n,), jnp.float32),
+                          jnp.asarray(C), chunk_size=chunk)
+    x64 = X.astype(np.float64)
+    c64 = C.astype(np.float64)
+    d2 = ((x64 * x64).sum(1)[:, None] + (c64 * c64).sum(1)[None, :]
+          - 2.0 * x64 @ c64.T)
+    sse64 = np.maximum(d2, 0).min(1).sum()
+    assert abs(float(stats.sse) - sse64) / sse64 < 1e-4

@@ -1,0 +1,86 @@
+"""Randomized property sweeps: algebraic invariants that must hold for ANY
+shape/configuration, exercised across seeded random configs (the reference
+tests only five fixed scenarios, kmeans_spark.py:355-621)."""
+
+import numpy as np
+import pytest
+
+from kmeans_tpu import KMeans
+from kmeans_tpu.ops.assign import assign_reduce
+
+
+def _random_config(rng):
+    n = int(rng.integers(5, 2000))
+    d = int(rng.integers(1, 40))
+    k = int(rng.integers(1, min(n, 12) + 1))
+    return n, d, k
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fit_predict_invariants_random_shapes(seed, mesh8):
+    """For any (n, d, k): k centroids come back finite, every label is in
+    range, every predicted label points at the point's true nearest
+    centroid (lowest index on ties), and counts sum to n."""
+    rng = np.random.default_rng(seed)
+    n, d, k = _random_config(rng)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    km = KMeans(k=k, seed=seed, max_iter=10, verbose=False,
+                mesh=mesh8).fit(X)
+    assert km.centroids.shape == (k, d)
+    assert np.all(np.isfinite(km.centroids))
+    labels = km.predict(X)
+    assert labels.shape == (n,) and labels.min() >= 0 and labels.max() < k
+    assert int(km.cluster_sizes_.sum()) == n
+    # Brute-force nearest-centroid oracle in float64.
+    x64 = X.astype(np.float64)
+    c64 = km.centroids.astype(np.float64)
+    d2 = ((x64 ** 2).sum(1)[:, None] + (c64 ** 2).sum(1)[None, :]
+          - 2.0 * x64 @ c64.T)
+    oracle = np.argmin(d2, axis=1)
+    # fp32-vs-f64 boundary flips allowed only where the margin is tiny.
+    diff = labels != oracle
+    if diff.any():
+        sorted_d2 = np.sort(d2[diff], axis=1)
+        margins = sorted_d2[:, 1] - sorted_d2[:, 0]
+        assert margins.max() < 1e-3, (margins.max(), diff.sum())
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_chunk_size_invariance(seed):
+    """assign_reduce statistics must not depend on the scan chunking
+    (beyond fp addition order)."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(100 + seed)
+    n, d, k = 960, int(rng.integers(2, 20)), int(rng.integers(2, 9))
+    X = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)
+    W = jnp.ones((n,), jnp.float32)
+    C = X[:k]
+    ref = None
+    for chunk in (32, 96, 480, 960):
+        st = assign_reduce(X, W, C, chunk_size=chunk)
+        got = (np.asarray(st.sums), np.asarray(st.counts), float(st.sse))
+        if ref is None:
+            ref = got
+            continue
+        np.testing.assert_array_equal(got[1], ref[1])       # counts exact
+        np.testing.assert_allclose(got[0], ref[0], rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(got[2], ref[2], rtol=1e-5)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_row_permutation_invariance(seed, mesh8):
+    """Shuffling input rows must not change the fitted centroid SET (fp
+    accumulation order shifts values only within tolerance)."""
+    rng = np.random.default_rng(200 + seed)
+    X = rng.normal(size=(800, 5)).astype(np.float32)
+    k = 4
+    init = X[rng.choice(800, size=k, replace=False)].copy()
+    km1 = KMeans(k=k, seed=0, init=init, max_iter=15, verbose=False,
+                 mesh=mesh8).fit(X)
+    perm = rng.permutation(800)
+    km2 = KMeans(k=k, seed=0, init=init, max_iter=15, verbose=False,
+                 mesh=mesh8).fit(X[perm])
+    c1 = np.array(sorted(km1.centroids.tolist()))
+    c2 = np.array(sorted(km2.centroids.tolist()))
+    np.testing.assert_allclose(c1, c2, atol=1e-4)
