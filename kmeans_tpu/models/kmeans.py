@@ -139,7 +139,21 @@ class KMeans:
             raise ValueError(f"empty_cluster must be one of {_EMPTY_POLICIES},"
                              f" got {empty_cluster!r}")
         self.empty_cluster = empty_cluster
-        self.dtype = np.dtype(dtype) if dtype is not None else np.dtype(np.float32)
+        requested = np.dtype(dtype) if dtype is not None \
+            else np.dtype(np.float32)
+        # Canonicalize against the backend: without jax_enable_x64, float64
+        # arrays are silently stored as float32 on device — declaring the
+        # narrowed dtype up front keeps every dataset/model dtype check
+        # consistent (and warns, instead of surprising at predict time).
+        canonical = np.dtype(jax.dtypes.canonicalize_dtype(requested))
+        if canonical != requested:
+            import warnings
+            warnings.warn(
+                f"dtype {requested} requires jax_enable_x64; computing in "
+                f"{canonical} instead (set jax.config.update("
+                f"'jax_enable_x64', True) before constructing the model "
+                f"for true {requested})", UserWarning, stacklevel=2)
+        self.dtype = canonical
         self.mesh = mesh
         self.model_shards = model_shards
         self.chunk_size = chunk_size

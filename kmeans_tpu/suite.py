@@ -111,8 +111,13 @@ def test_c_convergence(mesh) -> bool:
     _banner("TEST C: CONVERGENCE CHECK")
     X, _ = make_blobs(n_samples=5000, centers=4, n_features=5,
                       random_state=42)
+    # float64 like the reference's NumPy executors (kmeans_spark.py:153):
+    # the monotone-SSE invariant is a property of exact Lloyd steps, and on
+    # TPU the f32 matmul-form distances run at bf16 MXU precision, whose
+    # boundary-assignment flips can tick SSE up by ~1e-4 relative near
+    # convergence (see README troubleshooting / docs/PERFORMANCE.md).
     km = KMeans(k=4, max_iter=30, tolerance=1e-5, seed=42,
-                compute_sse=True, mesh=mesh).fit(X)
+                compute_sse=True, mesh=mesh, dtype=np.float64).fit(X)
     print("\n[SSE History]")
     for i, sse in enumerate(km.sse_history):
         print(f"Iteration {i + 1}: SSE = {sse:.4f}")
@@ -211,6 +216,11 @@ def main(argv=None) -> int:
     import jax
 
     from kmeans_tpu.parallel.mesh import force_cpu_devices, make_mesh
+
+    # Test A runs the parity fit in float64 (like sklearn's oracle); x64
+    # must be on before any array is created or f64 silently narrows to
+    # f32 on device.
+    jax.config.update("jax_enable_x64", True)
 
     if args.platform == "cpu":
         force_cpu_devices(args.devices)       # None honors XLA_FLAGS, else 1

@@ -61,3 +61,14 @@ def blobs_small():
     X, y = make_blobs(n_samples=1000, centers=3, n_features=2,
                       random_state=42)
     return X, y
+
+
+def sq_dists_f64(X, C):
+    """Shared float64 brute-force pairwise squared-distance oracle
+    (expanded matmul form, clamped at 0) used by the op/property tests."""
+    import numpy as _np
+    x64 = _np.asarray(X, dtype=_np.float64)
+    c64 = _np.asarray(C, dtype=_np.float64)
+    d2 = ((x64 * x64).sum(1)[:, None] + (c64 * c64).sum(1)[None, :]
+          - 2.0 * x64 @ c64.T)
+    return _np.maximum(d2, 0.0)

@@ -249,3 +249,37 @@ def test_pickle_after_partial_fit_keeps_labels():
     mb = MiniBatchKMeans(k=3, seed=0, verbose=False).partial_fit(batch)
     mb2 = pickle.loads(pickle.dumps(mb))
     np.testing.assert_array_equal(mb2.labels_, mb.predict(batch))
+
+
+def test_float64_without_x64_warns_and_works():
+    """Regression: requesting dtype=float64 without jax_enable_x64 used to
+    leave model.dtype=float64 while the device silently stored float32 —
+    the eager labels_ pass then crashed on the dtype re-check.  Now the
+    dtype canonicalizes up front (with a warning) and fit/labels_ work."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).parent.parent
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ":".join(
+        p for p in [str(repo), env.get("PYTHONPATH")] if p)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("JAX_ENABLE_X64", None)
+    code = (
+        "import warnings, numpy as np\n"
+        "from kmeans_tpu import KMeans\n"
+        "X = np.random.default_rng(0).normal(size=(200, 3))\n"
+        "with warnings.catch_warnings(record=True) as w:\n"
+        "    warnings.simplefilter('always')\n"
+        "    km = KMeans(k=3, seed=0, verbose=False, dtype=np.float64)\n"
+        "assert any('x64' in str(x.message) for x in w), w\n"
+        "assert km.dtype == np.float32, km.dtype\n"
+        "km.fit(X)\n"
+        "assert km.labels_.shape == (200,)\n"
+        "print('OK')\n")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "OK" in proc.stdout

@@ -99,7 +99,8 @@ def test_two_process_fit_matches_single_process(tmp_path):
     repo = Path(__file__).parent.parent
     port = _free_port()
     env = dict(os.environ)
-    env["PYTHONPATH"] = f"{repo}:{env.get('PYTHONPATH', '')}"
+    env["PYTHONPATH"] = ":".join(
+        p for p in [str(repo), env.get("PYTHONPATH")] if p)
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
     procs = [subprocess.Popen(

@@ -125,9 +125,6 @@ def test_sse_accumulation_accuracy_at_scale():
     C = X[:k].copy()
     stats = assign_reduce(jnp.asarray(X), jnp.ones((n,), jnp.float32),
                           jnp.asarray(C), chunk_size=chunk)
-    x64 = X.astype(np.float64)
-    c64 = C.astype(np.float64)
-    d2 = ((x64 * x64).sum(1)[:, None] + (c64 * c64).sum(1)[None, :]
-          - 2.0 * x64 @ c64.T)
-    sse64 = np.maximum(d2, 0).min(1).sum()
+    from tests.conftest import sq_dists_f64
+    sse64 = sq_dists_f64(X, C).min(1).sum()
     assert abs(float(stats.sse) - sse64) / sse64 < 1e-4

@@ -32,17 +32,16 @@ def test_fit_predict_invariants_random_shapes(seed, mesh8):
     assert labels.shape == (n,) and labels.min() >= 0 and labels.max() < k
     assert int(km.cluster_sizes_.sum()) == n
     # Brute-force nearest-centroid oracle in float64.
-    x64 = X.astype(np.float64)
-    c64 = km.centroids.astype(np.float64)
-    d2 = ((x64 ** 2).sum(1)[:, None] + (c64 ** 2).sum(1)[None, :]
-          - 2.0 * x64 @ c64.T)
+    from tests.conftest import sq_dists_f64
+    d2 = sq_dists_f64(X, km.centroids)
     oracle = np.argmin(d2, axis=1)
-    # fp32-vs-f64 boundary flips allowed only where the margin is tiny.
-    diff = labels != oracle
-    if diff.any():
-        sorted_d2 = np.sort(d2[diff], axis=1)
-        margins = sorted_d2[:, 1] - sorted_d2[:, 0]
-        assert margins.max() < 1e-3, (margins.max(), diff.sum())
+    # fp32-vs-f64 boundary flips allowed only where the CHOSEN centroid is
+    # within a tiny margin of the true nearest (a grossly wrong label must
+    # fail regardless of how close the top-2 oracle distances are).
+    diff = np.flatnonzero(labels != oracle)
+    if diff.size:
+        excess = d2[diff, labels[diff]] - d2[diff, oracle[diff]]
+        assert excess.max() < 1e-3, (excess.max(), diff.size)
 
 
 @pytest.mark.parametrize("seed", range(4))
