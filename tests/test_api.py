@@ -188,7 +188,10 @@ def test_fitted_model_pickles_and_deepcopies():
 
 def test_deepcopy_preserves_mesh_and_fit():
     import copy
+    import jax
     from kmeans_tpu import make_mesh
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
     rng = np.random.default_rng(7)
     X = rng.normal(size=(160, 4)).astype(np.float32)
     mesh = make_mesh(data=4, model=2)

@@ -58,7 +58,9 @@ def test_uneven_shard_padding(mesh8):
 
 def test_various_mesh_shapes(data):
     import jax
-    for shape in [(2, 1), (2, 2), (1, 8), (8, 1)]:
+    for shape in [(1, 1), (2, 1), (2, 2), (1, 8), (8, 1)]:
+        if shape[0] * shape[1] > len(jax.devices()):
+            continue                     # single-chip hardware mode
         mesh = make_mesh(data=shape[0], model=shape[1],
                          devices=jax.devices()[: shape[0] * shape[1]])
         km = _fit(mesh, data)
@@ -67,8 +69,9 @@ def test_various_mesh_shapes(data):
 
 def test_mesh_validation():
     import jax
-    with pytest.raises(ValueError, match="divisible"):
-        make_mesh(model=3, devices=jax.devices()[:8])
+    if len(jax.devices()) >= 8:
+        with pytest.raises(ValueError, match="divisible"):
+            make_mesh(model=3, devices=jax.devices()[:8])
     with pytest.raises(ValueError, match="positive"):
         make_mesh(model=0)
     with pytest.raises(ValueError, match="needs"):

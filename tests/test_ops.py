@@ -125,6 +125,6 @@ def test_sse_accumulation_accuracy_at_scale():
     C = X[:k].copy()
     stats = assign_reduce(jnp.asarray(X), jnp.ones((n,), jnp.float32),
                           jnp.asarray(C), chunk_size=chunk)
-    from tests.conftest import sq_dists_f64
+    from conftest import sq_dists_f64
     sse64 = sq_dists_f64(X, C).min(1).sum()
     assert abs(float(stats.sse) - sse64) / sse64 < 1e-4

@@ -32,7 +32,7 @@ def test_fit_predict_invariants_random_shapes(seed, mesh8):
     assert labels.shape == (n,) and labels.min() >= 0 and labels.max() < k
     assert int(km.cluster_sizes_.sum()) == n
     # Brute-force nearest-centroid oracle in float64.
-    from tests.conftest import sq_dists_f64
+    from conftest import sq_dists_f64
     d2 = sq_dists_f64(X, km.centroids)
     oracle = np.argmin(d2, axis=1)
     # fp32-vs-f64 boundary flips allowed only where the CHOSEN centroid is
