@@ -3,6 +3,8 @@ capture, and dataset resharding (the ``repartition`` analogue)."""
 
 import os
 
+import pytest
+
 import numpy as np
 from sklearn.datasets import make_blobs
 
