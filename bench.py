@@ -114,6 +114,10 @@ def main() -> None:
     per_iter = max((t_big - t_small) / iters, 1e-9)
     log(f"bench: fit(2)={t_small*1e3:.0f} ms, fit({2+iters})="
         f"{t_big*1e3:.0f} ms -> {per_iter*1e3:.2f} ms/iter steady-state")
+    if t_big - t_small <= 0.05:
+        log("bench: WARNING: marginal time is within dispatch-latency "
+            "noise (~50 ms) — raise BENCH_N/BENCH_ITERS for a trustworthy "
+            "number (python -m kmeans_tpu bench does this adaptively)")
 
     n_chips = max(1, len(jax.devices()))
     throughput = n * d / per_iter / n_chips

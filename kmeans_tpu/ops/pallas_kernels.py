@@ -51,24 +51,30 @@ def _kernel(x_ref, w_ref, c_ref, labels_ref, mind2_ref, sums_ref,
     tile_n = x.shape[0]
     x2 = jnp.sum(x * x, axis=1, keepdims=True)         # (tile_n, 1)
 
-    def scan_k(kt, carry):
-        best, mind2 = carry
-        c = c_ref[pl.ds(kt * tile_k, tile_k), :]       # (tile_k, D)
+    # The k-tile loops are unrolled at trace time (k_tiles is static and
+    # small — <= 3 for every BASELINE.json config at the 1024 default
+    # tile): static python offsets sidestep a Pallas-tracing recursion in
+    # the int64 index promotion/conversion paths under jax_enable_x64, and
+    # give Mosaic static slices to schedule.
+    best = jnp.zeros((tile_n,), jnp.int32)
+    mind2 = jnp.full((tile_n,), jnp.inf, jnp.float32)
+    for kt in range(k_tiles):
+        off = kt * tile_k                              # python int: static
+        c = c_ref[pl.ds(off, tile_k), :]               # (tile_k, D)
         c2 = jnp.sum(c * c, axis=1)[None, :]           # (1, tile_k)
         xc = jax.lax.dot_general(
             x.astype(mm_dtype), c.astype(mm_dtype),
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # (tile_n, tile_k) MXU
         d2 = jnp.maximum(x2 + c2 - 2.0 * xc, 0.0)
-        local_best = jnp.argmin(d2, axis=1).astype(jnp.int32)
+        # Explicit int32 index dtype: under jax_enable_x64 jnp.argmin
+        # returns int64, which Mosaic cannot lower on TPU.
+        local_best = jax.lax.argmin(d2, 1, jnp.int32)
         local_min = jnp.min(d2, axis=1)
         upd = local_min < mind2                        # strict: earlier tile
-        best = jnp.where(upd, kt * tile_k + local_best, best)  # wins ties
-        return best, jnp.where(upd, local_min, mind2)
-
-    best0 = jnp.zeros((tile_n,), jnp.int32)
-    mind20 = jnp.full((tile_n,), jnp.inf, jnp.float32)
-    best, mind2 = jax.lax.fori_loop(0, k_tiles, scan_k, (best0, mind20))
+        best = jnp.where(upd, local_best + np.int32(off), best)  # ties ->
+        #                                              earlier tile wins
+        mind2 = jnp.where(upd, local_min, mind2)
 
     labels_ref[:, :] = best[:, None]
     mind2_ref[:, :] = mind2[:, None]
@@ -80,19 +86,17 @@ def _kernel(x_ref, w_ref, c_ref, labels_ref, mind2_ref, sums_ref,
         sums_ref[:, :] = jnp.zeros_like(sums_ref)
         counts_ref[:, :] = jnp.zeros_like(counts_ref)
 
-    def accum_k(kt, _):
-        ids = kt * tile_k + jax.lax.broadcasted_iota(
-            jnp.int32, (1, tile_k), 1)                 # (1, tile_k)
+    for kt in range(k_tiles):                          # static unroll
+        off = kt * tile_k
+        ids = jax.lax.broadcasted_iota(
+            jnp.int32, (1, tile_k), 1) + np.int32(off)  # (1, tile_k)
         onehot = (best[:, None] == ids).astype(jnp.float32) * w
-        sums_ref[pl.ds(kt * tile_k, tile_k), :] += jax.lax.dot_general(
+        sums_ref[pl.ds(off, tile_k), :] += jax.lax.dot_general(
             onehot.astype(mm_dtype), x.astype(mm_dtype),
             (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)        # (tile_k, D) MXU
-        counts_ref[:, pl.ds(kt * tile_k, tile_k)] += jnp.sum(
+        counts_ref[:, pl.ds(off, tile_k)] += jnp.sum(
             onehot, axis=0, keepdims=True)
-        return 0
-
-    jax.lax.fori_loop(0, k_tiles, accum_k, 0)
 
 
 @functools.partial(jax.jit,
@@ -111,6 +115,12 @@ def fused_assign_reduce(points: jax.Array, weights: jax.Array,
     pads D to the 128-lane boundary (zero columns change nothing) and k to
     a ``tile_k`` multiple with far-away sentinel rows (never selected).
     """
+    if not interpret and jax.config.jax_enable_x64:
+        raise NotImplementedError(
+            "Pallas TPU kernels cannot compile under jax_enable_x64 in "
+            "this jax/Mosaic version (the internal grid carry lowers to "
+            "i64, which Mosaic rejects — reproduced with a trivial "
+            "kernel); disable x64 or use distance_mode='matmul'")
     n, d = points.shape
     k = centroids.shape[0]
     f32 = jnp.float32

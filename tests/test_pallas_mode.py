@@ -6,6 +6,15 @@ import numpy as np
 import pytest
 from sklearn.datasets import make_blobs
 
+import jax
+
+# Mosaic cannot compile Pallas TPU kernels under jax_enable_x64 (internal
+# grid carry lowers to i64) — the hardware-mode conftest enables x64, so
+# these compile-path tests only run where they can: CPU interpret mode.
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() != "cpu" and jax.config.jax_enable_x64,
+    reason="Pallas TPU kernels do not compile under jax_enable_x64")
+
 from kmeans_tpu import KMeans
 from kmeans_tpu.parallel.mesh import make_mesh
 
