@@ -43,6 +43,27 @@ def _round_up(a: int, b: int) -> int:
     return _cdiv(a, b) * b
 
 
+# k-tile loops unroll at trace time up to this bound (static python
+# offsets sidestep a Pallas-tracing recursion in the int64 index
+# promotion paths under jax_enable_x64, and give Mosaic static slices to
+# schedule; <= 3 tiles covers every BASELINE.json config at the 1024
+# default tile).  Beyond it, a fori_loop with int32-safe arithmetic keeps
+# trace/compile cost O(1) in k — valid because the x64 configuration is
+# already rejected at the fused_assign_reduce boundary.
+_UNROLL_K_TILES = 8
+
+
+def _k_tile_loop(k_tiles: int, body, init):
+    """Run ``body(kt_python_int_or_int32_tracer, carry)`` over the k tiles:
+    static unroll when small, ``fori_loop`` otherwise."""
+    if k_tiles <= _UNROLL_K_TILES:
+        carry = init
+        for kt in range(k_tiles):
+            carry = body(kt, carry)
+        return carry
+    return jax.lax.fori_loop(np.int32(0), np.int32(k_tiles), body, init)
+
+
 def _kernel(x_ref, w_ref, c_ref, labels_ref, mind2_ref, sums_ref,
             counts_ref, *, k_tiles: int, tile_k: int, mm_dtype):
     i = pl.program_id(0)
@@ -51,15 +72,11 @@ def _kernel(x_ref, w_ref, c_ref, labels_ref, mind2_ref, sums_ref,
     tile_n = x.shape[0]
     x2 = jnp.sum(x * x, axis=1, keepdims=True)         # (tile_n, 1)
 
-    # The k-tile loops are unrolled at trace time (k_tiles is static and
-    # small — <= 3 for every BASELINE.json config at the 1024 default
-    # tile): static python offsets sidestep a Pallas-tracing recursion in
-    # the int64 index promotion/conversion paths under jax_enable_x64, and
-    # give Mosaic static slices to schedule.
-    best = jnp.zeros((tile_n,), jnp.int32)
-    mind2 = jnp.full((tile_n,), jnp.inf, jnp.float32)
-    for kt in range(k_tiles):
-        off = kt * tile_k                              # python int: static
+    def scan_k(kt, carry):
+        best, mind2 = carry
+        # Unrolled path: plain python-int offset (Mosaic's slice lowering
+        # accepts int, not np scalars).  fori path: int32 tracer product.
+        off = kt * tile_k if isinstance(kt, int) else kt * np.int32(tile_k)
         c = c_ref[pl.ds(off, tile_k), :]               # (tile_k, D)
         c2 = jnp.sum(c * c, axis=1)[None, :]           # (1, tile_k)
         xc = jax.lax.dot_general(
@@ -72,9 +89,15 @@ def _kernel(x_ref, w_ref, c_ref, labels_ref, mind2_ref, sums_ref,
         local_best = jax.lax.argmin(d2, 1, jnp.int32)
         local_min = jnp.min(d2, axis=1)
         upd = local_min < mind2                        # strict: earlier tile
-        best = jnp.where(upd, local_best + np.int32(off), best)  # ties ->
-        #                                              earlier tile wins
-        mind2 = jnp.where(upd, local_min, mind2)
+        # astype keeps the carry int32 on the interpret+x64 fori path
+        # (where the loop index is int64); a no-op everywhere else.
+        best = jnp.where(upd, (local_best + off).astype(jnp.int32),
+                         best)                         # ties -> earlier
+        return best, jnp.where(upd, local_min, mind2)  # tile wins
+
+    best, mind2 = _k_tile_loop(
+        k_tiles, scan_k, (jnp.zeros((tile_n,), jnp.int32),
+                          jnp.full((tile_n,), jnp.inf, jnp.float32)))
 
     labels_ref[:, :] = best[:, None]
     mind2_ref[:, :] = mind2[:, None]
@@ -86,10 +109,10 @@ def _kernel(x_ref, w_ref, c_ref, labels_ref, mind2_ref, sums_ref,
         sums_ref[:, :] = jnp.zeros_like(sums_ref)
         counts_ref[:, :] = jnp.zeros_like(counts_ref)
 
-    for kt in range(k_tiles):                          # static unroll
-        off = kt * tile_k
+    def accum_k(kt, carry):
+        off = kt * tile_k if isinstance(kt, int) else kt * np.int32(tile_k)
         ids = jax.lax.broadcasted_iota(
-            jnp.int32, (1, tile_k), 1) + np.int32(off)  # (1, tile_k)
+            jnp.int32, (1, tile_k), 1) + off           # (1, tile_k)
         onehot = (best[:, None] == ids).astype(jnp.float32) * w
         sums_ref[pl.ds(off, tile_k), :] += jax.lax.dot_general(
             onehot.astype(mm_dtype), x.astype(mm_dtype),
@@ -97,6 +120,9 @@ def _kernel(x_ref, w_ref, c_ref, labels_ref, mind2_ref, sums_ref,
             preferred_element_type=jnp.float32)        # (tile_k, D) MXU
         counts_ref[:, pl.ds(off, tile_k)] += jnp.sum(
             onehot, axis=0, keepdims=True)
+        return carry
+
+    _k_tile_loop(k_tiles, accum_k, np.int32(0))
 
 
 @functools.partial(jax.jit,

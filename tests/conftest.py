@@ -40,6 +40,15 @@ import pytest  # noqa: E402
 
 from kmeans_tpu.parallel.mesh import make_mesh  # noqa: E402
 
+# Mosaic cannot compile Pallas TPU kernels under jax_enable_x64 (the
+# internal grid carry lowers to i64; reproduced with a trivial kernel) —
+# this suite enables x64, so the Pallas compile-path modules skip on
+# hardware and tests/test_pallas_tpu.py covers the Mosaic path under a
+# scoped disable_x64 instead.
+pallas_x64_skip = pytest.mark.skipif(
+    jax.default_backend() != "cpu" and jax.config.jax_enable_x64,
+    reason="Pallas TPU kernels do not compile under jax_enable_x64")
+
 
 @pytest.fixture(scope="session")
 def mesh1():

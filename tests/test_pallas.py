@@ -4,14 +4,9 @@ the same kernel compiles to Mosaic on real TPU)."""
 import numpy as np
 import pytest
 
-import jax
+from conftest import pallas_x64_skip
 
-# Mosaic cannot compile Pallas TPU kernels under jax_enable_x64 (internal
-# grid carry lowers to i64) — the hardware-mode conftest enables x64, so
-# these compile-path tests only run where they can: CPU interpret mode.
-pytestmark = pytest.mark.skipif(
-    jax.default_backend() != "cpu" and jax.config.jax_enable_x64,
-    reason="Pallas TPU kernels do not compile under jax_enable_x64")
+pytestmark = pallas_x64_skip
 
 from kmeans_tpu.ops.assign import assign_reduce
 from kmeans_tpu.ops.pallas_kernels import fused_assign_reduce
@@ -59,3 +54,20 @@ def test_fused_kernel_tie_break_lowest_index():
     labels, *_ = fused_assign_reduce(X, np.ones(2, np.float32), C,
                                      tile_n=8, tile_k=128, interpret=True)
     np.testing.assert_array_equal(np.asarray(labels), [0, 0])
+
+
+def test_fori_fallback_for_many_k_tiles():
+    """k_tiles > _UNROLL_K_TILES exercises the fori_loop path (trace cost
+    stays O(1) in k); interpret mode + x64 also covers its int32-carry
+    handling."""
+    X, w, C = _case(512, 6, 1200)
+    labels, mind2, sums, counts = fused_assign_reduce(
+        X, w, C, tile_k=128, interpret=True)       # k_tiles = 10
+    ref = assign_reduce(X, w, C, chunk_size=512)
+    np.testing.assert_array_equal(np.asarray(labels),
+                                  np.asarray(ref_labels := np.argmin(
+                                      ((X[:, None] - C[None]) ** 2).sum(2),
+                                      axis=1)))
+    np.testing.assert_array_equal(np.asarray(counts), np.asarray(ref.counts))
+    np.testing.assert_allclose(np.asarray(sums), np.asarray(ref.sums),
+                               rtol=1e-4, atol=1e-4)

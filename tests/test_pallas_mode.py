@@ -6,14 +6,9 @@ import numpy as np
 import pytest
 from sklearn.datasets import make_blobs
 
-import jax
+from conftest import pallas_x64_skip
 
-# Mosaic cannot compile Pallas TPU kernels under jax_enable_x64 (internal
-# grid carry lowers to i64) — the hardware-mode conftest enables x64, so
-# these compile-path tests only run where they can: CPU interpret mode.
-pytestmark = pytest.mark.skipif(
-    jax.default_backend() != "cpu" and jax.config.jax_enable_x64,
-    reason="Pallas TPU kernels do not compile under jax_enable_x64")
+pytestmark = pallas_x64_skip
 
 from kmeans_tpu import KMeans
 from kmeans_tpu.parallel.mesh import make_mesh

@@ -1,0 +1,36 @@
+"""Mosaic compile-path coverage on real hardware.
+
+The main Pallas test modules run in interpret mode on CPU and skip under
+x64 on TPU (Mosaic/x64 limitation, see conftest.pallas_x64_skip).  This
+module keeps the actual TPU compilation tested: it scopes x64 OFF around
+the kernel call (jax.enable_x64(False)) — interpret mode cannot validate Mosaic lowering.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() == "cpu",
+    reason="Mosaic compile path needs real TPU hardware")
+
+
+def test_fused_kernel_compiles_and_matches_oracle_on_tpu():
+    import jax.numpy as jnp
+
+    from kmeans_tpu.ops.assign import assign_reduce
+    from kmeans_tpu.ops.pallas_kernels import fused_assign_reduce
+
+    with jax.enable_x64(False):
+        rng = np.random.default_rng(0)
+        X = jnp.asarray(rng.normal(size=(2048, 24)), jnp.float32)
+        W = jnp.ones((2048,), jnp.float32)
+        C = X[:9]
+        labels, mind2, sums, counts = fused_assign_reduce(X, W, C)
+        ref = assign_reduce(X, W, C, chunk_size=512)
+        np.testing.assert_array_equal(np.asarray(counts),
+                                      np.asarray(ref.counts))
+        np.testing.assert_allclose(np.asarray(sums), np.asarray(ref.sums),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(float((mind2 * W).sum()),
+                                   float(ref.sse), rtol=1e-5)
