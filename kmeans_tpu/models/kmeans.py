@@ -153,7 +153,12 @@ class KMeans:
                 f"{canonical} instead (set jax.config.update("
                 f"'jax_enable_x64', True) before constructing the model "
                 f"for true {requested})", UserWarning, stacklevel=2)
-        self.dtype = canonical
+            self.dtype = canonical
+        else:
+            # Keep the caller's exact instance when the value is unchanged:
+            # sklearn.base.clone deepcopies params and then requires the
+            # constructor to store them by IDENTITY.
+            self.dtype = requested
         self.mesh = mesh
         self.model_shards = model_shards
         self.chunk_size = chunk_size
@@ -244,10 +249,11 @@ class KMeans:
 
     # ------------------------------------------------------------------- fit
 
-    def fit(self, X, *, sample_weight=None, resume: bool = False,
+    def fit(self, X, y=None, *, sample_weight=None, resume: bool = False,
             profile_dir: Optional[str] = None) -> "KMeans":
         """Fit on (n, D) array-like or a cached ShardedDataset.
-        Returns self (kmeans_spark.py:239-319).
+        Returns self (kmeans_spark.py:239-319).  ``y`` is ignored
+        (sklearn estimator-protocol compatibility).
 
         ``sample_weight`` (n,) weights every statistic (sums, counts, SSE) —
         sklearn-style, beyond the reference.  ``resume=True`` continues from
@@ -612,12 +618,12 @@ class KMeans:
         labels = predict_fn(ds.points, cents_dev)
         return np.asarray(labels)[: ds.n]
 
-    def fit_predict(self, X) -> np.ndarray:
+    def fit_predict(self, X, y=None) -> np.ndarray:
         # labels_ is materialized by fit() from the same X — reusing it
         # avoids a second upload + assignment pass.
         return self.fit(X).labels_
 
-    def fit_transform(self, X) -> np.ndarray:
+    def fit_transform(self, X, y=None) -> np.ndarray:
         return self.fit(X).transform(X)
 
     def transform(self, X) -> np.ndarray:
@@ -629,7 +635,7 @@ class KMeans:
         d2 = _pairwise_jit(X, c, mode=self.distance_mode)
         return np.sqrt(np.asarray(d2))
 
-    def score(self, X) -> float:
+    def score(self, X, y=None) -> float:
         """Negative SSE of X under the fitted centroids (sklearn convention)."""
         if self.centroids is None:
             raise ValueError("Model must be fitted before prediction")
@@ -674,6 +680,13 @@ class KMeans:
             if name not in self._PARAM_NAMES:
                 self.__dict__[name] = value
         return self
+
+    def get_feature_names_out(self, input_features=None) -> np.ndarray:
+        """Output feature names of ``transform`` (sklearn transformer
+        protocol — one distance column per centroid), enabling use as a
+        feature-extraction stage in ``sklearn.pipeline.Pipeline``."""
+        name = type(self).__name__.lower()
+        return np.asarray([f"{name}{i}" for i in range(self.k)], dtype=object)
 
     @property
     def cluster_centers_(self) -> Optional[np.ndarray]:

@@ -32,7 +32,7 @@ class MiniBatchKMeans(KMeans):
             raise ValueError(f"batch_size must be positive, got {batch_size}")
         self.batch_size = batch_size
 
-    def fit(self, X, *, resume: bool = False) -> "MiniBatchKMeans":
+    def fit(self, X, y=None, *, resume: bool = False) -> "MiniBatchKMeans":
         from kmeans_tpu.parallel.sharding import ShardedDataset
         if isinstance(X, ShardedDataset):
             if X.host is None:
@@ -121,7 +121,8 @@ class MiniBatchKMeans(KMeans):
         self._seen = seen.copy()
         return new_centroids, seen, max_shift
 
-    def partial_fit(self, X, *, sample_weight=None) -> "MiniBatchKMeans":
+    def partial_fit(self, X, y=None, *,
+                    sample_weight=None) -> "MiniBatchKMeans":
         """One incremental update from a caller-provided batch (sklearn's
         streaming API — beyond the reference, which has no incremental
         path).  First call initializes centroids from the batch; subsequent

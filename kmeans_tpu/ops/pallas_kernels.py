@@ -47,21 +47,27 @@ def _round_up(a: int, b: int) -> int:
 # offsets sidestep a Pallas-tracing recursion in the int64 index
 # promotion paths under jax_enable_x64, and give Mosaic static slices to
 # schedule; <= 3 tiles covers every BASELINE.json config at the 1024
-# default tile).  Beyond it, a fori_loop with int32-safe arithmetic keeps
-# trace/compile cost O(1) in k — valid because the x64 configuration is
-# already rejected at the fused_assign_reduce boundary.
+# default tile).  Beyond it, a fori_loop keeps trace/compile cost O(1) in
+# k.  NOTE the fori index is int64 under jax_enable_x64 (interpret mode
+# reaches that combination; compiled Mosaic mode rejects x64 at the
+# fused_assign_reduce boundary) — hence the int32-normalizing offset below
+# and the .astype on the label carry in scan_k.
 _UNROLL_K_TILES = 8
 
 
-def _k_tile_loop(k_tiles: int, body, init):
-    """Run ``body(kt_python_int_or_int32_tracer, carry)`` over the k tiles:
-    static unroll when small, ``fori_loop`` otherwise."""
+def _k_tile_loop(k_tiles: int, tile_k: int, body, init):
+    """Run ``body(off, carry)`` over the k tiles, where ``off`` is the tile
+    row offset: a plain python int on the static-unroll path (Mosaic's
+    slice lowering rejects np scalars), an int32 tracer on the fori path."""
     if k_tiles <= _UNROLL_K_TILES:
         carry = init
         for kt in range(k_tiles):
-            carry = body(kt, carry)
+            carry = body(kt * tile_k, carry)
         return carry
-    return jax.lax.fori_loop(np.int32(0), np.int32(k_tiles), body, init)
+    return jax.lax.fori_loop(
+        np.int32(0), np.int32(k_tiles),
+        lambda kt, c: body(jnp.asarray(kt, jnp.int32) * np.int32(tile_k), c),
+        init)
 
 
 def _kernel(x_ref, w_ref, c_ref, labels_ref, mind2_ref, sums_ref,
@@ -72,11 +78,8 @@ def _kernel(x_ref, w_ref, c_ref, labels_ref, mind2_ref, sums_ref,
     tile_n = x.shape[0]
     x2 = jnp.sum(x * x, axis=1, keepdims=True)         # (tile_n, 1)
 
-    def scan_k(kt, carry):
+    def scan_k(off, carry):
         best, mind2 = carry
-        # Unrolled path: plain python-int offset (Mosaic's slice lowering
-        # accepts int, not np scalars).  fori path: int32 tracer product.
-        off = kt * tile_k if isinstance(kt, int) else kt * np.int32(tile_k)
         c = c_ref[pl.ds(off, tile_k), :]               # (tile_k, D)
         c2 = jnp.sum(c * c, axis=1)[None, :]           # (1, tile_k)
         xc = jax.lax.dot_general(
@@ -96,8 +99,9 @@ def _kernel(x_ref, w_ref, c_ref, labels_ref, mind2_ref, sums_ref,
         return best, jnp.where(upd, local_min, mind2)  # tile wins
 
     best, mind2 = _k_tile_loop(
-        k_tiles, scan_k, (jnp.zeros((tile_n,), jnp.int32),
-                          jnp.full((tile_n,), jnp.inf, jnp.float32)))
+        k_tiles, tile_k, scan_k,
+        (jnp.zeros((tile_n,), jnp.int32),
+         jnp.full((tile_n,), jnp.inf, jnp.float32)))
 
     labels_ref[:, :] = best[:, None]
     mind2_ref[:, :] = mind2[:, None]
@@ -109,8 +113,7 @@ def _kernel(x_ref, w_ref, c_ref, labels_ref, mind2_ref, sums_ref,
         sums_ref[:, :] = jnp.zeros_like(sums_ref)
         counts_ref[:, :] = jnp.zeros_like(counts_ref)
 
-    def accum_k(kt, carry):
-        off = kt * tile_k if isinstance(kt, int) else kt * np.int32(tile_k)
+    def accum_k(off, carry):
         ids = jax.lax.broadcasted_iota(
             jnp.int32, (1, tile_k), 1) + off           # (1, tile_k)
         onehot = (best[:, None] == ids).astype(jnp.float32) * w
@@ -122,7 +125,7 @@ def _kernel(x_ref, w_ref, c_ref, labels_ref, mind2_ref, sums_ref,
             onehot, axis=0, keepdims=True)
         return carry
 
-    _k_tile_loop(k_tiles, accum_k, np.int32(0))
+    _k_tile_loop(k_tiles, tile_k, accum_k, np.int32(0))
 
 
 @functools.partial(jax.jit,

@@ -286,3 +286,25 @@ def test_float64_without_x64_warns_and_works():
                           capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "OK" in proc.stdout
+
+
+def test_sklearn_clone_and_pipeline_interop(small_X):
+    """get_params/set_params/transform satisfy the sklearn estimator and
+    transformer protocols: clone() produces an unfitted twin, and KMeans
+    works as a Pipeline feature-extraction stage."""
+    from sklearn.base import clone
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.pipeline import Pipeline
+
+    km = KMeans(k=4, seed=0, verbose=False)
+    twin = clone(km)
+    assert twin is not km and twin.get_params() == km.get_params()
+    assert twin.centroids is None
+
+    y = (small_X[:, 0] > 0).astype(int)
+    pipe = Pipeline([("km", KMeans(k=4, seed=0, verbose=False)),
+                     ("clf", LogisticRegression(max_iter=200))])
+    pipe.fit(small_X.astype(np.float32), y)
+    assert pipe.predict(small_X.astype(np.float32)).shape == (len(small_X),)
+    names = pipe.named_steps["km"].get_feature_names_out()
+    assert list(names) == [f"kmeans{i}" for i in range(4)]

@@ -34,3 +34,25 @@ def test_fused_kernel_compiles_and_matches_oracle_on_tpu():
                                    rtol=1e-4, atol=1e-4)
         np.testing.assert_allclose(float((mind2 * W).sum()),
                                    float(ref.sse), rtol=1e-5)
+
+
+def test_fori_fallback_compiles_on_tpu():
+    """k_tiles > _UNROLL_K_TILES takes the fori_loop path with dynamic
+    pl.ds offsets — Mosaic must lower it too, not just the static unroll."""
+    import jax.numpy as jnp
+
+    from kmeans_tpu.ops.assign import assign_reduce
+    from kmeans_tpu.ops.pallas_kernels import fused_assign_reduce
+
+    with jax.enable_x64(False):
+        rng = np.random.default_rng(1)
+        X = jnp.asarray(rng.normal(size=(1024, 16)), jnp.float32)
+        W = jnp.ones((1024,), jnp.float32)
+        C = jnp.asarray(rng.normal(size=(1200, 16)), jnp.float32)
+        labels, mind2, sums, counts = fused_assign_reduce(
+            X, W, C, tile_k=128)                   # k_tiles = 10 > 8
+        ref = assign_reduce(X, W, C, chunk_size=1024)
+        np.testing.assert_array_equal(np.asarray(counts),
+                                      np.asarray(ref.counts))
+        np.testing.assert_allclose(np.asarray(sums), np.asarray(ref.sums),
+                                   rtol=1e-4, atol=1e-4)
