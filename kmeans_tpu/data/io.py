@@ -135,3 +135,28 @@ def from_raw(path, shape: Tuple[int, int], mesh: Mesh, *,
 
     return _sharded_from_source(read_rows, n, d, mesh, chunk, dtype,
                                 sample_weight, host_handle=mm)
+
+
+def iter_npy_blocks(path, block_rows: int, *, dtype=None):
+    """Factory for ``KMeans.fit_stream``: returns a zero-argument callable
+    that yields consecutive (<= block_rows, D) slices of a 2-D ``.npy``
+    via mmap — only one block is ever resident in host memory, so the file
+    can exceed both HBM and host RAM.
+
+    Usage::
+
+        km.fit_stream(iter_npy_blocks("big.npy", 1_000_000))
+    """
+    if block_rows <= 0:
+        raise ValueError(f"block_rows must be positive, got {block_rows}")
+
+    def make_blocks():
+        arr = np.load(path, mmap_mode="r")
+        if arr.ndim != 2:
+            raise ValueError(f"{path} must contain a 2-D array, "
+                             f"got shape {arr.shape}")
+        for start in range(0, arr.shape[0], block_rows):
+            block = np.asarray(arr[start: start + block_rows])
+            yield block if dtype is None else block.astype(dtype)
+
+    return make_blocks

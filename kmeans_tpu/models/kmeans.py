@@ -386,6 +386,126 @@ class KMeans:
         self.restart_inertias_ = np.asarray(inertias, dtype=np.float64)
         return self
 
+    def fit_stream(self, make_blocks, *, d: Optional[int] = None) -> "KMeans":
+        """EXACT full-batch Lloyd over data larger than device memory.
+
+        ``make_blocks()`` returns a fresh iterable of (n_i, D) host blocks;
+        it is re-invoked every iteration (one epoch of blocks = one Lloyd
+        iteration).  Each block streams through the SAME fused SPMD step as
+        ``fit`` and the dense (k, D+1) statistics are summed across blocks
+        in float64 on the host, so — unlike :class:`MiniBatchKMeans`'s
+        sampled approximation — the trajectory is identical (up to fp
+        summation order) to an in-memory fit of the concatenated blocks.
+        This is the capability the reference gets from Spark's
+        disk-spillable RDDs (``README.md:71`` advises repartitioning under
+        memory pressure); here only one block is device-resident at a time.
+
+        Constraints: ``empty_cluster`` must be ``'keep'`` or ``'farthest'``
+        (``'resample'`` needs global row access); named init strategies
+        seed from the FIRST block (documented divergence — pass an explicit
+        (k, D) init array for full control); ``n_init``/``resume`` are not
+        supported.  ``d`` pre-declares the feature count (otherwise peeked
+        from the first block).
+        """
+        from kmeans_tpu.parallel.sharding import shard_points
+        if self.empty_cluster == "resample":
+            raise ValueError(
+                "fit_stream supports empty_cluster 'keep' or 'farthest' "
+                "('resample' needs global row access)")
+        if self.n_init != 1:
+            raise ValueError("fit_stream does not support n_init > 1")
+        log = IterationLogger(self.verbose and jax.process_index() == 0)
+        log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
+
+        explicit_init = not isinstance(self.init, str) \
+            and not callable(self.init)
+        first = None
+        if d is None or not explicit_init:
+            # Peek one block — for the feature count and/or data-dependent
+            # seeding.  Skipped entirely for the d + explicit-init path
+            # (no reason to read a block before the first epoch).
+            first = np.asarray(next(iter(make_blocks())), dtype=self.dtype)
+            d = first.shape[1] if d is None else d
+        init_src = first if first is not None else np.empty((0, d),
+                                                            self.dtype)
+        centroids = resolve_init(self.init, init_src, self.k, self.seed)
+        centroids = self._postprocess_centroids(
+            np.asarray(centroids, dtype=np.float64)).astype(self.dtype)
+
+        mesh = self._resolve_mesh()
+        _, model_shards = mesh_shape(mesh)
+
+        class _StreamMeta:
+            """_handle_empty's dataset view of a stream: no rows are
+            addressable, so resample-style fills degrade to keep-old (the
+            reference's own under-return fallback, kmeans_spark.py:201)."""
+            def __init__(self, d):
+                self.d = d
+
+            def positive_rows(self):
+                return np.empty((0,), np.int64)
+
+            def take(self, idx):
+                return np.empty((0, self.d))
+
+        meta = _StreamMeta(d)
+
+        self.sse_history = []
+        self.iter_times_ = []
+        self.iterations_run = 0
+        acc = np.float64
+        step_fn = chunk = None                     # sized from first block
+        for iteration in range(self.max_iter):
+            iter_start = time.perf_counter()
+            cents_dev = self._put_centroids(centroids, mesh, model_shards)
+            sums = np.zeros((self.k, d), acc)
+            counts = np.zeros((self.k,), acc)
+            sse = 0.0
+            far_d, far_p = -1.0, None
+            n_seen = 0
+            for block in make_blocks():            # fresh epoch every iter
+                block = np.ascontiguousarray(np.asarray(block,
+                                                        dtype=self.dtype))
+                if block.ndim != 2 or block.shape[1] != d:
+                    raise ValueError(f"block shape {block.shape} != (*, {d})")
+                if step_fn is None:                # chunk from a REAL block
+                    _, _, step_fn, _, chunk = self._setup(block.shape[0], d)
+                n_seen += block.shape[0]
+                pts, w = shard_points(block, mesh, chunk)
+                st: StepStats = step_fn(pts, w, cents_dev)
+                sums += np.asarray(st.sums, dtype=acc)[: self.k]
+                counts += np.asarray(st.counts, dtype=acc)[: self.k]
+                sse += float(st.sse)
+                if float(st.farthest_dist) > far_d:
+                    far_d = float(st.farthest_dist)
+                    far_p = np.asarray(st.farthest_point, dtype=acc)
+            first = None                           # release the peek block
+            if n_seen == 0:
+                raise ValueError(
+                    f"make_blocks() yielded no rows on iteration "
+                    f"{iteration + 1} — it must return a FRESH iterable on "
+                    f"every call (one epoch per Lloyd iteration)")
+            if iteration == 0 and n_seen < self.k:
+                raise ValueError(f"Not enough data points ({n_seen}) to "
+                                 f"initialize {self.k} clusters")
+
+            agg = StepStats(sums, counts, np.float64(sse),
+                            np.float64(far_d),
+                            far_p if far_p is not None
+                            else np.zeros((d,), acc),
+                            np.zeros((self.k,), acc))
+            centroids, max_shift = self._finish_lloyd_iteration(
+                centroids, sums, counts, sse, agg, meta, iteration, log,
+                None, iter_start)
+            if max_shift < self.tolerance:           # kmeans_spark.py:310
+                log.converged(iteration + 1)
+                break
+        self._fit_ds, self._labels_cache = None, None
+        self._labels_error = ("labels_ is not materialized by fit_stream "
+                              "(the dataset never resides in memory); call "
+                              "predict on each block")
+        return self
+
     def _run_restart(self, ds, mesh, model_shards, step_fn, centroids,
                      start_iter, seed, log) -> "KMeans":
         """One restart: the reference's full fit loop (kmeans_spark.py:
@@ -402,52 +522,65 @@ class KMeans:
             # (kmeans_spark.py:181-188) — in float64 for stable division.
             sums = np.asarray(stats.sums, dtype=np.float64)[: self.k]
             counts = np.asarray(stats.counts, dtype=np.float64)[: self.k]
-            nonempty = counts > 0
-            new_centroids = np.where(
-                nonempty[:, None],
-                sums / np.maximum(counts, 1.0)[:, None],
-                centroids.astype(np.float64))
-            new_centroids = self._handle_empty(
-                new_centroids, nonempty, ds, stats, iteration, log,
-                seed=seed)
-            new_centroids = self._postprocess_centroids(
-                new_centroids, prev=centroids.astype(np.float64))
-            new_centroids = new_centroids.astype(self.dtype)
-
-            if self.compute_sse:          # SSE vs starting centroids (:279)
-                sse = float(stats.sse)
-                self.sse_history.append(sse)
-                if len(self.sse_history) > 1 and \
-                        sse > self.sse_history[-2] + 1e-6:
-                    log.warn_sse_increase(self.sse_history[-2], sse)
-
-            # Numerical-stability guard (kmeans_spark.py:289-290).
-            if not np.all(np.isfinite(new_centroids)):
-                raise ValueError(
-                    f"NaN or Inf detected in centroids at iteration "
-                    f"{iteration + 1}")
-
-            shifts = np.linalg.norm(
-                new_centroids.astype(np.float64) -
-                centroids.astype(np.float64), axis=1)
-            max_shift = float(np.max(shifts))       # kmeans_spark.py:293-294
-
-            sizes = counts.astype(np.int64)
-            log.iteration(iteration, max_shift, sizes,
-                          self.sse_history[-1] if
-                          (self.compute_sse and self.sse_history) else None)
-
-            centroids = new_centroids                # kmeans_spark.py:307
-            self.centroids = np.asarray(centroids)
-            self.cluster_sizes_ = sizes
-            self.iterations_run = iteration + 1      # fixes SURVEY §2.1 bug
-            self.iter_times_.append(time.perf_counter() - iter_start)
-
+            centroids, max_shift = self._finish_lloyd_iteration(
+                centroids, sums, counts,
+                float(stats.sse) if self.compute_sse else 0.0, stats, ds,
+                iteration, log, seed, iter_start)
             if max_shift < self.tolerance:           # kmeans_spark.py:310-313
                 log.converged(iteration + 1)
                 break
             cents_dev = self._put_centroids(centroids, mesh, model_shards)
         return self
+
+    def _finish_lloyd_iteration(self, centroids, sums, counts, sse_val,
+                                stats, ds_like, iteration, log, seed,
+                                iter_start):
+        """Shared host-side finish of one Lloyd iteration (the reference
+        driver's role, kmeans_spark.py:181-204 + :279-307), used by both
+        the in-memory host loop and ``fit_stream``: mean division in
+        float64, empty-cluster handling, the subclass postprocess hook, SSE
+        bookkeeping + monotonicity warning (:283-286), the NaN/Inf guard
+        (:289-290), shift computation, per-iteration logging (:296-304),
+        and fitted-state writes.  Returns (new_centroids, max_shift)."""
+        nonempty = counts > 0
+        new_centroids = np.where(
+            nonempty[:, None],
+            sums / np.maximum(counts, 1.0)[:, None],
+            centroids.astype(np.float64))
+        new_centroids = self._handle_empty(
+            new_centroids, nonempty, ds_like, stats, iteration, log,
+            seed=seed)
+        new_centroids = self._postprocess_centroids(
+            new_centroids, prev=centroids.astype(np.float64))
+        new_centroids = new_centroids.astype(self.dtype)
+
+        if self.compute_sse:              # SSE vs starting centroids (:279)
+            self.sse_history.append(sse_val)
+            if len(self.sse_history) > 1 and \
+                    sse_val > self.sse_history[-2] + 1e-6:
+                log.warn_sse_increase(self.sse_history[-2], sse_val)
+
+        # Numerical-stability guard (kmeans_spark.py:289-290).
+        if not np.all(np.isfinite(new_centroids)):
+            raise ValueError(
+                f"NaN or Inf detected in centroids at iteration "
+                f"{iteration + 1}")
+
+        shifts = np.linalg.norm(
+            new_centroids.astype(np.float64) -
+            centroids.astype(np.float64), axis=1)
+        max_shift = float(np.max(shifts))           # kmeans_spark.py:293-294
+
+        sizes = counts.astype(np.int64)
+        log.iteration(iteration, max_shift, sizes,
+                      self.sse_history[-1] if
+                      (self.compute_sse and self.sse_history) else None)
+
+        self.centroids = np.asarray(new_centroids)   # kmeans_spark.py:307
+        self.cluster_sizes_ = sizes
+        self.iterations_run = iteration + 1          # fixes SURVEY §2.1 bug
+        self.iter_times_.append(time.perf_counter() - iter_start)
+        return new_centroids, max_shift
 
     def _fit_on_device(self, ds, centroids, start_iter, mesh, model_shards,
                        log) -> "KMeans":

@@ -1,0 +1,104 @@
+"""Streaming full-batch Lloyd (KMeans.fit_stream): exact K-Means over
+data that never resides in memory at once — the bigger-than-HBM path."""
+
+import numpy as np
+import pytest
+
+from kmeans_tpu import KMeans
+from kmeans_tpu.data.io import iter_npy_blocks
+from kmeans_tpu.data.synthetic import make_blobs
+
+
+@pytest.fixture()
+def data():
+    X, _ = make_blobs(6000, centers=5, n_features=8, random_state=11,
+                      dtype=np.float32)
+    return X
+
+
+def _blocks_of(X, size):
+    def make_blocks():
+        for i in range(0, len(X), size):
+            yield X[i: i + size]
+    return make_blocks
+
+
+def test_stream_matches_in_memory_fit(data, mesh8):
+    rng = np.random.RandomState(0)
+    init = data[rng.choice(len(data), 5, replace=False)].copy()
+    km_mem = KMeans(k=5, seed=0, init=init, compute_sse=True,
+                    empty_cluster="keep", verbose=False, mesh=mesh8,
+                    chunk_size=128).fit(data)
+    km_st = KMeans(k=5, seed=0, init=init, compute_sse=True,
+                   empty_cluster="keep", verbose=False, mesh=mesh8,
+                   chunk_size=128)
+    km_st.fit_stream(_blocks_of(data, 1000))
+    # fp summation order differs (per-block f64 accumulation vs one
+    # on-device pass), so the stop decision can shift by an iteration
+    # right at the tolerance threshold; the fixed-point must agree.
+    assert abs(km_st.iterations_run - km_mem.iterations_run) <= 1
+    np.testing.assert_allclose(km_st.centroids, km_mem.centroids, atol=1e-4)
+    n = min(len(km_st.sse_history), len(km_mem.sse_history))
+    np.testing.assert_allclose(km_st.sse_history[:n],
+                               km_mem.sse_history[:n], rtol=1e-5)
+
+
+def test_stream_uneven_blocks_and_npy(tmp_path, data, mesh8):
+    path = tmp_path / "pts.npy"
+    np.save(path, data)
+    rng = np.random.RandomState(1)
+    init = data[rng.choice(len(data), 4, replace=False)].copy()
+    km = KMeans(k=4, seed=0, init=init, empty_cluster="farthest",
+                verbose=False, mesh=mesh8, chunk_size=128)
+    km.fit_stream(iter_npy_blocks(path, 1700))      # 6000 -> 1700*3 + 900
+    assert np.all(np.isfinite(km.centroids))
+    ref = KMeans(k=4, seed=0, init=init, empty_cluster="farthest",
+                 verbose=False, mesh=mesh8, chunk_size=128).fit(data)
+    np.testing.assert_allclose(km.centroids, ref.centroids, atol=1e-4)
+
+
+def test_stream_guards(data):
+    with pytest.raises(ValueError, match="resample"):
+        KMeans(k=3, verbose=False).fit_stream(_blocks_of(data, 1000))
+    with pytest.raises(ValueError, match="n_init"):
+        KMeans(k=3, n_init=2, empty_cluster="keep",
+               verbose=False).fit_stream(_blocks_of(data, 1000))
+    km = KMeans(k=3, empty_cluster="keep", verbose=False, max_iter=2)
+    km.fit_stream(_blocks_of(data, 1000))
+    with pytest.raises(AttributeError, match="fit_stream"):
+        km.labels_
+    labels = km.predict(data[:100])                 # per-block predict works
+    assert labels.shape == (100,)
+
+
+def test_stream_too_few_points():
+    X = np.zeros((3, 2), np.float32)
+    km = KMeans(k=5, empty_cluster="keep", verbose=False,
+                init=np.zeros((5, 2), np.float32))
+    with pytest.raises(ValueError, match="Not enough data points"):
+        km.fit_stream(_blocks_of(X, 2))
+
+
+def test_stream_farthest_multiple_empties_keeps_old(mesh8):
+    """>= 2 empty clusters under 'farthest': one slot refills from the
+    farthest point, the rest keep their old centroids (no crash)."""
+    X = np.concatenate([np.zeros((50, 2)), np.ones((50, 2)) * 100.0]
+                       ).astype(np.float32)
+    far_init = np.array([[0, 0], [100, 100], [500, 500], [600, 600],
+                         [700, 700]], np.float32)
+    km = KMeans(k=5, init=far_init, empty_cluster="farthest", max_iter=3,
+                verbose=False, mesh=mesh8, chunk_size=8)
+    km.fit_stream(_blocks_of(X, 40))
+    assert np.all(np.isfinite(km.centroids))
+
+
+def test_stream_one_shot_iterable_raises(data):
+    blocks = iter([data[:2000], data[2000:]])      # NOT a fresh iterable
+
+    def make_blocks():
+        return blocks                               # exhausted after epoch 0
+
+    km = KMeans(k=3, empty_cluster="keep", verbose=False, max_iter=5,
+                init=data[:3].copy())
+    with pytest.raises(ValueError, match="FRESH iterable"):
+        km.fit_stream(make_blocks)
