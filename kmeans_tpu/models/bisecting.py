@@ -218,6 +218,16 @@ class BisectingKMeans(KMeans):
         self.cluster_sizes_ = np.array([wsize[i] for i in range(k_out)])
         return self
 
+    def fit_stream(self, make_blocks, *, d=None):
+        """Blocked: the inherited ``fit_stream`` would run plain flat Lloyd
+        — no bisecting tree, stale ``cluster_sse_``/``labels_`` semantics
+        (ADVICE r1).  Bisecting needs random row access for its per-split
+        2-means fits, which a stream cannot serve."""
+        raise NotImplementedError(
+            "BisectingKMeans does not support fit_stream (the split tree "
+            "needs the full dataset resident); use KMeans.fit_stream for a "
+            "flat out-of-core fit")
+
     # ------------------------------------------------------------ checkpoint
 
     def _state_dict(self) -> dict:

@@ -93,6 +93,12 @@ class KMeans:
     TPU-native extensions:
 
     init : 'forgy' (reference parity) | 'k-means++' | callable | (k,D) array.
+    compute_labels : materialize ``labels_`` at the end of ``fit`` with one
+        extra fused assignment pass (sklearn semantics; default True).
+        ``False`` skips the pass AND releases the device-resident dataset —
+        centroid-only workloads pay nothing for labels they never read
+        (``labels_`` then raises; call ``predict(X)`` instead).  Mirrors
+        sklearn's ``MiniBatchKMeans(compute_labels=...)``.
     n_init : number of independent restarts (sklearn-style; the reference
         draws once).  Restart 0 uses ``seed`` exactly (so n_init=1 is
         bit-identical to the reference trajectory); further restarts use
@@ -118,6 +124,7 @@ class KMeans:
                  compute_sse: bool = False, *,
                  init: Union[str, np.ndarray, callable] = "forgy",
                  n_init: int = 1,
+                 compute_labels: bool = True,
                  empty_cluster: str = "resample",
                  dtype=None,
                  mesh: Optional[Mesh] = None,
@@ -135,6 +142,7 @@ class KMeans:
         if int(n_init) < 1:
             raise ValueError(f"n_init must be >= 1, got {n_init}")
         self.n_init = int(n_init)
+        self.compute_labels = compute_labels
         if empty_cluster not in _EMPTY_POLICIES:
             raise ValueError(f"empty_cluster must be one of {_EMPTY_POLICIES},"
                              f" got {empty_cluster!r}")
@@ -270,13 +278,13 @@ class KMeans:
             self._fit(X, sample_weight=sample_weight, resume=resume)
         # Materialize labels_ eagerly (sklearn semantics) — one extra fused
         # assignment pass, after which the device-resident dataset reference
-        # is released so fit() never leaves HBM pinned.  Multi-host
-        # process-local datasets are skipped: their labels span
+        # is released so fit() never leaves HBM pinned.  Skipped when
+        # ``compute_labels=False`` (centroid-only workloads) and for
+        # multi-host process-local datasets, whose labels span
         # non-addressable devices (predict each host's local rows instead).
         addressable = not isinstance(self._fit_ds, ShardedDataset) or \
             self._fit_ds.points.is_fully_addressable
-        self._labels_error = None
-        if self._eager_labels and addressable:
+        if self.compute_labels and self._eager_labels and addressable:
             _ = self.labels_
         else:
             if not addressable:
@@ -284,8 +292,25 @@ class KMeans:
                     "labels_ is not available for a multi-host "
                     "process-local fit (labels would span non-addressable "
                     "devices); call predict on each process's local rows")
+            # compute_labels=False error state was set by _set_fit_data.
             self._fit_ds = None
         return self
+
+    def _set_fit_data(self, ds) -> None:
+        """Point the lazy ``labels_`` machinery at new training data,
+        clearing any stale error state a previous ``fit_stream`` left
+        (ADVICE r1: a successful fit after fit_stream must not keep
+        raising the 'not materialized' error).  ``compute_labels=False``
+        opts the whole machinery out — sklearn's ``MiniBatchKMeans``
+        semantics, uniformly across ``fit`` and ``partial_fit``."""
+        if self.compute_labels:
+            self._fit_ds, self._labels_cache = ds, None
+            self._labels_error = None
+        else:
+            self._fit_ds, self._labels_cache = None, None
+            self._labels_error = (
+                "labels_ was not materialized because "
+                "compute_labels=False; call predict(X) instead")
 
     def _apply_sample_weight(self, X, sample_weight):
         """Fold an explicit (n,) sample_weight into a fresh cached dataset
@@ -338,7 +363,7 @@ class KMeans:
                 "empty_cluster='resample' cannot gather rows from a "
                 "multi-host process-local dataset; use "
                 "empty_cluster='keep' or 'farthest'")
-        self._fit_ds, self._labels_cache = ds, None   # feeds lazy labels_
+        self._set_fit_data(ds)                        # feeds lazy labels_
         log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
         self.best_restart_ = 0
         self.restart_inertias_ = None
@@ -781,9 +806,9 @@ class KMeans:
     # ---------------------------------------------------- sklearn-style sugar
 
     _PARAM_NAMES = ("k", "max_iter", "tolerance", "seed", "compute_sse",
-                    "init", "n_init", "empty_cluster", "dtype", "mesh",
-                    "model_shards", "chunk_size", "distance_mode",
-                    "host_loop", "verbose")
+                    "init", "n_init", "compute_labels", "empty_cluster",
+                    "dtype", "mesh", "model_shards", "chunk_size",
+                    "distance_mode", "host_loop", "verbose")
 
     def get_params(self, deep: bool = True) -> dict:
         """Constructor parameters as a dict (sklearn estimator protocol —
@@ -896,6 +921,7 @@ class KMeans:
             "tolerance": self.tolerance, "seed": self.seed,
             "compute_sse": self.compute_sse,
             "n_init": self.n_init,
+            "compute_labels": self.compute_labels,
             "empty_cluster": self.empty_cluster,
             "distance_mode": self.distance_mode,
             "model_shards": self.model_shards,
@@ -930,6 +956,7 @@ class KMeans:
                     tolerance=state["tolerance"], seed=state["seed"],
                     compute_sse=state["compute_sse"], init=init,
                     n_init=int(state.get("n_init", 1)),
+                    compute_labels=bool(state.get("compute_labels", True)),
                     empty_cluster=state["empty_cluster"],
                     distance_mode=state["distance_mode"],
                     model_shards=state["model_shards"],

@@ -44,7 +44,7 @@ class MiniBatchKMeans(KMeans):
             raise ValueError(f"X must be 2-D (n, D), got shape {X.shape}")
         n, d = X.shape
         bs = min(self.batch_size, n)
-        self._fit_ds, self._labels_cache = X, None    # feeds lazy labels_
+        self._set_fit_data(X)                         # feeds lazy labels_
         import jax
         log = IterationLogger(self.verbose and jax.process_index() == 0)
 
@@ -152,8 +152,19 @@ class MiniBatchKMeans(KMeans):
                                  self.iterations_run, log)
         # labels for THIS batch under the updated centroids (sklearn
         # semantics: partial_fit leaves labels_ of the last batch).
-        self._fit_ds, self._labels_cache = X, None
+        self._set_fit_data(X)
         return self
+
+    def fit_stream(self, make_blocks, *, d=None):
+        """Blocked: the inherited exact-Lloyd ``fit_stream`` would silently
+        bypass mini-batch semantics (ADVICE r1).  For streaming, feed blocks
+        through ``partial_fit``; for an exact bigger-than-memory fit, use
+        ``KMeans.fit_stream``."""
+        raise NotImplementedError(
+            "MiniBatchKMeans does not support fit_stream (it would run "
+            "exact full-batch Lloyd, not mini-batch updates); stream blocks "
+            "through partial_fit, or use KMeans.fit_stream for an exact "
+            "out-of-core fit")
 
     def _state_dict(self) -> dict:
         state = super()._state_dict()

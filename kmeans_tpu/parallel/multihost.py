@@ -59,19 +59,17 @@ def initialize(coordinator_address: Optional[str] = None,
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id)
-    except RuntimeError:
-        # "must be called before any JAX computations" — backends already
-        # initialized.  If the caller passed explicit coordinates, or the
-        # environment says this is one process of a cluster job, swallowing
-        # would silently downgrade EVERY host to a wrong single-process
-        # fit — raise.  Otherwise this is a plain single-process program
-        # calling initialize() late, which is harmless.
+    except (RuntimeError, ValueError):
+        # RuntimeError: "must be called before any JAX computations" —
+        # backends already initialized.  ValueError: no coordinator could
+        # be auto-detected (ADVICE r1).  Either way, if the caller passed
+        # explicit coordinates or the environment says this is one process
+        # of a cluster job, swallowing would silently downgrade EVERY host
+        # to a wrong single-process fit — raise.  Otherwise this is a
+        # plain single-process program calling initialize() late/without a
+        # coordinator, which is harmless.
         if explicit or _cluster_env_present():
             raise
-    except ValueError:
-        if explicit:
-            raise
-        # No coordinator configured anywhere: a plain single-process run.
 
 
 def is_primary() -> bool:

@@ -134,6 +134,11 @@ class ShardedDataset:
         empty-cluster resampling — zero-weight rows must never become
         centroids)."""
         if self._host_weights is None:
+            # Enforce the invariant HERE (ADVICE r1): for process-local
+            # datasets, global row indices don't map onto the interleaved
+            # padded device layout, so arange(n) would be wrong — don't
+            # rely on every caller being separately guarded.
+            self._require_addressable("positive_rows")
             return np.arange(self.n)
         return np.flatnonzero(self._host_weights > 0)
 

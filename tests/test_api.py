@@ -308,3 +308,33 @@ def test_sklearn_clone_and_pipeline_interop(small_X):
     assert pipe.predict(small_X.astype(np.float32)).shape == (len(small_X),)
     names = pipe.named_steps["km"].get_feature_names_out()
     assert list(names) == [f"kmeans{i}" for i in range(4)]
+
+
+def test_compute_labels_false_skips_labels_pass(small_X, mesh8):
+    """ADVICE r1: public opt-out of the eager labels_ pass (sklearn's
+    MiniBatchKMeans compute_labels analogue) for centroid-only workloads."""
+    km = KMeans(k=3, seed=0, verbose=False, mesh=mesh8,
+                compute_labels=False).fit(small_X)
+    assert km._fit_ds is None                 # dataset released, no pass run
+    with pytest.raises(AttributeError, match="compute_labels=False"):
+        _ = km.labels_
+    assert km.predict(small_X).shape == (len(small_X),)
+    assert km.get_params()["compute_labels"] is False
+    # Round-trips through set_params back to eager labels.
+    km.set_params(compute_labels=True).fit(small_X)
+    assert km.labels_.shape == (len(small_X),)
+
+
+def test_compute_labels_false_partial_fit(small_X):
+    """compute_labels=False holds for partial_fit too (sklearn's
+    MiniBatchKMeans leaves labels_ unset after partial_fit)."""
+    mb = MiniBatchKMeans(k=3, seed=0, verbose=False, batch_size=32,
+                         compute_labels=False)
+    mb.partial_fit(small_X[:64])
+    assert mb._fit_ds is None
+    with pytest.raises(AttributeError, match="compute_labels=False"):
+        _ = mb.labels_
+    mb2 = MiniBatchKMeans(k=3, seed=0, verbose=False, batch_size=32,
+                          compute_labels=False, max_iter=3).fit(small_X)
+    with pytest.raises(AttributeError, match="compute_labels=False"):
+        _ = mb2.labels_

@@ -136,3 +136,33 @@ def test_resample_rejected_up_front(mesh8):
                 init=X[:2].copy())          # explicit init: no row gather
     with pytest.raises(ValueError, match="keep"):
         km.fit(ds)
+
+
+def test_positive_rows_guard_on_nonaddressable(mesh8):
+    """ADVICE r1: positive_rows() must enforce addressability itself —
+    global arange(n) indices don't map onto the interleaved process-local
+    padded layout."""
+    ds, _ = _make_nonaddressable_ds(mesh8)
+    with pytest.raises(ValueError, match="positive_rows"):
+        ds.positive_rows()
+
+
+def test_initialize_reraises_valueerror_in_cluster_env(monkeypatch):
+    """ADVICE r1: auto-detection failure (ValueError) inside a cluster job
+    must raise, not silently downgrade every host to single-process."""
+    import jax
+
+    def boom(coordinator_address=None, num_processes=None, process_id=None):
+        raise ValueError("could not auto-detect coordinator")
+
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    monkeypatch.setenv("SLURM_JOB_ID", "12345")
+    with pytest.raises(ValueError, match="auto-detect"):
+        initialize()
+    monkeypatch.delenv("SLURM_JOB_ID")
+    for v in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+              "MEGASCALE_COORDINATOR_ADDRESS", "OMPI_COMM_WORLD_SIZE",
+              "CLOUD_TPU_TASK_ID", "TPU_WORKER_ID"):
+        monkeypatch.delenv(v, raising=False)
+    initialize()                 # plain single-process: swallowed

@@ -102,3 +102,24 @@ def test_stream_one_shot_iterable_raises(data):
                 init=data[:3].copy())
     with pytest.raises(ValueError, match="FRESH iterable"):
         km.fit_stream(make_blocks)
+
+
+def test_fit_after_fit_stream_clears_stale_labels_error(data, mesh8):
+    """ADVICE r1: a successful fit() after fit_stream() must clear the
+    'not materialized by fit_stream' error state."""
+    km = KMeans(k=5, seed=0, empty_cluster="keep", verbose=False, mesh=mesh8)
+    km.fit_stream(_blocks_of(data, 2000))
+    with pytest.raises(AttributeError, match="fit_stream"):
+        _ = km.labels_
+    km.fit(data)
+    assert km.labels_.shape == (len(data),)
+
+
+def test_minibatch_and_bisecting_fit_stream_blocked():
+    """ADVICE r1: the inherited exact-Lloyd fit_stream would silently bypass
+    mini-batch / bisecting semantics — both must refuse."""
+    from kmeans_tpu.models import BisectingKMeans, MiniBatchKMeans
+    with pytest.raises(NotImplementedError, match="partial_fit"):
+        MiniBatchKMeans(k=3, verbose=False).fit_stream(lambda: [])
+    with pytest.raises(NotImplementedError, match="KMeans.fit_stream"):
+        BisectingKMeans(k=3, verbose=False).fit_stream(lambda: [])
