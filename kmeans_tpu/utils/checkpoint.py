@@ -11,6 +11,7 @@ as well as fitted-model save/load.
 from __future__ import annotations
 
 import json
+import os
 from pathlib import Path
 from typing import Any, Dict
 
@@ -27,14 +28,24 @@ def _normalize(path) -> Path:
 
 
 def save_state(path, state: Dict[str, Any]) -> None:
-    """Write a checkpoint dict; arrays as npz payloads, rest as JSON."""
+    """Write a checkpoint dict; arrays as npz payloads, rest as JSON.
+
+    The write is ATOMIC (temp file in the same directory + ``os.replace``):
+    a concurrent or crashed-midway writer can never leave a torn file for a
+    reader to load (r1 VERDICT #5 — multi-host shared-filesystem safety)."""
     path = _normalize(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     arrays = {k: np.asarray(v) for k, v in state.items()
               if isinstance(v, np.ndarray)}
     meta = {k: v for k, v in state.items() if k not in arrays}
     meta["__format_version__"] = FORMAT_VERSION
-    np.savez(path, __meta__=json.dumps(meta), **arrays)
+    tmp = path.with_name(f".{path.name}.{os.getpid()}.tmp")
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, __meta__=json.dumps(meta), **arrays)
+        os.replace(tmp, path)
+    finally:
+        tmp.unlink(missing_ok=True)
 
 
 def load_state(path) -> Dict[str, Any]:

@@ -72,6 +72,44 @@ km2 = KMeans(k=4, seed=0, init="kmeans++", empty_cluster="keep",
              verbose=False).fit(ds)
 assert np.all(np.isfinite(km2.centroids))
 
+# --- multi-host checkpoint: every process calls save(); only process 0
+# writes, and the barrier makes the file visible before any return
+# (r1 VERDICT #5).
+km.save(out_dir / "mh_ckpt")
+loaded = KMeans.load(out_dir / "mh_ckpt")
+np.testing.assert_array_equal(loaded.centroids, km.centroids)
+
+# --- TP mesh with the MODEL axis spanning processes: the per-chunk
+# all_gather of per-block minima (the TP collective) crosses the process
+# boundary for real.  Each data-axis row block is replicated across the
+# model axis, so both processes hold every row — built with
+# make_array_from_callback from the full (deterministic) X.
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+from kmeans_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS  # noqa: E402
+from kmeans_tpu.parallel.sharding import (ShardedDataset,  # noqa: E402
+                                          pad_points)
+
+devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+assert len(devs) == 4
+# data x model grid: model axis pairs one device of EACH process.
+grid = np.array([[devs[0], devs[2]], [devs[1], devs[3]]])
+mesh_tp = Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+chunk = 64
+x_pad, w_pad = pad_points(X.astype(np.float32), 2 * chunk)
+pts = jax.make_array_from_callback(
+    x_pad.shape, NamedSharding(mesh_tp, P(DATA_AXIS, None)),
+    lambda idx: x_pad[idx])
+w = jax.make_array_from_callback(
+    w_pad.shape, NamedSharding(mesh_tp, P(DATA_AXIS)),
+    lambda idx: w_pad[idx])
+ds_tp = ShardedDataset(pts, w, len(X), chunk, mesh_tp)
+km_tp = KMeans(k=4, seed=0, init=init, empty_cluster="keep",
+               compute_sse=True, verbose=False).fit(ds_tp)
+np.save(out_dir / f"centroids_tp_{proc_id}.npy", km_tp.centroids)
+np.save(out_dir / f"sse_tp_{proc_id}.npy", np.asarray(km_tp.sse_history))
+
 np.save(out_dir / f"centroids_{proc_id}.npy", km.centroids)
 np.save(out_dir / f"sse_{proc_id}.npy", np.asarray(km.sse_history))
-print(f"proc {proc_id}: OK iters={km.iterations_run}", flush=True)
+print(f"proc {proc_id}: OK iters={km.iterations_run} "
+      f"tp_iters={km_tp.iterations_run}", flush=True)

@@ -129,6 +129,19 @@ def test_two_process_fit_matches_single_process(tmp_path):
     sse0 = np.load(tmp_path / "sse_0.npy")
     np.testing.assert_allclose(sse0, np.asarray(km.sse_history), rtol=1e-5)
 
+    # TP (model=2, model axis spanning the two processes) must agree too.
+    tp0 = np.load(tmp_path / "centroids_tp_0.npy")
+    tp1 = np.load(tmp_path / "centroids_tp_1.npy")
+    np.testing.assert_array_equal(tp0, tp1)
+    np.testing.assert_allclose(tp0, km.centroids, atol=1e-3)
+    np.testing.assert_allclose(np.load(tmp_path / "sse_tp_0.npy"),
+                               np.asarray(km.sse_history), rtol=1e-5)
+
+    # save() was called by BOTH processes; the gating means exactly one
+    # writer — the checkpoint must exist and load cleanly.
+    loaded = KMeans.load(tmp_path / "mh_ckpt")
+    np.testing.assert_allclose(loaded.centroids, c0)
+
 
 def test_resample_rejected_up_front(mesh8):
     ds, X = _make_nonaddressable_ds(mesh8)
