@@ -107,14 +107,26 @@ def main() -> None:
     timed_fit(fit_big, points, weights, cents)
     log(f"bench: compile+warmup {time.perf_counter() - t0:.1f}s")
 
-    t_small = min(timed_fit(fit_small, points, weights, cents)
-                  for _ in range(2))
-    t_big = min(timed_fit(fit_big, points, weights, cents)
-                for _ in range(2))
-    per_iter = max((t_big - t_small) / iters, 1e-9)
-    log(f"bench: fit(2)={t_small*1e3:.0f} ms, fit({2+iters})="
-        f"{t_big*1e3:.0f} ms -> {per_iter*1e3:.2f} ms/iter steady-state")
-    if t_big - t_small <= 0.05:
+    # Median-of-3 marginal measurements (r1 VERDICT #8): the tunneled
+    # single-chip environment shows ~±20% run-to-run wall-clock variance,
+    # so a single marginal is not trustworthy.  Interleaving each
+    # (small, big) pair keeps every marginal internally consistent under
+    # slow drift; the JSON carries the relative spread so downstream
+    # readers can see the measurement quality.
+    margins = []
+    for rep in range(3):
+        t_small = timed_fit(fit_small, points, weights, cents)
+        t_big = timed_fit(fit_big, points, weights, cents)
+        margins.append(max(t_big - t_small, 1e-9))
+        log(f"bench: rep {rep + 1}/3: fit(2)={t_small*1e3:.0f} ms, "
+            f"fit({2+iters})={t_big*1e3:.0f} ms -> "
+            f"{margins[-1]/iters*1e3:.2f} ms/iter")
+    margin = float(np.median(margins))
+    per_iter = margin / iters
+    spread = (max(margins) - min(margins)) / margin
+    log(f"bench: median {per_iter*1e3:.2f} ms/iter, spread "
+        f"{spread*100:.0f}% over 3 reps")
+    if margin <= 0.05:
         log("bench: WARNING: marginal time is within dispatch-latency "
             "noise (~50 ms) — raise BENCH_N/BENCH_ITERS for a trustworthy "
             "number (python -m kmeans_tpu bench does this adaptively)")
@@ -130,6 +142,8 @@ def main() -> None:
         "value": round(throughput, 1),
         "unit": "points*dims/sec/chip",
         "vs_baseline": round(throughput * n_chips / base, 2),
+        "ms_per_iter": round(per_iter * 1e3, 3),
+        "spread": round(spread, 3),
     }))
 
 

@@ -105,20 +105,30 @@ def bench_config(name: str, iters: int, mode: str) -> Dict:
     while True:
         fit_big = build(2 + iters)
         _, out_big = timed(fit_big)                  # compile + warm
-        t_small = min(timed(fit_small)[0] for _ in range(2))
-        t_big = min(timed(fit_big)[0] for _ in range(2))
+        t_small = timed(fit_small)[0]
+        t_big = timed(fit_big)[0]
         if t_big - t_small > 0.05 or iters >= 2000:
             break
         iters *= 5
         _log(f"[{name}] marginal below noise floor; retrying with "
              f"iters={iters}")
-    noise_limited = (t_big - t_small) <= 0.05   # same floor as the loop
+    # Median-of-3 interleaved marginals + relative spread (r1 VERDICT #8):
+    # the environment shows ~±20% run-to-run variance, so one marginal is
+    # not a measurement.  The adaptive loop's last pair is the first rep.
+    margins = [max(t_big - t_small, 1e-9)]
+    for _ in range(2):
+        ts = timed(fit_small)[0]
+        tb = timed(fit_big)[0]
+        margins.append(max(tb - ts, 1e-9))
+    margin = float(np.median(margins))
+    spread = (max(margins) - min(margins)) / margin
+    noise_limited = margin <= 0.05              # same floor as the loop
     if noise_limited:
-        _log(f"[{name}] WARNING: marginal time ({t_big - t_small:.3f}s over "
+        _log(f"[{name}] WARNING: marginal time ({margin:.3f}s over "
              f"{iters} iters) is within dispatch-latency noise — "
              f"per-iteration numbers are unmeasurable at this size and are "
              f"reported as null")
-    per_iter = (t_big - t_small) / iters
+    per_iter = margin / iters
     sse = float(np.asarray(out_big[2])[-1])          # last-iteration SSE
     n_chips = max(1, len(jax.devices()))
     result = {
@@ -127,6 +137,7 @@ def bench_config(name: str, iters: int, mode: str) -> Dict:
         "ms_per_iter": None if noise_limited else round(per_iter * 1e3, 4),
         "throughput_pd_per_sec_per_chip": None if noise_limited else
         round(n * d / per_iter / n_chips, 1),
+        "spread": None if noise_limited else round(spread, 3),
         "sse": sse,
         "noise_limited": noise_limited,
     }
