@@ -5,24 +5,53 @@ This variant (Sculley 2010-style) reuses the same fused SPMD step on a seeded
 per-iteration sample and applies per-center count-weighted incremental
 updates — useful when N is far larger than one pass per iteration justifies.
 Shares every guard and logging behavior with :class:`KMeans`.
+
+Two sampling engines (``sampling=`` constructor arg):
+
+* ``'device'`` (default) — the dataset is uploaded ONCE (or passed as an
+  already-resident :class:`ShardedDataset`, host copy not required) and each
+  iteration draws its batch on device via seeded Gumbel top-k inside the
+  same dispatch that computes the batch statistics
+  (``parallel.distributed.make_minibatch_step_fn``).  No per-iteration
+  host->device traffic at all — the r1 host path was dispatch/transfer-bound
+  on tunneled chips (r1 VERDICT #4).
+* ``'host'`` — the r1 behavior: per-iteration host ``rng.choice`` + batch
+  upload.  Still the right engine when X is larger than device memory
+  (only one batch is ever resident).
+
+``host_loop=False`` additionally runs ALL iterations in one dispatch
+(``make_minibatch_fit_fn`` — the mini-batch analogue of the flagship
+``make_fit_fn`` loop).  Measured on a tunneled v5e at N=2M, D=128, k=1024,
+batch 65536: 3.1 ms/iter on-device loop vs 105 ms/iter per-iteration
+dispatches vs ~1.8 s/iter for the r1 host-upload path.
+
+Both engines derive iteration i's randomness purely from ``(seed, i)``, so
+checkpoint/resume continues the exact batch sequence; their RNG streams
+differ, so trajectories are not comparable ACROSS engines (each is
+bit-deterministic within itself).
 """
 
 from __future__ import annotations
 
+import time
+from typing import Optional
+
 import numpy as np
 
-from kmeans_tpu.models.kmeans import KMeans
+from kmeans_tpu.models.kmeans import KMeans, _STEP_CACHE
 from kmeans_tpu.models.init import resolve_init
 from kmeans_tpu.utils.logging import IterationLogger
 
+_SAMPLING = ("device", "host")
+
 
 class MiniBatchKMeans(KMeans):
-    _PARAM_NAMES = KMeans._PARAM_NAMES + ("batch_size",)
+    _PARAM_NAMES = KMeans._PARAM_NAMES + ("batch_size", "sampling")
 
     def __init__(self, k: int = 3, max_iter: int = 100,
                  tolerance: float = 1e-4, seed: int = 42,
                  compute_sse: bool = False, *, batch_size: int = 4096,
-                 **kwargs):
+                 sampling: str = "device", **kwargs):
         super().__init__(k, max_iter, tolerance, seed, compute_sse, **kwargs)
         if self.n_init != 1:
             raise ValueError("MiniBatchKMeans does not support n_init > 1; "
@@ -30,14 +59,164 @@ class MiniBatchKMeans(KMeans):
                              "inertia")
         if batch_size <= 0:
             raise ValueError(f"batch_size must be positive, got {batch_size}")
+        if sampling not in _SAMPLING:
+            raise ValueError(f"sampling must be one of {_SAMPLING}, "
+                             f"got {sampling!r}")
         self.batch_size = batch_size
+        self.sampling = sampling
+
+    # ------------------------------------------------------------------- fit
 
     def fit(self, X, y=None, *, resume: bool = False) -> "MiniBatchKMeans":
+        if self.sampling == "host":
+            return self._fit_host(X, resume=resume)
+        return self._fit_device(X, resume=resume)
+
+    def _resume_or_init(self, init_src, resume: bool):
+        """Shared fit prelude: (centroids float64, start_iter, seen)."""
+        if resume and self.centroids is not None:
+            return (np.asarray(self.centroids, dtype=np.float64),
+                    self.iterations_run,
+                    np.asarray(self._seen, dtype=np.float64))
+        centroids = resolve_init(
+            self.init, init_src, self.k, self.seed).astype(np.float64)
+        self.sse_history = []
+        self.iterations_run = 0
+        return centroids, 0, np.zeros(self.k)
+
+    def _fit_device(self, X, *, resume: bool) -> "MiniBatchKMeans":
+        """On-device sampling engine: resident dataset, one dispatch per
+        iteration (sampling + batch statistics fused)."""
+        import jax
+        from kmeans_tpu.parallel import distributed as dist
+        from kmeans_tpu.parallel.mesh import mesh_shape
+
+        ds = self._dataset(X)                  # host copy NOT required
+        mesh = self._resolve_mesh()
+        data_shards, model_shards = mesh_shape(mesh)
+        bs = min(self.batch_size, ds.n)
+        # Rounded up: every shard contributes the same (>= 8-row sublane-
+        # aligned) count, so the effective batch is bs_local * data_shards.
+        bs_local = max(8, -(-bs // data_shards))
+        log = IterationLogger(self.verbose and jax.process_index() == 0)
+
+        self._set_fit_data(ds)                 # feeds lazy labels_
+        if not ds.points.is_fully_addressable:
+            self._fit_ds, self._labels_cache = None, None
+            self._labels_error = (
+                "labels_ is not available for a multi-host process-local "
+                "fit (labels would span non-addressable devices); call "
+                "predict on each process's local rows")
+        centroids, start_iter, seen = self._resume_or_init(ds, resume)
+        if start_iter == 0:
+            self.iter_times_ = []
+        log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
+        base_key = jax.random.PRNGKey(self.seed)
+
+        if not self.host_loop:
+            return self._fit_device_loop(ds, mesh, model_shards, bs_local,
+                                         centroids, start_iter, seen,
+                                         base_key, log)
+
+        cache_key = (mesh, bs_local, self.distance_mode, "mbstep")
+        if cache_key not in _STEP_CACHE:
+            _STEP_CACHE[cache_key] = dist.make_minibatch_step_fn(
+                mesh, batch_per_shard=bs_local, mode=self.distance_mode)
+        step_fn = _STEP_CACHE[cache_key]
+        # Scale factor target: total dataset weight (== n when unweighted).
+        total_w = float(np.asarray(
+            jax.jit(lambda w: w.sum())(ds.weights)))
+
+        for iteration in range(start_iter, self.max_iter):
+            t0 = time.perf_counter()
+            # Batch i is a pure function of (seed, i): resume continues the
+            # exact sequence an uninterrupted run would draw.
+            stats = step_fn(ds.points, ds.weights,
+                            self._put_centroids(
+                                centroids.astype(self.dtype), mesh,
+                                model_shards),
+                            base_key, np.int32(iteration))
+            # One combined transfer (each separate np.asarray pays a full
+            # host round trip on tunneled platforms).
+            sums_d, counts_d, sse_d = jax.device_get(
+                (stats.sums, stats.counts, stats.sse))
+            sums = np.asarray(sums_d, dtype=np.float64)[: self.k]
+            counts = np.asarray(counts_d, dtype=np.float64)[: self.k]
+            batch_w = float(counts.sum())
+            centroids, seen, max_shift = self._apply_batch_stats(
+                sums, counts, centroids, seen, iteration, log,
+                sse=float(sse_d),
+                sse_scale=total_w / max(batch_w, 1.0))
+            self.iter_times_.append(time.perf_counter() - t0)
+            if max_shift < self.tolerance:
+                log.converged(iteration + 1)
+                break
+        return self
+
+    def _fit_device_loop(self, ds, mesh, model_shards, bs_local, centroids,
+                         start_iter, seen, base_key,
+                         log) -> "MiniBatchKMeans":
+        """Whole-mini-batch-fit-in-one-dispatch (``host_loop=False``): no
+        per-iteration host sync at all — on tunneled chips the per-
+        iteration path is dispatch-bound (~5 round trips/iter vs sub-ms
+        batch compute).  Same key schedule as the per-iteration path, so
+        the two produce the same batch sequence."""
+        import jax
+        from kmeans_tpu.parallel import distributed as dist
+
+        iters_left = self.max_iter - start_iter
+        if iters_left <= 0:
+            return self
+        cache_key = (mesh, bs_local, self.distance_mode, self.k, iters_left,
+                     float(self.tolerance), self.compute_sse, "mbfit")
+        if cache_key not in _STEP_CACHE:
+            _STEP_CACHE[cache_key] = dist.make_minibatch_fit_fn(
+                mesh, batch_per_shard=bs_local, mode=self.distance_mode,
+                k_real=self.k, max_iter=iters_left,
+                tolerance=float(self.tolerance),
+                history_sse=self.compute_sse)
+        fit_fn = _STEP_CACHE[cache_key]
+        cents_dev = self._put_centroids(centroids.astype(self.dtype), mesh,
+                                        model_shards)
+        t0 = time.perf_counter()
+        cents, seen_out, n_iters, sse_hist, shift_hist, counts = fit_fn(
+            ds.points, ds.weights, cents_dev, base_key,
+            np.int32(start_iter), np.asarray(seen, dtype=self.dtype))
+        n_iters = int(n_iters)
+        elapsed = time.perf_counter() - t0
+
+        self.centroids = np.asarray(cents, dtype=self.dtype)
+        if not np.all(np.isfinite(self.centroids)):
+            raise ValueError(
+                f"NaN or Inf detected in centroids at iteration "
+                f"{start_iter + n_iters}")
+        self._seen = np.asarray(seen_out, dtype=np.float64)
+        self.cluster_sizes_ = np.asarray(counts, dtype=np.int64)
+        self.iterations_run = start_iter + n_iters
+        self.iter_times_.extend([elapsed / max(n_iters, 1)] * n_iters)
+        sse_hist = np.asarray(sse_hist, dtype=np.float64)[:n_iters]
+        shift_hist = np.asarray(shift_hist, dtype=np.float64)[:n_iters]
+        if self.compute_sse:
+            self.sse_history.extend(float(s) for s in sse_hist)
+        log.iteration(self.iterations_run - 1,
+                      float(shift_hist[-1]) if n_iters else 0.0,
+                      list(self.cluster_sizes_),
+                      self.sse_history[-1] if
+                      (self.compute_sse and self.sse_history) else None)
+        if n_iters and shift_hist[-1] < self.tolerance:
+            log.converged(self.iterations_run)
+        return self
+
+    def _fit_host(self, X, y=None, *,
+                  resume: bool = False) -> "MiniBatchKMeans":
+        """Host sampling engine (the r1 path): per-iteration host
+        ``rng.choice`` + batch upload.  Use when X exceeds device memory."""
         from kmeans_tpu.parallel.sharding import ShardedDataset
         if isinstance(X, ShardedDataset):
             if X.host is None:
-                raise ValueError("MiniBatchKMeans needs host data to draw "
-                                 "batches; pass a NumPy array")
+                raise ValueError("sampling='host' needs host data to draw "
+                                 "batches; pass a NumPy array or use "
+                                 "sampling='device'")
             X = X.host
         X = np.ascontiguousarray(np.asarray(X, dtype=self.dtype))
         if X.ndim != 2:
@@ -48,18 +227,7 @@ class MiniBatchKMeans(KMeans):
         import jax
         log = IterationLogger(self.verbose and jax.process_index() == 0)
 
-        if resume and self.centroids is not None:
-            centroids = np.asarray(self.centroids, dtype=np.float64)
-            start_iter = self.iterations_run
-            seen = np.asarray(self._seen, dtype=np.float64)
-        else:
-            centroids = resolve_init(
-                self.init, X, self.k, self.seed).astype(np.float64)
-            self.sse_history = []
-            self.iterations_run = 0
-            start_iter = 0
-            seen = np.zeros(self.k)    # lifetime per-center counts
-
+        centroids, start_iter, seen = self._resume_or_init(X, resume)
         log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
 
         for iteration in range(start_iter, self.max_iter):
@@ -81,10 +249,9 @@ class MiniBatchKMeans(KMeans):
     def _incremental_update(self, batch: np.ndarray, centroids: np.ndarray,
                             seen: np.ndarray, iteration: int,
                             log: IterationLogger, sse_scale: float = 1.0):
-        """One Sculley update from one batch: fused stats on device, then
-        per-center count-weighted interpolation on the host.  Shared by
-        ``fit`` (seeded internal batches) and ``partial_fit`` (caller-
-        provided batches)."""
+        """One Sculley update from one HOST batch: fused stats on device,
+        then the count-weighted interpolation.  Used by the host sampling
+        engine and ``partial_fit`` (caller-provided batches)."""
         bs, d = batch.shape
         mesh, model_shards, step_fn, _, chunk = self._setup(bs, d)
         from kmeans_tpu.parallel.sharding import shard_points
@@ -93,7 +260,18 @@ class MiniBatchKMeans(KMeans):
             centroids.astype(self.dtype), mesh, model_shards))
         sums = np.asarray(stats.sums, dtype=np.float64)[: self.k]
         counts = np.asarray(stats.counts, dtype=np.float64)[: self.k]
+        return self._apply_batch_stats(sums, counts, centroids, seen,
+                                       iteration, log,
+                                       sse=float(stats.sse),
+                                       sse_scale=sse_scale)
 
+    def _apply_batch_stats(self, sums: np.ndarray, counts: np.ndarray,
+                           centroids: np.ndarray, seen: np.ndarray,
+                           iteration: int, log: IterationLogger, *,
+                           sse: float, sse_scale: float):
+        """Host-side Sculley update from one batch's (sums, counts, sse):
+        per-center count-weighted interpolation with lifetime ``seen``
+        counts, guards and logging shared by both sampling engines."""
         seen += counts
         eta = np.divide(counts, np.maximum(seen, 1.0))[:, None]
         batch_mean = sums / np.maximum(counts, 1.0)[:, None]
@@ -106,8 +284,7 @@ class MiniBatchKMeans(KMeans):
                 f"NaN or Inf detected in centroids at iteration "
                 f"{iteration + 1}")
         if self.compute_sse:
-            sse = float(stats.sse) * sse_scale   # scaled batch estimate
-            self.sse_history.append(sse)
+            self.sse_history.append(sse * sse_scale)  # scaled batch estimate
 
         max_shift = float(np.max(np.linalg.norm(
             new_centroids - centroids, axis=1)))
@@ -169,6 +346,7 @@ class MiniBatchKMeans(KMeans):
     def _state_dict(self) -> dict:
         state = super()._state_dict()
         state["batch_size"] = self.batch_size
+        state["sampling"] = self.sampling
         state["seen_counts"] = np.asarray(getattr(self, "_seen",
                                                   np.zeros(self.k)))
         return state
@@ -179,4 +357,5 @@ class MiniBatchKMeans(KMeans):
 
     @classmethod
     def _load_kwargs(cls, state: dict) -> dict:
-        return {"batch_size": state["batch_size"]}
+        return {"batch_size": state["batch_size"],
+                "sampling": state.get("sampling", "device")}

@@ -425,6 +425,196 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
     return jax.jit(mapped)
 
 
+def make_minibatch_step_fn(mesh: Mesh, *, batch_per_shard: int,
+                           mode: str = "matmul") -> Callable:
+    """Build the fused ON-DEVICE mini-batch iteration:
+    (points, weights, centroids, key) -> StepStats of a freshly-sampled
+    batch — sampling AND statistics in ONE dispatch.
+
+    Replaces the r1 host path (per-iteration ``rng.choice`` + full batch
+    re-upload, r1 VERDICT #4): each data shard draws ``batch_per_shard``
+    of its own resident rows, gathers them shard-locally — no cross-shard
+    traffic — and feeds them through the same ``_local_stats`` pass as the
+    full-batch step.  On a tunneled chip this removes the per-iteration
+    batch upload that made the host path transfer-bound.
+
+    Sampling: STRATIFIED without replacement, O(batch) — the shard's
+    ``n_local`` rows are split into ``batch_per_shard`` contiguous strata,
+    one uniform row is drawn per stratum, and a per-iteration uniform
+    rotation of the whole index space makes every row reachable across
+    iterations (without it, the ``n_local mod batch`` tail rows would
+    never be sampled).  A Gumbel top-k draw (exact uniform w/o
+    replacement, as in ``models.init._kmeanspp_device``) was measured
+    first and REJECTED: its sort over the full shard cost ~330 ms/iter at
+    N=2M on a v5e — more than 100x the batch's actual compute.  Each
+    point's marginal inclusion probability remains uniform; the joint
+    constraint (one row per rotated stratum) is harmless for Sculley
+    updates (sklearn's MiniBatchKMeans samples WITH replacement, an even
+    weaker guarantee).  Zero-weight (padding) rows can be selected but
+    carry weight 0 into every statistic.  The draw is a pure function of
+    (key, shard index) and is replicated across the model axis (the key
+    folds in the DATA index only, so model replicas gather identical
+    rows).
+
+    Returned stats are replicated like ``make_step_fn``'s (sums, counts,
+    sse over the batch; farthest/per-cluster elided — the Sculley update
+    uses none of them).
+    """
+    data_shards, model_shards = mesh_shape(mesh)
+
+    def step(points, weights, centroids_block, key, iteration):
+        k_local, d = centroids_block.shape
+        acc = _accum_dtype(points.dtype)
+        bx, bw = _sample_batch(points, weights,
+                               jax.random.fold_in(key, iteration),
+                               batch_per_shard, data_shards)
+        st = _local_stats(bx, bw, centroids_block,
+                          chunk_size=batch_per_shard, mode=mode,
+                          model_shards=model_shards, need_sse=True,
+                          need_farthest=False, need_sse_pc=False)
+        m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
+        k = k_local * model_shards
+        off = jnp.asarray(m_idx * k_local, jnp.int32)
+        axes = (DATA_AXIS, MODEL_AXIS)
+        sums = lax.psum(lax.dynamic_update_slice(
+            jnp.zeros((k, d), st.sums.dtype), st.sums,
+            (off, jnp.int32(0))), axes)
+        counts = lax.psum(lax.dynamic_update_slice(
+            jnp.zeros((k,), st.counts.dtype), st.counts, (off,)), axes)
+        sse = lax.psum(st.sse, axes) / model_shards
+        zero = jnp.zeros((), acc)
+        return StepStats(sums, counts, sse, zero,
+                         jnp.zeros((d,), acc), jnp.zeros((k,), acc))
+
+    mapped = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(MODEL_AXIS, None),
+                  P(None), P()),
+        out_specs=StepStats(P(None, None), P(None), P(), P(), P(None),
+                            P(None)),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+def _sample_batch(points, weights, key, batch_per_shard: int,
+                  data_shards: int):
+    """Shard-local stratified batch draw (see make_minibatch_step_fn's
+    docstring for the design rationale and the rejected Gumbel top-k
+    alternative).  Returns (bx (bs_local, D), bw (bs_local,))."""
+    d_idx = lax.axis_index(DATA_AXIS) if data_shards > 1 else 0
+    shard_key = jax.random.fold_in(key, d_idx)
+    n_local = points.shape[0]
+    stratum = n_local // batch_per_shard         # >= 1: caller guarantees
+    k_rot, k_row = jax.random.split(shard_key)
+    rho = jax.random.randint(k_rot, (), 0, n_local, dtype=jnp.int32)
+    r = jax.random.randint(k_row, (batch_per_shard,), 0, stratum,
+                           dtype=jnp.int32)
+    offs = jnp.arange(batch_per_shard, dtype=jnp.int32) * stratum
+    idx = (offs + r + rho) % n_local             # distinct mod-n_local rows
+    return points[idx], weights[idx]
+
+
+def make_minibatch_fit_fn(mesh: Mesh, *, batch_per_shard: int,
+                          mode: str = "matmul", k_real: int, max_iter: int,
+                          tolerance: float, history_sse: bool = True):
+    """Build the FULLY ON-DEVICE mini-batch training loop: ALL iterations
+    (sampling + batch stats + Sculley update) in ONE dispatch under
+    ``lax.while_loop`` — the mini-batch analogue of ``make_fit_fn``.
+
+    On a tunneled chip the per-iteration path costs ~5 host round trips
+    per iteration (key fold, centroid upload, stat transfers) while the
+    batch's actual compute is sub-millisecond, so the whole fit is
+    dispatch-bound; this removes every per-iteration sync.  Same
+    trade-offs as ``make_fit_fn``: no per-iteration host logging
+    (histories returned as arrays) and the Sculley interpolation runs in
+    the accumulation dtype on device (the host loop interpolates in
+    float64).
+
+    ``iter0`` offsets the sampling keys so a resumed fit draws the SAME
+    batch sequence an uninterrupted run would (checkpoint continuity);
+    ``seen0`` carries the lifetime per-center counts across resumes.
+
+    Returns ``fit(points, weights, centroids0, key, iter0, seen0) ->
+    (centroids, seen, n_iters, sse_hist[max_iter], shift_hist[max_iter],
+    counts_last)`` with everything replicated.  ``sse_hist`` entries are
+    scaled batch estimates (total weight / batch weight), matching the
+    host path.
+    """
+    data_shards, model_shards = mesh_shape(mesh)
+
+    def fit(points, weights, cents_block, key, iter0, seen0):
+        k_local, d = cents_block.shape
+        acc = _accum_dtype(points.dtype)
+        k_pad = k_local * model_shards
+        m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
+        real = jnp.arange(k_pad) < k_real
+        axes = (DATA_AXIS, MODEL_AXIS)
+        w_total = lax.psum(jnp.sum(weights.astype(acc)),
+                           axes) / model_shards
+
+        def batch_stats(cents_full, i):
+            blk = lax.dynamic_slice(
+                cents_full, (jnp.asarray(m_idx * k_local, jnp.int32),
+                             jnp.int32(0)), (k_local, d))
+            bx, bw = _sample_batch(
+                points, weights, jax.random.fold_in(key, iter0 + i),
+                batch_per_shard, data_shards)
+            st = _local_stats(bx, bw, blk.astype(points.dtype),
+                              chunk_size=batch_per_shard, mode=mode,
+                              model_shards=model_shards,
+                              need_sse=history_sse, need_farthest=False,
+                              need_sse_pc=False)
+            off = jnp.asarray(m_idx * k_local, jnp.int32)
+            sums = lax.psum(lax.dynamic_update_slice(
+                jnp.zeros((k_pad, d), acc), st.sums,
+                (off, jnp.int32(0))), axes)
+            counts = lax.psum(lax.dynamic_update_slice(
+                jnp.zeros((k_pad,), acc), st.counts, (off,)), axes)
+            sse = (lax.psum(st.sse, axes) / model_shards
+                   if history_sse else st.sse)
+            return sums, counts, sse
+
+        def body(state):
+            i, cents, seen, _, sse_hist, shift_hist, _ = state
+            sums, counts, sse = batch_stats(cents, i)
+            seen = seen + counts
+            eta = (counts / jnp.maximum(seen, 1.0))[:, None]
+            bmean = sums / jnp.maximum(counts, 1.0)[:, None]
+            new = jnp.where((counts > 0)[:, None],
+                            (1.0 - eta) * cents + eta * bmean, cents)
+            shifts = jnp.sqrt(jnp.sum((new - cents) ** 2, axis=1))
+            max_shift = jnp.max(jnp.where(real, shifts, 0.0))
+            batch_w = jnp.sum(jnp.where(real, counts, 0.0))
+            sse_hist = sse_hist.at[i].set(
+                sse * w_total / jnp.maximum(batch_w, 1.0))
+            shift_hist = shift_hist.at[i].set(max_shift)
+            return i + 1, new, seen, max_shift, sse_hist, shift_hist, counts
+
+        def cond(state):
+            i, _, _, max_shift, *_ = state
+            return (i < max_iter) & ((i == 0) | (max_shift >= tolerance))
+
+        cents0 = lax.all_gather(cents_block, MODEL_AXIS,
+                                tiled=True).astype(acc) \
+            if model_shards > 1 else cents_block.astype(acc)
+        seen_pad = jnp.pad(seen0.astype(acc), (0, k_pad - k_real))
+        state = (jnp.int32(0), cents0, seen_pad, jnp.asarray(jnp.inf, acc),
+                 jnp.zeros((max_iter,), acc), jnp.zeros((max_iter,), acc),
+                 jnp.zeros((k_pad,), acc))
+        i, cents, seen, _, sse_hist, shift_hist, counts = lax.while_loop(
+            cond, body, state)
+        return (cents[:k_real], seen[:k_real], i, sse_hist, shift_hist,
+                counts[:k_real])
+
+    mapped = jax.shard_map(
+        fit, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(MODEL_AXIS, None),
+                  P(None), P(), P(None)),
+        out_specs=(P(None, None), P(None), P(), P(None), P(None), P(None)),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
 def make_predict_fn(mesh: Mesh, *, chunk_size: int,
                     mode: str = "matmul") -> Callable:
     """Build the jitted SPMD label assignment: (points, centroids) -> labels.
