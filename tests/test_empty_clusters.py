@@ -50,3 +50,40 @@ def test_farthest_policy_uses_a_data_point(mesh8):
     # The empty slot was refilled with an actual data point.
     replaced = km.centroids[2]
     assert np.any(np.all(np.isclose(X, replaced[None, :], atol=1e-9), axis=1))
+
+
+def test_resample_hostless_dataset_uses_device_sampler(tight_blobs, mesh8):
+    """A dataset with no host copy routes 'resample' through the on-device
+    Gumbel-argmax sampler (r1 VERDICT #6) — refills must be real data rows
+    and two runs must agree bit-for-bit."""
+    X = tight_blobs.astype(np.float32)
+
+    def run():
+        km = KMeans(k=6, max_iter=30, seed=42, empty_cluster="resample",
+                    mesh=mesh8, verbose=False)
+        ds = km.cache(X)
+        ds._host = None                    # simulate device-only data
+        ds._host_weights = None
+        return km.fit(ds)
+
+    a, b = run(), run()
+    assert np.all(np.isfinite(a.centroids))
+    np.testing.assert_array_equal(a.centroids, b.centroids)
+
+
+def test_sample_positive_rows_device_path_draws_data_rows(mesh8):
+    from kmeans_tpu.parallel.sharding import to_device
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(300, 4)).astype(np.float32)
+    ds = to_device(X, mesh8, 32, np.float32)
+    ds._host = None
+    ds._host_weights = None
+    rows = ds.sample_positive_rows(3, [42, 1])
+    assert rows.shape == (3, 4)
+    for row in rows:                        # each drawn row is a real row
+        assert np.any(np.all(np.isclose(X, row[None, :], atol=1e-6),
+                             axis=1))
+    rows2 = ds.sample_positive_rows(3, [42, 1])
+    np.testing.assert_array_equal(rows, rows2)      # seeded -> identical
+    # distinct rows (without replacement)
+    assert len(np.unique(rows.round(6), axis=0)) == 3
