@@ -409,8 +409,9 @@ class KMeans:
 
         seeds = self._restart_seeds()
 
-        # Batched restarts: one dispatch for the whole n_init sweep.
-        if len(seeds) > 1 and not self.host_loop and model_shards == 1:
+        # Batched restarts: one dispatch for the whole n_init sweep
+        # (composes with model-axis centroid sharding, r1 VERDICT #3).
+        if len(seeds) > 1 and not self.host_loop:
             return self._fit_on_device_multi(ds, seeds, mesh, log)
 
         best = None
@@ -725,9 +726,11 @@ class KMeans:
                 empty_policy=self.empty_cluster, n_init=R,
                 history_sse=self.compute_sse, seed=self.seed)
         fit_fn = _STEP_CACHE[key]
-        inits = np.stack([self._init_centroids(ds, s) for s in seeds])
+        _, model_shards = mesh_shape(mesh)
+        inits = np.stack([dist.pad_centroids(
+            self._init_centroids(ds, s), model_shards) for s in seeds])
         cents_dev = jax.device_put(
-            inits, NamedSharding(mesh, P(None, None, None)))
+            inits, NamedSharding(mesh, P(None, MODEL_AXIS, None)))
         self.sse_history = []
         self.iterations_run = 0
         self.iter_times_ = []

@@ -74,22 +74,61 @@ def _model_axis_select(model_shards: int):
 PALLAS_MODES = ("pallas", "pallas_bf16")
 
 
-def _pallas_local_stats(points, weights, centroids_block, *, mode: str):
+def _pallas_local_stats(points, weights, centroids_block, *, mode: str,
+                        model_shards: int = 1, chunk_size: int = 512):
     """Shard-local pass via the fused Pallas kernel (ops.pallas_kernels):
     one Mosaic kernel per shard instead of the XLA scan.  f32 compute
     (bf16 matmuls for 'pallas_bf16'); falls back to the Pallas interpreter
-    off-TPU so the same code path is CI-testable."""
-    from kmeans_tpu.ops.pallas_kernels import fused_assign_reduce
+    off-TPU so the same code path is CI-testable.
+
+    Under centroid (model-axis) sharding the kernel runs in its
+    assignment-only form (``pallas_assign``): the GLOBAL argmin is
+    reconstructed from an all_gather of per-block minima, then the one-hot
+    accumulation runs as an ownership-masked XLA chunk scan — fusing it
+    into the kernel against the LOCAL block would mis-accumulate points
+    whose true winner lives in another shard's block (r1 VERDICT #3)."""
+    from kmeans_tpu.ops.pallas_kernels import (fused_assign_reduce,
+                                               pallas_assign)
     acc = _accum_dtype(points.dtype)
     interpret = jax.default_backend() != "tpu"
-    labels, mind2, sums, counts = fused_assign_reduce(
-        points, weights, centroids_block,
-        bf16=(mode == "pallas_bf16"), interpret=interpret)
+    bf16 = (mode == "pallas_bf16")
+    k_local, d = centroids_block.shape
     w = weights.astype(jnp.float32)
-    sse = jnp.sum(mind2 * w).astype(acc)
-    sse_pc = jax.ops.segment_sum(
-        mind2 * w, labels, num_segments=centroids_block.shape[0]).astype(acc)
-    masked = jnp.where(w > 0, mind2, -jnp.inf)
+    if model_shards <= 1:
+        labels, gmind2, sums, counts = fused_assign_reduce(
+            points, weights, centroids_block, bf16=bf16,
+            interpret=interpret)
+        w_eff = w
+    else:
+        labels, mind2 = pallas_assign(points, centroids_block, bf16=bf16,
+                                      interpret=interpret)
+        minds = lax.all_gather(mind2, MODEL_AXIS)          # (m, n_local)
+        owner = jnp.argmin(minds, axis=0)
+        gmind2 = jnp.min(minds, axis=0)
+        m_idx = lax.axis_index(MODEL_AXIS)
+        w_eff = w * (owner == m_idx)                       # ownership mask
+        n_chunks = points.shape[0] // chunk_size
+        xs = (points.reshape(n_chunks, chunk_size, d),
+              labels.reshape(n_chunks, chunk_size),
+              w_eff.reshape(n_chunks, chunk_size))
+        ids = jnp.arange(k_local, dtype=labels.dtype)
+
+        def body(carry, chk):
+            s, cnt = carry
+            xc, lc, wc = chk
+            oh = (lc[:, None] == ids[None, :]) * wc[:, None]
+            s = s + lax.dot_general(oh, xc.astype(jnp.float32),
+                                    (((0,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            return (s, cnt + jnp.sum(oh, axis=0)), None
+
+        (sums, counts), _ = lax.scan(
+            body, (jnp.zeros((k_local, d), jnp.float32),
+                   jnp.zeros((k_local,), jnp.float32)), xs)
+    sse = jnp.sum(gmind2 * w).astype(acc)        # global min: /m later
+    sse_pc = jax.ops.segment_sum(                # ownership-masked: psum-safe
+        gmind2 * w_eff, labels, num_segments=k_local).astype(acc)
+    masked = jnp.where(w > 0, gmind2, -jnp.inf)
     i = jnp.argmax(masked)
     far_d = jnp.where(jnp.any(w > 0), masked[i], -1.0).astype(acc)
     far_p = points[i].astype(acc)
@@ -107,11 +146,9 @@ def _local_stats(points, weights, centroids_block, *, chunk_size, mode,
     reconstructed across the model axis.  The ``need_*`` flags elide the
     optional statistics' compute (see ``accumulate_chunk``)."""
     if mode in PALLAS_MODES:
-        if model_shards > 1:
-            raise ValueError("pallas modes do not support centroid (model-"
-                             "axis) sharding yet; use mode='matmul'")
         return _pallas_local_stats(points, weights, centroids_block,
-                                   mode=mode)[0]
+                                   mode=mode, model_shards=model_shards,
+                                   chunk_size=chunk_size)[0]
     k_local, d = centroids_block.shape
     acc = _accum_dtype(points.dtype)
     n_chunks = points.shape[0] // chunk_size
@@ -364,9 +401,10 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
     in-loop SSE history lags one iteration by reference semantics,
     kmeans_spark.py:279) and the argmin restart wins.
 
-    Restrictions: ``model`` axis must be size 1 (restarts and centroid-table
-    sharding both multiply the k axis; compose them later if a k-sharded
-    multi-restart config ever matters).  ``empty_policy`` may be any of
+    ``model``-axis (centroid-table) sharding composes with the restart
+    batch (r1 VERDICT #3): blocks arrive (R, k_local, D) sharded on axis 1,
+    each shard scores points against its block only, and the loop carries
+    the gathered full table per restart.  ``empty_policy`` may be any of
     'keep' / 'farthest' / 'resample' — resample draws are keyed per
     (iteration, restart), so restarts refill independently.
 
@@ -380,36 +418,48 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
             f"'resample', got {empty_policy!r}")
     rng_key = jax.random.PRNGKey(seed)
     data_shards, model_shards = mesh_shape(mesh)
-    if model_shards > 1:
-        raise ValueError("multi-restart device loop requires model axis of "
-                         "size 1 (got {}); restarts are run sequentially "
-                         "under centroid sharding".format(model_shards))
 
-    def fit(points, weights, cents0):
-        # cents0: (R, k, d), replicated on every shard.
+    def fit(points, weights, cents0_blocks):
+        # cents0_blocks: (R, k_local, d), k axis sharded on MODEL.
         acc = _accum_dtype(points.dtype)
-        R, k, d = cents0.shape
+        R, k_local, d = cents0_blocks.shape
+        k_pad = k_local * model_shards
+        m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
+        real = jnp.arange(k_pad) < k_real          # mask off sentinel rows
+        axes = (DATA_AXIS, MODEL_AXIS)
 
         need_farthest = (empty_policy == "farthest")
 
         def all_stats(cents, need_sse):
             """Global per-restart stats: vmap the shard-local pass over R
-            (no collectives inside the vmap), then psum the stacked
-            accumulators over the data axis.  Optional statistics are
-            elided per the need flags (see ``accumulate_chunk``)."""
-            def local(c):
-                return _local_stats(points, weights, c,
+            (collectives vectorize over the restart batch), slicing each
+            restart's centroid block from its full table, then psum the
+            embedded accumulators over both mesh axes.  Optional
+            statistics are elided per the need flags."""
+            def local(c_full):
+                blk = lax.dynamic_slice(
+                    c_full, (jnp.asarray(m_idx * k_local, jnp.int32),
+                             jnp.int32(0)), (k_local, d))
+                return _local_stats(points, weights,
+                                    blk.astype(points.dtype),
                                     chunk_size=chunk_size, mode=mode,
-                                    model_shards=1, need_sse=need_sse,
+                                    model_shards=model_shards,
+                                    need_sse=need_sse,
                                     need_farthest=need_farthest,
                                     need_sse_pc=False)
             st = jax.vmap(local)(cents)
-            sums = lax.psum(st.sums, DATA_AXIS)            # (R, k, d)
-            counts = lax.psum(st.counts, DATA_AXIS)        # (R, k)
-            sse = lax.psum(st.sse, DATA_AXIS) if need_sse else st.sse
+            off = jnp.asarray(m_idx * k_local, jnp.int32)
+            sums = lax.psum(jax.vmap(lambda s: lax.dynamic_update_slice(
+                jnp.zeros((k_pad, d), acc), s.astype(acc),
+                (off, jnp.int32(0))))(st.sums), axes)      # (R, k_pad, d)
+            counts = lax.psum(jax.vmap(lambda c: lax.dynamic_update_slice(
+                jnp.zeros((k_pad,), acc), c.astype(acc), (off,)))(
+                    st.counts), axes)                      # (R, k_pad)
+            sse = (lax.psum(st.sse, axes) / model_shards
+                   if need_sse else st.sse)
             if need_farthest:
-                far_ds = lax.all_gather(st.farthest_dist, DATA_AXIS)
-                far_ps = lax.all_gather(st.farthest_point, DATA_AXIS)
+                far_ds = lax.all_gather(st.farthest_dist, axes)
+                far_ps = lax.all_gather(st.farthest_point, axes)
                 owner = jnp.argmax(far_ds, axis=0)         # (R,)
                 far_p = jnp.take_along_axis(
                     far_ps, owner[None, :, None], axis=0)[0]   # (R, d)
@@ -424,14 +474,14 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
             new = jnp.where((counts > 0)[..., None], mean.astype(acc), cents)
             if empty_policy == "farthest":
                 def refill(new_r, far_r, counts_r):
-                    is_empty = counts_r <= 0
+                    is_empty = (counts_r <= 0) & real
                     fe = jnp.argmax(is_empty)
-                    val = jnp.where(jnp.any(is_empty), far_r.astype(acc),
-                                    new_r[fe])
+                    val = jnp.where(jnp.any(is_empty),
+                                    far_r[:d].astype(acc), new_r[fe])
                     return new_r.at[fe].set(val)
                 new = jax.vmap(refill)(new, far_p, counts)
             elif empty_policy == "resample":
-                any_any = jnp.any(counts <= 0)   # scalar: cond stays a branch
+                any_any = jnp.any((counts <= 0) & real[None, :])
                 d_idx = lax.axis_index(DATA_AXIS)
                 key_i = jax.random.fold_in(rng_key, i)
 
@@ -458,13 +508,14 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                     rows_g, owner[None, :, None], axis=0)[0]   # (R, d)
 
                 def refill_r(new_r, row_r, counts_r):
-                    is_empty = counts_r <= 0
+                    is_empty = (counts_r <= 0) & real
                     fe = jnp.argmax(is_empty)
                     val = jnp.where(jnp.any(is_empty), row_r, new_r[fe])
                     return new_r.at[fe].set(val)
                 new = jax.vmap(refill_r)(new, winner, counts)
             shifts = jnp.sqrt(jnp.sum((new - cents) ** 2, axis=2))
-            max_shift = jnp.max(shifts, axis=1)            # (R,)
+            max_shift = jnp.max(jnp.where(real[None, :], shifts, 0.0),
+                                axis=1)                    # (R,)
             # Frozen restarts keep their centroids and recorded stats.
             new = jnp.where(done[:, None, None], cents, new)
             sse_hist = sse_hist.at[:, i].set(jnp.where(done, 0.0, sse))
@@ -479,10 +530,13 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
             i, _, done, *_ = state
             return (i < max_iter) & ~jnp.all(done)
 
-        state = (jnp.int32(0), cents0.astype(acc),
+        cents0 = lax.all_gather(cents0_blocks, MODEL_AXIS, axis=1,
+                                tiled=True).astype(acc) \
+            if model_shards > 1 else cents0_blocks.astype(acc)
+        state = (jnp.int32(0), cents0,
                  jnp.zeros((R,), bool), jnp.zeros((R,), jnp.int32),
                  jnp.zeros((R, max_iter), acc), jnp.zeros((R, max_iter), acc),
-                 jnp.zeros((R, k), acc))
+                 jnp.zeros((R, k_pad), acc))
         _, cents, _, n_iters, sse_hist, shift_hist, counts_out = \
             lax.while_loop(cond, body, state)
 
@@ -495,7 +549,8 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
 
     mapped = jax.shard_map(
         fit, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None, None, None)),
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS),
+                  P(None, MODEL_AXIS, None)),
         out_specs=(P(None, None), P(), P(None), P(None), P(None), P(),
                    P(None)),
         check_vma=False)
@@ -705,19 +760,26 @@ def make_predict_fn(mesh: Mesh, *, chunk_size: int,
     def predict(points, centroids_block):
         k_local, d = centroids_block.shape
         n_local = points.shape[0]
+        m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
         if mode in PALLAS_MODES:
+            from kmeans_tpu.ops.pallas_kernels import (fused_assign_reduce,
+                                                       pallas_assign)
+            interpret = jax.default_backend() != "tpu"
+            bf16 = (mode == "pallas_bf16")
             if model_shards > 1:
-                raise ValueError("pallas modes do not support centroid "
-                                 "(model-axis) sharding yet")
-            from kmeans_tpu.ops.pallas_kernels import fused_assign_reduce
+                labels_l, mind2_l = pallas_assign(
+                    points, centroids_block, bf16=bf16, interpret=interpret)
+                minds = lax.all_gather(mind2_l, MODEL_AXIS)
+                owner = jnp.argmin(minds, axis=0)
+                contrib = jnp.where(owner == m_idx,
+                                    m_idx * k_local + labels_l, 0)
+                return lax.psum(contrib, MODEL_AXIS).astype(jnp.int32)
             labels, *_ = fused_assign_reduce(
                 points, jnp.ones((n_local,), jnp.float32), centroids_block,
-                bf16=(mode == "pallas_bf16"),
-                interpret=jax.default_backend() != "tpu")
+                bf16=bf16, interpret=interpret)
             return labels
         n_chunks = n_local // chunk_size
         xs = points.reshape(n_chunks, chunk_size, d)
-        m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
 
         def body(_, xc):
             d2 = pairwise_sq_dists(xc, centroids_block, mode=mode)

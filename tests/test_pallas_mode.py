@@ -1,6 +1,6 @@
 """End-to-end KMeans with distance_mode='pallas' (interpret mode on CPU):
-must reproduce the XLA path's trajectory on DP meshes and reject model-axis
-sharding cleanly."""
+must reproduce the XLA path's trajectory on DP meshes AND under model-axis
+(centroid) sharding (r1 VERDICT #3)."""
 
 import numpy as np
 import pytest
@@ -39,7 +39,24 @@ def test_pallas_mode_device_loop(data, mesh8):
     assert np.all(np.isfinite(km.centroids))
 
 
-def test_pallas_rejects_model_sharding(data, mesh4x2):
-    km = KMeans(k=4, mesh=mesh4x2, distance_mode="pallas", verbose=False)
-    with pytest.raises(ValueError, match="model"):
-        km.fit(data)
+def test_pallas_under_model_sharding_matches_matmul(data, mesh4x2):
+    """r1 VERDICT #3: pallas x TP now composes — assignment-only kernel +
+    global argmin reconstruction + ownership-masked accumulation."""
+    a = KMeans(k=4, max_iter=15, seed=42, compute_sse=True, mesh=mesh4x2,
+               distance_mode="matmul", verbose=False).fit(data)
+    b = KMeans(k=4, max_iter=15, seed=42, compute_sse=True, mesh=mesh4x2,
+               distance_mode="pallas", verbose=False).fit(data)
+    assert a.iterations_run == b.iterations_run
+    np.testing.assert_allclose(a.centroids, b.centroids, atol=1e-4)
+    np.testing.assert_allclose(a.sse_history, b.sse_history, rtol=1e-5)
+    np.testing.assert_array_equal(a.predict(data), b.predict(data))
+
+
+def test_pallas_tp_device_loop(data, mesh4x2):
+    km = KMeans(k=4, max_iter=15, seed=42, empty_cluster="keep",
+                mesh=mesh4x2, distance_mode="pallas", host_loop=False,
+                verbose=False).fit(data)
+    ref = KMeans(k=4, max_iter=15, seed=42, empty_cluster="keep",
+                 mesh=mesh4x2, distance_mode="matmul", host_loop=False,
+                 verbose=False).fit(data)
+    np.testing.assert_allclose(km.centroids, ref.centroids, atol=1e-4)

@@ -56,3 +56,24 @@ def test_fori_fallback_compiles_on_tpu():
                                       np.asarray(ref.counts))
         np.testing.assert_allclose(np.asarray(sums), np.asarray(ref.sums),
                                    rtol=1e-4, atol=1e-4)
+
+
+def test_assign_only_kernel_compiles_and_matches_on_tpu():
+    """pallas_assign (the model-sharding variant, r1 VERDICT #3) must
+    lower through Mosaic and agree with the fused kernel's assignment."""
+    import jax.numpy as jnp
+
+    from kmeans_tpu.ops.pallas_kernels import (fused_assign_reduce,
+                                               pallas_assign)
+
+    with jax.enable_x64(False):
+        rng = np.random.default_rng(2)
+        X = jnp.asarray(rng.normal(size=(2048, 24)), jnp.float32)
+        W = jnp.ones((2048,), jnp.float32)
+        C = X[:9]
+        labels_a, mind2_a = pallas_assign(X, C)
+        labels_f, mind2_f, _, _ = fused_assign_reduce(X, W, C)
+        np.testing.assert_array_equal(np.asarray(labels_a),
+                                      np.asarray(labels_f))
+        np.testing.assert_allclose(np.asarray(mind2_a),
+                                   np.asarray(mind2_f), rtol=1e-6)
