@@ -1,0 +1,203 @@
+"""Microbenchmark of assignment-pass chunk-body variants on real TPU.
+
+Isolates which formulation of the (chunk, k) tile work is fastest:
+argmin input form (full d2 vs h - xc), one-hot build (convert*mul vs
+single where-select), counts (VPU column sum vs ones-column in the
+scatter matmul).  Marginal method: per-pass cost is the time difference
+between chained fori_loop(2) and fori_loop(2+T) runs, where each pass
+feeds the next through a real centroid update (prevents XLA hoisting).
+
+Usage: python experiments/exp_chunk_variants.py [N] [D] [K] [T]
+"""
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
+D = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+K = int(sys.argv[3]) if len(sys.argv) > 3 else 1024
+T = int(sys.argv[4]) if len(sys.argv) > 4 else 20
+
+
+def body_old(xc, wc, c, k):
+    """Round-1 body: full d2, astype*mul one-hot, VPU counts."""
+    x2 = jnp.sum(xc * xc, axis=-1, keepdims=True)
+    c2 = jnp.sum(c * c, axis=-1)[None, :]
+    xcp = lax.dot_general(xc, c, (((1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    d2 = jnp.maximum(x2 + c2 - 2.0 * xcp, 0.0)
+    best = jnp.argmin(d2, axis=1).astype(jnp.int32)
+    onehot = (best[:, None] == jnp.arange(k, dtype=jnp.int32)[None, :])
+    onehot = onehot.astype(jnp.float32) * wc[:, None]
+    sums = lax.dot_general(onehot, xc, (((0,), (0,)), ((), ())),
+                           preferred_element_type=jnp.float32)
+    counts = jnp.sum(onehot, axis=0)
+    return sums, counts
+
+
+def body_d2h(xc, wc, c, k):
+    """h - xc argmin, astype*mul one-hot."""
+    h = 0.5 * jnp.sum(c * c, axis=-1)[None, :]
+    xcp = lax.dot_general(xc, c, (((1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    best = jnp.argmin(h - xcp, axis=1).astype(jnp.int32)
+    onehot = (best[:, None] == jnp.arange(k, dtype=jnp.int32)[None, :])
+    onehot = onehot.astype(jnp.float32) * wc[:, None]
+    sums = lax.dot_general(onehot, xc, (((0,), (0,)), ((), ())),
+                           preferred_element_type=jnp.float32)
+    counts = jnp.sum(onehot, axis=0)
+    return sums, counts
+
+
+def body_where(xc, wc, c, k):
+    """Full d2 argmin, single where-select one-hot."""
+    x2 = jnp.sum(xc * xc, axis=-1, keepdims=True)
+    c2 = jnp.sum(c * c, axis=-1)[None, :]
+    xcp = lax.dot_general(xc, c, (((1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    d2 = jnp.maximum(x2 + c2 - 2.0 * xcp, 0.0)
+    best = jnp.argmin(d2, axis=1).astype(jnp.int32)
+    onehot = jnp.where(
+        best[:, None] == jnp.arange(k, dtype=jnp.int32)[None, :],
+        wc[:, None], jnp.zeros((), jnp.float32))
+    sums = lax.dot_general(onehot, xc, (((0,), (0,)), ((), ())),
+                           preferred_element_type=jnp.float32)
+    counts = jnp.sum(onehot, axis=0)
+    return sums, counts
+
+
+def body_both(xc, wc, c, k):
+    """h - xc argmin + where-select one-hot (the regressed combo)."""
+    h = 0.5 * jnp.sum(c * c, axis=-1)[None, :]
+    xcp = lax.dot_general(xc, c, (((1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    best = jnp.argmin(h - xcp, axis=1).astype(jnp.int32)
+    onehot = jnp.where(
+        best[:, None] == jnp.arange(k, dtype=jnp.int32)[None, :],
+        wc[:, None], jnp.zeros((), jnp.float32))
+    sums = lax.dot_general(onehot, xc, (((0,), (0,)), ((), ())),
+                           preferred_element_type=jnp.float32)
+    counts = jnp.sum(onehot, axis=0)
+    return sums, counts
+
+
+def make_fit(body, chunk, n_iter):
+    @jax.jit
+    def fit(points, weights, cents0):
+        xs = (points.reshape(-1, chunk, D), weights.reshape(-1, chunk))
+
+        def one_pass(cents):
+            def scan_body(carry, chk):
+                s, cnt = carry
+                xc, wc = chk
+                ds, dc = body(xc, wc, cents, K)
+                return (s + ds, cnt + dc), None
+            (s, cnt), _ = lax.scan(
+                scan_body, (jnp.zeros((K, D), jnp.float32),
+                            jnp.zeros((K,), jnp.float32)), xs)
+            return s / jnp.maximum(cnt, 1.0)[:, None]
+
+        return lax.fori_loop(0, n_iter, lambda i, c: one_pass(c), cents0)
+    return fit
+
+
+def measure(name, body, points, weights, cents, chunk):
+    f2 = make_fit(body, chunk, 2)
+    fb = make_fit(body, chunk, 2 + T)
+    # warm both
+    float(f2(points, weights, cents)[0, 0])
+    float(fb(points, weights, cents)[0, 0])
+    margins = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(f2(points, weights, cents)[0, 0])
+        t_small = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(fb(points, weights, cents)[0, 0])
+        t_big = time.perf_counter() - t0
+        margins.append((t_big - t_small) / T)
+    med = float(np.median(margins)) * 1e3
+    print(f"{name:24s} {med:8.3f} ms/iter  (reps "
+          f"{[f'{m*1e3:.2f}' for m in margins]})", flush=True)
+    return med
+
+
+def _scalar_body(scalar_of):
+    """Diagnostic body: keeps only part of the pass live via a scalar
+    data dependence (sums = eps*scalar so the next iteration's centroids
+    depend on this pass without the one-hot/scatter work)."""
+    def body(xc, wc, c, k):
+        s = scalar_of(xc, wc, c, k).astype(jnp.float32)
+        return (jnp.full((k, D), 1e-30, jnp.float32) * s,
+                jnp.ones((k,), jnp.float32))
+    return body
+
+
+def _d2(xc, c):
+    x2 = jnp.sum(xc * xc, axis=-1, keepdims=True)
+    c2 = jnp.sum(c * c, axis=-1)[None, :]
+    xcp = lax.dot_general(xc, c, (((1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    return jnp.maximum(x2 + c2 - 2.0 * xcp, 0.0)
+
+
+diag_mm = _scalar_body(lambda xc, wc, c, k: jnp.sum(
+    lax.dot_general(xc, c, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)[:, :8]))
+diag_argmin = _scalar_body(lambda xc, wc, c, k: jnp.sum(
+    jnp.argmin(_d2(xc, c), axis=1)))
+diag_min = _scalar_body(lambda xc, wc, c, k: jnp.sum(
+    jnp.min(_d2(xc, c), axis=1)))
+
+
+def diag_onehot(xc, wc, c, k):
+    """Full old body minus the counts column-sum."""
+    best = jnp.argmin(_d2(xc, c), axis=1).astype(jnp.int32)
+    onehot = (best[:, None] == jnp.arange(k, dtype=jnp.int32)[None, :])
+    onehot = onehot.astype(jnp.float32) * wc[:, None]
+    sums = lax.dot_general(onehot, xc, (((0,), (0,)), ((), ())),
+                           preferred_element_type=jnp.float32)
+    return sums, jnp.ones((k,), jnp.float32)
+
+
+BODIES = {"old": body_old, "d2h": body_d2h, "where": body_where,
+          "both": body_both, "diag_mm": diag_mm,
+          "diag_argmin": diag_argmin, "diag_min": diag_min,
+          "diag_onehot": diag_onehot}
+
+
+def main():
+    rng = np.random.default_rng(0)
+    which = (sys.argv[5].split(",") if len(sys.argv) > 5
+             else list(BODIES))
+    chunks = ([int(c) for c in sys.argv[6].split(",")]
+              if len(sys.argv) > 6 else [32768])
+    max_chunk = max(chunks)
+    n_pad = -(-N // max_chunk) * max_chunk
+    X = rng.uniform(-1, 1, size=(n_pad, D)).astype(np.float32)
+    c0 = X[rng.choice(N, K, replace=False)].copy()
+    w = np.zeros((n_pad,), np.float32)
+    w[:N] = 1.0
+    X[N:] = 0.0
+    points = jax.device_put(jnp.asarray(X))
+    weights = jax.device_put(jnp.asarray(w))
+    cents = jax.device_put(jnp.asarray(c0))
+    print(f"N={N} (pad {n_pad}) D={D} K={K} T={T} "
+          f"backend={jax.default_backend()}", flush=True)
+    for chunk in chunks:
+        if n_pad % chunk:
+            continue
+        for name in which:
+            measure(f"{name}@{chunk}", BODIES[name], points, weights,
+                    cents, chunk)
+
+
+if __name__ == "__main__":
+    main()

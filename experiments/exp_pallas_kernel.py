@@ -1,0 +1,349 @@
+"""Pallas fused-kernel variant lab (real TPU).
+
+Builds parameterized variants of the fused assign+reduce kernel and
+times them with the marginal method (chained fori_loop(2) vs
+fori_loop(2+T) with a real centroid update between passes).  Each
+variant is correctness-checked against a NumPy oracle on a small slice
+before timing.
+
+Knobs per variant:
+  tile_n, tile_k      - grid/block tiling
+  pipe                - software-pipeline: accumulate tile i-1's one-hot
+                        scatter while tile i's distance matmul runs
+  man_argmin          - manual min + select-iota-min instead of lax.argmin
+  ones_col            - counts via a constant-1 column in the scatter
+                        matmul (needs d < d_pad) instead of a VPU sum
+  bf16                - bf16 matmul inputs
+  vmem_mb             - Mosaic scoped-VMEM limit
+
+Usage: python experiments/exp_pallas_kernel.py N D K T spec1 spec2 ...
+  spec: name=tile_n,tile_k,flags   flags subset of {p,m,o,b}
+  e.g.  pipe1=512,3072,pmo
+"""
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BIG = 1e30          # added to h for padded centroid rows
+IDX_BIG = 2 ** 30
+
+
+def _round_up(a, b):
+    return -(-a // b) * b
+
+
+def build_kernel(*, tile_n, tile_k, pipe, man_argmin, ones_col, bf16,
+                 fold_h, vmem_mb, n_pad, d, d_pad, k, k_pad):
+    """Returns fn(x_pad (n_pad, d_pad), w (n_pad,), c_pad (k_pad, d_pad),
+    h (1, k_pad)) -> (labels, mind2, sums (k_pad, d_pad), counts)."""
+    k_tiles = k_pad // tile_k
+    n_tiles = n_pad // tile_n
+    mm = jnp.bfloat16 if bf16 else jnp.float32
+    d_col = d  # column used for counts when ones_col
+
+    def argmin_tiles(x, c_ref, h_ref):
+        """(best, mind2h) over all k tiles; d2h = h - x @ c.T.
+
+        With fold_h, x must carry 1.0 in column d and c_ref carries -h in
+        column d, so the MXU emits x@c.T - h directly and the kernel
+        argmaxes it (no (n, k) subtract)."""
+        def one(off, carry):
+            best, mind2h = carry
+            c = c_ref[pl.ds(off, tile_k), :]
+            xc = lax.dot_general(x.astype(mm), c.astype(mm),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+            if fold_h:
+                d2h = xc                                    # actually -d2h
+                ids = lax.broadcasted_iota(jnp.int32, (tile_n, tile_k), 1)
+                lb = lax.argmax(d2h, 1, jnp.int32)
+                m = -jnp.max(d2h, axis=1)
+            else:
+                h = h_ref[:, pl.ds(off, tile_k)]            # (1, tile_k)
+                d2h = h - xc                                # (tile_n, tile_k)
+                ids = lax.broadcasted_iota(jnp.int32, (tile_n, tile_k), 1)
+                if man_argmin:
+                    m = jnp.min(d2h, axis=1)
+                    lb = jnp.min(jnp.where(d2h == m[:, None], ids,
+                                           IDX_BIG), axis=1)
+                else:
+                    lb = lax.argmin(d2h, 1, jnp.int32)
+                    m = jnp.min(d2h, axis=1)
+            upd = m < mind2h
+            best = jnp.where(upd, lb + off, best)
+            return best, jnp.where(upd, m, mind2h)
+        carry = (jnp.zeros((tile_n,), jnp.int32),
+                 jnp.full((tile_n,), jnp.inf, jnp.float32))
+        for kt in range(k_tiles):
+            carry = one(kt * tile_k, carry)
+        return carry
+
+    def accum(best, x, w, sums_ref, counts_ref):
+        """One-hot scatter of one tile into the accumulators."""
+        if fold_h:
+            x_aug = x                       # ones column already in x
+        elif ones_col:
+            lanes = lax.broadcasted_iota(jnp.int32, (tile_n, d_pad), 1)
+            x_aug = jnp.where(lanes == d_col, 1.0, x)
+        else:
+            x_aug = x
+        for kt in range(k_tiles):
+            off = kt * tile_k
+            ids = lax.broadcasted_iota(jnp.int32, (tile_n, tile_k), 1) + off
+            ohw = jnp.where(best[:, None] == ids, w, 0.0)   # (tile_n, tile_k)
+            sums_ref[pl.ds(off, tile_k), :] += lax.dot_general(
+                ohw.astype(mm), x_aug.astype(mm), (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if not ones_col:
+                counts_ref[:, pl.ds(off, tile_k)] += jnp.sum(
+                    ohw, axis=0, keepdims=True)
+
+    x2_corr = 1.0 if fold_h else 0.0   # ones column contributes 1 to x2
+
+    def kernel_plain(x_ref, w_ref, c_ref, h_ref, labels_ref, mind2_ref,
+                     sums_ref, counts_ref):
+        i = pl.program_id(0)
+        x = x_ref[:, :]
+        w = w_ref[:, :]
+        best, mind2h = argmin_tiles(x, c_ref, h_ref)
+        x2 = jnp.sum(x * x, axis=1) - x2_corr
+        labels_ref[:, :] = best[:, None]
+        mind2_ref[:, :] = jnp.maximum(2.0 * mind2h + x2, 0.0)[:, None]
+
+        @pl.when(i == 0)
+        def _():
+            sums_ref[:, :] = jnp.zeros_like(sums_ref)
+            counts_ref[:, :] = jnp.zeros_like(counts_ref)
+
+        accum(best, x, w, sums_ref, counts_ref)
+
+    def kernel_pipe(x_ref, w_ref, c_ref, h_ref, labels_ref, mind2_ref,
+                    sums_ref, counts_ref, xs, ws, bs):
+        i = pl.program_id(0)
+        slot = lax.rem(i, 2)
+        prev = lax.rem(i + 1, 2)
+
+        @pl.when(i == 0)
+        def _():
+            sums_ref[:, :] = jnp.zeros_like(sums_ref)
+            counts_ref[:, :] = jnp.zeros_like(counts_ref)
+
+        # Phase 2 first in program order: accumulate tile i-1 (independent
+        # of this step's matmul -> Mosaic may overlap MXU/VPU chains).
+        @pl.when(i > 0)
+        def _():
+            accum(bs[prev, :, 0], xs[prev], ws[prev, :, :],
+                  sums_ref, counts_ref)
+
+        @pl.when(i < n_tiles)
+        def _():
+            x = x_ref[:, :]
+            w = w_ref[:, :]
+            best, mind2h = argmin_tiles(x, c_ref, h_ref)
+            x2 = jnp.sum(x * x, axis=1) - x2_corr
+            labels_ref[:, :] = best[:, None]
+            mind2_ref[:, :] = jnp.maximum(2.0 * mind2h + x2, 0.0)[:, None]
+            xs[slot] = x
+            ws[slot, :, :] = w
+            bs[slot, :, 0] = best
+
+    grid = (n_tiles + 1,) if pipe else (n_tiles,)
+    nclamp = (lambda i: (min(i, n_tiles - 1) if isinstance(i, int)
+                         else jnp.minimum(i, n_tiles - 1), 0))
+    in_specs = [
+        pl.BlockSpec((tile_n, d_pad), nclamp, memory_space=pltpu.VMEM),
+        pl.BlockSpec((tile_n, 1), nclamp, memory_space=pltpu.VMEM),
+        pl.BlockSpec((k_pad, d_pad), lambda i: (0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, k_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+    ]
+    out_specs = [
+        pl.BlockSpec((tile_n, 1), nclamp, memory_space=pltpu.VMEM),
+        pl.BlockSpec((tile_n, 1), nclamp, memory_space=pltpu.VMEM),
+        pl.BlockSpec((k_pad, d_pad), lambda i: (0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, k_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+        jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+        jax.ShapeDtypeStruct((k_pad, d_pad), jnp.float32),
+        jax.ShapeDtypeStruct((1, k_pad), jnp.float32),
+    ]
+    scratch = []
+    if pipe:
+        scratch = [pltpu.VMEM((2, tile_n, d_pad), jnp.float32),
+                   pltpu.VMEM((2, tile_n, 1), jnp.float32),
+                   pltpu.VMEM((2, tile_n, 1), jnp.int32)]
+
+    fn = pl.pallas_call(
+        kernel_pipe if pipe else kernel_plain,
+        grid=grid, in_specs=in_specs, out_specs=out_specs,
+        out_shape=out_shape, scratch_shapes=scratch,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=vmem_mb * 1024 * 1024),
+    )
+    return fn
+
+
+def make_variant(name, spec, n, d, k):
+    tile_n, tile_k, flags = spec
+    d_pad = _round_up(d, 128)
+    fold_h = "f" in flags and d < d_pad
+    ones_col = fold_h or ("o" in flags and d < d_pad)
+    tile_k = min(tile_k, _round_up(k, 128))
+    k_pad = _round_up(k, tile_k)
+    n_pad = _round_up(n, tile_n)
+    kern = build_kernel(
+        tile_n=tile_n, tile_k=tile_k, pipe="p" in flags,
+        man_argmin="m" in flags, ones_col=ones_col, bf16="b" in flags,
+        fold_h=fold_h, vmem_mb=100, n_pad=n_pad, d=d, d_pad=d_pad, k=k,
+        k_pad=k_pad)
+
+    def run(x_pad, w_col, c, k_real):
+        # c: (k, d) real centroids -> pad to (k_pad, d_pad) zeros
+        c_p = jnp.zeros((k_pad, d_pad), jnp.float32)
+        c_p = lax.dynamic_update_slice(c_p, c.astype(jnp.float32), (0, 0))
+        h = 0.5 * jnp.sum(c_p * c_p, axis=1)
+        h = h + jnp.where(jnp.arange(k_pad) >= k_real, BIG, 0.0)
+        if fold_h:
+            c_p = c_p.at[:, d].set(-h)      # MXU emits x@c.T - h directly
+        labels, mind2, sums, counts = kern(x_pad, w_col, c_p, h[None, :])
+        if ones_col:
+            counts = sums[:, d]
+        else:
+            counts = counts[0]
+        return labels[:, 0], mind2[:, 0], sums[:k, :d], counts[:k]
+
+    return run, n_pad, fold_h
+
+
+def oracle(X, w, c, fold=False):
+    """bf16-aware oracle: one-pass bf16 dot (operands rounded, f32/f64
+    accumulate) mirroring what Mosaic/excess-precision XLA do; argmin over
+    h - xc like the kernel.  fold=True also rounds h to bf16 (the -h
+    column rides through the MXU in the fold_h variants)."""
+    import ml_dtypes
+    bf = lambda a: a.astype(ml_dtypes.bfloat16).astype(np.float64)
+    xc = bf(X) @ bf(c).T
+    h = 0.5 * (c.astype(np.float64) ** 2).sum(-1)[None, :]
+    if fold:
+        h = bf(h)
+    d2h = h - xc
+    best = d2h.argmin(1)
+    x2 = (X.astype(np.float64) ** 2).sum(-1)
+    mind2 = np.maximum(2.0 * d2h.min(1) + x2, 0.0)
+    k = c.shape[0]
+    onehot = np.eye(k)[best] * w[:, None]
+    return best, mind2, onehot.T @ bf(X), onehot.sum(0)
+
+
+def main():
+    N = int(sys.argv[1]); D = int(sys.argv[2]); K = int(sys.argv[3])
+    T = int(sys.argv[4])
+    specs = {}
+    for s in sys.argv[5:]:
+        name, rest = s.split("=")
+        parts = rest.split(",")
+        specs[name] = (int(parts[0]), int(parts[1]),
+                       parts[2] if len(parts) > 2 else "")
+
+    rng = np.random.default_rng(0)
+    Xs = rng.standard_t(df=4, size=(4096, D)).astype(np.float32)
+    cs = Xs[rng.choice(4096, min(K, 512), replace=False)].copy()
+    ws = np.ones((4096,), np.float32)
+
+    X = rng.standard_t(df=4, size=(N, D)).astype(np.float32)
+    X /= np.sqrt((X * X).mean())
+    c0 = X[rng.choice(N, K, replace=False)].copy()
+
+    print(f"N={N} D={D} K={K} T={T}", flush=True)
+    for name, spec in specs.items():
+        # correctness on the small slice (against its own k for speed)
+        try:
+            run_s, n_pad_s, fold_s = make_variant(name, spec, 4096, D,
+                                                  len(cs))
+            x_pad = jnp.zeros((n_pad_s, _round_up(D, 128)), jnp.float32)
+            x_pad = x_pad.at[:4096, :D].set(Xs)
+            if fold_s:
+                x_pad = x_pad.at[:, D].set(1.0)
+            w_col = jnp.zeros((n_pad_s, 1), jnp.float32).at[:4096, 0].set(ws)
+            lb, m2, sm, cn = jax.jit(functools.partial(
+                run_s, k_real=len(cs)))(x_pad, w_col, jnp.asarray(cs))
+            ob, om, os_, oc = oracle(Xs, ws, cs, fold=fold_s)
+            # Labels must agree with the bf16-aware oracle except on
+            # ULP-close pairs (accumulation tree differs); counts must be
+            # EXACTLY self-consistent with the kernel's own labels, sums
+            # approximately so.
+            lb = np.asarray(lb)[:4096]
+            m2 = np.asarray(m2)[:4096]
+            sm, cn = np.asarray(sm), np.asarray(cn)
+            mism = (lb != ob).mean()
+            cn_self = np.bincount(lb, weights=ws, minlength=len(cs))
+            oh_self = np.eye(len(cs))[lb] * ws[:, None]
+            sm_self = oh_self.T @ Xs.astype(np.float64)
+            ok = (mism <= 1e-3
+                  and np.allclose(m2, om, rtol=1e-2, atol=1.0)
+                  and np.array_equal(cn, cn_self)
+                  and np.allclose(sm, sm_self, rtol=1e-2, atol=0.5))
+            if not ok:
+                print(f"{name:16s} mism={mism:.2e} "
+                      f"m2max={np.abs(m2-om).max():.3g} "
+                      f"cnok={np.array_equal(cn, cn_self)} "
+                      f"smmax={np.abs(sm-sm_self).max():.3g}", flush=True)
+        except Exception as e:
+            print(f"{name:16s} BUILD/CHECK FAILED: {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+            continue
+        if not ok:
+            print(f"{name:16s} WRONG RESULT — skipping timing", flush=True)
+            continue
+
+        run, n_pad, fold_b = make_variant(name, spec, N, D, K)
+        d_pad = _round_up(D, 128)
+        x_pad = jnp.zeros((n_pad, d_pad), jnp.float32).at[:N, :D].set(X)
+        if fold_b:
+            x_pad = x_pad.at[:, D].set(1.0)
+        w_col = jnp.zeros((n_pad, 1), jnp.float32).at[:N, 0].set(1.0)
+
+        def fit(n_iter, x_pad, w_col, cents0):
+            def body(i, cents):
+                _, _, sums, counts = run(x_pad, w_col, cents, K)
+                return sums / jnp.maximum(counts, 1.0)[:, None]
+            return lax.fori_loop(0, n_iter, body, cents0)
+
+        try:
+            f2 = jax.jit(functools.partial(fit, 2))
+            fb = jax.jit(functools.partial(fit, 2 + T))
+            cents = jnp.asarray(c0)
+            float(f2(x_pad, w_col, cents)[0, 0])
+            float(fb(x_pad, w_col, cents)[0, 0])
+        except Exception as e:
+            print(f"{name:16s} COMPILE FAILED: {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+            continue
+        margins = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(f2(x_pad, w_col, cents)[0, 0])
+            ts = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            float(fb(x_pad, w_col, cents)[0, 0])
+            tb = time.perf_counter() - t0
+            margins.append((tb - ts) / T)
+        med = float(np.median(margins)) * 1e3
+        print(f"{name:16s} {med:8.3f} ms/iter  (reps "
+              f"{[f'{m*1e3:.2f}' for m in margins]})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -74,12 +74,48 @@ def _model_axis_select(model_shards: int):
 PALLAS_MODES = ("pallas", "pallas_bf16")
 
 
+def _weighted_sqnorm_total(points, weights):
+    """The loop-invariant first term of _sse_from_stats:
+    ``sum_i w_i ||x_i||^2`` over the shard's RAW (un-prepped) rows."""
+    return jnp.sum(weights.astype(jnp.float32)
+                   * jnp.sum(points.astype(jnp.float32) ** 2, axis=1))
+
+
+def _sse_from_stats(x2w, centroids, sums, counts, acc):
+    """SSE derived algebraically from the pass statistics:
+
+        SSE = sum_i w_i ||x_i||^2  -  2 sum_k <c_k, S_k>  +  sum_k n_k ||c_k||^2
+
+    (expand ||x - c_{b(i)}||^2 and group by cluster; S_k / n_k are the
+    weighted per-cluster coordinate sums and counts).  ``x2w`` is the
+    loop-INVARIANT first term — callers compute it once per fit.  This
+    costs O(k*D) instead of an O(n) reduce over the kernel's per-point
+    mind2 output, whose HBM layout-conversion copy alone is ~0.3 ms/iter
+    at 400k points; an in-kernel SSE accumulator was measured even more
+    expensive (~1 ms/iter — it chains the sequential grid).  Clamped at 0:
+    the difference of large terms can go tiny-negative near a perfect
+    fit.  Accuracy: the same bf16-product class as the kernel's distances
+    (sums carry bf16-rounded products), plus cancellation amplification
+    when SSE << x2w; the convergence-history use cares about neither."""
+    c = centroids.astype(jnp.float32)
+    cross = jnp.sum(c * sums.astype(jnp.float32))
+    cnorm = jnp.sum(counts.astype(jnp.float32) * jnp.sum(c * c, axis=1))
+    return jnp.maximum(x2w - 2.0 * cross + cnorm, 0.0).astype(acc)
+
+
 def _pallas_local_stats(points, weights, centroids_block, *, mode: str,
-                        model_shards: int = 1, chunk_size: int = 512):
+                        model_shards: int = 1, chunk_size: int = 512,
+                        need_sse: bool = True, need_farthest: bool = True,
+                        need_sse_pc: bool = True, x2w=None, w_col=None):
     """Shard-local pass via the fused Pallas kernel (ops.pallas_kernels):
     one Mosaic kernel per shard instead of the XLA scan.  f32 compute
     (bf16 matmuls for 'pallas_bf16'); falls back to the Pallas interpreter
     off-TPU so the same code path is CI-testable.
+
+    The ``need_*`` flags elide the optional statistics' XLA-side work
+    (r2: the unconditional per-cluster ``segment_sum`` was real per-pass
+    VPU cost the on-device fit loop never consumed); elided fields keep
+    their ``init_stats`` values exactly like the XLA path's.
 
     Under centroid (model-axis) sharding the kernel runs in its
     assignment-only form (``pallas_assign``): the GLOBAL argmin is
@@ -95,9 +131,15 @@ def _pallas_local_stats(points, weights, centroids_block, *, mode: str,
     k_local, d = centroids_block.shape
     w = weights.astype(jnp.float32)
     if model_shards <= 1:
+        # Per-point mind2 is only materialized when something reads it:
+        # farthest tracking, per-cluster SSE, or an SSE without the
+        # precomputed invariant term.
+        need_point = (need_farthest or need_sse_pc
+                      or (need_sse and x2w is None))
         labels, gmind2, sums, counts = fused_assign_reduce(
-            points, weights, centroids_block, bf16=bf16,
-            interpret=interpret)
+            points, w_col if w_col is not None else weights,
+            centroids_block, bf16=bf16, interpret=interpret,
+            with_mind2=need_point)
         w_eff = w
     else:
         labels, mind2 = pallas_assign(points, centroids_block, bf16=bf16,
@@ -125,20 +167,31 @@ def _pallas_local_stats(points, weights, centroids_block, *, mode: str,
         (sums, counts), _ = lax.scan(
             body, (jnp.zeros((k_local, d), jnp.float32),
                    jnp.zeros((k_local,), jnp.float32)), xs)
-    sse = jnp.sum(gmind2 * w).astype(acc)        # global min: /m later
-    sse_pc = jax.ops.segment_sum(                # ownership-masked: psum-safe
+    zero = init_stats(k_local, d, acc)
+    if not need_sse:
+        sse = zero.sse
+    elif x2w is not None and model_shards <= 1:
+        sse = _sse_from_stats(x2w, centroids_block, sums, counts, acc)
+    else:
+        sse = jnp.sum(gmind2 * w).astype(acc)    # global min: /m later
+    sse_pc = (jax.ops.segment_sum(        # ownership-masked: psum-safe
         gmind2 * w_eff, labels, num_segments=k_local).astype(acc)
-    masked = jnp.where(w > 0, gmind2, -jnp.inf)
-    i = jnp.argmax(masked)
-    far_d = jnp.where(jnp.any(w > 0), masked[i], -1.0).astype(acc)
-    far_p = points[i].astype(acc)
+        if need_sse_pc else zero.sse_per_cluster)
+    if need_farthest:
+        masked = jnp.where(w > 0, gmind2, -jnp.inf)
+        i = jnp.argmax(masked)
+        far_d = jnp.where(jnp.any(w > 0), masked[i], -1.0).astype(acc)
+        far_p = points[i, :d].astype(acc)    # [:d]: prepped points carry
+    else:                                    # lane padding + fold column
+        far_d, far_p = zero.farthest_dist, zero.farthest_point
     return StepStats(sums.astype(acc), counts.astype(acc), sse, far_d,
                      far_p, sse_pc), labels
 
 
 def _local_stats(points, weights, centroids_block, *, chunk_size, mode,
                  model_shards: int, need_sse: bool = True,
-                 need_farthest: bool = True, need_sse_pc: bool = True):
+                 need_farthest: bool = True, need_sse_pc: bool = True,
+                 x2w=None, w_col=None):
     """Per-(data,model)-shard pass: scan chunks via the shared
     ``accumulate_chunk`` body (or one fused Pallas kernel for the 'pallas'
     modes).  Returned ``sums``/``counts`` cover only this shard's centroid
@@ -148,7 +201,11 @@ def _local_stats(points, weights, centroids_block, *, chunk_size, mode,
     if mode in PALLAS_MODES:
         return _pallas_local_stats(points, weights, centroids_block,
                                    mode=mode, model_shards=model_shards,
-                                   chunk_size=chunk_size)[0]
+                                   chunk_size=chunk_size,
+                                   need_sse=need_sse,
+                                   need_farthest=need_farthest,
+                                   need_sse_pc=need_sse_pc, x2w=x2w,
+                                   w_col=w_col)[0]
     k_local, d = centroids_block.shape
     acc = _accum_dtype(points.dtype)
     n_chunks = points.shape[0] // chunk_size
@@ -181,9 +238,18 @@ def make_step_fn(mesh: Mesh, *, chunk_size: int,
 
     def step(points, weights, centroids_block):
         k_local, d = centroids_block.shape
+        x2w = None
+        if mode in PALLAS_MODES and model_shards <= 1:
+            # Algebraic SSE term (see _sse_from_stats): besides being
+            # cheaper, it avoids the min-over-noisy-distances LOW BIAS of
+            # the per-point SSE under bf16-rate products (measured 6.5%
+            # low on separated blobs vs 1.2e-6 relative for this form),
+            # and keeps the host loop's SSE identical to the device
+            # loops'.
+            x2w = _weighted_sqnorm_total(points, weights)
         st = _local_stats(points, weights, centroids_block,
                           chunk_size=chunk_size, mode=mode,
-                          model_shards=model_shards)
+                          model_shards=model_shards, x2w=x2w)
         m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
         # Embed this shard's centroid block into the full table, then one
         # psum over BOTH axes yields replicated global sums/counts.
@@ -221,7 +287,8 @@ def make_step_fn(mesh: Mesh, *, chunk_size: int,
     return jax.jit(mapped)
 
 
-def _resample_draw(points, weights, key, i, d_idx, any_empty, acc):
+def _resample_draw(points, weights, key, i, d_idx, any_empty, acc,
+                   d_out=None):
     """One seeded uniform positive-weight row draw for the device loops'
     'resample' policy: per-shard Gumbel-argmax (O(n_local) reduction, no
     sort), gated by ``lax.cond`` so the Gumbel generation costs nothing on
@@ -229,8 +296,10 @@ def _resample_draw(points, weights, key, i, d_idx, any_empty, acc):
     replicated counts, so every shard takes the same branch).  Returns the
     shard's (score, row) candidate; the caller picks the global winner
     with a tiny all_gather OUTSIDE the cond (collectives inside a traced
-    branch are fragile under shard_map)."""
-    d = points.shape[1]
+    branch are fragile under shard_map).  ``d_out`` slices the drawn row
+    back to the real feature width when ``points`` went through
+    ``prep_points`` (lane padding + fold column)."""
+    d = points.shape[1] if d_out is None else d_out
 
     def draw(_):
         g = jax.random.gumbel(
@@ -238,7 +307,7 @@ def _resample_draw(points, weights, key, i, d_idx, any_empty, acc):
             (points.shape[0],), jnp.float32)
         score = jnp.where(weights > 0, g, -jnp.inf)
         j = jnp.argmax(score)
-        return score[j], points[j].astype(acc)
+        return score[j], points[j, :d].astype(acc)
 
     def skip(_):
         return jnp.asarray(-jnp.inf, jnp.float32), jnp.zeros((d,), acc)
@@ -294,6 +363,17 @@ def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
     def fit(points, weights, centroids_block):
         k_local, d = centroids_block.shape
         acc = _accum_dtype(points.dtype)
+        x2w = w_col = None
+        if mode in PALLAS_MODES and model_shards <= 1:
+            # Hoist the kernel's x-side padding/fold-column/weight-layout
+            # prep out of the iteration loop (~3 + 1.6 ms/iter at the
+            # benchmark shapes; XLA does not hoist the full-array work
+            # itself), and precompute the loop-invariant SSE term (see
+            # _sse_from_stats).
+            from kmeans_tpu.ops.pallas_kernels import prep_points
+            if need_sse:
+                x2w = _weighted_sqnorm_total(points, weights)
+            points, weights, w_col = prep_points(points, weights)
         k_pad = k_local * model_shards
         m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
         real = jnp.arange(k_pad) < k_real          # mask off sentinel rows
@@ -303,7 +383,7 @@ def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                               chunk_size=chunk_size, mode=mode,
                               model_shards=model_shards, need_sse=need_sse,
                               need_farthest=need_farthest,
-                              need_sse_pc=False)
+                              need_sse_pc=False, x2w=x2w, w_col=w_col)
             off = jnp.asarray(m_idx * k_local, jnp.int32)
             sums = lax.psum(lax.dynamic_update_slice(
                 jnp.zeros((k_pad, d), acc), st.sums, (off, jnp.int32(0))),
@@ -345,7 +425,8 @@ def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                 first_empty = jnp.argmax(is_empty)
                 d_idx = lax.axis_index(DATA_AXIS)
                 s, row = _resample_draw(points, weights, rng_key,
-                                        iter0 + i, d_idx, any_empty, acc)
+                                        iter0 + i, d_idx, any_empty, acc,
+                                        d_out=d)
                 ss = lax.all_gather(s, (DATA_AXIS, MODEL_AXIS))
                 rows = lax.all_gather(row, (DATA_AXIS, MODEL_AXIS))
                 refill = jnp.where(any_empty, rows[jnp.argmax(ss)],
@@ -423,6 +504,13 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
         # cents0_blocks: (R, k_local, d), k axis sharded on MODEL.
         acc = _accum_dtype(points.dtype)
         R, k_local, d = cents0_blocks.shape
+        x2w = w_col = None
+        if mode in PALLAS_MODES and model_shards <= 1:
+            # Hoist the kernel's x-side prep out of the loop (see
+            # make_fit_fn); shared by every restart.
+            from kmeans_tpu.ops.pallas_kernels import prep_points
+            x2w = _weighted_sqnorm_total(points, weights)
+            points, weights, w_col = prep_points(points, weights)
         k_pad = k_local * model_shards
         m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
         real = jnp.arange(k_pad) < k_real          # mask off sentinel rows
@@ -446,7 +534,8 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                                     model_shards=model_shards,
                                     need_sse=need_sse,
                                     need_farthest=need_farthest,
-                                    need_sse_pc=False)
+                                    need_sse_pc=False, x2w=x2w,
+                                    w_col=w_col)
             st = jax.vmap(local)(cents)
             off = jnp.asarray(m_idx * k_local, jnp.int32)
             sums = lax.psum(jax.vmap(lambda s: lax.dynamic_update_slice(
@@ -493,12 +582,13 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                                               jnp.float32)
                         score = jnp.where(weights > 0, g, -jnp.inf)
                         j = jnp.argmax(score)
-                        return score[j], points[j].astype(acc)
+                        # [:d]: prepped points carry lane padding + fold
+                        return score[j], points[j, :d].astype(acc)
                     return jax.vmap(one)(jnp.arange(R))
 
                 def skip(_):
                     return (jnp.full((R,), -jnp.inf, jnp.float32),
-                            jnp.zeros((R, points.shape[1]), acc))
+                            jnp.zeros((R, d), acc))
 
                 ss, rows = lax.cond(any_any, draws, skip, None)
                 ss_g = lax.all_gather(ss, DATA_AXIS)       # (S, R)
@@ -762,8 +852,7 @@ def make_predict_fn(mesh: Mesh, *, chunk_size: int,
         n_local = points.shape[0]
         m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
         if mode in PALLAS_MODES:
-            from kmeans_tpu.ops.pallas_kernels import (fused_assign_reduce,
-                                                       pallas_assign)
+            from kmeans_tpu.ops.pallas_kernels import pallas_assign
             interpret = jax.default_backend() != "tpu"
             bf16 = (mode == "pallas_bf16")
             if model_shards > 1:
@@ -774,9 +863,11 @@ def make_predict_fn(mesh: Mesh, *, chunk_size: int,
                 contrib = jnp.where(owner == m_idx,
                                     m_idx * k_local + labels_l, 0)
                 return lax.psum(contrib, MODEL_AXIS).astype(jnp.int32)
-            labels, *_ = fused_assign_reduce(
-                points, jnp.ones((n_local,), jnp.float32), centroids_block,
-                bf16=bf16, interpret=interpret)
+            # Assignment-only kernel: the fused variant would also run
+            # the one-hot scatter matmul (same MXU FLOPs as the distance
+            # matmul) only to discard the sums.
+            labels, _ = pallas_assign(points, centroids_block, bf16=bf16,
+                                      interpret=interpret)
             return labels
         n_chunks = n_local // chunk_size
         xs = points.reshape(n_chunks, chunk_size, d)

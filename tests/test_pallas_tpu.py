@@ -72,7 +72,7 @@ def test_assign_only_kernel_compiles_and_matches_on_tpu():
         W = jnp.ones((2048,), jnp.float32)
         C = X[:9]
         labels_a, mind2_a = pallas_assign(X, C)
-        labels_f, mind2_f, _, _ = fused_assign_reduce(X, W, C)
+        labels_f, mind2_f, *_ = fused_assign_reduce(X, W, C)
         np.testing.assert_array_equal(np.asarray(labels_a),
                                       np.asarray(labels_f))
         np.testing.assert_allclose(np.asarray(mind2_a),
