@@ -73,8 +73,12 @@ def main() -> None:
     d = int(os.environ.get("BENCH_D", 128))
     k = int(os.environ.get("BENCH_K", 1024))
     iters = int(os.environ.get("BENCH_ITERS", 20))
-    mode = os.environ.get("BENCH_MODE", "matmul")
+    mode = os.environ.get("BENCH_MODE", "auto")
 
+    if mode == "auto":
+        # The library's own resolution rule (KMeans distance_mode='auto').
+        from kmeans_tpu.ops.pallas_kernels import resolve_auto
+        mode = resolve_auto(n, d, k)
     log(f"bench: backend={backend} devices={len(jax.devices())} "
         f"N={n} D={d} k={k} iters={iters} mode={mode}")
 

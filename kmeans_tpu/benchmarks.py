@@ -70,6 +70,9 @@ def bench_config(name: str, iters: int, mode: str) -> Dict:
     X = make_config_data(name, rng)
     n, d = X.shape
     k = CONFIG_K[name]
+    if mode == "auto":
+        from kmeans_tpu.ops.pallas_kernels import resolve_auto
+        mode = resolve_auto(n, d, k)
     mesh = make_mesh()
     data_shards, model_shards = mesh_shape(mesh)
     chunk = choose_chunk_size(-(-n // data_shards), k, d)
@@ -149,8 +152,9 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="kmeans_tpu benchmarks")
     parser.add_argument("--configs", default=",".join(DEFAULT_CONFIGS))
     parser.add_argument("--iters", type=int, default=5)
-    parser.add_argument("--mode", default="matmul",
-                        help="matmul | matmul_bf16 | pallas | pallas_bf16")
+    parser.add_argument("--mode", default="auto",
+                        help="auto | matmul | matmul_bf16 | pallas | "
+                             "pallas_bf16")
     args = parser.parse_args(argv)
 
     results = []

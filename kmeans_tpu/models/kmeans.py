@@ -157,7 +157,11 @@ class KMeans:
         devices with ``model_shards`` centroid shards.
     model_shards : size of the centroid-sharding (TP) axis for auto meshes.
     chunk_size : points per scan chunk (None = auto, VMEM-budgeted).
-    distance_mode : 'matmul' (MXU form) | 'direct' (exact; small problems).
+    distance_mode : 'auto' (default: the fused Pallas kernel on TPU
+        hardware where it measures faster — k >= 512 and low lane-padding
+        waste, see ops.pallas_kernels.pallas_preferred — else the XLA
+        'matmul' path) | 'matmul' (MXU form) | 'matmul_bf16' | 'pallas' |
+        'pallas_bf16' | 'direct' (exact; small problems).
     verbose : reference-style per-iteration prints (kmeans_spark.py:296-304).
     """
 
@@ -172,7 +176,7 @@ class KMeans:
                  mesh: Optional[Mesh] = None,
                  model_shards: int = 1,
                  chunk_size: Optional[int] = None,
-                 distance_mode: str = "matmul",
+                 distance_mode: str = "auto",
                  host_loop: bool = True,
                  verbose: bool = True):
         self.k = k
@@ -233,6 +237,15 @@ class KMeans:
 
     # ------------------------------------------------------------------ mesh
 
+    def _mode(self, n: int, d: int) -> str:
+        """Resolve distance_mode='auto' to a concrete mode for (n, d)
+        data (ops.pallas_kernels.pallas_preferred holds the measured
+        win-region rule); explicit modes pass through untouched."""
+        if self.distance_mode != "auto":
+            return self.distance_mode
+        from kmeans_tpu.ops.pallas_kernels import resolve_auto
+        return resolve_auto(n, d, self.k)
+
     def _resolve_mesh(self) -> Mesh:
         if self.mesh is None:
             self.mesh = make_mesh(model=self.model_shards)
@@ -248,7 +261,7 @@ class KMeans:
         mesh = self._resolve_mesh()
         _, model_shards = mesh_shape(mesh)
         chunk = self._chunk_for(n, d)
-        step_fn, predict_fn = _get_step_fns(mesh, chunk, self.distance_mode)
+        step_fn, predict_fn = _get_step_fns(mesh, chunk, self._mode(n, d))
         return mesh, model_shards, step_fn, predict_fn, chunk
 
     def cache(self, X, sample_weight=None) -> ShardedDataset:
@@ -288,7 +301,7 @@ class KMeans:
         mesh = self._resolve_mesh()
         _, model_shards = mesh_shape(mesh)
         step_fn, predict_fn = _get_step_fns(mesh, ds.chunk,
-                                            self.distance_mode)
+                                            self._mode(ds.n, ds.d))
         return ds, mesh, model_shards, step_fn, predict_fn
 
     def _put_centroids(self, centroids: np.ndarray, mesh: Mesh,
@@ -655,12 +668,13 @@ class KMeans:
         parallel.distributed.make_fit_fn for semantics and trade-offs."""
         seed = self.seed if seed is None else seed
         iters_left = self.max_iter - start_iter
-        key = (mesh, ds.chunk, self.distance_mode, self.k, iters_left,
+        mode = self._mode(ds.n, ds.d)
+        key = (mesh, ds.chunk, mode, self.k, iters_left,
                float(self.tolerance), self.empty_cluster, self.compute_sse,
                seed, start_iter, "fit")
         if key not in _STEP_CACHE:
             _STEP_CACHE[key] = dist.make_fit_fn(
-                mesh, chunk_size=ds.chunk, mode=self.distance_mode,
+                mesh, chunk_size=ds.chunk, mode=mode,
                 k_real=self.k, max_iter=iters_left,
                 tolerance=float(self.tolerance),
                 empty_policy=self.empty_cluster,
@@ -715,12 +729,13 @@ class KMeans:
         (parallel.distributed.make_multi_fit_fn) and the winner — lowest
         true final inertia — is selected on device too."""
         R = len(seeds)
-        key = (mesh, ds.chunk, self.distance_mode, self.k, self.max_iter,
+        mode = self._mode(ds.n, ds.d)
+        key = (mesh, ds.chunk, mode, self.k, self.max_iter,
                float(self.tolerance), self.empty_cluster, R,
                self.compute_sse, self.seed, "multifit")
         if key not in _STEP_CACHE:
             _STEP_CACHE[key] = dist.make_multi_fit_fn(
-                mesh, chunk_size=ds.chunk, mode=self.distance_mode,
+                mesh, chunk_size=ds.chunk, mode=mode,
                 k_real=self.k, max_iter=self.max_iter,
                 tolerance=float(self.tolerance),
                 empty_policy=self.empty_cluster, n_init=R,
@@ -832,7 +847,16 @@ class KMeans:
             raise ValueError("Model must be fitted before prediction")
         X = jnp.asarray(np.asarray(X, dtype=self.dtype))
         c = jnp.asarray(np.asarray(self.centroids, dtype=self.dtype))
-        d2 = _pairwise_jit(X, c, mode=self.distance_mode)
+        # transform needs the FULL (n, k) distance matrix, which only the
+        # XLA paths produce; pallas/auto map to the equivalent matmul form.
+        mode = self.distance_mode
+        if mode == "auto":
+            mode = "matmul"
+        elif mode == "pallas":
+            mode = "matmul"
+        elif mode == "pallas_bf16":
+            mode = "matmul_bf16"
+        d2 = _pairwise_jit(X, c, mode=mode)
         return np.sqrt(np.asarray(d2))
 
     def score(self, X, y=None) -> float:

@@ -118,10 +118,13 @@ class MiniBatchKMeans(KMeans):
                                          centroids, start_iter, seen,
                                          base_key, log)
 
-        cache_key = (mesh, bs_local, self.distance_mode, "mbstep")
+        # auto resolves against the BATCH row count — that's what the
+        # kernel would process per pass.
+        mode = self._mode(bs_local, ds.d)
+        cache_key = (mesh, bs_local, mode, "mbstep")
         if cache_key not in _STEP_CACHE:
             _STEP_CACHE[cache_key] = dist.make_minibatch_step_fn(
-                mesh, batch_per_shard=bs_local, mode=self.distance_mode)
+                mesh, batch_per_shard=bs_local, mode=mode)
         step_fn = _STEP_CACHE[cache_key]
         # Scale factor target: total dataset weight (== n when unweighted).
         total_w = float(np.asarray(
@@ -167,11 +170,12 @@ class MiniBatchKMeans(KMeans):
         iters_left = self.max_iter - start_iter
         if iters_left <= 0:
             return self
-        cache_key = (mesh, bs_local, self.distance_mode, self.k, iters_left,
+        mode = self._mode(bs_local, ds.d)
+        cache_key = (mesh, bs_local, mode, self.k, iters_left,
                      float(self.tolerance), self.compute_sse, "mbfit")
         if cache_key not in _STEP_CACHE:
             _STEP_CACHE[cache_key] = dist.make_minibatch_fit_fn(
-                mesh, batch_per_shard=bs_local, mode=self.distance_mode,
+                mesh, batch_per_shard=bs_local, mode=mode,
                 k_real=self.k, max_iter=iters_left,
                 tolerance=float(self.tolerance),
                 history_sse=self.compute_sse)

@@ -37,10 +37,13 @@ Design (r2 — each choice measured on a v5e, see docs/PERFORMANCE.md):
   sentinel coordinates): padding rows can never win the argmin, and the
   fold trick stays exact.
 
-Measured v5e results (steady-state ms/iter inside the on-device fit loop,
-marginal method): 2M x 128 k=1024: 7.4 vs 10.8 for the XLA scan path
-(1.46x); GloVe-shaped 400k x 100 k=3000: 3.7 vs 5.9 (1.6x).  See
-BASELINE.md for the bench-harness numbers.
+Measured v5e results (steady-state ms/iter inside the on-device fit
+loop, interleaved marginal medians): 2M x 128 k=1024: 7.9 vs 10.8 for
+the XLA scan path (1.37x); GloVe-shaped 400k x 100 k=3000: 4.4 vs 5.7
+(1.29x); 1M x 128 k=512: parity; small-k/small-D shapes LOSE to XLA
+(lane-padding waste) — ``pallas_preferred`` encodes the win region for
+``distance_mode='auto'``.  See BASELINE.md for the bench-harness
+numbers.
 
 Numerics: Mosaic executes f32 dots at bf16-input rate on this platform
 (one-pass bf16 multiplies, f32 accumulation — measured identical runtime
@@ -91,23 +94,61 @@ def _round_up(a: int, b: int) -> int:
 
 
 def choose_tiles(n: int, d_pad: int, k_pad: int) -> Tuple[int, int]:
-    """Measured tile heuristic (v5e sweep, experiments/exp_pallas_kernel.py):
-    large k wants a single wide k-tile (k_pad=3072: one 3072 tile beats
-    6x512 by 4.6x); small k wants two k-tiles so the pipelined phases
-    interleave (k=1024: 2x512 beats 1x1024 by 1.2x); tile_n targets ~2^22
-    tile elements, capped at 2048 rows."""
+    """Measured tile heuristic (v5e sweep, experiments/exp_pallas_kernel.py).
+    k-tiles narrower than 512 lanes are the failure mode (k=512 as 2x256:
+    7.1 ms vs 3.1 for one 512 tile; k=1024 as 8x128: 39 ms): never split
+    below 512.  Two ~512 tiles beat one 1024 tile at k=1024 (7.4 vs
+    8.8 ms — the pipelined phases interleave); k_pad >= 2048 wants wide
+    balanced tiles up to 4096 (one 3072 tile beats 6x512 by 4.6x), with
+    balance so the round-up to a tile_k multiple never inflates k_pad by
+    more than one 128-lane register (k=4224 with a fixed 4096 tile would
+    pad to 8192 — ~1.9x the MXU work).  tile_n targets ~2^22 tile
+    elements, capped at 2048 rows."""
     if k_pad >= 2048:
-        # One wide tile up to 4096; beyond that, balanced tiles so the
-        # round-up to a tile_k multiple never inflates k_pad by more
-        # than one 128-lane register (k=4224 with a fixed 4096 tile
-        # would pad to 8192 — ~1.9x the MXU work).
         k_tiles = _cdiv(k_pad, 4096)
         tile_k = _round_up(_cdiv(k_pad, k_tiles), 128)
+    elif k_pad >= 1024:
+        tile_k = _round_up(k_pad // 2, 128)        # two >=512-wide tiles
     else:
-        tile_k = max(128, _round_up(k_pad // 2, 128))
+        tile_k = k_pad                             # never split below 512
     tile_n = max(256, min(2048, (1 << 22) // max(tile_k, d_pad)))
     tile_n = 1 << (tile_n.bit_length() - 1)        # power-of-2 floor
     return tile_n, tile_k
+
+
+def pallas_preferred(n: int, d: int, k: int) -> bool:
+    """Should ``distance_mode='auto'`` pick the fused Pallas kernel here?
+
+    Measured win region (v5e, interleaved marginals vs the XLA scan path
+    — BASELINE.md): 2M x 128 k=1024: 1.37x; 400k x 100 k=3000: 1.29x;
+    1M x 128 k=512: parity.  Measured LOSS region: k=64 D=16: 11x slower
+    (lane padding makes the kernel do 16x the MXU work); k=10 D=784:
+    ~20x slower (k padded 12.8x).  Hence the two gates: enough real k
+    (>= 512), and <= 1.5x combined padding waste.  Also falls back when
+    the VMEM-resident centroid block would exceed the kernel budget, and
+    off TPU / under x64 (interpret mode is for CI, not speed).
+    """
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except RuntimeError:
+        on_tpu = False
+    if not on_tpu or jax.config.jax_enable_x64:
+        return False
+    d_pad = _round_up(d, 128)
+    k_pad0 = _round_up(k, 128)
+    if k < 512 or d_pad * k_pad0 > 1.5 * d * k:
+        return False
+    tile_n, tile_k = choose_tiles(n, d_pad, k_pad0)
+    k_pad = _round_up(k_pad0, tile_k)
+    return _vmem_estimate(tile_n, tile_k, d_pad, k_pad,
+                          True) <= _VMEM_LIMIT
+
+
+def resolve_auto(n: int, d: int, k: int) -> str:
+    """The single resolution rule behind ``distance_mode='auto'`` —
+    shared by KMeans._mode and both bench harnesses so benchmark numbers
+    always reflect the library default."""
+    return "pallas" if pallas_preferred(n, d, k) else "matmul"
 
 
 def _check_x64(interpret: bool) -> None:

@@ -1,0 +1,60 @@
+"""distance_mode='auto' resolution (r2): the fused Pallas kernel where it
+measures faster on TPU, the XLA matmul path everywhere else.
+
+These tests run on the CPU mesh, where auto must ALWAYS resolve to
+'matmul' (the kernel's interpret mode is for correctness CI, not speed);
+the shape rule itself is tested directly against the measured win/loss
+configs from BASELINE.md.
+"""
+
+import numpy as np
+import pytest
+
+from kmeans_tpu import KMeans
+from kmeans_tpu.data.synthetic import make_blobs
+
+
+def test_auto_is_the_default():
+    assert KMeans().distance_mode == "auto"
+
+
+def test_auto_resolves_to_matmul_off_tpu():
+    km = KMeans(k=3)
+    assert km._mode(10_000, 16) == "matmul"
+
+
+def test_explicit_mode_passes_through():
+    km = KMeans(k=3, distance_mode="direct")
+    assert km._mode(10_000, 16) == "direct"
+
+
+def test_shape_rule_matches_measured_win_loss_regions(monkeypatch):
+    """Pin the rule to the BASELINE.md measurements by faking a TPU
+    backend (the rule is pure shape logic past the backend gate)."""
+    import jax
+
+    from kmeans_tpu.ops import pallas_kernels as pk
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    with jax.enable_x64(False):
+        # Measured wins (BASELINE.md): headline and GloVe-shaped configs.
+        assert pk.pallas_preferred(2_000_000, 128, 1024)
+        assert pk.pallas_preferred(400_000, 100, 3000)
+        # Measured losses: lane-padding waste (blobs1m, mnist) and small k.
+        assert not pk.pallas_preferred(1_000_000, 16, 64)      # 11x slower
+        assert not pk.pallas_preferred(60_000, 784, 10)        # k pad 12.8x
+        assert not pk.pallas_preferred(10_000, 2, 5)
+        # k just under the gate.
+        assert not pk.pallas_preferred(1_000_000, 128, 511)
+        # Oversized centroid block falls back instead of raising.
+        assert not pk.pallas_preferred(1_000_000, 512, 200_000)
+    # x64 always falls back (Mosaic limitation, _check_x64).
+    with jax.enable_x64(True):
+        assert not pk.pallas_preferred(2_000_000, 128, 1024)
+
+
+def test_auto_fit_matches_matmul_fit_on_cpu():
+    X, _ = make_blobs(2_000, 3, 8, random_state=0, dtype=np.float32)
+    a = KMeans(k=3, seed=1, verbose=False).fit(X)
+    b = KMeans(k=3, seed=1, verbose=False, distance_mode="matmul").fit(X)
+    np.testing.assert_array_equal(a.centroids, b.centroids)

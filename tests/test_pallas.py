@@ -71,3 +71,19 @@ def test_fori_fallback_for_many_k_tiles():
     np.testing.assert_array_equal(np.asarray(counts), np.asarray(ref.counts))
     np.testing.assert_allclose(np.asarray(sums), np.asarray(ref.sums),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_nonfinite_rows_get_in_range_labels():
+    """NaN/Inf coordinates must never leak the manual argmin's index
+    sentinel: the cross-tile merge guard (NaN < running-min is False)
+    keeps such rows at label 0.  fit() rejects non-finite data up front;
+    this pins the kernel's own behavior for raw callers."""
+    X = np.random.default_rng(0).normal(size=(64, 8)).astype(np.float32)
+    X[3, 2] = np.nan
+    X[17, :] = np.inf
+    C = np.random.default_rng(1).normal(size=(300, 8)).astype(np.float32)
+    w = np.ones((64,), np.float32)
+    labels, *_ = fused_assign_reduce(X, w, C, tile_n=32, tile_k=128,
+                                     interpret=True)
+    assert 0 <= int(np.min(labels)) and int(np.max(labels)) < 300
+    assert int(labels[3]) == 0 and int(labels[17]) == 0
