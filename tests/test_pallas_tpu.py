@@ -77,3 +77,38 @@ def test_assign_only_kernel_compiles_and_matches_on_tpu():
                                       np.asarray(labels_f))
         np.testing.assert_allclose(np.asarray(mind2_a),
                                    np.asarray(mind2_f), rtol=1e-6)
+
+
+def test_pallas_fit_agrees_with_matmul_fit_in_win_region():
+    """End-to-end Mosaic-path agreement at a shape where auto picks the
+    kernel: both modes must converge to the same centroids from the same
+    init (assignments may differ only on bf16-product near-ties, which a
+    few Lloyd iterations wash out on blob data)."""
+    import numpy as np
+
+    from kmeans_tpu import KMeans
+    from kmeans_tpu.data.synthetic import make_blobs
+
+    with jax.enable_x64(False):
+        X, _ = make_blobs(40_000, 512, 64, random_state=3,
+                          dtype=np.float32)
+        a = KMeans(k=512, seed=5, max_iter=8, verbose=False,
+                   distance_mode="pallas", compute_sse=True).fit(X)
+        b = KMeans(k=512, seed=5, max_iter=8, verbose=False,
+                   distance_mode="matmul", compute_sse=True).fit(X)
+        np.testing.assert_allclose(
+            np.sort(a.centroids, axis=0), np.sort(b.centroids, axis=0),
+            rtol=1e-3, atol=1e-3)
+        # Algebraic (pallas) vs per-point (matmul) SSE agree to the
+        # bf16-product error class.
+        np.testing.assert_allclose(a.sse_history[-1], b.sse_history[-1],
+                                   rtol=2e-2)
+
+
+def test_auto_resolves_to_pallas_on_hardware():
+    from kmeans_tpu import KMeans
+
+    with jax.enable_x64(False):
+        km = KMeans(k=1024)
+        assert km._mode(2_000_000, 128) == "pallas"
+        assert km._mode(1_000_000, 16) == "matmul"   # padding-waste region
