@@ -148,6 +148,7 @@ def main() -> None:
         "vs_baseline": round(throughput * n_chips / base, 2),
         "ms_per_iter": round(per_iter * 1e3, 3),
         "spread": round(spread, 3),
+        "mode": mode,
     }))
 
 

@@ -149,10 +149,20 @@ def _pallas_local_stats(points, weights, centroids_block, *, mode: str,
         gmind2 = jnp.min(minds, axis=0)
         m_idx = lax.axis_index(MODEL_AXIS)
         w_eff = w * (owner == m_idx)                       # ownership mask
-        n_chunks = points.shape[0] // chunk_size
-        xs = (points.reshape(n_chunks, chunk_size, d),
-              labels.reshape(n_chunks, chunk_size),
-              w_eff.reshape(n_chunks, chunk_size))
+        # Prepped points (width != d) carry lane padding + a constant-1
+        # fold column at lane d: the scatter matmul's lane-d output
+        # column then IS the weighted counts (no separate VPU sum), and
+        # rows are a PREP_ROW_MULTIPLE multiple (chunk_size need not
+        # divide them).
+        from kmeans_tpu.ops.pallas_kernels import PREP_ROW_MULTIPLE
+        n_loc, d_in = points.shape
+        fold = d_in != d
+        acc_chunk = (chunk_size if n_loc % chunk_size == 0
+                     else PREP_ROW_MULTIPLE)
+        n_chunks = n_loc // acc_chunk
+        xs = (points.reshape(n_chunks, acc_chunk, d_in),
+              labels.reshape(n_chunks, acc_chunk),
+              w_eff.reshape(n_chunks, acc_chunk))
         ids = jnp.arange(k_local, dtype=labels.dtype)
 
         def body(carry, chk):
@@ -162,11 +172,16 @@ def _pallas_local_stats(points, weights, centroids_block, *, mode: str,
             s = s + lax.dot_general(oh, xc.astype(jnp.float32),
                                     (((0,), (0,)), ((), ())),
                                     preferred_element_type=jnp.float32)
-            return (s, cnt + jnp.sum(oh, axis=0)), None
+            if not fold:
+                cnt = cnt + jnp.sum(oh, axis=0)
+            return (s, cnt), None
 
         (sums, counts), _ = lax.scan(
-            body, (jnp.zeros((k_local, d), jnp.float32),
+            body, (jnp.zeros((k_local, d_in), jnp.float32),
                    jnp.zeros((k_local,), jnp.float32)), xs)
+        if fold:
+            counts = sums[:, d]
+            sums = sums[:, :d]
     zero = init_stats(k_local, d, acc)
     if not need_sse:
         sse = zero.sse
@@ -366,14 +381,15 @@ def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
         k_local, d = centroids_block.shape
         acc = _accum_dtype(points.dtype)
         x2w = w_col = None
-        if mode in PALLAS_MODES and model_shards <= 1:
+        if mode in PALLAS_MODES:
             # Hoist the kernel's x-side padding/fold-column/weight-layout
             # prep out of the iteration loop (~3 + 1.6 ms/iter at the
             # benchmark shapes; XLA does not hoist the full-array work
             # itself), and precompute the loop-invariant SSE term (see
-            # _sse_from_stats).
+            # _sse_from_stats; single-block stats only — the TP path's
+            # SSE comes from the gathered global minima).
             from kmeans_tpu.ops.pallas_kernels import prep_points
-            if need_sse:
+            if need_sse and model_shards <= 1:
                 x2w = _weighted_sqnorm_total(points, weights)
             points, weights, w_col = prep_points(points, weights)
         k_pad = k_local * model_shards
@@ -507,11 +523,12 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
         acc = _accum_dtype(points.dtype)
         R, k_local, d = cents0_blocks.shape
         x2w = w_col = None
-        if mode in PALLAS_MODES and model_shards <= 1:
+        if mode in PALLAS_MODES:
             # Hoist the kernel's x-side prep out of the loop (see
             # make_fit_fn); shared by every restart.
             from kmeans_tpu.ops.pallas_kernels import prep_points
-            x2w = _weighted_sqnorm_total(points, weights)
+            if model_shards <= 1:
+                x2w = _weighted_sqnorm_total(points, weights)
             points, weights, w_col = prep_points(points, weights)
         k_pad = k_local * model_shards
         m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0

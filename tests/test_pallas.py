@@ -87,3 +87,56 @@ def test_nonfinite_rows_get_in_range_labels():
                                      interpret=True)
     assert 0 <= int(np.min(labels)) and int(np.max(labels)) < 300
     assert int(labels[3]) == 0 and int(labels[17]) == 0
+
+
+@pytest.mark.parametrize("n,d,k", [
+    (513, 100, 9),     # fold path (d < 128), odd row count
+    (300, 128, 7),     # no-fold path (d == d_pad)
+    (257, 130, 5),     # d just past a lane boundary (d_pad = 256)
+    (1000, 40, 600),   # wide single-tile fold path (tile_k = k_pad)
+    (900, 40, 1100),   # TRUE multi-k-tile path (k_pad 1152 -> 2 tiles)
+])
+def test_fused_kernel_weighted_property_sweep(n, d, k):
+    """Weighted stats across fold/no-fold and single/multi k-tile paths
+    must match a NumPy oracle exactly on labels/counts and closely on
+    sums/mind2 (interpret mode computes true f32)."""
+    rng = np.random.default_rng(n + d + k)
+    X = rng.normal(size=(n, d)).astype(np.float32) * 3
+    C = rng.normal(size=(k, d)).astype(np.float32) * 3
+    w = rng.uniform(0.0, 2.0, size=n).astype(np.float32)
+    w[rng.choice(n, n // 5, replace=False)] = 0.0
+    labels, mind2, sums, counts = fused_assign_reduce(X, w, C,
+                                                      interpret=True)
+    d2 = ((X[:, None, :].astype(np.float64)
+           - C[None, :, :].astype(np.float64)) ** 2).sum(-1)
+    ref_labels = d2.argmin(1)
+    np.testing.assert_array_equal(np.asarray(labels), ref_labels)
+    np.testing.assert_allclose(np.asarray(mind2), d2.min(1), rtol=1e-4,
+                               atol=1e-4)
+    oh = np.zeros((n, k)); oh[np.arange(n), ref_labels] = w
+    np.testing.assert_allclose(np.asarray(sums), oh.T @ X, rtol=1e-4,
+                               atol=1e-3)
+    np.testing.assert_allclose(np.asarray(counts), oh.sum(0), rtol=1e-6,
+                               atol=1e-5)
+
+
+def test_prepped_inputs_match_raw_inputs():
+    """prep_points + kernel == raw inputs + kernel (the prep is pure
+    layout: row padding with zero weights, lane padding, fold column)."""
+    from kmeans_tpu.ops.pallas_kernels import prep_points
+
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(700, 60)).astype(np.float32)
+    C = rng.normal(size=(20, 60)).astype(np.float32)
+    w = rng.uniform(0.5, 1.5, size=700).astype(np.float32)
+    raw = fused_assign_reduce(X, w, C, interpret=True)
+    import jax.numpy as jnp
+    px, pw, pwc = prep_points(jnp.asarray(X), jnp.asarray(w))
+    prep = fused_assign_reduce(px, pwc, C, interpret=True)
+    np.testing.assert_array_equal(np.asarray(raw[0]),
+                                  np.asarray(prep[0])[:700])
+    # f32 accumulation order differs with the padded row tiling.
+    np.testing.assert_allclose(np.asarray(raw[2]), np.asarray(prep[2]),
+                               rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(raw[3]), np.asarray(prep[3]),
+                               rtol=1e-5)

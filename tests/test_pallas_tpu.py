@@ -90,7 +90,12 @@ def test_pallas_fit_agrees_with_matmul_fit_in_win_region():
     from kmeans_tpu.data.synthetic import make_blobs
 
     with jax.enable_x64(False):
-        X, _ = make_blobs(40_000, 512, 64, random_state=3,
+        # (n, centers, n_features): d=512, k=512 — inside the win
+        # region, with k matching the true center count so both modes
+        # converge to the same well-separated optimum (over-clustering
+        # would leave near-tie splits that are legitimately
+        # mode-dependent under bf16-rate products).
+        X, _ = make_blobs(40_000, 512, 512, random_state=3,
                           dtype=np.float32)
         a = KMeans(k=512, seed=5, max_iter=8, verbose=False,
                    distance_mode="pallas", compute_sse=True).fit(X)
