@@ -833,6 +833,48 @@ class KMeans:
         labels = predict_fn(ds.points, cents_dev)
         return np.asarray(labels)[: ds.n]
 
+    def predict_stream(self, make_blocks):
+        """Labels for a bigger-than-HBM dataset, one block at a time.
+
+        The streaming complement of ``fit_stream``: ``make_blocks()``
+        yields (m, D) arrays (e.g. ``data.io.iter_npy_blocks``); this
+        generator yields one int32 (m,) label array per block, uploading
+        only a block at a time.  Blocks may vary in size (each distinct
+        padded size compiles once).  Usage::
+
+            labels = np.concatenate(list(km.predict_stream(blocks)))
+        """
+        # Eager wrapper: the fitted-guard must fail AT THE CALL SITE like
+        # predict's (kmeans_spark.py:337-338), not on first iteration of
+        # the returned generator.
+        if self.centroids is None:
+            raise ValueError("Model must be fitted before prediction")
+        return self._predict_stream_blocks(make_blocks)
+
+    def _predict_stream_blocks(self, make_blocks):
+        from kmeans_tpu.parallel.sharding import shard_points
+        mesh = self._resolve_mesh()
+        _, model_shards = mesh_shape(mesh)
+        cents_dev = None
+        for block in make_blocks():
+            block = np.ascontiguousarray(np.asarray(block,
+                                                    dtype=self.dtype))
+            if block.ndim != 2:
+                raise ValueError(
+                    f"block must be 2-D (m, D), got shape {block.shape}")
+            if block.shape[1] != self.centroids.shape[1]:
+                raise ValueError(
+                    f"block has {block.shape[1]} features, model has "
+                    f"{self.centroids.shape[1]}")
+            if cents_dev is None:
+                cents_dev = self._put_centroids(
+                    np.asarray(self.centroids), mesh, model_shards)
+            chunk = self._chunk_for(*block.shape)
+            _, predict_fn = _get_step_fns(mesh, chunk,
+                                          self._mode(*block.shape))
+            pts, _ = shard_points(block, mesh, chunk)
+            yield np.asarray(predict_fn(pts, cents_dev))[: block.shape[0]]
+
     def fit_predict(self, X, y=None) -> np.ndarray:
         # labels_ is materialized by fit() from the same X — reusing it
         # avoids a second upload + assignment pass.

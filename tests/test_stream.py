@@ -147,3 +147,40 @@ def test_stream_resample_policy_from_reservoir(mesh8):
     b, c = run(8), run(8)
     assert np.all(np.isfinite(b.centroids))
     np.testing.assert_array_equal(b.centroids, c.centroids)
+
+
+def test_predict_stream_matches_predict():
+    """predict_stream over blocks == predict on the concatenated array,
+    including ragged final blocks and per-size compilation reuse."""
+    import numpy as np
+
+    from kmeans_tpu import KMeans
+    from kmeans_tpu.data.synthetic import make_blobs
+
+    X, _ = make_blobs(5_000, 4, 8, random_state=11, dtype=np.float32)
+    km = KMeans(k=4, seed=2, verbose=False).fit(X)
+
+    def blocks():
+        yield X[:2_000]
+        yield X[2_000:4_100]        # different size -> second compile
+        yield X[4_100:]             # ragged tail
+
+    streamed = np.concatenate(list(km.predict_stream(blocks)))
+    np.testing.assert_array_equal(streamed, km.predict(X))
+
+
+def test_predict_stream_guards():
+    import numpy as np
+    import pytest
+
+    from kmeans_tpu import KMeans
+
+    km = KMeans(k=3)
+    # Fail-fast: the guard raises AT THE CALL, not on first iteration.
+    with pytest.raises(ValueError, match="fitted before prediction"):
+        km.predict_stream(lambda: iter([np.zeros((4, 2))]))
+    X = np.random.default_rng(0).normal(size=(200, 6)).astype(np.float32)
+    km.fit(X)
+    bad = lambda: iter([np.zeros((8, 5), np.float32)])
+    with pytest.raises(ValueError, match="features"):
+        list(km.predict_stream(bad))
