@@ -117,3 +117,47 @@ def test_auto_resolves_to_pallas_on_hardware():
         km = KMeans(k=1024)
         assert km._mode(2_000_000, 128) == "pallas"
         assert km._mode(1_000_000, 16) == "matmul"   # padding-waste region
+
+
+def test_multi_restart_pallas_composes_on_hardware():
+    """n_init>1 vmaps the whole device loop over restarts; the pallas
+    kernel must lower under that batching and pick the same winner as
+    the XLA path."""
+    import numpy as np
+
+    from kmeans_tpu import KMeans
+    from kmeans_tpu.data.synthetic import make_blobs
+
+    with jax.enable_x64(False):
+        X, _ = make_blobs(50_000, 512, 512, random_state=5,
+                          dtype=np.float32)
+        a = KMeans(k=512, seed=7, n_init=3, host_loop=False, max_iter=6,
+                   verbose=False, distance_mode="pallas",
+                   compute_sse=True).fit(X)
+        b = KMeans(k=512, seed=7, n_init=3, host_loop=False, max_iter=6,
+                   verbose=False, distance_mode="matmul",
+                   compute_sse=True).fit(X)
+        assert a.best_restart_ == b.best_restart_
+        np.testing.assert_allclose(
+            np.sort(a.centroids, 0), np.sort(b.centroids, 0),
+            rtol=1e-3, atol=1e-3)
+
+
+def test_pallas_fit_is_deterministic_on_hardware():
+    """The determinism checker (the SPMD race-detector analogue) must
+    hold bit-exactly for the Mosaic kernel path: fixed grid order, no
+    atomics — two identical fits, identical bits."""
+    import numpy as np
+
+    from kmeans_tpu import KMeans
+    from kmeans_tpu.data.synthetic import make_blobs
+    from kmeans_tpu.utils.debug import check_determinism
+
+    with jax.enable_x64(False):
+        X, _ = make_blobs(30_000, 512, 512, random_state=6,
+                          dtype=np.float32)
+        report = check_determinism(
+            lambda: KMeans(k=512, seed=4, max_iter=4, verbose=False,
+                           distance_mode="pallas", compute_sse=True),
+            X, runs=2)
+        assert report["deterministic"], report["details"]
