@@ -19,10 +19,11 @@ from kmeans_tpu.models.kmeans import KMeans
 from kmeans_tpu.models.minibatch import MiniBatchKMeans
 from kmeans_tpu.models.bisecting import BisectingKMeans
 from kmeans_tpu.models.spherical import SphericalKMeans
+from kmeans_tpu.models.gmm import GaussianMixture
 from kmeans_tpu.parallel.mesh import make_mesh
 from kmeans_tpu.parallel.sharding import ShardedDataset
 
 __version__ = "0.1.0"
 
 __all__ = ["KMeans", "MiniBatchKMeans", "BisectingKMeans",
-           "SphericalKMeans", "ShardedDataset", "make_mesh", "__version__"]
+           "SphericalKMeans", "GaussianMixture", "ShardedDataset", "make_mesh", "__version__"]

@@ -10,7 +10,8 @@ from kmeans_tpu.models.kmeans import KMeans
 from kmeans_tpu.models.minibatch import MiniBatchKMeans
 from kmeans_tpu.models.bisecting import BisectingKMeans
 from kmeans_tpu.models.spherical import SphericalKMeans
+from kmeans_tpu.models.gmm import GaussianMixture
 from kmeans_tpu.models.init import forgy_init, kmeanspp_init
 
 __all__ = ["KMeans", "MiniBatchKMeans", "BisectingKMeans",
-           "SphericalKMeans", "forgy_init", "kmeanspp_init"]
+           "SphericalKMeans", "GaussianMixture", "forgy_init", "kmeanspp_init"]

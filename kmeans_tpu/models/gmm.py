@@ -1,0 +1,320 @@
+"""Diagonal-covariance Gaussian mixture — EM on the K-Means machinery.
+
+A beyond-reference model family (the reference framework is K-Means
+only, SURVEY.md §1): sklearn-style ``GaussianMixture`` whose E-step runs
+as the same chunked, data-sharded, psum-reduced SPMD pass as the K-Means
+assignment step (``parallel.gmm_step``), with the two (chunk, k)
+log-density matmuls on the MXU.  Host-side M-step in float64 (mirroring
+``KMeans``'s host centroid division), sklearn-compatible surface:
+``fit`` / ``predict`` / ``predict_proba`` / ``score`` /
+``score_samples`` / ``sample`` / ``aic`` / ``bic``, attributes
+``weights_`` / ``means_`` / ``covariances_`` / ``precisions_`` /
+``converged_`` / ``n_iter_`` / ``lower_bound_``.
+
+Only ``covariance_type='diag'`` is implemented — it is the one diagonal
+fit to the TPU formulation (full covariances need per-component k x D x D
+solves that leave the matmul-dominant regime); 'spherical' is a special
+case users can get by tying ``covariances_`` afterwards.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from kmeans_tpu.parallel.gmm_step import (EStats, make_gmm_predict_fn,
+                                          make_gmm_step_fn)
+from kmeans_tpu.parallel.mesh import make_mesh, mesh_shape
+from kmeans_tpu.parallel.sharding import (ShardedDataset, choose_chunk_size,
+                                          to_device)
+from kmeans_tpu.utils.validation import check_finite_array
+
+_STEP_CACHE: dict = {}
+# Softmax sharpness for the hard-assignment init pass: with inv_var this
+# large, the nearest-centroid log-density dominates by >>f32 range, so
+# responsibilities are exactly one-hot (sklearn inits from one-hot
+# KMeans-label responsibilities too).
+_HARD_INV_VAR = 1e6
+
+
+def _get_fns(mesh: Mesh, chunk: int):
+    key = (mesh, chunk, "gmm")
+    if key not in _STEP_CACHE:
+        _STEP_CACHE[key] = (make_gmm_step_fn(mesh, chunk_size=chunk),
+                            make_gmm_predict_fn(mesh, chunk_size=chunk))
+    return _STEP_CACHE[key]
+
+
+class GaussianMixture:
+    """sklearn-style diagonal GMM, data-sharded over the TPU mesh.
+
+    Parameters follow ``sklearn.mixture.GaussianMixture`` where they
+    overlap (``n_components``, ``tol``, ``reg_covar``, ``max_iter``,
+    ``init_params``: 'kmeans' | 'k-means++' | 'random', explicit
+    ``weights_init`` / ``means_init`` / ``precisions_init``); ``seed``,
+    ``mesh``, ``chunk_size``, ``dtype``, ``verbose`` follow this
+    framework's ``KMeans``.  ``lower_bound_`` is the mean per-sample
+    log-likelihood, and convergence is its absolute change < ``tol``
+    (sklearn semantics).
+    """
+
+    def __init__(self, n_components: int = 1, *,
+                 covariance_type: str = "diag", tol: float = 1e-3,
+                 reg_covar: float = 1e-6, max_iter: int = 100,
+                 init_params: str = "kmeans", weights_init=None,
+                 means_init=None, precisions_init=None, seed: int = 42,
+                 dtype=None, mesh: Optional[Mesh] = None,
+                 chunk_size: Optional[int] = None, verbose: bool = False):
+        if covariance_type != "diag":
+            raise ValueError(
+                "only covariance_type='diag' is implemented (see module "
+                f"docstring), got {covariance_type!r}")
+        if n_components < 1:
+            raise ValueError(f"n_components must be >= 1, "
+                             f"got {n_components}")
+        if max_iter < 1:
+            raise ValueError(f"max_iter must be >= 1, got {max_iter}")
+        if tol < 0 or reg_covar < 0:
+            raise ValueError("tol and reg_covar must be >= 0")
+        if init_params not in ("kmeans", "k-means++", "kmeans++", "random"):
+            raise ValueError(f"unknown init_params {init_params!r}")
+        self.n_components = n_components
+        self.covariance_type = covariance_type
+        self.tol = tol
+        self.reg_covar = reg_covar
+        self.max_iter = max_iter
+        self.init_params = init_params
+        self.weights_init = weights_init
+        self.means_init = means_init
+        self.precisions_init = precisions_init
+        self.seed = seed
+        self.dtype = np.dtype(jax.dtypes.canonicalize_dtype(
+            np.dtype(dtype) if dtype is not None else np.float32))
+        self.mesh = mesh
+        self.chunk_size = chunk_size
+        self.verbose = verbose
+
+        self.weights_: Optional[np.ndarray] = None
+        self.means_: Optional[np.ndarray] = None
+        self.covariances_: Optional[np.ndarray] = None
+        self.converged_: bool = False
+        self.n_iter_: int = 0
+        self.lower_bound_: float = -np.inf
+
+    # ------------------------------------------------------------- plumbing
+
+    def _resolve_mesh(self) -> Mesh:
+        if self.mesh is None:
+            self.mesh = make_mesh(model=1)
+        return self.mesh
+
+    def _dataset(self, X, sample_weight=None) -> ShardedDataset:
+        if isinstance(X, ShardedDataset):
+            return X
+        X = np.asarray(X, dtype=self.dtype)
+        if X.ndim != 2:
+            raise ValueError(f"X must be 2-D (n, D), got shape {X.shape}")
+        check_finite_array(X, "Data contains NaN or Inf values")
+        mesh = self._resolve_mesh()
+        data_shards, _ = mesh_shape(mesh)
+        chunk = self.chunk_size or choose_chunk_size(
+            -(-X.shape[0] // data_shards), self.n_components, X.shape[1])
+        return to_device(X, mesh, chunk, self.dtype,
+                         sample_weight=sample_weight)
+
+    def _params_dev(self):
+        a = 1.0 / np.maximum(self.covariances_, self.reg_covar)
+        return (jnp.asarray(self.means_.astype(self.dtype)),
+                jnp.asarray(a.astype(self.dtype)),
+                jnp.asarray(np.log(self.covariances_).sum(1)
+                            .astype(self.dtype)),
+                jnp.asarray(np.log(self.weights_).astype(self.dtype)))
+
+    # ----------------------------------------------------------------- init
+
+    def _init_params(self, ds: ShardedDataset, step_fn):
+        d = ds.d
+        k = self.n_components
+        if self.means_init is not None:
+            means = np.asarray(self.means_init, np.float64)
+            if means.shape != (k, d):
+                raise ValueError(f"means_init shape {means.shape} != "
+                                 f"({k}, {d})")
+        else:
+            if self.init_params == "random":
+                # sklearn 'random' draws random responsibilities; seeding
+                # means at random points is the established analogue.
+                from kmeans_tpu.models.init import forgy_init
+                means = np.asarray(forgy_init(ds, k, self.seed,
+                                              validate=False), np.float64)
+            else:
+                # Both 'kmeans' and 'k-means++' seed the internal KMeans
+                # with D^2 (k-means++) sampling — sklearn's 'kmeans' mode
+                # also runs its KMeans with init='k-means++'; 'k-means++'
+                # here skips the Lloyd refinement (seeding only).
+                from kmeans_tpu.models.kmeans import KMeans
+                refine = 20 if self.init_params == "kmeans" else 1
+                km = KMeans(k=k, seed=self.seed, init="kmeans++",
+                            max_iter=refine, verbose=False,
+                            compute_labels=False, mesh=self.mesh,
+                            empty_cluster="resample")
+                km._eager_labels = False
+                km.fit(ds)
+                means = np.asarray(km.centroids, np.float64)
+
+        # One HARD-assignment E-step (inv_var >> data scale makes the
+        # softmax one-hot) yields the per-component one-hot statistics
+        # sklearn also inits from; M-step below turns them into
+        # weights/covariances.  Explicit precisions/weights_init override.
+        hard = step_fn(ds.points, ds.weights,
+                       jnp.asarray(means.astype(self.dtype)),
+                       jnp.full((k, d), self.dtype.type(_HARD_INV_VAR)),
+                       jnp.zeros((k,), self.dtype),
+                       jnp.zeros((k,), self.dtype))
+        w_total, (pi, mu, var) = self._m_step(hard)
+        self.means_ = mu if self.means_init is None else means
+        self.weights_ = (pi if self.weights_init is None
+                         else np.asarray(self.weights_init, np.float64))
+        if self.precisions_init is not None:
+            self.covariances_ = 1.0 / np.asarray(self.precisions_init,
+                                                 np.float64)
+        else:
+            self.covariances_ = var
+        self.weights_ = self.weights_ / self.weights_.sum()
+        return w_total
+
+    # ------------------------------------------------------------------- EM
+
+    def _m_step(self, st: EStats):
+        """float64 host M-step from the psum-reduced E statistics."""
+        R = np.asarray(st.resp_sum, np.float64)
+        S1 = np.asarray(st.xsum, np.float64)
+        S2 = np.asarray(st.x2sum, np.float64)
+        w_total = float(R.sum())
+        Rc = np.maximum(R, 10 * np.finfo(np.float64).tiny)
+        mu = S1 / Rc[:, None]
+        var = S2 / Rc[:, None] - mu ** 2 + self.reg_covar
+        var = np.maximum(var, self.reg_covar)
+        pi = np.maximum(R / max(w_total, 1e-300), 1e-300)
+        return w_total, (pi / pi.sum(), mu, var)
+
+    def fit(self, X, sample_weight=None) -> "GaussianMixture":
+        ds = self._dataset(X, sample_weight)
+        mesh = self._resolve_mesh()
+        step_fn, _ = _get_fns(mesh, ds.chunk)
+        self._fit_chunk = ds.chunk
+        w_total = self._init_params(ds, step_fn)
+        if w_total <= 0:
+            raise ValueError("total sample weight must be positive")
+
+        self.converged_ = False
+        prev = -np.inf
+        for it in range(1, self.max_iter + 1):
+            t0 = time.perf_counter()
+            st: EStats = step_fn(ds.points, ds.weights, *self._params_dev())
+            _, (pi, mu, var) = self._m_step(st)
+            self.weights_, self.means_, self.covariances_ = pi, mu, var
+            self.lower_bound_ = float(st.loglik) / w_total
+            self.n_iter_ = it
+            if self.verbose:
+                print(f"EM iteration {it}: mean log-likelihood = "
+                      f"{self.lower_bound_:.6f} "
+                      f"[{(time.perf_counter() - t0) * 1e3:.1f} ms]",
+                      flush=True)
+            if not np.isfinite(self.lower_bound_):
+                raise ValueError(
+                    f"non-finite log-likelihood at EM iteration {it}")
+            if abs(self.lower_bound_ - prev) < self.tol:
+                self.converged_ = True
+                break
+            prev = self.lower_bound_
+        return self
+
+    # ------------------------------------------------------------ inference
+
+    def _check_fitted(self):
+        if self.means_ is None:
+            raise ValueError("Model must be fitted before prediction")
+
+    def _posterior(self, X):
+        self._check_fitted()
+        ds = self._dataset(X)
+        mesh = self._resolve_mesh()
+        _, predict_fn = _get_fns(mesh, ds.chunk)
+        labels, logr, lse = predict_fn(ds.points, *self._params_dev())
+        return (np.asarray(labels)[: ds.n],
+                np.asarray(logr)[: ds.n].astype(np.float64),
+                np.asarray(lse)[: ds.n].astype(np.float64))
+
+    def predict(self, X) -> np.ndarray:
+        return self._posterior(X)[0]
+
+    def predict_proba(self, X) -> np.ndarray:
+        return np.exp(self._posterior(X)[1])
+
+    def score_samples(self, X) -> np.ndarray:
+        """Per-sample log-likelihood log p(x) under the mixture."""
+        return self._posterior(X)[2]
+
+    def score(self, X, y=None) -> float:
+        """Mean per-sample log-likelihood (sklearn convention)."""
+        return float(np.mean(self.score_samples(X)))
+
+    def sample(self, n_samples: int = 1):
+        """Draw (X, component_labels) from the fitted mixture."""
+        self._check_fitted()
+        rng = np.random.default_rng(self.seed)
+        comp = rng.choice(self.n_components, size=n_samples,
+                          p=self.weights_ / self.weights_.sum())
+        X = (self.means_[comp]
+             + rng.standard_normal((n_samples, self.means_.shape[1]))
+             * np.sqrt(self.covariances_[comp]))
+        return X.astype(self.dtype), comp.astype(np.int32)
+
+    # ----------------------------------------------------- model selection
+
+    @property
+    def precisions_(self) -> np.ndarray:
+        self._check_fitted()
+        return 1.0 / self.covariances_
+
+    def _n_parameters(self) -> int:
+        k, d = self.n_components, self.means_.shape[1]
+        return (k - 1) + k * d + k * d
+
+    def bic(self, X) -> float:
+        n = np.asarray(X).shape[0] if not isinstance(X, ShardedDataset) \
+            else X.n
+        return (-2.0 * self.score(X) * n
+                + self._n_parameters() * math.log(n))
+
+    def aic(self, X) -> float:
+        n = np.asarray(X).shape[0] if not isinstance(X, ShardedDataset) \
+            else X.n
+        return -2.0 * self.score(X) * n + 2.0 * self._n_parameters()
+
+    def get_params(self, deep: bool = True) -> dict:
+        return {"n_components": self.n_components,
+                "covariance_type": self.covariance_type, "tol": self.tol,
+                "reg_covar": self.reg_covar, "max_iter": self.max_iter,
+                "init_params": self.init_params,
+                "weights_init": self.weights_init,
+                "means_init": self.means_init,
+                "precisions_init": self.precisions_init,
+                "seed": self.seed, "dtype": self.dtype, "mesh": self.mesh,
+                "chunk_size": self.chunk_size, "verbose": self.verbose}
+
+    def set_params(self, **params) -> "GaussianMixture":
+        valid = self.get_params()
+        for name, value in params.items():
+            if name not in valid:
+                raise ValueError(f"invalid parameter {name!r} for "
+                                 f"GaussianMixture")
+            setattr(self, name, value)
+        return self

@@ -1,0 +1,157 @@
+"""The SPMD EM step for diagonal-covariance Gaussian mixtures.
+
+Same execution model as the K-Means step (``distributed.make_step_fn``):
+points sharded on the ``data`` mesh axis, parameters replicated, one
+jitted ``shard_map`` whose only collective is a ``psum`` of dense
+per-component accumulators.  The reference framework has no mixture
+model at all — this is a beyond-reference family built on the same
+TPU-first machinery (SURVEY.md §2.3 backend mapping).
+
+TPU formulation of the E-step: for diagonal Gaussians,
+
+    log N(x | mu_k, sigma_k^2)
+      = -0.5 * [ sum_d x_d^2 * a_kd  -  2 sum_d x_d * (mu_kd * a_kd)
+                 + sum_d mu_kd^2 * a_kd + sum_d log sigma_kd^2
+                 + D * log 2pi ]                    with a = 1/sigma^2,
+
+so the (chunk, k) log-density tile is TWO matmuls — ``x^2 @ a.T`` and
+``x @ (mu*a).T`` — plus per-component row constants: the same
+MXU-dominant shape as the K-Means distance pass.  Responsibilities come
+from a max-subtracted softmax over k; the per-chunk accumulators
+
+    R_k    = sum_i w_i r_ik                       (k,)
+    S1_k   = sum_i w_i r_ik x_i                   (k, D)  [resp.T @ x]
+    S2_k   = sum_i w_i r_ik x_i^2                 (k, D)  [resp.T @ x^2]
+    ll     = sum_i w_i logsumexp_k(...)           ()
+
+are all dense and psum-able; the M-step (host or caller side) is then
+pi = R/W, mu = S1/R, sigma^2 = S2/R - mu^2 + reg.  Zero-weight padding
+rows contribute nothing to any statistic.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kmeans_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, mesh_shape
+
+_LOG2PI = math.log(2.0 * math.pi)
+
+
+class EStats(NamedTuple):
+    """Globally-reduced E-step statistics (everything psum-able)."""
+
+    resp_sum: jax.Array    # (k,)   sum of weighted responsibilities
+    xsum: jax.Array        # (k, D) responsibility-weighted point sums
+    x2sum: jax.Array       # (k, D) responsibility-weighted square sums
+    loglik: jax.Array      # ()     weighted total log-likelihood
+
+
+def _log_prob_chunk(x, means, inv_var, log_det, log_weights):
+    """(chunk, k) weighted log joint: log pi_k + log N(x | mu_k, s2_k)."""
+    a = inv_var                                    # (k, D)
+    b = means * inv_var                            # (k, D)
+    x2a = lax.dot_general(x * x, a, (((1,), (1,)), ((), ())),
+                          preferred_element_type=x.dtype)   # (c, k) MXU
+    xb = lax.dot_general(x, b, (((1,), (1,)), ((), ())),
+                         preferred_element_type=x.dtype)    # (c, k) MXU
+    quad = x2a - 2.0 * xb + jnp.sum(means * b, axis=1)[None, :]
+    d = x.shape[1]
+    return (log_weights[None, :]
+            - 0.5 * (quad + log_det[None, :] + d * _LOG2PI))
+
+
+def estep_chunk(x, w, means, inv_var, log_det, log_weights):
+    """One chunk's contribution to EStats (shared by step fn and tests)."""
+    logp = _log_prob_chunk(x, means, inv_var, log_det, log_weights)
+    m = jnp.max(logp, axis=1, keepdims=True)
+    p = jnp.exp(logp - m)
+    denom = jnp.sum(p, axis=1, keepdims=True)
+    lse = (m[:, 0] + jnp.log(denom[:, 0]))
+    resp = p / denom * w[:, None]                  # weighted, padded -> 0
+    return EStats(
+        resp_sum=jnp.sum(resp, axis=0),
+        xsum=lax.dot_general(resp, x, (((0,), (0,)), ((), ())),
+                             preferred_element_type=x.dtype),
+        x2sum=lax.dot_general(resp, x * x, (((0,), (0,)), ((), ())),
+                              preferred_element_type=x.dtype),
+        loglik=jnp.sum(jnp.where(w > 0, lse * w, 0.0)),
+    )
+
+
+def make_gmm_step_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
+    """Build the jitted SPMD E-step:
+    (points, weights, means, inv_var, log_det, log_weights) -> EStats,
+    fully replicated.  Parameters are replicated (no model-axis sharding
+    for the mixture family — k*2D parameter tables are small next to the
+    data); the data axis carries N exactly like the K-Means step."""
+    data_shards, model_shards = mesh_shape(mesh)
+    if model_shards > 1:
+        raise ValueError(
+            "GaussianMixture does not shard its parameter tables; build "
+            "the mesh with model_shards=1 (the data axis still scales N)")
+
+    def step(points, weights, means, inv_var, log_det, log_weights):
+        k, d = means.shape
+        acc = points.dtype
+        n_chunks = points.shape[0] // chunk_size
+        xs = (points.reshape(n_chunks, chunk_size, d),
+              weights.astype(acc).reshape(n_chunks, chunk_size))
+
+        def body(carry, chunk):
+            xc, wc = chunk
+            st = estep_chunk(xc, wc, means, inv_var, log_det, log_weights)
+            return EStats(carry.resp_sum + st.resp_sum,
+                          carry.xsum + st.xsum,
+                          carry.x2sum + st.x2sum,
+                          carry.loglik + st.loglik), None
+
+        init = EStats(jnp.zeros((k,), acc), jnp.zeros((k, d), acc),
+                      jnp.zeros((k, d), acc), jnp.zeros((), acc))
+        st, _ = lax.scan(body, init, xs)
+        return EStats(*(lax.psum(s, DATA_AXIS) for s in st))
+
+    mapped = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None, None),
+                  P(None, None), P(None), P(None)),
+        out_specs=EStats(P(None), P(None, None), P(None, None), P()),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+def make_gmm_predict_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
+    """Jitted sharded posterior pass:
+    (points, means, inv_var, log_det, log_weights) ->
+    (labels, log_resp (n, k), log_prob (n,)) — the marginal
+    ``log p(x) = logsumexp_k`` rides along for score/score_samples."""
+    data_shards, model_shards = mesh_shape(mesh)
+
+    def predict(points, means, inv_var, log_det, log_weights):
+        k, d = means.shape
+        n_chunks = points.shape[0] // chunk_size
+        xs = points.reshape(n_chunks, chunk_size, d)
+
+        def body(_, xc):
+            logp = _log_prob_chunk(xc, means, inv_var, log_det,
+                                   log_weights)
+            lse = jax.scipy.special.logsumexp(logp, axis=1, keepdims=True)
+            return None, (jnp.argmax(logp, axis=1).astype(jnp.int32),
+                          logp - lse, lse[:, 0])
+
+        _, (labels, logr, lse) = lax.scan(body, None, xs)
+        return labels.reshape(-1), logr.reshape(-1, k), lse.reshape(-1)
+
+    mapped = jax.shard_map(
+        predict, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(None, None), P(None, None),
+                  P(None), P(None)),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS, None), P(DATA_AXIS)),
+        check_vma=False)
+    return jax.jit(mapped)

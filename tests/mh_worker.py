@@ -112,6 +112,21 @@ km_tp = KMeans(k=4, seed=0, init=init, empty_cluster="keep",
 np.save(out_dir / f"centroids_tp_{proc_id}.npy", km_tp.centroids)
 np.save(out_dir / f"sse_tp_{proc_id}.npy", np.asarray(km_tp.sse_history))
 
+# Pallas mode (interpret off-TPU) under the SAME cross-process TP mesh:
+# covers pallas_assign + the prepped ownership-masked accumulation with
+# the model-axis all_gather crossing the process boundary for real.
+km_ptp = KMeans(k=4, seed=0, init=init, empty_cluster="keep",
+                compute_sse=True, verbose=False,
+                distance_mode="pallas").fit(ds_tp)
+np.testing.assert_allclose(km_ptp.centroids, km_tp.centroids,
+                           rtol=1e-5, atol=1e-5)
+# And data-parallel pallas on the process-local dataset.
+km_pdp = KMeans(k=4, seed=0, init=init, empty_cluster="keep",
+                compute_sse=True, verbose=False,
+                distance_mode="pallas").fit(ds)
+np.testing.assert_allclose(km_pdp.centroids, km.centroids,
+                           rtol=1e-5, atol=1e-5)
+
 np.save(out_dir / f"centroids_{proc_id}.npy", km.centroids)
 np.save(out_dir / f"sse_{proc_id}.npy", np.asarray(km.sse_history))
 print(f"proc {proc_id}: OK iters={km.iterations_run} "

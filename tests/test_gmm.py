@@ -1,0 +1,151 @@
+"""GaussianMixture (diag EM on the K-Means machinery) vs the sklearn
+oracle and its own invariants.  Runs on the 8-virtual-device CPU mesh
+like the rest of the suite; the E-step is the same sharded psum pass at
+any device count."""
+
+import numpy as np
+import pytest
+
+from kmeans_tpu import GaussianMixture
+from kmeans_tpu.data.synthetic import make_blobs
+
+
+def _data(n=4_000, centers=3, d=5, seed=0):
+    X, y = make_blobs(n, centers, d, random_state=seed, dtype=np.float32)
+    return X, y
+
+
+def _shared_init(X, k, seed=0):
+    """Explicit identical init for trajectory-level sklearn parity."""
+    rng = np.random.default_rng(seed)
+    means = X[rng.choice(len(X), k, replace=False)].astype(np.float64)
+    weights = np.full(k, 1.0 / k)
+    precisions = np.ones((k, X.shape[1]))
+    return means, weights, precisions
+
+
+def test_em_matches_sklearn_with_shared_init():
+    sklearn_gmm = pytest.importorskip("sklearn.mixture").GaussianMixture
+    X, _ = _data()
+    k = 3
+    means, weights, precisions = _shared_init(X, k)
+    ours = GaussianMixture(
+        n_components=k, max_iter=15, tol=0.0, reg_covar=1e-6,
+        means_init=means, weights_init=weights,
+        precisions_init=precisions).fit(X)
+    ref = sklearn_gmm(
+        n_components=k, covariance_type="diag", max_iter=15, tol=0.0,
+        reg_covar=1e-6, means_init=means, weights_init=weights,
+        precisions_init=precisions, n_init=1).fit(X.astype(np.float64))
+    np.testing.assert_allclose(ours.means_, ref.means_, rtol=2e-3,
+                               atol=2e-3)
+    np.testing.assert_allclose(ours.weights_, ref.weights_, rtol=2e-3,
+                               atol=1e-4)
+    np.testing.assert_allclose(ours.covariances_, ref.covariances_,
+                               rtol=5e-3, atol=5e-4)
+    # Mean log-likelihood (sklearn's lower_bound_ is also per-sample).
+    np.testing.assert_allclose(ours.lower_bound_, ref.lower_bound_,
+                               rtol=1e-4)
+    # Posterior agreement.
+    np.testing.assert_allclose(ours.predict_proba(X),
+                               ref.predict_proba(X.astype(np.float64)),
+                               atol=2e-3)
+    assert (ours.predict(X) == ref.predict(X.astype(np.float64))).mean() \
+        > 0.999
+
+
+def test_loglik_monotone_nondecreasing():
+    X, _ = _data(seed=3)
+    gm = GaussianMixture(n_components=4, max_iter=20, tol=0.0, seed=1,
+                         verbose=False)
+    history = []
+    orig = GaussianMixture._m_step
+
+    def spy(self, st):
+        history.append(float(st.loglik))
+        return orig(self, st)
+
+    GaussianMixture._m_step = spy
+    try:
+        gm.fit(X)
+    finally:
+        GaussianMixture._m_step = orig
+    ll = np.array(history[1:])       # skip the hard-assignment init pass
+    assert np.all(np.diff(ll) >= -1e-3 * np.abs(ll[:-1])), ll
+
+
+def test_posterior_rows_sum_to_one_and_score():
+    X, _ = _data(seed=4)
+    gm = GaussianMixture(n_components=3, max_iter=10, seed=2).fit(X)
+    proba = gm.predict_proba(X)
+    np.testing.assert_allclose(proba.sum(1), 1.0, atol=1e-5)
+    assert proba.shape == (len(X), 3)
+    assert np.isfinite(gm.score(X))
+    np.testing.assert_allclose(gm.score(X), gm.score_samples(X).mean())
+    # labels are the argmax of the posterior
+    np.testing.assert_array_equal(gm.predict(X), proba.argmax(1))
+
+
+def test_recovers_blob_structure():
+    X, y = _data(n=6_000, centers=4, d=6, seed=7)
+    gm = GaussianMixture(n_components=4, max_iter=50, seed=3).fit(X)
+    assert gm.converged_
+    labels = gm.predict(X)
+    # Cluster/label agreement up to permutation: each true blob maps to
+    # one dominant component.
+    purity = 0.0
+    for c in range(4):
+        frac = np.bincount(labels[y == c], minlength=4).max() / (y == c).sum()
+        purity += frac / 4
+    assert purity > 0.95, purity
+
+
+def test_sample_weight_equivalence_with_duplication():
+    X, _ = _data(n=1_000, seed=5)
+    Xdup = np.concatenate([X, X[:300]])
+    w = np.ones(len(X), np.float32)
+    w[:300] = 2.0
+    means, weights, precisions = _shared_init(X, 3, seed=1)
+    a = GaussianMixture(n_components=3, max_iter=8, tol=0.0,
+                        means_init=means, weights_init=weights,
+                        precisions_init=precisions).fit(
+        X, sample_weight=w)
+    b = GaussianMixture(n_components=3, max_iter=8, tol=0.0,
+                        means_init=means, weights_init=weights,
+                        precisions_init=precisions).fit(Xdup)
+    np.testing.assert_allclose(a.means_, b.means_, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(a.weights_, b.weights_, rtol=1e-4)
+
+
+def test_sample_and_information_criteria():
+    X, _ = _data(seed=6)
+    gm = GaussianMixture(n_components=3, max_iter=10, seed=4).fit(X)
+    S, comp = gm.sample(500)
+    assert S.shape == (500, X.shape[1]) and comp.shape == (500,)
+    assert set(np.unique(comp)) <= set(range(3))
+    # More components should not catastrophically improve BIC on 3 blobs.
+    assert np.isfinite(gm.bic(X)) and np.isfinite(gm.aic(X))
+    assert gm.bic(X) > gm.aic(X) - 1e9
+
+
+def test_guards():
+    with pytest.raises(ValueError, match="covariance_type"):
+        GaussianMixture(covariance_type="full")
+    with pytest.raises(ValueError, match="n_components"):
+        GaussianMixture(n_components=0)
+    with pytest.raises(ValueError, match="init_params"):
+        GaussianMixture(init_params="bogus")
+    gm = GaussianMixture(n_components=2)
+    with pytest.raises(ValueError, match="fitted before prediction"):
+        gm.predict(np.zeros((4, 2), np.float32))
+    with pytest.raises(ValueError, match="NaN or Inf"):
+        GaussianMixture(n_components=2).fit(
+            np.array([[1.0, np.nan]], np.float32))
+
+
+def test_cached_dataset_roundtrip():
+    X, _ = _data(seed=8)
+    gm = GaussianMixture(n_components=3, max_iter=10, seed=5)
+    gm.fit(X)
+    ds = gm._dataset(X)
+    np.testing.assert_array_equal(gm.predict(ds), gm.predict(X))
