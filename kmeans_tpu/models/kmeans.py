@@ -731,15 +731,15 @@ class KMeans:
         R = len(seeds)
         mode = self._mode(ds.n, ds.d)
         key = (mesh, ds.chunk, mode, self.k, self.max_iter,
-               float(self.tolerance), self.empty_cluster, R,
-               self.compute_sse, self.seed, "multifit")
+               float(self.tolerance), self.empty_cluster, tuple(seeds),
+               self.compute_sse, "multifit")
         if key not in _STEP_CACHE:
             _STEP_CACHE[key] = dist.make_multi_fit_fn(
                 mesh, chunk_size=ds.chunk, mode=mode,
                 k_real=self.k, max_iter=self.max_iter,
                 tolerance=float(self.tolerance),
                 empty_policy=self.empty_cluster, n_init=R,
-                history_sse=self.compute_sse, seed=self.seed)
+                history_sse=self.compute_sse, seeds=tuple(seeds))
         fit_fn = _STEP_CACHE[key]
         _, model_shards = mesh_shape(mesh)
         inits = np.stack([dist.pad_centroids(

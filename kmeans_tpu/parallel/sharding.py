@@ -206,8 +206,14 @@ class ShardedDataset:
         # (multi-host workers run without jax_enable_x64).
         seed = int(np.random.SeedSequence(seed_seq).generate_state(1)[0]
                    % (2 ** 31))
+        # Cap at the positive-weight population like the host-copy engine:
+        # an uncapped _gumbel_rows would "draw" row 0 once the without-
+        # replacement mask is exhausted, installing a zero-weight row.
+        take = min(m, int(jnp.sum(self.weights > 0)))
+        if take == 0:
+            return np.empty((0, self.d))
         rows = jax.device_get(_gumbel_rows(self.points, self.weights,
-                                           seed, m))
+                                           seed, take))
         return np.asarray(rows, dtype=np.float64)
 
     def with_weights(self, sample_weight: np.ndarray) -> "ShardedDataset":
