@@ -1,13 +1,13 @@
 """The model family: KMeans, MiniBatchKMeans, BisectingKMeans,
-SphericalKMeans — all sharing the same fused TPU step.
+SphericalKMeans, GaussianMixture — all sharing the same fused TPU step.
 
 Run: ``python examples/04_model_zoo.py``
 """
 
 import numpy as np
 
-from kmeans_tpu import (BisectingKMeans, KMeans, MiniBatchKMeans,
-                        SphericalKMeans)
+from kmeans_tpu import (BisectingKMeans, GaussianMixture, KMeans,
+                        MiniBatchKMeans, SphericalKMeans)
 from kmeans_tpu.data.synthetic import make_blobs
 from kmeans_tpu.metrics import silhouette_score
 
@@ -24,3 +24,12 @@ for cls, kwargs in [
     sil = silhouette_score(X, model.predict(X), sample_size=5_000, seed=0)
     print(f"{cls.__name__:18s} iters={model.iterations_run:3d} "
           f"silhouette={sil:.3f}")
+
+# Soft clustering: diagonal-covariance EM on the same SPMD machinery —
+# here with every EM iteration in ONE device dispatch (host_loop=False)
+# and 2 seeded restarts.
+gm = GaussianMixture(n_components=6, seed=42, n_init=2,
+                     host_loop=False).fit(X)
+sil = silhouette_score(X, gm.predict(X), sample_size=5_000, seed=0)
+print(f"{'GaussianMixture':18s} iters={gm.n_iter_:3d} "
+      f"silhouette={sil:.3f} loglik={gm.lower_bound_:.3f}")

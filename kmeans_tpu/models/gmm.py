@@ -4,12 +4,24 @@ A beyond-reference model family (the reference framework is K-Means
 only, SURVEY.md §1): sklearn-style ``GaussianMixture`` whose E-step runs
 as the same chunked, data-sharded, psum-reduced SPMD pass as the K-Means
 assignment step (``parallel.gmm_step``), with the two (chunk, k)
-log-density matmuls on the MXU.  Host-side M-step in float64 (mirroring
-``KMeans``'s host centroid division), sklearn-compatible surface:
-``fit`` / ``predict`` / ``predict_proba`` / ``score`` /
-``score_samples`` / ``sample`` / ``aic`` / ``bic``, attributes
-``weights_`` / ``means_`` / ``covariances_`` / ``precisions_`` /
-``converged_`` / ``n_iter_`` / ``lower_bound_``.
+log-density matmuls on the MXU.  Composes with the framework's engines
+like KMeans does (r2 VERDICT next-round #3):
+
+* ``model_shards > 1`` row-shards the (k, D) parameter tables over the
+  mesh's model axis (component/TP sharding);
+* ``host_loop=False`` runs ALL EM iterations in one dispatch under a
+  device-side ``lax.while_loop`` (``gmm_step.make_gmm_fit_fn``);
+* ``n_init`` runs seeded restarts (host-sequential; the winner is the
+  restart with the highest final ``lower_bound_``).
+
+Numerics: every E pass works in a CENTERED frame — the data's global
+mean is subtracted chunk-by-chunk in registers and added back to the
+means after the M-step.  Responsibilities and log-likelihood are exactly
+shift-invariant, but centering keeps the accumulated second moments at
+the data's SPREAD scale, so ``S2/R - mu^2`` no longer cancels below f32
+precision for data with ``|mean|/std >~ 1e3`` (r2 ADVICE, medium — the
+uncentered form silently collapsed covariances to the ``reg_covar``
+clamp; sklearn avoids it by accumulating in float64).
 
 Only ``covariance_type='diag'`` is implemented — it is the one diagonal
 fit to the TPU formulation (full covariances need per-component k x D x D
@@ -26,11 +38,12 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kmeans_tpu.parallel.gmm_step import (EStats, make_gmm_predict_fn,
+from kmeans_tpu.parallel.gmm_step import (EStats, make_gmm_fit_fn,
+                                          make_gmm_predict_fn,
                                           make_gmm_step_fn)
-from kmeans_tpu.parallel.mesh import make_mesh, mesh_shape
+from kmeans_tpu.parallel.mesh import MODEL_AXIS, make_mesh, mesh_shape
 from kmeans_tpu.parallel.sharding import (ShardedDataset, choose_chunk_size,
                                           to_device)
 from kmeans_tpu.utils.validation import check_finite_array
@@ -41,6 +54,15 @@ _STEP_CACHE: dict = {}
 # responsibilities are exactly one-hot (sklearn inits from one-hot
 # KMeans-label responsibilities too).
 _HARD_INV_VAR = 1e6
+
+# Weighted-mean pass for the centering shift (GSPMD: XLA inserts the
+# cross-shard collectives for the sharded matvec itself).  The zero-
+# weight guard is TINY, not 1.0 — clamping at 1.0 would scale the shift
+# down whenever total weight < 1 and re-open the cancellation regime the
+# shift exists to close.
+_mean_jit = jax.jit(lambda p, w: (w @ p.astype(jnp.float32))
+                    / jnp.maximum(jnp.sum(w.astype(jnp.float32)),
+                                  jnp.finfo(jnp.float32).tiny))
 
 
 def _get_fns(mesh: Mesh, chunk: int):
@@ -56,21 +78,33 @@ class GaussianMixture:
 
     Parameters follow ``sklearn.mixture.GaussianMixture`` where they
     overlap (``n_components``, ``tol``, ``reg_covar``, ``max_iter``,
-    ``init_params``: 'kmeans' | 'k-means++' | 'random', explicit
-    ``weights_init`` / ``means_init`` / ``precisions_init``); ``seed``,
-    ``mesh``, ``chunk_size``, ``dtype``, ``verbose`` follow this
-    framework's ``KMeans``.  ``lower_bound_`` is the mean per-sample
-    log-likelihood, and convergence is its absolute change < ``tol``
-    (sklearn semantics).
+    ``n_init``, ``init_params``: 'kmeans' | 'k-means++' | 'random',
+    explicit ``weights_init`` / ``means_init`` / ``precisions_init``);
+    ``seed``, ``mesh``, ``model_shards``, ``chunk_size``, ``dtype``,
+    ``host_loop``, ``verbose`` follow this framework's ``KMeans``.
+    ``lower_bound_`` is the mean per-sample log-likelihood, and
+    convergence is its absolute change < ``tol`` (sklearn semantics).
+
+    ``host_loop=False`` trades per-iteration host logging for a single
+    dispatch (the M-step then divides in the accumulation dtype on
+    device instead of the host's float64 — same documented divergence as
+    ``KMeans(host_loop=False)``).
     """
+
+    _PARAM_NAMES = ("n_components", "covariance_type", "tol", "reg_covar",
+                    "max_iter", "n_init", "init_params", "weights_init",
+                    "means_init", "precisions_init", "seed", "dtype",
+                    "mesh", "model_shards", "chunk_size", "host_loop",
+                    "verbose")
 
     def __init__(self, n_components: int = 1, *,
                  covariance_type: str = "diag", tol: float = 1e-3,
                  reg_covar: float = 1e-6, max_iter: int = 100,
-                 init_params: str = "kmeans", weights_init=None,
-                 means_init=None, precisions_init=None, seed: int = 42,
-                 dtype=None, mesh: Optional[Mesh] = None,
-                 chunk_size: Optional[int] = None, verbose: bool = False):
+                 n_init: int = 1, init_params: str = "kmeans",
+                 weights_init=None, means_init=None, precisions_init=None,
+                 seed: int = 42, dtype=None, mesh: Optional[Mesh] = None,
+                 model_shards: int = 1, chunk_size: Optional[int] = None,
+                 host_loop: bool = True, verbose: bool = False):
         if covariance_type != "diag":
             raise ValueError(
                 "only covariance_type='diag' is implemented (see module "
@@ -80,6 +114,8 @@ class GaussianMixture:
                              f"got {n_components}")
         if max_iter < 1:
             raise ValueError(f"max_iter must be >= 1, got {max_iter}")
+        if int(n_init) < 1:
+            raise ValueError(f"n_init must be >= 1, got {n_init}")
         if tol < 0 or reg_covar < 0:
             raise ValueError("tol and reg_covar must be >= 0")
         if init_params not in ("kmeans", "k-means++", "kmeans++", "random"):
@@ -89,6 +125,7 @@ class GaussianMixture:
         self.tol = tol
         self.reg_covar = reg_covar
         self.max_iter = max_iter
+        self.n_init = int(n_init)
         self.init_params = init_params
         self.weights_init = weights_init
         self.means_init = means_init
@@ -97,7 +134,9 @@ class GaussianMixture:
         self.dtype = np.dtype(jax.dtypes.canonicalize_dtype(
             np.dtype(dtype) if dtype is not None else np.float32))
         self.mesh = mesh
+        self.model_shards = model_shards
         self.chunk_size = chunk_size
+        self.host_loop = host_loop
         self.verbose = verbose
 
         self.weights_: Optional[np.ndarray] = None
@@ -111,7 +150,7 @@ class GaussianMixture:
 
     def _resolve_mesh(self) -> Mesh:
         if self.mesh is None:
-            self.mesh = make_mesh(model=1)
+            self.mesh = make_mesh(model=self.model_shards)
         return self.mesh
 
     def _dataset(self, X, sample_weight=None) -> ShardedDataset:
@@ -128,17 +167,73 @@ class GaussianMixture:
         return to_device(X, mesh, chunk, self.dtype,
                          sample_weight=sample_weight)
 
-    def _params_dev(self):
-        a = 1.0 / np.maximum(self.covariances_, self.reg_covar)
-        return (jnp.asarray(self.means_.astype(self.dtype)),
-                jnp.asarray(a.astype(self.dtype)),
-                jnp.asarray(np.log(self.covariances_).sum(1)
-                            .astype(self.dtype)),
-                jnp.asarray(np.log(self.weights_).astype(self.dtype)))
+    @property
+    def _k_pad(self) -> int:
+        _, m = mesh_shape(self._resolve_mesh())
+        return -(-self.n_components // m) * m
+
+    def _shift(self) -> np.ndarray:
+        """The centering shift (data's global mean), zeros pre-fit."""
+        s = getattr(self, "shift_", None)
+        if s is None:
+            return np.zeros(self.means_.shape[1], np.float64)
+        return s
+
+    def _pad_tables(self, means_c, var, log_w):
+        """Pad the parameter tables to the model-axis multiple: padding
+        components carry ``log_w = -inf`` so they never receive
+        responsibility."""
+        k, k_pad = self.n_components, self._k_pad
+        d = means_c.shape[1]
+        mc = np.zeros((k_pad, d), self.dtype)
+        mc[:k] = means_c
+        vv = np.ones((k_pad, d), self.dtype)
+        vv[:k] = var
+        lw = np.full((k_pad,), -np.inf, self.dtype)
+        lw[:k] = log_w
+        return mc, vv, lw
+
+    def _put_tables(self, mesh, means_c, var, log_w):
+        """Pad + place the parameter tables row-sharded on the model axis."""
+        mc, vv, lw = self._pad_tables(means_c, var, log_w)
+        row = NamedSharding(mesh, P(MODEL_AXIS, None))
+        vec = NamedSharding(mesh, P(MODEL_AXIS))
+        return (jax.device_put(mc, row), jax.device_put(vv, row),
+                jax.device_put(lw, vec))
+
+    def _params_dev(self, mesh):
+        """Device-placed (shift, means_c, inv_var, log_det, log_w): the
+        precision AND the log-determinant both come from the SAME clamped
+        covariance (r2 ADVICE: computing log_det from the unclamped table
+        made the density inconsistent when covariances_ < reg_covar)."""
+        cv = np.maximum(self.covariances_, max(self.reg_covar, 1e-300))
+        shift = self._shift()
+        means_c, var, log_w = self._put_tables(
+            mesh, (self.means_ - shift).astype(self.dtype),
+            cv.astype(self.dtype),
+            np.log(np.maximum(self.weights_, 1e-300)).astype(self.dtype))
+        inv_var = 1.0 / var
+        log_det = jnp.sum(jnp.log(var), axis=1)
+        return (jnp.asarray(shift.astype(self.dtype)), means_c, inv_var,
+                log_det, log_w)
+
+    def _trim(self, st: EStats) -> EStats:
+        k = self.n_components
+        return EStats(np.asarray(st.resp_sum)[:k], np.asarray(st.xsum)[:k],
+                      np.asarray(st.x2sum)[:k], st.loglik)
 
     # ----------------------------------------------------------------- init
 
-    def _init_params(self, ds: ShardedDataset, step_fn):
+    def _restart_seeds(self) -> list:
+        """Restart 0 uses ``seed`` exactly; an explicit means_init makes
+        every restart identical, so it collapses to one (sklearn too)."""
+        if self.means_init is not None:
+            return [self.seed]
+        extra = np.random.SeedSequence(self.seed).generate_state(
+            self.n_init - 1) if self.n_init > 1 else []
+        return [self.seed] + [int(s) for s in extra]
+
+    def _init_params(self, ds: ShardedDataset, step_fn, seed: int):
         d = ds.d
         k = self.n_components
         if self.means_init is not None:
@@ -151,7 +246,7 @@ class GaussianMixture:
                 # sklearn 'random' draws random responsibilities; seeding
                 # means at random points is the established analogue.
                 from kmeans_tpu.models.init import forgy_init
-                means = np.asarray(forgy_init(ds, k, self.seed,
+                means = np.asarray(forgy_init(ds, k, seed,
                                               validate=False), np.float64)
             else:
                 # Both 'kmeans' and 'k-means++' seed the internal KMeans
@@ -160,7 +255,7 @@ class GaussianMixture:
                 # here skips the Lloyd refinement (seeding only).
                 from kmeans_tpu.models.kmeans import KMeans
                 refine = 20 if self.init_params == "kmeans" else 1
-                km = KMeans(k=k, seed=self.seed, init="kmeans++",
+                km = KMeans(k=k, seed=seed, init="kmeans++",
                             max_iter=refine, verbose=False,
                             compute_labels=False, mesh=self.mesh,
                             empty_cluster="resample")
@@ -172,13 +267,18 @@ class GaussianMixture:
         # softmax one-hot) yields the per-component one-hot statistics
         # sklearn also inits from; M-step below turns them into
         # weights/covariances.  Explicit precisions/weights_init override.
+        mesh = self._resolve_mesh()
+        shift = self._shift()
+        means_c, hard_var, log_w = self._put_tables(
+            mesh, (means - shift).astype(self.dtype),
+            np.full((k, d), 1.0 / _HARD_INV_VAR, self.dtype),
+            np.zeros((k,), self.dtype))
         hard = step_fn(ds.points, ds.weights,
-                       jnp.asarray(means.astype(self.dtype)),
-                       jnp.full((k, d), self.dtype.type(_HARD_INV_VAR)),
-                       jnp.zeros((k,), self.dtype),
-                       jnp.zeros((k,), self.dtype))
-        w_total, (pi, mu, var) = self._m_step(hard)
-        self.means_ = mu if self.means_init is None else means
+                       jnp.asarray(shift.astype(self.dtype)), means_c,
+                       1.0 / hard_var, jnp.zeros((self._k_pad,),
+                                                 self.dtype), log_w)
+        w_total, (pi, mu_c, var) = self._m_step(self._trim(hard))
+        self.means_ = (mu_c + shift) if self.means_init is None else means
         self.weights_ = (pi if self.weights_init is None
                          else np.asarray(self.weights_init, np.float64))
         if self.precisions_init is not None:
@@ -192,7 +292,9 @@ class GaussianMixture:
     # ------------------------------------------------------------------- EM
 
     def _m_step(self, st: EStats):
-        """float64 host M-step from the psum-reduced E statistics."""
+        """float64 host M-step from the psum-reduced E statistics.  The
+        inputs are CENTERED-frame statistics; the returned means are too
+        (callers add the shift back)."""
         R = np.asarray(st.resp_sum, np.float64)
         S1 = np.asarray(st.xsum, np.float64)
         S2 = np.asarray(st.x2sum, np.float64)
@@ -209,17 +311,54 @@ class GaussianMixture:
         mesh = self._resolve_mesh()
         step_fn, _ = _get_fns(mesh, ds.chunk)
         self._fit_chunk = ds.chunk
-        w_total = self._init_params(ds, step_fn)
+        # Centering shift: the dataset's weighted global mean (see module
+        # docstring).  One cheap GSPMD pass, fixed for the whole fit.
+        self.shift_ = np.asarray(
+            _mean_jit(ds.points, ds.weights), np.float64)
+        seeds = self._restart_seeds()
+        self.best_restart_ = 0
+        self.restart_lower_bounds_ = None
+
+        best = None
+        lls = []
+        for r, seed in enumerate(seeds):
+            self._fit_one(ds, mesh, step_fn, seed)
+            if len(seeds) == 1:
+                return self
+            lls.append(self.lower_bound_)
+            if best is None or self.lower_bound_ > best["ll"]:
+                best = {"ll": self.lower_bound_, "restart": r,
+                        "weights_": self.weights_, "means_": self.means_,
+                        "covariances_": self.covariances_,
+                        "converged_": self.converged_,
+                        "n_iter_": self.n_iter_}
+        self.weights_ = best["weights_"]
+        self.means_ = best["means_"]
+        self.covariances_ = best["covariances_"]
+        self.converged_ = best["converged_"]
+        self.n_iter_ = best["n_iter_"]
+        self.lower_bound_ = best["ll"]
+        self.best_restart_ = best["restart"]
+        self.restart_lower_bounds_ = np.asarray(lls, np.float64)
+        return self
+
+    def _fit_one(self, ds, mesh, step_fn, seed: int) -> None:
+        w_total = self._init_params(ds, step_fn, seed)
         if w_total <= 0:
             raise ValueError("total sample weight must be positive")
+        if not self.host_loop:
+            return self._fit_on_device(ds, mesh)
 
         self.converged_ = False
         prev = -np.inf
+        shift = self._shift()
         for it in range(1, self.max_iter + 1):
             t0 = time.perf_counter()
-            st: EStats = step_fn(ds.points, ds.weights, *self._params_dev())
-            _, (pi, mu, var) = self._m_step(st)
-            self.weights_, self.means_, self.covariances_ = pi, mu, var
+            st: EStats = step_fn(ds.points, ds.weights,
+                                 *self._params_dev(mesh))
+            _, (pi, mu_c, var) = self._m_step(self._trim(st))
+            self.weights_, self.means_ = pi, mu_c + shift
+            self.covariances_ = var
             self.lower_bound_ = float(st.loglik) / w_total
             self.n_iter_ = it
             if self.verbose:
@@ -234,7 +373,45 @@ class GaussianMixture:
                 self.converged_ = True
                 break
             prev = self.lower_bound_
-        return self
+
+    def _fit_on_device(self, ds, mesh) -> None:
+        """All EM iterations in ONE dispatch (``host_loop=False``) — the
+        mixture analogue of ``KMeans._fit_on_device``."""
+        key = (mesh, ds.chunk, self.n_components, self.max_iter,
+               float(self.tol), float(self.reg_covar), "gmmfit")
+        if key not in _STEP_CACHE:
+            _STEP_CACHE[key] = make_gmm_fit_fn(
+                mesh, chunk_size=ds.chunk, k_real=self.n_components,
+                max_iter=self.max_iter, tol=float(self.tol),
+                reg_covar=float(self.reg_covar))
+        fit_fn = _STEP_CACHE[key]
+        k = self.n_components
+        shift = self._shift()
+        cv = np.maximum(self.covariances_, max(self.reg_covar, 1e-300))
+        # The device loop carries FULL replicated tables (each shard
+        # slices its block per iteration, like KMeans' make_fit_fn).
+        mc, var0, log_w0 = self._pad_tables(
+            (self.means_ - shift).astype(self.dtype),
+            cv.astype(self.dtype),
+            np.log(np.maximum(self.weights_, 1e-300)).astype(self.dtype))
+        means_out, var_out, log_w_out, it, hist, conv = fit_fn(
+            ds.points, ds.weights, jnp.asarray(shift.astype(self.dtype)),
+            jnp.asarray(mc), jnp.asarray(var0), jnp.asarray(log_w0))
+        n = int(it)
+        hist = np.asarray(hist, np.float64)[:n]
+        if n and not np.all(np.isfinite(hist)):
+            raise ValueError(
+                f"non-finite log-likelihood at EM iteration {n}")
+        self.means_ = np.asarray(means_out, np.float64)[:k] + shift
+        self.covariances_ = np.asarray(var_out, np.float64)[:k]
+        w = np.exp(np.asarray(log_w_out, np.float64)[:k])
+        self.weights_ = w / w.sum()
+        self.converged_ = bool(conv)
+        self.n_iter_ = n
+        self.lower_bound_ = float(hist[-1]) if n else -np.inf
+        if self.verbose:
+            print(f"EM device loop: {n} iterations, mean log-likelihood = "
+                  f"{self.lower_bound_:.6f}", flush=True)
 
     # ------------------------------------------------------------ inference
 
@@ -247,9 +424,10 @@ class GaussianMixture:
         ds = self._dataset(X)
         mesh = self._resolve_mesh()
         _, predict_fn = _get_fns(mesh, ds.chunk)
-        labels, logr, lse = predict_fn(ds.points, *self._params_dev())
+        labels, logr, lse = predict_fn(ds.points, *self._params_dev(mesh))
+        k = self.n_components
         return (np.asarray(labels)[: ds.n],
-                np.asarray(logr)[: ds.n].astype(np.float64),
+                np.asarray(logr)[: ds.n, :k].astype(np.float64),
                 np.asarray(lse)[: ds.n].astype(np.float64))
 
     def predict(self, X) -> np.ndarray:
@@ -300,21 +478,27 @@ class GaussianMixture:
         return -2.0 * self.score(X) * n + 2.0 * self._n_parameters()
 
     def get_params(self, deep: bool = True) -> dict:
-        return {"n_components": self.n_components,
-                "covariance_type": self.covariance_type, "tol": self.tol,
-                "reg_covar": self.reg_covar, "max_iter": self.max_iter,
-                "init_params": self.init_params,
-                "weights_init": self.weights_init,
-                "means_init": self.means_init,
-                "precisions_init": self.precisions_init,
-                "seed": self.seed, "dtype": self.dtype, "mesh": self.mesh,
-                "chunk_size": self.chunk_size, "verbose": self.verbose}
+        return {name: getattr(self, name) for name in self._PARAM_NAMES}
 
     def set_params(self, **params) -> "GaussianMixture":
-        valid = self.get_params()
-        for name, value in params.items():
-            if name not in valid:
+        """Route new values through ``__init__`` so they get exactly the
+        constructor's validation and canonicalization (r2 ADVICE: raw
+        attribute assignment accepted dtype strings, n_components=0,
+        covariance_type='full' silently), then restore fitted state."""
+        for name in params:
+            if name not in self._PARAM_NAMES:
                 raise ValueError(f"invalid parameter {name!r} for "
                                  f"GaussianMixture")
-            setattr(self, name, value)
+        merged = self.get_params()
+        merged.update(params)
+        saved = dict(self.__dict__)
+        try:
+            self.__init__(**merged)
+        except Exception:
+            self.__dict__.clear()
+            self.__dict__.update(saved)
+            raise
+        for name, value in saved.items():
+            if name not in self._PARAM_NAMES:
+                self.__dict__[name] = value
         return self

@@ -1,11 +1,12 @@
-"""The SPMD EM step for diagonal-covariance Gaussian mixtures.
+"""The SPMD EM machinery for diagonal-covariance Gaussian mixtures.
 
 Same execution model as the K-Means step (``distributed.make_step_fn``):
-points sharded on the ``data`` mesh axis, parameters replicated, one
-jitted ``shard_map`` whose only collective is a ``psum`` of dense
-per-component accumulators.  The reference framework has no mixture
-model at all — this is a beyond-reference family built on the same
-TPU-first machinery (SURVEY.md §2.3 backend mapping).
+points sharded on the ``data`` mesh axis, one jitted ``shard_map`` whose
+collectives are a ``psum`` of dense per-component accumulators (plus,
+under component sharding, a per-chunk ``pmax``/``psum`` pair for the
+softmax normalizer).  The reference framework has no mixture model at
+all — this is a beyond-reference family built on the same TPU-first
+machinery (SURVEY.md §2.3 backend mapping).
 
 TPU formulation of the E-step: for diagonal Gaussians,
 
@@ -24,9 +25,31 @@ from a max-subtracted softmax over k; the per-chunk accumulators
     S2_k   = sum_i w_i r_ik x_i^2                 (k, D)  [resp.T @ x^2]
     ll     = sum_i w_i logsumexp_k(...)           ()
 
-are all dense and psum-able; the M-step (host or caller side) is then
+are all dense and psum-able; the M-step (host or device side) is then
 pi = R/W, mu = S1/R, sigma^2 = S2/R - mu^2 + reg.  Zero-weight padding
 rows contribute nothing to any statistic.
+
+Centering (``shift``): every pass subtracts a caller-supplied (D,)
+shift — the data's global mean — from each chunk and works against
+SHIFTED means.  Responsibilities and the log-likelihood are exactly
+shift-invariant, but the accumulated E-statistics are not numerically:
+the uncentered ``S2/R - mu^2`` cancels below f32 precision when
+``|mean|/std >~ 1e3`` and covariances silently collapse to the
+``reg_covar`` clamp (r2 ADVICE, medium).  Accumulating in the centered
+frame keeps ``S2`` at the data's SPREAD scale, so the variance emerges
+without cancellation; the caller adds ``shift`` back to the means.  The
+subtract fuses into the chunk pipeline — no centered copy of the data is
+ever materialized.
+
+Component (model-axis) sharding: the (k, D) parameter tables row-shard
+over the ``model`` axis exactly like the K-Means centroid table.  Each
+shard scores points against its component block; the softmax normalizer
+needs the GLOBAL max and sum over k, reconstructed with one ``pmax`` and
+one ``psum`` of (chunk,) vectors per chunk — O(chunk) traffic against
+the O(chunk*k_local) matmul tile, negligible on ICI.  Per-shard
+statistics cover the local block and are embedded + psum'd like the
+K-Means step.  Component padding rows (k not divisible by the axis)
+carry ``log_weights = -inf`` so they never receive responsibility.
 """
 
 from __future__ import annotations
@@ -36,6 +59,7 @@ from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -67,14 +91,23 @@ def _log_prob_chunk(x, means, inv_var, log_det, log_weights):
             - 0.5 * (quad + log_det[None, :] + d * _LOG2PI))
 
 
-def estep_chunk(x, w, means, inv_var, log_det, log_weights):
-    """One chunk's contribution to EStats (shared by step fn and tests)."""
+def _estep_tile(x, w, means, inv_var, log_det, log_weights,
+                model_shards: int):
+    """One chunk's LOCAL-block contribution to EStats.  With the component
+    table sharded, the softmax normalizer (row max + denominator) is
+    reconstructed globally via pmax/psum over the model axis; the
+    statistics stay local to this shard's block.  ``loglik`` is identical
+    on every model shard (the caller divides the cross-axis psum out)."""
     logp = _log_prob_chunk(x, means, inv_var, log_det, log_weights)
-    m = jnp.max(logp, axis=1, keepdims=True)
-    p = jnp.exp(logp - m)
-    denom = jnp.sum(p, axis=1, keepdims=True)
-    lse = (m[:, 0] + jnp.log(denom[:, 0]))
-    resp = p / denom * w[:, None]                  # weighted, padded -> 0
+    m = jnp.max(logp, axis=1)
+    if model_shards > 1:
+        m = lax.pmax(m, MODEL_AXIS)
+    p = jnp.exp(logp - m[:, None])
+    denom = jnp.sum(p, axis=1)
+    if model_shards > 1:
+        denom = lax.psum(denom, MODEL_AXIS)
+    lse = m + jnp.log(denom)
+    resp = p / denom[:, None] * w[:, None]         # weighted, padded -> 0
     return EStats(
         resp_sum=jnp.sum(resp, axis=0),
         xsum=lax.dot_general(resp, x, (((0,), (0,)), ((), ())),
@@ -85,42 +118,79 @@ def estep_chunk(x, w, means, inv_var, log_det, log_weights):
     )
 
 
+def estep_chunk(x, w, means, inv_var, log_det, log_weights):
+    """Unsharded one-chunk E-statistics (oracle tests use this)."""
+    return _estep_tile(x, w, means, inv_var, log_det, log_weights, 1)
+
+
+def _scan_estats(points, weights, means_blk, inv_var_blk, log_det_blk,
+                 log_w_blk, shift, *, chunk_size: int, model_shards: int):
+    """Shard-local chunked E pass -> local-block EStats (pre-psum).
+    ``shift`` centers each chunk in registers; ``means_blk`` must already
+    be in the centered frame."""
+    k_local, d = means_blk.shape
+    acc = points.dtype
+    n_chunks = points.shape[0] // chunk_size
+    xs = (points.reshape(n_chunks, chunk_size, d),
+          weights.astype(acc).reshape(n_chunks, chunk_size))
+
+    def body(carry, chunk):
+        xc, wc = chunk
+        st = _estep_tile(xc - shift[None, :], wc, means_blk, inv_var_blk,
+                         log_det_blk, log_w_blk, model_shards)
+        return EStats(carry.resp_sum + st.resp_sum,
+                      carry.xsum + st.xsum,
+                      carry.x2sum + st.x2sum,
+                      carry.loglik + st.loglik), None
+
+    init = EStats(jnp.zeros((k_local,), acc), jnp.zeros((k_local, d), acc),
+                  jnp.zeros((k_local, d), acc), jnp.zeros((), acc))
+    st, _ = lax.scan(body, init, xs)
+    return st
+
+
+def _embed_psum(st: EStats, k_pad: int, k_local: int, model_shards: int):
+    """Embed a shard's local-block stats into the full table and psum over
+    both axes -> replicated global EStats (the K-Means embedding pattern,
+    distributed.make_step_fn)."""
+    d = st.xsum.shape[1]
+    acc = st.xsum.dtype
+    m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
+    off = jnp.asarray(m_idx * k_local, jnp.int32)
+    axes = (DATA_AXIS, MODEL_AXIS)
+    resp = lax.psum(lax.dynamic_update_slice(
+        jnp.zeros((k_pad,), acc), st.resp_sum, (off,)), axes)
+    xsum = lax.psum(lax.dynamic_update_slice(
+        jnp.zeros((k_pad, d), acc), st.xsum, (off, jnp.int32(0))), axes)
+    x2sum = lax.psum(lax.dynamic_update_slice(
+        jnp.zeros((k_pad, d), acc), st.x2sum, (off, jnp.int32(0))), axes)
+    # loglik is replicated across the model axis -> divide the psum out.
+    ll = lax.psum(st.loglik, axes) / model_shards
+    return EStats(resp, xsum, x2sum, ll)
+
+
 def make_gmm_step_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
     """Build the jitted SPMD E-step:
-    (points, weights, means, inv_var, log_det, log_weights) -> EStats,
-    fully replicated.  Parameters are replicated (no model-axis sharding
-    for the mixture family — k*2D parameter tables are small next to the
-    data); the data axis carries N exactly like the K-Means step."""
+    (points, weights, shift, means, inv_var, log_det, log_weights) ->
+    EStats over the FULL (k_pad) component table, replicated.  Parameter
+    tables arrive row-sharded on the ``model`` axis (replicated when that
+    axis is 1); ``means`` must be pre-centered by ``shift`` and the
+    returned ``xsum``/``x2sum`` are in the centered frame."""
     data_shards, model_shards = mesh_shape(mesh)
-    if model_shards > 1:
-        raise ValueError(
-            "GaussianMixture does not shard its parameter tables; build "
-            "the mesh with model_shards=1 (the data axis still scales N)")
 
-    def step(points, weights, means, inv_var, log_det, log_weights):
-        k, d = means.shape
-        acc = points.dtype
-        n_chunks = points.shape[0] // chunk_size
-        xs = (points.reshape(n_chunks, chunk_size, d),
-              weights.astype(acc).reshape(n_chunks, chunk_size))
-
-        def body(carry, chunk):
-            xc, wc = chunk
-            st = estep_chunk(xc, wc, means, inv_var, log_det, log_weights)
-            return EStats(carry.resp_sum + st.resp_sum,
-                          carry.xsum + st.xsum,
-                          carry.x2sum + st.x2sum,
-                          carry.loglik + st.loglik), None
-
-        init = EStats(jnp.zeros((k,), acc), jnp.zeros((k, d), acc),
-                      jnp.zeros((k, d), acc), jnp.zeros((), acc))
-        st, _ = lax.scan(body, init, xs)
-        return EStats(*(lax.psum(s, DATA_AXIS) for s in st))
+    def step(points, weights, shift, means, inv_var, log_det, log_weights):
+        k_local = means.shape[0]
+        st = _scan_estats(points, weights, means, inv_var, log_det,
+                          log_weights, shift, chunk_size=chunk_size,
+                          model_shards=model_shards)
+        return _embed_psum(st, k_local * model_shards, k_local,
+                           model_shards)
 
     mapped = jax.shard_map(
         step, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None, None),
-                  P(None, None), P(None), P(None)),
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None),
+                  P(MODEL_AXIS, None), P(MODEL_AXIS, None), P(MODEL_AXIS),
+                  P(MODEL_AXIS)),
         out_specs=EStats(P(None), P(None, None), P(None, None), P()),
         check_vma=False)
     return jax.jit(mapped)
@@ -128,30 +198,135 @@ def make_gmm_step_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
 
 def make_gmm_predict_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
     """Jitted sharded posterior pass:
-    (points, means, inv_var, log_det, log_weights) ->
-    (labels, log_resp (n, k), log_prob (n,)) — the marginal
-    ``log p(x) = logsumexp_k`` rides along for score/score_samples."""
+    (points, shift, means, inv_var, log_det, log_weights) ->
+    (labels, log_resp (n, k_pad), log_prob (n,)).  Labels are GLOBAL
+    component indices (under component sharding each shard's local argmax
+    is promoted by the gathered per-block maxima, lowest block wins
+    ties); ``log_resp`` comes back sharded (data, model) so no device
+    ever holds more than its (n_local, k_local) tile."""
     data_shards, model_shards = mesh_shape(mesh)
 
-    def predict(points, means, inv_var, log_det, log_weights):
-        k, d = means.shape
+    def predict(points, shift, means, inv_var, log_det, log_weights):
+        k_local, d = means.shape
         n_chunks = points.shape[0] // chunk_size
         xs = points.reshape(n_chunks, chunk_size, d)
+        m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
 
         def body(_, xc):
-            logp = _log_prob_chunk(xc, means, inv_var, log_det,
-                                   log_weights)
-            lse = jax.scipy.special.logsumexp(logp, axis=1, keepdims=True)
-            return None, (jnp.argmax(logp, axis=1).astype(jnp.int32),
-                          logp - lse, lse[:, 0])
+            logp = _log_prob_chunk(xc - shift[None, :], means, inv_var,
+                                   log_det, log_weights)
+            best_l = jnp.argmax(logp, axis=1).astype(jnp.int32)
+            max_l = jnp.max(logp, axis=1)
+            if model_shards > 1:
+                maxes = lax.all_gather(max_l, MODEL_AXIS)      # (m, c)
+                owner = jnp.argmax(maxes, axis=0)
+                m_glob = jnp.max(maxes, axis=0)
+                labels = lax.psum(
+                    jnp.where(owner == m_idx, m_idx * k_local + best_l, 0),
+                    MODEL_AXIS).astype(jnp.int32)
+            else:
+                m_glob, labels = max_l, best_l
+            denom = jnp.sum(jnp.exp(logp - m_glob[:, None]), axis=1)
+            if model_shards > 1:
+                denom = lax.psum(denom, MODEL_AXIS)
+            lse = m_glob + jnp.log(denom)
+            return None, (labels, logp - lse[:, None], lse)
 
         _, (labels, logr, lse) = lax.scan(body, None, xs)
-        return labels.reshape(-1), logr.reshape(-1, k), lse.reshape(-1)
+        return (labels.reshape(-1), logr.reshape(-1, k_local),
+                lse.reshape(-1))
 
     mapped = jax.shard_map(
         predict, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(None, None), P(None, None),
-                  P(None), P(None)),
-        out_specs=(P(DATA_AXIS), P(DATA_AXIS, None), P(DATA_AXIS)),
+        in_specs=(P(DATA_AXIS, None), P(None), P(MODEL_AXIS, None),
+                  P(MODEL_AXIS, None), P(MODEL_AXIS), P(MODEL_AXIS)),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS, MODEL_AXIS), P(DATA_AXIS)),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+def make_gmm_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
+                    max_iter: int, tol: float, reg_covar: float):
+    """Build the FULLY ON-DEVICE EM loop: all iterations in ONE dispatch
+    under ``lax.while_loop`` — the mixture analogue of
+    ``distributed.make_fit_fn`` (r2 VERDICT next-round #3).
+
+    Per iteration: slice this shard's component block from the carried
+    full tables, run the chunked E pass, psum-embed, M-step IN THE
+    ACCUMULATION DTYPE on device (the host loop M-steps in float64 — the
+    same documented division divergence as the K-Means device loop), and
+    test ``|mean loglik - prev| < tol`` (sklearn semantics, matching the
+    host loop).  Floors mirror the host M-step: ``R`` floored at
+    ``10 * tiny``, mixing weights at ``max(1e-300, tiny(acc))`` — for
+    float64 these equal the host constants exactly.
+
+    Returns ``fit(points, weights, shift, means0_c, var0, log_w0) ->
+    (means_c, var, log_w, n_iter, ll_hist[max_iter], converged)`` with
+    everything replicated; ``means0_c``/``means_c`` are in the centered
+    frame (caller adds ``shift`` back), tables are (k_pad, ...) with
+    padding components carried as ``log_w = -inf``.
+    """
+    data_shards, model_shards = mesh_shape(mesh)
+
+    def fit(points, weights, shift, means0, var0, log_w0):
+        k_pad, d = means0.shape
+        k_local = k_pad // model_shards
+        acc = points.dtype
+        tiny = jnp.asarray(np.finfo(np.dtype(str(acc))).tiny, acc)
+        pi_floor = jnp.maximum(jnp.asarray(1e-300, acc), tiny)
+        real = jnp.arange(k_pad) < k_real
+        m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
+        w_total = lax.psum(jnp.sum(weights.astype(acc)), DATA_AXIS)
+
+        def estats(means_c, var, log_w):
+            cv = jnp.maximum(var, reg_covar)
+            inv_var = 1.0 / cv
+            log_det = jnp.sum(jnp.log(cv), axis=1)
+            off = jnp.asarray(m_idx * k_local, jnp.int32)
+            blk = lambda a: lax.dynamic_slice(
+                a, (off,) + (jnp.int32(0),) * (a.ndim - 1),
+                (k_local,) + a.shape[1:])
+            st = _scan_estats(points, weights, blk(means_c).astype(acc),
+                              blk(inv_var).astype(acc),
+                              blk(log_det).astype(acc),
+                              blk(log_w).astype(acc), shift,
+                              chunk_size=chunk_size,
+                              model_shards=model_shards)
+            return _embed_psum(st, k_pad, k_local, model_shards)
+
+        def body(state):
+            it, means_c, var, log_w, prev, hist, _ = state
+            st = estats(means_c, var, log_w)
+            Rc = jnp.maximum(st.resp_sum, 10 * tiny)
+            mu = st.xsum / Rc[:, None]
+            new_var = jnp.maximum(
+                st.x2sum / Rc[:, None] - mu ** 2 + reg_covar, reg_covar)
+            pi = jnp.maximum(st.resp_sum / jnp.maximum(w_total, pi_floor),
+                             pi_floor)
+            pi = pi / jnp.sum(jnp.where(real, pi, 0.0))
+            new_log_w = jnp.where(real, jnp.log(pi), -jnp.inf)
+            ll = st.loglik / w_total
+            hist = hist.at[it].set(ll)
+            conv = jnp.abs(ll - prev) < tol
+            return (it + 1, jnp.where(real[:, None], mu, means_c),
+                    jnp.where(real[:, None], new_var, var), new_log_w,
+                    ll, hist, conv)
+
+        def cond(state):
+            it, *_, conv = state
+            return (it < max_iter) & ~conv
+
+        state = (jnp.int32(0), means0.astype(acc), var0.astype(acc),
+                 log_w0.astype(acc), jnp.asarray(-jnp.inf, acc),
+                 jnp.zeros((max_iter,), acc), jnp.asarray(False))
+        it, means_c, var, log_w, _, hist, conv = lax.while_loop(
+            cond, body, state)
+        return means_c, var, log_w, it, hist, conv
+
+    mapped = jax.shard_map(
+        fit, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None),
+                  P(None, None), P(None, None), P(None)),
+        out_specs=(P(None, None), P(None, None), P(None), P(), P(), P()),
         check_vma=False)
     return jax.jit(mapped)

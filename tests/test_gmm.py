@@ -149,3 +149,161 @@ def test_cached_dataset_roundtrip():
     gm.fit(X)
     ds = gm._dataset(X)
     np.testing.assert_array_equal(gm.predict(ds), gm.predict(X))
+
+
+# ---------------------------------------------------------------- round 3:
+# composition with the framework's engines (r2 VERDICT next-round #3) and
+# the r2 ADVICE numerics fixes.
+
+
+def _fit_kw(**kw):
+    X, _ = _data(n=3_000, centers=4, d=6, seed=12)
+    means, weights, precisions = _shared_init(X, 4, seed=2)
+    gm = GaussianMixture(n_components=4, max_iter=12, tol=0.0,
+                         means_init=means, weights_init=weights,
+                         precisions_init=precisions, **kw).fit(X)
+    return X, gm
+
+
+@pytest.mark.parametrize("mesh_name", ["mesh8", "mesh4x2"])
+def test_sharded_fit_matches_single_device(mesh_name, request, mesh1):
+    """Data sharding AND component (model-axis) sharding are numerically
+    inert: the mesh4x2 fit row-shards the (k, D) parameter tables."""
+    mesh = request.getfixturevalue(mesh_name)
+    _, ref = _fit_kw(mesh=mesh1)
+    _, gm = _fit_kw(mesh=mesh, model_shards=mesh.shape["model"])
+    np.testing.assert_allclose(gm.means_, ref.means_, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(gm.covariances_, ref.covariances_,
+                               rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(gm.lower_bound_, ref.lower_bound_, rtol=1e-5)
+
+
+def test_component_sharded_posterior_matches(mesh4x2, mesh1):
+    """predict/predict_proba/score agree between replicated and
+    component-sharded parameter tables (incl. the k=4 on 2-shard padding
+    path via k=5)."""
+    X, _ = _data(n=2_000, centers=5, d=4, seed=13)
+    kw = dict(n_components=5, max_iter=8, seed=3)
+    a = GaussianMixture(**kw, mesh=mesh1).fit(X)
+    b = GaussianMixture(**kw, mesh=mesh4x2, model_shards=2)
+    # Same parameters, different execution layout.
+    b.fit(X)
+    b.weights_, b.means_, b.covariances_ = a.weights_, a.means_, \
+        a.covariances_
+    b.shift_ = a.shift_
+    np.testing.assert_allclose(b.predict_proba(X), a.predict_proba(X),
+                               atol=1e-5)
+    np.testing.assert_allclose(b.score_samples(X), a.score_samples(X),
+                               rtol=1e-5, atol=1e-5)
+    assert (b.predict(X) == a.predict(X)).mean() > 0.999
+
+
+@pytest.mark.parametrize("mesh_name", ["mesh1", "mesh8", "mesh4x2"])
+def test_device_loop_matches_host_loop(mesh_name, request):
+    """host_loop=False (one-dispatch EM under lax.while_loop) follows the
+    host loop's trajectory; float64 makes the division paths comparable."""
+    mesh = request.getfixturevalue(mesh_name)
+    X, _ = _data(n=3_000, centers=4, d=6, seed=12)
+    X = X.astype(np.float64)
+    means, weights, precisions = _shared_init(X, 4, seed=2)
+    kw = dict(n_components=4, max_iter=12, tol=1e-6, dtype=np.float64,
+              means_init=means, weights_init=weights,
+              precisions_init=precisions, mesh=mesh,
+              model_shards=mesh.shape["model"])
+    host = GaussianMixture(**kw, host_loop=True).fit(X)
+    dev = GaussianMixture(**kw, host_loop=False).fit(X)
+    assert dev.n_iter_ == host.n_iter_
+    assert dev.converged_ == host.converged_
+    np.testing.assert_allclose(dev.means_, host.means_, rtol=1e-9,
+                               atol=1e-9)
+    np.testing.assert_allclose(dev.covariances_, host.covariances_,
+                               rtol=1e-8)
+    np.testing.assert_allclose(dev.weights_, host.weights_, rtol=1e-9)
+    np.testing.assert_allclose(dev.lower_bound_, host.lower_bound_,
+                               rtol=1e-10)
+
+
+def test_n_init_picks_best_lower_bound():
+    X, _ = _data(n=2_000, centers=4, d=5, seed=14)
+    gm = GaussianMixture(n_components=4, max_iter=15, seed=9, n_init=3,
+                         init_params="random").fit(X)
+    assert gm.restart_lower_bounds_.shape == (3,)
+    assert gm.best_restart_ == int(np.argmax(gm.restart_lower_bounds_))
+    np.testing.assert_allclose(
+        gm.lower_bound_, gm.restart_lower_bounds_[gm.best_restart_])
+    # Single-restart fit of the winning seed is not WORSE than the sweep.
+    assert gm.lower_bound_ >= gm.restart_lower_bounds_.min() - 1e-12
+
+
+def test_device_loop_n_init(mesh8):
+    """n_init restarts compose with the device loop (host-sequential
+    restarts, each a one-dispatch fit)."""
+    X, _ = _data(n=2_000, centers=3, d=4, seed=15)
+    gm = GaussianMixture(n_components=3, max_iter=10, seed=4, n_init=2,
+                         init_params="random", host_loop=False,
+                         mesh=mesh8).fit(X)
+    assert gm.restart_lower_bounds_.shape == (2,)
+    assert np.isfinite(gm.lower_bound_)
+    assert gm.means_.shape == (3, 4)
+
+
+def test_offset_data_covariances_not_collapsed():
+    """r2 ADVICE (medium): with |mean|/std ~ 1e4, the uncentered f32
+    S2/R - mu^2 cancels and covariances collapse to reg_covar.  The
+    centered E pass must recover the true ~1.0 variances and match
+    sklearn's float64 result."""
+    sklearn_gmm = pytest.importorskip("sklearn.mixture").GaussianMixture
+    rng = np.random.default_rng(0)
+    k, d = 3, 4
+    centers = rng.normal(size=(k, d)) * 3 + 1e4    # offset >> spread
+    y = rng.integers(0, k, size=4_000)
+    X = (centers[y] + rng.normal(size=(4_000, d))).astype(np.float32)
+    means = centers.astype(np.float64)
+    weights = np.full(k, 1.0 / k)
+    precisions = np.ones((k, d))
+    ours = GaussianMixture(n_components=k, max_iter=10, tol=0.0,
+                           reg_covar=1e-6, means_init=means,
+                           weights_init=weights,
+                           precisions_init=precisions).fit(X)
+    ref = sklearn_gmm(n_components=k, covariance_type="diag", max_iter=10,
+                      tol=0.0, reg_covar=1e-6, means_init=means,
+                      weights_init=weights, precisions_init=precisions,
+                      n_init=1).fit(X.astype(np.float64))
+    # Without centering these come out ~reg_covar (1e-6); truth is ~1.
+    assert ours.covariances_.min() > 0.5
+    np.testing.assert_allclose(ours.covariances_, ref.covariances_,
+                               rtol=0.05)
+    np.testing.assert_allclose(ours.means_, ref.means_, rtol=1e-6)
+
+
+def test_log_det_consistent_with_clamped_precision():
+    """r2 ADVICE (low): log_det must come from the SAME clamped
+    covariance as the precision — densities then integrate to one even
+    when covariances_ < reg_covar (reachable via precisions_init)."""
+    X, _ = _data(n=1_000, centers=2, d=3, seed=16)
+    gm = GaussianMixture(n_components=2, max_iter=3, reg_covar=1e-2,
+                         seed=6).fit(X)
+    gm.covariances_ = np.full_like(gm.covariances_, 1e-8)  # << reg_covar
+    # Explicitly-clamped twin: same density must come out.
+    gm2 = GaussianMixture(n_components=2, max_iter=3, reg_covar=1e-2,
+                          seed=6).fit(X)
+    gm2.covariances_ = np.full_like(gm2.covariances_, 1e-2)
+    gm2.weights_, gm2.means_ = gm.weights_, gm.means_
+    gm2.shift_ = gm.shift_
+    np.testing.assert_allclose(gm.score_samples(X[:100]),
+                               gm2.score_samples(X[:100]), rtol=1e-6)
+
+
+def test_set_params_validates():
+    """r2 ADVICE (low): set_params routes through __init__ validation."""
+    gm = GaussianMixture(n_components=3)
+    gm.set_params(dtype="float64")
+    assert gm.dtype == np.dtype(np.float64)       # canonicalized, not str
+    with pytest.raises(ValueError, match="n_components"):
+        gm.set_params(n_components=0)
+    with pytest.raises(ValueError, match="covariance_type"):
+        gm.set_params(covariance_type="full")
+    with pytest.raises(ValueError, match="invalid parameter"):
+        gm.set_params(bogus=1)
+    # Failed set_params leaves the model untouched.
+    assert gm.n_components == 3 and gm.covariance_type == "diag"
