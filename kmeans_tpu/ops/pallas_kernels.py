@@ -71,8 +71,10 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# Added to h for padded centroid rows: no real point can beat it, finite
-# in f32 (and exactly representable in bf16 for the fold path).
+# Added to h for padded centroid rows: no real point can beat it —
+# finite, and far beyond any real h in both f32 and bf16 (it is NOT
+# exactly representable in bf16: the 7-bit mantissa rounds it to
+# ~1.014e30, which masks just as well; r2 ADVICE).
 _PAD_H = 1e30
 # Index sentinel for the manual argmin's index-min (> any real k).
 _IDX_BIG = np.int32(2 ** 30)
@@ -125,8 +127,12 @@ def pallas_preferred(n: int, d: int, k: int) -> bool:
     (lane padding makes the kernel do 16x the MXU work); k=10 D=784:
     ~20x slower (k padded 12.8x).  Hence the two gates: enough real k
     (>= 512), and <= 1.5x combined padding waste.  Also falls back when
-    the VMEM-resident centroid block would exceed the kernel budget, and
-    off TPU / under x64 (interpret mode is for CI, not speed).
+    the VMEM-resident centroid block would exceed the kernel budget, off
+    TPU (interpret mode is for CI, not speed), and under x64 — not a
+    compile limitation anymore (the kernels DO compile under
+    jax_enable_x64 since r3; pass distance_mode='pallas' explicitly for
+    f32-rate compute on x64 data) but a precision contract: an x64 user
+    asked for float64 math and the fused kernel is an f32 engine.
     """
     try:
         on_tpu = jax.default_backend() == "tpu"
@@ -151,18 +157,15 @@ def resolve_auto(n: int, d: int, k: int) -> str:
     return "pallas" if pallas_preferred(n, d, k) else "matmul"
 
 
-def _check_x64(interpret: bool) -> None:
-    if not interpret and jax.config.jax_enable_x64:
-        raise NotImplementedError(
-            "Pallas TPU kernels cannot compile under jax_enable_x64 with "
-            "this jax/Mosaic toolchain: even a trivial kernel containing "
-            "no 64-bit values (out[:] = x[:] * 2.0) fails remote "
-            "compilation when the x64 flag is on (reproduced on jax "
-            "0.9.0, 2026-07; the failure is in the Mosaic lowering of "
-            "the grid machinery, not in kernel-authored code, so no "
-            "int32-carry workaround applies — track jax-ml/jax Mosaic "
-            "x64 lowering fixes). Disable x64 or use "
-            "distance_mode='matmul'")
+# r2's x64 guard is GONE (r2 VERDICT #5): the toolchain fixed the Mosaic
+# grid-machinery x64 lowering that used to fail even trivial kernels
+# (re-verified 2026-07-30 on jax 0.9.0 / v5e), and the one remaining
+# in-repo blocker — index maps returning a bare Python 0, which lowers
+# as i64 under the x64 flag and broke the grid with a mixed
+# "func.return (i32, i64)" — is fixed in _specs (explicit np.int32).
+# The kernels now compile and run under jax_enable_x64; they remain an
+# f32 COMPUTE engine by design (inputs are cast, see _pad_inputs), which
+# is why resolve_auto still prefers the XLA path under x64.
 
 
 def _build_kernel(*, n_tiles, k_tiles, tile_n, tile_k, d, d_pad, mm_dtype,
@@ -384,14 +387,21 @@ def _pad_inputs(points, weights, centroids, tile_n, tile_k):
 
 def _specs(tile_n, tile_k, d_pad, k_pad, n_tiles, with_stats, pipelined,
            with_mind2=True):
+    # Index maps return EXPLICIT int32 (np scalars — jax constants may
+    # not be captured by index maps): under jax_enable_x64 a bare Python
+    # 0 lowers as i64 and the mixed (i32, i64) index tuple breaks
+    # Mosaic's grid lowering ("func.return (i32, i64)") — this was the
+    # last x64 blocker once the toolchain fixed trivial-kernel x64
+    # compilation (r2 VERDICT #5; re-tested 2026-07-30 on jax 0.9.0).
+    zero = np.int32(0)
     # Pipelined grids run one flush step past the data; clamp the block
     # index so the final step re-maps the last tile (no write happens).
     if pipelined:
         def nmap(i):
-            return (jnp.minimum(i, n_tiles - 1), 0)
+            return (jnp.minimum(i, np.int32(n_tiles - 1)), zero)
     else:
         def nmap(i):
-            return (i, 0)
+            return (i, zero)
     in_specs = [
         pl.BlockSpec((tile_n, d_pad), nmap, memory_space=pltpu.VMEM),
     ]
@@ -399,9 +409,10 @@ def _specs(tile_n, tile_k, d_pad, k_pad, n_tiles, with_stats, pipelined,
         in_specs.append(
             pl.BlockSpec((tile_n, 1), nmap, memory_space=pltpu.VMEM))
     in_specs += [
-        pl.BlockSpec((k_pad, d_pad), lambda i: (0, 0),
+        pl.BlockSpec((k_pad, d_pad), lambda i: (zero, zero),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, k_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, k_pad), lambda i: (zero, zero),
+                     memory_space=pltpu.VMEM),
     ]
     out_specs = [
         pl.BlockSpec((tile_n, 1), nmap, memory_space=pltpu.VMEM),
@@ -411,9 +422,9 @@ def _specs(tile_n, tile_k, d_pad, k_pad, n_tiles, with_stats, pipelined,
             pl.BlockSpec((tile_n, 1), nmap, memory_space=pltpu.VMEM))
     if with_stats:
         out_specs += [
-            pl.BlockSpec((k_pad, d_pad), lambda i: (0, 0),
+            pl.BlockSpec((k_pad, d_pad), lambda i: (zero, zero),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, k_pad), lambda i: (0, 0),
+            pl.BlockSpec((1, k_pad), lambda i: (zero, zero),
                          memory_space=pltpu.VMEM),
         ]
     return in_specs, out_specs
@@ -511,7 +522,6 @@ def pallas_assign(points: jax.Array, centroids: jax.Array, *,
     one-hot accumulation must wait for the GLOBAL argmin reconstructed
     across shards (r1 VERDICT #3); fusing it against the local block would
     accumulate points whose true winner lives in another shard's block."""
-    _check_x64(interpret)
     return _call(points, None, centroids, tile_n=tile_n, tile_k=tile_k,
                  bf16=bf16, interpret=interpret, with_stats=False)
 
@@ -537,7 +547,6 @@ def fused_assign_reduce(points: jax.Array, weights: jax.Array,
     ``mind2`` output should derive it from sums/counts (see
     parallel.distributed._sse_from_stats).
     """
-    _check_x64(interpret)
     return _call(points, weights, centroids, tile_n=tile_n, tile_k=tile_k,
                  bf16=bf16, interpret=interpret, with_stats=True,
                  with_mind2=with_mind2)

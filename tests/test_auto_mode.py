@@ -48,7 +48,8 @@ def test_shape_rule_matches_measured_win_loss_regions(monkeypatch):
         assert not pk.pallas_preferred(1_000_000, 128, 511)
         # Oversized centroid block falls back instead of raising.
         assert not pk.pallas_preferred(1_000_000, 512, 200_000)
-    # x64 always falls back (Mosaic limitation, _check_x64).
+    # x64 always falls back in AUTO mode — a precision contract (the
+    # fused kernel is an f32 engine; explicit 'pallas' still works).
     with jax.enable_x64(True):
         assert not pk.pallas_preferred(2_000_000, 128, 1024)
 

@@ -1,9 +1,12 @@
 """Mosaic compile-path coverage on real hardware.
 
 The main Pallas test modules run in interpret mode on CPU and skip under
-x64 on TPU (Mosaic/x64 limitation, see conftest.pallas_x64_skip).  This
-module keeps the actual TPU compilation tested: it scopes x64 OFF around
-the kernel call (jax.enable_x64(False)) — interpret mode cannot validate Mosaic lowering.
+x64 on TPU (their oracles promote to f64 there, see
+conftest.pallas_x64_skip).  This module keeps the actual TPU compilation
+tested: most tests scope x64 OFF around the kernel call so the oracle
+stays f32; ``test_kernel_compiles_under_live_x64`` pins that the kernels
+also compile and run with the x64 flag ON (r2 VERDICT #5 — the former
+NotImplementedError guard is gone).
 """
 
 import jax
@@ -13,6 +16,32 @@ import pytest
 pytestmark = pytest.mark.skipif(
     jax.default_backend() == "cpu",
     reason="Mosaic compile path needs real TPU hardware")
+
+
+def test_kernel_compiles_under_live_x64():
+    """r2 VERDICT #5: the x64 guard is removed — the fused kernel must
+    compile and run with jax_enable_x64 ON (f32 compute semantics: the
+    oracle is scoped to f32 for the comparison)."""
+    import jax.numpy as jnp
+
+    from kmeans_tpu.ops.assign import assign_reduce
+    from kmeans_tpu.ops.pallas_kernels import fused_assign_reduce
+
+    assert jax.config.jax_enable_x64       # conftest turns it on
+    rng = np.random.default_rng(0)
+    Xh = rng.normal(size=(2048, 24)).astype(np.float32)
+    X = jnp.asarray(Xh, jnp.float32)
+    W = jnp.ones((2048,), jnp.float32)
+    C = jnp.asarray(Xh[:9], jnp.float32)
+    labels, mind2, sums, counts = fused_assign_reduce(X, W, C)
+    assert np.asarray(labels).dtype == np.int32
+    with jax.enable_x64(False):            # f32 oracle for comparison
+        ref = assign_reduce(jnp.asarray(Xh), jnp.ones((2048,), jnp.float32),
+                            jnp.asarray(Xh[:9]), chunk_size=512)
+    np.testing.assert_array_equal(np.asarray(counts),
+                                  np.asarray(ref.counts))
+    np.testing.assert_allclose(np.asarray(sums), np.asarray(ref.sums),
+                               rtol=1e-4, atol=1e-4)
 
 
 def test_fused_kernel_compiles_and_matches_oracle_on_tpu():
