@@ -67,9 +67,19 @@ def timed_fit(fit_fn, points, weights, cents) -> float:
 def main() -> None:
     import jax
 
+    from kmeans_tpu.benchmarks import (enable_compilation_cache,
+                                       measure_marginal)
+
+    enable_compilation_cache()
     backend = jax.default_backend()
     on_accel = backend not in ("cpu",)
-    n = int(os.environ.get("BENCH_N", 2_000_000 if on_accel else 100_000))
+    # Default = the BASELINE.json NORTH-STAR config (10M x 128, k=1024)
+    # on accelerators.  Affordable as a default since r3 because the
+    # dataset is generated ON DEVICE (below): the former 5 GB host
+    # upload — ~10 MB/s through the tunneled PJRT transport, the
+    # dominant share of r2's "compile+warmup" (docs/PERFORMANCE.md
+    # "Time to first iteration") — no longer exists.
+    n = int(os.environ.get("BENCH_N", 10_000_000 if on_accel else 100_000))
     d = int(os.environ.get("BENCH_D", 128))
     k = int(os.environ.get("BENCH_K", 1024))
     iters = int(os.environ.get("BENCH_ITERS", 20))
@@ -82,18 +92,31 @@ def main() -> None:
     log(f"bench: backend={backend} devices={len(jax.devices())} "
         f"N={n} D={d} k={k} iters={iters} mode={mode}")
 
-    from kmeans_tpu.parallel import distributed as dist
-    from kmeans_tpu.parallel.mesh import make_mesh, mesh_shape
-    from kmeans_tpu.parallel.sharding import choose_chunk_size, shard_points
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    rng = np.random.default_rng(42)
-    X = rng.uniform(-1, 1, size=(n, d)).astype(np.float32)
-    init = X[rng.choice(n, size=k, replace=False)].copy()
+    from kmeans_tpu.parallel import distributed as dist
+    from kmeans_tpu.parallel.mesh import DATA_AXIS, make_mesh, mesh_shape
+    from kmeans_tpu.parallel.sharding import choose_chunk_size
 
     mesh = make_mesh()
     data_shards, model_shards = mesh_shape(mesh)
     chunk = choose_chunk_size(-(-n // data_shards), k, d)
-    points, weights = shard_points(X, mesh, chunk)
+    n_pad = -(-n // (data_shards * chunk)) * (data_shards * chunk)
+
+    # Seeded uniform points generated ON DEVICE, already sharded (GSPMD
+    # materializes each shard locally): zero host->device transfer.
+    gen = jax.jit(
+        lambda key: (jax.random.uniform(key, (n_pad, d), jnp.float32,
+                                        -1.0, 1.0),
+                     (jnp.arange(n_pad) < n).astype(jnp.float32)),
+        out_shardings=(NamedSharding(mesh, P(DATA_AXIS, None)),
+                       NamedSharding(mesh, P(DATA_AXIS))))
+    points, weights = gen(jax.random.PRNGKey(42))
+    # Forgy init from the generated rows (a tiny k-row device gather).
+    rng = np.random.default_rng(42)
+    init = np.asarray(points[np.sort(rng.choice(n, size=k,
+                                                replace=False))])
     cents = jax.device_put(dist.pad_centroids(init, model_shards),
                            dist.centroid_sharding(mesh))
 
@@ -111,23 +134,16 @@ def main() -> None:
     timed_fit(fit_big, points, weights, cents)
     log(f"bench: compile+warmup {time.perf_counter() - t0:.1f}s")
 
-    # Median-of-3 marginal measurements (r1 VERDICT #8): the tunneled
-    # single-chip environment shows ~±20% run-to-run wall-clock variance,
-    # so a single marginal is not trustworthy.  Interleaving each
-    # (small, big) pair keeps every marginal internally consistent under
-    # slow drift; the JSON carries the relative spread so downstream
-    # readers can see the measurement quality.
-    margins = []
-    for rep in range(3):
-        t_small = timed_fit(fit_small, points, weights, cents)
-        t_big = timed_fit(fit_big, points, weights, cents)
-        margins.append(max(t_big - t_small, 1e-9))
-        log(f"bench: rep {rep + 1}/3: fit(2)={t_small*1e3:.0f} ms, "
-            f"fit({2+iters})={t_big*1e3:.0f} ms -> "
-            f"{margins[-1]/iters*1e3:.2f} ms/iter")
-    margin = float(np.median(margins))
+    # The shared measurement protocol (kmeans_tpu.benchmarks.
+    # measure_marginal): median of 3 interleaved marginals + relative
+    # spread, so both harnesses measure under identical rules.
+    margin, spread, margins = measure_marginal(
+        lambda: timed_fit(fit_small, points, weights, cents),
+        lambda: timed_fit(fit_big, points, weights, cents))
+    for rep, m in enumerate(margins):
+        log(f"bench: rep {rep + 1}/3: marginal {m*1e3:.0f} ms over "
+            f"{iters} iters -> {m/iters*1e3:.2f} ms/iter")
     per_iter = margin / iters
-    spread = (max(margins) - min(margins)) / margin
     log(f"bench: median {per_iter*1e3:.2f} ms/iter, spread "
         f"{spread*100:.0f}% over 3 reps")
     if margin <= 0.05:

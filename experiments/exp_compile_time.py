@@ -1,0 +1,106 @@
+"""Where does bench.py's "compile+warmup" wall time go? (r2 VERDICT #6)
+
+BENCH_r01 reported 47.6 s compile+warmup at the 2M benchmark shape;
+BENCH_r02 164.1 s; the r3 10M run 323.7 s.  This experiment decomposes
+the time into its actual phases — host data generation, host->device
+transfer of the points array, jit trace+lowering, backend (Mosaic+XLA)
+compilation of BOTH while_loop programs, and first execution — and
+measures the persistent-compilation-cache mitigation.
+
+Run (on the TPU):   python experiments/exp_compile_time.py [N] [mode]
+Second run reuses the cache dir and shows the compile-phase savings.
+Env: EXP_CACHE_DIR (default /tmp/jax_cache_exp; delete it for a cold
+measurement), EXP_NO_CACHE=1 disables the cache entirely.
+
+Findings (v5e, 2026-07-30, N=2M D=128 k=1024, mode=pallas — recorded in
+docs/PERFORMANCE.md "Time to first iteration"): see the doc table.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
+    mode_arg = sys.argv[2] if len(sys.argv) > 2 else "auto"
+    cache_dir = os.environ.get("EXP_CACHE_DIR", "/tmp/jax_cache_exp")
+
+    import jax
+    if not os.environ.get("EXP_NO_CACHE"):
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        print(f"compilation cache: {cache_dir} "
+              f"({'present' if os.path.isdir(cache_dir) else 'cold'})")
+    else:
+        print("compilation cache: DISABLED")
+
+    from kmeans_tpu.ops.pallas_kernels import resolve_auto
+    from kmeans_tpu.parallel import distributed as dist
+    from kmeans_tpu.parallel.mesh import make_mesh, mesh_shape
+    from kmeans_tpu.parallel.sharding import choose_chunk_size, shard_points
+
+    d, k, iters = 128, 1024, 20
+    mode = resolve_auto(n, d, k) if mode_arg == "auto" else mode_arg
+    print(f"N={n} D={d} k={k} mode={mode} "
+          f"backend={jax.default_backend()}")
+
+    def t(label, fn):
+        start = time.perf_counter()
+        out = fn()
+        dt = time.perf_counter() - start
+        print(f"  {label:<42s} {dt:8.2f} s", flush=True)
+        return out, dt
+
+    total0 = time.perf_counter()
+    rng = np.random.default_rng(42)
+    X, _ = t("host data gen (rng.uniform)",
+             lambda: rng.uniform(-1, 1, size=(n, d)).astype(np.float32))
+    init = X[rng.choice(n, size=k, replace=False)].copy()
+
+    mesh = make_mesh()
+    data_shards, model_shards = mesh_shape(mesh)
+    chunk = choose_chunk_size(-(-n // data_shards), k, d)
+
+    (points, weights), _ = t("device_put (async dispatch)",
+                             lambda: shard_points(X, mesh, chunk))
+    # Force the actual HBM transfer before anything else is timed: a
+    # scalar reduction must read every element.
+    _, t_xfer = t("host->device transfer (forced by sum)",
+                  lambda: float(jax.jit(lambda p: p.sum())(points)))
+    cents = jax.device_put(dist.pad_centroids(init, model_shards),
+                           dist.centroid_sharding(mesh))
+
+    def build(max_iter):
+        return dist.make_fit_fn(mesh, chunk_size=chunk, mode=mode,
+                                k_real=k, max_iter=max_iter,
+                                tolerance=1e-30, empty_policy="keep",
+                                history_sse=False)
+
+    fit_small, fit_big = build(2), build(2 + iters)
+
+    lowered_small, _ = t("trace+lower fit(2)",
+                         lambda: fit_small.lower(points, weights, cents))
+    _, t_c_small = t("backend compile fit(2)  [Mosaic+XLA]",
+                     lowered_small.compile)
+    lowered_big, _ = t(f"trace+lower fit({2 + iters})",
+                       lambda: fit_big.lower(points, weights, cents))
+    _, t_c_big = t(f"backend compile fit({2 + iters})",
+                   lowered_big.compile)
+
+    def run(fn):
+        out = fn(points, weights, cents)
+        return int(out[1])
+    _, _ = t("first exec fit(2)", lambda: run(fit_small))
+    _, _ = t(f"first exec fit({2 + iters})", lambda: run(fit_big))
+    print(f"  {'TOTAL':<42s} {time.perf_counter() - total0:8.2f} s")
+    print(f"\ncompile phases alone: {t_c_small + t_c_big:.1f} s; "
+          f"transfer: {t_xfer:.1f} s")
+
+
+if __name__ == "__main__":
+    main()
