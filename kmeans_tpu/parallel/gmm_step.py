@@ -108,12 +108,26 @@ def _estep_tile(x, w, means, inv_var, log_det, log_weights,
         denom = lax.psum(denom, MODEL_AXIS)
     lse = m + jnp.log(denom)
     resp = p / denom[:, None] * w[:, None]         # weighted, padded -> 0
+    # Moment accumulators run at HIGHEST matmul precision: on TPU, "f32"
+    # dots execute with bf16-rounded products by default (fine for the
+    # responsibility softmax above — relative logp error ~2^-8 barely
+    # moves a softmax), but the M-step's variance is the DIFFERENCE
+    # S2/R - mu^2, which survives only while |mu|/sigma < ~sqrt(2^8) ~ 16
+    # per dim under bf16 products.  Clusters offset ~25 sigma from the
+    # global mean collapsed to reg_covar on hardware (r3, found driving
+    # the v5e; invisible on CPU where f32 dots are exact).  HIGHEST
+    # (3-pass bf16 ~ true f32) restores the CPU bound (~2^12 sigma) for
+    # the two moment matmuls only — ~2x the E-step's MXU work, the price
+    # of correct covariances in the matmul formulation.
+    hi = lax.Precision.HIGHEST
     return EStats(
         resp_sum=jnp.sum(resp, axis=0),
         xsum=lax.dot_general(resp, x, (((0,), (0,)), ((), ())),
-                             preferred_element_type=x.dtype),
+                             preferred_element_type=x.dtype,
+                             precision=hi),
         x2sum=lax.dot_general(resp, x * x, (((0,), (0,)), ((), ())),
-                              preferred_element_type=x.dtype),
+                              preferred_element_type=x.dtype,
+                              precision=hi),
         loglik=jnp.sum(jnp.where(w > 0, lse * w, 0.0)),
     )
 

@@ -127,6 +127,20 @@ km_pdp = KMeans(k=4, seed=0, init=init, empty_cluster="keep",
 np.testing.assert_allclose(km_pdp.centroids, km.centroids,
                            rtol=1e-5, atol=1e-5)
 
+# --- GMM on the process-local dataset (r3): the E-step's psum-embedded
+# statistics AND the centering shift's GSPMD weighted mean cross the
+# process boundary; the replicated results must agree bit-for-bit
+# across processes.  Explicit means_init (forgy would need a host copy).
+from kmeans_tpu import GaussianMixture  # noqa: E402
+
+gm = GaussianMixture(n_components=4, means_init=init.astype(np.float64),
+                     max_iter=5, tol=0.0, seed=0)
+gm.fit(ds)
+assert np.all(np.isfinite(gm.means_)) and np.isfinite(gm.lower_bound_)
+np.save(out_dir / f"gmm_means_{proc_id}.npy", gm.means_)
+np.save(out_dir / f"gmm_ll_{proc_id}.npy",
+        np.asarray([gm.lower_bound_]))
+
 np.save(out_dir / f"centroids_{proc_id}.npy", km.centroids)
 np.save(out_dir / f"sse_{proc_id}.npy", np.asarray(km.sse_history))
 print(f"proc {proc_id}: OK iters={km.iterations_run} "

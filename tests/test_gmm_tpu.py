@@ -1,0 +1,49 @@
+"""GMM numerics on real hardware.
+
+On TPU, "f32" dots execute with bf16-rounded products by default — fine
+for the responsibility softmax, fatal for the M-step's variance
+difference S2/R - mu^2 once a cluster's mean sits more than ~16 sigma
+from the centering shift (r3: covariances collapsed to reg_covar and
+the log-likelihood went POSITIVE via the density-spike singularity,
+found only by driving the chip — the CPU suite computes exact f32 dots
+and cannot see it).  The moment matmuls now run at Precision.HIGHEST
+(gmm_step._estep_tile); this pins that on hardware.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() == "cpu",
+    reason="bf16-rate matmul products only exist on real TPU hardware")
+
+
+def test_moment_matmuls_survive_offset_clusters_on_tpu():
+    from kmeans_tpu import GaussianMixture
+
+    rng = np.random.default_rng(0)
+    k, d, n = 32, 64, 50_000
+    # Cluster means ~N(0, 25) per dim after global centering: |mu|/sigma
+    # up to ~25 — beyond the bf16-product survival bound (~16), inside
+    # the f32 one (~4096).
+    centers = rng.normal(size=(k, d)) * 5 + 1e3
+    y = rng.integers(0, k, size=n)
+    X = (centers[y] + rng.normal(size=(n, d))).astype(np.float32)
+
+    gm = GaussianMixture(n_components=k, means_init=centers, max_iter=3,
+                         tol=0.0, seed=1).fit(X)
+    # True per-dim variances are 1.0; bf16-product moments collapsed
+    # them to reg_covar (1e-6) and pushed the mean loglik positive.
+    assert gm.covariances_.min() > 0.5, gm.covariances_.min()
+    assert gm.covariances_.max() < 2.0
+    assert gm.lower_bound_ < 0
+
+    # Device loop agrees with the host loop on the same hardware path.
+    gm_dev = GaussianMixture(n_components=k, means_init=centers,
+                             max_iter=3, tol=0.0, seed=1,
+                             host_loop=False).fit(X)
+    np.testing.assert_allclose(gm_dev.covariances_, gm.covariances_,
+                               rtol=1e-4)
+    np.testing.assert_allclose(gm_dev.lower_bound_, gm.lower_bound_,
+                               rtol=1e-5)

@@ -149,6 +149,20 @@ def test_two_process_fit_matches_single_process(tmp_path):
     np.testing.assert_array_equal(rs0, rs1)
     assert np.all(np.isfinite(rs0))
 
+    # GMM EM across the process boundary (r3): replicated results agree
+    # bit-for-bit between processes and match a single-process fit.
+    g0 = np.load(tmp_path / "gmm_means_0.npy")
+    g1 = np.load(tmp_path / "gmm_means_1.npy")
+    np.testing.assert_array_equal(g0, g1)
+    from kmeans_tpu import GaussianMixture
+    gm_ref = GaussianMixture(n_components=4,
+                             means_init=init.astype(np.float64),
+                             max_iter=5, tol=0.0, seed=0).fit(X)
+    np.testing.assert_allclose(g0, gm_ref.means_, atol=1e-3)
+    np.testing.assert_allclose(
+        float(np.load(tmp_path / "gmm_ll_0.npy")[0]),
+        gm_ref.lower_bound_, rtol=1e-4)
+
 
 # (r1's up-front 'resample' rejection for process-local datasets is gone:
 # the on-device Gumbel sampler serves it now.  Real coverage lives in the
