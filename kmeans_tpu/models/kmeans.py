@@ -673,21 +673,25 @@ class KMeans:
         seed = self.seed if seed is None else seed
         iters_left = self.max_iter - start_iter
         mode = self._mode(ds.n, ds.d)
+        # Seeds travel as a traced ARGUMENT (not a baked constant), so
+        # fits differing only by seed/start_iter — restarts, bisecting
+        # splits, resumes — reuse one compiled program.
         key = (mesh, ds.chunk, mode, self.k, iters_left,
                float(self.tolerance), self.empty_cluster, self.compute_sse,
-               seed, start_iter, "fit")
+               "fit")
         if key not in _STEP_CACHE:
             _STEP_CACHE[key] = dist.make_fit_fn(
                 mesh, chunk_size=ds.chunk, mode=mode,
                 k_real=self.k, max_iter=iters_left,
                 tolerance=float(self.tolerance),
                 empty_policy=self.empty_cluster,
-                history_sse=self.compute_sse, seed=seed, iter0=start_iter)
+                history_sse=self.compute_sse)
         fit_fn = _STEP_CACHE[key]
         cents_dev = self._put_centroids(centroids, mesh, model_shards)
         fit_start = time.perf_counter()
         cents, n_iters, sse_hist, shift_hist, counts = fit_fn(
-            ds.points, ds.weights, cents_dev)
+            ds.points, ds.weights, cents_dev,
+            dist._empty_seed_array(seed, start_iter, iters_left))
         self._finish_device_fit(cents, int(n_iters), start_iter, sse_hist,
                                 shift_hist, counts,
                                 time.perf_counter() - fit_start, log)
@@ -735,7 +739,7 @@ class KMeans:
         R = len(seeds)
         mode = self._mode(ds.n, ds.d)
         key = (mesh, ds.chunk, mode, self.k, self.max_iter,
-               float(self.tolerance), self.empty_cluster, tuple(seeds),
+               float(self.tolerance), self.empty_cluster, R,
                self.compute_sse, "multifit")
         if key not in _STEP_CACHE:
             _STEP_CACHE[key] = dist.make_multi_fit_fn(
@@ -743,7 +747,7 @@ class KMeans:
                 k_real=self.k, max_iter=self.max_iter,
                 tolerance=float(self.tolerance),
                 empty_policy=self.empty_cluster, n_init=R,
-                history_sse=self.compute_sse, seeds=tuple(seeds))
+                history_sse=self.compute_sse)
         fit_fn = _STEP_CACHE[key]
         _, model_shards = mesh_shape(mesh)
         inits = np.stack([dist.pad_centroids(
@@ -755,7 +759,9 @@ class KMeans:
         self.iter_times_ = []
         fit_start = time.perf_counter()
         cents, n_iters, sse_hist, shift_hist, counts, best, finals = fit_fn(
-            ds.points, ds.weights, cents_dev)
+            ds.points, ds.weights, cents_dev,
+            np.stack([dist._empty_seed_array(s, 0, self.max_iter)
+                      for s in seeds]))
         self.best_restart_ = int(best)
         self.restart_inertias_ = np.asarray(finals, dtype=np.float64)
         self._finish_device_fit(cents, int(n_iters), 0, sse_hist, shift_hist,

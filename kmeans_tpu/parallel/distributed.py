@@ -311,8 +311,10 @@ def _empty_seed_array(seed: int, iter0: int, max_iter: int) -> np.ndarray:
     ``ShardedDataset.sample_positive_rows(m, [seed, iteration + 1])``
     derives ``PRNGKey(SeedSequence([seed, iteration + 1]) % 2**31)``
     (sharding.py:205-210).  SeedSequence is host-only, so the whole
-    schedule is precomputed here and closed over as a (max_iter,)
-    constant, indexed by the loop counter."""
+    schedule is precomputed here and passed to the fit functions as a
+    TRACED (max_iter,) argument indexed by the loop counter — an
+    argument, not a baked constant, so fits differing only by seed share
+    one compiled program."""
     return np.asarray(
         [np.random.SeedSequence([seed, iter0 + i + 1]).generate_state(1)[0]
          % (2 ** 31) for i in range(max_iter)], dtype=np.uint32)
@@ -421,8 +423,7 @@ def _refill_empty_slots_batched(new, is_empty, skip, points, weights,
 
 def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                 k_real: int, max_iter: int, tolerance: float,
-                empty_policy: str = "keep", history_sse: bool = True,
-                seed: int = 0, iter0: int = 0):
+                empty_policy: str = "keep", history_sse: bool = True):
     """Build a FULLY ON-DEVICE training loop: one dispatch runs all
     iterations under ``lax.while_loop``.
 
@@ -450,15 +451,19 @@ def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
       ``_refill_empty_slots``), so host- and device-loop trajectories
       agree whenever the host path uses that engine (hostless datasets).
 
-    Returns ``fit(points, weights, centroids0) ->
+    Returns ``fit(points, weights, centroids0, empty_seeds) ->
     (centroids, n_iters, sse_history[max_iter], shift_history[max_iter],
-    counts)`` with everything replicated.
+    counts)`` with everything replicated.  ``empty_seeds`` is the
+    (max_iter,) uint32 per-iteration draw-seed schedule
+    (``_empty_seed_array(seed, iter0, max_iter)``; any array for
+    'keep') — a traced ARGUMENT, not a baked constant, so fits that
+    differ only by seed (restarts, bisecting splits, resumes) share one
+    compiled program.
     """
     if empty_policy not in ("keep", "farthest", "resample"):
         raise ValueError(
             f"on-device loop supports empty_cluster 'keep', 'farthest' or "
             f"'resample', got {empty_policy!r}")
-    empty_seeds = jnp.asarray(_empty_seed_array(seed, iter0, max_iter))
     data_shards, model_shards = mesh_shape(mesh)
     # Elide unneeded per-iteration statistics (the reference's own
     # compute_sse speed/observability trade, kmeans_spark.py:34): skipping
@@ -467,7 +472,11 @@ def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
     need_sse = bool(history_sse)
     need_farthest = (empty_policy == "farthest")
 
-    def fit(points, weights, centroids_block):
+    def fit(points, weights, centroids_block, empty_seeds):
+        if empty_seeds.shape != (max_iter,):
+            raise ValueError(f"empty_seeds must have shape ({max_iter},) "
+                             f"(one per iteration), got "
+                             f"{empty_seeds.shape}")
         k_local, d = centroids_block.shape
         acc = _accum_dtype(points.dtype)
         # The empty-slot refill draws against the PRE-prep row space so it
@@ -568,7 +577,8 @@ def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
 
     mapped = jax.shard_map(
         fit, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(MODEL_AXIS, None)),
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(MODEL_AXIS, None),
+                  P(None)),
         out_specs=(P(None, None), P(), P(), P(), P(None)),
         check_vma=False)
     return jax.jit(mapped)
@@ -577,7 +587,7 @@ def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
 def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                       k_real: int, max_iter: int, tolerance: float,
                       empty_policy: str = "keep", n_init: int,
-                      history_sse: bool = True, seeds=(0,)):
+                      history_sse: bool = True):
     """Build a BATCHED on-device training loop: ``n_init`` independent
     restarts run in ONE dispatch, vmapped over the restart axis.
 
@@ -601,12 +611,14 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
     each shard scores points against its block only, and the loop carries
     the gathered full table per restart.  ``empty_policy`` may be any of
     'keep' / 'farthest' / 'resample'; ALL empty slots refill in the same
-    iteration, and each restart's draws are keyed by ITS entry in
-    ``seeds`` (one per restart, the same seeds the host-sequential path
+    iteration, and each restart's draws are keyed by ITS row of the
+    ``empty_seeds`` (R, max_iter) argument (per-restart
+    ``_empty_seed_array`` rows — the same seeds the host-sequential path
     feeds ``_handle_empty``), so the batched sweep refills exactly like R
-    sequential fits.
+    sequential fits while every seed set shares one compiled program.
 
-    Returns ``fit(points, weights, centroids0[R,k,D]) -> (best_centroids,
+    Returns ``fit(points, weights, centroids0[R,k,D],
+    empty_seeds[R,max_iter]) -> (best_centroids,
     n_iters_best, sse_hist_best, shift_hist_best, counts_best, best_idx,
     final_inertias[R])`` with everything replicated.
     """
@@ -614,17 +626,16 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
         raise ValueError(
             f"on-device loop supports empty_cluster 'keep', 'farthest' or "
             f"'resample', got {empty_policy!r}")
-    if len(seeds) != n_init:
-        raise ValueError(f"need one seed per restart: {len(seeds)} seeds "
-                         f"for n_init={n_init}")
-    empty_seeds = jnp.asarray(np.stack(
-        [_empty_seed_array(s, 0, max_iter) for s in seeds]))  # (R, max_iter)
     data_shards, model_shards = mesh_shape(mesh)
 
-    def fit(points, weights, cents0_blocks):
+    def fit(points, weights, cents0_blocks, empty_seeds):
         # cents0_blocks: (R, k_local, d), k axis sharded on MODEL.
         acc = _accum_dtype(points.dtype)
         R, k_local, d = cents0_blocks.shape
+        if empty_seeds.shape != (R, max_iter):
+            raise ValueError(f"empty_seeds must have shape ({R}, "
+                             f"{max_iter}) (one row per restart), got "
+                             f"{empty_seeds.shape}")
         n_orig, w_draw = points.shape[0], weights   # pre-prep row space
         x2w = w_col = None
         if mode in PALLAS_MODES:
@@ -742,7 +753,7 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
     mapped = jax.shard_map(
         fit, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS),
-                  P(None, MODEL_AXIS, None)),
+                  P(None, MODEL_AXIS, None), P(None, None)),
         out_specs=(P(None, None), P(), P(None), P(None), P(None), P(),
                    P(None)),
         check_vma=False)

@@ -113,6 +113,22 @@ def test_k1_sse_no_cancellation_far_from_origin(mesh8):
     assert np.isclose(model.sse_history[-1], expect, rtol=1e-3)
 
 
+def test_device_loop_inner_fits_match_host(blobs6, mesh8):
+    """r3: host_loop=False runs each inner 2-means as ONE device
+    dispatch (the tunneled-platform fix: per-iteration host RTT made a
+    k=32 bisecting fit take ~13 minutes).  The split tree must come out
+    identical to the host-loop fit; the shared make_fit_fn program is
+    reused across splits because the draw seeds are a traced argument."""
+    X, _ = blobs6
+    kw = dict(k=6, seed=3, dtype=np.float64, mesh=mesh8, verbose=False)
+    host = BisectingKMeans(host_loop=True, **kw).fit(X)
+    dev = BisectingKMeans(host_loop=False, **kw).fit(X)
+    np.testing.assert_allclose(dev.centroids, host.centroids, atol=1e-9)
+    np.testing.assert_array_equal(dev.labels_, host.labels_)
+    np.testing.assert_allclose(dev.cluster_sse_, host.cluster_sse_,
+                               rtol=1e-9)
+
+
 def test_empty_cluster_forwarded_to_inner_fits(blobs6, mesh8):
     X, _ = blobs6
     model = BisectingKMeans(k=4, empty_cluster="farthest", seed=0,
