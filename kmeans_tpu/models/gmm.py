@@ -477,6 +477,101 @@ class GaussianMixture:
             else X.n
         return -2.0 * self.score(X) * n + 2.0 * self._n_parameters()
 
+    # ------------------------------------------------- checkpoint / pickle
+
+    def save(self, path) -> None:
+        """Checkpoint fitted state AND explicit init arrays (mirrors
+        ``KMeans.save`` — the reference has no serialization at all,
+        SURVEY.md §5).  Multi-host: call on EVERY process; the shared
+        primary-gated writer (``checkpoint.save_state_primary``) handles
+        the single-writer + barrier contract."""
+        from kmeans_tpu.utils import checkpoint as ckpt
+        state = {
+            "model_class": type(self).__name__,
+            "n_components": self.n_components,
+            "covariance_type": self.covariance_type,
+            "tol": self.tol, "reg_covar": self.reg_covar,
+            "max_iter": self.max_iter, "n_init": self.n_init,
+            "init_params": self.init_params, "seed": self.seed,
+            "model_shards": self.model_shards,
+            "chunk_size": self.chunk_size, "host_loop": self.host_loop,
+            "verbose": self.verbose, "dtype": str(self.dtype),
+            "weights_": np.asarray(self.weights_)
+            if self.weights_ is not None else np.zeros((0,)),
+            "means_": np.asarray(self.means_)
+            if self.means_ is not None else np.zeros((0, 0)),
+            "covariances_": np.asarray(self.covariances_)
+            if self.covariances_ is not None else np.zeros((0, 0)),
+            "shift_": np.asarray(self._shift())
+            if self.means_ is not None else np.zeros((0,)),
+            "converged_": bool(self.converged_),
+            "n_iter_": int(self.n_iter_),
+            "lower_bound_": float(self.lower_bound_),
+        }
+        # Explicit init arrays are CONFIG, not fitted state: a loaded
+        # model that is re-fit must seed exactly like the original.
+        for name in ("weights_init", "means_init", "precisions_init"):
+            val = getattr(self, name)
+            if val is not None:
+                state[f"cfg_{name}"] = np.asarray(val)
+        ckpt.save_state_primary(path, state, "kmeans_tpu.gmm.save")
+
+    @classmethod
+    def load(cls, path) -> "GaussianMixture":
+        from kmeans_tpu.utils import checkpoint as ckpt
+        state = ckpt.load_state(path)
+        inits = {name: state[f"cfg_{name}"]
+                 for name in ("weights_init", "means_init",
+                              "precisions_init")
+                 if f"cfg_{name}" in state}
+        model = cls(n_components=int(state["n_components"]),
+                    covariance_type=str(state["covariance_type"]),
+                    tol=float(state["tol"]),
+                    reg_covar=float(state["reg_covar"]),
+                    max_iter=int(state["max_iter"]),
+                    n_init=int(state.get("n_init", 1)),
+                    init_params=str(state["init_params"]),
+                    seed=int(state["seed"]),
+                    model_shards=int(state.get("model_shards", 1)),
+                    chunk_size=(int(state["chunk_size"])
+                                if state["chunk_size"] is not None else
+                                None),
+                    host_loop=bool(state.get("host_loop", True)),
+                    verbose=bool(state["verbose"]),
+                    dtype=np.dtype(str(state["dtype"])), **inits)
+        if state["means_"].size:
+            model.weights_ = np.asarray(state["weights_"], np.float64)
+            model.means_ = np.asarray(state["means_"], np.float64)
+            model.covariances_ = np.asarray(state["covariances_"],
+                                            np.float64)
+            model.shift_ = np.asarray(state["shift_"], np.float64)
+            model.converged_ = bool(state["converged_"])
+            model.n_iter_ = int(state["n_iter_"])
+            model.lower_bound_ = float(state["lower_bound_"])
+        return model
+
+    def __getstate__(self) -> dict:
+        """CROSS-PROCESS pickle support: the ``jax.sharding.Mesh`` of
+        Device handles is dropped (KMeans does the same); an unpickled
+        model lazily rebuilds a mesh on next use."""
+        state = dict(self.__dict__)
+        state["mesh"] = None
+        return state
+
+    def __deepcopy__(self, memo):
+        """In-process deepcopy keeps the (copyable, user-configured)
+        mesh — only cross-process pickling must drop device handles
+        (same contract as ``KMeans.__deepcopy__``)."""
+        import copy as _copy
+        new = self.__class__.__new__(self.__class__)
+        memo[id(self)] = new
+        for name, value in self.__dict__.items():
+            if name == "mesh":
+                new.__dict__[name] = value     # share device handles
+            else:
+                new.__dict__[name] = _copy.deepcopy(value, memo)
+        return new
+
     def get_params(self, deep: bool = True) -> dict:
         return {name: getattr(self, name) for name in self._PARAM_NAMES}
 

@@ -1120,17 +1120,11 @@ class KMeans:
     def save(self, path) -> None:
         """Checkpoint fitted state (beyond-reference; SURVEY.md §5).
 
-        Multi-host: call on EVERY process (SPMD style).  Only process 0
-        writes — N identical concurrent writers to one shared-filesystem
-        path race (r1 VERDICT #5) — and a cross-process barrier orders the
-        write before any process returns, so a following ``load`` on any
-        host with access to the path sees the complete file."""
-        from kmeans_tpu.parallel.multihost import is_primary
-        if is_primary():
-            ckpt.save_state(path, self._state_dict())
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices("kmeans_tpu.save")
+        Multi-host: call on EVERY process (SPMD style); the shared
+        primary-gated writer handles the single-writer + barrier
+        contract (``checkpoint.save_state_primary``)."""
+        ckpt.save_state_primary(path, self._state_dict(),
+                                "kmeans_tpu.save")
 
     @classmethod
     def load(cls, path) -> "KMeans":

@@ -294,6 +294,57 @@ def test_log_det_consistent_with_clamped_precision():
                                gm2.score_samples(X[:100]), rtol=1e-6)
 
 
+def test_save_load_roundtrip(tmp_path):
+    """GMM checkpointing mirrors KMeans.save/load (the reference has no
+    serialization, SURVEY.md §5) — incl. the centering shift, so a
+    loaded model scores identically."""
+    X, _ = _data(n=1_500, centers=3, d=4, seed=17)
+    gm = GaussianMixture(n_components=3, max_iter=10, seed=7).fit(X)
+    gm.save(tmp_path / "gmm_ckpt")
+    loaded = GaussianMixture.load(tmp_path / "gmm_ckpt")
+    np.testing.assert_array_equal(loaded.means_, gm.means_)
+    np.testing.assert_array_equal(loaded.covariances_, gm.covariances_)
+    np.testing.assert_array_equal(loaded.weights_, gm.weights_)
+    assert loaded.converged_ == gm.converged_
+    assert loaded.n_iter_ == gm.n_iter_
+    np.testing.assert_allclose(loaded.lower_bound_, gm.lower_bound_)
+    np.testing.assert_array_equal(loaded.predict(X), gm.predict(X))
+    np.testing.assert_allclose(loaded.score_samples(X),
+                               gm.score_samples(X), rtol=1e-6)
+    # Unfitted round-trip keeps config, no fitted state.
+    GaussianMixture(n_components=2).save(tmp_path / "unfit")
+    assert GaussianMixture.load(tmp_path / "unfit").means_ is None
+    # Explicit init arrays are config: a loaded model re-fits exactly
+    # like the original would.
+    means, weights, precisions = _shared_init(X, 3, seed=2)
+    cfg = GaussianMixture(n_components=3, max_iter=5, tol=0.0,
+                          means_init=means, weights_init=weights,
+                          precisions_init=precisions)
+    cfg.save(tmp_path / "cfg")
+    cfg2 = GaussianMixture.load(tmp_path / "cfg")
+    np.testing.assert_array_equal(cfg2.means_init, means)
+    a = cfg.fit(X)
+    b = cfg2.fit(X)
+    np.testing.assert_array_equal(a.means_, b.means_)
+
+
+def test_pickle_drops_mesh_deepcopy_keeps_it(mesh8):
+    import copy
+    import pickle
+    X, _ = _data(n=1_200, centers=3, d=4, seed=18)
+    gm = GaussianMixture(n_components=3, max_iter=8, seed=8,
+                         mesh=mesh8).fit(X)
+    clone = pickle.loads(pickle.dumps(gm))
+    assert clone.mesh is None                  # device handles dropped
+    np.testing.assert_array_equal(clone.means_, gm.means_)
+    np.testing.assert_array_equal(clone.predict(X), gm.predict(X))
+    # In-process deepcopy keeps the user-configured mesh (KMeans
+    # contract).
+    dup = copy.deepcopy(gm)
+    assert dup.mesh is gm.mesh
+    np.testing.assert_array_equal(dup.predict(X), gm.predict(X))
+
+
 def test_set_params_validates():
     """r2 ADVICE (low): set_params routes through __init__ validation."""
     gm = GaussianMixture(n_components=3)
