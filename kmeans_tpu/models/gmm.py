@@ -89,6 +89,13 @@ class GaussianMixture:
     dispatch (the M-step then divides in the accumulation dtype on
     device instead of the host's float64 — same documented divergence as
     ``KMeans(host_loop=False)``).
+
+    Chunking note: raw-array inputs are chunked with the EM-specific
+    2^23-element budget (docs/PERFORMANCE.md — the K-Means budget costs
+    ~2x per EM iteration at k=256-class shapes).  A pre-built
+    ``ShardedDataset`` keeps ITS chunk (its padding committed to it);
+    when loading data yourself for a mixture fit, pass the dataset
+    loader a chunk near ``2^23 / n_components`` rows.
     """
 
     _PARAM_NAMES = ("n_components", "covariance_type", "tol", "reg_covar",
@@ -162,8 +169,17 @@ class GaussianMixture:
         check_finite_array(X, "Data contains NaN or Inf values")
         mesh = self._resolve_mesh()
         data_shards, _ = mesh_shape(mesh)
+        # The EM pass wants SMALLER (chunk, k) tiles than the K-Means
+        # pass: its tile feeds exp + 4 matmuls, and past ~2^23 tile
+        # elements XLA materializes the logp tile in HBM between
+        # fusions.  Measured (v5e, 2M x 128, k=256): chunk 131072 (the
+        # K-Means budget) runs 28.6 ms/iter vs 14.2 at 32768 — 2x from
+        # chunk sizing alone (3% spreads on both).  Small-k shapes
+        # measured too noisy to justify changing their row cap, so only
+        # the element budget shrinks (2^25 -> 2^23).
         chunk = self.chunk_size or choose_chunk_size(
-            -(-X.shape[0] // data_shards), self.n_components, X.shape[1])
+            -(-X.shape[0] // data_shards), self.n_components, X.shape[1],
+            budget_elems=1 << 23)
         return to_device(X, mesh, chunk, self.dtype,
                          sample_weight=sample_weight)
 
@@ -432,6 +448,13 @@ class GaussianMixture:
 
     def predict(self, X) -> np.ndarray:
         return self._posterior(X)[0]
+
+    def fit_predict(self, X, y=None, *, sample_weight=None) -> np.ndarray:
+        """Fit and return component labels for X (sklearn convention:
+        ``y`` is ignored).  X is placed on device ONCE and shared by the
+        fit and the labeling pass."""
+        ds = self._dataset(X, sample_weight)
+        return self.fit(ds).predict(ds)
 
     def predict_proba(self, X) -> np.ndarray:
         return np.exp(self._posterior(X)[1])

@@ -74,6 +74,14 @@ def test_loglik_monotone_nondecreasing():
     assert np.all(np.diff(ll) >= -1e-3 * np.abs(ll[:-1])), ll
 
 
+def test_fit_predict_matches_fit_then_predict():
+    X, _ = _data(n=1_000, seed=19)
+    kw = dict(n_components=3, max_iter=8, seed=2)
+    labels = GaussianMixture(**kw).fit_predict(X)
+    ref = GaussianMixture(**kw).fit(X).predict(X)
+    np.testing.assert_array_equal(labels, ref)
+
+
 def test_posterior_rows_sum_to_one_and_score():
     X, _ = _data(seed=4)
     gm = GaussianMixture(n_components=3, max_iter=10, seed=2).fit(X)
