@@ -1,0 +1,205 @@
+"""Fused Pallas/Mosaic E-step kernel for the diagonal GMM — a REJECTED
+r3 experiment, kept with its measurements (repo policy: rejected
+alternatives stay on the record).
+
+Verdict (v5e, 2026-07-30): numerically EXACT — matches the XLA oracle
+in interpret mode and on hardware (incl. HIGHEST-precision moments,
+which Mosaic honors: measured 2e-7 rel err vs 1.1e-3 at default
+bf16-product rate) — but catastrophically slow as scheduled here:
+**3.35 s warm for one 500k x 128, k=256 E-step vs ~3.5 ms for the XLA
+scan path** (scalar-transfer-synced single-dispatch timing).  The
+naive sequential grid with fixed-index (k_pad, d_pad) accumulator
+blocks and 3-pass HIGHEST scatter matmuls serializes Mosaic's
+pipeline; closing a ~1000x gap needs the same multi-round scheduling
+investment the r2 K-Means kernel got (ping-pong scratch, software
+pipelining, phase overlap) for a bounded prize — the XLA EM step is
+already within ~2x of its matmul+exp floor after the r3 chunk-budget
+fix (docs/PERFORMANCE.md "The mixture family").  Parked here.
+
+Original design notes follow.
+
+One kernel per data shard computes the ENTIRE E-step contribution —
+log-density matmuls, max-subtracted softmax, and the three
+responsibility-weighted accumulators — without ever materializing the
+(n, k) log-density tile in HBM.  The XLA scan path round-trips that
+tile between the matmul, softmax, and moment stages (the r3 chunk-size
+finding, docs/PERFORMANCE.md: past ~2^23 tile elements the stages
+de-fuse); here ``logp`` lives only in VMEM for the current row tile.
+
+Formulation (see parallel.gmm_step): with a = 1/sigma^2, b = mu*a, and
+the per-component constant
+
+    c1_k = log pi_k - 0.5*(d*log 2pi + sum_d log sigma^2 + sum_d mu^2 a),
+
+the weighted log joint is  logp = c1 + x@b.T - 0.5*(x*x)@a.T  — two
+MXU matmuls per row tile.  Per tile: m = rowmax(logp),
+p = exp(logp - m), r = p * w / rowsum(p), then
+
+    rsum += colsum(r)          (1, k)
+    s1   += r.T @ x            (k, d)   [Precision.HIGHEST]
+    s2   += r.T @ (x*x)        (k, d)   [Precision.HIGHEST]
+    ll   += sum(w * (m + log rowsum(p)))
+
+accumulated across the sequential row grid in VMEM.  The two moment
+matmuls run at HIGHEST precision — Mosaic honors it (measured 2e-7
+rel err vs 1.1e-3 for the default bf16-rate products), which is what
+keeps ``S2/R - mu^2`` from cancelling for clusters offset from the
+centering shift (the r3 hardware finding, tests/test_gmm_tpu.py).
+
+Centering: the kernel subtracts the caller's ``shift`` row in
+registers, so the means/moments are in the centered frame exactly like
+the XLA path.
+
+Scope: single component block (no model-axis sharding — the softmax
+normalizer would need a cross-shard psum mid-kernel); the whole
+(k_pad, d_pad) parameter set plus one (tile_n, k_pad) logp tile must
+fit the VMEM budget (``pallas_estep_supported``).  Padding components
+carry ``c1 = -_PAD_BIG`` so they never receive responsibility; padding
+rows carry ``w = 0`` so they contribute to nothing.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_PAD_BIG = 1e30
+_VMEM_LIMIT = 100 * 1024 * 1024
+
+
+def _round_up(a: int, b: int) -> int:
+    return -(-a // b) * b
+
+
+def _tile_n_for(d_pad: int, k_pad: int) -> int:
+    """Row-tile height: target ~2^21 logp elements, power of two,
+    128..2048 (the r3 chunk finding scaled to VMEM residency)."""
+    t = max(128, min(2048, (1 << 21) // max(k_pad, d_pad)))
+    return 1 << (t.bit_length() - 1)
+
+
+def _vmem_estimate(tile_n: int, d_pad: int, k_pad: int) -> int:
+    tiles = tile_n * (2 * d_pad + 2 * k_pad + 8) * 4   # x, x2, logp, p
+    params = (3 * k_pad * d_pad + 2 * k_pad) * 4       # a, b, outs, c1
+    outs = 2 * k_pad * d_pad * 4 + k_pad * 4
+    return tiles + params + outs
+
+
+def pallas_estep_supported(n: int, d: int, k: int) -> bool:
+    """Can the fused kernel run this shape inside the VMEM budget?"""
+    d_pad = _round_up(d, 128)
+    k_pad = _round_up(k, 128)
+    tile_n = _tile_n_for(d_pad, k_pad)
+    return _vmem_estimate(tile_n, d_pad, k_pad) <= _VMEM_LIMIT
+
+
+def _kernel(x_ref, w_ref, shift_ref, a_ref, b_ref, c1_ref,
+            rsum_ref, s1_ref, s2_ref, ll_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        rsum_ref[:, :] = jnp.zeros_like(rsum_ref)
+        s1_ref[:, :] = jnp.zeros_like(s1_ref)
+        s2_ref[:, :] = jnp.zeros_like(s2_ref)
+        ll_ref[:, :] = jnp.zeros_like(ll_ref)
+
+    x = x_ref[:, :] - shift_ref[:, :]              # centered frame
+    w = w_ref[:, :]
+    x2 = x * x
+    logp = (c1_ref[:, :]
+            + lax.dot_general(x, b_ref[:, :], (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+            - 0.5 * lax.dot_general(x2, a_ref[:, :],
+                                    (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32))
+    m = jnp.max(logp, axis=1, keepdims=True)       # (tile_n, 1)
+    p = jnp.exp(logp - m)
+    denom = jnp.sum(p, axis=1, keepdims=True)      # (tile_n, 1)
+    r = p * (w / denom)                            # weighted resp
+    hi = lax.Precision.HIGHEST
+    rsum_ref[:, :] += jnp.sum(r, axis=0, keepdims=True)
+    s1_ref[:, :] += lax.dot_general(r, x, (((0,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32,
+                                    precision=hi)
+    s2_ref[:, :] += lax.dot_general(r, x2, (((0,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32,
+                                    precision=hi)
+    ll_ref[:, :] += jnp.sum(w * (m + jnp.log(denom)), keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_estep(points: jax.Array, weights: jax.Array, shift: jax.Array,
+                 means_c: jax.Array, inv_var: jax.Array,
+                 log_det: jax.Array, log_weights: jax.Array,
+                 *, interpret: bool = False
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """(resp_sum (k,), xsum (k, d), x2sum (k, d), loglik ()) for one
+    shard — the fused equivalent of ``gmm_step._scan_estats`` at
+    ``model_shards == 1``.  ``means_c`` must already be centered by
+    ``shift``; padded rows must carry ``weights == 0``."""
+    n, d = points.shape
+    k = means_c.shape[0]
+    f32 = jnp.float32
+    d_pad = _round_up(d, 128)
+    k_pad = _round_up(k, 128)
+    tile_n = _tile_n_for(d_pad, k_pad)
+    n_pad = _round_up(n, tile_n)
+
+    x = points.astype(f32)
+    w = weights.astype(f32)
+    if n_pad != n:
+        x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
+        w = jnp.pad(w, (0, n_pad - n))
+    if d_pad != d:
+        x = jnp.pad(x, ((0, 0), (0, d_pad - d)))
+
+    a = jnp.pad(inv_var.astype(f32), ((0, k_pad - k), (0, d_pad - d)))
+    mu = means_c.astype(f32)
+    b = jnp.pad(mu * inv_var.astype(f32),
+                ((0, k_pad - k), (0, d_pad - d)))
+    c1 = (log_weights.astype(f32)
+          - 0.5 * (d * np.log(2.0 * np.pi) + log_det.astype(f32)
+                   + jnp.sum(mu * mu * inv_var.astype(f32), axis=1)))
+    c1 = jnp.pad(c1, (0, k_pad - k), constant_values=-_PAD_BIG)[None, :]
+    shift_row = jnp.pad(shift.astype(f32), (0, d_pad - d))[None, :]
+
+    n_tiles = n_pad // tile_n
+    zero = np.int32(0)
+    nmap = lambda i: (i, zero)
+    fixed = lambda i: (zero, zero)
+    rsum, s1, s2, ll = pl.pallas_call(
+        _kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((tile_n, d_pad), nmap, memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_n, 1), nmap, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d_pad), fixed, memory_space=pltpu.VMEM),
+            pl.BlockSpec((k_pad, d_pad), fixed, memory_space=pltpu.VMEM),
+            pl.BlockSpec((k_pad, d_pad), fixed, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_pad), fixed, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, k_pad), fixed, memory_space=pltpu.VMEM),
+            pl.BlockSpec((k_pad, d_pad), fixed, memory_space=pltpu.VMEM),
+            pl.BlockSpec((k_pad, d_pad), fixed, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), fixed, memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, k_pad), f32),
+            jax.ShapeDtypeStruct((k_pad, d_pad), f32),
+            jax.ShapeDtypeStruct((k_pad, d_pad), f32),
+            jax.ShapeDtypeStruct((1, 1), f32),
+        ],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT),
+    )(x, w[:, None], shift_row, a, b, c1)
+    return (rsum[0, :k], s1[:k, :d], s2[:k, :d], ll[0, 0])

@@ -78,20 +78,26 @@ def _sharded_from_source(read_rows, n: int, d: int, mesh: Mesh,
 
 
 def _resolve_chunk(n: int, d: int, k_hint: int, mesh: Mesh,
-                   chunk_size: Optional[int]) -> int:
+                   chunk_size: Optional[int],
+                   budget_elems: Optional[int] = None) -> int:
     data_shards, model_shards = mesh_shape(mesh)
+    kw = {} if budget_elems is None else {"budget_elems": budget_elems}
     return chunk_size or choose_chunk_size(
-        -(-n // data_shards), max(k_hint, model_shards), d)
+        -(-n // data_shards), max(k_hint, model_shards), d, **kw)
 
 
 def from_npy(path, mesh: Mesh, *, chunk_size: Optional[int] = None,
              dtype=np.float32, k_hint: int = 16,
+             budget_elems: Optional[int] = None,
              sample_weight: Optional[np.ndarray] = None) -> ShardedDataset:
     """Shard a 2-D ``.npy`` file onto the mesh without loading it whole.
 
     ``k_hint`` feeds the automatic chunk-size choice (the (chunk, k)
     distance tile is the working set); pass the k you plan to fit, or set
-    ``chunk_size`` explicitly.  With ``mesh=None`` this falls back to a
+    ``chunk_size`` explicitly.  ``budget_elems`` overrides the per-tile
+    element budget — pass ``models.gmm.EM_CHUNK_BUDGET`` when the dataset
+    is destined for a ``GaussianMixture`` fit (the EM pass wants smaller
+    tiles than K-Means; docs/PERFORMANCE.md).  With ``mesh=None`` this falls back to a
     plain in-memory upload (single-device paths have no per-shard slicing
     to exploit).
     """
@@ -104,7 +110,7 @@ def from_npy(path, mesh: Mesh, *, chunk_size: Optional[int] = None,
         return to_device(np.asarray(mm, dtype=dtype), None,
                          chunk_size or choose_chunk_size(n, k_hint, d),
                          dtype, sample_weight=sample_weight)
-    chunk = _resolve_chunk(n, d, k_hint, mesh, chunk_size)
+    chunk = _resolve_chunk(n, d, k_hint, mesh, chunk_size, budget_elems)
 
     def read_rows(lo: int, hi: int) -> np.ndarray:
         return np.asarray(mm[lo:hi], dtype=dtype)
@@ -116,6 +122,7 @@ def from_npy(path, mesh: Mesh, *, chunk_size: Optional[int] = None,
 def from_raw(path, shape: Tuple[int, int], mesh: Mesh, *,
              file_dtype=np.float32, chunk_size: Optional[int] = None,
              dtype=np.float32, k_hint: int = 16,
+             budget_elems: Optional[int] = None,
              offset: int = 0,
              sample_weight: Optional[np.ndarray] = None) -> ShardedDataset:
     """Shard a headerless binary file of ``shape`` row-major ``file_dtype``
@@ -128,7 +135,7 @@ def from_raw(path, shape: Tuple[int, int], mesh: Mesh, *,
         return to_device(np.asarray(mm, dtype=dtype), None,
                          chunk_size or choose_chunk_size(n, k_hint, d),
                          dtype, sample_weight=sample_weight)
-    chunk = _resolve_chunk(n, d, k_hint, mesh, chunk_size)
+    chunk = _resolve_chunk(n, d, k_hint, mesh, chunk_size, budget_elems)
 
     def read_rows(lo: int, hi: int) -> np.ndarray:
         return np.asarray(mm[lo:hi], dtype=dtype)

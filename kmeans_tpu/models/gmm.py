@@ -54,6 +54,11 @@ _STEP_CACHE: dict = {}
 # responsibilities are exactly one-hot (sklearn inits from one-hot
 # KMeans-label responsibilities too).
 _HARD_INV_VAR = 1e6
+# Per-tile element budget for EM chunking (measured 2x vs the K-Means
+# 2^25 budget at k=256-class shapes, docs/PERFORMANCE.md).  Exported so
+# data-loader users can request EM-sized chunks:
+# ``data.io.from_npy(..., budget_elems=EM_CHUNK_BUDGET)``.
+EM_CHUNK_BUDGET = 1 << 23
 
 # Weighted-mean pass for the centering shift (GSPMD: XLA inserts the
 # cross-shard collectives for the sharded matvec itself).  The zero-
@@ -91,11 +96,12 @@ class GaussianMixture:
     ``KMeans(host_loop=False)``).
 
     Chunking note: raw-array inputs are chunked with the EM-specific
-    2^23-element budget (docs/PERFORMANCE.md — the K-Means budget costs
-    ~2x per EM iteration at k=256-class shapes).  A pre-built
-    ``ShardedDataset`` keeps ITS chunk (its padding committed to it);
-    when loading data yourself for a mixture fit, pass the dataset
-    loader a chunk near ``2^23 / n_components`` rows.
+    ``EM_CHUNK_BUDGET`` (2^23 elements; docs/PERFORMANCE.md — the
+    K-Means budget costs ~2x per EM iteration at k=256-class shapes).
+    A pre-built ``ShardedDataset`` keeps ITS chunk (its padding
+    committed to it); when loading data yourself for a mixture fit,
+    pass the loader ``budget_elems=EM_CHUNK_BUDGET``
+    (``data.io.from_npy``/``from_raw`` forward it).
     """
 
     _PARAM_NAMES = ("n_components", "covariance_type", "tol", "reg_covar",
@@ -170,7 +176,7 @@ class GaussianMixture:
         mesh = self._resolve_mesh()
         data_shards, _ = mesh_shape(mesh)
         # The EM pass wants SMALLER (chunk, k) tiles than the K-Means
-        # pass: its tile feeds exp + 4 matmuls, and past ~2^23 tile
+        # pass: its tile feeds exp + 4 matmuls, and past ~EM_CHUNK_BUDGET
         # elements XLA materializes the logp tile in HBM between
         # fusions.  Measured (v5e, 2M x 128, k=256): chunk 131072 (the
         # K-Means budget) runs 28.6 ms/iter vs 14.2 at 32768 — 2x from
@@ -179,7 +185,7 @@ class GaussianMixture:
         # the element budget shrinks (2^25 -> 2^23).
         chunk = self.chunk_size or choose_chunk_size(
             -(-X.shape[0] // data_shards), self.n_components, X.shape[1],
-            budget_elems=1 << 23)
+            budget_elems=EM_CHUNK_BUDGET)
         return to_device(X, mesh, chunk, self.dtype,
                          sample_weight=sample_weight)
 
