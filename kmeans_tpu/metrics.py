@@ -7,15 +7,16 @@ fixed-size chunks under ``lax.scan``, distances in the matmul form so the
 O(n²D) / O(nkD) work lands on the MXU, per-cluster reductions as one-hot
 matmuls instead of segment gathers.
 
-All functions take host arrays, run jitted on the default backend, and are
-validated against scikit-learn's implementations in
+All functions take host arrays, run as ``shard_map`` passes with the row
+axis sharded over the mesh's data axis (``mesh=None`` builds one over
+every visible device — a 1-device mesh is the plain single-chip case),
+and are validated against scikit-learn's implementations in
 ``tests/test_metrics.py`` (sklearn stays a test-only oracle, the
 reference's own policy — README.md:13).
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
@@ -57,65 +58,118 @@ def _pad_chunks(X, labels, chunk: int):
     pad = (-n) % chunk
     Xp = np.pad(X, ((0, pad), (0, 0)))
     # Padding rows get label -1: their one-hot row is all-zero, so they
-    # contribute to nothing.
+    # contribute to nothing.  Returned as HOST arrays — callers place
+    # them exactly once (sharded) per score.
     lp = np.pad(labels, (0, pad), constant_values=-1)
-    return jnp.asarray(Xp), jnp.asarray(lp), n
+    return Xp, lp, n
 
 
-@functools.partial(jax.jit, static_argnames=("k", "chunk"))
-def _cluster_moments(Xp, lp, k: int, chunk: int):
-    """Per-cluster (count, coordinate-sum) in one chunked pass."""
-    d = Xp.shape[1]
-    xs = (Xp.reshape(-1, chunk, d), lp.reshape(-1, chunk))
-
-    def body(carry, args):
-        sums, counts = carry
-        xc, lc = args
-        onehot = (lc[:, None] == jnp.arange(k)[None, :]).astype(jnp.float32)
-        sums = sums + jnp.einsum("ck,cd->kd", onehot, xc)
-        counts = counts + jnp.sum(onehot, axis=0)
-        return (sums, counts), None
-
-    (sums, counts), _ = lax.scan(
-        body, (jnp.zeros((k, d)), jnp.zeros((k,))), xs)
-    return sums, counts
+# Built shard_map passes for the O(n*k*D) reductions, keyed like
+# _SIL_CACHE — the O(n) row axis shards over the mesh's data axis, so
+# these scale exactly like the training step (r3: previously
+# single-device jits).
+_MOM_CACHE: dict = {}
 
 
-@functools.partial(jax.jit, static_argnames=("k", "chunk"))
-def _scatter_to_centroids(Xp, lp, centroids, k: int, chunk: int):
+def _sharded_reduction(mesh, k: int, chunk: int, kind: str):
+    from jax.sharding import PartitionSpec as P
+    from kmeans_tpu.parallel.mesh import DATA_AXIS
+    key = (mesh, k, chunk, kind)
+    if key in _MOM_CACHE:
+        return _MOM_CACHE[key]
+
+    if kind == "moments":
+        def run(xrows, lrows):
+            d = xrows.shape[1]
+            xs = (xrows.reshape(-1, chunk, d), lrows.reshape(-1, chunk))
+
+            def body(carry, args):
+                sums, counts = carry
+                xc, lc = args
+                onehot = (lc[:, None] == jnp.arange(k)[None, :]) \
+                    .astype(jnp.float32)
+                return (sums + jnp.einsum("ck,cd->kd", onehot, xc),
+                        counts + jnp.sum(onehot, axis=0)), None
+
+            a, b = lax.scan(body, (jnp.zeros((k, d)), jnp.zeros((k,))),
+                            xs)[0]
+            return lax.psum(a, DATA_AXIS), lax.psum(b, DATA_AXIS)
+
+        in_specs = (P(DATA_AXIS, None), P(DATA_AXIS))
+        out_specs = (P(None, None), P(None))
+    else:                # per-cluster distance sums to own centroid
+        def run(xrows, lrows, centroids):
+            d = xrows.shape[1]
+            xs = (xrows.reshape(-1, chunk, d), lrows.reshape(-1, chunk))
+
+            def body(carry, args):
+                s1, s2 = carry
+                xc, lc = args
+                d2 = pairwise_sq_dists(xc, centroids)      # (chunk, k)
+                onehot = (lc[:, None] == jnp.arange(k)[None, :]) \
+                    .astype(jnp.float32)
+                own_d2 = jnp.sum(d2 * onehot, axis=1)
+                return (s1 + jnp.einsum("ck,c->k", onehot,
+                                        jnp.sqrt(own_d2)),
+                        s2 + jnp.einsum("ck,c->k", onehot, own_d2)), None
+
+            a, b = lax.scan(body, (jnp.zeros((k,)), jnp.zeros((k,))),
+                            xs)[0]
+            return lax.psum(a, DATA_AXIS), lax.psum(b, DATA_AXIS)
+
+        in_specs = (P(DATA_AXIS, None), P(DATA_AXIS), P(None, None))
+        out_specs = (P(None), P(None))
+
+    mapped = jax.shard_map(run, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
+    _MOM_CACHE[key] = jax.jit(mapped)
+    return _MOM_CACHE[key]
+
+
+def _place_rows(mesh, Xp, lp):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from kmeans_tpu.parallel.mesh import DATA_AXIS
+    return (jax.device_put(np.asarray(Xp),
+                           NamedSharding(mesh, P(DATA_AXIS, None))),
+            jax.device_put(np.asarray(lp),
+                           NamedSharding(mesh, P(DATA_AXIS))))
+
+
+def _cluster_moments(mesh, xr, lr, k: int, chunk: int):
+    """Per-cluster (coordinate-sum, count) from PLACED rows."""
+    return _sharded_reduction(mesh, k, chunk, "moments")(xr, lr)
+
+
+def _scatter_to_centroids(mesh, xr, lr, centroids, k: int, chunk: int):
     """Per-cluster sums of EUCLIDEAN distance and squared distance from
-    each member to its own centroid — one chunked pass."""
-    d = Xp.shape[1]
-    xs = (Xp.reshape(-1, chunk, d), lp.reshape(-1, chunk))
-
-    def body(carry, args):
-        s1, s2 = carry
-        xc, lc = args
-        d2 = pairwise_sq_dists(xc, centroids)              # (chunk, k)
-        onehot = (lc[:, None] == jnp.arange(k)[None, :]).astype(jnp.float32)
-        own_d2 = jnp.sum(d2 * onehot, axis=1)              # (chunk,)
-        dist = jnp.sqrt(own_d2)
-        s1 = s1 + jnp.einsum("ck,c->k", onehot, dist)
-        s2 = s2 + jnp.einsum("ck,c->k", onehot, own_d2)
-        return (s1, s2), None
-
-    (s1, s2), _ = lax.scan(body, (jnp.zeros((k,)), jnp.zeros((k,))), xs)
-    return s1, s2
+    each member to its own centroid, from PLACED rows."""
+    return _sharded_reduction(mesh, k, chunk, "scatter")(xr, lr, centroids)
 
 
-def davies_bouldin_score(X, labels) -> float:
-    """Davies-Bouldin index (lower is better).
+def _mesh_and_chunk(X, mesh):
+    from kmeans_tpu.parallel.mesh import make_mesh, mesh_shape
+    if mesh is None:
+        mesh = make_mesh()
+    data_shards, _ = mesh_shape(mesh)
+    chunk = min(2048, max(256, -(-X.shape[0] // data_shards)))
+    return mesh, data_shards, chunk
+
+
+def davies_bouldin_score(X, labels, *, mesh=None) -> float:
+    """Davies-Bouldin index (lower is better), row-sharded over the mesh.
 
     DB = mean_i max_{j!=i} (s_i + s_j) / d(c_i, c_j) with s_i the mean
     Euclidean distance of cluster i's members to its centroid.
     """
     X, labels, k = _as_arrays(X, labels)
-    chunk = min(2048, max(256, X.shape[0]))
-    Xp, lp, n = _pad_chunks(X, labels, chunk)
-    sums, counts = _cluster_moments(Xp, lp, k, chunk)
+    mesh, data_shards, chunk = _mesh_and_chunk(X, mesh)
+    Xp, lp, n = _pad_chunks(X, labels, data_shards * chunk)
+    xr, lr = _place_rows(mesh, Xp, lp)          # placed ONCE, reused
+    sums, counts = _cluster_moments(mesh, xr, lr, k, chunk)
     counts = np.asarray(counts, np.float64)
     centroids = np.asarray(sums, np.float64) / np.maximum(counts, 1.0)[:, None]
-    s1, _ = _scatter_to_centroids(Xp, lp, jnp.asarray(centroids, jnp.float32),
+    s1, _ = _scatter_to_centroids(mesh, xr, lr,
+                                  jnp.asarray(centroids, jnp.float32),
                                   k, chunk)
     scatter = np.asarray(s1, np.float64) / np.maximum(counts, 1.0)
     cd = np.sqrt(np.maximum(np.asarray(
@@ -127,17 +181,20 @@ def davies_bouldin_score(X, labels) -> float:
     return float(np.mean(ratio.max(axis=1)))
 
 
-def calinski_harabasz_score(X, labels) -> float:
+def calinski_harabasz_score(X, labels, *, mesh=None) -> float:
     """Calinski-Harabasz index / variance-ratio criterion (higher is
-    better): (between-group SS / (k-1)) / (within-group SS / (n-k))."""
+    better): (between-group SS / (k-1)) / (within-group SS / (n-k)).
+    Row-sharded over the mesh."""
     X, labels, k = _as_arrays(X, labels)
-    chunk = min(2048, max(256, X.shape[0]))
-    Xp, lp, n = _pad_chunks(X, labels, chunk)
-    sums, counts = _cluster_moments(Xp, lp, k, chunk)
+    mesh, data_shards, chunk = _mesh_and_chunk(X, mesh)
+    Xp, lp, n = _pad_chunks(X, labels, data_shards * chunk)
+    xr, lr = _place_rows(mesh, Xp, lp)          # placed ONCE, reused
+    sums, counts = _cluster_moments(mesh, xr, lr, k, chunk)
     counts = np.asarray(counts, np.float64)
     sums = np.asarray(sums, np.float64)
     centroids = sums / np.maximum(counts, 1.0)[:, None]
-    _, s2 = _scatter_to_centroids(Xp, lp, jnp.asarray(centroids, jnp.float32),
+    _, s2 = _scatter_to_centroids(mesh, xr, lr,
+                                  jnp.asarray(centroids, jnp.float32),
                                   k, chunk)
     wss = float(np.sum(np.asarray(s2, np.float64)))
     mean = sums.sum(axis=0) / n
@@ -223,8 +280,7 @@ def silhouette_samples(X, labels, *, mesh=None) -> np.ndarray:
     clusters score 0 (sklearn convention).  ``mesh=None`` builds a
     data-axis mesh over every visible device; the O(n^2 D) pass is
     row-sharded across it."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    from kmeans_tpu.parallel.mesh import DATA_AXIS, make_mesh, mesh_shape
+    from kmeans_tpu.parallel.mesh import make_mesh, mesh_shape
     X, labels, k = _as_arrays(X, labels)
     if mesh is None:
         mesh = make_mesh()
@@ -238,9 +294,7 @@ def silhouette_samples(X, labels, *, mesh=None) -> np.ndarray:
     counts = jnp.asarray(np.bincount(labels, minlength=k)
                          .astype(np.float32))
     fn = _silhouette_mesh_fn(mesh, k, chunk, col_block)
-    xr = jax.device_put(np.asarray(Xr),
-                        NamedSharding(mesh, P(DATA_AXIS, None)))
-    lrp = jax.device_put(np.asarray(lr), NamedSharding(mesh, P(DATA_AXIS)))
+    xr, lrp = _place_rows(mesh, Xr, lr)
     s = fn(xr, lrp, Xc, lc, counts)
     return np.asarray(s, dtype=np.float64)[:n]
 

@@ -52,6 +52,16 @@ def test_silhouette_mesh_invariance(labeled_blobs, mesh1, mesh8):
     np.testing.assert_allclose(a, b, atol=1e-6)
 
 
+def test_db_ch_mesh_invariance(labeled_blobs, mesh1, mesh8):
+    """Davies-Bouldin / Calinski-Harabasz row-shard over the mesh too
+    (r3): 1- and 8-device results agree."""
+    X, labels = labeled_blobs
+    assert davies_bouldin_score(X, labels, mesh=mesh1) == pytest.approx(
+        davies_bouldin_score(X, labels, mesh=mesh8), rel=1e-6)
+    assert calinski_harabasz_score(X, labels, mesh=mesh1) == pytest.approx(
+        calinski_harabasz_score(X, labels, mesh=mesh8), rel=1e-6)
+
+
 def test_davies_bouldin_matches_sklearn(labeled_blobs):
     X, labels = labeled_blobs
     ours = davies_bouldin_score(X, labels)
