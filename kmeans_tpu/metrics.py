@@ -146,12 +146,12 @@ def _scatter_to_centroids(mesh, xr, lr, centroids, k: int, chunk: int):
     return _sharded_reduction(mesh, k, chunk, "scatter")(xr, lr, centroids)
 
 
-def _mesh_and_chunk(X, mesh):
+def _mesh_and_chunk(X, mesh, lo: int = 256, hi: int = 2048):
     from kmeans_tpu.parallel.mesh import make_mesh, mesh_shape
     if mesh is None:
         mesh = make_mesh()
     data_shards, _ = mesh_shape(mesh)
-    chunk = min(2048, max(256, -(-X.shape[0] // data_shards)))
+    chunk = min(hi, max(lo, -(-X.shape[0] // data_shards)))
     return mesh, data_shards, chunk
 
 
@@ -280,12 +280,8 @@ def silhouette_samples(X, labels, *, mesh=None) -> np.ndarray:
     clusters score 0 (sklearn convention).  ``mesh=None`` builds a
     data-axis mesh over every visible device; the O(n^2 D) pass is
     row-sharded across it."""
-    from kmeans_tpu.parallel.mesh import make_mesh, mesh_shape
     X, labels, k = _as_arrays(X, labels)
-    if mesh is None:
-        mesh = make_mesh()
-    data_shards, _ = mesh_shape(mesh)
-    chunk = min(1024, max(128, -(-X.shape[0] // data_shards)))
+    mesh, data_shards, chunk = _mesh_and_chunk(X, mesh, lo=128, hi=1024)
     col_block = min(4096, max(256, X.shape[0]))
     # Rows pad to a whole number of chunks per shard; columns to a whole
     # number of blocks.  Padding rows carry label -1 -> all-zero one-hot.

@@ -88,3 +88,23 @@ def test_from_raw_matches_npy(tmp_path, mesh8):
     km = KMeans(k=4, seed=0, verbose=False).fit(ds)
     assert km.centroids.shape == (4, 4)
     assert np.all(np.isfinite(km.centroids))
+
+
+def test_budget_elems_requests_em_sized_chunks(tmp_path, mesh8):
+    """r3: loaders forward ``budget_elems`` so datasets destined for a
+    GaussianMixture fit get EM-sized chunks (gmm.EM_CHUNK_BUDGET).
+    The fixture is large enough (40k rows/shard) that the EM budget
+    MUST yield a strictly smaller chunk than the K-Means default."""
+    from kmeans_tpu.models.gmm import EM_CHUNK_BUDGET
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(320_000, 4)).astype(np.float32)
+    path = tmp_path / "big.npy"
+    np.save(path, X)
+    default = from_npy(path, mesh8, k_hint=256)
+    em = from_npy(path, mesh8, k_hint=256, budget_elems=EM_CHUNK_BUDGET)
+    assert em.chunk < default.chunk, (em.chunk, default.chunk)
+    assert em.chunk <= EM_CHUNK_BUDGET // 256
+    # Dataset content is identical either way.
+    np.testing.assert_allclose(np.asarray(em.points)[: em.n],
+                               np.asarray(default.points)[: default.n],
+                               rtol=0)
