@@ -175,6 +175,38 @@ def test_multi_restart_empty_refill_matches_host(mesh8):
     np.testing.assert_allclose(dev.centroids, host.centroids, atol=1e-9)
 
 
+def test_device_loop_resume_draws_same_refill_sequence(mesh8):
+    """A fit interrupted and resumed must draw the SAME empty-refill
+    rows an uninterrupted fit would: the per-iteration seed schedule is
+    keyed by ABSOLUTE iteration ([seed, iter+1]), and the resumed
+    program receives the offset schedule as a traced argument."""
+    rng = np.random.default_rng(21)
+    X = rng.normal(size=(240, 3))
+    init = np.concatenate(
+        [X[:2], 1e3 * np.arange(1, 4, dtype=float)[:, None]
+         + np.zeros((3, 3))])
+    kw = dict(k=5, seed=17, init=init, empty_cluster="resample",
+              compute_sse=True, tolerance=1e-12, mesh=mesh8,
+              dtype=np.float64, host_loop=False, verbose=False)
+
+    def hostless(km):
+        ds = km.cache(X)
+        ds._host = None
+        ds._host_weights = None
+        return ds
+
+    full = KMeans(max_iter=9, **kw)
+    full.fit(hostless(full))
+
+    part = KMeans(max_iter=4, **kw)
+    part.fit(hostless(part))
+    part.max_iter = 9
+    part.fit(hostless(part), resume=True)
+
+    assert part.iterations_run == full.iterations_run
+    np.testing.assert_allclose(part.centroids, full.centroids, atol=1e-9)
+
+
 def test_device_loop_early_convergence(mesh8):
     X, _ = make_blobs(n_samples=2000, centers=3, n_features=2,
                       random_state=0, cluster_std=0.3)
