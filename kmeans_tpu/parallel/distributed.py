@@ -761,7 +761,8 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
 
 
 def make_minibatch_step_fn(mesh: Mesh, *, batch_per_shard: int,
-                           mode: str = "matmul") -> Callable:
+                           mode: str = "matmul",
+                           n_candidates: int = 0) -> Callable:
     """Build the fused ON-DEVICE mini-batch iteration:
     (points, weights, centroids, key) -> StepStats of a freshly-sampled
     batch — sampling AND statistics in ONE dispatch.
@@ -793,15 +794,19 @@ def make_minibatch_step_fn(mesh: Mesh, *, batch_per_shard: int,
 
     Returned stats are replicated like ``make_step_fn``'s (sums, counts,
     sse over the batch; farthest/per-cluster elided — the Sculley update
-    uses none of them).
+    uses none of them).  ``n_candidates > 0`` additionally returns
+    ``n_candidates`` uniformly-drawn rows of the batch (plus a validity
+    mask) for the host-side low-count reassignment decision
+    (``_batch_candidates``); the return type becomes
+    (stats, cand_rows, cand_valid).
     """
     data_shards, model_shards = mesh_shape(mesh)
 
     def step(points, weights, centroids_block, key, iteration):
         k_local, d = centroids_block.shape
         acc = _accum_dtype(points.dtype)
-        bx, bw = _sample_batch(points, weights,
-                               jax.random.fold_in(key, iteration),
+        base_i = jax.random.fold_in(key, iteration)
+        bx, bw = _sample_batch(points, weights, base_i,
                                batch_per_shard, data_shards)
         st = _local_stats(bx, bw, centroids_block,
                           chunk_size=batch_per_shard, mode=mode,
@@ -818,15 +823,22 @@ def make_minibatch_step_fn(mesh: Mesh, *, batch_per_shard: int,
             jnp.zeros((k,), st.counts.dtype), st.counts, (off,)), axes)
         sse = lax.psum(st.sse, axes) / model_shards
         zero = jnp.zeros((), acc)
-        return StepStats(sums, counts, sse, zero,
-                         jnp.zeros((d,), acc), jnp.zeros((k,), acc))
+        stats = StepStats(sums, counts, sse, zero,
+                          jnp.zeros((d,), acc), jnp.zeros((k,), acc))
+        if n_candidates <= 0:
+            return stats
+        cand_rows, cand_valid = _batch_candidates(
+            bx, bw, base_i, n_candidates, data_shards)
+        return stats, cand_rows, cand_valid
 
+    stats_spec = StepStats(P(None, None), P(None), P(), P(), P(None),
+                           P(None))
     mapped = jax.shard_map(
         step, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(MODEL_AXIS, None),
                   P(None), P()),
-        out_specs=StepStats(P(None, None), P(None), P(), P(), P(None),
-                            P(None)),
+        out_specs=stats_spec if n_candidates <= 0
+        else (stats_spec, P(None, None), P(None)),
         check_vma=False)
     return jax.jit(mapped)
 
@@ -849,9 +861,82 @@ def _sample_batch(points, weights, key, batch_per_shard: int,
     return points[idx], weights[idx]
 
 
+def _batch_candidates(bx, bw, base_i, n_cand: int, data_shards: int):
+    """Draw up to ``n_cand`` distinct positive-weight rows from the CURRENT
+    global mini-batch, uniformly, with the result replicated on every shard:
+    a seeded Gumbel-top-k per data shard, then a global top-k over the
+    gathered per-shard winners (exact — any global top-``n_cand`` element is
+    in its own shard's top ``n_cand``).
+
+    These rows seed sklearn-style low-count center reassignment — the
+    mini-batch analogue of the reference's empty-cluster resample
+    (kmeans_spark.py:190-204), which draws replacement centers from the
+    data when a center stops receiving points.
+
+    Key discipline: ``base_i`` is the iteration's batch key
+    (``fold_in(key, iteration)``); each shard folds in
+    ``data_shards + d_idx`` — disjoint from the batch draw's
+    ``fold_in(base_i, d_idx)`` stream (d_idx < data_shards) and a pure
+    function of (seed, iteration, shard), so the per-iteration and
+    one-dispatch engines draw bit-identical candidates and resumes
+    continue the exact sequence.  Like the batch draw, the key folds in
+    the DATA index only, so model-axis replicas agree.
+
+    Returns (rows (n_cand, d), valid (n_cand,) bool) — ``valid`` is False
+    for tail slots when the batch has fewer positive rows than ``n_cand``.
+    """
+    d_idx = lax.axis_index(DATA_AXIS) if data_shards > 1 else 0
+    ck = jax.random.fold_in(base_i, data_shards + d_idx)
+    bs_local, d = bx.shape
+    kc = min(n_cand, bs_local)
+    g = jax.random.gumbel(ck, (bs_local,), jnp.float32)
+    score = jnp.where(bw > 0, g, -jnp.inf)
+    s_loc, idx = lax.top_k(score, kc)
+    rows_loc = bx[idx]                                    # (kc, d)
+    if data_shards > 1:
+        s_all = lax.all_gather(s_loc, DATA_AXIS).reshape(-1)
+        rows_all = lax.all_gather(rows_loc, DATA_AXIS).reshape(-1, d)
+    else:
+        s_all, rows_all = s_loc, rows_loc
+    if s_all.shape[0] < n_cand:        # k > global batch: pad with invalid
+        pad = n_cand - s_all.shape[0]
+        s_all = jnp.concatenate(
+            [s_all, jnp.full((pad,), -jnp.inf, s_all.dtype)])
+        rows_all = jnp.concatenate(
+            [rows_all, jnp.zeros((pad, d), rows_all.dtype)])
+    s_best, j = lax.top_k(s_all, n_cand)
+    return rows_all[j], s_best > -jnp.inf
+
+
+def apply_reassignment(new, seen, cand_rows, cand_valid, real, do_re,
+                       ratio: float, n_cand: int, acc):
+    """sklearn-style low-count center reassignment, shared by the
+    mini-batch device loops: centers whose lifetime ``seen`` count fell
+    below ``ratio * seen.max()`` are re-seeded from the current batch's
+    candidate rows (in slot order), and their counts reset to the minimum
+    count among the KEPT centers (sklearn's 'not too small to avoid
+    instant reassignment' rule).  ``do_re`` gates the whole step (the
+    every-``reassign_every``-iterations cadence); tie-break and ordering
+    are deterministic so host- and device-loop trajectories agree.
+    Returns (new, seen)."""
+    seen_real = jnp.where(real, seen, -jnp.inf)
+    thresh = ratio * jnp.max(seen_real)
+    flagged = (seen < thresh) & real & do_re
+    rank = jnp.cumsum(flagged.astype(jnp.int32)) - 1
+    take = jnp.clip(rank, 0, n_cand - 1)
+    ok = flagged & (rank < n_cand) & cand_valid[take]
+    new = jnp.where(ok[:, None], cand_rows.astype(acc)[take], new)
+    keep_min = jnp.min(jnp.where(real & ~flagged, seen, jnp.inf))
+    keep_min = jnp.where(jnp.isfinite(keep_min), keep_min, 0.0)
+    seen = jnp.where(ok, keep_min, seen)
+    return new, seen
+
+
 def make_minibatch_fit_fn(mesh: Mesh, *, batch_per_shard: int,
                           mode: str = "matmul", k_real: int, max_iter: int,
-                          tolerance: float, history_sse: bool = True):
+                          tolerance: float, history_sse: bool = True,
+                          reassignment_ratio: float = 0.0,
+                          reassign_every: int = 1):
     """Build the FULLY ON-DEVICE mini-batch training loop: ALL iterations
     (sampling + batch stats + Sculley update) in ONE dispatch under
     ``lax.while_loop`` — the mini-batch analogue of ``make_fit_fn``.
@@ -868,6 +953,16 @@ def make_minibatch_fit_fn(mesh: Mesh, *, batch_per_shard: int,
     ``iter0`` offsets the sampling keys so a resumed fit draws the SAME
     batch sequence an uninterrupted run would (checkpoint continuity);
     ``seen0`` carries the lifetime per-center counts across resumes.
+
+    ``reassignment_ratio > 0`` enables sklearn-style dead-center
+    recovery — the mini-batch analogue of the reference's ONE fault path
+    (empty-cluster resample, kmeans_spark.py:190-204): every
+    ``reassign_every`` GLOBAL iterations, centers whose lifetime count is
+    below ``reassignment_ratio * seen.max()`` are re-seeded from rows of
+    the current batch (``_batch_candidates`` — same key schedule as the
+    per-iteration engine, so the two trajectories agree) and their counts
+    reset to the kept centers' minimum.  The cadence and draws key off
+    the ABSOLUTE iteration (``iter0 + i``), preserving resume continuity.
 
     Returns ``fit(points, weights, centroids0, key, iter0, seen0) ->
     (centroids, seen, n_iters, sse_hist[max_iter], shift_hist[max_iter],
@@ -891,9 +986,9 @@ def make_minibatch_fit_fn(mesh: Mesh, *, batch_per_shard: int,
             blk = lax.dynamic_slice(
                 cents_full, (jnp.asarray(m_idx * k_local, jnp.int32),
                              jnp.int32(0)), (k_local, d))
-            bx, bw = _sample_batch(
-                points, weights, jax.random.fold_in(key, iter0 + i),
-                batch_per_shard, data_shards)
+            base_i = jax.random.fold_in(key, iter0 + i)
+            bx, bw = _sample_batch(points, weights, base_i,
+                                   batch_per_shard, data_shards)
             st = _local_stats(bx, bw, blk.astype(points.dtype),
                               chunk_size=batch_per_shard, mode=mode,
                               model_shards=model_shards,
@@ -907,16 +1002,37 @@ def make_minibatch_fit_fn(mesh: Mesh, *, batch_per_shard: int,
                 jnp.zeros((k_pad,), acc), st.counts, (off,)), axes)
             sse = (lax.psum(st.sse, axes) / model_shards
                    if history_sse else st.sse)
-            return sums, counts, sse
+            if reassignment_ratio <= 0:
+                cand = None
+            elif reassign_every == 1:
+                cand = _batch_candidates(bx, bw, base_i, k_real,
+                                         data_shards)
+            else:
+                # Off-cadence iterations skip the draw (Gumbel + top_k +
+                # (k, D) all_gather) entirely; the predicate is shard-
+                # uniform (replicated loop counter), so the collective
+                # inside the cond is safe.
+                cand = lax.cond(
+                    ((iter0 + i + 1) % reassign_every) == 0,
+                    lambda: _batch_candidates(bx, bw, base_i, k_real,
+                                              data_shards),
+                    lambda: (jnp.zeros((k_real, d), bx.dtype),
+                             jnp.zeros((k_real,), bool)))
+            return sums, counts, sse, cand
 
         def body(state):
             i, cents, seen, _, sse_hist, shift_hist, _ = state
-            sums, counts, sse = batch_stats(cents, i)
+            sums, counts, sse, cand = batch_stats(cents, i)
             seen = seen + counts
             eta = (counts / jnp.maximum(seen, 1.0))[:, None]
             bmean = sums / jnp.maximum(counts, 1.0)[:, None]
             new = jnp.where((counts > 0)[:, None],
                             (1.0 - eta) * cents + eta * bmean, cents)
+            if reassignment_ratio > 0:
+                do_re = ((iter0 + i + 1) % reassign_every) == 0
+                new, seen = apply_reassignment(
+                    new, seen, cand[0], cand[1], real, do_re,
+                    reassignment_ratio, k_real, acc)
             shifts = jnp.sqrt(jnp.sum((new - cents) ** 2, axis=1))
             max_shift = jnp.max(jnp.where(real, shifts, 0.0))
             batch_w = jnp.sum(jnp.where(real, counts, 0.0))
