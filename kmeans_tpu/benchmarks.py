@@ -160,9 +160,10 @@ def bench_config(name: str, iters: int, mode: str) -> Dict:
     # The grow/stop decision uses the MEDIAN of 3 interleaved pairs (r1
     # VERDICT #8) — r3 fix: deciding on a single pair let one noise spike
     # stop the growth early and mis-report a measurable config as
-    # noise-limited.  The cap is high (5^7 ≈ 78k) because a while_loop's
-    # compile time does not depend on its trip count — only sub-µs/iter
-    # configs stay unmeasurable.
+    # noise-limited.  The cap is high — the 5x growth stops at the first
+    # measured gap >= 50k iterations — because a while_loop's compile
+    # time does not depend on its trip count; only sub-µs/iter configs
+    # stay unmeasurable.
     out_big = None
     while True:
         fit_big = build(2 + iters)

@@ -227,8 +227,12 @@ class GaussianMixture:
         """Device-placed (shift, means_c, inv_var, log_det, log_w): the
         precision AND the log-determinant both come from the SAME clamped
         covariance (r2 ADVICE: computing log_det from the unclamped table
-        made the density inconsistent when covariances_ < reg_covar)."""
-        cv = np.maximum(self.covariances_, max(self.reg_covar, 1e-300))
+        made the density inconsistent when covariances_ < reg_covar).
+        The floor is the COMPUTE dtype's tiny — a 1e-300 float64 floor
+        flushes to 0 when cast to float32, reopening inv_var=inf for
+        reg_covar=0 collapsed components (review r4)."""
+        cv = np.maximum(self.covariances_,
+                        max(self.reg_covar, float(np.finfo(self.dtype).tiny)))
         shift = self._shift()
         means_c, var, log_w = self._put_tables(
             mesh, (self.means_ - shift).astype(self.dtype),
@@ -324,7 +328,11 @@ class GaussianMixture:
         Rc = np.maximum(R, 10 * np.finfo(np.float64).tiny)
         mu = S1 / Rc[:, None]
         var = S2 / Rc[:, None] - mu ** 2 + self.reg_covar
-        var = np.maximum(var, self.reg_covar)
+        # tiny floor: reg_covar=0 must not leave exact-zero variances
+        # (precisions_ would be inf; the compute-dtype floor happens
+        # again in _params_dev).
+        var = np.maximum(var, max(self.reg_covar,
+                                  np.finfo(np.float64).tiny))
         pi = np.maximum(R / max(w_total, 1e-300), 1e-300)
         return w_total, (pi / pi.sum(), mu, var)
 
@@ -343,8 +351,25 @@ class GaussianMixture:
 
         best = None
         lls = []
+        last_err = None
         for r, seed in enumerate(seeds):
-            self._fit_one(ds, mesh, step_fn, seed)
+            try:
+                self._fit_one(ds, mesh, step_fn, seed)
+            except Exception as e:
+                # A failed restart (e.g. the device loop's non-finite-
+                # loglik error) must not discard earlier successful
+                # restarts or leave the model holding the failed
+                # restart's partial state (r3 ADVICE).  Single-restart
+                # fits still propagate immediately.
+                if len(seeds) == 1:
+                    raise
+                import warnings
+                warnings.warn(f"GMM restart {r + 1}/{len(seeds)} failed "
+                              f"({e}); continuing with the remaining "
+                              f"restarts", UserWarning, stacklevel=2)
+                last_err = e
+                lls.append(-np.inf)
+                continue
             if len(seeds) == 1:
                 return self
             lls.append(self.lower_bound_)
@@ -354,6 +379,8 @@ class GaussianMixture:
                         "covariances_": self.covariances_,
                         "converged_": self.converged_,
                         "n_iter_": self.n_iter_}
+        if best is None:
+            raise last_err
         self.weights_ = best["weights_"]
         self.means_ = best["means_"]
         self.covariances_ = best["covariances_"]
@@ -409,7 +436,8 @@ class GaussianMixture:
         fit_fn = _STEP_CACHE[key]
         k = self.n_components
         shift = self._shift()
-        cv = np.maximum(self.covariances_, max(self.reg_covar, 1e-300))
+        cv = np.maximum(self.covariances_,
+                        max(self.reg_covar, float(np.finfo(self.dtype).tiny)))
         # The device loop carries FULL replicated tables (each shard
         # slices its block per iteration, like KMeans' make_fit_fn).
         mc, var0, log_w0 = self._pad_tables(
@@ -536,6 +564,13 @@ class GaussianMixture:
             "converged_": bool(self.converged_),
             "n_iter_": int(self.n_iter_),
             "lower_bound_": float(self.lower_bound_),
+            # Restart metadata (n_init > 1): save/load must not silently
+            # drop fitted attributes (r3 ADVICE).
+            "best_restart_": int(getattr(self, "best_restart_", 0)),
+            "restart_lower_bounds_":
+                np.asarray(self.restart_lower_bounds_)
+                if getattr(self, "restart_lower_bounds_", None) is not None
+                else np.zeros((0,)),
         }
         # Explicit init arrays are CONFIG, not fitted state: a loaded
         # model that is re-fit must seed exactly like the original.
@@ -577,6 +612,11 @@ class GaussianMixture:
             model.converged_ = bool(state["converged_"])
             model.n_iter_ = int(state["n_iter_"])
             model.lower_bound_ = float(state["lower_bound_"])
+            model.best_restart_ = int(state.get("best_restart_", 0))
+            rlb = state.get("restart_lower_bounds_")
+            model.restart_lower_bounds_ = (
+                np.asarray(rlb, np.float64)
+                if rlb is not None and rlb.size else None)
         return model
 
     def __getstate__(self) -> dict:

@@ -293,7 +293,12 @@ def make_gmm_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
         w_total = lax.psum(jnp.sum(weights.astype(acc)), DATA_AXIS)
 
         def estats(means_c, var, log_w):
-            cv = jnp.maximum(var, reg_covar)
+            # Floor at tiny(acc) even when reg_covar=0 (allowed by
+            # validation): a collapsed component would otherwise give
+            # inv_var=inf / log_det=-inf -> NaN loglik (r3 ADVICE; the
+            # host paths floor at the same dtype-tiny in _params_dev).
+            cv = jnp.maximum(var, jnp.maximum(
+                jnp.asarray(reg_covar, acc), tiny))
             inv_var = 1.0 / cv
             log_det = jnp.sum(jnp.log(cv), axis=1)
             off = jnp.asarray(m_idx * k_local, jnp.int32)
@@ -313,8 +318,13 @@ def make_gmm_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
             st = estats(means_c, var, log_w)
             Rc = jnp.maximum(st.resp_sum, 10 * tiny)
             mu = st.xsum / Rc[:, None]
+            # The CARRIED/returned variance is floored at tiny too — a
+            # var of exactly 0 would make the fitted model's precisions_
+            # inf and its score()/predict() NaN even though the in-loop
+            # E-step floors its own copy (review r4).
             new_var = jnp.maximum(
-                st.x2sum / Rc[:, None] - mu ** 2 + reg_covar, reg_covar)
+                st.x2sum / Rc[:, None] - mu ** 2 + reg_covar,
+                jnp.maximum(jnp.asarray(reg_covar, acc), tiny))
             pi = jnp.maximum(st.resp_sum / jnp.maximum(w_total, pi_floor),
                              pi_floor)
             pi = pi / jnp.sum(jnp.where(real, pi, 0.0))

@@ -366,3 +366,86 @@ def test_set_params_validates():
         gm.set_params(bogus=1)
     # Failed set_params leaves the model untouched.
     assert gm.n_components == 3 and gm.covariance_type == "diag"
+
+
+def test_restart_failure_keeps_best_so_far(monkeypatch):
+    """r3 ADVICE: an exception in a later restart must not discard
+    earlier successful restarts (the best-so-far result is installed,
+    with a warning)."""
+    X, _ = make_blobs(600, centers=3, n_features=4, random_state=0,
+                      dtype=np.float32)
+    gm = GaussianMixture(n_components=3, n_init=3, max_iter=20, seed=0)
+    orig = GaussianMixture._fit_one
+    calls = {"n": 0}
+
+    def flaky(self, ds, mesh, step_fn, seed):
+        calls["n"] += 1
+        if calls["n"] == 3:                       # last restart blows up
+            raise ValueError("non-finite log-likelihood at EM iteration 1")
+        return orig(self, ds, mesh, step_fn, seed)
+
+    monkeypatch.setattr(GaussianMixture, "_fit_one", flaky)
+    with pytest.warns(UserWarning, match="restart 3/3 failed"):
+        gm.fit(X)
+    assert np.isfinite(gm.lower_bound_)
+    assert gm.means_ is not None and np.all(np.isfinite(gm.means_))
+    assert gm.restart_lower_bounds_.shape == (3,)
+    assert gm.restart_lower_bounds_[2] == -np.inf
+    # All restarts failing propagates the error.
+    def always_fail(self, ds, mesh, step_fn, seed):
+        raise ValueError("non-finite log-likelihood at EM iteration 1")
+
+    monkeypatch.setattr(GaussianMixture, "_fit_one", always_fail)
+    with pytest.warns(UserWarning):
+        with pytest.raises(ValueError, match="non-finite"):
+            GaussianMixture(n_components=3, n_init=2, max_iter=5,
+                            seed=0).fit(X)
+
+
+def test_restart_metadata_roundtrips_checkpoint(tmp_path):
+    """r3 ADVICE: save/load must not silently drop best_restart_ /
+    restart_lower_bounds_."""
+    X, _ = make_blobs(600, centers=3, n_features=4, random_state=1,
+                      dtype=np.float32)
+    gm = GaussianMixture(n_components=3, n_init=3, max_iter=15,
+                         seed=3).fit(X)
+    gm.save(tmp_path / "gm.npz")
+    back = GaussianMixture.load(tmp_path / "gm.npz")
+    assert back.best_restart_ == gm.best_restart_
+    np.testing.assert_array_equal(back.restart_lower_bounds_,
+                                  gm.restart_lower_bounds_)
+
+
+def test_reg_covar_zero_partial_collapse_survives(mesh8):
+    """r3 ADVICE: with reg_covar=0, a NEAR-collapsed component (tiny but
+    nonzero variance) must not diverge between engines — the device loop
+    floors the covariance at the compute dtype's tiny exactly like the
+    host path's _params_dev floor, so both fits complete and the fitted
+    model scores finitely."""
+    X, _ = make_blobs(800, centers=3, n_features=4, random_state=2,
+                      dtype=np.float32)
+    X[:200] = X[0] + np.random.default_rng(0).normal(
+        scale=1e-3, size=(200, 4)).astype(np.float32)
+    for host_loop in (True, False):
+        gm = GaussianMixture(n_components=3, reg_covar=0.0, max_iter=15,
+                             seed=0, mesh=mesh8, host_loop=host_loop)
+        gm.fit(X)
+        assert np.isfinite(gm.lower_bound_), host_loop
+        assert np.all(np.isfinite(gm.precisions_)), host_loop
+        assert np.isfinite(gm.score(X)), host_loop
+
+
+def test_reg_covar_zero_full_collapse_fails_loudly(mesh8):
+    """r4 review: a FULLY collapsed component (identical rows) with
+    reg_covar=0 cannot be represented (the density matmul overflows at
+    inv_var = 1/tiny; sklearn raises on this too) — both engines must
+    fail LOUDLY with the non-finite-loglik error, never silently return
+    a model whose score() is NaN."""
+    rng = np.random.default_rng(2)
+    X = np.concatenate([np.full((400, 4), 5.0),
+                        rng.normal(size=(400, 4))]).astype(np.float32)
+    for host_loop in (True, False):
+        gm = GaussianMixture(n_components=2, reg_covar=0.0, max_iter=15,
+                             seed=0, mesh=mesh8, host_loop=host_loop)
+        with pytest.raises(ValueError, match="non-finite log-likelihood"):
+            gm.fit(X)

@@ -154,7 +154,7 @@ def test_reservoir_draw_is_uniform_chi2():
     multi-block epoch.  Composite draw (Algorithm-R reservoir -> seeded
     subsample) repeated over many independent seeds; a chi-squared test
     against the uniform row-inclusion frequency must not reject."""
-    from scipy import stats
+    stats = pytest.importorskip("scipy.stats")  # optional oracle, like sklearn
 
     from kmeans_tpu.models.kmeans import _EpochReservoir
 
