@@ -97,7 +97,19 @@ class MiniBatchKMeans(KMeans):
     def fit(self, X, y=None, *, resume: bool = False) -> "MiniBatchKMeans":
         if self.sampling == "host":
             return self._fit_host(X, resume=resume)
-        return self._fit_device(X, resume=resume)
+        self._fit_device(X, resume=resume)
+        # Multi-host process-local fits materialize labels_ HERE, while
+        # every process is still executing fit: deferring the global
+        # assignment dispatch to a later labels_ read or pickle on ONE
+        # process (e.g. an is_primary() checkpoint block) would run an
+        # SPMD computation the other processes never join (review r4).
+        # Single-host fits keep the documented lazy labels_.
+        from kmeans_tpu.parallel.sharding import ShardedDataset
+        if self.compute_labels and \
+                isinstance(self._fit_ds, ShardedDataset) and \
+                not self._fit_ds.points.is_fully_addressable:
+            _ = self.labels_
+        return self
 
     def _resume_or_init(self, init_src, resume: bool):
         """Shared fit prelude: (centroids float64, start_iter, seen)."""
@@ -128,12 +140,13 @@ class MiniBatchKMeans(KMeans):
         log = IterationLogger(self.verbose and jax.process_index() == 0)
 
         self._set_fit_data(ds)                 # feeds lazy labels_
-        if not ds.points.is_fully_addressable:
+        if not ds.points.is_fully_addressable and not ds.labelable:
+            # Layout-less hand-built global arrays cannot unpad labels.
             self._fit_ds, self._labels_cache = None, None
             self._labels_error = (
-                "labels_ is not available for a multi-host process-local "
-                "fit (labels would span non-addressable devices); call "
-                "predict on each process's local rows")
+                "labels_ is not available for this multi-host fit "
+                "(unknown per-process layout); call predict on each "
+                "process's local rows")
         centroids, start_iter, seen = self._resume_or_init(ds, resume)
         if start_iter == 0:
             self.iter_times_ = []

@@ -131,7 +131,8 @@ class ShardedDataset:
     def __init__(self, points: jax.Array, weights: jax.Array, n: int,
                  chunk: int, mesh: Optional[Mesh],
                  host: Optional[np.ndarray] = None,
-                 host_weights: Optional[np.ndarray] = None):
+                 host_weights: Optional[np.ndarray] = None,
+                 local_rows: Optional[int] = None):
         self.points = points
         self.weights = weights
         self.n = n
@@ -140,10 +141,27 @@ class ShardedDataset:
         self.mesh = mesh
         self._host = host
         self._host_weights = host_weights
+        # REAL rows THIS process contributed (multi-host process-local
+        # loading): this process's real data occupies the first
+        # ``local_rows`` rows of its own contiguous padded block, which
+        # is what lets ``predict`` unpad per process (r3 VERDICT #4).
+        # Defaults to n for fully-addressable datasets; None means the
+        # per-process layout is unknown (hand-built global arrays).
+        self.local_rows = (local_rows if local_rows is not None
+                           else (n if points.is_fully_addressable else None))
 
     @property
     def dtype(self):
         return np.dtype(str(self.points.dtype))
+
+    @property
+    def labelable(self) -> bool:
+        """True when per-process labels can be unpadded from a global
+        assignment pass: the array is fully addressable, or the
+        process-local layout is known (``local_rows``).  The single
+        predicate behind ``fit``-time ``labels_`` availability and
+        ``predict``'s process-local path — keep them in lockstep."""
+        return self.points.is_fully_addressable or self.local_rows is not None
 
     @property
     def host(self) -> Optional[np.ndarray]:
@@ -332,8 +350,11 @@ def from_process_local(X_local, mesh: Mesh, *,
     Single-process: exact equivalent of ``to_device`` (host copy kept).
     Multi-host notes: the result has no host copy, so use
     ``init='kmeans++'`` (on-device D² seeding) or an explicit init array —
-    Forgy row-gather needs host data and raises a pointed error; run
-    ``predict`` on each process's local rows rather than on this dataset.
+    Forgy row-gather needs host data and raises a pointed error.
+    ``predict``/``labels_`` on this dataset return THIS process's own
+    rows' labels (``local_rows`` records the per-process layout);
+    concatenating across processes in process order gives the global
+    labels.
     """
     if mesh is None:
         raise ValueError("from_process_local requires a mesh")
@@ -378,4 +399,5 @@ def from_process_local(X_local, mesh: Mesh, *,
         NamedSharding(mesh, P(DATA_AXIS, None)), x_pad, (n_pad_global, d))
     w = jax.make_array_from_process_local_data(
         NamedSharding(mesh, P(DATA_AXIS)), w_pad, (n_pad_global,))
-    return ShardedDataset(pts, w, n_global, chunk, mesh)
+    return ShardedDataset(pts, w, n_global, chunk, mesh,
+                          local_rows=n_local)

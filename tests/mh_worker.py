@@ -53,12 +53,14 @@ assert ds.n == 3000, ds.n
 
 km = KMeans(k=4, seed=0, init=init, empty_cluster="keep",
             compute_sse=True, verbose=is_primary()).fit(ds)
-assert km._labels_cache is None    # eager labels skipped on multi-host
-try:
-    km.labels_
-    raise SystemExit("labels_ should raise on a process-local fit")
-except AttributeError as e:
-    assert "local rows" in str(e), e
+# Process-local labels (r3 VERDICT #4): labels_ holds THIS process's own
+# rows' labels; concatenated across processes = the global label array
+# (asserted by the parent test).
+labels_local = km.labels_
+assert labels_local.shape == (len(X_local),), labels_local.shape
+np.save(out_dir / f"labels_{proc_id}.npy", labels_local)
+# predict on the process-local dataset agrees with the eager labels.
+np.testing.assert_array_equal(km.predict(ds), labels_local)
 
 # 'resample' on a process-local dataset: the on-device Gumbel sampler
 # replaces the r1 rejection (r1 VERDICT #6).  Force empties with two
@@ -74,6 +76,18 @@ np.save(out_dir / f"centroids_rs_{proc_id}.npy", km_rs.centroids)
 km2 = KMeans(k=4, seed=0, init="kmeans++", empty_cluster="keep",
              verbose=False).fit(ds)
 assert np.all(np.isfinite(km2.centroids))
+
+# MiniBatch on the process-local dataset: labels_ is materialized EAGERLY
+# inside fit (all processes join the dispatch), so a later single-process
+# pickle/labels_ read cannot desync the SPMD program (review r4).
+from kmeans_tpu.models import MiniBatchKMeans  # noqa: E402
+
+mb = MiniBatchKMeans(k=4, init=init, batch_size=256, max_iter=8, seed=0,
+                     verbose=False).fit(ds)
+assert mb._labels_cache is not None and mb._fit_ds is None
+assert mb._labels_cache.shape == (len(X_local),)
+import pickle  # noqa: E402
+pickle.dumps(mb)          # single-process-safe: no implicit dispatch left
 
 # --- multi-host checkpoint: every process calls save(); only process 0
 # writes, and the barrier makes the file visible before any return

@@ -62,6 +62,7 @@ def _make_nonaddressable_ds(mesh):
     ds._host = None
     ds._host_weights = None
     ds.points = _FakeNonAddressable(ds.points)
+    ds.local_rows = None        # hand-built global array: layout unknown
     return ds, X
 
 
@@ -128,6 +129,13 @@ def test_two_process_fit_matches_single_process(tmp_path):
     np.testing.assert_allclose(c0, km.centroids, atol=1e-3)
     sse0 = np.load(tmp_path / "sse_0.npy")
     np.testing.assert_allclose(sse0, np.asarray(km.sse_history), rtol=1e-5)
+
+    # Process-local labels (r3 VERDICT #4): each worker's labels_ covers
+    # its OWN rows; the process-order concatenation equals the
+    # single-process labels_ of the concatenated data.
+    lab = np.concatenate([np.load(tmp_path / "labels_0.npy"),
+                          np.load(tmp_path / "labels_1.npy")])
+    np.testing.assert_array_equal(lab, km.labels_)
 
     # TP (model=2, model axis spanning the two processes) must agree too.
     tp0 = np.load(tmp_path / "centroids_tp_0.npy")
