@@ -165,12 +165,11 @@ class MiniBatchKMeans(KMeans):
         re_every = self._reassign_every(bs_local * data_shards)
 
         def get_step(nc: int):
-            cache_key = (mesh, bs_local, mode, nc, "mbstep")
-            if cache_key not in _STEP_CACHE:
-                _STEP_CACHE[cache_key] = dist.make_minibatch_step_fn(
+            return _STEP_CACHE.get_or_create(
+                (mesh, bs_local, mode, nc, "mbstep"),
+                lambda: dist.make_minibatch_step_fn(
                     mesh, batch_per_shard=bs_local, mode=mode,
-                    n_candidates=nc)
-            return _STEP_CACHE[cache_key]
+                    n_candidates=nc))
 
         step_fn = get_step(0)
         # Candidate variant dispatched ONLY on reassignment iterations —
@@ -240,15 +239,14 @@ class MiniBatchKMeans(KMeans):
         cache_key = (mesh, bs_local, mode, self.k, iters_left,
                      float(self.tolerance), self.compute_sse,
                      float(self.reassignment_ratio), re_every, "mbfit")
-        if cache_key not in _STEP_CACHE:
-            _STEP_CACHE[cache_key] = dist.make_minibatch_fit_fn(
+        fit_fn = _STEP_CACHE.get_or_create(
+            cache_key, lambda: dist.make_minibatch_fit_fn(
                 mesh, batch_per_shard=bs_local, mode=mode,
                 k_real=self.k, max_iter=iters_left,
                 tolerance=float(self.tolerance),
                 history_sse=self.compute_sse,
                 reassignment_ratio=float(self.reassignment_ratio),
-                reassign_every=re_every)
-        fit_fn = _STEP_CACHE[cache_key]
+                reassign_every=re_every))
         cents_dev = self._put_centroids(centroids.astype(self.dtype), mesh,
                                         model_shards)
         t0 = time.perf_counter()

@@ -1,0 +1,52 @@
+"""Step-function cache bounding (r3 VERDICT weak #7): streaming many
+distinct block shapes must not pin a compiled executable per shape for
+the process lifetime."""
+
+import numpy as np
+import pytest
+
+from kmeans_tpu import KMeans
+from kmeans_tpu.models import kmeans as kmeans_mod
+from kmeans_tpu.utils.cache import LRUCache
+
+
+def test_lru_semantics():
+    c = LRUCache(2)
+    c["a"] = 1
+    c["b"] = 2
+    _ = c["a"]          # refresh a
+    c["c"] = 3          # evicts b (LRU)
+    assert "a" in c and "c" in c and "b" not in c and len(c) == 2
+    with pytest.raises(ValueError, match="maxsize"):
+        LRUCache(0)
+
+
+def test_get_or_create_never_raises_on_eviction():
+    """The models go through get_or_create, so a concurrent eviction
+    between check and read can never surface as KeyError — the factory
+    result is returned directly."""
+    c = LRUCache(1)
+    calls = []
+    assert c.get_or_create("a", lambda: calls.append("a") or 1) == 1
+    assert c.get_or_create("b", lambda: calls.append("b") or 2) == 2  # evicts a
+    assert c.get_or_create("a", lambda: calls.append("a2") or 3) == 3
+    assert calls == ["a", "b", "a2"] and len(c) == 1
+
+
+def test_predict_stream_cache_bounded(monkeypatch):
+    cap = 6
+    monkeypatch.setattr(kmeans_mod, "_STEP_CACHE", LRUCache(cap))
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(512, 4)).astype(np.float32)
+    km = KMeans(k=3, seed=0, verbose=False, max_iter=5).fit(X)
+    want = km.predict(X)
+
+    # 20 distinct block sizes -> 20 distinct padded shapes; without the
+    # bound each would pin its own compiled predict program.
+    sizes = [17 + 13 * i for i in range(20)]
+    got = np.concatenate(list(km.predict_stream(
+        lambda: (X[: s] for s in sizes))))
+    assert len(kmeans_mod._STEP_CACHE) <= cap
+    # Labels stay correct across evictions/recompiles.
+    np.testing.assert_array_equal(got, np.concatenate(
+        [want[: s] for s in sizes]))
