@@ -24,9 +24,10 @@ X, _ = make_blobs(300_000, centers=10, n_features=32, random_state=6,
 np.save(path, X)
 print(f"wrote {path} ({path.stat().st_size / 1e6:.0f} MB)")
 
-# Shared explicit init: named strategies would seed the streaming fit
-# from the FIRST block only (documented divergence), which can land in a
-# different local optimum than seeding from the full array.
+# Shared explicit init so the streamed and in-memory fits follow the
+# same trajectory.  (Named strategies also work: 'forgy' runs one
+# reservoir pass over the FULL stream — the reference's takeSample
+# capability — and 'k-means++'/'k-means||' run a streamed kmeans||.)
 rng = np.random.RandomState(42)
 init = X[rng.choice(len(X), 10, replace=False)].copy()
 

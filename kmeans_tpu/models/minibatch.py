@@ -86,10 +86,12 @@ class MiniBatchKMeans(KMeans):
         self.reassignment_ratio = float(reassignment_ratio)
 
     def _reassign_every(self, batch_global: int) -> int:
-        """Reassignment cadence: once every ``10 * k`` PROCESSED samples
-        (sklearn's ``_random_reassign`` rule), expressed in iterations of
-        the effective global batch.  Deterministic in the absolute
-        iteration index, so resumes keep the cadence."""
+        """Reassignment cadence: the first iteration count n with
+        ``n * batch > 10 * k`` — sklearn's ``_random_reassign`` rule is
+        the STRICT inequality ``10 * k < n_since_last_reassign``, so
+        ``batch == 10 * k`` gives a period of 2, which floor-div + 1
+        reproduces exactly.  Deterministic in the absolute iteration
+        index, so resumes keep the cadence."""
         return 10 * self.k // max(batch_global, 1) + 1
 
     # ------------------------------------------------------------------- fit

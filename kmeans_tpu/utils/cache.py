@@ -31,11 +31,16 @@ class LRUCache:
         eviction between the check and the read can never raise — the
         worst race outcome is a duplicate compile, exactly like the old
         unbounded dict."""
-        if key in self._d:
+        try:
+            value = self._d[key]           # single atomic read
+        except KeyError:
+            value = factory()
+            self[key] = value
+            return value
+        try:
             self._d.move_to_end(key)
-            return self._d[key]
-        value = factory()
-        self[key] = value
+        except KeyError:
+            pass            # evicted concurrently; value is still valid
         return value
 
     def __contains__(self, key) -> bool:
