@@ -370,20 +370,23 @@ def streamed_forgy_init(make_blocks, k: int, seeds, d: int, dtype):
 
 
 @functools.partial(jax.jit, static_argnames=("cap",))
-def _stream_round_block(x, cands, phi_prev, ell, key, cap: int):
+def _stream_round_block(x, w, cands, phi_prev, ell, key, cap: int):
     """One block's contribution to one streamed kmeans|| round: min
     squared distance to the CURRENT candidate set (matmul form on the
     MXU), Bernoulli-sample rows w.p. ``min(1, ell*d2/phi_prev)``, return
     up to ``cap`` sampled rows + validity + this block's cost (which
-    accumulates into the NEXT round's phi)."""
+    accumulates into the NEXT round's phi).  ``w`` is the 0/1 padding
+    mask — blocks arrive padded to a fixed row multiple so ragged
+    streams compile once per round, not once per block length."""
     from kmeans_tpu.ops.assign import pairwise_sq_dists
     d2 = jnp.maximum(
         jnp.min(pairwise_sq_dists(x, cands, mode="matmul"), axis=1), 0.0)
-    phi_b = jnp.sum(d2)
+    d2 = jnp.where(w > 0, d2, 0.0)                 # padding: no cost,
+    phi_b = jnp.sum(d2)                            # never sampled
     p = jnp.minimum(1.0, ell * d2 /
                     jnp.maximum(phi_prev, jnp.finfo(d2.dtype).tiny))
     u = jax.random.uniform(key, d2.shape, d2.dtype)
-    score = jnp.where(u < p, 1.0 + u, 0.0)
+    score = jnp.where((u < p) & (w > 0), 1.0 + u, 0.0)
     vals, idx = jax.lax.top_k(score, cap)
     return x[idx], vals > 0, phi_b
 
@@ -430,16 +433,27 @@ def streamed_kmeans_parallel_init(make_blocks, k: int, seeds, d: int,
     cands = [r.rows[:1].copy() for r in res]         # per-seed candidates
 
     def epoch_blocks():
+        """Blocks padded to a fixed row multiple (>= cap, so top_k's
+        static argument is always just ``cap``): ragged streams compile
+        one program per round instead of one per block length."""
+        mult = -(-cap // 512) * 512      # >= cap AND a 512-chunk multiple
         for block in make_blocks():
-            yield np.ascontiguousarray(np.asarray(block, dtype=dtype))
+            x = np.ascontiguousarray(np.asarray(block, dtype=dtype))
+            pad = (-x.shape[0]) % mult
+            w = np.ones(x.shape[0] + pad, dtype)
+            if pad:
+                x = np.concatenate(
+                    [x, np.zeros((pad, x.shape[1]), dtype)])
+                w[x.shape[0] - pad:] = 0.0
+            yield x, w
 
     phi = np.zeros(R)
-    for x in epoch_blocks():                         # pass: initial phi
-        xd = jnp.asarray(x)
+    for x, w in epoch_blocks():                      # pass: initial phi
+        xd, wd = jnp.asarray(x), jnp.asarray(w)
         for r in range(R):
             _, _, phi_b = _stream_round_block(
-                xd, jnp.asarray(cands[r].astype(dtype)), jnp.inf, 0.0,
-                jax.random.PRNGKey(0), 1)
+                xd, wd, jnp.asarray(cands[r].astype(dtype)), jnp.inf,
+                0.0, jax.random.PRNGKey(0), cap)
             phi[r] += float(phi_b)
 
     keys = [jax.random.PRNGKey(
@@ -448,15 +462,14 @@ def streamed_kmeans_parallel_init(make_blocks, k: int, seeds, d: int,
     for rd in range(rounds):                         # sampling passes
         new = [[] for _ in range(R)]
         phi_next = np.zeros(R)
-        for bi, x in enumerate(epoch_blocks()):
-            xd = jnp.asarray(x)
-            bc = min(cap, x.shape[0])
+        for bi, (x, w) in enumerate(epoch_blocks()):
+            xd, wd = jnp.asarray(x), jnp.asarray(w)
             for r in range(R):
                 rows, valid, phi_b = _stream_round_block(
-                    xd, jnp.asarray(cands[r].astype(dtype)),
+                    xd, wd, jnp.asarray(cands[r].astype(dtype)),
                     float(phi[r]), ell,
                     jax.random.fold_in(
-                        jax.random.fold_in(keys[r], rd), bi), bc)
+                        jax.random.fold_in(keys[r], rd), bi), cap)
                 rows, valid = np.asarray(rows), np.asarray(valid)
                 if valid.any():
                     new[r].append(rows[valid].astype(np.float64))
@@ -469,21 +482,24 @@ def streamed_kmeans_parallel_init(make_blocks, k: int, seeds, d: int,
     for r in range(R):
         cands[r] = np.unique(cands[r], axis=0)
 
-    # Cell-mass pass (+ cap-k backfill reservoirs for tiny streams).
+    # Cell-mass pass (+ cap-k backfill reservoirs, maintained only for
+    # restarts that actually came up short — review r4).
     masses = [np.zeros(len(c)) for c in cands]
-    back = [_EpochReservoir(k, d, np.random.default_rng([s, 0xF1259]))
-            for s in seeds]
+    short = [r for r in range(R) if len(cands[r]) < k]
+    back = {r: _EpochReservoir(k, d,
+                               np.random.default_rng([seeds[r], 0xF1259]))
+            for r in short}
     chunk = 512
-    for x in epoch_blocks():
-        pad = (-x.shape[0]) % chunk
-        xp = jnp.asarray(np.pad(x, ((0, pad), (0, 0))))
-        wp = jnp.asarray(np.pad(np.ones(x.shape[0], dtype), (0, pad)))
+    for x, w in epoch_blocks():
+        xp, wp = jnp.asarray(x), jnp.asarray(w)
         for r in range(R):
             st = assign_reduce(xp, wp, jnp.asarray(cands[r].astype(dtype)),
                                chunk_size=chunk)
             masses[r] += np.asarray(st.counts, np.float64)
-        for b in back:
-            b.offer(x)
+        if short:
+            real = x[np.asarray(w) > 0]
+            for r in short:
+                back[r].offer(real)
 
     outs = []
     for r in range(R):

@@ -99,15 +99,9 @@ def _estep_tile(x, w, means, inv_var, log_det, log_weights,
     statistics stay local to this shard's block.  ``loglik`` is identical
     on every model shard (the caller divides the cross-axis psum out)."""
     logp = _log_prob_chunk(x, means, inv_var, log_det, log_weights)
-    m = jnp.max(logp, axis=1)
-    if model_shards > 1:
-        m = lax.pmax(m, MODEL_AXIS)
-    p = jnp.exp(logp - m[:, None])
-    denom = jnp.sum(p, axis=1)
-    if model_shards > 1:
-        denom = lax.psum(denom, MODEL_AXIS)
-    lse = m + jnp.log(denom)
-    resp = p / denom[:, None] * w[:, None]         # weighted, padded -> 0
+    # Weighted responsibilities via the shared cross-model-axis softmax
+    # (one implementation for every covariance type).
+    resp, lse = _softmax_resp(logp, w, model_shards)
     # Moment accumulators run at HIGHEST matmul precision: on TPU, "f32"
     # dots execute with bf16-rounded products by default (fine for the
     # responsibility softmax above — relative logp error ~2^-8 barely
@@ -222,33 +216,11 @@ def make_gmm_predict_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
 
     def predict(points, shift, means, inv_var, log_det, log_weights):
         k_local, d = means.shape
-        n_chunks = points.shape[0] // chunk_size
-        xs = points.reshape(n_chunks, chunk_size, d)
-        m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
-
-        def body(_, xc):
-            logp = _log_prob_chunk(xc - shift[None, :], means, inv_var,
-                                   log_det, log_weights)
-            best_l = jnp.argmax(logp, axis=1).astype(jnp.int32)
-            max_l = jnp.max(logp, axis=1)
-            if model_shards > 1:
-                maxes = lax.all_gather(max_l, MODEL_AXIS)      # (m, c)
-                owner = jnp.argmax(maxes, axis=0)
-                m_glob = jnp.max(maxes, axis=0)
-                labels = lax.psum(
-                    jnp.where(owner == m_idx, m_idx * k_local + best_l, 0),
-                    MODEL_AXIS).astype(jnp.int32)
-            else:
-                m_glob, labels = max_l, best_l
-            denom = jnp.sum(jnp.exp(logp - m_glob[:, None]), axis=1)
-            if model_shards > 1:
-                denom = lax.psum(denom, MODEL_AXIS)
-            lse = m_glob + jnp.log(denom)
-            return None, (labels, logp - lse[:, None], lse)
-
-        _, (labels, logr, lse) = lax.scan(body, None, xs)
-        return (labels.reshape(-1), logr.reshape(-1, k_local),
-                lse.reshape(-1))
+        return _predict_from_logp(
+            lambda xc: _log_prob_chunk(
+                xc - shift[None, :], means, inv_var, log_det,
+                log_weights),
+            points, chunk_size, k_local, d, model_shards)
 
     mapped = jax.shard_map(
         predict, mesh=mesh,
@@ -259,8 +231,294 @@ def make_gmm_predict_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
     return jax.jit(mapped)
 
 
+class EStatsFull(NamedTuple):
+    """Globally-reduced E-step statistics for FULL covariances: the diag
+    ``x2sum`` is replaced by the per-component scatter moment
+    ``sum_i w_i r_ik (x_i - shift)(x_i - shift)^T`` — one dense
+    psum-reducible (k, D, D) tensor accumulated as batched outer-product
+    matmuls on the MXU (r3 VERDICT #5)."""
+
+    resp_sum: jax.Array    # (k,)
+    xsum: jax.Array        # (k, D)
+    scatter: jax.Array     # (k, D, D)
+    loglik: jax.Array      # ()
+
+
+def _log_prob_full_chunk(x, means, prec_chol, log_det_half, log_weights):
+    """(chunk, k) weighted log joint for full covariances.
+
+    ``prec_chol`` is the precision Cholesky P_k = L_k^-T (sklearn's
+    parameterization: Sigma_k = L_k L_k^T, Sigma_k^-1 = P_k P_k^T), so
+
+        log N(x | mu_k, Sigma_k)
+          = log_det_half_k - 0.5 * (||(x - mu_k) P_k||^2 + D log 2pi)
+
+    with ``log_det_half_k = sum_d log P_k[d, d]`` (= -0.5 log|Sigma_k|).
+    The transform is ONE batched (chunk, D) x (k, D, D) einsum — k
+    matmuls on the MXU — minus a per-component constant row."""
+    xt = jnp.einsum("cd,kde->cke", x, prec_chol,
+                    preferred_element_type=x.dtype)        # (c, k, D)
+    mt = jnp.einsum("kd,kde->ke", means, prec_chol,
+                    preferred_element_type=x.dtype)        # (k, D)
+    quad = jnp.sum((xt - mt[None]) ** 2, axis=-1)          # (c, k)
+    d = x.shape[1]
+    return (log_weights[None, :] + log_det_half[None, :]
+            - 0.5 * (quad + d * _LOG2PI))
+
+
+def _log_prob_tied_chunk(x, means_t, prec_chol, log_det_half, log_weights):
+    """(chunk, k) weighted log joint for a TIED covariance: with ONE
+    shared precision Cholesky P, transform once (``xt = x @ P`` — a
+    single matmul) and the quadratic form becomes the SAME
+    ``||xt||^2 + ||mt||^2 - 2 xt mt^T`` two-matmul MXU shape as the
+    diagonal density.  ``means_t`` must be pre-transformed (mu @ P)."""
+    xt = x @ prec_chol                                     # (c, D) MXU
+    x2 = jnp.sum(xt * xt, axis=1)[:, None]
+    cross = lax.dot_general(xt, means_t, (((1,), (1,)), ((), ())),
+                            preferred_element_type=x.dtype)  # (c, k) MXU
+    m2 = jnp.sum(means_t * means_t, axis=1)[None, :]
+    quad = x2 - 2.0 * cross + m2
+    d = x.shape[1]
+    return (log_weights[None, :] + log_det_half
+            - 0.5 * (quad + d * _LOG2PI))
+
+
+def _softmax_resp(logp, w, model_shards: int):
+    """Shared responsibility softmax with the cross-model-axis
+    normalizer reconstruction; returns (resp, lse)."""
+    m = jnp.max(logp, axis=1)
+    if model_shards > 1:
+        m = lax.pmax(m, MODEL_AXIS)
+    p = jnp.exp(logp - m[:, None])
+    denom = jnp.sum(p, axis=1)
+    if model_shards > 1:
+        denom = lax.psum(denom, MODEL_AXIS)
+    lse = m + jnp.log(denom)
+    return p / denom[:, None] * w[:, None], lse
+
+
+def make_gmm_step_full_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
+    """Full-covariance SPMD E-step: (points, weights, shift, means_c,
+    prec_chol (k, D, D), log_det_half (k,), log_weights) -> EStatsFull
+    replicated.  Parameter tables row-shard on the ``model`` axis
+    (components); the scatter moment accumulates at HIGHEST matmul
+    precision for the same bf16-cancellation reason as the diag moments
+    (see _estep_tile)."""
+    data_shards, model_shards = mesh_shape(mesh)
+
+    def step(points, weights, shift, means, prec_chol, log_det_half,
+             log_weights):
+        k_local, d = means.shape
+        acc = points.dtype
+        n_chunks = points.shape[0] // chunk_size
+        xs = (points.reshape(n_chunks, chunk_size, d),
+              weights.astype(acc).reshape(n_chunks, chunk_size))
+        hi = lax.Precision.HIGHEST
+
+        def body(carry, chunk):
+            xc_raw, wc = chunk
+            xc = xc_raw - shift[None, :]
+            logp = _log_prob_full_chunk(xc, means, prec_chol,
+                                        log_det_half, log_weights)
+            resp, lse = _softmax_resp(logp, wc, model_shards)
+            st = EStatsFull(
+                resp_sum=jnp.sum(resp, axis=0),
+                xsum=lax.dot_general(resp, xc, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=acc,
+                                     precision=hi),
+                scatter=jnp.einsum("ck,cd,ce->kde", resp, xc, xc,
+                                   preferred_element_type=acc,
+                                   precision=hi),
+                loglik=jnp.sum(jnp.where(wc > 0, lse * wc, 0.0)))
+            return EStatsFull(carry.resp_sum + st.resp_sum,
+                              carry.xsum + st.xsum,
+                              carry.scatter + st.scatter,
+                              carry.loglik + st.loglik), None
+
+        init = EStatsFull(jnp.zeros((k_local,), acc),
+                          jnp.zeros((k_local, d), acc),
+                          jnp.zeros((k_local, d, d), acc),
+                          jnp.zeros((), acc))
+        st, _ = lax.scan(body, init, xs)
+        # Embed + psum (the K-Means embedding pattern).
+        k_pad = k_local * model_shards
+        m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
+        off = jnp.asarray(m_idx * k_local, jnp.int32)
+        axes = (DATA_AXIS, MODEL_AXIS)
+        resp = lax.psum(lax.dynamic_update_slice(
+            jnp.zeros((k_pad,), acc), st.resp_sum, (off,)), axes)
+        xsum = lax.psum(lax.dynamic_update_slice(
+            jnp.zeros((k_pad, d), acc), st.xsum,
+            (off, jnp.int32(0))), axes)
+        scatter = lax.psum(lax.dynamic_update_slice(
+            jnp.zeros((k_pad, d, d), acc), st.scatter,
+            (off, jnp.int32(0), jnp.int32(0))), axes)
+        ll = lax.psum(st.loglik, axes) / model_shards
+        return EStatsFull(resp, xsum, scatter, ll)
+
+    mapped = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None),
+                  P(MODEL_AXIS, None), P(MODEL_AXIS, None, None),
+                  P(MODEL_AXIS), P(MODEL_AXIS)),
+        out_specs=EStatsFull(P(None), P(None, None),
+                             P(None, None, None), P()),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+def make_gmm_step_tied_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
+    """Tied-covariance SPMD E-step: (points, weights, shift, means_t
+    (pre-transformed mu_c @ P), prec_chol (D, D) replicated,
+    log_det_half (), log_weights) -> EStats replicated with ``x2sum``
+    zero (the tied M-step derives the covariance from the loop-invariant
+    total scatter + means, so no per-component second moment is
+    accumulated)."""
+    data_shards, model_shards = mesh_shape(mesh)
+
+    def step(points, weights, shift, means_t, prec_chol, log_det_half,
+             log_weights):
+        k_local, d = means_t.shape
+        acc = points.dtype
+        n_chunks = points.shape[0] // chunk_size
+        xs = (points.reshape(n_chunks, chunk_size, d),
+              weights.astype(acc).reshape(n_chunks, chunk_size))
+        hi = lax.Precision.HIGHEST
+
+        def body(carry, chunk):
+            xc_raw, wc = chunk
+            xc = xc_raw - shift[None, :]
+            logp = _log_prob_tied_chunk(xc, means_t, prec_chol,
+                                        log_det_half, log_weights)
+            resp, lse = _softmax_resp(logp, wc, model_shards)
+            st = EStats(
+                resp_sum=jnp.sum(resp, axis=0),
+                xsum=lax.dot_general(resp, xc, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=acc,
+                                     precision=hi),
+                x2sum=carry.x2sum,          # elided — not accumulated
+                loglik=jnp.sum(jnp.where(wc > 0, lse * wc, 0.0)))
+            return EStats(carry.resp_sum + st.resp_sum,
+                          carry.xsum + st.xsum, carry.x2sum,
+                          carry.loglik + st.loglik), None
+
+        init = EStats(jnp.zeros((k_local,), acc),
+                      jnp.zeros((k_local, d), acc),
+                      jnp.zeros((k_local, d), acc), jnp.zeros((), acc))
+        st, _ = lax.scan(body, init, xs)
+        return _embed_psum(st, k_local * model_shards, k_local,
+                           model_shards)
+
+    mapped = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None),
+                  P(MODEL_AXIS, None), P(None, None), P(), P(MODEL_AXIS)),
+        out_specs=EStats(P(None), P(None, None), P(None, None), P()),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+def make_total_scatter_fn(mesh: Mesh) -> Callable:
+    """(points, weights, shift) -> (D, D) total weighted scatter
+    ``sum_i w_i (x_i - shift)(x_i - shift)^T``, replicated — the
+    loop-INVARIANT term of the tied M-step (computed once per fit)."""
+    def total(points, weights, shift):
+        xc = points - shift[None, :]
+        w = weights.astype(points.dtype)
+        t = lax.dot_general(xc * w[:, None], xc, (((0,), (0,)), ((), ())),
+                            preferred_element_type=points.dtype,
+                            precision=lax.Precision.HIGHEST)
+        return lax.psum(t, DATA_AXIS)
+
+    mapped = jax.shard_map(
+        total, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None)),
+        out_specs=P(None, None), check_vma=False)
+    return jax.jit(mapped)
+
+
+def _predict_from_logp(logp_fn, points, chunk_size, k_local, d,
+                       model_shards):
+    """Shared posterior scan: per chunk compute logp via ``logp_fn``,
+    reconstruct global labels/log-resp/lse across the model axis."""
+    n_chunks = points.shape[0] // chunk_size
+    xs = points.reshape(n_chunks, chunk_size, d)
+    m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
+
+    def body(_, xc):
+        logp = logp_fn(xc)
+        best_l = jnp.argmax(logp, axis=1).astype(jnp.int32)
+        max_l = jnp.max(logp, axis=1)
+        if model_shards > 1:
+            maxes = lax.all_gather(max_l, MODEL_AXIS)
+            owner = jnp.argmax(maxes, axis=0)
+            m_glob = jnp.max(maxes, axis=0)
+            labels = lax.psum(
+                jnp.where(owner == m_idx, m_idx * k_local + best_l, 0),
+                MODEL_AXIS).astype(jnp.int32)
+        else:
+            m_glob, labels = max_l, best_l
+        denom = jnp.sum(jnp.exp(logp - m_glob[:, None]), axis=1)
+        if model_shards > 1:
+            denom = lax.psum(denom, MODEL_AXIS)
+        lse = m_glob + jnp.log(denom)
+        return None, (labels, logp - lse[:, None], lse)
+
+    _, (labels, logr, lse) = lax.scan(body, None, xs)
+    return (labels.reshape(-1), logr.reshape(-1, k_local),
+            lse.reshape(-1))
+
+
+def make_gmm_predict_full_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
+    """Full-covariance posterior pass (same contract as
+    ``make_gmm_predict_fn``)."""
+    data_shards, model_shards = mesh_shape(mesh)
+
+    def predict(points, shift, means, prec_chol, log_det_half,
+                log_weights):
+        k_local, d = means.shape
+        return _predict_from_logp(
+            lambda xc: _log_prob_full_chunk(
+                xc - shift[None, :], means, prec_chol, log_det_half,
+                log_weights),
+            points, chunk_size, k_local, d, model_shards)
+
+    mapped = jax.shard_map(
+        predict, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(None), P(MODEL_AXIS, None),
+                  P(MODEL_AXIS, None, None), P(MODEL_AXIS),
+                  P(MODEL_AXIS)),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS, MODEL_AXIS), P(DATA_AXIS)),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+def make_gmm_predict_tied_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
+    """Tied-covariance posterior pass (same contract as
+    ``make_gmm_predict_fn``; ``means_t`` pre-transformed)."""
+    data_shards, model_shards = mesh_shape(mesh)
+
+    def predict(points, shift, means_t, prec_chol, log_det_half,
+                log_weights):
+        k_local, d = means_t.shape
+        return _predict_from_logp(
+            lambda xc: _log_prob_tied_chunk(
+                xc - shift[None, :], means_t, prec_chol, log_det_half,
+                log_weights),
+            points, chunk_size, k_local, d, model_shards)
+
+    mapped = jax.shard_map(
+        predict, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(None), P(MODEL_AXIS, None),
+                  P(None, None), P(), P(MODEL_AXIS)),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS, MODEL_AXIS), P(DATA_AXIS)),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
 def make_gmm_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
-                    max_iter: int, tol: float, reg_covar: float):
+                    max_iter: int, tol: float, reg_covar: float,
+                    cov_type: str = "diag"):
     """Build the FULLY ON-DEVICE EM loop: all iterations in ONE dispatch
     under ``lax.while_loop`` — the mixture analogue of
     ``distributed.make_fit_fn`` (r2 VERDICT next-round #3).
@@ -325,6 +583,14 @@ def make_gmm_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
             new_var = jnp.maximum(
                 st.x2sum / Rc[:, None] - mu ** 2 + reg_covar,
                 jnp.maximum(jnp.asarray(reg_covar, acc), tiny))
+            if cov_type == "spherical":
+                # One scalar variance per component: the mean of the
+                # per-dim variances (sklearn's spherical M-step),
+                # carried broadcast over D so the diag E-step is reused
+                # unchanged.
+                new_var = jnp.broadcast_to(
+                    jnp.mean(new_var, axis=1, keepdims=True),
+                    new_var.shape)
             pi = jnp.maximum(st.resp_sum / jnp.maximum(w_total, pi_floor),
                              pi_floor)
             pi = pi / jnp.sum(jnp.where(real, pi, 0.0))

@@ -138,7 +138,7 @@ def test_sample_and_information_criteria():
 
 def test_guards():
     with pytest.raises(ValueError, match="covariance_type"):
-        GaussianMixture(covariance_type="full")
+        GaussianMixture(covariance_type="banana")
     with pytest.raises(ValueError, match="n_components"):
         GaussianMixture(n_components=0)
     with pytest.raises(ValueError, match="init_params"):
@@ -361,7 +361,7 @@ def test_set_params_validates():
     with pytest.raises(ValueError, match="n_components"):
         gm.set_params(n_components=0)
     with pytest.raises(ValueError, match="covariance_type"):
-        gm.set_params(covariance_type="full")
+        gm.set_params(covariance_type="banana")
     with pytest.raises(ValueError, match="invalid parameter"):
         gm.set_params(bogus=1)
     # Failed set_params leaves the model untouched.

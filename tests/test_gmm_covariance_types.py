@@ -1,0 +1,171 @@
+"""GaussianMixture covariance_type 'spherical'/'tied'/'full' (r3 VERDICT
+#5 — diag-only was an immediate wall for sklearn users, whose default is
+'full').  Parity oracle: sklearn.mixture.GaussianMixture with shared
+init and tolerance on correlated-covariance fixtures."""
+
+import numpy as np
+import pytest
+
+from kmeans_tpu import GaussianMixture
+
+ALL_TYPES = ("diag", "spherical", "tied", "full")
+
+
+def _correlated_blobs(n_per=800, seed=0):
+    """Three 2-D blobs, two with strong feature correlation — the shape
+    diag covariances cannot represent."""
+    rng = np.random.default_rng(seed)
+    A1 = np.array([[1.0, 0.8], [0.0, 0.6]])
+    A2 = np.array([[0.5, -0.4], [0.3, 1.0]])
+    X = np.concatenate([
+        rng.normal(size=(n_per, 2)) @ A1.T + [5, 5],
+        rng.normal(size=(n_per, 2)) @ A2.T + [-5, -3],
+        rng.normal(size=(n_per, 2)) * 0.7 + [5, -6]])
+    return X.astype(np.float32)
+
+
+INIT = np.array([[5, 5], [-5, -3], [5, -6]], np.float64)
+COV_SHAPES = {"diag": (3, 2), "spherical": (3,), "tied": (2, 2),
+              "full": (3, 2, 2)}
+
+
+@pytest.fixture(scope="module")
+def Xc():
+    return _correlated_blobs()
+
+
+@pytest.mark.parametrize("ct", ALL_TYPES)
+def test_matches_sklearn_shared_init(ct, Xc):
+    skm = pytest.importorskip("sklearn.mixture")
+    gm = GaussianMixture(n_components=3, covariance_type=ct,
+                         means_init=INIT, max_iter=60, tol=1e-5,
+                         seed=0).fit(Xc)
+    sk = skm.GaussianMixture(n_components=3, covariance_type=ct,
+                             means_init=INIT, max_iter=60, tol=1e-5,
+                             random_state=0).fit(Xc.astype(np.float64))
+    assert gm.covariances_.shape == COV_SHAPES[ct]
+    np.testing.assert_allclose(gm.lower_bound_, sk.lower_bound_,
+                               rtol=1e-4)
+    np.testing.assert_allclose(gm.means_, sk.means_, atol=5e-2)
+    np.testing.assert_allclose(gm.covariances_, sk.covariances_,
+                               rtol=0.1, atol=5e-2)
+    np.testing.assert_allclose(gm.weights_, sk.weights_, atol=1e-2)
+
+
+def test_full_beats_diag_on_correlated_data(Xc):
+    """The capability justification: on correlated clusters the full
+    model must reach a strictly better lower bound than diag."""
+    kw = dict(n_components=3, means_init=INIT, max_iter=60, tol=1e-5,
+              seed=0)
+    full = GaussianMixture(covariance_type="full", **kw).fit(Xc)
+    diag = GaussianMixture(covariance_type="diag", **kw).fit(Xc)
+    assert full.lower_bound_ > diag.lower_bound_ + 0.05
+
+
+@pytest.mark.parametrize("ct", ("tied", "full"))
+def test_model_sharded_matches_single_device(ct, Xc, mesh4x2, mesh1):
+    """Component (model-axis) sharding composes with the non-diag
+    densities: the tied/full E-step's cross-shard softmax normalizer and
+    scatter psum must reproduce the single-device fit."""
+    kw = dict(n_components=3, covariance_type=ct, means_init=INIT,
+              max_iter=25, tol=1e-5, seed=0)
+    a = GaussianMixture(mesh=mesh4x2, **kw).fit(Xc)
+    b = GaussianMixture(mesh=mesh1, **kw).fit(Xc)
+    np.testing.assert_allclose(a.lower_bound_, b.lower_bound_, rtol=1e-5)
+    np.testing.assert_allclose(a.means_, b.means_, atol=1e-4)
+    np.testing.assert_allclose(a.covariances_, b.covariances_, atol=1e-4)
+    np.testing.assert_array_equal(a.predict(Xc), b.predict(Xc))
+
+
+def test_spherical_device_loop_matches_host(Xc, mesh8):
+    kw = dict(n_components=3, covariance_type="spherical",
+              means_init=INIT, max_iter=25, tol=1e-6, seed=0, mesh=mesh8,
+              dtype=np.float64)
+    host = GaussianMixture(host_loop=True, **kw).fit(Xc)
+    dev = GaussianMixture(host_loop=False, **kw).fit(Xc)
+    np.testing.assert_allclose(dev.lower_bound_, host.lower_bound_,
+                               rtol=1e-8)
+    np.testing.assert_allclose(dev.covariances_, host.covariances_,
+                               rtol=1e-6)
+    assert dev.covariances_.shape == (3,)
+
+
+@pytest.mark.parametrize("ct", ("tied", "full"))
+def test_device_loop_guard(ct, Xc):
+    gm = GaussianMixture(n_components=3, covariance_type=ct,
+                         means_init=INIT, host_loop=False)
+    with pytest.raises(ValueError, match="host_loop=False supports"):
+        gm.fit(Xc)
+
+
+@pytest.mark.parametrize("ct", ALL_TYPES)
+def test_posterior_and_sampling_surfaces(ct, Xc):
+    gm = GaussianMixture(n_components=3, covariance_type=ct,
+                         means_init=INIT, max_iter=30, seed=0).fit(Xc)
+    proba = gm.predict_proba(Xc[:100])
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+    assert np.isfinite(gm.score(Xc))
+    S, comp = gm.sample(500)
+    assert S.shape == (500, 2) and comp.shape == (500,)
+    # Sampled data scores reasonably under the model it came from.
+    assert gm.score(S) > gm.score(Xc) - 2.0
+    prec = gm.precisions_
+    if ct in ("diag", "spherical"):
+        assert prec.shape == gm.covariances_.shape
+    else:
+        # P P^T must invert the covariance.
+        eye = np.eye(2)
+        cov = gm.covariances_
+        prod = prec @ cov if ct == "tied" else np.einsum(
+            "kde,kef->kdf", prec, cov)
+        np.testing.assert_allclose(prod, np.broadcast_to(
+            eye, prod.shape), atol=1e-4)
+
+
+@pytest.mark.parametrize("ct", ALL_TYPES)
+def test_bic_penalty_matches_sklearn(ct, Xc):
+    skm = pytest.importorskip("sklearn.mixture")
+    gm = GaussianMixture(n_components=3, covariance_type=ct,
+                         means_init=INIT, max_iter=20, seed=0).fit(Xc)
+    sk = skm.GaussianMixture(n_components=3, covariance_type=ct,
+                             means_init=INIT, max_iter=20,
+                             random_state=0).fit(Xc.astype(np.float64))
+    assert gm._n_parameters() == sk._n_parameters()
+    np.testing.assert_allclose(gm.bic(Xc), sk.bic(Xc.astype(np.float64)),
+                               rtol=1e-3)
+
+
+@pytest.mark.parametrize("ct", ("spherical", "tied", "full"))
+def test_save_load_roundtrip_types(ct, Xc, tmp_path):
+    gm = GaussianMixture(n_components=3, covariance_type=ct,
+                         means_init=INIT, max_iter=15, seed=0).fit(Xc)
+    gm.save(tmp_path / "gm.npz")
+    back = GaussianMixture.load(tmp_path / "gm.npz")
+    assert back.covariance_type == ct
+    np.testing.assert_array_equal(back.covariances_, gm.covariances_)
+    np.testing.assert_array_equal(back.predict(Xc[:200]),
+                                  gm.predict(Xc[:200]))
+
+
+def test_precisions_init_roundtrip_full(Xc):
+    """Explicit precisions_init for 'full' is inverted into covariances."""
+    prec = np.stack([np.eye(2) * 2.0] * 3)
+    gm = GaussianMixture(n_components=3, covariance_type="full",
+                         means_init=INIT, precisions_init=prec,
+                         max_iter=1, tol=1e12, seed=0).fit(Xc)
+    assert gm.covariances_.shape == (3, 2, 2)
+
+
+def test_ill_defined_covariance_raises():
+    """Duplicated rows + reg_covar=0 under 'full' collapse a component's
+    covariance to singular: the Cholesky fails with sklearn's
+    ill-defined-covariance error, not a cryptic LinAlgError."""
+    X = np.concatenate([np.full((200, 2), 3.0),
+                        np.random.default_rng(0).normal(
+                            size=(200, 2))]).astype(np.float32)
+    gm = GaussianMixture(n_components=2, covariance_type="full",
+                         reg_covar=0.0, max_iter=10, seed=0,
+                         means_init=np.array([[3.0, 3.0], [0.0, 0.0]]))
+    with pytest.raises(ValueError,
+                       match="ill-defined empirical covariance"):
+        gm.fit(X)
