@@ -436,16 +436,12 @@ def streamed_kmeans_parallel_init(make_blocks, k: int, seeds, d: int,
         """Blocks padded to a fixed row multiple (>= cap, so top_k's
         static argument is always just ``cap``): ragged streams compile
         one program per round instead of one per block length."""
+        from kmeans_tpu.parallel.sharding import pad_points
         mult = -(-cap // 512) * 512      # >= cap AND a 512-chunk multiple
         for block in make_blocks():
-            x = np.ascontiguousarray(np.asarray(block, dtype=dtype))
-            pad = (-x.shape[0]) % mult
-            w = np.ones(x.shape[0] + pad, dtype)
-            if pad:
-                x = np.concatenate(
-                    [x, np.zeros((pad, x.shape[1]), dtype)])
-                w[x.shape[0] - pad:] = 0.0
-            yield x, w
+            yield pad_points(
+                np.ascontiguousarray(np.asarray(block, dtype=dtype)),
+                mult)
 
     phi = np.zeros(R)
     for x, w in epoch_blocks():                      # pass: initial phi
