@@ -1,0 +1,98 @@
+"""GloVe-shape (400k x 100, k=3000) Pallas MFU investigation (r3 VERDICT
+#7): the kernel-level analysis stopped at "tile choice" — this sweep
+measures tile-balance and pipelining variants and tests the hypothesis
+that the 55%-vs-70% MFU gap is EXACTLY the 128-lane padding waste
+(D=100 -> 128 is 1.28x MXU work the real-FLOPs MFU definition gives no
+credit for; k=3000 -> 3072 another 1.024x; 70% / 1.31 = 53.4%).
+
+Run on TPU hardware:  python experiments/exp_glove_mfu.py
+"""
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from kmeans_tpu.ops.pallas_kernels import (fused_assign_reduce,
+                                           prep_points, choose_tiles,
+                                           _round_up)
+
+N, D, K = 400_000, 100, 3000
+PEAK_TFLOPS = 197.0          # v5e bf16 peak (the rate f32 dots run at)
+REAL_TFLOP_PER_PASS = 4.0 * N * D * K / 1e12   # distance + scatter matmuls
+
+
+def bench(tile_n, tile_k, iters=60, gap=40):
+    """Marginal ms/pass via the iteration-gap method, whole loop in one
+    dispatch (the tunneled chip's dispatch latency would otherwise swamp
+    a ~4 ms kernel; a scalar transfer is the only reliable barrier)."""
+    key = jax.random.PRNGKey(0)
+    x_raw = jax.random.normal(key, (N, D), jnp.float32)
+    w_raw = jnp.ones((N,), jnp.float32)
+    c0 = x_raw[:K] * 1.0
+    x, w, w_col = prep_points(x_raw, w_raw)
+
+    def many(n_it):
+        @jax.jit
+        def run(x, w_col, c):
+            def body(i, c):
+                _, _, sums, counts = fused_assign_reduce(
+                    x, w_col, c, tile_n=tile_n, tile_k=tile_k,
+                    with_mind2=False)
+                # Data dependency so no pass is DCE'd; *0 keeps c fixed.
+                return c + 0.0 * sums
+            return jnp.sum(lax.fori_loop(0, n_it, body, c))
+
+        float(run(x, w_col, c0))                 # compile + warm
+        reps = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            float(run(x, w_col, c0))             # scalar sync
+            reps.append(time.perf_counter() - t0)
+        return float(np.median(reps))
+
+    t_small = many(2)
+    t_big = many(2 + gap)
+    ms = (t_big - t_small) / gap * 1e3
+    return ms
+
+
+def main():
+    assert jax.default_backend() == "tpu", "run on TPU hardware"
+    d_pad = _round_up(D, 128)
+    k_pad = _round_up(K, 128)
+    auto = choose_tiles(N, d_pad, k_pad, fold=D < d_pad)
+    print(f"auto tiles: {auto}; real TFLOP/pass {REAL_TFLOP_PER_PASS:.3f}; "
+          f"pad waste {d_pad / D * _round_up(k_pad, auto[1]) / K:.3f}x",
+          flush=True)
+    results = {}
+    for tile_n, tile_k in [(1024, 3072), (512, 3072), (2048, 3072),
+                           (1024, 1536), (512, 1536), (2048, 1536),
+                           (1024, 1024), (1024, 768)]:
+        try:
+            ms = bench(tile_n, tile_k)
+        except Exception as e:                   # VMEM guard etc.
+            print(f"tile_n={tile_n:5d} tile_k={tile_k:5d}: "
+                  f"SKIP ({type(e).__name__})", flush=True)
+            continue
+        mfu = REAL_TFLOP_PER_PASS / (ms / 1e3) / PEAK_TFLOPS
+        # Padded-FLOPs utilization: how hard the MXU actually runs.
+        kp = _round_up(k_pad, tile_k)
+        hw = mfu * (d_pad / D) * (kp / K)
+        results[(tile_n, tile_k)] = ms
+        print(f"tile_n={tile_n:5d} tile_k={tile_k:5d}: {ms:7.3f} ms/pass  "
+              f"MFU(real) {mfu * 100:5.1f}%  MXU-util(padded) "
+              f"{hw * 100:5.1f}%", flush=True)
+    best = min(results, key=results.get)
+    print(f"best: {best} at {results[best]:.3f} ms "
+          f"(auto {auto}: {results.get(auto, float('nan')):.3f} ms)")
+
+
+if __name__ == "__main__":
+    main()

@@ -592,7 +592,11 @@ class GaussianMixture:
         epoch computes all live restarts' statistics from one shared
         pass (R x compute, 1x IO) — and the winner is the restart with
         the highest final ``lower_bound_``, the in-memory selection
-        rule.
+        rule.  Exception: ``init_params='kmeans'`` refines each
+        restart's seeds with its OWN ~20-epoch streamed Lloyd fit (R x
+        the IO, the one phase that does not share passes) — on IO-bound
+        streams prefer ``'k-means++'`` (seeding only) or an explicit
+        ``means_init``.
 
         Setup passes before the EM epochs: one for the centering shift
         (+ one for the tied total scatter), the init strategy's passes
@@ -606,7 +610,13 @@ class GaussianMixture:
         from kmeans_tpu.models.init import (streamed_forgy_init,
                                             streamed_kmeans_parallel_init)
         if d is None:
-            peek = np.asarray(next(iter(make_blocks())), dtype=self.dtype)
+            try:
+                peek = np.asarray(next(iter(make_blocks())),
+                                  dtype=self.dtype)
+            except StopIteration:
+                raise ValueError(
+                    "make_blocks() yielded no rows — it must return a "
+                    "FRESH iterable on every call") from None
             if peek.ndim != 2:
                 raise ValueError(f"blocks must be 2-D (m, D), got shape "
                                  f"{peek.shape}")
@@ -794,7 +804,10 @@ class GaussianMixture:
                 params[i] = (pi, mu_c + shift, var)
                 st.ll = float(stats[j].loglik) / w_total
                 st.n_iter = it
-                if self.verbose and i == 0:
+                # Narrate the lowest LIVE restart, not restart 0 — the
+                # log must not go silent while later restarts still run
+                # epochs (review r4).
+                if self.verbose and i == live[0]:
                     print(f"EM iteration {it}: mean log-likelihood = "
                           f"{st.ll:.6f} "
                           f"[{(time.perf_counter() - t0) * 1e3:.1f} ms]",

@@ -95,26 +95,50 @@ def _round_up(a: int, b: int) -> int:
     return _cdiv(a, b) * b
 
 
-def choose_tiles(n: int, d_pad: int, k_pad: int) -> Tuple[int, int]:
-    """Measured tile heuristic (v5e sweep, experiments/exp_pallas_kernel.py).
-    k-tiles narrower than 512 lanes are the failure mode (k=512 as 2x256:
-    7.1 ms vs 3.1 for one 512 tile; k=1024 as 8x128: 39 ms): never split
-    below 512.  Two ~512 tiles beat one 1024 tile at k=1024 (7.4 vs
-    8.8 ms — the pipelined phases interleave); k_pad >= 2048 wants wide
-    balanced tiles up to 4096 (one 3072 tile beats 6x512 by 4.6x), with
-    balance so the round-up to a tile_k multiple never inflates k_pad by
-    more than one 128-lane register (k=4224 with a fixed 4096 tile would
-    pad to 8192 — ~1.9x the MXU work).  tile_n targets ~2^22 tile
-    elements, capped at 2048 rows."""
+def choose_tiles(n: int, d_pad: int, k_pad: int,
+                 fold: Optional[bool] = None) -> Tuple[int, int]:
+    """Measured tile heuristic (v5e sweeps, experiments/
+    exp_pallas_kernel.py + exp_glove_mfu.py).
+
+    k-tiles narrower than 512 lanes are the failure mode (k=512 as
+    2x256: 7.1 ms vs 3.1 for one 512 tile; k=1024 as 8x128: 39 ms):
+    never split below 512.  Two ~512 tiles beat one 1024 tile at k=1024
+    (7.4 vs 8.8 ms — the pipelined phases interleave).  Above 2048 the
+    best split depends on the FOLD variant (r4 sweep at 400k rows):
+
+    * fold path (d < d_pad — h and counts ride the matmul): a 2-way
+      balanced split wins — k_pad=3072 as 2x1536 runs 3.48 ms vs 3.97
+      for one 3072 tile (70% vs 61% real-FLOPs MFU, 92% padded-MXU
+      utilization), k_pad=2048 as 2x1024 2.25 vs 2.75;
+    * no-fold (d == d_pad): the single wide tile wins — k_pad=2048
+      one-tile 2.65 vs 2.98 split, k_pad=4096 one-tile 6.79 vs 7.46 —
+      so tiles stay wide up to 4096, balanced so the round-up to a
+      tile_k multiple never inflates k_pad by more than one 128-lane
+      register (k=4224 with a fixed 4096 tile would pad to 8192 —
+      ~1.9x the MXU work).
+
+    tile_n: 1024 rows whenever tile_k >= 1024 — every r4 variant with
+    wide k-tiles ran best at 1024 rows ((1024,1536) 3.48 ms vs
+    (2048,1536) 4.19 and (512,1536) 5.15) — else the ~2^22-element
+    target capped at 2048 rows (the r2-measured best for 512-wide
+    tiles).  ``fold`` tells the rule the data's true width is below
+    ``d_pad``."""
+    if fold is None:
+        fold = False            # conservative: unknown true D
     if k_pad >= 2048:
         k_tiles = _cdiv(k_pad, 4096)
+        if fold:
+            k_tiles = max(2, k_tiles)
         tile_k = _round_up(_cdiv(k_pad, k_tiles), 128)
     elif k_pad >= 1024:
         tile_k = _round_up(k_pad // 2, 128)        # two >=512-wide tiles
     else:
         tile_k = k_pad                             # never split below 512
-    tile_n = max(256, min(2048, (1 << 22) // max(tile_k, d_pad)))
-    tile_n = 1 << (tile_n.bit_length() - 1)        # power-of-2 floor
+    if tile_k >= 1024:
+        tile_n = 1024
+    else:
+        tile_n = max(256, min(2048, (1 << 22) // max(tile_k, d_pad)))
+        tile_n = 1 << (tile_n.bit_length() - 1)    # power-of-2 floor
     return tile_n, tile_k
 
 
@@ -144,7 +168,7 @@ def pallas_preferred(n: int, d: int, k: int) -> bool:
     k_pad0 = _round_up(k, 128)
     if k < 512 or d_pad * k_pad0 > 1.5 * d * k:
         return False
-    tile_n, tile_k = choose_tiles(n, d_pad, k_pad0)
+    tile_n, tile_k = choose_tiles(n, d_pad, k_pad0, fold=d < d_pad)
     k_pad = _round_up(k_pad0, tile_k)
     return _vmem_estimate(tile_n, tile_k, d_pad, k_pad,
                           True) <= _VMEM_LIMIT
@@ -445,7 +469,7 @@ def _call(points, weights, centroids, *, tile_n, tile_k, bf16, interpret,
     d_pad0 = _round_up(d, 128)
     k_pad0 = _round_up(k, 128)
     if tile_n is None or tile_k is None:
-        auto_n, auto_k = choose_tiles(n, d_pad0, k_pad0)
+        auto_n, auto_k = choose_tiles(n, d_pad0, k_pad0, fold=d < d_pad0)
         tile_n = tile_n or auto_n
         tile_k = tile_k or auto_k
     tile_n = min(tile_n, _round_up(max(n, 8), 8))
