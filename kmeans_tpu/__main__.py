@@ -21,7 +21,10 @@ def main() -> int:
     if cmd == "fit":
         from kmeans_tpu.cli import main as fit_main
         return fit_main(rest)
-    print(f"unknown command {cmd!r}; available: suite, bench, fit",
+    if cmd == "report":
+        from kmeans_tpu.utils.diagram import main as report_main
+        return report_main(rest)
+    print(f"unknown command {cmd!r}; available: suite, bench, fit, report",
           file=sys.stderr)
     return 2
 

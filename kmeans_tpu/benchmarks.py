@@ -152,36 +152,84 @@ def bench_config(name: str, iters: int, mode: str) -> Dict:
 
     fit_small = build(2)
     t0 = time.perf_counter()
-    timed(fit_small, 2)
-    _log(f"[{name}] compile+warmup(2-iter) {time.perf_counter() - t0:.1f}s")
+    timed(fit_small, 2)                              # compile
+    t_small, _ = timed(fit_small, 2)                 # warm dispatch floor
+    _log(f"[{name}] compile+warmup(2-iter) {time.perf_counter() - t0:.1f}s"
+         f" (dispatch floor {t_small * 1e3:.0f} ms)")
 
-    # Adaptive: grow the iteration gap until the marginal time rises above
-    # the dispatch-latency noise floor (~50 ms on tunneled platforms).
-    # The grow/stop decision uses the MEDIAN of 3 interleaved pairs (r1
-    # VERDICT #8) — r3 fix: deciding on a single pair let one noise spike
-    # stop the growth early and mis-report a measurable config as
-    # noise-limited.  The cap is high — the 5x growth stops at the first
-    # measured gap >= 50k iterations — because a while_loop's compile
-    # time does not depend on its trip count; only sub-µs/iter configs
-    # stay unmeasurable.
+    # Adaptive gap: the marginal must rise far enough above the per-pair
+    # dispatch noise (~±25 ms on tunneled platforms) that the PUBLISHED
+    # spread is <= ~5% — i.e. a BIG-run wall time of ~1.5 s, not merely
+    # a margin above the 50 ms noise floor (r3 published 44-47% spreads
+    # for the sub-5 ms-marginal glove/small rows; r4 fix per the repo's
+    # own methodology bar).  Growth is steered by the big run's DIRECT
+    # wall time with the measured dispatch floor subtracted — a marginal
+    # at the noise floor is garbage and once projected a 2M-iteration
+    # (~18 min) dispatch that CRASHED the TPU worker (r4, observed) —
+    # and clamped to 25x per step, so dispatches stay at seconds.  Stop
+    # decisions use the MEDIAN of 5 interleaved pairs (r1 VERDICT #8).
+    # A spread failure at a SUFFICIENT gap (projection says the current
+    # T already suffices — i.e. a tunnel-drift burst) re-measures
+    # without growing; if the spread still exceeds 5% after the retry
+    # budget, the row is published flagged ``indicative_only``.
+    TARGET_BIG, ITER_CAP = 1.5, 2_000_000
+    RAMP_BUDGET, SPREAD_BUDGET = 8, 2
+
     out_big = None
+    ramp = spread_tries = 0
+    built_iters = None
+    margin = spread = None
     while True:
-        fit_big = build(2 + iters)
-        _, out_big = timed(fit_big, 2 + iters)       # compile + warm
+        if built_iters != iters:
+            fit_big = build(2 + iters)
+            t_big, out_big = timed(fit_big, 2 + iters)   # compile/load
+            built_iters = iters
+            if t_big >= TARGET_BIG / 2:
+                # Near/over target: confirm with a warm run (the first
+                # call's trace/cache-load overhead could fake a pass).
+                t_big, _ = timed(fit_big, 2 + iters)
+            if t_big < TARGET_BIG and iters < ITER_CAP \
+                    and ramp < RAMP_BUDGET:
+                ramp += 1
+                # Dispatch-floor-corrected projection: t_big/(2+iters)
+                # alone is pure dispatch latency for tiny configs and
+                # would burn the whole budget in underestimates.
+                per_iter = max((t_big - t_small) / (2 + iters), 1e-9)
+                iters = int(min(ITER_CAP,
+                                min(iters * 25,
+                                    max(TARGET_BIG / per_iter,
+                                        iters * 5))))
+                _log(f"[{name}] big run {t_big * 1e3:.0f} ms below the "
+                     f"{TARGET_BIG:.1f} s target; retrying with "
+                     f"iters={iters}")
+                continue
         margin, spread, _ = measure_marginal(
             lambda: timed(fit_small, 2)[0],
-            lambda: timed(fit_big, 2 + iters)[0])
-        if margin > 0.05 or iters >= 50_000:
+            lambda: timed(fit_big, 2 + iters)[0], reps=5)
+        if spread <= 0.05 or iters >= ITER_CAP \
+                or spread_tries >= SPREAD_BUDGET:
             break
-        iters *= 5
-        _log(f"[{name}] marginal below noise floor; retrying with "
-             f"iters={iters}")
-    noise_limited = margin <= 0.05              # same floor as the loop
+        spread_tries += 1
+        est = max(margin, 1e-9) / iters
+        proj = 1.4 * TARGET_BIG / est
+        if proj > iters * 1.2:
+            iters = int(min(ITER_CAP, min(iters * 25, proj)))
+            _log(f"[{name}] spread {spread * 100:.0f}% above the 5% bar "
+                 f"with an undersized gap; retrying with iters={iters}")
+        else:
+            _log(f"[{name}] spread {spread * 100:.0f}% from tunnel drift "
+                 f"at a sufficient gap; re-measuring")
+    noise_limited = margin <= 0.05
+    indicative = (not noise_limited) and spread > 0.05
     if noise_limited:
         _log(f"[{name}] WARNING: marginal time ({margin:.3f}s over "
              f"{iters} iters) is within dispatch-latency noise — "
              f"per-iteration numbers are unmeasurable at this size and are "
              f"reported as null")
+    elif indicative:
+        _log(f"[{name}] WARNING: spread {spread * 100:.0f}% exceeds the "
+             f"5% publication bar after {spread_tries} retries "
+             f"(tunnel drift) — row flagged indicative_only")
     per_iter = margin / iters
     sse = float(np.asarray(out_big[2])[-1])          # last-iteration SSE
     n_chips = max(1, len(jax.devices()))
@@ -194,6 +242,7 @@ def bench_config(name: str, iters: int, mode: str) -> Dict:
         "spread": None if noise_limited else round(spread, 3),
         "sse": sse,
         "noise_limited": noise_limited,
+        "indicative_only": indicative,
     }
     print(json.dumps(result), flush=True)
     return result

@@ -1,0 +1,201 @@
+"""Rendered architecture-diagram + one-page report artifacts.
+
+Artifact-level parity with the reference's two binary documents
+(SURVEY.md header inventory): ``architecture_diagram-K-means_with_
+spark.jpg`` (a driver/worker dataflow flowchart) and
+``Distributed_KMeans_Report.pdf`` (one page: problem formulation,
+parallelization strategy, performance).  Unlike the reference — whose
+artifacts were produced out-of-band (its requirements.txt lists
+reportlab as "optional report generation" but never imports it) — both
+are REGENERATED from code: ``python -m kmeans_tpu report``.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+_LAYERS = [
+    ("L4  Harness + CLI",
+     "suite.py (narrative A–E, real exit codes) · benchmarks · "
+     "bench.py · cli fit · pytest (8-device CPU mesh + real "
+     "2-process run)"),
+    ("L3  Algorithm API",
+     "KMeans · MiniBatch (reassignment) · Bisecting · Spherical · "
+     "GaussianMixture (diag/spherical/tied/full) ·\ninit strategies · "
+     "checkpoint/resume · streaming fit/predict/transform · metrics"),
+    ("L2  Distributed primitives",
+     "Mesh (data × model) · ShardedDataset · shard_map SPMD step + "
+     "psum/all_gather ·\non-device while_loop fits · multihost "
+     "process-local loading"),
+    ("L1  Compute kernels",
+     "fused assign+reduce (matmul-form distances, one-hot scatter, "
+     "SSE, farthest) as chunked lax.scan · software-pipelined "
+     "Pallas/Mosaic kernel (fold-into-MXU, manual argmin)"),
+]
+
+_FLOW = [
+    ("points sharded on\nthe data axis\n(resident all fit)", 0),
+    ("fused chunk kernel:\ndistances → argmin →\none-hot scatter "
+     "(MXU)", 1),
+    ("dense (k, D+1)\naccumulator + SSE\nper shard", 2),
+    ("ONE lax.psum over\nthe mesh → replicated\nglobal stats", 3),
+    ("centroid update +\nconvergence check\n(host or in-loop)", 4),
+]
+
+
+def _require_matplotlib():
+    """matplotlib is an optional dependency (like the reference, whose
+    requirements.txt lists it for the speedup plot): fail with a
+    pointed message, not a bare ImportError."""
+    try:
+        import matplotlib  # noqa: F401
+    except ImportError:
+        raise ImportError(
+            "the report/diagram artifacts need matplotlib "
+            "(pip install matplotlib) — the library itself does not"
+        ) from None
+
+
+def render_architecture(path) -> Path:
+    """Render the layer map + per-iteration dataflow to a PNG.
+
+    The visual analogue of the reference's architecture JPG: its
+    driver→executor→shuffle→driver round trip becomes the one-psum SPMD
+    step (docs/ARCHITECTURE.md's ASCII layer map, rendered)."""
+    _require_matplotlib()
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    from matplotlib.patches import FancyArrowPatch, FancyBboxPatch
+
+    fig, (ax_l, ax_f) = plt.subplots(
+        2, 1, figsize=(11, 8.2), height_ratios=[4, 1.6])
+    fig.suptitle("kmeans_tpu — TPU-native distributed K-Means framework",
+                 fontsize=14, fontweight="bold")
+
+    colors = ["#cfe3f7", "#d8f0d3", "#fbe6c2", "#f3d1d4"]
+    ax_l.set_xlim(0, 10)
+    ax_l.set_ylim(0, len(_LAYERS) * 1.15)
+    ax_l.axis("off")
+    for i, (title, body) in enumerate(_LAYERS):
+        y = (len(_LAYERS) - 1 - i) * 1.15
+        ax_l.add_patch(FancyBboxPatch(
+            (0.15, y + 0.08), 9.7, 1.0,
+            boxstyle="round,pad=0.02", linewidth=1.2,
+            edgecolor="#444444", facecolor=colors[i]))
+        ax_l.text(0.35, y + 0.85, title, fontsize=11, fontweight="bold",
+                  va="top")
+        ax_l.text(0.55, y + 0.52, body, fontsize=8.5, va="top", wrap=True)
+    ax_l.set_title("Layer map (SURVEY.md §1 → TPU-native re-design)",
+                   fontsize=10, loc="left")
+
+    ax_f.set_xlim(0, 10)
+    ax_f.set_ylim(0, 2)
+    ax_f.axis("off")
+    ax_f.set_title("One Lloyd iteration = one jitted SPMD step (the "
+                   "reference's broadcast/shuffle/collect round-trip "
+                   "collapses into a single psum)", fontsize=10,
+                   loc="left")
+    w = 1.72
+    for text, i in _FLOW:
+        x = 0.15 + i * (w + 0.25)
+        ax_f.add_patch(FancyBboxPatch(
+            (x, 0.35), w, 1.25, boxstyle="round,pad=0.02",
+            linewidth=1.0, edgecolor="#444444", facecolor="#eeeeee"))
+        ax_f.text(x + w / 2, 0.97, text, fontsize=7.6, ha="center",
+                  va="center")
+        if i:
+            ax_f.add_patch(FancyArrowPatch(
+                (x - 0.23, 0.97), (x + 0.0, 0.97),
+                arrowstyle="-|>", mutation_scale=14, color="#333333"))
+
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fig.savefig(path, dpi=150, bbox_inches="tight")
+    plt.close(fig)
+    return path
+
+
+def render_report(path, *, diagram: Path = None,
+                  speedup: Path = None) -> Path:
+    """One-page PDF report: problem formulation, parallelization
+    strategy, measured performance — the content class of the
+    reference's ``Distributed_KMeans_Report.pdf``, with this repo's
+    measured numbers, regenerated from code."""
+    _require_matplotlib()
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.image as mpimg
+    import matplotlib.pyplot as plt
+
+    fig = plt.figure(figsize=(8.5, 11))
+    fig.text(0.5, 0.965, "kmeans_tpu: TPU-Native Distributed K-Means",
+             ha="center", fontsize=16, fontweight="bold")
+    fig.text(0.5, 0.945, "Project report (regenerated by "
+             "`python -m kmeans_tpu report`)", ha="center", fontsize=9,
+             style="italic")
+
+    body = (
+        "Problem formulation.  Partition n points in R^D into k clusters "
+        "minimizing the within-cluster sum of squared\ndistances (SSE), at "
+        "scales where one machine's memory and FLOPs are insufficient "
+        "(headline: 10M x 128, k=1024).\n"
+        "\n"
+        "Parallelization strategy.  Points are sharded across a device "
+        "mesh's data axis and stay resident for the whole\nfit; centroids "
+        "are replicated (or sharded on a second model axis when k*D is "
+        "large).  Each iteration is ONE jitted\nSPMD step: every shard "
+        "scans its chunks through a fused assign+reduce kernel (distances "
+        "in matmul form on the\nMXU, running argmin, one-hot scatter-sum) "
+        "into a dense (k, D+1) accumulator, and a single lax.psum "
+        "replicates\nthe global statistics.  The reference's per-iteration "
+        "broadcast -> per-point Python closures -> keyed shuffle ->\n"
+        "driver collect round-trip collapses into that one collective; "
+        "with host_loop=False the entire fit (convergence\ntest included) "
+        "is a single dispatch.  A hand-scheduled Pallas/Mosaic kernel "
+        "serves the large-k win region.\n"
+        "\n"
+        "Performance (TPU v5e, 1 chip, steady-state; BASELINE.md).  "
+        "Headline 10M x 128, k=1024: ~38.5 ms/iteration =\n3.3e10 "
+        "points*dims/s/chip (~12,000x an idealized 8-worker scaling of "
+        "the reference's measured per-point executor\nloop), ~69-70% MFU "
+        "of the chip's bf16 peak.  Final SSE matches a float64 oracle to "
+        "~3e-6 relative; centroid\nparity with scikit-learn to 1e-4 "
+        "(sorted centroids, shared init).  Strong scaling across mesh "
+        "sizes reproduces the\nreference's speedup-graph capability "
+        "(artifacts/speedup_graph.png).")
+    fig.text(0.06, 0.915, body, fontsize=8.3, va="top", family="serif")
+
+    y0 = 0.50
+    if diagram is not None and Path(diagram).exists():
+        ax = fig.add_axes([0.07, y0 - 0.33, 0.86, 0.36])
+        ax.imshow(mpimg.imread(diagram))
+        ax.axis("off")
+    if speedup is not None and Path(speedup).exists():
+        ax = fig.add_axes([0.25, 0.015, 0.5, 0.16])
+        ax.imshow(mpimg.imread(speedup))
+        ax.axis("off")
+
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fig.savefig(path, format="pdf", bbox_inches="tight")
+    plt.close(fig)
+    return path
+
+
+def main(argv=None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="python -m kmeans_tpu report",
+        description="Regenerate the architecture diagram + project "
+                    "report artifacts")
+    parser.add_argument("--out-dir", default="artifacts")
+    args = parser.parse_args(argv)
+    out = Path(args.out_dir)
+    diagram = render_architecture(out / "architecture_diagram.png")
+    print(f"wrote {diagram}")
+    report = render_report(out / "kmeans_tpu_report.pdf",
+                           diagram=diagram,
+                           speedup=out / "speedup_graph.png")
+    print(f"wrote {report}")
+    return 0

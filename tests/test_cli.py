@@ -82,3 +82,15 @@ def test_fit_cli_inertia_without_sse(data_file, tmp_path):
                      "--out-dir", str(out)]) == 0
     summary = json.loads((out / "summary.json").read_text())
     assert summary["inertia"] is not None and summary["inertia"] > 0
+
+
+def test_report_command_generates_artifacts(tmp_path):
+    """Artifact parity (r3 missing #1/#2): the architecture diagram and
+    the one-page report regenerate from code."""
+    pytest.importorskip("matplotlib")   # optional dep, like the plots
+    from kmeans_tpu.utils.diagram import main as report_main
+    assert report_main(["--out-dir", str(tmp_path)]) == 0
+    png = tmp_path / "architecture_diagram.png"
+    pdf = tmp_path / "kmeans_tpu_report.pdf"
+    assert png.exists() and png.stat().st_size > 10_000
+    assert pdf.exists() and pdf.stat().st_size > 10_000
