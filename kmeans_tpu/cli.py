@@ -42,7 +42,8 @@ def _build_model(args):
                   seed=args.seed, compute_sse=args.sse, init=args.init,
                   n_init=args.n_init, verbose=not args.quiet)
     if args.model == "minibatch":
-        # MiniBatchKMeans rejects n_init > 1 itself (clear error).
+        # n_init > 1 selects the best-scoring candidate init
+        # (sklearn-style), then runs one training session.
         return MiniBatchKMeans(batch_size=args.batch_size, **common)
     if args.model == "bisecting":
         return BisectingKMeans(**common)      # n_init applies per split

@@ -31,9 +31,9 @@ next-round #3):
 * ``model_shards > 1`` row-shards the (k, D) parameter tables over the
   mesh's model axis (component/TP sharding);
 * ``host_loop=False`` runs ALL EM iterations in one dispatch under a
-  device-side ``lax.while_loop`` (``gmm_step.make_gmm_fit_fn``;
-  'diag'/'spherical' — 'full'/'tied' M-steps need a Cholesky
-  factorization per iteration, kept on the float64 host path);
+  device-side ``lax.while_loop`` — all four covariance types
+  (full/tied factor their Cholesky on device per iteration,
+  ``gmm_step.make_gmm_fit_full_fn``/``_tied_fn``);
 * ``n_init`` runs seeded restarts (host-sequential; the winner is the
   restart with the highest final ``lower_bound_``).
 
@@ -63,6 +63,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kmeans_tpu.parallel.gmm_step import (EStats, EStatsFull,
                                           make_gmm_fit_fn,
+                                          make_gmm_fit_full_fn,
+                                          make_gmm_fit_tied_fn,
                                           make_gmm_predict_fn,
                                           make_gmm_predict_full_fn,
                                           make_gmm_predict_tied_fn,
@@ -841,12 +843,6 @@ class GaussianMixture:
         if w_total <= 0:
             raise ValueError("total sample weight must be positive")
         if not self.host_loop:
-            if self.covariance_type in ("full", "tied"):
-                raise ValueError(
-                    "host_loop=False supports covariance_type 'diag' and "
-                    "'spherical' only — the 'full'/'tied' M-step "
-                    "factorizes a Cholesky per iteration, which runs on "
-                    "the float64 host path; use host_loop=True")
             return self._fit_on_device(ds, mesh)
 
         self.converged_ = False
@@ -876,40 +872,66 @@ class GaussianMixture:
 
     def _fit_on_device(self, ds, mesh) -> None:
         """All EM iterations in ONE dispatch (``host_loop=False``) — the
-        mixture analogue of ``KMeans._fit_on_device``."""
+        mixture analogue of ``KMeans._fit_on_device``.  All four
+        covariance types: diag/spherical via ``make_gmm_fit_fn``,
+        full/tied via their own loops (batched on-device Cholesky per
+        iteration; a component collapsing to non-PD surfaces as the
+        loud non-finite-loglik error — the float64 host loop gives the
+        pointed ill-defined-covariance message instead)."""
+        ct = self.covariance_type
+        builder = {"diag": make_gmm_fit_fn, "spherical": make_gmm_fit_fn,
+                   "tied": make_gmm_fit_tied_fn,
+                   "full": make_gmm_fit_full_fn}[ct]
+        kwargs = {"cov_type": ct} if ct in ("diag", "spherical") else {}
         key = (mesh, ds.chunk, self.n_components, self.max_iter,
-               float(self.tol), float(self.reg_covar),
-               self.covariance_type, "gmmfit")
-        fit_fn = _STEP_CACHE.get_or_create(key, lambda: make_gmm_fit_fn(
+               float(self.tol), float(self.reg_covar), ct, "gmmfit")
+        fit_fn = _STEP_CACHE.get_or_create(key, lambda: builder(
             mesh, chunk_size=ds.chunk, k_real=self.n_components,
             max_iter=self.max_iter, tol=float(self.tol),
-            reg_covar=float(self.reg_covar),
-            cov_type=self.covariance_type))
+            reg_covar=float(self.reg_covar), **kwargs))
         k = self.n_components
+        k_pad = self._k_pad
+        d = self.means_.shape[1]
         shift = self._shift()
-        cv = np.maximum(self._diag_view(),
-                        max(self.reg_covar, float(np.finfo(self.dtype).tiny)))
-        # The device loop carries FULL replicated tables (each shard
-        # slices its block per iteration, like KMeans' make_fit_fn).
-        mc, var0, log_w0 = self._pad_tables(
-            (self.means_ - shift).astype(self.dtype),
-            cv.astype(self.dtype),
-            np.log(np.maximum(self.weights_, 1e-300)).astype(self.dtype))
-        means_out, var_out, log_w_out, it, hist, conv = fit_fn(
+        log_w0 = np.full((k_pad,), -np.inf, self.dtype)
+        log_w0[:k] = np.log(np.maximum(self.weights_, 1e-300))
+        if ct in ("diag", "spherical"):
+            cv = np.maximum(
+                self._diag_view(),
+                max(self.reg_covar, float(np.finfo(self.dtype).tiny)))
+            # The device loop carries FULL replicated tables (each shard
+            # slices its block per iteration, like KMeans' make_fit_fn).
+            mc, cov0, _ = self._pad_tables(
+                (self.means_ - shift).astype(self.dtype),
+                cv.astype(self.dtype), log_w0[:k])
+        elif ct == "full":
+            mc = np.zeros((k_pad, d), self.dtype)
+            mc[:k] = (self.means_ - shift).astype(self.dtype)
+            cov0 = np.broadcast_to(np.eye(d, dtype=self.dtype),
+                                   (k_pad, d, d)).copy()
+            cov0[:k] = np.asarray(self.covariances_, self.dtype)
+        else:                                     # tied
+            mc = np.zeros((k_pad, d), self.dtype)
+            mc[:k] = (self.means_ - shift).astype(self.dtype)
+            cov0 = np.asarray(self.covariances_, self.dtype)
+        means_out, cov_out, log_w_out, it, hist, conv = fit_fn(
             ds.points, ds.weights, jnp.asarray(shift.astype(self.dtype)),
-            jnp.asarray(mc), jnp.asarray(var0), jnp.asarray(log_w0))
+            jnp.asarray(mc), jnp.asarray(cov0), jnp.asarray(log_w0))
         n = int(it)
         hist = np.asarray(hist, np.float64)[:n]
         if n and not np.all(np.isfinite(hist)):
             raise ValueError(
                 f"non-finite log-likelihood at EM iteration {n}")
         self.means_ = np.asarray(means_out, np.float64)[:k] + shift
-        cv_out = np.asarray(var_out, np.float64)[:k]
-        # spherical carries its scalar variance broadcast over D in the
-        # loop; collapse back to the sklearn (k,) shape.
-        self.covariances_ = (cv_out[:, 0]
-                             if self.covariance_type == "spherical"
-                             else cv_out)
+        cv_out = np.asarray(cov_out, np.float64)
+        if ct == "spherical":
+            # The loop carries the scalar variance broadcast over D;
+            # collapse back to the sklearn (k,) shape.
+            self.covariances_ = cv_out[:k, 0]
+        elif ct == "tied":
+            self.covariances_ = cv_out               # shared (D, D)
+        else:
+            self.covariances_ = cv_out[:k]
         w = np.exp(np.asarray(log_w_out, np.float64)[:k])
         self.weights_ = w / w.sum()
         self.converged_ = bool(conv)
@@ -948,6 +970,44 @@ class GaussianMixture:
 
     def predict_proba(self, X) -> np.ndarray:
         return np.exp(self._posterior(X)[1])
+
+    def predict_stream(self, make_blocks):
+        """Component labels for a bigger-than-memory dataset, one block
+        at a time — the inference complement of ``fit_stream`` (mirrors
+        ``KMeans.predict_stream``).  Yields one int32 (m,) array per
+        block of ``make_blocks()``."""
+        self._check_fitted()
+        return (lab for lab, _, _ in self._posterior_stream(make_blocks))
+
+    def score_samples_stream(self, make_blocks):
+        """Per-sample log-likelihood log p(x), one block at a time."""
+        self._check_fitted()
+        return (lse for _, _, lse in self._posterior_stream(make_blocks))
+
+    def _posterior_stream(self, make_blocks):
+        from kmeans_tpu.parallel.sharding import shard_points
+        mesh = self._resolve_mesh()
+        data_shards, _ = mesh_shape(mesh)
+        d = self.means_.shape[1]
+        k = self.n_components
+        params = None
+        for block in make_blocks():
+            block = np.ascontiguousarray(np.asarray(block,
+                                                    dtype=self.dtype))
+            if block.ndim != 2 or block.shape[1] != d:
+                raise ValueError(f"block shape {block.shape} != (*, {d})")
+            chunk = self.chunk_size or choose_chunk_size(
+                -(-block.shape[0] // data_shards), k, d,
+                budget_elems=EM_CHUNK_BUDGET)
+            _, predict_fn = _get_fns(mesh, chunk, self.covariance_type)
+            if params is None:
+                params = self._params_dev(mesh)
+            pts, _ = shard_points(block, mesh, chunk)
+            labels, logr, lse = predict_fn(pts, *params)
+            m = block.shape[0]
+            yield (np.asarray(labels)[:m],
+                   np.asarray(logr)[:m, :k].astype(np.float64),
+                   np.asarray(lse)[:m].astype(np.float64))
 
     def score_samples(self, X) -> np.ndarray:
         """Per-sample log-likelihood log p(x) under the mixture."""

@@ -69,10 +69,6 @@ class MiniBatchKMeans(KMeans):
                  sampling: str = "device",
                  reassignment_ratio: float = 0.01, **kwargs):
         super().__init__(k, max_iter, tolerance, seed, compute_sse, **kwargs)
-        if self.n_init != 1:
-            raise ValueError("MiniBatchKMeans does not support n_init > 1; "
-                             "run restarts explicitly and keep the best "
-                             "inertia")
         if batch_size <= 0:
             raise ValueError(f"batch_size must be positive, got {batch_size}")
         if sampling not in _SAMPLING:
@@ -84,6 +80,10 @@ class MiniBatchKMeans(KMeans):
         self.batch_size = batch_size
         self.sampling = sampling
         self.reassignment_ratio = float(reassignment_ratio)
+        # Set unconditionally (like KMeans' best_restart_): resume- and
+        # partial_fit-trained models must not raise on these reads.
+        self.init_inertias_ = None
+        self.best_init_ = 0
 
     def _reassign_every(self, batch_global: int) -> int:
         """Reassignment cadence: the first iteration count n with
@@ -119,11 +119,59 @@ class MiniBatchKMeans(KMeans):
             return (np.asarray(self.centroids, dtype=np.float64),
                     self.iterations_run,
                     np.asarray(self._seen, dtype=np.float64))
-        centroids = resolve_init(
-            self.init, init_src, self.k, self.seed).astype(np.float64)
+        centroids = self._select_init(init_src).astype(np.float64)
         self.sse_history = []
         self.iterations_run = 0
         return centroids, 0, np.zeros(self.k)
+
+    def _select_init(self, init_src) -> np.ndarray:
+        """sklearn-style ``n_init``: draw candidate inits and keep the
+        one scoring the LOWEST inertia, then run ONE training session —
+        sklearn's MiniBatchKMeans evaluates candidate inits rather than
+        running full restarts (its n_init semantics differ from
+        KMeans').  Scoring: exact full-data SSE when the dataset is
+        device-resident (one fused dispatch per candidate — cheap
+        against the fit), else a seeded 3*batch_size validation subset
+        (sklearn's init_size heuristic).  Records ``init_inertias_`` /
+        ``best_init_``; one candidate (n_init=1 or an explicit init
+        array) skips scoring entirely."""
+        from kmeans_tpu.parallel.sharding import ShardedDataset
+        seeds = self._restart_seeds()
+        cands = [np.asarray(resolve_init(self.init, init_src, self.k, s))
+                 for s in seeds]
+        if len(cands) == 1:
+            self.init_inertias_ = None
+            self.best_init_ = 0
+            return cands[0]
+        if isinstance(init_src, ShardedDataset):
+            ds = init_src
+            # _prepare keys the step fn on the dataset's OWN chunk.
+            _, mesh, model_shards, step_fn, _ = self._prepare(ds)
+            def score(c):
+                st = step_fn(ds.points, ds.weights, self._put_centroids(
+                    c.astype(self.dtype), mesh, model_shards))
+                return float(st.sse)
+        else:
+            X = np.asarray(init_src)
+            n = X.shape[0]
+            take = min(n, max(3 * self.batch_size, 3 * self.k))
+            rng = np.random.default_rng([self.seed, 0x1717])
+            val = np.ascontiguousarray(
+                X[rng.choice(n, size=take, replace=False)].astype(
+                    self.dtype))
+            from kmeans_tpu.parallel.sharding import shard_points
+            mesh, model_shards, step_fn, _, chunk = self._setup(
+                take, X.shape[1])
+            pts, w = shard_points(val, mesh, chunk)
+            def score(c):
+                st = step_fn(pts, w, self._put_centroids(
+                    c.astype(self.dtype), mesh, model_shards))
+                return float(st.sse)
+        inertias = [score(c) for c in cands]
+        best = int(np.argmin(inertias))
+        self.init_inertias_ = np.asarray(inertias, np.float64)
+        self.best_init_ = best
+        return cands[best]
 
     def _fit_device(self, X, *, resume: bool) -> "MiniBatchKMeans":
         """On-device sampling engine: resident dataset, one dispatch per

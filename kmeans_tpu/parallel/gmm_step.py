@@ -297,6 +297,82 @@ def _softmax_resp(logp, w, model_shards: int):
     return p / denom[:, None] * w[:, None], lse
 
 
+def _scan_estats_full(points, weights, means, prec_chol, log_det_half,
+                      log_w, shift, *, chunk_size: int,
+                      model_shards: int) -> EStatsFull:
+    """Shard-local chunked FULL-covariance E pass -> local-block
+    EStatsFull (pre-psum).  Shared by the per-dispatch step builder and
+    the on-device fit loop."""
+    k_local, d = means.shape
+    acc = points.dtype
+    n_chunks = points.shape[0] // chunk_size
+    xs = (points.reshape(n_chunks, chunk_size, d),
+          weights.astype(acc).reshape(n_chunks, chunk_size))
+    hi = lax.Precision.HIGHEST
+
+    def body(carry, chunk):
+        xc_raw, wc = chunk
+        xc = xc_raw - shift[None, :]
+        logp = _log_prob_full_chunk(xc, means, prec_chol, log_det_half,
+                                    log_w)
+        resp, lse = _softmax_resp(logp, wc, model_shards)
+        st = EStatsFull(
+            resp_sum=jnp.sum(resp, axis=0),
+            xsum=lax.dot_general(resp, xc, (((0,), (0,)), ((), ())),
+                                 preferred_element_type=acc,
+                                 precision=hi),
+            scatter=jnp.einsum("ck,cd,ce->kde", resp, xc, xc,
+                               preferred_element_type=acc, precision=hi),
+            loglik=jnp.sum(jnp.where(wc > 0, lse * wc, 0.0)))
+        return EStatsFull(carry.resp_sum + st.resp_sum,
+                          carry.xsum + st.xsum,
+                          carry.scatter + st.scatter,
+                          carry.loglik + st.loglik), None
+
+    init = EStatsFull(jnp.zeros((k_local,), acc),
+                      jnp.zeros((k_local, d), acc),
+                      jnp.zeros((k_local, d, d), acc),
+                      jnp.zeros((), acc))
+    st, _ = lax.scan(body, init, xs)
+    return st
+
+
+def _embed_psum_full(st: EStatsFull, k_pad: int, k_local: int,
+                     model_shards: int) -> EStatsFull:
+    """Embed a shard's local-block FULL stats into the padded table and
+    psum over both axes (the K-Means embedding pattern)."""
+    d = st.xsum.shape[1]
+    acc = st.xsum.dtype
+    m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
+    off = jnp.asarray(m_idx * k_local, jnp.int32)
+    axes = (DATA_AXIS, MODEL_AXIS)
+    resp = lax.psum(lax.dynamic_update_slice(
+        jnp.zeros((k_pad,), acc), st.resp_sum, (off,)), axes)
+    xsum = lax.psum(lax.dynamic_update_slice(
+        jnp.zeros((k_pad, d), acc), st.xsum, (off, jnp.int32(0))), axes)
+    scatter = lax.psum(lax.dynamic_update_slice(
+        jnp.zeros((k_pad, d, d), acc), st.scatter,
+        (off, jnp.int32(0), jnp.int32(0))), axes)
+    ll = lax.psum(st.loglik, axes) / model_shards
+    return EStatsFull(resp, xsum, scatter, ll)
+
+
+def _prec_chol_dev(cov, tiny):
+    """On-device precision Cholesky of a (..., D, D) covariance batch:
+    Sigma = L L^T -> P = L^-T, log_det_half = -sum log diag L.  A
+    non-PD input yields NaNs, which surface as a non-finite
+    log-likelihood (the device loop's loud-failure contract)."""
+    from jax.scipy.linalg import solve_triangular
+    d = cov.shape[-1]
+    L = jnp.linalg.cholesky(cov)
+    eye = jnp.broadcast_to(jnp.eye(d, dtype=cov.dtype), cov.shape)
+    p_chol = jnp.swapaxes(
+        solve_triangular(L, eye, lower=True), -1, -2)
+    ldh = -jnp.sum(jnp.log(jnp.maximum(
+        jnp.diagonal(L, axis1=-2, axis2=-1), tiny)), axis=-1)
+    return p_chol, ldh
+
+
 def make_gmm_step_full_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
     """Full-covariance SPMD E-step: (points, weights, shift, means_c,
     prec_chol (k, D, D), log_det_half (k,), log_weights) -> EStatsFull
@@ -309,52 +385,12 @@ def make_gmm_step_full_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
     def step(points, weights, shift, means, prec_chol, log_det_half,
              log_weights):
         k_local, d = means.shape
-        acc = points.dtype
-        n_chunks = points.shape[0] // chunk_size
-        xs = (points.reshape(n_chunks, chunk_size, d),
-              weights.astype(acc).reshape(n_chunks, chunk_size))
-        hi = lax.Precision.HIGHEST
-
-        def body(carry, chunk):
-            xc_raw, wc = chunk
-            xc = xc_raw - shift[None, :]
-            logp = _log_prob_full_chunk(xc, means, prec_chol,
-                                        log_det_half, log_weights)
-            resp, lse = _softmax_resp(logp, wc, model_shards)
-            st = EStatsFull(
-                resp_sum=jnp.sum(resp, axis=0),
-                xsum=lax.dot_general(resp, xc, (((0,), (0,)), ((), ())),
-                                     preferred_element_type=acc,
-                                     precision=hi),
-                scatter=jnp.einsum("ck,cd,ce->kde", resp, xc, xc,
-                                   preferred_element_type=acc,
-                                   precision=hi),
-                loglik=jnp.sum(jnp.where(wc > 0, lse * wc, 0.0)))
-            return EStatsFull(carry.resp_sum + st.resp_sum,
-                              carry.xsum + st.xsum,
-                              carry.scatter + st.scatter,
-                              carry.loglik + st.loglik), None
-
-        init = EStatsFull(jnp.zeros((k_local,), acc),
-                          jnp.zeros((k_local, d), acc),
-                          jnp.zeros((k_local, d, d), acc),
-                          jnp.zeros((), acc))
-        st, _ = lax.scan(body, init, xs)
-        # Embed + psum (the K-Means embedding pattern).
-        k_pad = k_local * model_shards
-        m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
-        off = jnp.asarray(m_idx * k_local, jnp.int32)
-        axes = (DATA_AXIS, MODEL_AXIS)
-        resp = lax.psum(lax.dynamic_update_slice(
-            jnp.zeros((k_pad,), acc), st.resp_sum, (off,)), axes)
-        xsum = lax.psum(lax.dynamic_update_slice(
-            jnp.zeros((k_pad, d), acc), st.xsum,
-            (off, jnp.int32(0))), axes)
-        scatter = lax.psum(lax.dynamic_update_slice(
-            jnp.zeros((k_pad, d, d), acc), st.scatter,
-            (off, jnp.int32(0), jnp.int32(0))), axes)
-        ll = lax.psum(st.loglik, axes) / model_shards
-        return EStatsFull(resp, xsum, scatter, ll)
+        st = _scan_estats_full(points, weights, means, prec_chol,
+                               log_det_half, log_weights, shift,
+                               chunk_size=chunk_size,
+                               model_shards=model_shards)
+        return _embed_psum_full(st, k_local * model_shards, k_local,
+                                model_shards)
 
     mapped = jax.shard_map(
         step, mesh=mesh,
@@ -365,6 +401,44 @@ def make_gmm_step_full_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
                              P(None, None, None), P()),
         check_vma=False)
     return jax.jit(mapped)
+
+
+def _scan_estats_tied(points, weights, means_t, prec_chol, log_det_half,
+                      log_w, shift, *, chunk_size: int,
+                      model_shards: int) -> EStats:
+    """Shard-local chunked TIED-covariance E pass -> local-block EStats
+    with ``x2sum`` elided (the tied M-step derives its covariance from
+    the loop-invariant total scatter + means).  Shared by the
+    per-dispatch step builder and the on-device fit loop."""
+    k_local, d = means_t.shape
+    acc = points.dtype
+    n_chunks = points.shape[0] // chunk_size
+    xs = (points.reshape(n_chunks, chunk_size, d),
+          weights.astype(acc).reshape(n_chunks, chunk_size))
+    hi = lax.Precision.HIGHEST
+
+    def body(carry, chunk):
+        xc_raw, wc = chunk
+        xc = xc_raw - shift[None, :]
+        logp = _log_prob_tied_chunk(xc, means_t, prec_chol,
+                                    log_det_half, log_w)
+        resp, lse = _softmax_resp(logp, wc, model_shards)
+        st = EStats(
+            resp_sum=jnp.sum(resp, axis=0),
+            xsum=lax.dot_general(resp, xc, (((0,), (0,)), ((), ())),
+                                 preferred_element_type=acc,
+                                 precision=hi),
+            x2sum=carry.x2sum,          # elided — not accumulated
+            loglik=jnp.sum(jnp.where(wc > 0, lse * wc, 0.0)))
+        return EStats(carry.resp_sum + st.resp_sum,
+                      carry.xsum + st.xsum, carry.x2sum,
+                      carry.loglik + st.loglik), None
+
+    init = EStats(jnp.zeros((k_local,), acc),
+                  jnp.zeros((k_local, d), acc),
+                  jnp.zeros((k_local, d), acc), jnp.zeros((), acc))
+    st, _ = lax.scan(body, init, xs)
+    return st
 
 
 def make_gmm_step_tied_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
@@ -378,34 +452,11 @@ def make_gmm_step_tied_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
 
     def step(points, weights, shift, means_t, prec_chol, log_det_half,
              log_weights):
-        k_local, d = means_t.shape
-        acc = points.dtype
-        n_chunks = points.shape[0] // chunk_size
-        xs = (points.reshape(n_chunks, chunk_size, d),
-              weights.astype(acc).reshape(n_chunks, chunk_size))
-        hi = lax.Precision.HIGHEST
-
-        def body(carry, chunk):
-            xc_raw, wc = chunk
-            xc = xc_raw - shift[None, :]
-            logp = _log_prob_tied_chunk(xc, means_t, prec_chol,
-                                        log_det_half, log_weights)
-            resp, lse = _softmax_resp(logp, wc, model_shards)
-            st = EStats(
-                resp_sum=jnp.sum(resp, axis=0),
-                xsum=lax.dot_general(resp, xc, (((0,), (0,)), ((), ())),
-                                     preferred_element_type=acc,
-                                     precision=hi),
-                x2sum=carry.x2sum,          # elided — not accumulated
-                loglik=jnp.sum(jnp.where(wc > 0, lse * wc, 0.0)))
-            return EStats(carry.resp_sum + st.resp_sum,
-                          carry.xsum + st.xsum, carry.x2sum,
-                          carry.loglik + st.loglik), None
-
-        init = EStats(jnp.zeros((k_local,), acc),
-                      jnp.zeros((k_local, d), acc),
-                      jnp.zeros((k_local, d), acc), jnp.zeros((), acc))
-        st, _ = lax.scan(body, init, xs)
+        k_local = means_t.shape[0]
+        st = _scan_estats_tied(points, weights, means_t, prec_chol,
+                               log_det_half, log_weights, shift,
+                               chunk_size=chunk_size,
+                               model_shards=model_shards)
         return _embed_psum(st, k_local * model_shards, k_local,
                            model_shards)
 
@@ -467,6 +518,190 @@ def _predict_from_logp(logp_fn, points, chunk_size, k_local, d,
     _, (labels, logr, lse) = lax.scan(body, None, xs)
     return (labels.reshape(-1), logr.reshape(-1, k_local),
             lse.reshape(-1))
+
+
+def make_gmm_fit_full_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
+                         max_iter: int, tol: float, reg_covar: float):
+    """FULL-covariance on-device EM loop: all iterations in ONE dispatch
+    (the 'full' analogue of ``make_gmm_fit_fn``, r4 — the r4 host path
+    initially shipped full/tied host-loop-only).
+
+    Per iteration: batched precision Cholesky of the carried (k_pad, D,
+    D) covariances (``_prec_chol_dev`` — jnp.linalg.cholesky +
+    triangular solve, tiny against the E pass), the chunked full E pass,
+    psum-embed, and the M-step in the accumulation dtype (scatter/R -
+    mu mu^T + reg I, diagonal floored at tiny).  A component collapsing
+    to a non-PD covariance yields NaN loglik -> the caller's loud
+    non-finite error (the device loop cannot raise sklearn's pointed
+    ill-defined-covariance message; the float64 host loop can).
+
+    Returns ``fit(points, weights, shift, means0_c, cov0, log_w0) ->
+    (means_c, cov, log_w, n_iter, ll_hist[max_iter], converged)``,
+    everything replicated, tables (k_pad, ...) with padding components
+    carried as ``log_w = -inf``.
+    """
+    data_shards, model_shards = mesh_shape(mesh)
+
+    def fit(points, weights, shift, means0, cov0, log_w0):
+        k_pad, d = means0.shape
+        k_local = k_pad // model_shards
+        acc = points.dtype
+        tiny = jnp.asarray(np.finfo(np.dtype(str(acc))).tiny, acc)
+        pi_floor = jnp.maximum(jnp.asarray(1e-300, acc), tiny)
+        real = jnp.arange(k_pad) < k_real
+        m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
+        w_total = lax.psum(jnp.sum(weights.astype(acc)), DATA_AXIS)
+        diag_idx = jnp.arange(d)
+
+        def estats(means_c, cov, log_w):
+            p_chol, ldh = _prec_chol_dev(cov, tiny)
+            # Padding components carry identity covariance (benign) and
+            # -inf log_w, so their density never receives responsibility.
+            off = jnp.asarray(m_idx * k_local, jnp.int32)
+            blk = lambda a: lax.dynamic_slice(
+                a, (off,) + (jnp.int32(0),) * (a.ndim - 1),
+                (k_local,) + a.shape[1:])
+            st = _scan_estats_full(
+                points, weights, blk(means_c).astype(acc),
+                blk(p_chol).astype(acc), blk(ldh).astype(acc),
+                blk(log_w).astype(acc), shift, chunk_size=chunk_size,
+                model_shards=model_shards)
+            return _embed_psum_full(st, k_pad, k_local, model_shards)
+
+        def body(state):
+            it, means_c, cov, log_w, prev, hist, _ = state
+            st = estats(means_c, cov, log_w)
+            Rc = jnp.maximum(st.resp_sum, 10 * tiny)
+            mu = st.xsum / Rc[:, None]
+            new_cov = (st.scatter / Rc[:, None, None]
+                       - mu[:, :, None] * mu[:, None, :])
+            diag = new_cov[:, diag_idx, diag_idx]
+            new_cov = new_cov.at[:, diag_idx, diag_idx].set(
+                jnp.maximum(diag + reg_covar,
+                            jnp.maximum(jnp.asarray(reg_covar, acc),
+                                        tiny)))
+            pi = jnp.maximum(st.resp_sum / jnp.maximum(w_total, pi_floor),
+                             pi_floor)
+            pi = pi / jnp.sum(jnp.where(real, pi, 0.0))
+            new_log_w = jnp.where(real, jnp.log(pi), -jnp.inf)
+            ll = st.loglik / w_total
+            hist = hist.at[it].set(ll)
+            conv = jnp.abs(ll - prev) < tol
+            eye = jnp.broadcast_to(jnp.eye(d, dtype=acc), cov.shape)
+            return (it + 1, jnp.where(real[:, None], mu, means_c),
+                    jnp.where(real[:, None, None], new_cov, eye),
+                    new_log_w, ll, hist, conv)
+
+        def cond(state):
+            it, *_, conv = state
+            return (it < max_iter) & ~conv
+
+        eye = jnp.broadcast_to(jnp.eye(d, dtype=acc), cov0.shape)
+        cov_start = jnp.where(real[:, None, None], cov0.astype(acc), eye)
+        state = (jnp.int32(0), means0.astype(acc), cov_start,
+                 log_w0.astype(acc), jnp.asarray(-jnp.inf, acc),
+                 jnp.zeros((max_iter,), acc), jnp.asarray(False))
+        it, means_c, cov, log_w, _, hist, conv = lax.while_loop(
+            cond, body, state)
+        return means_c, cov, log_w, it, hist, conv
+
+    mapped = jax.shard_map(
+        fit, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None),
+                  P(None, None), P(None, None, None), P(None)),
+        out_specs=(P(None, None), P(None, None, None), P(None), P(),
+                   P(), P()),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+def make_gmm_fit_tied_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
+                         max_iter: int, tol: float, reg_covar: float):
+    """TIED-covariance on-device EM loop: the total scatter is computed
+    ONCE inside the dispatch (loop-invariant), each iteration factors
+    the single shared (D, D) covariance, transforms the means, runs the
+    tied E pass, and M-steps via ``(T - sum_k R_k mu_k mu_k^T)/W``.
+
+    Returns ``fit(points, weights, shift, means0_c, cov0, log_w0) ->
+    (means_c, cov (D, D), log_w, n_iter, ll_hist, converged)``."""
+    data_shards, model_shards = mesh_shape(mesh)
+
+    def fit(points, weights, shift, means0, cov0, log_w0):
+        k_pad, d = means0.shape
+        k_local = k_pad // model_shards
+        acc = points.dtype
+        tiny = jnp.asarray(np.finfo(np.dtype(str(acc))).tiny, acc)
+        pi_floor = jnp.maximum(jnp.asarray(1e-300, acc), tiny)
+        real = jnp.arange(k_pad) < k_real
+        m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
+        w_total = lax.psum(jnp.sum(weights.astype(acc)), DATA_AXIS)
+        diag_idx = jnp.arange(d)
+
+        # Loop-invariant total scatter (psum over data; identical on
+        # every model replica).
+        xc_all = points - shift[None, :]
+        T = lax.psum(lax.dot_general(
+            xc_all * weights.astype(acc)[:, None], xc_all,
+            (((0,), (0,)), ((), ())), preferred_element_type=acc,
+            precision=lax.Precision.HIGHEST), DATA_AXIS)
+
+        def estats(means_c, cov, log_w):
+            p_chol, ldh = _prec_chol_dev(cov, tiny)
+            means_t = means_c @ p_chol
+            off = jnp.asarray(m_idx * k_local, jnp.int32)
+            blk = lambda a: lax.dynamic_slice(
+                a, (off,) + (jnp.int32(0),) * (a.ndim - 1),
+                (k_local,) + a.shape[1:])
+            st = _scan_estats_tied(
+                points, weights, blk(means_t).astype(acc),
+                p_chol.astype(acc), ldh.astype(acc),
+                blk(log_w).astype(acc), shift, chunk_size=chunk_size,
+                model_shards=model_shards)
+            return _embed_psum(st, k_pad, k_local, model_shards)
+
+        def body(state):
+            it, means_c, cov, log_w, prev, hist, _ = state
+            st = estats(means_c, cov, log_w)
+            Rc = jnp.maximum(st.resp_sum, 10 * tiny)
+            mu = st.xsum / Rc[:, None]
+            mu_real = jnp.where(real[:, None], mu, 0.0)
+            new_cov = (T - jnp.einsum("k,kd,ke->de", st.resp_sum,
+                                      mu_real, mu_real,
+                                      precision=lax.Precision.HIGHEST)
+                       ) / jnp.maximum(w_total, pi_floor)
+            diag = new_cov[diag_idx, diag_idx]
+            new_cov = new_cov.at[diag_idx, diag_idx].set(
+                jnp.maximum(diag + reg_covar,
+                            jnp.maximum(jnp.asarray(reg_covar, acc),
+                                        tiny)))
+            pi = jnp.maximum(st.resp_sum / jnp.maximum(w_total, pi_floor),
+                             pi_floor)
+            pi = pi / jnp.sum(jnp.where(real, pi, 0.0))
+            new_log_w = jnp.where(real, jnp.log(pi), -jnp.inf)
+            ll = st.loglik / w_total
+            hist = hist.at[it].set(ll)
+            conv = jnp.abs(ll - prev) < tol
+            return (it + 1, jnp.where(real[:, None], mu, means_c),
+                    new_cov, new_log_w, ll, hist, conv)
+
+        def cond(state):
+            it, *_, conv = state
+            return (it < max_iter) & ~conv
+
+        state = (jnp.int32(0), means0.astype(acc), cov0.astype(acc),
+                 log_w0.astype(acc), jnp.asarray(-jnp.inf, acc),
+                 jnp.zeros((max_iter,), acc), jnp.asarray(False))
+        it, means_c, cov, log_w, _, hist, conv = lax.while_loop(
+            cond, body, state)
+        return means_c, cov, log_w, it, hist, conv
+
+    mapped = jax.shard_map(
+        fit, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None),
+                  P(None, None), P(None, None), P(None)),
+        out_specs=(P(None, None), P(None, None), P(None), P(), P(), P()),
+        check_vma=False)
+    return jax.jit(mapped)
 
 
 def make_gmm_predict_full_fn(mesh: Mesh, *, chunk_size: int) -> Callable:

@@ -91,11 +91,32 @@ def test_spherical_device_loop_matches_host(Xc, mesh8):
 
 
 @pytest.mark.parametrize("ct", ("tied", "full"))
-def test_device_loop_guard(ct, Xc):
-    gm = GaussianMixture(n_components=3, covariance_type=ct,
-                         means_init=INIT, host_loop=False)
-    with pytest.raises(ValueError, match="host_loop=False supports"):
-        gm.fit(Xc)
+def test_full_tied_device_loop_matches_host(ct, Xc, mesh8):
+    """r4: the one-dispatch device loop serves full/tied too (on-device
+    batched Cholesky per iteration); float64 makes the two engines'
+    trajectories comparable."""
+    kw = dict(n_components=3, covariance_type=ct, means_init=INIT,
+              max_iter=25, tol=1e-6, seed=0, mesh=mesh8,
+              dtype=np.float64)
+    host = GaussianMixture(host_loop=True, **kw).fit(Xc)
+    dev = GaussianMixture(host_loop=False, **kw).fit(Xc)
+    np.testing.assert_allclose(dev.lower_bound_, host.lower_bound_,
+                               rtol=1e-7)
+    np.testing.assert_allclose(dev.means_, host.means_, atol=1e-6)
+    np.testing.assert_allclose(dev.covariances_, host.covariances_,
+                               rtol=1e-5, atol=1e-8)
+    assert dev.covariances_.shape == host.covariances_.shape
+
+
+@pytest.mark.parametrize("ct", ("tied", "full"))
+def test_full_tied_device_loop_under_model_sharding(ct, Xc, mesh4x2):
+    """Device loop + component sharding compose for the new types."""
+    kw = dict(n_components=3, covariance_type=ct, means_init=INIT,
+              max_iter=20, tol=1e-6, seed=0, dtype=np.float64)
+    a = GaussianMixture(mesh=mesh4x2, host_loop=False, **kw).fit(Xc)
+    b = GaussianMixture(host_loop=True, **kw).fit(Xc)
+    np.testing.assert_allclose(a.lower_bound_, b.lower_bound_, rtol=1e-6)
+    np.testing.assert_allclose(a.means_, b.means_, atol=1e-5)
 
 
 @pytest.mark.parametrize("ct", ALL_TYPES)
@@ -240,3 +261,17 @@ def test_gmm_fit_stream_restart_resilience(Xc, mesh8, monkeypatch):
         gm.fit_stream(lambda: iter([b.copy() for b in blocks]))
     assert np.isfinite(gm.lower_bound_)
     assert gm.restart_lower_bounds_[1] == -np.inf
+
+
+@pytest.mark.parametrize("ct", ("diag", "full"))
+def test_gmm_predict_stream_matches_predict(ct, Xc, mesh8):
+    gm = GaussianMixture(n_components=3, covariance_type=ct,
+                         means_init=INIT, max_iter=15, seed=0,
+                         mesh=mesh8).fit(Xc)
+    blocks = [Xc[:700], Xc[700:1500], Xc[1500:]]
+    lab = np.concatenate(list(gm.predict_stream(
+        lambda: iter([b.copy() for b in blocks]))))
+    np.testing.assert_array_equal(lab, gm.predict(Xc))
+    lse = np.concatenate(list(gm.score_samples_stream(
+        lambda: iter([b.copy() for b in blocks]))))
+    np.testing.assert_allclose(lse, gm.score_samples(Xc), rtol=1e-5)

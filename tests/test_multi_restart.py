@@ -108,9 +108,32 @@ def test_invalid_n_init_raises():
         KMeans(k=3, n_init=0)
 
 
-def test_minibatch_rejects_multi_restart():
-    with pytest.raises(ValueError, match="n_init"):
-        MiniBatchKMeans(k=3, n_init=2)
+def test_minibatch_n_init_selects_best_candidate():
+    """r4: MiniBatchKMeans n_init follows sklearn's semantics — score
+    candidate inits, keep the lowest-inertia one, run ONE session (not
+    full restarts).  A far-out explicit seed pool makes candidate
+    quality differ deterministically."""
+    X = blobs()
+    mb1 = MiniBatchKMeans(k=4, n_init=1, seed=0, batch_size=256,
+                          max_iter=30, verbose=False).fit(X)
+    mb8 = MiniBatchKMeans(k=4, n_init=8, seed=0, batch_size=256,
+                          max_iter=30, verbose=False).fit(X)
+    assert mb8.init_inertias_.shape == (8,)
+    assert mb8.best_init_ == int(np.argmin(mb8.init_inertias_))
+    # The selected candidate's full-data inertia is the pool minimum, so
+    # the chosen start is never worse than n_init=1's.
+    assert mb8.init_inertias_[mb8.best_init_] <= mb8.init_inertias_[0]
+    assert np.all(np.isfinite(mb8.centroids))
+    assert mb1.init_inertias_ is None       # single candidate: unscored
+
+
+def test_minibatch_n_init_host_engine():
+    X = blobs()
+    mb = MiniBatchKMeans(k=4, n_init=4, seed=1, batch_size=256,
+                         max_iter=20, sampling="host",
+                         verbose=False).fit(X)
+    assert mb.init_inertias_.shape == (4,)
+    assert np.all(np.isfinite(mb.centroids))
 
 
 def test_bisecting_forwards_n_init():
