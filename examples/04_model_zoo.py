@@ -25,9 +25,9 @@ for cls, kwargs in [
     print(f"{cls.__name__:18s} iters={model.iterations_run:3d} "
           f"silhouette={sil:.3f}")
 
-# Soft clustering: diagonal-covariance EM on the same SPMD machinery —
-# here with every EM iteration in ONE device dispatch (host_loop=False)
-# and 2 seeded restarts.
+# Soft clustering: EM on the same SPMD machinery (covariance_type picks
+# diag/spherical/tied/full) — here with every EM iteration in ONE device
+# dispatch (host_loop=False) and 2 seeded restarts.
 gm = GaussianMixture(n_components=6, seed=42, n_init=2,
                      host_loop=False).fit(X)
 sil = silhouette_score(X, gm.predict(X), sample_size=5_000, seed=0)

@@ -28,7 +28,10 @@ from kmeans_tpu.ops.assign import pairwise_sq_dists
 from kmeans_tpu.utils.validation import check_finite_array
 
 __all__ = ["silhouette_score", "silhouette_samples",
-           "davies_bouldin_score", "calinski_harabasz_score"]
+           "davies_bouldin_score", "calinski_harabasz_score",
+           "adjusted_rand_score", "mutual_info_score",
+           "normalized_mutual_info_score",
+           "homogeneity_completeness_v_measure"]
 
 
 def _as_arrays(X, labels):
@@ -306,3 +309,90 @@ def silhouette_score(X, labels, *, sample_size: Optional[int] = None,
             X.shape[0], size=sample_size, replace=False)
         X, labels = X[idx], labels[idx]
     return float(np.mean(silhouette_samples(X, labels, mesh=mesh)))
+
+
+# --------------------------------------------------------- external metrics
+# Label-agreement scores against a ground truth (sklearn's external
+# cluster-validity family).  These are O(n) contingency-table reductions —
+# host NumPy is the right engine (no MXU work exists); they complete the
+# metrics surface so a reference user migrating an evaluation pipeline
+# finds the standard scores in one place.
+
+
+def _contingency(labels_true, labels_pred):
+    lt = np.asarray(labels_true).ravel()
+    lp = np.asarray(labels_pred).ravel()
+    if lt.shape != lp.shape:
+        raise ValueError(f"label arrays differ in length: {lt.shape} vs "
+                         f"{lp.shape}")
+    if lt.size == 0:
+        raise ValueError("label arrays must be non-empty")
+    _, ti = np.unique(lt, return_inverse=True)
+    _, pi = np.unique(lp, return_inverse=True)
+    rows, cols = int(ti.max()) + 1, int(pi.max()) + 1
+    return np.bincount(ti * cols + pi,
+                       minlength=rows * cols).reshape(rows, cols)
+
+
+def adjusted_rand_score(labels_true, labels_pred) -> float:
+    """Adjusted Rand index (Hubert & Arabie) — chance-corrected pair
+    agreement; 1.0 = identical partitions, ~0 = random."""
+    c = _contingency(labels_true, labels_pred)
+    n = c.sum()
+
+    def comb2(x):
+        return x * (x - 1) / 2.0
+
+    sum_ij = comb2(c.astype(np.float64)).sum()
+    a = comb2(c.sum(axis=1).astype(np.float64)).sum()
+    b = comb2(c.sum(axis=0).astype(np.float64)).sum()
+    expected = a * b / max(comb2(float(n)), 1.0)
+    max_index = 0.5 * (a + b)
+    if max_index == expected:          # degenerate: single cluster both
+        return 1.0
+    return float((sum_ij - expected) / (max_index - expected))
+
+
+def _entropy(counts) -> float:
+    p = counts[counts > 0].astype(np.float64)
+    p = p / p.sum()
+    return float(-(p * np.log(p)).sum())
+
+
+def _mi_from_contingency(c) -> float:
+    c = c.astype(np.float64)
+    n = c.sum()
+    outer = np.outer(c.sum(axis=1), c.sum(axis=0))
+    nz = c > 0
+    return float((c[nz] / n * (np.log(c[nz] * n) -
+                               np.log(outer[nz]))).sum())
+
+
+def mutual_info_score(labels_true, labels_pred) -> float:
+    """Mutual information of the two partitions (nats)."""
+    return _mi_from_contingency(_contingency(labels_true, labels_pred))
+
+
+def normalized_mutual_info_score(labels_true, labels_pred) -> float:
+    """NMI with arithmetic-mean normalization (sklearn's default)."""
+    c = _contingency(labels_true, labels_pred)
+    mi = _mi_from_contingency(c)
+    h1 = _entropy(c.sum(axis=1))
+    h2 = _entropy(c.sum(axis=0))
+    denom = 0.5 * (h1 + h2)
+    if denom == 0.0:                   # both partitions trivial
+        return 1.0
+    return float(np.clip(mi / denom, 0.0, 1.0))
+
+
+def homogeneity_completeness_v_measure(labels_true, labels_pred):
+    """(homogeneity, completeness, v-measure) — sklearn's definitions."""
+    c = _contingency(labels_true, labels_pred)
+    mi = _mi_from_contingency(c)
+    h_true = _entropy(c.sum(axis=1))
+    h_pred = _entropy(c.sum(axis=0))
+    hom = 1.0 if h_true == 0.0 else mi / h_true
+    com = 1.0 if h_pred == 0.0 else mi / h_pred
+    v = (0.0 if hom + com == 0.0
+         else 2.0 * hom * com / (hom + com))
+    return float(hom), float(com), float(v)

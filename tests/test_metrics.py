@@ -145,3 +145,34 @@ def test_gapped_labels_match_sklearn():
     noisy[0] = -1                      # becomes its own singleton cluster
     np.testing.assert_allclose(silhouette_samples(X, noisy),
                                skm.silhouette_samples(X, noisy), atol=5e-3)
+
+
+def test_external_metrics_match_sklearn():
+    """ARI / MI / NMI / homogeneity-completeness-V against sklearn on
+    partially-agreeing partitions."""
+    skm = pytest.importorskip("sklearn.metrics")
+    from kmeans_tpu.metrics import (adjusted_rand_score,
+                                    homogeneity_completeness_v_measure,
+                                    mutual_info_score,
+                                    normalized_mutual_info_score)
+    rng = np.random.default_rng(0)
+    lt = rng.integers(0, 5, 600)
+    lp = lt.copy()
+    lp[rng.choice(600, 150, replace=False)] = rng.integers(0, 7, 150)
+    np.testing.assert_allclose(adjusted_rand_score(lt, lp),
+                               skm.adjusted_rand_score(lt, lp), rtol=1e-9)
+    np.testing.assert_allclose(mutual_info_score(lt, lp),
+                               skm.mutual_info_score(lt, lp), rtol=1e-9)
+    np.testing.assert_allclose(
+        normalized_mutual_info_score(lt, lp),
+        skm.normalized_mutual_info_score(lt, lp), rtol=1e-9)
+    np.testing.assert_allclose(
+        homogeneity_completeness_v_measure(lt, lp),
+        skm.homogeneity_completeness_v_measure(lt, lp), rtol=1e-9)
+    # Identity and degenerate partitions.
+    assert adjusted_rand_score(lt, lt) == 1.0
+    np.testing.assert_allclose(
+        normalized_mutual_info_score(lt, lt), 1.0, rtol=1e-12)
+    assert adjusted_rand_score(np.zeros(10), np.zeros(10)) == 1.0
+    with pytest.raises(ValueError, match="non-empty"):
+        adjusted_rand_score([], [])
