@@ -327,6 +327,11 @@ def _contingency(labels_true, labels_pred):
                          f"{lp.shape}")
     if lt.size == 0:
         raise ValueError("label arrays must be non-empty")
+    # Float label arrays (e.g. loadtxt output with NaN for missing rows)
+    # must not cluster NaN as a real category (sklearn raises too).
+    for arr in (lt, lp):
+        if np.issubdtype(arr.dtype, np.floating):
+            check_finite_array(arr, "labels contain NaN or Inf values")
     _, ti = np.unique(lt, return_inverse=True)
     _, pi = np.unique(lp, return_inverse=True)
     rows, cols = int(ti.max()) + 1, int(pi.max()) + 1

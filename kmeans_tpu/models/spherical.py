@@ -100,7 +100,35 @@ class SphericalKMeans(KMeans):
         fallback = centroids if prev is None else prev
         return np.where(norms > 0, unit, fallback)
 
-    def transform(self, X) -> np.ndarray:
-        """Chordal distances ``sqrt(2 - 2*cos)`` to each centroid, (n, k)."""
-        X = _normalize_rows(np.asarray(X, dtype=np.float64))
-        return super().transform(X.astype(self.dtype))
+    def transform(self, X, *, block_rows=None) -> np.ndarray:
+        """Chordal distances ``sqrt(2 - 2*cos)`` to each centroid, (n, k);
+        cosine similarity is ``1 - d**2 / 2``.  Rows are L2-normalized by
+        the ``transform_stream`` wrapper the base implementation streams
+        through (normalizing here too would pay a second full-array
+        float64 pass, review r4)."""
+        return super().transform(X, block_rows=block_rows)
+
+    # ------------------------------------------------------------ streaming
+    # The streaming paths receive raw host blocks that never pass through
+    # this model's normalizing ``cache`` — wrap them so magnitudes cannot
+    # silently break the cosine semantics (found r4: the inherited
+    # fit_stream/predict_stream ran on un-normalized blocks).
+
+    def _normalized_blocks(self, make_blocks):
+        def wrapped():
+            return (_normalize_rows(
+                np.asarray(b, np.float64)).astype(self.dtype)
+                for b in make_blocks())
+        return wrapped
+
+    def fit_stream(self, make_blocks, *, d=None,
+                   resume: bool = False) -> "SphericalKMeans":
+        return super().fit_stream(self._normalized_blocks(make_blocks),
+                                  d=d, resume=resume)
+
+    def predict_stream(self, make_blocks):
+        return super().predict_stream(self._normalized_blocks(make_blocks))
+
+    def transform_stream(self, make_blocks, *, block_rows=None):
+        return super().transform_stream(
+            self._normalized_blocks(make_blocks), block_rows=block_rows)

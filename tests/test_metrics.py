@@ -176,3 +176,10 @@ def test_external_metrics_match_sklearn():
     assert adjusted_rand_score(np.zeros(10), np.zeros(10)) == 1.0
     with pytest.raises(ValueError, match="non-empty"):
         adjusted_rand_score([], [])
+
+
+def test_external_metrics_reject_nan_labels():
+    from kmeans_tpu.metrics import adjusted_rand_score
+    bad = np.array([0.0, 1.0, np.nan])
+    with pytest.raises(ValueError, match="NaN or Inf"):
+        adjusted_rand_score(bad, np.zeros(3))

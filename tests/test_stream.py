@@ -419,3 +419,29 @@ def test_stream_resume_exhausted_budget_is_noop(mesh8):
     np.testing.assert_array_equal(km.centroids, cents)
     assert km.iterations_run == iters
     np.testing.assert_array_equal(km.cluster_sizes_, sizes)
+
+
+def test_spherical_fit_stream_normalizes_blocks(mesh8):
+    """r4: SphericalKMeans' streaming paths must L2-normalize raw
+    blocks exactly like fit/predict do — a streamed fit on raw-magnitude
+    vectors must match the in-memory fit of the same data."""
+    from kmeans_tpu.models import SphericalKMeans
+    rng = np.random.default_rng(0)
+    dirs = rng.normal(size=(4, 6))
+    dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+    # Raw magnitudes vary wildly; direction carries the cluster signal.
+    X = np.concatenate([
+        d * rng.uniform(0.1, 100.0, size=(300, 1))
+        + 0.05 * rng.normal(size=(300, 6)) for d in dirs
+    ]).astype(np.float32)
+    init = X[rng.choice(len(X), 4, replace=False)]
+    kw = dict(k=4, seed=0, init=init, empty_cluster="keep",
+              verbose=False, mesh=mesh8, compute_sse=True)
+    mem = SphericalKMeans(**kw).fit(X)
+    st = SphericalKMeans(**kw)
+    st.fit_stream(_blocks_of(X, 400))
+    np.testing.assert_allclose(np.linalg.norm(st.centroids, axis=1),
+                               1.0, rtol=1e-5)
+    np.testing.assert_allclose(st.centroids, mem.centroids, atol=1e-4)
+    lab = np.concatenate(list(st.predict_stream(_blocks_of(X, 400))))
+    np.testing.assert_array_equal(lab, mem.predict(X))
