@@ -120,6 +120,14 @@ def _get_fns(mesh: Mesh, chunk: int, cov_type: str = "diag"):
                  pred_b(mesh, chunk_size=chunk)))
 
 
+def _reject_weighted_stream_item(item) -> None:
+    if isinstance(item, tuple):
+        raise ValueError(
+            "GaussianMixture.fit_stream does not support "
+            "(block, weights) items; pass bare (m, D) blocks "
+            "(KMeans.fit_stream supports weighted streams)")
+
+
 class GaussianMixture:
     """sklearn-style diagonal GMM, data-sharded over the TPU mesh.
 
@@ -613,12 +621,13 @@ class GaussianMixture:
                                             streamed_kmeans_parallel_init)
         if d is None:
             try:
-                peek = np.asarray(next(iter(make_blocks())),
-                                  dtype=self.dtype)
+                item = next(iter(make_blocks()))
             except StopIteration:
                 raise ValueError(
                     "make_blocks() yielded no rows — it must return a "
                     "FRESH iterable on every call") from None
+            _reject_weighted_stream_item(item)
+            peek = np.asarray(item, dtype=self.dtype)
             if peek.ndim != 2:
                 raise ValueError(f"blocks must be 2-D (m, D), got shape "
                                  f"{peek.shape}")
@@ -632,6 +641,7 @@ class GaussianMixture:
         sx = np.zeros(d)
         n_total = 0
         for block in make_blocks():
+            _reject_weighted_stream_item(block)
             b = np.asarray(block, np.float64)
             if b.ndim != 2 or b.shape[1] != d:
                 raise ValueError(f"block shape {b.shape} != (*, {d})")

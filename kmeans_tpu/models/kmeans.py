@@ -482,9 +482,16 @@ class KMeans:
         streams, so post-refill trajectories are equal in distribution,
         not bitwise.  ``d`` pre-declares the feature count (otherwise
         peeked from the first block).
+
+        Weighted streams: items may be ``(block, weights)`` pairs —
+        weights fold into every statistic exactly like ``fit``'s
+        ``sample_weight`` (streamed inits draw uniformly over
+        POSITIVE-weight rows, the in-memory rule; the streamed kmeans||
+        weights its D² mass).
         """
         from kmeans_tpu.parallel.sharding import shard_points
-        from kmeans_tpu.models.init import STREAM_INITIALIZERS
+        from kmeans_tpu.models.init import (STREAM_INITIALIZERS,
+                                            _split_block)
         log = IterationLogger(self.verbose and jax.process_index() == 0)
         muted = IterationLogger(False)
         log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
@@ -492,12 +499,14 @@ class KMeans:
         explicit_init = not isinstance(self.init, str) \
             and not callable(self.init)
         if d is None:
-            peek = np.asarray(next(iter(make_blocks())), dtype=self.dtype)
+            item = next(iter(make_blocks()))
+            peek = np.asarray(item[0] if isinstance(item, tuple) else item,
+                              dtype=self.dtype)
             if peek.ndim != 2:
                 raise ValueError(f"blocks must be 2-D (m, D), got shape "
                                  f"{peek.shape}")
             d = peek.shape[1]
-            del peek
+            del peek, item
 
         resume = bool(resume) and self.centroids is not None
         if resume and self.n_init != 1:
@@ -516,8 +525,8 @@ class KMeans:
                                    self.k, self.seed)
                 raw = [arr]
             elif callable(self.init):
-                first = np.asarray(next(iter(make_blocks())),
-                                   dtype=self.dtype)
+                first, _ = _split_block(next(iter(make_blocks())), d,
+                                        self.dtype)
                 raw = [np.asarray(self.init(first, self.k, s))
                        for s in seeds]
             else:
@@ -586,19 +595,20 @@ class KMeans:
             sse = [0.0] * len(active)
             far = [(-1.0, None)] * len(active)
             n_seen = 0
-            for block in make_blocks():
-                block = np.ascontiguousarray(
-                    np.asarray(block, dtype=self.dtype))
-                if block.ndim != 2 or block.shape[1] != d:
-                    raise ValueError(f"block shape {block.shape} != "
-                                     f"(*, {d})")
+            for item in make_blocks():
+                block, bw = _split_block(item, d, self.dtype)
                 if step_fn is None:                # chunk from a REAL block
                     _, _, step_fn, _, chunk = self._setup(block.shape[0], d)
                 if want_reservoir and not score_only:
+                    # Uniform over POSITIVE-weight rows — the in-memory
+                    # 'resample' engine's rule (zero-weight rows must
+                    # never seed a centroid).
+                    offer = block if bw is None else block[bw > 0]
                     for st_r in active:
-                        st_r.meta.reservoir.offer(block)
+                        st_r.meta.reservoir.offer(offer)
                 n_seen += block.shape[0]
-                pts, w = shard_points(block, mesh, chunk)
+                pts, w = shard_points(block, mesh, chunk,
+                                      sample_weight=bw)
                 # Dispatch every restart's step BEFORE any transfer, then
                 # ONE combined device_get per restart — each separate
                 # np.asarray pays a full host round trip on tunneled
@@ -996,6 +1006,8 @@ class KMeans:
         _, model_shards = mesh_shape(mesh)
         cents_dev = None
         for block in make_blocks():
+            if isinstance(block, tuple):     # weighted-stream item: the
+                block = block[0]             # weights are irrelevant here
             block = np.ascontiguousarray(np.asarray(block,
                                                     dtype=self.dtype))
             if block.ndim != 2:
@@ -1077,6 +1089,8 @@ class KMeans:
         block = block_rows or max(
             8192 * data_shards, (1 << 26) // max(self.k + d_model, 1))
         for raw in make_blocks():
+            if isinstance(raw, tuple):       # weighted-stream item: the
+                raw = raw[0]                 # weights are irrelevant here
             raw = np.asarray(raw, dtype=self.dtype)
             if raw.ndim != 2 or raw.shape[1] != d_model:
                 raise ValueError(f"block shape {raw.shape} != (*, "

@@ -116,9 +116,14 @@ class SphericalKMeans(KMeans):
 
     def _normalized_blocks(self, make_blocks):
         def wrapped():
-            return (_normalize_rows(
-                np.asarray(b, np.float64)).astype(self.dtype)
-                for b in make_blocks())
+            for item in make_blocks():
+                if isinstance(item, tuple):      # (block, weights) pair
+                    b, w = item
+                    yield (_normalize_rows(
+                        np.asarray(b, np.float64)).astype(self.dtype), w)
+                else:
+                    yield _normalize_rows(
+                        np.asarray(item, np.float64)).astype(self.dtype)
         return wrapped
 
     def fit_stream(self, make_blocks, *, d=None,

@@ -445,3 +445,79 @@ def test_spherical_fit_stream_normalizes_blocks(mesh8):
     np.testing.assert_allclose(st.centroids, mem.centroids, atol=1e-4)
     lab = np.concatenate(list(st.predict_stream(_blocks_of(X, 400))))
     np.testing.assert_array_equal(lab, mem.predict(X))
+
+
+def test_weighted_stream_matches_weighted_memory_fit(data, mesh8):
+    """r4: (block, weights) stream items fold weights into every
+    statistic exactly like fit's sample_weight."""
+    rng = np.random.RandomState(3)
+    w = rng.randint(1, 4, size=len(data)).astype(np.float64)
+    init = data[rng.choice(len(data), 5, replace=False)].copy()
+    mem = KMeans(k=5, seed=0, init=init, empty_cluster="keep",
+                 compute_sse=True, verbose=False, mesh=mesh8,
+                 chunk_size=128).fit(data, sample_weight=w)
+
+    def make_blocks():
+        for i in range(0, len(data), 1000):
+            yield data[i: i + 1000], w[i: i + 1000]
+
+    st = KMeans(k=5, seed=0, init=init, empty_cluster="keep",
+                compute_sse=True, verbose=False, mesh=mesh8,
+                chunk_size=128)
+    st.fit_stream(make_blocks)
+    np.testing.assert_allclose(st.centroids, mem.centroids, atol=1e-4)
+    n = min(len(st.sse_history), len(mem.sse_history))
+    np.testing.assert_allclose(st.sse_history[:n], mem.sse_history[:n],
+                               rtol=1e-5)
+
+
+def test_weighted_stream_init_skips_zero_weight_rows(mesh8):
+    """Zero-weight rows must never seed a centroid (the in-memory
+    positive-rows rule) — a poisoned far-out zero-weight region cannot
+    leak into streamed forgy or kmeans|| seeds."""
+    rng = np.random.RandomState(5)
+    good = rng.normal(size=(500, 2)).astype(np.float32)
+    poison = (rng.normal(size=(500, 2)) + 1e3).astype(np.float32)
+    X = np.concatenate([good, poison])
+    w = np.concatenate([np.ones(500), np.zeros(500)])
+
+    def make_blocks():
+        yield X[:600], w[:600]
+        yield X[600:], w[600:]
+
+    for init in ("forgy", "k-means++"):
+        km = KMeans(k=3, seed=0, init=init, empty_cluster="keep",
+                    verbose=False, mesh=mesh8, max_iter=5)
+        km.fit_stream(make_blocks)
+        assert np.all(np.abs(km.centroids) < 100), init
+
+
+def test_weighted_stream_guards(data):
+    km = KMeans(k=3, verbose=False, max_iter=1, empty_cluster="keep")
+    with pytest.raises(ValueError, match="must have shape"):
+        km.fit_stream(lambda: iter([(data[:100], np.ones(5))]))
+    with pytest.raises(ValueError, match="finite and >= 0"):
+        km.fit_stream(lambda: iter([(data[:100], -np.ones(100))]))
+    from kmeans_tpu import GaussianMixture
+    with pytest.raises(ValueError, match="does not support"):
+        GaussianMixture(n_components=2).fit_stream(
+            lambda: iter([(data[:100], np.ones(100))]))
+
+
+def test_weighted_stream_reusable_for_predict_and_transform(data, mesh8):
+    """A weighted make_blocks is reusable for predict_stream /
+    transform_stream: the weights are simply ignored there."""
+    rng = np.random.RandomState(3)
+    w = rng.randint(1, 4, size=len(data)).astype(np.float64)
+
+    def make_blocks():
+        for i in range(0, len(data), 2000):
+            yield data[i: i + 2000], w[i: i + 2000]
+
+    km = KMeans(k=4, seed=0, verbose=False, mesh=mesh8, max_iter=5,
+                empty_cluster="keep")
+    km.fit_stream(make_blocks)
+    lab = np.concatenate(list(km.predict_stream(make_blocks)))
+    np.testing.assert_array_equal(lab, km.predict(data))
+    tiles = np.concatenate(list(km.transform_stream(make_blocks)))
+    np.testing.assert_allclose(tiles, km.transform(data), atol=1e-5)
