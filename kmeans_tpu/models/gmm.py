@@ -120,14 +120,6 @@ def _get_fns(mesh: Mesh, chunk: int, cov_type: str = "diag"):
                  pred_b(mesh, chunk_size=chunk)))
 
 
-def _reject_weighted_stream_item(item) -> None:
-    if isinstance(item, tuple):
-        raise ValueError(
-            "GaussianMixture.fit_stream does not support "
-            "(block, weights) items; pass bare (m, D) blocks "
-            "(KMeans.fit_stream supports weighted streams)")
-
-
 class GaussianMixture:
     """sklearn-style diagonal GMM, data-sharded over the TPU mesh.
 
@@ -595,10 +587,12 @@ class GaussianMixture:
         K-Means streaming path already sums).
 
         ``make_blocks()`` returns a fresh iterable of (n_i, D) host
-        blocks, re-invoked every EM iteration (one epoch = one exact
-        E-step; the float64 host M-step is unchanged), so the trajectory
-        matches an in-memory ``fit`` of the concatenated blocks up to fp
-        summation order.  ``n_init`` restarts run INTERLEAVED — every
+        blocks — or ``(block, weights)`` pairs, folding weights into
+        every statistic like ``fit``'s ``sample_weight`` — re-invoked
+        every EM iteration (one epoch = one exact E-step; the float64
+        host M-step is unchanged), so the trajectory matches an
+        in-memory ``fit`` of the concatenated blocks up to fp summation
+        order.  ``n_init`` restarts run INTERLEAVED — every
         epoch computes all live restarts' statistics from one shared
         pass (R x compute, 1x IO) — and the winner is the restart with
         the highest final ``lower_bound_``, the in-memory selection
@@ -617,7 +611,8 @@ class GaussianMixture:
         initial responsibilities.
         """
         from kmeans_tpu.parallel.sharding import shard_points
-        from kmeans_tpu.models.init import (streamed_forgy_init,
+        from kmeans_tpu.models.init import (_split_block,
+                                            streamed_forgy_init,
                                             streamed_kmeans_parallel_init)
         if d is None:
             try:
@@ -626,34 +621,43 @@ class GaussianMixture:
                 raise ValueError(
                     "make_blocks() yielded no rows — it must return a "
                     "FRESH iterable on every call") from None
-            _reject_weighted_stream_item(item)
-            peek = np.asarray(item, dtype=self.dtype)
+            peek = np.asarray(item[0] if isinstance(item, tuple) else item,
+                              dtype=self.dtype)
             if peek.ndim != 2:
                 raise ValueError(f"blocks must be 2-D (m, D), got shape "
                                  f"{peek.shape}")
             d = peek.shape[1]
-            del peek
+            del peek, item
         mesh = self._resolve_mesh()
         ct = self.covariance_type
         k = self.n_components
 
-        # ---- pass: centering shift (+ row count) in float64 on host.
+        # ---- pass: weighted centering shift (+ positive-row count) in
+        # float64 on the host.  Items may be (block, weights) pairs —
+        # weights fold into every statistic like fit's sample_weight.
         sx = np.zeros(d)
-        n_total = 0
-        for block in make_blocks():
-            _reject_weighted_stream_item(block)
-            b = np.asarray(block, np.float64)
-            if b.ndim != 2 or b.shape[1] != d:
-                raise ValueError(f"block shape {b.shape} != (*, {d})")
-            sx += b.sum(axis=0)
-            n_total += len(b)
-        if n_total == 0:
+        sw_total = 0.0
+        n_rows = n_pos = 0
+        for item in make_blocks():
+            block, bw = _split_block(item, d, np.float64)
+            n_rows += block.shape[0]
+            if bw is None:
+                sx += block.sum(axis=0)
+                sw_total += block.shape[0]
+                n_pos += block.shape[0]
+            else:
+                sx += (block * bw[:, None]).sum(axis=0)
+                sw_total += float(bw.sum())
+                n_pos += int((bw > 0).sum())
+        if n_rows == 0:
             raise ValueError("make_blocks() yielded no rows — it must "
                              "return a FRESH iterable on every call")
-        if n_total < k:
-            raise ValueError(f"Not enough data points ({n_total}) to "
+        if n_pos == 0:          # rows exist but every weight is zero
+            raise ValueError("total sample weight must be positive")
+        if n_pos < k:
+            raise ValueError(f"Not enough data points ({n_pos}) to "
                              f"initialize {k} clusters")
-        self.shift_ = sx / n_total
+        self.shift_ = sx / sw_total
         shift = self.shift_
 
         chunk = self.chunk_size
@@ -665,12 +669,8 @@ class GaussianMixture:
             step arguments (post points/weights)."""
             nonlocal chunk, step_fn
             acc = [None] * len(tables_list)
-            for block in make_blocks():
-                block = np.ascontiguousarray(np.asarray(block,
-                                                        dtype=self.dtype))
-                if block.ndim != 2 or block.shape[1] != d:
-                    raise ValueError(f"block shape {block.shape} != "
-                                     f"(*, {d})")
+            for item in make_blocks():
+                block, bw = _split_block(item, d, self.dtype)
                 if step_fn is None:
                     data_shards, _ = mesh_shape(mesh)
                     eff_k = k * d if ct == "full" else k
@@ -678,7 +678,8 @@ class GaussianMixture:
                         -(-block.shape[0] // data_shards), eff_k, d,
                         budget_elems=EM_CHUNK_BUDGET)
                     step_fn = _get_fns(mesh, chunk, ct)[0]
-                pts, w = shard_points(block, mesh, chunk)
+                pts, w = shard_points(block, mesh, chunk,
+                                      sample_weight=bw)
                 outs = [step_fn(pts, w, *t) for t in tables_list]
                 for i, st in enumerate(outs):
                     st = jax.device_get(st)
@@ -701,13 +702,13 @@ class GaussianMixture:
                 (mesh, "gmm_total_scatter"),
                 lambda: make_total_scatter_fn(mesh))
             T = np.zeros((d, d))
-            for block in make_blocks():
-                block = np.ascontiguousarray(np.asarray(block,
-                                                        dtype=self.dtype))
+            for item in make_blocks():
+                block, bw = _split_block(item, d, self.dtype)
                 pts, w = shard_points(
                     block, mesh, chunk or choose_chunk_size(
                         -(-block.shape[0] // mesh_shape(mesh)[0]), k, d,
-                        budget_elems=EM_CHUNK_BUDGET))
+                        budget_elems=EM_CHUNK_BUDGET),
+                    sample_weight=bw)
                 T += np.asarray(ts_fn(pts, w, jnp.asarray(
                     shift.astype(self.dtype))), np.float64)
             self._total_scatter = T
@@ -1000,8 +1001,10 @@ class GaussianMixture:
         data_shards, _ = mesh_shape(mesh)
         d = self.means_.shape[1]
         k = self.n_components
+        from kmeans_tpu.models.init import _block_of
         params = None
         for block in make_blocks():
+            block = _block_of(block)         # weights irrelevant here
             block = np.ascontiguousarray(np.asarray(block,
                                                     dtype=self.dtype))
             if block.ndim != 2 or block.shape[1] != d:

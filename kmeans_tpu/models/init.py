@@ -345,6 +345,19 @@ def kmeans_parallel_init(X, k: int, seed: int, *, rounds: int = 5,
 # streams) — ``_split_block`` is the single decoder.
 
 
+def _block_of(item):
+    """Block part of a stream item, for inference paths that don't
+    consume weights (predict/transform/score streams) — validates the
+    tuple arity like ``_split_block`` but drops the weights."""
+    if isinstance(item, tuple):
+        if len(item) != 2:
+            raise ValueError(
+                f"stream items must be (m, D) blocks or (block, weights) "
+                f"pairs, got a {len(item)}-tuple")
+        return item[0]
+    return item
+
+
 def _split_block(item, d: int, dtype):
     """Decode one stream item: a bare (m, D) array or a (block, weights)
     tuple.  Returns (block contiguous in ``dtype``, weights (m,) in the

@@ -1004,10 +1004,10 @@ class KMeans:
         from kmeans_tpu.parallel.sharding import shard_points
         mesh = self._resolve_mesh()
         _, model_shards = mesh_shape(mesh)
+        from kmeans_tpu.models.init import _block_of
         cents_dev = None
         for block in make_blocks():
-            if isinstance(block, tuple):     # weighted-stream item: the
-                block = block[0]             # weights are irrelevant here
+            block = _block_of(block)         # weights irrelevant here
             block = np.ascontiguousarray(np.asarray(block,
                                                     dtype=self.dtype))
             if block.ndim != 2:
@@ -1088,9 +1088,9 @@ class KMeans:
         # small-k/large-D transform upload an unbounded input block.
         block = block_rows or max(
             8192 * data_shards, (1 << 26) // max(self.k + d_model, 1))
+        from kmeans_tpu.models.init import _block_of
         for raw in make_blocks():
-            if isinstance(raw, tuple):       # weighted-stream item: the
-                raw = raw[0]                 # weights are irrelevant here
+            raw = _block_of(raw)             # weights irrelevant here
             raw = np.asarray(raw, dtype=self.dtype)
             if raw.ndim != 2 or raw.shape[1] != d_model:
                 raise ValueError(f"block shape {raw.shape} != (*, "

@@ -498,10 +498,11 @@ def test_weighted_stream_guards(data):
         km.fit_stream(lambda: iter([(data[:100], np.ones(5))]))
     with pytest.raises(ValueError, match="finite and >= 0"):
         km.fit_stream(lambda: iter([(data[:100], -np.ones(100))]))
+    # GMM weighted streams are supported too (r4):
     from kmeans_tpu import GaussianMixture
-    with pytest.raises(ValueError, match="does not support"):
+    with pytest.raises(ValueError, match="must have shape"):
         GaussianMixture(n_components=2).fit_stream(
-            lambda: iter([(data[:100], np.ones(100))]))
+            lambda: iter([(data[:100], np.ones(5))]))
 
 
 def test_weighted_stream_reusable_for_predict_and_transform(data, mesh8):
@@ -521,3 +522,35 @@ def test_weighted_stream_reusable_for_predict_and_transform(data, mesh8):
     np.testing.assert_array_equal(lab, km.predict(data))
     tiles = np.concatenate(list(km.transform_stream(make_blocks)))
     np.testing.assert_allclose(tiles, km.transform(data), atol=1e-5)
+
+
+def test_gmm_weighted_stream_matches_weighted_memory(data, mesh8):
+    """r4: GMM weighted streams fold weights into the E statistics
+    exactly like fit's sample_weight."""
+    from kmeans_tpu import GaussianMixture
+    rng = np.random.RandomState(4)
+    w = rng.randint(1, 4, size=len(data)).astype(np.float64)
+    init = data[rng.choice(len(data), 3, replace=False)].astype(np.float64)
+    kw = dict(n_components=3, means_init=init, max_iter=15, tol=1e-6,
+              seed=0, mesh=mesh8)
+    mem = GaussianMixture(**kw).fit(data, sample_weight=w)
+
+    def make_blocks():
+        for i in range(0, len(data), 2000):
+            yield data[i: i + 2000], w[i: i + 2000]
+
+    st = GaussianMixture(**kw).fit_stream(make_blocks)
+    np.testing.assert_allclose(st.lower_bound_, mem.lower_bound_,
+                               rtol=1e-5)
+    np.testing.assert_allclose(st.means_, mem.means_, atol=1e-3)
+    np.testing.assert_allclose(st.covariances_, mem.covariances_,
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_all_zero_weight_stream_raises_pointed_error(data):
+    """review r4: all-zero weights must raise the weight error, not the
+    misleading FRESH-iterable one (rows WERE yielded)."""
+    from kmeans_tpu import GaussianMixture
+    with pytest.raises(ValueError, match="total sample weight"):
+        GaussianMixture(n_components=2).fit_stream(
+            lambda: iter([(data[:100], np.zeros(100))]))
