@@ -1000,26 +1000,38 @@ class KMeans:
             raise ValueError("Model must be fitted before prediction")
         return self._predict_stream_blocks(make_blocks)
 
-    def _predict_stream_blocks(self, make_blocks):
-        from kmeans_tpu.parallel.sharding import shard_points
+    def _iter_stream_blocks(self, make_blocks, *, with_weights: bool):
+        """Shared scaffolding of every streaming inference/scoring
+        surface (predict/transform/score streams): decode each item
+        ((block, weights) pairs kept or dropped per ``with_weights``),
+        validate its shape against the fitted model, lazily upload the
+        fitted centroids ONCE, and raise the FRESH-iterable error on an
+        empty stream (an exhausted generator must not silently produce
+        zero output — review r4).  Yields
+        (block, weights_or_None, cents_dev, mesh, model_shards)."""
+        from kmeans_tpu.models.init import _block_of, _split_block
         mesh = self._resolve_mesh()
         _, model_shards = mesh_shape(mesh)
-        from kmeans_tpu.models.init import _block_of
+        d = self.centroids.shape[1]
         cents_dev = None
-        for block in make_blocks():
-            block = _block_of(block)         # weights irrelevant here
-            block = np.ascontiguousarray(np.asarray(block,
-                                                    dtype=self.dtype))
-            if block.ndim != 2:
-                raise ValueError(
-                    f"block must be 2-D (m, D), got shape {block.shape}")
-            if block.shape[1] != self.centroids.shape[1]:
-                raise ValueError(
-                    f"block has {block.shape[1]} features, model has "
-                    f"{self.centroids.shape[1]}")
+        empty = True
+        for item in make_blocks():
+            raw = item if with_weights else _block_of(item)
+            block, bw = _split_block(raw, d, self.dtype)
+            empty = False
             if cents_dev is None:
                 cents_dev = self._put_centroids(
                     np.asarray(self.centroids), mesh, model_shards)
+            yield block, bw, cents_dev, mesh, model_shards
+        if empty:
+            raise ValueError(
+                "make_blocks() yielded no rows — it must return a FRESH "
+                "iterable on every call")
+
+    def _predict_stream_blocks(self, make_blocks):
+        from kmeans_tpu.parallel.sharding import shard_points
+        for block, _, cents_dev, mesh, _ in self._iter_stream_blocks(
+                make_blocks, with_weights=False):
             chunk = self._chunk_for(*block.shape)
             _, predict_fn = _get_step_fns(mesh, chunk,
                                           self._mode(*block.shape))
@@ -1073,31 +1085,21 @@ class KMeans:
 
     def _transform_stream_blocks(self, make_blocks, block_rows):
         from kmeans_tpu.parallel.sharding import shard_points
-        mesh = self._resolve_mesh()
-        data_shards, model_shards = mesh_shape(mesh)
+        data_shards, _ = mesh_shape(self._resolve_mesh())
         # The full (n, k) matrix only exists on the host; pallas/auto map
         # to the equivalent matmul form (the fused kernel never
         # materializes distances).
         mode = {"auto": "matmul", "pallas": "matmul",
                 "pallas_bf16": "matmul_bf16"}.get(self.distance_mode,
                                                   self.distance_mode)
-        cents_dev = None
         d_model = self.centroids.shape[1]
         # Auto block: ~2^26 elements across BOTH the (block, D) input and
         # the (block, k) output tile — sizing on k alone would let a
         # small-k/large-D transform upload an unbounded input block.
         block = block_rows or max(
             8192 * data_shards, (1 << 26) // max(self.k + d_model, 1))
-        from kmeans_tpu.models.init import _block_of
-        for raw in make_blocks():
-            raw = _block_of(raw)             # weights irrelevant here
-            raw = np.asarray(raw, dtype=self.dtype)
-            if raw.ndim != 2 or raw.shape[1] != d_model:
-                raise ValueError(f"block shape {raw.shape} != (*, "
-                                 f"{d_model})")
-            if cents_dev is None:
-                cents_dev = self._put_centroids(
-                    np.asarray(self.centroids), mesh, model_shards)
+        for raw, _, cents_dev, mesh, _ in self._iter_stream_blocks(
+                make_blocks, with_weights=False):
             for start in range(0, raw.shape[0], block):
                 xb = np.ascontiguousarray(raw[start: start + block])
                 chunk = self._chunk_for(*xb.shape)
@@ -1118,6 +1120,25 @@ class KMeans:
             np.asarray(self.centroids), mesh, model_shards)
         stats = step_fn(ds.points, ds.weights, cents_dev)
         return -float(stats.sse)
+
+    def score_stream(self, make_blocks) -> float:
+        """Negative SSE of a block stream under the fitted centroids —
+        the scoring complement of ``fit_stream``/``predict_stream`` (one
+        pass, bounded device memory; items may be (block, weights)
+        pairs).  An empty/exhausted stream raises rather than returning
+        a perfect -0.0 score."""
+        from kmeans_tpu.parallel.sharding import shard_points
+        if self.centroids is None:
+            raise ValueError("Model must be fitted before prediction")
+        sse = 0.0
+        for block, bw, cents_dev, mesh, _ in self._iter_stream_blocks(
+                make_blocks, with_weights=True):
+            chunk = self._chunk_for(*block.shape)
+            step_fn, _ = _get_step_fns(mesh, chunk,
+                                       self._mode(*block.shape))
+            pts, w = shard_points(block, mesh, chunk, sample_weight=bw)
+            sse += float(step_fn(pts, w, cents_dev).sse)
+        return -sse
 
     # ---------------------------------------------------- sklearn-style sugar
 

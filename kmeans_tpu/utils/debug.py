@@ -39,10 +39,13 @@ def check_determinism(model_factory: Callable[[], object], X,
     compare full trajectories bit-for-bit.
 
     ``model_factory`` must build a NEW, identically-configured model each
-    call (e.g. ``lambda: KMeans(k=8, seed=0, verbose=False)``).  Returns a
+    call (e.g. ``lambda: KMeans(k=8, seed=0, verbose=False)``).  Works
+    for the K-Means family AND :class:`GaussianMixture` (r4).  Returns a
     report; ``report["deterministic"]`` is the verdict, and
-    ``report["details"]`` names the first field that diverged (centroids,
-    sse_history, iterations, labels) for debugging.
+    ``report["details"]`` names the first field that diverged — per
+    family, see ``_snapshot`` (K-Means: centroids/sse_history/
+    iterations/labels; GMM: means/covariances/weights/lower_bound/
+    iterations/labels).
     """
     if runs < 2:
         raise ValueError(f"runs must be >= 2, got {runs}")
@@ -63,31 +66,44 @@ def check_determinism(model_factory: Callable[[], object], X,
                     "sample_weight; omit it for this model")
             fit_kwargs["sample_weight"] = sample_weight
         model.fit(X.copy(), **fit_kwargs)
-        snap = {
-            "centroids": np.asarray(model.centroids).copy(),
-            "sse_history": np.asarray(model.sse_history, dtype=np.float64),
-            "iterations": model.iterations_run,
-            "labels": np.asarray(model.predict(X)).copy(),
-        }
+        snap = _snapshot(model, X)
         if ref is None:
             ref = snap
             continue
-        for field in ("iterations",):
-            if snap[field] != ref[field]:
-                return DeterminismReport(
-                    deterministic=False, runs=r + 1,
-                    details=f"{field} diverged on run {r}: "
-                            f"{ref[field]} vs {snap[field]}")
-        for field in ("centroids", "sse_history", "labels"):
-            if snap[field].shape != ref[field].shape or \
-                    not np.array_equal(snap[field], ref[field]):
+        for field, val in snap.items():
+            a = np.asarray(ref[field])
+            b = np.asarray(val)
+            if a.shape != b.shape or not np.array_equal(a, b):
                 where = ""
-                if snap[field].shape == ref[field].shape:
-                    bad = np.flatnonzero(
-                        (snap[field] != ref[field]).reshape(-1))
+                if a.shape == b.shape and a.ndim:
+                    bad = np.flatnonzero((a != b).reshape(-1))
                     where = f" (first mismatch at flat index {bad[0]})"
+                elif not a.ndim:
+                    where = f": {a} vs {b}"
                 return DeterminismReport(
                     deterministic=False, runs=r + 1,
                     details=f"{field} diverged on run {r}{where}")
     return DeterminismReport(deterministic=True, runs=runs,
                              details="all trajectories bit-identical")
+
+
+def _snapshot(model, X) -> dict:
+    """Bit-comparable trajectory snapshot, per model family (the K-Means
+    estimators expose centroids/sse_history; GaussianMixture its EM
+    parameters — r4: the checker covers the mixture family too)."""
+    if hasattr(model, "centroids"):              # K-Means family
+        return {
+            "centroids": np.asarray(model.centroids).copy(),
+            "sse_history": np.asarray(model.sse_history,
+                                      dtype=np.float64),
+            "iterations": model.iterations_run,
+            "labels": np.asarray(model.predict(X)).copy(),
+        }
+    return {                                     # GaussianMixture family
+        "means": np.asarray(model.means_).copy(),
+        "covariances": np.asarray(model.covariances_).copy(),
+        "weights": np.asarray(model.weights_).copy(),
+        "lower_bound": np.float64(model.lower_bound_),
+        "iterations": model.n_iter_,
+        "labels": np.asarray(model.predict(X)).copy(),
+    }

@@ -80,3 +80,16 @@ def test_sample_weight_supported(X, mesh8):
         lambda: KMeans(k=5, seed=2, verbose=False, mesh=mesh8), X,
         sample_weight=w)
     assert report["deterministic"], report
+
+
+def test_determinism_checker_covers_gmm():
+    """r4: the reproducibility checker (SURVEY.md §5 race-detection
+    analogue) serves the mixture family too."""
+    from kmeans_tpu import GaussianMixture
+    from kmeans_tpu.data.synthetic import make_blobs
+    X, _ = make_blobs(600, centers=3, n_features=4, random_state=0,
+                      dtype=np.float32)
+    rep = check_determinism(
+        lambda: GaussianMixture(n_components=3, seed=0, max_iter=10,
+                                covariance_type="full"), X)
+    assert rep["deterministic"], rep

@@ -268,8 +268,13 @@ def test_predict_stream_guards():
     X = np.random.default_rng(0).normal(size=(200, 6)).astype(np.float32)
     km.fit(X)
     bad = lambda: iter([np.zeros((8, 5), np.float32)])
-    with pytest.raises(ValueError, match="features"):
+    with pytest.raises(ValueError, match=r"block shape .* != \(\*, 6\)"):
         list(km.predict_stream(bad))
+    # An exhausted/empty stream raises, never silently yields nothing.
+    with pytest.raises(ValueError, match="FRESH iterable"):
+        list(km.predict_stream(lambda: iter([])))
+    with pytest.raises(ValueError, match="FRESH iterable"):
+        km.score_stream(lambda: iter([]))
 
 
 # ---- streamed init over the FULL stream (r3 VERDICT #3) ----------------
@@ -554,3 +559,16 @@ def test_all_zero_weight_stream_raises_pointed_error(data):
     with pytest.raises(ValueError, match="total sample weight"):
         GaussianMixture(n_components=2).fit_stream(
             lambda: iter([(data[:100], np.zeros(100))]))
+
+
+def test_score_stream_matches_score(data, mesh8):
+    km = KMeans(k=4, seed=0, verbose=False, mesh=mesh8, max_iter=5,
+                empty_cluster="keep").fit(data)
+    got = km.score_stream(_blocks_of(data, 1700))
+    np.testing.assert_allclose(got, km.score(data), rtol=1e-6)
+    # Weighted: 2x weights double the SSE of an unweighted stream.
+    w = np.full(len(data), 2.0)
+    got_w = km.score_stream(
+        lambda: ((data[i:i+1700], w[i:i+1700])
+                 for i in range(0, len(data), 1700)))
+    np.testing.assert_allclose(got_w, 2.0 * km.score(data), rtol=1e-6)
