@@ -69,18 +69,24 @@ class _EpochReservoir:
 
 
 class _ArraySource:
-    """Adapter giving a host ndarray the ShardedDataset row-access API."""
+    """Adapter giving a host ndarray the ShardedDataset row-access API.
+    Optional ``weights`` make ``positive_rows``/``host_weights`` honor
+    per-row sample weights (a zero-weight row must never seed a
+    centroid)."""
 
-    def __init__(self, X: np.ndarray):
+    def __init__(self, X: np.ndarray, weights: Optional[np.ndarray] = None):
         self._X = np.asarray(X)
         self.n, self.d = self._X.shape
         self.dtype = self._X.dtype
+        self._w = None if weights is None else np.asarray(weights)
 
     def take(self, idx):
         return self._X[idx]
 
     def positive_rows(self):
-        return np.arange(self.n)
+        if self._w is None:
+            return np.arange(self.n)
+        return np.flatnonzero(self._w > 0)
 
     @property
     def host(self):
@@ -88,11 +94,13 @@ class _ArraySource:
 
     @property
     def host_weights(self):
-        return None
+        return self._w
 
 
-def as_source(X):
-    return X if hasattr(X, "take") and hasattr(X, "n") else _ArraySource(X)
+def as_source(X, weights=None):
+    if hasattr(X, "take") and hasattr(X, "n"):
+        return X
+    return _ArraySource(X, weights)
 
 
 def forgy_init(X, k: int, seed: int, *, validate: bool = True) -> np.ndarray:

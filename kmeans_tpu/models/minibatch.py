@@ -96,9 +96,20 @@ class MiniBatchKMeans(KMeans):
 
     # ------------------------------------------------------------------- fit
 
-    def fit(self, X, y=None, *, resume: bool = False) -> "MiniBatchKMeans":
+    def fit(self, X, y=None, *, sample_weight=None,
+            resume: bool = False) -> "MiniBatchKMeans":
+        """Fit with mini-batch Sculley updates.  ``sample_weight``
+        follows sklearn's MiniBatch semantics: rows are SAMPLED
+        uniformly and the weights scale every batch statistic (sums,
+        counts, lifetime ``seen``) — exactly what sklearn's
+        ``MiniBatchKMeans.fit(X, sample_weight=...)`` does."""
         if self.sampling == "host":
-            return self._fit_host(X, resume=resume)
+            # The host engine exists for X bigger than device memory:
+            # weights stay on the host (routing through cache() would
+            # upload the whole dataset, review r4).
+            return self._fit_host(X, sample_weight=sample_weight,
+                                  resume=resume)
+        X = self._apply_sample_weight(X, sample_weight)
         self._fit_device(X, resume=resume)
         # Multi-host process-local fits materialize labels_ HERE, while
         # every process is still executing fit: deferring the global
@@ -152,17 +163,20 @@ class MiniBatchKMeans(KMeans):
                     c.astype(self.dtype), mesh, model_shards))
                 return float(st.sse)
         else:
-            X = np.asarray(init_src)
+            from kmeans_tpu.models.init import as_source
+            src = as_source(init_src)
+            X = np.asarray(src.host)
+            hw = src.host_weights             # None when unweighted
             n = X.shape[0]
             take = min(n, max(3 * self.batch_size, 3 * self.k))
             rng = np.random.default_rng([self.seed, 0x1717])
-            val = np.ascontiguousarray(
-                X[rng.choice(n, size=take, replace=False)].astype(
-                    self.dtype))
+            idx = rng.choice(n, size=take, replace=False)
+            val = np.ascontiguousarray(X[idx].astype(self.dtype))
+            vw = None if hw is None else np.asarray(hw)[idx]
             from kmeans_tpu.parallel.sharding import shard_points
             mesh, model_shards, step_fn, _, chunk = self._setup(
                 take, X.shape[1])
-            pts, w = shard_points(val, mesh, chunk)
+            pts, w = shard_points(val, mesh, chunk, sample_weight=vw)
             def score(c):
                 st = step_fn(pts, w, self._put_centroids(
                     c.astype(self.dtype), mesh, model_shards))
@@ -328,37 +342,57 @@ class MiniBatchKMeans(KMeans):
             log.converged(self.iterations_run)
         return self
 
-    def _fit_host(self, X, y=None, *,
+    def _fit_host(self, X, y=None, *, sample_weight=None,
                   resume: bool = False) -> "MiniBatchKMeans":
         """Host sampling engine (the r1 path): per-iteration host
-        ``rng.choice`` + batch upload.  Use when X exceeds device memory."""
-        from kmeans_tpu.parallel.sharding import ShardedDataset
+        ``rng.choice`` + batch upload.  Use when X exceeds device
+        memory — weights are validated and kept on the host (no full
+        upload ever happens)."""
+        from kmeans_tpu.parallel.sharding import (ShardedDataset,
+                                                  _validate_sample_weight)
+        from kmeans_tpu.models.init import as_source
+        hw = None
         if isinstance(X, ShardedDataset):
             if X.host is None:
                 raise ValueError("sampling='host' needs host data to draw "
                                  "batches; pass a NumPy array or use "
                                  "sampling='device'")
+            if sample_weight is not None:
+                raise ValueError("pass sample_weight when caching the "
+                                 "dataset, not on a pre-built "
+                                 "ShardedDataset")
+            hw = X.host_weights               # None when unweighted
             X = X.host
         X = np.ascontiguousarray(np.asarray(X, dtype=self.dtype))
         if X.ndim != 2:
             raise ValueError(f"X must be 2-D (n, D), got shape {X.shape}")
         n, d = X.shape
+        if sample_weight is not None:
+            hw = _validate_sample_weight(sample_weight, n, self.dtype)
         bs = min(self.batch_size, n)
+        total_w = float(hw.sum()) if hw is not None else float(n)
         self._set_fit_data(X)                         # feeds lazy labels_
         import jax
         log = IterationLogger(self.verbose and jax.process_index() == 0)
 
-        centroids, start_iter, seen = self._resume_or_init(X, resume)
+        # The weighted source keeps init draws off zero-weight rows
+        # (forgy_init's invariant) and weights the n_init scoring.
+        centroids, start_iter, seen = self._resume_or_init(
+            as_source(X, hw), resume)
         log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
 
         for iteration in range(start_iter, self.max_iter):
             # Per-iteration derived RNG: batch i is a pure function of
             # (seed, i), so a checkpointed run resumes the SAME batch
-            # sequence an uninterrupted run would see.
+            # sequence an uninterrupted run would see.  Rows are drawn
+            # UNIFORMLY; weights scale the statistics (sklearn's
+            # MiniBatch sample_weight semantics).
             rng = np.random.default_rng([self.seed, iteration])
-            batch = X[rng.choice(n, size=bs, replace=False)]
+            idx = rng.choice(n, size=bs, replace=False)
             centroids, seen, max_shift = self._incremental_update(
-                batch, centroids, seen, iteration, log, sse_scale=n / bs)
+                X[idx], centroids, seen, iteration, log,
+                batch_weight=hw[idx] if hw is not None else None,
+                total_w=total_w)
             if max_shift < self.tolerance:
                 log.converged(iteration + 1)
                 break
@@ -369,10 +403,15 @@ class MiniBatchKMeans(KMeans):
 
     def _incremental_update(self, batch: np.ndarray, centroids: np.ndarray,
                             seen: np.ndarray, iteration: int,
-                            log: IterationLogger, sse_scale: float = 1.0):
+                            log: IterationLogger, sse_scale: float = 1.0,
+                            batch_weight=None, total_w=None):
         """One Sculley update from one HOST batch: fused stats on device,
         then the count-weighted interpolation.  Used by the host sampling
         engine and ``partial_fit`` (caller-provided batches).
+        ``batch_weight`` scales the batch's statistics; ``total_w`` (the
+        dataset's total weight) switches the SSE estimate to the
+        weighted scale factor ``total_w / batch_weight_sum`` (for
+        unweighted data that reduces to the old ``n / bs``).
 
         Reassignment candidates are drawn on the host from THIS batch
         (seeded by ``[seed, iteration]`` — a different stream than the
@@ -381,18 +420,29 @@ class MiniBatchKMeans(KMeans):
         bs, d = batch.shape
         mesh, model_shards, step_fn, _, chunk = self._setup(bs, d)
         from kmeans_tpu.parallel.sharding import shard_points
-        pts, w = shard_points(batch, mesh, chunk)
+        pts, w = shard_points(batch, mesh, chunk,
+                              sample_weight=batch_weight)
         stats = step_fn(pts, w, self._put_centroids(
             centroids.astype(self.dtype), mesh, model_shards))
         sums = np.asarray(stats.sums, dtype=np.float64)[: self.k]
         counts = np.asarray(stats.counts, dtype=np.float64)[: self.k]
+        if total_w is not None:
+            sse_scale = total_w / max(float(counts.sum()), 1.0)
         candidates = None
         do_re = self.reassignment_ratio > 0 and \
             (iteration + 1) % self._reassign_every(bs) == 0
         if do_re:
             rng = np.random.default_rng([self.seed, iteration, 0xC4ED])
-            idx = rng.choice(bs, size=min(self.k, bs), replace=False)
-            candidates = batch[idx].astype(np.float64)
+            # Only positive-weight rows are eligible replacement centers
+            # (the device engine's _batch_candidates masks bw > 0 too);
+            # the unweighted draw stream is unchanged (elig = arange).
+            elig = (np.arange(bs) if batch_weight is None
+                    else np.flatnonzero(np.asarray(batch_weight) > 0))
+            take = min(self.k, len(elig))
+            if take:
+                idx = elig[rng.choice(len(elig), size=take,
+                                      replace=False)]
+                candidates = batch[idx].astype(np.float64)
         return self._apply_batch_stats(sums, counts, centroids, seen,
                                        iteration, log,
                                        sse=float(stats.sse),

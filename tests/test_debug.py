@@ -67,10 +67,29 @@ def test_rejects_bad_args(X):
 
 
 def test_sample_weight_unsupported_model_clear_error(X):
+    """Every shipped model family accepts sample_weight now
+    (MiniBatchKMeans gained it r4), so the pointed guard is exercised
+    with a minimal stub whose fit doesn't take the kwarg."""
+    class NoWeights:
+        verbose = False
+
+        def fit(self, X):
+            return self
+
     with pytest.raises(ValueError, match="sample_weight"):
-        check_determinism(
-            lambda: MiniBatchKMeans(k=3, seed=0, verbose=False), X,
-            sample_weight=np.ones(X.shape[0], np.float32))
+        check_determinism(lambda: NoWeights(), X,
+                          sample_weight=np.ones(X.shape[0], np.float32))
+
+
+def test_minibatch_sample_weight_deterministic(X, mesh8):
+    """r4: weighted MiniBatch fits are reproducible through the checker."""
+    w = np.ones(X.shape[0], np.float32)
+    w[:100] = 3.0
+    report = check_determinism(
+        lambda: MiniBatchKMeans(k=3, seed=0, batch_size=128, max_iter=6,
+                                verbose=False, mesh=mesh8), X,
+        sample_weight=w)
+    assert report["deterministic"], report
 
 
 def test_sample_weight_supported(X, mesh8):

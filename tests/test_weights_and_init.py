@@ -169,3 +169,47 @@ def test_device_kmeanspp_distinct_centers(mesh8):
     ds._host = None
     km.fit(ds)
     assert len(np.unique(km.centroids.round(9), axis=0)) == 8
+
+
+@pytest.mark.parametrize("sampling", ["device", "host"])
+def test_minibatch_fit_accepts_sample_weight(sampling, mesh8):
+    """r4 sklearn parity: MiniBatchKMeans.fit(X, sample_weight=...) —
+    rows sampled uniformly, weights scale every statistic.  Heavily
+    up-weighting one blob must pull its centroid estimate like the
+    weighted full-batch fit does."""
+    from kmeans_tpu.models import MiniBatchKMeans
+    rng = np.random.default_rng(0)
+    X = np.concatenate([rng.normal(size=(1000, 4)) - 4,
+                        rng.normal(size=(1000, 4)) + 4]).astype(np.float32)
+    w = np.concatenate([np.full(1000, 10.0), np.ones(1000)])
+    init = np.array([[-4.0] * 4, [4.0] * 4], np.float32)
+    full = KMeans(k=2, seed=0, init=init, verbose=False,
+                  mesh=mesh8).fit(X, sample_weight=w)
+    mb = MiniBatchKMeans(k=2, seed=0, init=init, batch_size=512,
+                         max_iter=60, verbose=False, mesh=mesh8,
+                         sampling=sampling)
+    mb.fit(X, sample_weight=w)
+    np.testing.assert_allclose(mb.centroids, full.centroids, atol=0.3)
+    # Lifetime counts reflect the 10x weight imbalance.
+    assert mb._seen[0] > 4 * mb._seen[1]
+
+
+def test_minibatch_host_engine_weights_respect_zero_rows(mesh8):
+    """r4 review: the host engine must keep weights on the HOST (no full
+    upload), seed inits only from positive-weight rows, and never
+    reassign a dead center onto a zero-weight row."""
+    from kmeans_tpu.models import MiniBatchKMeans
+    rng = np.random.default_rng(1)
+    good = rng.normal(size=(800, 3)).astype(np.float32)
+    poison = (rng.normal(size=(800, 3)) + 1e3).astype(np.float32)
+    X = np.concatenate([good, poison])
+    w = np.concatenate([np.ones(800), np.zeros(800)])
+    mb = MiniBatchKMeans(k=3, seed=0, init="forgy", batch_size=256,
+                         max_iter=40, verbose=False, mesh=mesh8,
+                         sampling="host", n_init=3)
+    mb.fit(X, sample_weight=w)
+    # No centroid (seeded, reassigned, or updated) may sit in the
+    # zero-weight poison region.
+    assert np.all(np.abs(mb.centroids) < 100)
+    with pytest.raises(ValueError, match="pass sample_weight when"):
+        mb.fit(mb.cache(X), sample_weight=w)
