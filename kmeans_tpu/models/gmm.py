@@ -514,7 +514,19 @@ class GaussianMixture:
         pi = np.maximum(R / max(w_total, 1e-300), 1e-300)
         return w_total, (pi / pi.sum(), mu, var)
 
-    def fit(self, X, sample_weight=None) -> "GaussianMixture":
+    def fit(self, X, sample_weight=None, *,
+            resume: bool = False) -> "GaussianMixture":
+        """Fit by EM.  ``resume=True`` continues EM from the CURRENT
+        fitted parameters for up to ``max_iter`` further iterations
+        (sklearn's ``warm_start`` capability; composes with
+        ``save``/``load`` like ``KMeans.fit(resume=True)``) — single
+        restart only, since the restart sweep re-initializes.  Resumed
+        trajectories match the uninterrupted fit to fp rounding at
+        exact-dot precision (CPU, or TPU with
+        ``jax_default_matmul_precision='highest'``); under default
+        bf16-rate TPU dots borderline responsibilities can diverge the
+        two trajectories percent-level on overlapping clusters — the
+        same documented class as the streamed-vs-in-memory comparison."""
         ds = self._dataset(X, sample_weight)
         mesh = self._resolve_mesh()
         step_fn, _ = _get_fns(mesh, ds.chunk, self.covariance_type)
@@ -533,6 +545,12 @@ class GaussianMixture:
                 ts_fn(ds.points, ds.weights,
                       jnp.asarray(self.shift_.astype(self.dtype))),
                 np.float64)
+        if resume and self.means_ is not None:
+            if self.n_init != 1:
+                raise ValueError("fit(resume=True) requires n_init == 1 "
+                                 "(the restart sweep re-initializes)")
+            self._fit_one(ds, mesh, step_fn, self.seed, resume=True)
+            return self
         seeds = self._restart_seeds()
         self.best_restart_ = 0
         self.restart_lower_bounds_ = None
@@ -849,21 +867,36 @@ class GaussianMixture:
                                       if len(states) > 1 else None)
         return self
 
-    def _fit_one(self, ds, mesh, step_fn, seed: int) -> None:
-        w_total = self._init_params(ds, step_fn, seed)
-        if w_total <= 0:
-            raise ValueError("total sample weight must be positive")
+    def _fit_one(self, ds, mesh, step_fn, seed: int,
+                 resume: bool = False) -> None:
+        if not resume:
+            # Continue-from-current (resume) skips the re-init; the
+            # iteration counter carries over on both loops, and the
+            # host loop's convergence baseline carries over too (the
+            # device kernel starts its in-dispatch tol history fresh —
+            # at worst one extra iteration, like KMeans' device resume).
+            w_total = self._init_params(ds, step_fn, seed)
+            if w_total <= 0:
+                raise ValueError("total sample weight must be positive")
         if not self.host_loop:
-            return self._fit_on_device(ds, mesh)
+            return self._fit_on_device(
+                ds, mesh, base_iter=self.n_iter_ if resume else 0)
 
         self.converged_ = False
-        prev = -np.inf
+        base = self.n_iter_ if resume else 0
+        prev = self.lower_bound_ if resume else -np.inf
         shift = self._shift()
-        for it in range(1, self.max_iter + 1):
+        for it in range(base + 1, base + self.max_iter + 1):
             t0 = time.perf_counter()
             st: EStats = step_fn(ds.points, ds.weights,
                                  *self._params_dev(mesh))
-            _, (pi, mu_c, var) = self._m_step(self._trim(st))
+            # The per-iteration float64 M-step total (sum of resp sums
+            # == total sample weight) normalizes the lower bound — the
+            # same reduction class on fresh AND resumed fits (an f32
+            # device-side sum diverged from it at large n, review r4).
+            w_total, (pi, mu_c, var) = self._m_step(self._trim(st))
+            if w_total <= 0:
+                raise ValueError("total sample weight must be positive")
             self.weights_, self.means_ = pi, mu_c + shift
             self.covariances_ = var
             self.lower_bound_ = float(st.loglik) / w_total
@@ -881,14 +914,16 @@ class GaussianMixture:
                 break
             prev = self.lower_bound_
 
-    def _fit_on_device(self, ds, mesh) -> None:
+    def _fit_on_device(self, ds, mesh, base_iter: int = 0) -> None:
         """All EM iterations in ONE dispatch (``host_loop=False``) — the
         mixture analogue of ``KMeans._fit_on_device``.  All four
         covariance types: diag/spherical via ``make_gmm_fit_fn``,
         full/tied via their own loops (batched on-device Cholesky per
         iteration; a component collapsing to non-PD surfaces as the
         loud non-finite-loglik error — the float64 host loop gives the
-        pointed ill-defined-covariance message instead)."""
+        pointed ill-defined-covariance message instead).  ``base_iter``
+        offsets ``n_iter_`` for resumed fits (the loop itself always
+        starts from the CURRENT parameter tables)."""
         ct = self.covariance_type
         builder = {"diag": make_gmm_fit_fn, "spherical": make_gmm_fit_fn,
                    "tied": make_gmm_fit_tied_fn,
@@ -946,7 +981,7 @@ class GaussianMixture:
         w = np.exp(np.asarray(log_w_out, np.float64)[:k])
         self.weights_ = w / w.sum()
         self.converged_ = bool(conv)
-        self.n_iter_ = n
+        self.n_iter_ = base_iter + n
         self.lower_bound_ = float(hist[-1]) if n else -np.inf
         if self.verbose:
             print(f"EM device loop: {n} iterations, mean log-likelihood = "

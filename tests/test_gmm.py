@@ -449,3 +449,48 @@ def test_reg_covar_zero_full_collapse_fails_loudly(mesh8):
                              seed=0, mesh=mesh8, host_loop=host_loop)
         with pytest.raises(ValueError, match="non-finite log-likelihood"):
             gm.fit(X)
+
+
+def test_fit_resume_continues_em(mesh8, tmp_path):
+    """r4: fit(resume=True) continues EM from the current parameters
+    (sklearn's warm_start capability) and composes with save/load."""
+    X, _ = make_blobs(800, centers=3, n_features=4, random_state=5,
+                      dtype=np.float32)
+    init = X[:3].astype(np.float64)
+    kw = dict(n_components=3, means_init=init, tol=0.0, seed=0,
+              mesh=mesh8)
+    full = GaussianMixture(max_iter=12, **kw).fit(X)
+    part = GaussianMixture(max_iter=5, **kw).fit(X)
+    assert part.n_iter_ == 5
+    part.max_iter = 7
+    part.fit(X, resume=True)
+    assert part.n_iter_ == 12
+    np.testing.assert_allclose(part.means_, full.means_, rtol=1e-6)
+    np.testing.assert_allclose(part.lower_bound_, full.lower_bound_,
+                               rtol=1e-7)
+    # resume through a checkpoint round-trip
+    p = tmp_path / "gm.npz"
+    half = GaussianMixture(max_iter=5, **kw).fit(X)
+    half.save(p)
+    back = GaussianMixture.load(p)
+    back.max_iter = 7
+    back.mesh = mesh8
+    back.fit(X, resume=True)
+    np.testing.assert_allclose(back.means_, full.means_, rtol=1e-6)
+    with pytest.raises(ValueError, match="n_init == 1"):
+        GaussianMixture(n_components=3, n_init=2, means_init=None,
+                        seed=0).fit(X).fit(X, resume=True)
+
+
+def test_fit_resume_device_loop(mesh8):
+    X, _ = make_blobs(800, centers=3, n_features=4, random_state=5,
+                      dtype=np.float32)
+    init = X[:3].astype(np.float64)
+    kw = dict(n_components=3, means_init=init, tol=0.0, seed=0,
+              mesh=mesh8, host_loop=False, dtype=np.float64)
+    full = GaussianMixture(max_iter=12, **kw).fit(X)
+    part = GaussianMixture(max_iter=5, **kw).fit(X)
+    part.max_iter = 7
+    part.fit(X, resume=True)
+    assert part.n_iter_ == 12
+    np.testing.assert_allclose(part.means_, full.means_, rtol=1e-8)
