@@ -65,6 +65,7 @@ from kmeans_tpu.parallel.gmm_step import (EStats, EStatsFull,
                                           make_gmm_fit_fn,
                                           make_gmm_fit_full_fn,
                                           make_gmm_fit_tied_fn,
+                                          make_gmm_multi_fit_fn,
                                           make_gmm_predict_fn,
                                           make_gmm_predict_full_fn,
                                           make_gmm_predict_tied_fn,
@@ -555,6 +556,13 @@ class GaussianMixture:
         self.best_restart_ = 0
         self.restart_lower_bounds_ = None
 
+        # Batched restart sweep: ALL n_init restarts vmapped through ONE
+        # EM dispatch (the mixture analogue of KMeans' make_multi_fit_fn;
+        # diag/spherical — the batchable density).
+        if len(seeds) > 1 and not self.host_loop \
+                and self.covariance_type in ("diag", "spherical"):
+            return self._fit_on_device_multi(ds, mesh, step_fn, seeds)
+
         best = None
         lls = []
         last_err = None
@@ -913,6 +921,80 @@ class GaussianMixture:
                 self.converged_ = True
                 break
             prev = self.lower_bound_
+
+    def _fit_on_device_multi(self, ds, mesh, step_fn,
+                             seeds) -> "GaussianMixture":
+        """All ``n_init`` restarts in ONE dispatch (diag/spherical): each
+        restart's hard-assignment init runs host-side (R cheap passes),
+        then the (R, k_pad, ...) parameter tables ride one vmapped
+        device EM loop; the winner — highest final lower bound, the
+        host-sequential selection rule — comes back selected on device."""
+        ct = self.covariance_type
+        R = len(seeds)
+        k, k_pad = self.n_components, self._k_pad
+        d = ds.d
+        means0 = np.zeros((R, k_pad, d), self.dtype)
+        var0 = np.ones((R, k_pad, d), self.dtype)
+        log_w0 = np.full((R, k_pad), -np.inf, self.dtype)
+        shift = self._shift()
+        for r, seed in enumerate(seeds):
+            w_total = self._init_params(ds, step_fn, seed)
+            if w_total <= 0:
+                raise ValueError("total sample weight must be positive")
+            means0[r, :k] = (self.means_ - shift).astype(self.dtype)
+            var0[r, :k] = np.maximum(
+                self._diag_view(),
+                max(self.reg_covar,
+                    float(np.finfo(self.dtype).tiny))).astype(self.dtype)
+            log_w0[r, :k] = np.log(
+                np.maximum(self.weights_, 1e-300)).astype(self.dtype)
+        key = (mesh, ds.chunk, k, self.max_iter, float(self.tol),
+               float(self.reg_covar), ct, R, "gmmmultifit")
+        fit_fn = _STEP_CACHE.get_or_create(
+            key, lambda: make_gmm_multi_fit_fn(
+                mesh, chunk_size=ds.chunk, k_real=k,
+                max_iter=self.max_iter, tol=float(self.tol),
+                reg_covar=float(self.reg_covar), cov_type=ct))
+        means_out, var_out, log_w_out, n_it, hist, conv, best, lls = \
+            fit_fn(ds.points, ds.weights,
+                   jnp.asarray(shift.astype(self.dtype)),
+                   jnp.asarray(means0), jnp.asarray(var0),
+                   jnp.asarray(log_w0))
+        lls = np.asarray(lls, np.float64)
+        # Diverged restarts surface as -inf and cannot win (the
+        # sequential path's failed-restart resilience, r3 ADVICE);
+        # raising is reserved for EVERY restart diverging.
+        if not np.any(np.isfinite(lls)):
+            raise ValueError(
+                "non-finite log-likelihood in every batched restart")
+        n_failed = int(np.sum(~np.isfinite(lls)))
+        if n_failed:
+            import warnings
+            warnings.warn(f"{n_failed} of {R} batched GMM restarts "
+                          f"diverged (non-finite log-likelihood); "
+                          f"continuing with the survivors", UserWarning,
+                          stacklevel=2)
+        n = int(n_it)
+        hist = np.asarray(hist, np.float64)[:n]
+        if n and not np.all(np.isfinite(hist)):
+            raise ValueError(
+                f"non-finite log-likelihood at EM iteration {n}")
+        self.means_ = np.asarray(means_out, np.float64)[:k] + shift
+        cv_out = np.asarray(var_out, np.float64)
+        self.covariances_ = (cv_out[:k, 0] if ct == "spherical"
+                             else cv_out[:k])
+        w = np.exp(np.asarray(log_w_out, np.float64)[:k])
+        self.weights_ = w / w.sum()
+        self.converged_ = bool(conv)
+        self.n_iter_ = n
+        self.lower_bound_ = float(hist[-1]) if n else -np.inf
+        self.best_restart_ = int(best)
+        self.restart_lower_bounds_ = np.asarray(lls, np.float64)
+        if self.verbose:
+            print(f"EM batched restarts: best {self.best_restart_ + 1} of "
+                  f"{R}, mean log-likelihood = {self.lower_bound_:.6f}",
+                  flush=True)
+        return self
 
     def _fit_on_device(self, ds, mesh, base_iter: int = 0) -> None:
         """All EM iterations in ONE dispatch (``host_loop=False``) — the

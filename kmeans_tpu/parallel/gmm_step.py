@@ -520,6 +520,103 @@ def _predict_from_logp(logp_fn, points, chunk_size, k_local, d,
             lse.reshape(-1))
 
 
+def make_gmm_multi_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
+                          max_iter: int, tol: float, reg_covar: float,
+                          cov_type: str = "diag"):
+    """BATCHED on-device EM: ``n_init`` restarts in ONE dispatch, vmapped
+    over the restart axis — the mixture analogue of
+    ``distributed.make_multi_fit_fn`` (r4).  Works for the
+    diag/spherical density (the restart axis batches the two log-density
+    matmuls straight onto the MXU, raising utilization for small k).
+
+    Restarts converge independently (frozen once |ll - prev| < tol);
+    the winner is the restart with the HIGHEST final lower bound —
+    sklearn's (and the host-sequential path's) selection rule, read
+    from each restart's own last recorded lower bound, no extra pass.
+    A DIVERGED restart (NaN log-likelihood — e.g. a collapsed component
+    under reg_covar=0) surfaces as ``-inf`` in ``final_lls`` and can
+    never win — the batched sweep keeps the sequential path's
+    failed-restart resilience (r3 ADVICE); the caller raises only when
+    every restart diverged.
+
+    Returns ``fit(points, weights, shift, means0 (R, k_pad, D),
+    var0 (R, k_pad, D), log_w0 (R, k_pad)) -> (means_c, var, log_w,
+    n_iter, ll_hist[max_iter], converged, best, final_lls (R,))`` for
+    the winning restart, everything replicated."""
+    data_shards, model_shards = mesh_shape(mesh)
+
+    def fit(points, weights, shift, means0, var0, log_w0):
+        R, k_pad, d = means0.shape
+        k_local = k_pad // model_shards
+        acc = points.dtype
+        tiny = jnp.asarray(np.finfo(np.dtype(str(acc))).tiny, acc)
+        pi_floor = jnp.maximum(jnp.asarray(1e-300, acc), tiny)
+        real = jnp.arange(k_pad) < k_real
+        m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
+        w_total = lax.psum(jnp.sum(weights.astype(acc)), DATA_AXIS)
+
+        def estats_one(means_c, var, log_w):
+            return _diag_estats_block(
+                points, weights, shift, means_c, var, log_w,
+                m_idx=m_idx, k_local=k_local, k_pad=k_pad,
+                chunk_size=chunk_size, model_shards=model_shards,
+                reg_covar=reg_covar, tiny=tiny, acc=acc)
+
+        def body(state):
+            it, means_c, var, log_w, prev, hist, done, n_it, conv = state
+            st = jax.vmap(estats_one)(means_c, var, log_w)
+            mu, new_var, new_log_w = _diag_m_step(
+                st, w_total=w_total, reg_covar=reg_covar, tiny=tiny,
+                pi_floor=pi_floor, real=real, cov_type=cov_type, acc=acc)
+            ll = st.loglik / w_total                     # (R,)
+            # Frozen restarts keep their parameters and recorded state.
+            keep = done[:, None, None]
+            means_c = jnp.where(keep, means_c,
+                                jnp.where(real[None, :, None], mu,
+                                          means_c))
+            var = jnp.where(keep, var,
+                            jnp.where(real[None, :, None], new_var, var))
+            log_w = jnp.where(done[:, None], log_w, new_log_w)
+            hist = hist.at[:, it].set(jnp.where(done, 0.0, ll))
+            now_conv = jnp.abs(ll - prev) < tol
+            n_it = jnp.where(done, n_it, it + 1)
+            conv = jnp.where(done, conv, now_conv)
+            prev = jnp.where(done, prev, ll)
+            done = done | now_conv
+            return (it + 1, means_c, var, log_w, prev, hist, done, n_it,
+                    conv)
+
+        def cond(state):
+            it, *_, done, _, _ = state
+            return (it < max_iter) & ~jnp.all(done)
+
+        state = (jnp.int32(0), means0.astype(acc), var0.astype(acc),
+                 log_w0.astype(acc),
+                 jnp.full((R,), -jnp.inf, acc),
+                 jnp.zeros((R, max_iter), acc),
+                 jnp.zeros((R,), bool), jnp.zeros((R,), jnp.int32),
+                 jnp.zeros((R,), bool))
+        (_, means_c, var, log_w, prev, hist, done, n_it,
+         conv) = lax.while_loop(cond, body, state)
+        # prev holds each restart's LAST recorded lower bound; a
+        # diverged restart's NaN is masked to -inf so it cannot win
+        # (and NaN would otherwise poison argmax).
+        final_lls = jnp.where(jnp.isfinite(prev), prev, -jnp.inf)
+        best = jnp.argmax(final_lls)
+        return (means_c[best], var[best], log_w[best], n_it[best],
+                hist[best], conv[best], best, final_lls)
+
+    mapped = jax.shard_map(
+        fit, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None),
+                  P(None, None, None), P(None, None, None),
+                  P(None, None)),
+        out_specs=(P(None, None), P(None, None), P(None), P(), P(None),
+                   P(), P(), P(None)),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
 def make_gmm_fit_full_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
                          max_iter: int, tol: float, reg_covar: float):
     """FULL-covariance on-device EM loop: all iterations in ONE dispatch
@@ -751,6 +848,49 @@ def make_gmm_predict_tied_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
     return jax.jit(mapped)
 
 
+def _diag_estats_block(points, weights, shift, means_c, var, log_w, *,
+                       m_idx, k_local, k_pad, chunk_size, model_shards,
+                       reg_covar, tiny, acc):
+    """ONE restart's diag/spherical E statistics inside a device loop:
+    floor the covariance at max(reg_covar, tiny), slice this shard's
+    model block, run the chunked scan, psum-embed.  Shared by the
+    single-restart and the vmapped multi-restart loops so the
+    hard-won floor/precision rules exist exactly once."""
+    cv = jnp.maximum(var, jnp.maximum(jnp.asarray(reg_covar, acc), tiny))
+    inv_var = 1.0 / cv
+    log_det = jnp.sum(jnp.log(cv), axis=1)
+    off = jnp.asarray(m_idx * k_local, jnp.int32)
+    blk = lambda a: lax.dynamic_slice(
+        a, (off,) + (jnp.int32(0),) * (a.ndim - 1),
+        (k_local,) + a.shape[1:])
+    st = _scan_estats(points, weights, blk(means_c).astype(acc),
+                      blk(inv_var).astype(acc), blk(log_det).astype(acc),
+                      blk(log_w).astype(acc), shift,
+                      chunk_size=chunk_size, model_shards=model_shards)
+    return _embed_psum(st, k_pad, k_local, model_shards)
+
+
+def _diag_m_step(st, *, w_total, reg_covar, tiny, pi_floor, real,
+                 cov_type, acc):
+    """The diag/spherical device M-step, axis-agnostic (works on plain
+    (k_pad, ...) statistics and on restart-batched (R, k_pad, ...)
+    ones): mean, tiny-floored variance (spherical averages over D),
+    normalized mixing weights.  Returns (mu, new_var, new_log_w)."""
+    Rc = jnp.maximum(st.resp_sum, 10 * tiny)
+    mu = st.xsum / Rc[..., None]
+    new_var = jnp.maximum(
+        st.x2sum / Rc[..., None] - mu ** 2 + reg_covar,
+        jnp.maximum(jnp.asarray(reg_covar, acc), tiny))
+    if cov_type == "spherical":
+        new_var = jnp.broadcast_to(
+            jnp.mean(new_var, axis=-1, keepdims=True), new_var.shape)
+    pi = jnp.maximum(st.resp_sum / jnp.maximum(w_total, pi_floor),
+                     pi_floor)
+    pi = pi / jnp.sum(jnp.where(real, pi, 0.0), axis=-1, keepdims=True)
+    new_log_w = jnp.where(real, jnp.log(pi), -jnp.inf)
+    return mu, new_var, new_log_w
+
+
 def make_gmm_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
                     max_iter: int, tol: float, reg_covar: float,
                     cov_type: str = "diag"):
@@ -790,46 +930,24 @@ def make_gmm_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
             # validation): a collapsed component would otherwise give
             # inv_var=inf / log_det=-inf -> NaN loglik (r3 ADVICE; the
             # host paths floor at the same dtype-tiny in _params_dev).
-            cv = jnp.maximum(var, jnp.maximum(
-                jnp.asarray(reg_covar, acc), tiny))
-            inv_var = 1.0 / cv
-            log_det = jnp.sum(jnp.log(cv), axis=1)
-            off = jnp.asarray(m_idx * k_local, jnp.int32)
-            blk = lambda a: lax.dynamic_slice(
-                a, (off,) + (jnp.int32(0),) * (a.ndim - 1),
-                (k_local,) + a.shape[1:])
-            st = _scan_estats(points, weights, blk(means_c).astype(acc),
-                              blk(inv_var).astype(acc),
-                              blk(log_det).astype(acc),
-                              blk(log_w).astype(acc), shift,
-                              chunk_size=chunk_size,
-                              model_shards=model_shards)
-            return _embed_psum(st, k_pad, k_local, model_shards)
+            return _diag_estats_block(
+                points, weights, shift, means_c, var, log_w,
+                m_idx=m_idx, k_local=k_local, k_pad=k_pad,
+                chunk_size=chunk_size, model_shards=model_shards,
+                reg_covar=reg_covar, tiny=tiny, acc=acc)
 
         def body(state):
             it, means_c, var, log_w, prev, hist, _ = state
             st = estats(means_c, var, log_w)
-            Rc = jnp.maximum(st.resp_sum, 10 * tiny)
-            mu = st.xsum / Rc[:, None]
             # The CARRIED/returned variance is floored at tiny too — a
             # var of exactly 0 would make the fitted model's precisions_
             # inf and its score()/predict() NaN even though the in-loop
-            # E-step floors its own copy (review r4).
-            new_var = jnp.maximum(
-                st.x2sum / Rc[:, None] - mu ** 2 + reg_covar,
-                jnp.maximum(jnp.asarray(reg_covar, acc), tiny))
-            if cov_type == "spherical":
-                # One scalar variance per component: the mean of the
-                # per-dim variances (sklearn's spherical M-step),
-                # carried broadcast over D so the diag E-step is reused
-                # unchanged.
-                new_var = jnp.broadcast_to(
-                    jnp.mean(new_var, axis=1, keepdims=True),
-                    new_var.shape)
-            pi = jnp.maximum(st.resp_sum / jnp.maximum(w_total, pi_floor),
-                             pi_floor)
-            pi = pi / jnp.sum(jnp.where(real, pi, 0.0))
-            new_log_w = jnp.where(real, jnp.log(pi), -jnp.inf)
+            # E-step floors its own copy (review r4).  Spherical carries
+            # its scalar variance broadcast over D so the diag E-step is
+            # reused unchanged.
+            mu, new_var, new_log_w = _diag_m_step(
+                st, w_total=w_total, reg_covar=reg_covar, tiny=tiny,
+                pi_floor=pi_floor, real=real, cov_type=cov_type, acc=acc)
             ll = st.loglik / w_total
             hist = hist.at[it].set(ll)
             conv = jnp.abs(ll - prev) < tol

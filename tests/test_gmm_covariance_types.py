@@ -275,3 +275,56 @@ def test_gmm_predict_stream_matches_predict(ct, Xc, mesh8):
     lse = np.concatenate(list(gm.score_samples_stream(
         lambda: iter([b.copy() for b in blocks]))))
     np.testing.assert_allclose(lse, gm.score_samples(Xc), rtol=1e-5)
+
+
+@pytest.mark.parametrize("ct", ("diag", "spherical"))
+def test_batched_device_restarts_match_sequential(ct, Xc, mesh8):
+    """r4: host_loop=False + n_init>1 runs ALL restarts vmapped through
+    ONE EM dispatch (the mixture analogue of KMeans' batched restart
+    sweep); winner, per-restart lower bounds, and parameters match the
+    host-sequential path."""
+    kw = dict(n_components=3, covariance_type=ct, init_params="random",
+              max_iter=20, tol=1e-6, seed=0, n_init=3, mesh=mesh8,
+              dtype=np.float64)
+    a = GaussianMixture(host_loop=False, **kw).fit(Xc)
+    b = GaussianMixture(host_loop=True, **kw).fit(Xc)
+    # Per-restart lower bounds agree; winner selection can differ only
+    # on sub-1e-7 ties (all restarts reaching the same optimum), so the
+    # robust invariants are the bound values and the winning model's
+    # quality, not the tie-broken index.
+    np.testing.assert_allclose(a.restart_lower_bounds_,
+                               b.restart_lower_bounds_, rtol=1e-7)
+    np.testing.assert_allclose(a.lower_bound_, b.lower_bound_, rtol=1e-7)
+    np.testing.assert_allclose(a.score(Xc), b.score(Xc), rtol=1e-7)
+    assert abs(a.n_iter_ - b.n_iter_) <= 1    # borderline tol decision
+    assert a.restart_lower_bounds_.shape == (3,)
+
+
+def test_batched_device_restarts_under_model_sharding(Xc, mesh4x2):
+    kw = dict(n_components=3, init_params="random", max_iter=15,
+              tol=1e-6, seed=1, n_init=2, dtype=np.float64)
+    a = GaussianMixture(host_loop=False, mesh=mesh4x2,
+                        model_shards=2, **kw).fit(Xc)
+    b = GaussianMixture(host_loop=True, **kw).fit(Xc)
+    np.testing.assert_allclose(a.restart_lower_bounds_,
+                               b.restart_lower_bounds_, rtol=1e-6)
+    np.testing.assert_allclose(a.score(Xc), b.score(Xc), rtol=1e-6)
+
+
+def test_batched_device_restarts_survive_diverged_restart(mesh8):
+    """A diverged restart (collapsed component under reg_covar=0)
+    surfaces as -inf and cannot win — the batched sweep keeps the
+    sequential path's failed-restart resilience."""
+    rng = np.random.default_rng(2)
+    X = np.concatenate([np.full((400, 4), 5.0),
+                        rng.normal(size=(400, 4))]).astype(np.float32)
+    gm = GaussianMixture(n_components=2, reg_covar=0.0, max_iter=15,
+                         seed=0, init_params="random", n_init=4,
+                         host_loop=False, mesh=mesh8)
+    with pytest.warns(UserWarning, match="diverged"):
+        gm.fit(X)
+    lls = gm.restart_lower_bounds_
+    assert np.sum(np.isinf(lls)) >= 1 and np.sum(np.isfinite(lls)) >= 1
+    assert gm.lower_bound_ == lls[np.isfinite(lls)].max()
+    assert np.all(np.isfinite(gm.means_))
+    assert np.isfinite(gm.score(X))
