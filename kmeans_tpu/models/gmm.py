@@ -937,19 +937,46 @@ class GaussianMixture:
         var0 = np.ones((R, k_pad, d), self.dtype)
         log_w0 = np.full((R, k_pad), -np.inf, self.dtype)
         shift = self._shift()
+        # Per-restart init failures keep the survivors (the sequential
+        # path's r3-ADVICE resilience, covering host-side init errors as
+        # well as the kernel's in-loop divergence masking); only the
+        # SURVIVING rows ride the batched dispatch, and indices map back
+        # to the original restart numbering below.
+        alive = []
+        init_err = None
         for r, seed in enumerate(seeds):
-            w_total = self._init_params(ds, step_fn, seed)
-            if w_total <= 0:
-                raise ValueError("total sample weight must be positive")
-            means0[r, :k] = (self.means_ - shift).astype(self.dtype)
-            var0[r, :k] = np.maximum(
+            try:
+                w_total = self._init_params(ds, step_fn, seed)
+                if w_total <= 0:
+                    raise ValueError(
+                        "total sample weight must be positive")
+            except Exception as e:
+                if R == 1:
+                    raise
+                import warnings
+                warnings.warn(f"GMM restart {r + 1}/{R} failed at init "
+                              f"({e}); continuing with the remaining "
+                              f"restarts", UserWarning, stacklevel=2)
+                init_err = e
+                continue
+            i = len(alive)
+            alive.append(r)
+            means0[i, :k] = (self.means_ - shift).astype(self.dtype)
+            var0[i, :k] = np.maximum(
                 self._diag_view(),
                 max(self.reg_covar,
                     float(np.finfo(self.dtype).tiny))).astype(self.dtype)
-            log_w0[r, :k] = np.log(
+            log_w0[i, :k] = np.log(
                 np.maximum(self.weights_, 1e-300)).astype(self.dtype)
+        if not alive:
+            raise init_err
+        if len(alive) < R:
+            means0 = means0[: len(alive)]
+            var0 = var0[: len(alive)]
+            log_w0 = log_w0[: len(alive)]
+        R_live = len(alive)
         key = (mesh, ds.chunk, k, self.max_iter, float(self.tol),
-               float(self.reg_covar), ct, R, "gmmmultifit")
+               float(self.reg_covar), ct, R_live, "gmmmultifit")
         fit_fn = _STEP_CACHE.get_or_create(
             key, lambda: make_gmm_multi_fit_fn(
                 mesh, chunk_size=ds.chunk, k_real=k,
@@ -960,17 +987,22 @@ class GaussianMixture:
                    jnp.asarray(shift.astype(self.dtype)),
                    jnp.asarray(means0), jnp.asarray(var0),
                    jnp.asarray(log_w0))
-        lls = np.asarray(lls, np.float64)
+        # Map survivor-row results back to the ORIGINAL restart
+        # numbering (init-failed restarts hold -inf).
+        lls_live = np.asarray(lls, np.float64)
+        lls = np.full((R,), -np.inf)
+        lls[np.asarray(alive)] = lls_live
+        best = alive[int(best)]
         # Diverged restarts surface as -inf and cannot win (the
         # sequential path's failed-restart resilience, r3 ADVICE);
         # raising is reserved for EVERY restart diverging.
         if not np.any(np.isfinite(lls)):
             raise ValueError(
                 "non-finite log-likelihood in every batched restart")
-        n_failed = int(np.sum(~np.isfinite(lls)))
+        n_failed = int(np.sum(~np.isfinite(lls_live)))
         if n_failed:
             import warnings
-            warnings.warn(f"{n_failed} of {R} batched GMM restarts "
+            warnings.warn(f"{n_failed} of {R_live} batched GMM restarts "
                           f"diverged (non-finite log-likelihood); "
                           f"continuing with the survivors", UserWarning,
                           stacklevel=2)

@@ -152,6 +152,18 @@ class KMeans:
         self.seed = seed
         self.compute_sse = compute_sse
         self.init = init
+        if isinstance(n_init, str):
+            if n_init != "auto":
+                raise ValueError(f"n_init must be an int >= 1 or 'auto', "
+                                 f"got {n_init!r}")
+            # sklearn's n_init='auto': 1 for the D^2-seeded inits (each
+            # draw is already quality-controlled), 10 for plain random
+            # draws (forgy) — and for CALLABLE inits, which get 10
+            # distinct seeds like sklearn's; explicit arrays collapse
+            # to 1 in _restart_seeds.
+            n_init = (1 if isinstance(init, str)
+                      and init in ("k-means++", "kmeans++", "k-means||",
+                                   "kmeans||") else 10)
         if int(n_init) < 1:
             raise ValueError(f"n_init must be >= 1, got {n_init}")
         self.n_init = int(n_init)

@@ -328,3 +328,29 @@ def test_batched_device_restarts_survive_diverged_restart(mesh8):
     assert gm.lower_bound_ == lls[np.isfinite(lls)].max()
     assert np.all(np.isfinite(gm.means_))
     assert np.isfinite(gm.score(X))
+
+
+def test_batched_device_restarts_survive_init_failure(Xc, mesh8,
+                                                      monkeypatch):
+    """An init-time exception in one restart keeps the survivors (same
+    contract as the sequential path), with indices mapped back to the
+    original restart numbering."""
+    calls = {"n": 0}
+    orig = GaussianMixture._init_params
+
+    def flaky(self, ds, step_fn, seed):
+        calls["n"] += 1
+        if calls["n"] == 2:               # second restart's init blows up
+            raise ValueError("synthetic init failure")
+        return orig(self, ds, step_fn, seed)
+
+    monkeypatch.setattr(GaussianMixture, "_init_params", flaky)
+    gm = GaussianMixture(n_components=3, init_params="random", n_init=3,
+                         max_iter=15, tol=1e-6, seed=0, mesh=mesh8,
+                         host_loop=False)
+    with pytest.warns(UserWarning, match="failed at init"):
+        gm.fit(Xc)
+    assert gm.restart_lower_bounds_.shape == (3,)
+    assert gm.restart_lower_bounds_[1] == -np.inf
+    assert np.isfinite(gm.lower_bound_)
+    assert gm.best_restart_ in (0, 2)

@@ -206,3 +206,14 @@ def test_device_multi_model_sharding_uneven_k(mesh4x2):
                 empty_cluster="farthest").fit(X.astype(np.float32))
     assert km.centroids.shape == (5, 4)
     assert np.all(np.isfinite(km.centroids))
+
+
+def test_n_init_auto_follows_sklearn():
+    """r4: n_init='auto' — 1 for D^2-seeded inits, 10 for plain random
+    draws (sklearn's rule)."""
+    assert KMeans(k=3, n_init="auto", init="forgy").n_init == 10
+    assert KMeans(k=3, n_init="auto", init="k-means++").n_init == 1
+    assert KMeans(k=3, n_init="auto", init="kmeans||").n_init == 1
+    assert MiniBatchKMeans(k=3, n_init="auto", init="forgy").n_init == 10
+    with pytest.raises(ValueError, match="auto"):
+        KMeans(k=3, n_init="bogus")
