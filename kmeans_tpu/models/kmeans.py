@@ -157,13 +157,13 @@ class KMeans:
                 raise ValueError(f"n_init must be an int >= 1 or 'auto', "
                                  f"got {n_init!r}")
             # sklearn's n_init='auto': 1 for the D^2-seeded inits (each
-            # draw is already quality-controlled), 10 for plain random
-            # draws (forgy) — and for CALLABLE inits, which get 10
-            # distinct seeds like sklearn's; explicit arrays collapse
-            # to 1 in _restart_seeds.
+            # draw is already quality-controlled), ``_auto_n_init()`` for
+            # plain random draws (forgy) — and for CALLABLE inits, which
+            # get that many distinct seeds like sklearn's; explicit
+            # arrays collapse to 1 in _restart_seeds.
             n_init = (1 if isinstance(init, str)
                       and init in ("k-means++", "kmeans++", "k-means||",
-                                   "kmeans||") else 10)
+                                   "kmeans||") else self._auto_n_init())
         if int(n_init) < 1:
             raise ValueError(f"n_init must be >= 1, got {n_init}")
         self.n_init = int(n_init)
@@ -357,6 +357,16 @@ class KMeans:
             raise ValueError("pass sample_weight when caching the "
                              "dataset, not on a pre-built ShardedDataset")
         return self.cache(X, sample_weight=sample_weight)
+
+    def _auto_n_init(self) -> int:
+        """``n_init='auto'`` resolution for random/callable inits.
+
+        sklearn's rule: KMeans runs 10 full restarts; MiniBatchKMeans
+        overrides this with 3 (it only SCORES candidate inits on one
+        batch rather than running full restarts, so fewer draws suffice).
+        Called from ``__init__`` — overrides must not touch instance
+        state set after ``n_init``."""
+        return 10
 
     def _restart_seeds(self) -> list:
         """Per-restart init seeds.  Restart 0 is ``seed`` itself (n_init=1

@@ -85,6 +85,12 @@ class MiniBatchKMeans(KMeans):
         self.init_inertias_ = None
         self.best_init_ = 0
 
+    def _auto_n_init(self) -> int:
+        """sklearn resolves MiniBatchKMeans ``n_init='auto'`` to 3 (not
+        KMeans' 10): candidates are only SCORED on one pass, not trained,
+        so fewer random draws give the intended cost/quality trade."""
+        return 3
+
     def _reassign_every(self, batch_global: int) -> int:
         """Reassignment cadence: the first iteration count n with
         ``n * batch > 10 * k`` — sklearn's ``_random_reassign`` rule is

@@ -103,9 +103,9 @@ class SphericalKMeans(KMeans):
     def transform(self, X, *, block_rows=None) -> np.ndarray:
         """Chordal distances ``sqrt(2 - 2*cos)`` to each centroid, (n, k);
         cosine similarity is ``1 - d**2 / 2``.  Rows are L2-normalized by
-        the ``transform_stream`` wrapper the base implementation streams
-        through (normalizing here too would pay a second full-array
-        float64 pass, review r4)."""
+        the ``_iter_stream_blocks`` override the base implementation
+        streams through (normalizing here too would pay a second
+        full-array float64 pass, review r4)."""
         return super().transform(X, block_rows=block_rows)
 
     # ------------------------------------------------------------ streaming
@@ -131,9 +131,11 @@ class SphericalKMeans(KMeans):
         return super().fit_stream(self._normalized_blocks(make_blocks),
                                   d=d, resume=resume)
 
-    def predict_stream(self, make_blocks):
-        return super().predict_stream(self._normalized_blocks(make_blocks))
-
-    def transform_stream(self, make_blocks, *, block_rows=None):
-        return super().transform_stream(
-            self._normalized_blocks(make_blocks), block_rows=block_rows)
+    def _iter_stream_blocks(self, make_blocks, *, with_weights: bool):
+        """One choke point for every streaming inference/scoring surface
+        (predict/transform/score streams all route through here): wrapping
+        per public method instead let ``score_stream`` ship un-normalized
+        (advisor r4), and a future base-class stream method would repeat
+        that bug.  ``fit_stream`` has its own path and wraps separately."""
+        return super()._iter_stream_blocks(
+            self._normalized_blocks(make_blocks), with_weights=with_weights)

@@ -214,6 +214,9 @@ def test_n_init_auto_follows_sklearn():
     assert KMeans(k=3, n_init="auto", init="forgy").n_init == 10
     assert KMeans(k=3, n_init="auto", init="k-means++").n_init == 1
     assert KMeans(k=3, n_init="auto", init="kmeans||").n_init == 1
-    assert MiniBatchKMeans(k=3, n_init="auto", init="forgy").n_init == 10
+    # MiniBatchKMeans resolves 'auto' to 3 (sklearn: inits are only
+    # scored, not trained), via the _auto_n_init hook (advisor r4).
+    assert MiniBatchKMeans(k=3, n_init="auto", init="forgy").n_init == 3
+    assert MiniBatchKMeans(k=3, n_init="auto", init="k-means++").n_init == 1
     with pytest.raises(ValueError, match="auto"):
         KMeans(k=3, n_init="bogus")

@@ -452,6 +452,21 @@ def test_spherical_fit_stream_normalizes_blocks(mesh8):
     np.testing.assert_array_equal(lab, mem.predict(X))
 
 
+def test_spherical_score_stream_normalizes_blocks(mesh8):
+    """Advisor r4: score_stream was inherited WITHOUT the normalizing
+    wrapper, so raw-magnitude streams scored raw points against unit-norm
+    centroids.  score_stream on raw blocks must equal score(X)."""
+    from kmeans_tpu.models import SphericalKMeans
+    rng = np.random.default_rng(3)
+    X = (rng.normal(size=(600, 5))
+         * rng.uniform(0.1, 50.0, size=(600, 1))).astype(np.float32)
+    km = SphericalKMeans(k=4, seed=0, verbose=False, mesh=mesh8,
+                         empty_cluster="keep").fit(X)
+    s_mem = km.score(X)
+    s_stream = km.score_stream(_blocks_of(X, 200))
+    np.testing.assert_allclose(s_stream, s_mem, rtol=1e-5)
+
+
 def test_weighted_stream_matches_weighted_memory_fit(data, mesh8):
     """r4: (block, weights) stream items fold weights into every
     statistic exactly like fit's sample_weight."""
