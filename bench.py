@@ -14,12 +14,15 @@ kmeans_spark.py:575-579 — SURVEY.md §6 flags this); synchronization is via
 scalar transfer (block_until_ready is not a reliable barrier on tunneled
 PJRT platforms).
 
-``vs_baseline`` compares against an on-host re-enactment of the reference's
-per-point executor loop (``assign_partition``, kmeans_spark.py:147-159:
-np.linalg.norm per point + argmin), scaled by BASELINE.json's 8 Spark
-workers with PERFECT linear scaling assumed — a deliberately generous
-baseline (real Spark adds shuffle/serialization overhead on top, and its
-reduceByKey pass is not even counted here).
+``vs_baseline`` compares against the reference's per-point executor loop
+(``assign_partition``, kmeans_spark.py:147-159: np.linalg.norm per point +
+argmin), scaled by BASELINE.json's 8 Spark workers with PERFECT linear
+scaling assumed — a deliberately generous baseline (real Spark adds
+shuffle/serialization overhead on top, and its reduceByKey pass is not
+even counted here).  At the headline shape the divisor is PINNED to the
+median of the r1-r4 recorded probes (``BASELINE.json.published``) so the
+multiplier stops drifting with host load; a live probe is still run and
+logged as a drift check (other shapes use the live probe directly).
 
 Env overrides: BENCH_N, BENCH_D, BENCH_K, BENCH_ITERS, BENCH_MODE.
 """
@@ -54,6 +57,34 @@ def baseline_throughput(d: int, k: int, workers: int = 8,
     elapsed = time.perf_counter() - start
     per_point = elapsed / sample
     return workers * d / per_point
+
+
+def pinned_baseline(d: int, k: int):
+    """Pinned Spark-loop baseline from ``BASELINE.json.published`` (r5).
+
+    The live ``baseline_throughput`` probe drifts with host load (recorded
+    r1-r4 probes span 2.76e6-4.05e6, a 1.5x swing that moved the published
+    multiplier 8.2k<->12k between artifacts — r4 verdict #2), so the
+    published multiplier is measured against the pinned median of those
+    probes instead.  Only valid at the shape it was probed at; other
+    shapes fall back to the live probe.  Returns ``(value, "ok")`` or
+    ``(None, reason)`` — the reason string distinguishes a benign shape
+    mismatch from a lost/corrupt pin file, which at the headline shape
+    means the published multiplier silently reverts to drifting."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    try:
+        with open(path) as f:
+            pub = json.load(f)["published"]["spark_baseline"]
+        if (int(pub["probe_shape"]["d"]), int(pub["probe_shape"]["k"])) \
+                != (d, k):
+            return None, "shape_mismatch"
+        value = float(pub["pts_dims_per_s"])
+        if not value > 0:
+            return None, f"load_error: non-positive pin {value!r}"
+        return value, "ok"
+    except (OSError, KeyError, TypeError, ValueError) as e:
+        return None, f"load_error: {type(e).__name__}: {e}"
 
 
 def timed_fit(fit_fn, points, weights, cents, seeds) -> float:
@@ -163,8 +194,25 @@ def main() -> None:
     n_chips = max(1, len(jax.devices()))
     throughput = n * d / per_iter / n_chips
 
-    base = baseline_throughput(d, k)
-    log(f"bench: baseline (8 ideal Spark workers) {base:.3e} pts*dims/s")
+    base_live = baseline_throughput(d, k)
+    base, pin_status = pinned_baseline(d, k)
+    pinned = base is not None
+    if pinned:
+        drift = base_live / base - 1.0
+        log(f"bench: baseline (8 ideal Spark workers) PINNED {base:.3e} "
+            f"pts*dims/s (BASELINE.json.published; live probe "
+            f"{base_live:.3e}, {drift:+.0%} vs pin)")
+        if abs(drift) > 0.3:   # r4's incident measured +45%; fire below it
+            log("bench: WARNING: live baseline probe drifts >30% from the "
+                "pin — host-load artifact (the r4 8.2k<->12k failure mode) "
+                "or a genuinely different host; the published multiplier "
+                "stays pinned either way")
+    else:
+        base = base_live
+        # A lost pin at the headline shape is the r4-verdict drift bug
+        # reappearing — say WHY the pin was skipped, loudly.
+        log(f"bench: baseline (8 ideal Spark workers) {base:.3e} "
+            f"pts*dims/s (LIVE probe, un-pinned: {pin_status})")
 
     print(json.dumps({
         "metric": f"kmeans_iter_throughput_N{n}_D{d}_k{k}",
@@ -174,6 +222,11 @@ def main() -> None:
         "ms_per_iter": round(per_iter * 1e3, 3),
         "spread": round(spread, 3),
         "mode": mode,
+        # Divisor provenance: without these, a pinned 11,937x and a
+        # live-probe multiplier taken under host load are
+        # indistinguishable in the one-line artifact (review r5).
+        "baseline": round(base, 1),
+        "baseline_pinned": pinned,
     }))
 
 
