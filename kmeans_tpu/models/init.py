@@ -389,14 +389,13 @@ def _split_block(item, d: int, dtype):
     return block, w
 
 
-def streamed_forgy_init(make_blocks, k: int, seeds, d: int, dtype):
-    """ONE pass: per-seed cap-k Algorithm-R reservoirs — each result is a
-    uniform without-replacement k-row sample of the whole stream, the
-    exact capability of ``rdd.takeSample(False, k, seed)``
-    (kmeans_spark.py:72).  Weighted streams draw uniformly over the
-    POSITIVE-weight rows, the in-memory ``forgy_init`` rule.  Returns
-    (list of (k, d) arrays, n_total)."""
-    res = [_EpochReservoir(k, d, np.random.default_rng([s, 0xF0261]))
+def _reservoir_pass(make_blocks, cap: int, k: int, d: int, seeds,
+                    salt: int):
+    """Shared single-pass scaffold of the streamed samplers: one seeded
+    cap-row Algorithm-R reservoir per restart over the POSITIVE-weight
+    rows of the whole stream (the in-memory ``forgy_init`` weight rule).
+    Raises the standard n<k error.  Returns (reservoirs, n_rows)."""
+    res = [_EpochReservoir(cap, d, np.random.default_rng([s, salt]))
            for s in seeds]
     n = 0
     for item in make_blocks():
@@ -408,9 +407,51 @@ def streamed_forgy_init(make_blocks, k: int, seeds, d: int, dtype):
     if n < k:
         raise ValueError(
             f"Not enough data points ({n}) to initialize {k} clusters")
+    return res, n
+
+
+def streamed_forgy_init(make_blocks, k: int, seeds, d: int, dtype):
+    """ONE pass: per-seed cap-k Algorithm-R reservoirs — each result is a
+    uniform without-replacement k-row sample of the whole stream, the
+    exact capability of ``rdd.takeSample(False, k, seed)``
+    (kmeans_spark.py:72).  Weighted streams draw uniformly over the
+    POSITIVE-weight rows, the in-memory ``forgy_init`` rule.  Returns
+    (list of (k, d) arrays, n_total)."""
+    res, n = _reservoir_pass(make_blocks, k, k, d, seeds, 0xF0261)
     outs = []
     for r in res:
         c = r.rows[: r.filled].astype(dtype)
+        check_finite_array(c, "Data contains NaN or Inf values")
+        outs.append(c)
+    return outs, n
+
+
+def streamed_init_sample(make_blocks, k: int, seeds, d: int, dtype, *,
+                         cap: Optional[int] = None):
+    """ONE pass: per-seed uniform reservoir samples of the WHOLE stream
+    for CALLABLE inits (r4 VERDICT #8 — callables previously saw only
+    the first block, while every built-in streamed init draws over the
+    full stream like the reference's ``takeSample`` over the whole
+    distributed dataset, kmeans_spark.py:72).
+
+    Each result is a uniform without-replacement sample of up to ``cap``
+    positive-weight rows (Algorithm R), in randomly-permuted order —
+    enough for a D²-weighting or subsample-then-solve callable to be
+    meaningful, while bounding host memory (``cap`` defaults to
+    ``clamp(16*k, 2048, 32768)`` and is floored to ``k`` so the sample
+    can always seed k centroids).  Returns (list of (m, d) ``dtype``
+    arrays, n_total)."""
+    cap = int(cap if cap is not None else min(max(16 * k, 2048), 32768))
+    cap = max(cap, k)
+    res, n = _reservoir_pass(make_blocks, cap, k, d, seeds, 0xCA11AB1E)
+    outs = []
+    for r, s in zip(res, seeds):
+        # The reservoir's slot order is fill-order-biased (early rows sit
+        # in early slots); permute so positional callables (e.g.
+        # ``lambda X, k, seed: X[:k]``) still get a uniform draw.
+        rows = r.rows[: r.filled]
+        perm = np.random.default_rng([s, 0x5EED]).permutation(len(rows))
+        c = rows[perm].astype(dtype)
         check_finite_array(c, "Data contains NaN or Inf values")
         outs.append(c)
     return outs, n

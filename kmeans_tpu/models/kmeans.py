@@ -478,9 +478,12 @@ class KMeans:
         streamed kmeans|| (``models.init.streamed_kmeans_parallel_init``
         — exact streaming k-means++ would cost k passes, so the
         O(rounds)-pass scalable variant serves both names, as sklearn's
-        large-k paths do).  A callable init still receives only the
-        first block (documented — pass an explicit (k, D) array for
-        full control).
+        large-k paths do).  A CALLABLE init receives a seeded uniform
+        reservoir sample of the whole stream (up to ~32k
+        positive-weight rows, randomly permuted —
+        ``models.init.streamed_init_sample``), so custom inits get the
+        same full-stream contract as the built-ins; pass an explicit
+        (k, D) array for exact control.
 
         ``n_init > 1`` runs R restarts INTERLEAVED: every epoch computes
         all R restarts' statistics from one shared pass over the stream
@@ -513,7 +516,8 @@ class KMeans:
         """
         from kmeans_tpu.parallel.sharding import shard_points
         from kmeans_tpu.models.init import (STREAM_INITIALIZERS,
-                                            _split_block)
+                                            _split_block,
+                                            streamed_init_sample)
         log = IterationLogger(self.verbose and jax.process_index() == 0)
         muted = IterationLogger(False)
         log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
@@ -547,10 +551,16 @@ class KMeans:
                                    self.k, self.seed)
                 raw = [arr]
             elif callable(self.init):
-                first, _ = _split_block(next(iter(make_blocks())), d,
-                                        self.dtype)
-                raw = [np.asarray(self.init(first, self.k, s))
-                       for s in seeds]
+                # Full-stream contract for custom inits (r4 VERDICT #8):
+                # each restart's callable receives a seeded uniform
+                # reservoir sample of the WHOLE stream (positive-weight
+                # rows, randomly permuted) — the same takeSample
+                # capability the built-in streamed inits use — instead
+                # of just the first block.
+                samples, _ = streamed_init_sample(make_blocks, self.k,
+                                                  seeds, d, self.dtype)
+                raw = [np.asarray(self.init(sample, self.k, s))
+                       for sample, s in zip(samples, seeds)]
             else:
                 try:
                     stream_fn = STREAM_INITIALIZERS[self.init]
