@@ -15,7 +15,7 @@ compute_sse)`` with ``.fit`` / ``.predict`` / ``.centroids`` /
 kmeans++ init, checkpointing, profiling).
 """
 
-from kmeans_tpu.models.kmeans import KMeans
+from kmeans_tpu.models.kmeans import DispatchLatencyHint, KMeans
 from kmeans_tpu.models.minibatch import MiniBatchKMeans
 from kmeans_tpu.models.bisecting import BisectingKMeans
 from kmeans_tpu.models.spherical import SphericalKMeans
@@ -26,4 +26,5 @@ from kmeans_tpu.parallel.sharding import ShardedDataset
 __version__ = "0.1.0"
 
 __all__ = ["KMeans", "MiniBatchKMeans", "BisectingKMeans",
-           "SphericalKMeans", "GaussianMixture", "ShardedDataset", "make_mesh", "__version__"]
+           "SphericalKMeans", "GaussianMixture", "DispatchLatencyHint",
+           "ShardedDataset", "make_mesh", "__version__"]

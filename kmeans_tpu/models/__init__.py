@@ -6,7 +6,7 @@ initialization strategies (Forgy parity + kmeans++ superset) and a mini-batch
 variant.
 """
 
-from kmeans_tpu.models.kmeans import KMeans
+from kmeans_tpu.models.kmeans import DispatchLatencyHint, KMeans
 from kmeans_tpu.models.minibatch import MiniBatchKMeans
 from kmeans_tpu.models.bisecting import BisectingKMeans
 from kmeans_tpu.models.spherical import SphericalKMeans
@@ -14,4 +14,5 @@ from kmeans_tpu.models.gmm import GaussianMixture
 from kmeans_tpu.models.init import forgy_init, kmeanspp_init
 
 __all__ = ["KMeans", "MiniBatchKMeans", "BisectingKMeans",
-           "SphericalKMeans", "GaussianMixture", "forgy_init", "kmeanspp_init"]
+           "SphericalKMeans", "GaussianMixture", "DispatchLatencyHint",
+           "forgy_init", "kmeanspp_init"]

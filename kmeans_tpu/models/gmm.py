@@ -192,7 +192,14 @@ class GaussianMixture:
         self.mesh = mesh
         self.model_shards = model_shards
         self.chunk_size = chunk_size
-        self.host_loop = host_loop
+        if isinstance(host_loop, str):
+            # KMeans' host_loop='auto' is not implemented for the EM
+            # family — reject rather than silently treating the string
+            # as truthy-True (review r5).
+            raise ValueError("GaussianMixture host_loop must be True or "
+                             f"False ('auto' is KMeans-only), got "
+                             f"{host_loop!r}")
+        self.host_loop = bool(host_loop)
         self.verbose = verbose
 
         self.weights_: Optional[np.ndarray] = None

@@ -75,6 +75,47 @@ from kmeans_tpu.utils.cache import LRUCache
 _STEP_CACHE = LRUCache(64)
 
 
+class DispatchLatencyHint(UserWarning):
+    """One-time performance hint: per-iteration host dispatch dominates
+    the fit on this platform (r4 VERDICT #6 — a default-config user on a
+    high-latency link, e.g. a tunneled chip with ~70-100 ms RTT, would
+    otherwise spend most of their wall time on dispatch without any
+    signal)."""
+
+
+# One-time hint bookkeeping + measurement caches for host_loop='auto'.
+_HINTS_EMITTED: set = set()
+_RTT_CACHE: dict = {}          # device-id tuple -> measured RTT seconds
+_AUTO_CACHE = LRUCache(64)     # step key -> measured step seconds
+
+
+def _hint_once(kind: str, msg: str) -> None:
+    if kind not in _HINTS_EMITTED:
+        _HINTS_EMITTED.add(kind)
+        import warnings
+        warnings.warn(msg, DispatchLatencyHint, stacklevel=4)
+
+
+def _dispatch_rtt(mesh: Mesh) -> float:
+    """Measured host->device->host round trip of a trivial jitted op on
+    this mesh's first device (min of 3; cached per device set).  This is
+    the per-iteration latency floor a host loop pays that a device-side
+    ``lax.while_loop`` does not."""
+    key = tuple(d.id for d in mesh.devices.flat)
+    if key not in _RTT_CACHE:
+        dev = list(mesh.devices.flat)[0]
+        fn = jax.jit(lambda x: x + 1.0)
+        x = jax.device_put(np.float32(0), dev)
+        float(fn(x))                               # compile + warm
+        reps = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(fn(x))                           # scalar transfer = barrier
+            reps.append(time.perf_counter() - t0)
+        _RTT_CACHE[key] = min(reps)
+    return _RTT_CACHE[key]
+
+
 def _get_step_fns(mesh: Mesh, chunk_size: int, mode: str):
     return _STEP_CACHE.get_or_create(
         (mesh, chunk_size, mode),
@@ -129,6 +170,16 @@ class KMeans:
         waste, see ops.pallas_kernels.pallas_preferred — else the XLA
         'matmul' path) | 'matmul' (MXU form) | 'matmul_bf16' | 'pallas' |
         'pallas_bf16' | 'direct' (exact; small problems).
+    host_loop : True (reference per-iteration driver semantics: host-side
+        f64 division, per-iteration logging, host empty-cluster policy) |
+        False (the WHOLE fit as one device-side ``lax.while_loop``
+        dispatch — no per-iteration host round trips) | 'auto' (default:
+        host-loop behavior, but on platforms where one measured dispatch
+        RTT exceeds 5 ms and 25% of a step it switches to the device
+        loop when semantically interchangeable — verbose=False,
+        base-class hooks, single process, and not 'resample' on a
+        host-resident dataset — and otherwise emits a one-time
+        :class:`DispatchLatencyHint`; see ``_resolve_host_loop``).
     verbose : reference-style per-iteration prints (kmeans_spark.py:296-304).
     """
 
@@ -144,7 +195,7 @@ class KMeans:
                  model_shards: int = 1,
                  chunk_size: Optional[int] = None,
                  distance_mode: str = "auto",
-                 host_loop: bool = True,
+                 host_loop: Union[bool, str] = "auto",
                  verbose: bool = True):
         self.k = k
         self.max_iter = max_iter
@@ -196,6 +247,15 @@ class KMeans:
         self.model_shards = model_shards
         self.chunk_size = chunk_size
         self.distance_mode = distance_mode
+        if isinstance(host_loop, str):
+            if host_loop != "auto":
+                raise ValueError(f"host_loop must be True, False, or "
+                                 f"'auto', got {host_loop!r}")
+        else:
+            # Normalize bool-likes (1/0/np.bool_) so the identity checks
+            # in _resolve_host_loop can't silently route an explicit
+            # choice to 'auto' (review r5).
+            host_loop = bool(host_loop)
         self.host_loop = host_loop
         self.verbose = verbose
 
@@ -394,6 +454,114 @@ class KMeans:
             np.asarray(self.centroids), mesh, model_shards))
         return float(stats.sse)
 
+    def _resolve_host_loop(self, ds, mesh, model_shards, step_fn) -> bool:
+        """Resolve ``host_loop='auto'`` for this fit (r4 VERDICT #6).
+
+        Explicit True/False pass through untouched (zero overhead).
+        'auto' behaves like the host loop — the reference's per-iteration
+        driver semantics — unless ONE measurement at fit start shows
+        dispatch latency dominating: RTT > 5 ms absolute AND > 25% of a
+        measured step (on a tunneled chip the RTT is ~70-100 ms,
+        docs/PERFORMANCE.md).  Then, when the device loop is
+        semantically interchangeable for this estimator — base-class
+        Lloyd hooks (SphericalKMeans projects host-side), verbose=False
+        (per-iteration prints are host-loop-only), single process (the
+        decision must not diverge across SPMD processes) — the fit
+        switches to the one-dispatch device loop, whose trajectory
+        parity with the host loop is pinned to 1e-9
+        (tests/test_device_loop.py); otherwise it stays host-side and a
+        one-time :class:`DispatchLatencyHint` says where the wall time
+        goes.  The 5 ms absolute floor keeps low-latency platforms
+        (local CPU/TPU, µs dispatch) deterministically on the host path.
+
+        POLICY TWIN: ``MiniBatchKMeans._resolve_host_loop_mb`` applies
+        the same explicit-pass-through / process-count / RTT-floor /
+        hook-guard policy to the mini-batch engine (no step measurement
+        — its batch step is sub-ms by construction).  A change to the
+        policy here almost certainly belongs there too.
+        """
+        if self.host_loop is True or self.host_loop is False:
+            return self.host_loop
+        if jax.process_count() > 1:
+            return True
+        # RTT first: on fast platforms (µs dispatch) the 5 ms floor
+        # decides alone, and no step is ever timed — a default-config fit
+        # there pays only one cached trivial-op round trip (review r5).
+        rtt = _dispatch_rtt(mesh)
+        if rtt <= 5e-3:
+            return True
+        key = (mesh, ds.chunk, self._mode(ds.n, ds.d), self.k,
+               np.dtype(self.dtype).str, tuple(ds.points.shape), "autoloop")
+
+        def measure_step():
+            cents = self._put_centroids(
+                np.zeros((self.k, ds.d), self.dtype), mesh, model_shards)
+            stats = step_fn(ds.points, ds.weights, cents)
+            float(stats.sse)                        # compile + warm
+            t0 = time.perf_counter()
+            float(step_fn(ds.points, ds.weights, cents).sse)
+            return time.perf_counter() - t0
+
+        # The wasted-work accounting of this measurement: step_fn is the
+        # program the HOST loop runs, so on the stay-host outcomes the
+        # compile+2 dispatches are pure warmup; only a switch discards
+        # them (once per shape key) — accepted, the 25% rule needs a
+        # measured denominator.
+        step_total = _AUTO_CACHE.get_or_create(key, measure_step)
+        frac = rtt / max(step_total, 1e-12)
+        if frac <= 0.25:
+            return True
+        base_hooks = (
+            type(self)._postprocess_centroids is KMeans._postprocess_centroids
+            and type(self)._handle_empty is KMeans._handle_empty
+            and type(self)._finish_lloyd_iteration
+            is KMeans._finish_lloyd_iteration)
+        # 'resample' with a host-resident dataset draws replacements with
+        # the HOST rng (bit-identical to r1); the device loop draws with
+        # the on-device Gumbel engine.  Both are uniform, but switching
+        # would make results platform-dependent — only hostless datasets
+        # (where BOTH loops use the Gumbel engine, parity pinned by
+        # tests/test_device_loop.py) may switch under 'resample'.
+        resample_safe = (self.empty_cluster != "resample"
+                         or getattr(ds, "host", None) is None)
+        if base_hooks and resample_safe and not self.verbose:
+            _hint_once(
+                "auto_switched",
+                f"host_loop='auto': dispatch RTT {rtt*1e3:.0f} ms is "
+                f"{frac:.0%} of a measured step on this platform — running "
+                f"the whole fit as one device dispatch (host_loop=False "
+                f"semantics; pass host_loop=True to force the per-iteration "
+                f"host loop)")
+            return False
+        if not base_hooks:
+            _hint_once(
+                "auto_hint_hooks",
+                f"host_loop='auto': dispatch RTT {rtt*1e3:.0f} ms is "
+                f"{frac:.0%} of a measured step on this platform, but "
+                f"{type(self).__name__}'s host-side hooks require the "
+                f"per-iteration host loop — that latency is unavoidable "
+                f"for this estimator here")
+        elif not resample_safe:
+            _hint_once(
+                "auto_hint_resample",
+                f"host_loop='auto': dispatch RTT {rtt*1e3:.0f} ms is "
+                f"{frac:.0%} of a measured step on this platform, but "
+                f"empty_cluster='resample' on a host-resident dataset "
+                f"draws replacements host-side, so 'auto' stays on the "
+                f"host loop; empty_cluster='keep'/'farthest' lets it "
+                f"switch, and explicit host_loop=False switches too but "
+                f"moves the resample draw to the on-device engine "
+                f"(documented divergence)")
+        else:
+            _hint_once(
+                "auto_hint",
+                f"host_loop='auto': dispatch RTT {rtt*1e3:.0f} ms is "
+                f"{frac:.0%} of a measured step on this platform, so most "
+                f"of each iteration's wall time is host dispatch; set "
+                f"host_loop=False (one-dispatch fit) or verbose=False "
+                f"(lets 'auto' switch itself) to reclaim it")
+        return True
+
     def _fit(self, X, *, sample_weight, resume) -> "KMeans":
         # Multi-host: only process 0 narrates (every host computes the same
         # replicated statistics, so logs would be identical k-fold spam).
@@ -415,7 +583,8 @@ class KMeans:
 
         # Batched restarts: one dispatch for the whole n_init sweep
         # (composes with model-axis centroid sharding, r1 VERDICT #3).
-        if len(seeds) > 1 and not self.host_loop:
+        if len(seeds) > 1 and \
+                not self._resolve_host_loop(ds, mesh, model_shards, step_fn):
             return self._fit_on_device_multi(ds, seeds, mesh, log)
 
         best = None
@@ -736,8 +905,9 @@ class KMeans:
     def _run_restart(self, ds, mesh, model_shards, step_fn, centroids,
                      start_iter, seed, log) -> "KMeans":
         """One restart: the reference's full fit loop (kmeans_spark.py:
-        239-319), host- or device-side per ``host_loop``."""
-        if not self.host_loop:
+        239-319), host- or device-side per ``host_loop`` (with 'auto'
+        resolved against this platform's measured dispatch latency)."""
+        if not self._resolve_host_loop(ds, mesh, model_shards, step_fn):
             return self._fit_on_device(ds, centroids, start_iter, mesh,
                                        model_shards, log, seed)
 

@@ -57,11 +57,22 @@ class SphericalKMeans(KMeans):
     def __init__(self, k: int = 3, max_iter: int = 100,
                  tolerance: float = 1e-4, seed: int = 42,
                  compute_sse: bool = False, **kwargs):
-        if not kwargs.pop("host_loop", True):
+        hl = kwargs.pop("host_loop", True)
+        if isinstance(hl, str):
+            if hl != "auto":            # same contract as the base class
+                raise ValueError(f"host_loop must be True, False, or "
+                                 f"'auto', got {hl!r}")
+        elif not bool(hl):
             raise ValueError("SphericalKMeans requires host_loop=True (the "
                              "sphere projection runs in the host loop)")
+        # Pin host_loop=True explicitly (not the inherited 'auto'): the
+        # sphere projection forces the host loop regardless, so the auto
+        # RTT probe and its "host-side hooks" hint would be pure noise
+        # here (review r5: pop-and-discard silently replaced an explicit
+        # True with 'auto' once the base default changed).
         super().__init__(k=k, max_iter=max_iter, tolerance=tolerance,
-                         seed=seed, compute_sse=compute_sse, **kwargs)
+                         seed=seed, compute_sse=compute_sse,
+                         host_loop=True, **kwargs)
 
     def cache(self, X, sample_weight=None):
         """Upload L2-normalized rows (zero rows stay at the origin)."""

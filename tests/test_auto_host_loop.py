@@ -1,0 +1,232 @@
+"""``host_loop='auto'`` resolution (r4 VERDICT #6): on high-dispatch-
+latency platforms the default config must either switch to the
+one-dispatch device loop (when semantically interchangeable) or say,
+once, where the wall time goes — and must stay deterministically on the
+host path on fast platforms.
+
+Latency is SIMULATED by patching ``_dispatch_rtt`` (the tunneled-TPU
+RTT is ~70-100 ms; CPU dispatch is µs, under the 5 ms absolute floor).
+"""
+
+import numpy as np
+import pytest
+
+import kmeans_tpu.models.kmeans as km_mod
+from kmeans_tpu import KMeans
+from kmeans_tpu.models import DispatchLatencyHint, SphericalKMeans
+
+
+@pytest.fixture(autouse=True)
+def _fresh_auto_state():
+    """Per-test isolation of the once-per-process hint set and the
+    (rtt, step) measurement cache — patched RTTs must not leak."""
+    km_mod._HINTS_EMITTED.clear()
+    km_mod._AUTO_CACHE.clear()
+    yield
+    km_mod._HINTS_EMITTED.clear()
+    km_mod._AUTO_CACHE.clear()
+
+
+@pytest.fixture
+def data():
+    rng = np.random.default_rng(0)
+    return (rng.normal(size=(600, 6))
+            + 8.0 * rng.integers(0, 4, size=(600, 1))).astype(np.float32)
+
+
+def _spy_device_paths(monkeypatch):
+    calls = []
+    orig_single = KMeans._fit_on_device
+    orig_multi = KMeans._fit_on_device_multi
+
+    def spy_single(self, *a, **kw):
+        calls.append("device")
+        return orig_single(self, *a, **kw)
+
+    def spy_multi(self, *a, **kw):
+        calls.append("device_multi")
+        return orig_multi(self, *a, **kw)
+
+    monkeypatch.setattr(KMeans, "_fit_on_device", spy_single)
+    monkeypatch.setattr(KMeans, "_fit_on_device_multi", spy_multi)
+    return calls
+
+
+def test_auto_stays_host_on_fast_platform(data, mesh8, monkeypatch):
+    """µs-level dispatch (any local platform) stays under the 5 ms
+    absolute floor: 'auto' is deterministically the host loop, no hint."""
+    calls = _spy_device_paths(monkeypatch)
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", DispatchLatencyHint)
+        km = KMeans(k=4, seed=0, mesh=mesh8, verbose=False,
+                    empty_cluster="keep").fit(data)
+    assert km.host_loop == "auto"          # the constructor default
+    assert calls == []
+    assert km.centroids.shape == (4, 6)
+
+
+def test_auto_switches_to_device_loop_on_high_latency(data, mesh8,
+                                                      monkeypatch):
+    """Simulated 1 s RTT (>5 ms and >25% of any CPU step) + verbose=False
+    + base hooks -> the fit runs as ONE device dispatch, says so once,
+    and matches the host loop's trajectory."""
+    monkeypatch.setattr(km_mod, "_dispatch_rtt", lambda mesh: 1.0)
+    calls = _spy_device_paths(monkeypatch)
+    kw = dict(k=4, seed=0, mesh=mesh8, verbose=False, compute_sse=True,
+              dtype=np.float64, empty_cluster="keep")
+    with pytest.warns(DispatchLatencyHint, match="one device dispatch"):
+        auto = KMeans(host_loop="auto", **kw).fit(data)
+    assert calls == ["device"]
+    host = KMeans(host_loop=True, **kw).fit(data)
+    np.testing.assert_allclose(auto.centroids, host.centroids, atol=1e-9)
+    np.testing.assert_allclose(auto.sse_history, host.sse_history,
+                               rtol=1e-9)
+
+    # The hint is once-per-process: a second fit is silent.
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", DispatchLatencyHint)
+        KMeans(host_loop="auto", **kw).fit(data)
+
+
+def test_auto_batched_restart_sweep_on_high_latency(data, mesh8,
+                                                    monkeypatch):
+    """n_init > 1 under the switch takes the BATCHED one-dispatch sweep."""
+    monkeypatch.setattr(km_mod, "_dispatch_rtt", lambda mesh: 1.0)
+    calls = _spy_device_paths(monkeypatch)
+    km = KMeans(k=4, n_init=3, seed=0, mesh=mesh8, verbose=False,
+                empty_cluster="keep").fit(data)
+    assert calls == ["device_multi"]
+    assert km.restart_inertias_.shape == (3,)
+
+
+def test_auto_hints_but_stays_host_when_verbose(data, mesh8, monkeypatch,
+                                                capsys):
+    """verbose=True keeps the reference's per-iteration logging: no
+    switch, but the one-time hint names the dispatch share."""
+    monkeypatch.setattr(km_mod, "_dispatch_rtt", lambda mesh: 1.0)
+    calls = _spy_device_paths(monkeypatch)
+    with pytest.warns(DispatchLatencyHint, match="host dispatch"):
+        km = KMeans(k=4, seed=0, mesh=mesh8, verbose=True,
+                    empty_cluster="keep").fit(data)
+    assert calls == []
+    assert km.iterations_run > 0
+    assert "Iteration 1" in capsys.readouterr().out
+
+
+def test_auto_respects_host_side_hooks(data, mesh8, monkeypatch):
+    """A subclass with host-side Lloyd hooks must never be routed to the
+    device loop, and the one-time hint says why.  (SphericalKMeans pins
+    host_loop=True structurally — tested below — so this exercises the
+    defensive hook check with a user-defined subclass.)"""
+    monkeypatch.setattr(km_mod, "_dispatch_rtt", lambda mesh: 1.0)
+    calls = _spy_device_paths(monkeypatch)
+
+    class Nudged(KMeans):
+        def _postprocess_centroids(self, centroids, prev=None):
+            return centroids + 0.0
+
+    with pytest.warns(DispatchLatencyHint, match="host-side hooks"):
+        nk = Nudged(k=4, seed=0, mesh=mesh8, verbose=False,
+                    empty_cluster="keep").fit(data)
+    assert calls == []
+    assert nk.iterations_run > 0
+
+
+def test_spherical_pins_host_loop(data, mesh8, monkeypatch):
+    """SphericalKMeans requires the host loop: it pins host_loop=True
+    (never the inherited 'auto'), so no RTT probe and no hint ever run —
+    and an explicit True must survive (review r5: pop-and-discard used to
+    replace it with the base default)."""
+    def boom(mesh):
+        raise AssertionError("SphericalKMeans must not probe RTT")
+    monkeypatch.setattr(km_mod, "_dispatch_rtt", boom)
+    calls = _spy_device_paths(monkeypatch)
+    for kw in ({}, {"host_loop": True}, {"host_loop": "auto"}):
+        sk = SphericalKMeans(k=4, seed=0, mesh=mesh8, verbose=False, **kw)
+        assert sk.host_loop is True
+        sk.fit(data)
+    assert calls == []
+    with pytest.raises(ValueError, match="host_loop=True"):
+        SphericalKMeans(k=4, host_loop=False)
+
+
+def test_minibatch_auto_switches_on_high_latency(data, mesh8, monkeypatch):
+    """MiniBatch's device-sampling engine resolves 'auto' too (its batch
+    step is sub-ms, so RTT past the floor is dispatch-bound by
+    construction): verbose=False switches to the bit-matched one-dispatch
+    loop; verbose=True hints and stays."""
+    from kmeans_tpu.models import MiniBatchKMeans
+    monkeypatch.setattr(km_mod, "_dispatch_rtt", lambda mesh: 1.0)
+    loop_calls = []
+    orig = MiniBatchKMeans._fit_device_loop
+
+    def spy(self, *a, **kw):
+        loop_calls.append(1)
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(MiniBatchKMeans, "_fit_device_loop", spy)
+    kw = dict(k=4, seed=0, mesh=mesh8, batch_size=128, max_iter=6,
+              empty_cluster="keep")
+    with pytest.warns(DispatchLatencyHint, match="mini-batch"):
+        auto = MiniBatchKMeans(verbose=False, **kw).fit(data)
+    assert loop_calls == [1]
+    host = MiniBatchKMeans(verbose=False, host_loop=True, **kw).fit(data)
+    np.testing.assert_allclose(auto.centroids, host.centroids, atol=1e-5)
+
+    km_mod._HINTS_EMITTED.clear()
+    with pytest.warns(DispatchLatencyHint, match="round trips"):
+        MiniBatchKMeans(verbose=True, **kw).fit(data)
+    assert loop_calls == [1]          # verbose fit stayed per-iteration
+
+
+def test_host_loop_normalization():
+    """Bool-likes normalize so identity checks can't misroute them
+    (review r5: np.False_ passed ==-validation but failed `is False`)."""
+    assert KMeans(k=3, host_loop=np.False_).host_loop is False
+    assert KMeans(k=3, host_loop=1).host_loop is True
+    assert KMeans(k=3, host_loop=0).host_loop is False
+    from kmeans_tpu import GaussianMixture
+    with pytest.raises(ValueError, match="KMeans-only"):
+        GaussianMixture(n_components=2, host_loop="auto")
+
+
+def test_auto_resample_with_host_copy_stays_host(data, mesh8, monkeypatch):
+    """empty_cluster='resample' (the DEFAULT) on a host-resident dataset
+    draws replacements with the host rng; the device loop draws with the
+    on-device Gumbel engine.  'auto' must not make results
+    platform-dependent: it stays host-side and says why.  A hostless
+    (device-only) dataset uses the Gumbel engine in BOTH loops, so there
+    the switch is allowed."""
+    monkeypatch.setattr(km_mod, "_dispatch_rtt", lambda mesh: 1.0)
+    calls = _spy_device_paths(monkeypatch)
+    kw = dict(k=4, seed=0, mesh=mesh8, verbose=False)
+    with pytest.warns(DispatchLatencyHint, match="resample"):
+        KMeans(**kw).fit(data)                # default empty_cluster
+    assert calls == []
+
+    km_mod._HINTS_EMITTED.clear()
+    km = KMeans(**kw)
+    ds = km.cache(data)
+    ds._host = None                           # device-only dataset
+    ds._host_weights = None
+    with pytest.warns(DispatchLatencyHint, match="one device dispatch"):
+        km.fit(ds)
+    assert calls == ["device"]
+
+
+def test_explicit_host_loop_skips_measurement(data, mesh8, monkeypatch):
+    """Explicit True/False are zero-overhead: the RTT probe never runs."""
+    def boom(mesh):
+        raise AssertionError("explicit host_loop must not measure RTT")
+    monkeypatch.setattr(km_mod, "_dispatch_rtt", boom)
+    KMeans(k=4, seed=0, mesh=mesh8, verbose=False, host_loop=True,
+           empty_cluster="keep").fit(data)
+    KMeans(k=4, seed=0, mesh=mesh8, verbose=False, host_loop=False,
+           empty_cluster="keep").fit(data)
+
+
+def test_host_loop_validation():
+    with pytest.raises(ValueError, match="host_loop"):
+        KMeans(k=3, host_loop="sometimes")
