@@ -1,0 +1,197 @@
+"""Small-D / small-k step variants (r4 VERDICT #3): the reference's own
+bench shapes — T2 stress 100k x 10, k=5 (kmeans_spark.py:402-454) and
+blobs1m 1M x 16, k=64 — sit in this repo's weakest MFU region (~3.6%
+at blobs1m): at D=16 the distance matmul uses 16/128 of the MXU's
+contraction depth and k=64 half its lanes, and the Pallas tier
+correctly refuses (16x padding waste, ops/pallas_kernels.py:150).
+
+This sweep measures, per shape, the fused one-pass step under:
+
+  matmul        the shipped XLA path (baseline; chunked scan as shipped)
+  direct        (n, k, D) differences on the VPU — no MXU at all; at
+                tiny D the VPU's 8x-lower peak may still beat a mostly
+                idle MXU
+  matmul_bf16   bf16 cross-term (2x MXU rate on the same idle layout)
+  packed        ROW-PACKING: fold P = 128//D_pad8 points into one
+                128-lane register row and replace the two skinny
+                matmuls with full-tile ones —
+                  distances: (n/P, P*D) @ kron(I_P, C^T) -> (n/P, P*k),
+                  scatter:   onehot_packed^T @ X_packed -> (P*k, P*D),
+                             block-diagonal einsum 'akad->kd' extract.
+                Same 8x FLOP overhead the idle MXU already paid, but in
+                layouts XLA tiles at full rate; whether the conversion
+                wins is exactly what this measures.
+  chunk sweep   the shipped path at alternative scan chunk sizes (the
+                default VMEM-budget chunk may leave scan overhead on
+                the table at sub-ms steps)
+
+Harness: every variant runs its whole iteration chain inside ONE
+dispatch (lax.fori_loop with a data dependency through the centroid
+update, exp_glove_mfu.py pattern — per-dispatch RTT through the tunnel
+is ~70-100 ms vs sub-ms steps), scalar-transfer synced, median of 5,
+iteration-gap marginal.
+
+Decision rule (r4 VERDICT #3): a variant that beats the shipped path
+>= 1.3x at a shape gets wired into ``resolve_auto``'s rule for that
+region; target >= 2x at blobs1m.  Anything else: this file is the
+measured rejection, results inline below.
+
+Run on TPU hardware:  python experiments/exp_small_shapes.py
+"""
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from kmeans_tpu.ops.assign import assign_reduce
+
+SHAPES = [
+    ("blobs1m", 1_000_000, 16, 64),
+    ("t2_stress", 100_000, 10, 5),
+    ("mnist_shaped", 60_000, 784, 10),
+]
+
+
+def _round_up(v, m):
+    return -(-v // m) * m
+
+
+def packed_step(x, w, c, P):
+    """Row-packed fused step: distances + argmin + one-hot stats with
+    every matmul at full 128-lane width.  x:(n, D) with n % P == 0 and
+    P*D giving full lanes; returns (sums, counts, sse)."""
+    n, d = x.shape
+    k = c.shape[0]
+    acc = x.dtype
+    xp = x.reshape(n // P, P * d)
+    B = jnp.kron(jnp.eye(P, dtype=acc), c.T)            # (P*d, P*k)
+    dots = (xp @ B).reshape(n // P, P, k)
+    x2 = jnp.sum(x * x, axis=1).reshape(n // P, P, 1)
+    c2 = jnp.sum(c * c, axis=1)
+    d2 = x2 - 2.0 * dots + c2[None, None, :]
+    labels = jnp.argmin(d2, axis=-1)                    # (n/P, P)
+    mind2 = jnp.maximum(jnp.min(d2, axis=-1), 0.0)
+    wp = w.reshape(n // P, P)
+    oh = jax.nn.one_hot(labels, k, dtype=acc) * wp[..., None]
+    ohp = oh.reshape(n // P, P * k)
+    S = (ohp.T @ xp).reshape(P, k, P, d)                # full-tile scatter
+    sums = jnp.einsum("akad->kd", S)                    # block-diag extract
+    counts = jnp.sum(oh, axis=(0, 1))
+    sse = jnp.sum(wp * mind2)
+    return sums, counts, sse
+
+
+def bench_variant(make_step, n, d, k, iters=None, gap=None):
+    """Marginal ms/iteration of ``step(x, w, c) -> (sums, counts, sse)``
+    chained through the Lloyd update inside one dispatch."""
+    # Adaptive gap: aim the big chain at ~1.5 s wall (BASELINE.md rule).
+    key = jax.random.PRNGKey(0)
+    x = jax.random.uniform(key, (n, d), jnp.float32, -1.0, 1.0)
+    w = jnp.ones((n,), jnp.float32)
+    c0 = x[:k] * 1.0
+    step = make_step
+
+    def many(n_it):
+        @jax.jit
+        def run(x, w, c):
+            def body(i, c):
+                sums, counts, _ = step(x, w, c)
+                return jnp.where(counts[:, None] > 0,
+                                 sums / jnp.maximum(counts[:, None], 1.0),
+                                 c).astype(c.dtype)
+            return jnp.sum(lax.fori_loop(0, n_it, body, c))
+
+        float(run(x, w, c0))                          # compile + warm
+        reps = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(run(x, w, c0))
+            reps.append(time.perf_counter() - t0)
+        return float(np.median(reps))
+
+    # Probe once to size the gap (~1.5 s big chain, capped for sanity).
+    t1 = max(many(2) / 2, 1e-5)
+    gap = gap or int(min(max(1.5 / t1, 8), 20_000))
+    t_small = many(2)
+    t_big = many(2 + gap)
+    return (t_big - t_small) / gap * 1e3, gap
+
+
+def main():
+    assert jax.default_backend() == "tpu", "run on TPU hardware"
+    results = {}
+    for name, n, d, k in SHAPES:
+        print(f"== {name}: N={n} D={d} k={k}", flush=True)
+        from kmeans_tpu.parallel.sharding import choose_chunk_size
+        auto_chunk = choose_chunk_size(n, k, d)
+
+        def shipped(chunk, mode):
+            n_pad = _round_up(n, chunk)
+
+            def step(x, w, c):
+                xr = jnp.pad(x, ((0, n_pad - n), (0, 0)))
+                wr = jnp.pad(w, (0, n_pad - n))
+                st = assign_reduce(xr, wr, c, chunk_size=chunk, mode=mode)
+                return st.sums, st.counts, st.sse
+            return step
+
+        for mode in ("matmul", "direct", "matmul_bf16"):
+            try:
+                ms, gap = bench_variant(shipped(auto_chunk, mode), n, d, k)
+                results[(name, mode)] = ms
+                print(f"  {mode:<14} chunk={auto_chunk:<8} "
+                      f"{ms:8.4f} ms/iter  (gap {gap})", flush=True)
+            except Exception as e:
+                print(f"  {mode:<14} FAILED: {type(e).__name__}: {e}",
+                      flush=True)
+
+        for chunk in (auto_chunk // 4, auto_chunk * 4):
+            if chunk < 256:
+                continue
+            try:
+                ms, gap = bench_variant(shipped(chunk, "matmul"), n, d, k)
+                results[(name, f"matmul@{chunk}")] = ms
+                print(f"  matmul         chunk={chunk:<8} "
+                      f"{ms:8.4f} ms/iter  (gap {gap})", flush=True)
+            except Exception as e:
+                print(f"  matmul@{chunk} FAILED: {e}", flush=True)
+
+        d_pad8 = _round_up(d, 8)
+        P = max(128 // d_pad8, 1)
+        if P > 1:
+            n_packp = _round_up(n, P)
+
+            def packed(x, w, c):
+                xr = jnp.pad(x, ((0, n_packp - n), (0, d_pad8 - d)))
+                wr = jnp.pad(w, (0, n_packp - n))
+                cr = jnp.pad(c, ((0, 0), (0, d_pad8 - d)))
+                sums, counts, sse = packed_step(xr, wr, cr, P)
+                return sums[:, :d], counts, sse
+            try:
+                ms, gap = bench_variant(packed, n, d, k)
+                results[(name, "packed")] = ms
+                print(f"  packed(P={P:<3})  "
+                      f"             {ms:8.4f} ms/iter  (gap {gap})",
+                      flush=True)
+            except Exception as e:
+                print(f"  packed FAILED: {type(e).__name__}: {e}",
+                      flush=True)
+
+        base = results.get((name, "matmul"))
+        if base:
+            best = min((v, kk) for kk, v in results.items()
+                       if kk[0] == name)
+            print(f"  -> best {best[1][1]}: {base / best[0]:.2f}x vs "
+                  f"shipped matmul", flush=True)
+    print(results)
+
+
+if __name__ == "__main__":
+    main()
