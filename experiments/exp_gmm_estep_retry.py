@@ -1,0 +1,230 @@
+"""GMM E-step re-probe under the r4 fold-aware tile rules (r4 VERDICT
+#4): the r3 fused-Pallas rejection (exp_gmm_estep_pallas.py — 3.35 s vs
+3.5 ms, a ~1000x scheduling gap) predates ``choose_tiles(fold=...)``;
+and the XLA EM step's 14.2 ms/iter ~19% MFU accounting says the real
+cost driver is the two moment matmuls pinned at ``Precision.HIGHEST``
+(~3x MXU passes each, the price of variances that survive
+``S2/R - mu^2`` cancellation — parallel/gmm_step.py:105-116).
+
+Three measured questions, each with a decision rule:
+
+1. **Moment-precision ladder** (XLA path): HIGHEST vs HIGH vs DEFAULT
+   for the two moment matmuls, timing AND the r3 hardware failure probe
+   (a cluster offset ~25 sigma from the centering shift; its fitted
+   variance must stay within 5% of truth, not collapse toward
+   reg_covar).  If a cheaper precision keeps the bound on REAL v5e
+   matmuls, wire it into ``_estep_tile`` and take the speedup;
+   if not, the HIGHEST pin stays with fresh numbers on record.
+
+2. **Chunk budget sweep** around the r3 2^23-element rule at each
+   precision (the de-fuse boundary may sit elsewhere once the moment
+   matmuls change cost).
+
+3. **The r3 Pallas kernel with r4 tile_n** (1024 instead of the r3
+   VMEM-target rule): a cheap re-run that either shows the scheduling
+   gap closing (then the full pipelining port is worth scoping) or
+   refreshes the rejection under the current toolchain.
+
+Shape: N=2M x D=128, k=256 diag (the published 14.2 ms/iter config,
+docs/PERFORMANCE.md "The mixture family").
+
+Run on TPU hardware:  python experiments/exp_gmm_estep_retry.py
+"""
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+N, D, K = 2_097_152, 128, 256
+PEAK_TFLOPS = 197.0
+REAL_TFLOP_PER_ITER = 8.0 * N * D * K / 1e12     # 2 logp + 2 moment mm
+
+
+def estep_variant(x, w, means, inv_var, log_det, log_w, *, chunk,
+                  precision):
+    """Chunked diag E pass with a configurable moment-matmul precision
+    (everything else identical to parallel.gmm_step._estep_tile)."""
+    from kmeans_tpu.parallel.gmm_step import _log_prob_chunk
+
+    n_chunks = x.shape[0] // chunk
+    xs = (x.reshape(n_chunks, chunk, D), w.reshape(n_chunks, chunk))
+
+    def body(carry, ch):
+        xc, wc = ch
+        logp = _log_prob_chunk(xc, means, inv_var, log_det, log_w)
+        m = jnp.max(logp, axis=1, keepdims=True)
+        p = jnp.exp(logp - m)
+        denom = jnp.sum(p, axis=1, keepdims=True)
+        resp = p * (wc / denom[:, 0])[:, None]
+        r, s1, s2, ll = carry
+        return (r + jnp.sum(resp, axis=0),
+                s1 + lax.dot_general(resp, xc, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=xc.dtype,
+                                     precision=precision),
+                s2 + lax.dot_general(resp, xc * xc,
+                                     (((0,), (0,)), ((), ())),
+                                     preferred_element_type=xc.dtype,
+                                     precision=precision),
+                ll + jnp.sum(jnp.where(wc > 0,
+                                       (m[:, 0] + jnp.log(denom[:, 0]))
+                                       * wc, 0.0))), None
+
+    init = (jnp.zeros((K,), x.dtype), jnp.zeros((K, D), x.dtype),
+            jnp.zeros((K, D), x.dtype), jnp.zeros((), x.dtype))
+    out, _ = lax.scan(body, init, xs)
+    return out
+
+
+def bench_estep(x, w, params, *, chunk, precision, gap=80):
+    """Marginal ms/E-pass, whole chain in one dispatch."""
+    means, inv_var, log_det, log_w = params
+
+    def many(n_it):
+        @jax.jit
+        def run(x, w, means):
+            def body(i, means):
+                r, s1, s2, ll = estep_variant(
+                    x, w, means, inv_var, log_det, log_w,
+                    chunk=chunk, precision=precision)
+                # EVERY accumulator feeds the carry (an s1-only
+                # dependency lets XLA dead-code-eliminate the second
+                # HIGHEST moment matmul and the logsumexp — review r5:
+                # the ladder would time half the work it claims).
+                return means + 0.0 * ((s1 + s2) / jnp.maximum(
+                    r, 1.0)[:, None] + ll)
+            return jnp.sum(lax.fori_loop(0, n_it, body, means))
+
+        float(run(x, w, means))
+        reps = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(run(x, w, means))
+            reps.append(time.perf_counter() - t0)
+        return float(np.median(reps))
+
+    t_small = many(2)
+    t_big = many(2 + gap)
+    return (t_big - t_small) / gap * 1e3
+
+
+def variance_probe(precision):
+    """The r3 hardware failure shape: one cluster offset ~25 sigma from
+    the centering shift.  Returns max relative variance error."""
+    rng = np.random.default_rng(0)
+    n_small, k_small = 262_144, 8
+    true_var = 4.0
+    offsets = np.linspace(0, 50, k_small)          # sigmas from shift
+    comp = rng.integers(0, k_small, n_small)
+    x_np = (offsets[comp][:, None] * np.sqrt(true_var)
+            + rng.normal(size=(n_small, D)) * np.sqrt(true_var))
+    x = jnp.asarray(x_np, jnp.float32)
+    w = jnp.ones((n_small,), jnp.float32)
+    shift = jnp.mean(x, axis=0)
+    means0 = jnp.asarray(
+        offsets[:, None] * np.sqrt(true_var) * np.ones((k_small, D)),
+        jnp.float32)
+    params = (means0 - shift[None, :], jnp.full((k_small, D), 1 / true_var,
+                                                jnp.float32),
+              jnp.full((k_small,), D * np.log(true_var), jnp.float32),
+              jnp.full((k_small,), -np.log(k_small), jnp.float32))
+
+    @jax.jit
+    def one_pass(xc, wc):
+        return estep_variant(xc - shift[None, :], wc, *params,
+                             chunk=32_768, precision=precision)
+
+    r, s1, s2, _ = one_pass(x, w)
+    mu = s1 / r[:, None]
+    var = np.asarray(s2 / r[:, None] - mu * mu)
+    return float(np.max(np.abs(var - true_var) / true_var))
+
+
+def main():
+    assert jax.default_backend() == "tpu", "run on TPU hardware"
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (N, D), jnp.float32)
+    w = jnp.ones((N,), jnp.float32)
+    rng = np.random.default_rng(1)
+    means = jnp.asarray(rng.normal(size=(K, D)), jnp.float32)
+    inv_var = jnp.ones((K, D), jnp.float32)
+    log_det = jnp.zeros((K,), jnp.float32)
+    log_w = jnp.full((K,), -np.log(K), jnp.float32)
+    params = (means, inv_var, log_det, log_w)
+
+    results = {}
+    for prec_name, prec in [("HIGHEST", lax.Precision.HIGHEST),
+                            ("HIGH", lax.Precision.HIGH),
+                            ("DEFAULT", lax.Precision.DEFAULT)]:
+        err = variance_probe(prec)
+        for chunk in (16_384, 32_768, 65_536, 131_072):
+            ms = bench_estep(x, w, params, chunk=chunk, precision=prec)
+            mfu = REAL_TFLOP_PER_ITER / (ms / 1e3) / PEAK_TFLOPS
+            results[(prec_name, chunk)] = (ms, mfu, err)
+            print(f"  {prec_name:<8} chunk={chunk:<7} {ms:7.2f} ms/pass "
+                  f"{mfu:5.1%} MFU  var_err={err:.2e}", flush=True)
+
+    # 3. The r3 Pallas kernel with the r4 row-tile (1024) instead of the
+    # r3 VMEM-target rule: the r3 gap was ~1000x, so two synced single
+    # dispatches rank it — no marginal needed unless it lands within 2x
+    # of the XLA pass.
+    try:
+        import experiments.exp_gmm_estep_pallas as p3
+        for tile_rule, label in [(p3._tile_n_for, "r3 tile rule"),
+                                 (lambda d, k: 1024, "r4 tile_n=1024")]:
+            p3._tile_n_for = tile_rule
+            # _tile_n_for is read at trace time; same-shape re-calls
+            # would hit the jit cache and silently reuse the old tile.
+            p3.pallas_estep.clear_cache()
+            n_small = 524_288                      # the r3 probe size
+            xs, ws = x[:n_small], w[:n_small]
+            shift = jnp.zeros((D,), jnp.float32)
+
+            def one_sync():
+                out = p3.pallas_estep(xs, ws, shift, means, inv_var,
+                                      log_det, log_w)
+                jax.tree_util.tree_map(lambda a: np.asarray(a), out)
+
+            one_sync()                             # compile + warm
+            t0 = time.perf_counter()
+            one_sync()
+            ms = (time.perf_counter() - t0) * 1e3
+            if ms < 500.0:
+                # Out of the r3 1000x regime: a single dispatch now
+                # mostly measures the ~70-100 ms tunnel RTT, which
+                # would mask a fixed kernel (review r5) — switch to the
+                # chained marginal before applying any decision rule.
+                def chain(n_it):
+                    @jax.jit
+                    def run(xs, ws, m):
+                        def body(i, m):
+                            r_, s1, s2, ll = p3.pallas_estep(
+                                xs, ws, shift, m, inv_var, log_det,
+                                log_w)
+                            return m + 0.0 * (
+                                (s1 + s2) / jnp.maximum(
+                                    r_, 1.0)[:, None] + ll)
+                        return jnp.sum(lax.fori_loop(0, n_it, body, m))
+                    float(run(xs, ws, means))
+                    t0 = time.perf_counter()
+                    float(run(xs, ws, means))
+                    return time.perf_counter() - t0
+                gap = max(int(1.5 / max(ms / 1e3, 1e-4)), 4)
+                ms = (chain(2 + gap) - chain(2)) / gap * 1e3
+            print(f"  pallas [{label}] {ms:9.2f} ms per "
+                  f"{n_small}x{D} k={K} E-pass (r3 recorded 3350 ms; "
+                  f"XLA ~3.5 ms at this size)", flush=True)
+    except Exception as e:
+        print(f"  pallas re-run unavailable: {type(e).__name__}: {e}",
+              flush=True)
+    print(results)
+
+
+if __name__ == "__main__":
+    main()

@@ -99,6 +99,31 @@ CONFIG_K = {"small": 5, "blobs1m": 64, "uniform10m": 1024, "mnist": 10,
 DEFAULT_CONFIGS = ["small", "blobs1m", "mnist", "glove", "uniform10m"]
 
 
+def published_row(n: int, d: int, k: int):
+    """The matching BASELINE.json.published row, or None outside a repo
+    checkout — the bench then simply reports absolutes, it never fails
+    (r5: the published table became machine-readable; comparing each
+    run against it catches silent regressions AND tunnel-drift
+    windows).  Exact (n, d, k) first; a (d, k) match only when unique —
+    the table holds two (128, 1024) rows (headline 10M + 2M sanity), so
+    shape alone must not silently pick one by JSON order (review r5)."""
+    import json as _json
+    from pathlib import Path
+    try:
+        doc = _json.loads((Path(__file__).parent.parent
+                           / "BASELINE.json").read_text())
+        rows = doc["published"]["rows"]
+        exact = [r for r in rows
+                 if (int(r["n"]), int(r["d"]), int(r["k"])) == (n, d, k)]
+        if exact:
+            return exact[0]
+        shape = [r for r in rows if (int(r["d"]), int(r["k"])) == (d, k)]
+        return shape[0] if len(shape) == 1 else None
+    except (OSError, KeyError, TypeError, ValueError):
+        pass
+    return None
+
+
 def bench_config(name: str, iters: int, mode: str) -> Dict:
     import jax
     from kmeans_tpu.parallel import distributed as dist
@@ -244,6 +269,33 @@ def bench_config(name: str, iters: int, mode: str) -> Dict:
         "noise_limited": noise_limited,
         "indicative_only": indicative,
     }
+    pub = published_row(n, d, k)
+    if pub is not None and pub.get("mode") != mode:
+        # A matmul run compared against the published pallas row would
+        # warn 'regression' for a mode choice, not a regression
+        # (review r5): published rows record the auto-resolved mode.
+        _log(f"[{name}] published row is mode={pub.get('mode')!r}; this "
+             f"run is {mode!r} — vs_published comparison skipped")
+        pub = None
+    if pub is not None and not noise_limited:
+        # Same-shape check against the published table (per-row n may
+        # differ, so compare per-point-dim throughput, not ms).  Guarded
+        # like the lookup: a malformed row must never crash a bench that
+        # just spent minutes measuring (review r5).
+        try:
+            tput_pub = float(pub["pts_dims_per_s_chip"])
+            ratio = result["throughput_pd_per_sec_per_chip"] / tput_pub \
+                if tput_pub > 0 else None
+        except (KeyError, TypeError, ValueError):
+            ratio = None
+        if ratio is not None:
+            result["published_pts_dims_per_s_chip"] = tput_pub
+            result["vs_published"] = round(ratio, 3)
+            if abs(ratio - 1.0) > 0.2:
+                _log(f"[{name}] WARNING: {ratio:.2f}x the published "
+                     f"BASELINE.json row (r{pub.get('round')}, "
+                     f"{pub.get('measured')}) — regression, improvement, "
+                     f"or tunnel-drift window; re-run before publishing")
     print(json.dumps(result), flush=True)
     return result
 
