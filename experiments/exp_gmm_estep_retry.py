@@ -53,8 +53,9 @@ def estep_variant(x, w, means, inv_var, log_det, log_w, *, chunk,
     (everything else identical to parallel.gmm_step._estep_tile)."""
     from kmeans_tpu.parallel.gmm_step import _log_prob_chunk
 
-    n_chunks = x.shape[0] // chunk
-    xs = (x.reshape(n_chunks, chunk, D), w.reshape(n_chunks, chunk))
+    k, d = means.shape                 # NOT the module globals: the
+    n_chunks = x.shape[0] // chunk     # variance probe passes k=8
+    xs = (x.reshape(n_chunks, chunk, d), w.reshape(n_chunks, chunk))
 
     def body(carry, ch):
         xc, wc = ch
@@ -76,8 +77,8 @@ def estep_variant(x, w, means, inv_var, log_det, log_w, *, chunk,
                                        (m[:, 0] + jnp.log(denom[:, 0]))
                                        * wc, 0.0))), None
 
-    init = (jnp.zeros((K,), x.dtype), jnp.zeros((K, D), x.dtype),
-            jnp.zeros((K, D), x.dtype), jnp.zeros((), x.dtype))
+    init = (jnp.zeros((k,), x.dtype), jnp.zeros((k, d), x.dtype),
+            jnp.zeros((k, d), x.dtype), jnp.zeros((), x.dtype))
     out, _ = lax.scan(body, init, xs)
     return out
 

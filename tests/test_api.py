@@ -297,6 +297,12 @@ def test_float64_without_x64_warns_and_works():
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("JAX_ENABLE_X64", None)
     code = (
+        # Config-update BEFORE first backend use: the env var alone does
+        # not stop the axon PJRT plugin from initializing, and with the
+        # tunnel down that init blocks forever (r5, memory
+        # axon-tunnel-quirks) — the same pattern tests/conftest.py uses.
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
         "import warnings, numpy as np\n"
         "from kmeans_tpu import KMeans\n"
         "X = np.random.default_rng(0).normal(size=(200, 3))\n"
