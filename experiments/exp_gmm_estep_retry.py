@@ -165,6 +165,8 @@ def main():
                             ("DEFAULT", lax.Precision.DEFAULT)]:
         err = variance_probe(prec)
         for chunk in (16_384, 32_768, 65_536, 131_072):
+            if chunk > x.shape[0] or x.shape[0] % chunk:
+                continue                  # reduced-N smoke runs
             ms = bench_estep(x, w, params, chunk=chunk, precision=prec)
             mfu = REAL_TFLOP_PER_ITER / (ms / 1e3) / PEAK_TFLOPS
             results[(prec_name, chunk)] = (ms, mfu, err)

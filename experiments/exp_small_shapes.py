@@ -34,7 +34,11 @@ iteration-gap marginal.
 Decision rule (r4 VERDICT #3): a variant that beats the shipped path
 >= 1.3x at a shape gets wired into ``resolve_auto``'s rule for that
 region; target >= 2x at blobs1m.  Anything else: this file is the
-measured rejection, results inline below.
+measured rejection, results inline below.  ONLY EXACT variants are
+wirable into ``auto`` (packed / chunk / direct): ``matmul_bf16``
+changes boundary assignments (~2^-8 relative distance error) and the
+library's default must stay exact — a bf16 win is reported as the
+opt-in speedup it already is.
 
 Run on TPU hardware:  python experiments/exp_small_shapes.py
 """
@@ -153,7 +157,7 @@ def main():
                       flush=True)
 
         for chunk in (auto_chunk // 4, auto_chunk * 4):
-            if chunk < 256:
+            if chunk < 256 or chunk > n:   # chunk > n pads fake rows
                 continue
             try:
                 ms, gap = bench_variant(shipped(chunk, "matmul"), n, d, k)
