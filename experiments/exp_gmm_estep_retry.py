@@ -164,6 +164,12 @@ def main():
                             ("HIGH", lax.Precision.HIGH),
                             ("DEFAULT", lax.Precision.DEFAULT)]:
         err = variance_probe(prec)
+        # The accuracy answer stands alone: a reduced-N smoke run may
+        # skip every timing chunk below, and question 1's decision
+        # metric must never be computed-then-discarded (review r5).
+        print(f"  {prec_name:<8} variance probe (25+ sigma offsets): "
+              f"var_err={err:.2e}", flush=True)
+        results[(prec_name, "var_err")] = err
         for chunk in (16_384, 32_768, 65_536, 131_072):
             if chunk > x.shape[0] or x.shape[0] % chunk:
                 continue                  # reduced-N smoke runs
