@@ -28,8 +28,17 @@ This sweep measures, per shape, the fused one-pass step under:
 Harness: every variant runs its whole iteration chain inside ONE
 dispatch (lax.fori_loop with a data dependency through the centroid
 update, exp_glove_mfu.py pattern — per-dispatch RTT through the tunnel
-is ~70-100 ms vs sub-ms steps), scalar-transfer synced, median of 5,
-iteration-gap marginal.
+is ~70-100 ms vs sub-ms steps), scalar-transfer synced, median of 5
+interleaved pairs, iteration-gap marginal.  Two r5 fixes after the
+first run produced garbage (negative marginals, a 5x disagreement with
+the published blobs1m row): (1) the gap ramps like benchmarks.
+bench_config — grow until the BIG chain's direct wall time reaches
+~1.5 s — instead of sizing off an RTT-dominated 2-iteration probe
+(gaps of 20-43 put sub-ms signals under the ±25 ms tunnel jitter);
+(2) padding to the chunk multiple happens ONCE outside the chain, not
+inside the loop body (an in-body jnp.pad re-copies the full dataset
+every iteration — 64 MB/iter at blobs1m — which is not a cost the
+shipped path pays: shard_points pads at placement time).
 
 Decision rule (r4 VERDICT #3): a variant that beats the shipped path
 >= 1.3x at a shape gets wired into ``resolve_auto``'s rule for that
@@ -41,6 +50,46 @@ library's default must stay exact — a bf16 win is reported as the
 opt-in speedup it already is.
 
 Run on TPU hardware:  python experiments/exp_small_shapes.py
+
+MEASURED (TPU v5e via tunnel, 2026-07-31, fixed harness — gaps ramp to
+a 1.5 s big chain; all spreads <= 1.8%):
+
+  blobs1m (1M x 16, k=64), shipped auto chunk = 131072:
+    matmul           0.5801 ms/iter   (matches the published 0.579 row)
+    direct           1.8308           matmul_bf16  0.5811 (BW-bound: the
+                                      MXU is not the limiter at D=16)
+    packed(P=8)      1.9815           (the kron conversion costs more
+                                      than the idle MXU it fills)
+    chunk sweep      8192: 1.2625   16384: 1.2033   32768: 1.1726
+                     65536: 0.5502  250000: 1.3360  524288: 0.5010
+                     1000000 (SINGLE CHUNK, no scan): 0.3370  <- 1.72x
+  t2_stress (100k x 10, k=5), shipped chunk = n = 100000 (single):
+    matmul           0.0108 ms/iter   <- already the best variant
+    direct 0.0603 · bf16 0.0108 · packed 0.0429 · all smaller chunks worse
+  mnist_shaped (60k x 784, k=10), shipped chunk = n = 60000 (single):
+    matmul           0.0643 ms/iter   <- best (published row: 0.0668)
+    direct 0.6512 · bf16 0.0689 · chunks 3744/7496: ~1.0
+
+CONCLUSIONS (wired r5):
+  1. The ONLY variant clearing the 1.3x bar is "don't scan at all":
+     single-chunk beats the 2^17-capped scan 1.72x at blobs1m, and the
+     two shapes that already ran single-chunk (t2_stress, mnist) beat
+     every chunked variant too.  The scan's value is bounding the
+     (chunk, k) HBM temporaries — at n*k <= 2^26 elems (256 MB f32)
+     that bound is unnecessary on a 16 GB chip.  choose_chunk_size now
+     returns a single whole-dataset chunk in that region (the
+     SINGLE_CHUNK_ELEMS budget); the scan rule is unchanged elsewhere
+     (headline/glove shapes are far above the budget).
+  2. Row-packing (the kron full-tile conversion) is a measured
+     REJECTION: 3.4x slower than shipped at blobs1m, 4x at t2_stress —
+     the pass is HBM-bandwidth-bound, so converting 8x FLOP overhead
+     into full-rate tiles buys nothing the memory system can pay for.
+  3. bf16 cross-terms: no effect at D<=16 (BW-bound), mild penalty at
+     mnist (0.0689 vs 0.0643, extra convert pass on a compute-light
+     shape) — stays opt-in, auto keeps f32.
+  4. The non-monotonic chunk curve (65536 fast, 250000 slow, single
+     fast) tracks XLA's fusion decisions, not a smooth overhead model —
+     chunk-rule changes must be measured, not extrapolated.
 """
 
 import sys
@@ -92,98 +141,118 @@ def packed_step(x, w, c, P):
     return sums, counts, sse
 
 
-def bench_variant(make_step, n, d, k, iters=None, gap=None):
+def bench_variant(step, x, w, c0):
     """Marginal ms/iteration of ``step(x, w, c) -> (sums, counts, sse)``
-    chained through the Lloyd update inside one dispatch."""
-    # Adaptive gap: aim the big chain at ~1.5 s wall (BASELINE.md rule).
-    key = jax.random.PRNGKey(0)
-    x = jax.random.uniform(key, (n, d), jnp.float32, -1.0, 1.0)
-    w = jnp.ones((n,), jnp.float32)
-    c0 = x[:k] * 1.0
-    step = make_step
+    chained through the Lloyd update inside one dispatch.
 
-    def many(n_it):
-        @jax.jit
-        def run(x, w, c):
-            def body(i, c):
-                sums, counts, _ = step(x, w, c)
-                return jnp.where(counts[:, None] > 0,
-                                 sums / jnp.maximum(counts[:, None], 1.0),
-                                 c).astype(c.dtype)
-            return jnp.sum(lax.fori_loop(0, n_it, body, c))
+    ``x``/``w`` are PRE-padded device arrays (padding belongs outside
+    the timed chain).  The trip count is a traced scalar, so the whole
+    gap ramp reuses ONE compiled while_loop program.  Gap rule =
+    benchmarks.bench_config: grow (clamped 25x/step) until the big
+    chain's direct wall time reaches ~1.5 s, then take the median of 5
+    interleaved (small, big) marginals."""
+    from kmeans_tpu.benchmarks import measure_marginal
 
-        float(run(x, w, c0))                          # compile + warm
-        reps = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            float(run(x, w, c0))
-            reps.append(time.perf_counter() - t0)
-        return float(np.median(reps))
+    @jax.jit
+    def run(x, w, c, n_it):
+        def body(i, c):
+            sums, counts, _ = step(x, w, c)
+            return jnp.where(counts[:, None] > 0,
+                             sums / jnp.maximum(counts[:, None], 1.0),
+                             c).astype(c.dtype)
+        return jnp.sum(lax.fori_loop(0, n_it, body, c))
 
-    # Probe once to size the gap (~1.5 s big chain, capped for sanity).
-    t1 = max(many(2) / 2, 1e-5)
-    gap = gap or int(min(max(1.5 / t1, 8), 20_000))
-    t_small = many(2)
-    t_big = many(2 + gap)
-    return (t_big - t_small) / gap * 1e3, gap
+    def timed(n_it):
+        t0 = time.perf_counter()
+        float(run(x, w, c0, n_it))
+        return time.perf_counter() - t0
+
+    timed(2)                                          # compile
+    t_small = timed(2)                                # warm dispatch floor
+    gap, TARGET, CAP = 64, 1.5, 2_000_000
+    while True:
+        t_big = timed(2 + gap)
+        if t_big >= TARGET or gap >= CAP:
+            break
+        per_iter = max((t_big - t_small) / gap, 1e-9)
+        gap = int(min(CAP, min(gap * 25, max(TARGET / per_iter, gap * 5))))
+    margin, spread, _ = measure_marginal(
+        lambda: timed(2), lambda: timed(2 + gap), reps=5)
+    return margin / gap * 1e3, gap, spread
+
+
+def _padded(x, w, n_pad, d_pad=None):
+    n, d = x.shape
+    d_pad = d_pad or d
+    if n_pad == n and d_pad == d:
+        return x, w
+    xr = jnp.pad(x, ((0, n_pad - n), (0, d_pad - d)))
+    wr = jnp.pad(w, (0, n_pad - n))
+    return jax.device_put(xr), jax.device_put(wr)
 
 
 def main():
+    import os
     assert jax.default_backend() == "tpu", "run on TPU hardware"
+    only = os.environ.get("SHAPES")          # e.g. SHAPES=blobs1m,t2_stress
     results = {}
     for name, n, d, k in SHAPES:
+        if only and name not in only.split(","):
+            continue
         print(f"== {name}: N={n} D={d} k={k}", flush=True)
         from kmeans_tpu.parallel.sharding import choose_chunk_size
         auto_chunk = choose_chunk_size(n, k, d)
 
-        def shipped(chunk, mode):
-            n_pad = _round_up(n, chunk)
+        key = jax.random.PRNGKey(0)
+        x = jax.random.uniform(key, (n, d), jnp.float32, -1.0, 1.0)
+        w = jnp.ones((n,), jnp.float32)
+        c0 = x[:k] * 1.0
 
+        def shipped(chunk, mode):
             def step(x, w, c):
-                xr = jnp.pad(x, ((0, n_pad - n), (0, 0)))
-                wr = jnp.pad(w, (0, n_pad - n))
-                st = assign_reduce(xr, wr, c, chunk_size=chunk, mode=mode)
+                st = assign_reduce(x, w, c, chunk_size=chunk, mode=mode)
                 return st.sums, st.counts, st.sse
             return step
 
-        for mode in ("matmul", "direct", "matmul_bf16"):
+        def run_one(label, step, xr, wr):
             try:
-                ms, gap = bench_variant(shipped(auto_chunk, mode), n, d, k)
-                results[(name, mode)] = ms
-                print(f"  {mode:<14} chunk={auto_chunk:<8} "
-                      f"{ms:8.4f} ms/iter  (gap {gap})", flush=True)
+                ms, gap, spread = bench_variant(step, xr, wr, c0)
+                results[(name, label)] = ms
+                print(f"  {label:<16} {ms:8.4f} ms/iter  "
+                      f"(gap {gap}, spread {spread:.1%})", flush=True)
             except Exception as e:
-                print(f"  {mode:<14} FAILED: {type(e).__name__}: {e}",
+                print(f"  {label:<16} FAILED: {type(e).__name__}: {e}",
                       flush=True)
 
-        for chunk in (auto_chunk // 4, auto_chunk * 4):
-            if chunk < 256 or chunk > n:   # chunk > n pads fake rows
+        xr, wr = _padded(x, w, _round_up(n, auto_chunk))
+        for mode in ("matmul", "direct", "matmul_bf16"):
+            run_one(mode, shipped(auto_chunk, mode), xr, wr)
+
+        for chunk in sorted({(c // 8) * 8 for c in
+                             (auto_chunk // 16, auto_chunk // 8,
+                              auto_chunk // 4, auto_chunk // 2,
+                              auto_chunk * 4)}):
+            if chunk < 2048 or chunk > n or chunk == auto_chunk:
                 continue
-            try:
-                ms, gap = bench_variant(shipped(chunk, "matmul"), n, d, k)
-                results[(name, f"matmul@{chunk}")] = ms
-                print(f"  matmul         chunk={chunk:<8} "
-                      f"{ms:8.4f} ms/iter  (gap {gap})", flush=True)
-            except Exception as e:
-                print(f"  matmul@{chunk} FAILED: {e}", flush=True)
+            xr, wr = _padded(x, w, _round_up(n, chunk))
+            run_one(f"matmul@{chunk}", shipped(chunk, "matmul"), xr, wr)
 
         d_pad8 = _round_up(d, 8)
         P = max(128 // d_pad8, 1)
         if P > 1:
-            n_packp = _round_up(n, P)
+            xr, wr = _padded(x, w, _round_up(n, P), d_pad8)
+            cr = jnp.pad(c0, ((0, 0), (0, d_pad8 - d)))
 
-            def packed(x, w, c):
-                xr = jnp.pad(x, ((0, n_packp - n), (0, d_pad8 - d)))
-                wr = jnp.pad(w, (0, n_packp - n))
-                cr = jnp.pad(c, ((0, 0), (0, d_pad8 - d)))
-                sums, counts, sse = packed_step(xr, wr, cr, P)
-                return sums[:, :d], counts, sse
+            def packed(xp, wp, c):
+                # c arrives (k, d_pad8) from the chain's own update of
+                # the padded centroid table.
+                sums, counts, sse = packed_step(xp, wp, c, P)
+                return sums, counts, sse
             try:
-                ms, gap = bench_variant(packed, n, d, k)
+                ms, gap, spread = bench_variant(packed, xr, wr, cr)
                 results[(name, "packed")] = ms
-                print(f"  packed(P={P:<3})  "
-                      f"             {ms:8.4f} ms/iter  (gap {gap})",
-                      flush=True)
+                print(f"  packed(P={P:<3})    {ms:8.4f} ms/iter  "
+                      f"(gap {gap}, spread {spread:.1%})", flush=True)
             except Exception as e:
                 print(f"  packed FAILED: {type(e).__name__}: {e}",
                       flush=True)

@@ -81,9 +81,11 @@ def _resolve_chunk(n: int, d: int, k_hint: int, mesh: Mesh,
                    chunk_size: Optional[int],
                    budget_elems: Optional[int] = None) -> int:
     data_shards, model_shards = mesh_shape(mesh)
-    kw = {} if budget_elems is None else {"budget_elems": budget_elems}
+    # budget_elems=None IS choose_chunk_size's default contract now
+    # (default budget + single-chunk shortcut eligibility).
     return chunk_size or choose_chunk_size(
-        -(-n // data_shards), max(k_hint, model_shards), d, **kw)
+        -(-n // data_shards), max(k_hint, model_shards), d,
+        budget_elems=budget_elems)
 
 
 def from_npy(path, mesh: Mesh, *, chunk_size: Optional[int] = None,

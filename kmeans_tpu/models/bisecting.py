@@ -205,7 +205,11 @@ class BisectingKMeans(KMeans):
             c = float(np.asarray(stats.counts, np.float64)[0])
             cents[0] = (s / max(c, 1.0)).astype(self.dtype)
             mean = self._put_centroids(cents[0][None, :], mesh, model_shards)
-            step_exact, _ = _get_step_fns(mesh, ds.chunk, "direct")
+            # k=1 'direct' tiles are (chunk, 1, D): clamp by D, not k,
+            # so a hint-oversized single chunk can't stage a chunk x D
+            # transform tile (ShardedDataset.effective_chunk).
+            step_exact, _ = _get_step_fns(mesh, ds.effective_chunk(ds.d),
+                                          "direct")
             stats = step_exact(ds.points, ds.weights, mean)
             sse[0] = float(np.asarray(stats.sse_per_cluster, np.float64)[0])
             wsize[0] = c

@@ -251,6 +251,19 @@ class GaussianMixture:
         _, m = mesh_shape(self._resolve_mesh())
         return -(-self.n_components // m) * m
 
+    def _eff_chunk(self, ds) -> int:
+        """The dataset chunk, clamped for THIS model's tile footprint
+        (ShardedDataset.effective_chunk): a foreign dataset chunked with
+        a K-Means-sized ``k_hint`` must not materialize an oversized
+        (chunk, k[, D]) EM tile.  Same 'full'-covariance k*D scaling AND
+        the same EM_CHUNK_BUDGET as ``_dataset``'s own chunk choice —
+        the EM pass measured SMALLER tiles 2x faster (chunk-sizing note
+        in ``_dataset``), so the K-Means single-chunk budget must not
+        leak in through foreign datasets (r5 review)."""
+        eff_k = (self.n_components * ds.d
+                 if self.covariance_type == "full" else self.n_components)
+        return ds.effective_chunk(eff_k, EM_CHUNK_BUDGET)
+
     def _shift(self) -> np.ndarray:
         """The centering shift (data's global mean), zeros pre-fit."""
         s = getattr(self, "shift_", None)
@@ -537,8 +550,9 @@ class GaussianMixture:
         same documented class as the streamed-vs-in-memory comparison."""
         ds = self._dataset(X, sample_weight)
         mesh = self._resolve_mesh()
-        step_fn, _ = _get_fns(mesh, ds.chunk, self.covariance_type)
-        self._fit_chunk = ds.chunk
+        chunk = self._eff_chunk(ds)
+        step_fn, _ = _get_fns(mesh, chunk, self.covariance_type)
+        self._fit_chunk = chunk
         # Centering shift: the dataset's weighted global mean (see module
         # docstring).  One cheap GSPMD pass, fixed for the whole fit.
         self.shift_ = np.asarray(
@@ -982,11 +996,12 @@ class GaussianMixture:
             var0 = var0[: len(alive)]
             log_w0 = log_w0[: len(alive)]
         R_live = len(alive)
-        key = (mesh, ds.chunk, k, self.max_iter, float(self.tol),
+        chunk = self._eff_chunk(ds)
+        key = (mesh, chunk, k, self.max_iter, float(self.tol),
                float(self.reg_covar), ct, R_live, "gmmmultifit")
         fit_fn = _STEP_CACHE.get_or_create(
             key, lambda: make_gmm_multi_fit_fn(
-                mesh, chunk_size=ds.chunk, k_real=k,
+                mesh, chunk_size=chunk, k_real=k,
                 max_iter=self.max_iter, tol=float(self.tol),
                 reg_covar=float(self.reg_covar), cov_type=ct))
         means_out, var_out, log_w_out, n_it, hist, conv, best, lls = \
@@ -1050,10 +1065,11 @@ class GaussianMixture:
                    "tied": make_gmm_fit_tied_fn,
                    "full": make_gmm_fit_full_fn}[ct]
         kwargs = {"cov_type": ct} if ct in ("diag", "spherical") else {}
-        key = (mesh, ds.chunk, self.n_components, self.max_iter,
+        chunk = self._eff_chunk(ds)
+        key = (mesh, chunk, self.n_components, self.max_iter,
                float(self.tol), float(self.reg_covar), ct, "gmmfit")
         fit_fn = _STEP_CACHE.get_or_create(key, lambda: builder(
-            mesh, chunk_size=ds.chunk, k_real=self.n_components,
+            mesh, chunk_size=chunk, k_real=self.n_components,
             max_iter=self.max_iter, tol=float(self.tol),
             reg_covar=float(self.reg_covar), **kwargs))
         k = self.n_components
@@ -1118,7 +1134,8 @@ class GaussianMixture:
         self._check_fitted()
         ds = self._dataset(X)
         mesh = self._resolve_mesh()
-        _, predict_fn = _get_fns(mesh, ds.chunk, self.covariance_type)
+        _, predict_fn = _get_fns(mesh, self._eff_chunk(ds),
+                                 self.covariance_type)
         labels, logr, lse = predict_fn(ds.points, *self._params_dev(mesh))
         k = self.n_components
         return (np.asarray(labels)[: ds.n],

@@ -290,10 +290,23 @@ class KMeans:
             self.mesh = make_mesh(model=self.model_shards)
         return self.mesh
 
+    def _tile_k(self, n: int, d: int) -> int:
+        """The per-row tile width the scan stages for this model: k for
+        the matmul/pallas forms, k*D for 'direct' (its (chunk, k, D)
+        difference tensor, ops/assign.py) — the width every chunk
+        budget/clamp must be computed against (r5 review)."""
+        return self.k * d if self._mode(n, d) == "direct" else self.k
+
     def _chunk_for(self, n: int, d: int) -> int:
         data_shards, model_shards = mesh_shape(self._resolve_mesh())
         return self.chunk_size or choose_chunk_size(
-            -(-n // data_shards), max(self.k, model_shards), d)
+            -(-n // data_shards), max(self._tile_k(n, d), model_shards), d)
+
+    def _eff_chunk(self, ds) -> int:
+        """The dataset's chunk, clamped for this model's tile width
+        (ShardedDataset.effective_chunk) — guards fits against datasets
+        whose load-time k_hint undershot the real k."""
+        return ds.effective_chunk(self._tile_k(ds.n, ds.d))
 
     def _setup(self, n: int, d: int):
         """Resolve mesh + chunk + step functions WITHOUT moving any data."""
@@ -335,11 +348,13 @@ class KMeans:
 
         Step functions are built for the dataset's OWN chunk size (its
         padding commits to it), which may differ from what ``_chunk_for``
-        would pick for this model's k."""
+        would pick for this model's k — clamped to a safe divisor when
+        the load-time k_hint undershot this model's k
+        (ShardedDataset.effective_chunk)."""
         ds = self._dataset(X)
         mesh = self._resolve_mesh()
         _, model_shards = mesh_shape(mesh)
-        step_fn, predict_fn = _get_step_fns(mesh, ds.chunk,
+        step_fn, predict_fn = _get_step_fns(mesh, self._eff_chunk(ds),
                                             self._mode(ds.n, ds.d))
         return ds, mesh, model_shards, step_fn, predict_fn
 
@@ -490,8 +505,9 @@ class KMeans:
         rtt = _dispatch_rtt(mesh)
         if rtt <= 5e-3:
             return True
-        key = (mesh, ds.chunk, self._mode(ds.n, ds.d), self.k,
-               np.dtype(self.dtype).str, tuple(ds.points.shape), "autoloop")
+        key = (mesh, self._eff_chunk(ds), self._mode(ds.n, ds.d),
+               self.k, np.dtype(self.dtype).str, tuple(ds.points.shape),
+               "autoloop")
 
         def measure_step():
             cents = self._put_centroids(
@@ -991,11 +1007,12 @@ class KMeans:
         # Seeds travel as a traced ARGUMENT (not a baked constant), so
         # fits differing only by seed/start_iter — restarts, bisecting
         # splits, resumes — reuse one compiled program.
-        key = (mesh, ds.chunk, mode, self.k, iters_left,
+        chunk = self._eff_chunk(ds)
+        key = (mesh, chunk, mode, self.k, iters_left,
                float(self.tolerance), self.empty_cluster, self.compute_sse,
                "fit")
         fit_fn = _STEP_CACHE.get_or_create(key, lambda: dist.make_fit_fn(
-            mesh, chunk_size=ds.chunk, mode=mode,
+            mesh, chunk_size=chunk, mode=mode,
             k_real=self.k, max_iter=iters_left,
             tolerance=float(self.tolerance),
             empty_policy=self.empty_cluster,
@@ -1051,12 +1068,13 @@ class KMeans:
         true final inertia — is selected on device too."""
         R = len(seeds)
         mode = self._mode(ds.n, ds.d)
-        key = (mesh, ds.chunk, mode, self.k, self.max_iter,
+        chunk = self._eff_chunk(ds)
+        key = (mesh, chunk, mode, self.k, self.max_iter,
                float(self.tolerance), self.empty_cluster, R,
                self.compute_sse, "multifit")
         fit_fn = _STEP_CACHE.get_or_create(
             key, lambda: dist.make_multi_fit_fn(
-                mesh, chunk_size=ds.chunk, mode=mode,
+                mesh, chunk_size=chunk, mode=mode,
                 k_real=self.k, max_iter=self.max_iter,
                 tolerance=float(self.tolerance),
                 empty_policy=self.empty_cluster, n_init=R,

@@ -50,24 +50,92 @@ def _gumbel_rows(points, weights, seed, m: int):
     return rows
 
 
+#: Below this many (n_local * k) elements the whole local shard runs as
+#: ONE chunk — no scan at all.  Measured on TPU v5e (experiments/
+#: exp_small_shapes.py, r5): at blobs1m (1M x 16, k=64) the single-chunk
+#: pass is 1.72x faster than the 2^17-capped scan (0.337 vs 0.580
+#: ms/iter), and the shapes that already ran single-chunk (100k x 10 k=5,
+#: 60k x 784 k=10) beat every chunked variant.  2^26 f32 elements is a
+#: 256 MB distance matrix — trivially resident on a 16 GB chip; batched
+#: n_init multiplies the temporaries by R (a vmapped (R, n, k) matmul),
+#: still < 3 GB at R=10 in this region.  Set ``chunk_size`` explicitly
+#: to override (e.g. extreme R on a memory-constrained chip).
+SINGLE_CHUNK_ELEMS = 1 << 26
+
+
 def choose_chunk_size(n_local: int, k: int, d: int,
-                      budget_elems: int = 1 << 25,
+                      budget_elems: Optional[int] = None,
                       max_chunk: int = 1 << 17) -> int:
     """Pick the scan chunk size for the fused assign+reduce pass.
 
-    Measured on TPU v5e (N=2M, D=128, k=1024): per-pass cost falls
-    monotonically from 14.6 ms at chunk=2048 to a ~10.6 ms plateau at
-    chunk=32768..131072, then degrades again at >=512k — larger chunks
-    amortize scan/loop overhead while XLA tiles the (chunk, k) distance
-    matrix internally regardless of the scan granularity.  The default
-    budget of 2^25 tile elements puts k=1024 at the 32768-chunk plateau;
-    ``max_chunk`` caps low-k configs so the scan still bounds live HBM
-    temporaries.  Rounded to a multiple of 8 (f32 sublane), at least 128
-    (lane width), so tiles map cleanly onto the TPU's (8, 128) layout.
+    Two measured regimes (experiments/exp_small_shapes.py has the r5
+    sweep; the r3 plateau measurement is below):
+
+    * ``n_local * k <= SINGLE_CHUNK_ELEMS`` at the DEFAULT budget:
+      return one whole-shard chunk — the scan exists only to bound live
+      (chunk, k) HBM temporaries, and in this region the unbounded
+      temporary is small enough that eliding the loop wins outright
+      (1.72x at 1M x 16 k=64).  The chunk is ``n_local`` rounded UP to
+      the f32 sublane multiple, so the padded shard is exactly one
+      chunk.  Callers passing an explicit ``budget_elems`` (the EM
+      paths: ``models.gmm.EM_CHUNK_BUDGET``) opt OUT of the shortcut —
+      EM measured the opposite direction (smaller tiles beat larger
+      ones 2x at 2M x 128 k=256, models/gmm.py), so the K-Means
+      single-chunk result must not be extrapolated onto it.
+
+    * Otherwise, scan: measured on TPU v5e (N=2M, D=128, k=1024),
+      per-pass cost falls monotonically from 14.6 ms at chunk=2048 to a
+      ~10.6 ms plateau at chunk=32768..131072, then degrades again at
+      >=512k — larger chunks amortize scan/loop overhead while XLA
+      tiles the (chunk, k) distance matrix internally regardless of the
+      scan granularity.  The default budget of 2^25 tile elements puts
+      k=1024 at the 32768-chunk plateau; ``max_chunk`` caps low-k
+      configs so the scan still bounds live HBM temporaries.  Rounded
+      to a multiple of 8 (f32 sublane), at least 128 (lane width), so
+      tiles map cleanly onto the TPU's (8, 128) layout.
     """
+    if budget_elems is None:
+        if n_local * max(k, 1) <= SINGLE_CHUNK_ELEMS:
+            return int(max(128, -(-n_local // 8) * 8))
+        budget_elems = 1 << 25
     chunk = max(128, min(n_local, budget_elems // max(k, 1), max_chunk))
     chunk = min(chunk, max(n_local, 128))
     return int(max(8, (chunk // 8) * 8))
+
+
+def clamp_chunk_for_k(chunk: int, k: int,
+                      budget_elems: int = SINGLE_CHUNK_ELEMS) -> int:
+    """Bound the (chunk, k) fit-time temporary when the REAL k exceeds
+    the ``k_hint`` a dataset's chunk was auto-chosen with (r5 review
+    finding): a ``from_npy(..., k_hint=16)`` load of a 4M-row shard gets
+    a whole-shard single chunk under the SINGLE_CHUNK_ELEMS shortcut,
+    and a later ``KMeans(k=1024).fit(ds)`` would materialize a
+    (4M, 1024) distance tile (~16 GB) — the old 2^17 row cap bounded
+    that mismatch; this clamp restores the bound using the fitted k.
+
+    Returns the largest multiple-of-8 DIVISOR of ``chunk`` whose
+    (chunk', k) tile fits ``budget_elems`` — a divisor, because the
+    dataset's padding committed to whole-``chunk`` multiples per shard
+    (shard_points), so only divisors re-chunk without re-padding.
+    No-op when the tile already fits (every auto-chosen chunk whose
+    hint matched the fitted k), and when ``chunk`` is not a multiple of
+    8 — an explicit user ``chunk_size`` outside the auto rule's 8-row
+    grid must pass through untouched, because only true divisors of the
+    committed chunk re-chunk safely and ``chunk // 8`` would silently
+    floor it."""
+    if chunk * max(k, 1) <= budget_elems or chunk <= 8 or chunk % 8:
+        return chunk
+    target = max(8, budget_elems // max(k, 1))
+    base = chunk // 8
+    best = 1
+    i = 1
+    while i * i <= base:
+        if base % i == 0:
+            for cand in (i, base // i):
+                if cand * 8 <= target and cand > best:
+                    best = cand
+        i += 1
+    return best * 8
 
 
 def pad_points(x: np.ndarray, multiple: int) -> Tuple[np.ndarray, np.ndarray]:
@@ -153,6 +221,18 @@ class ShardedDataset:
     @property
     def dtype(self):
         return np.dtype(str(self.points.dtype))
+
+    def effective_chunk(self, k: int,
+                        budget_elems: int = SINGLE_CHUNK_ELEMS) -> int:
+        """The chunk fits should scan this dataset with for a model of
+        ``k`` clusters/components: ``self.chunk`` unless that would
+        materialize an oversized (chunk, k) tile because the load-time
+        ``k_hint`` undershot the real k — then the largest safe divisor
+        (clamp_chunk_for_k).  Models pass their real TILE width here —
+        k, or k*D for modes staging (chunk, k, D) tensors — instead of
+        reading ``.chunk`` directly; EM callers pass their own measured
+        ``budget_elems`` (models.gmm.EM_CHUNK_BUDGET)."""
+        return clamp_chunk_for_k(self.chunk, k, budget_elems)
 
     @property
     def labelable(self) -> bool:

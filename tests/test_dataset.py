@@ -60,3 +60,62 @@ def test_dataset_take(data, mesh8):
     # Device-only gather path (host reference dropped).
     ds._host = None
     np.testing.assert_allclose(ds.take(idx), data[idx])
+
+
+# --------------------------------------------------------- chunk sizing (r5)
+
+def test_choose_chunk_size_regions():
+    """The r5 single-chunk shortcut (experiments/exp_small_shapes.py:
+    1.72x at 1M x 16 k=64) and the unchanged scan regions."""
+    from kmeans_tpu.parallel.sharding import choose_chunk_size
+    # Single-chunk region: n*k <= 2^26 -> whole shard, rounded up to 8.
+    assert choose_chunk_size(1_000_000, 64, 16) == 1_000_000
+    assert choose_chunk_size(999_999, 64, 16) == 1_000_000
+    # Scan regions unchanged: headline and high-k shapes.
+    assert choose_chunk_size(10_000_000, 1024, 128) == 32768
+    assert choose_chunk_size(400_000, 3000, 100) == (1 << 25) // 3000 // 8 * 8
+    # Explicit budget (the EM paths) opts OUT of the shortcut.
+    assert choose_chunk_size(1_000_000, 64, 16,
+                             budget_elems=1 << 23) == 131072
+    # Tiny inputs keep the 128-row floor.
+    assert choose_chunk_size(5, 5, 2) == 128
+
+
+def test_clamp_chunk_for_k_divisor_property():
+    """clamp_chunk_for_k returns a multiple-of-8 divisor within budget —
+    the guard against load-time k_hint undershooting the fitted k
+    (r5 review finding)."""
+    from kmeans_tpu.parallel.sharding import clamp_chunk_for_k
+    # No-op when the tile fits.
+    assert clamp_chunk_for_k(1_000_000, 64) == 1_000_000
+    # Mis-hinted: 4M-row chunk fitted with k=1024 must shrink to the
+    # LARGEST divisor with chunk*k <= 2^26 — not merely any divisor
+    # (the r5 review caught a units bug returning 6400 here).
+    assert clamp_chunk_for_k(4_000_000, 1024) == 50_000
+    assert clamp_chunk_for_k(1 << 20, 1024) == 1 << 16
+    # Non-multiple-of-8 explicit chunks pass through untouched (only
+    # true divisors of the committed chunk re-chunk safely).
+    assert clamp_chunk_for_k(1_000_004, 1024) == 1_000_004
+    # Awkward row counts still yield the largest legal divisor (>= 8).
+    for chunk in (999_992, 777_768, 123_456_008):
+        c = clamp_chunk_for_k(chunk, 4096, budget_elems=1 << 20)
+        assert chunk % c == 0 and c % 8 == 0
+        assert c * 4096 <= max(1 << 20, 8 * 4096)
+        # Largest: no bigger multiple-of-8 divisor fits the budget.
+        bigger = [v for v in range(c + 8, chunk + 1, 8)
+                  if chunk % v == 0 and v * 4096 <= 1 << 20]
+        assert not bigger
+
+
+def test_mis_hinted_dataset_fit_matches(data, mesh8, tmp_path):
+    """A dataset loaded with a too-small k_hint still fits correctly
+    (the clamp changes only tiling, never results)."""
+    from kmeans_tpu.data.io import from_npy
+    p = tmp_path / "x.npy"
+    np.save(p, data.astype(np.float64))
+    ds = from_npy(p, mesh8, k_hint=1, dtype=np.float64)
+    km_a = KMeans(k=4, seed=1, mesh=mesh8, dtype=np.float64,
+                  verbose=False).fit(ds)
+    km_b = KMeans(k=4, seed=1, mesh=mesh8, dtype=np.float64,
+                  verbose=False).fit(data)
+    np.testing.assert_allclose(km_a.centroids, km_b.centroids)
