@@ -33,7 +33,8 @@ from kmeans_tpu.parallel.sharding import (ShardedDataset, choose_chunk_size,
 def _sharded_from_source(read_rows, n: int, d: int, mesh: Mesh,
                          chunk: int, dtype,
                          sample_weight: Optional[np.ndarray],
-                         host_handle) -> ShardedDataset:
+                         host_handle,
+                         explicit_chunk: bool = False) -> ShardedDataset:
     """Build a ShardedDataset whose shards pull rows via ``read_rows(lo, hi)``
     — each callback materializes only its own slice."""
     data_shards, _ = mesh_shape(mesh)
@@ -74,7 +75,8 @@ def _sharded_from_source(read_rows, n: int, d: int, mesh: Mesh,
     points = jax.make_array_from_callback((n_pad, d), x_sharding, x_cb)
     weights = jax.make_array_from_callback((n_pad,), w_sharding, w_cb)
     return ShardedDataset(points, weights, n, chunk, mesh,
-                          host=host_handle, host_weights=sw)
+                          host=host_handle, host_weights=sw,
+                          explicit_chunk=explicit_chunk)
 
 
 def _resolve_chunk(n: int, d: int, k_hint: int, mesh: Mesh,
@@ -111,14 +113,16 @@ def from_npy(path, mesh: Mesh, *, chunk_size: Optional[int] = None,
     if mesh is None:
         return to_device(np.asarray(mm, dtype=dtype), None,
                          chunk_size or choose_chunk_size(n, k_hint, d),
-                         dtype, sample_weight=sample_weight)
+                         dtype, sample_weight=sample_weight,
+                         explicit=chunk_size is not None)
     chunk = _resolve_chunk(n, d, k_hint, mesh, chunk_size, budget_elems)
 
     def read_rows(lo: int, hi: int) -> np.ndarray:
         return np.asarray(mm[lo:hi], dtype=dtype)
 
     return _sharded_from_source(read_rows, n, d, mesh, chunk, dtype,
-                                sample_weight, host_handle=mm)
+                                sample_weight, host_handle=mm,
+                                explicit_chunk=chunk_size is not None)
 
 
 def from_raw(path, shape: Tuple[int, int], mesh: Mesh, *,
@@ -136,14 +140,16 @@ def from_raw(path, shape: Tuple[int, int], mesh: Mesh, *,
     if mesh is None:
         return to_device(np.asarray(mm, dtype=dtype), None,
                          chunk_size or choose_chunk_size(n, k_hint, d),
-                         dtype, sample_weight=sample_weight)
+                         dtype, sample_weight=sample_weight,
+                         explicit=chunk_size is not None)
     chunk = _resolve_chunk(n, d, k_hint, mesh, chunk_size, budget_elems)
 
     def read_rows(lo: int, hi: int) -> np.ndarray:
         return np.asarray(mm[lo:hi], dtype=dtype)
 
     return _sharded_from_source(read_rows, n, d, mesh, chunk, dtype,
-                                sample_weight, host_handle=mm)
+                                sample_weight, host_handle=mm,
+                                explicit_chunk=chunk_size is not None)
 
 
 def iter_npy_blocks(path, block_rows: int, *, dtype=None):

@@ -326,7 +326,8 @@ class KMeans:
             raise ValueError(f"X must be 2-D (n, D), got shape {X.shape}")
         return to_device(X, self._resolve_mesh(),
                          self._chunk_for(*X.shape), self.dtype,
-                         sample_weight=sample_weight)
+                         sample_weight=sample_weight,
+                         explicit=self.chunk_size is not None)
 
     def _dataset(self, X) -> ShardedDataset:
         """Accept an (n, D) array-like or an already-cached ShardedDataset."""

@@ -118,12 +118,16 @@ def clamp_chunk_for_k(chunk: int, k: int,
     dataset's padding committed to whole-``chunk`` multiples per shard
     (shard_points), so only divisors re-chunk without re-padding.
     No-op when the tile already fits (every auto-chosen chunk whose
-    hint matched the fitted k), and when ``chunk`` is not a multiple of
-    8 — an explicit user ``chunk_size`` outside the auto rule's 8-row
-    grid must pass through untouched, because only true divisors of the
-    committed chunk re-chunk safely and ``chunk // 8`` would silently
-    floor it."""
-    if chunk * max(k, 1) <= budget_elems or chunk <= 8 or chunk % 8:
+    hint matched the fitted k); when ``chunk`` is already at or below
+    the 128-row floor ``choose_chunk_size`` enforces — clamping below
+    it would re-shrink chunks the auto rule DELIBERATELY floored (a
+    k=1024 full-covariance GMM on D=1024 data floors at 128; clamping
+    to the pure budget would scan 8-row tiles, r5 review); and when
+    ``chunk`` is not a multiple of 8 — an explicit user ``chunk_size``
+    outside the auto rule's 8-row grid must pass through untouched,
+    because only true divisors of the committed chunk re-chunk safely
+    and ``chunk // 8`` would silently floor it."""
+    if chunk * max(k, 1) <= budget_elems or chunk <= 128 or chunk % 8:
         return chunk
     target = max(8, budget_elems // max(k, 1))
     base = chunk // 8
@@ -200,13 +204,19 @@ class ShardedDataset:
                  chunk: int, mesh: Optional[Mesh],
                  host: Optional[np.ndarray] = None,
                  host_weights: Optional[np.ndarray] = None,
-                 local_rows: Optional[int] = None):
+                 local_rows: Optional[int] = None,
+                 explicit_chunk: bool = False):
         self.points = points
         self.weights = weights
         self.n = n
         self.d = points.shape[1]
         self.chunk = chunk
         self.mesh = mesh
+        # True when the chunk came from a user-supplied ``chunk_size``
+        # (loader kwarg or model attribute) rather than the auto rule:
+        # fits must then honor it verbatim — the documented escape
+        # hatch from the auto rule — so ``effective_chunk`` no-ops.
+        self.explicit_chunk = explicit_chunk
         self._host = host
         self._host_weights = host_weights
         # REAL rows THIS process contributed (multi-host process-local
@@ -231,7 +241,11 @@ class ShardedDataset:
         (clamp_chunk_for_k).  Models pass their real TILE width here —
         k, or k*D for modes staging (chunk, k, D) tensors — instead of
         reading ``.chunk`` directly; EM callers pass their own measured
-        ``budget_elems`` (models.gmm.EM_CHUNK_BUDGET)."""
+        ``budget_elems`` (models.gmm.EM_CHUNK_BUDGET).  An EXPLICIT
+        user chunk (loader/model ``chunk_size``) passes through
+        untouched — it is the documented override."""
+        if self.explicit_chunk:
+            return self.chunk
         return clamp_chunk_for_k(self.chunk, k, budget_elems)
 
     @property
@@ -334,7 +348,8 @@ class ShardedDataset:
             w_dev = jax.device_put(
                 w_pad, NamedSharding(self.mesh, P(DATA_AXIS)))
         return ShardedDataset(self.points, w_dev, self.n, self.chunk,
-                              self.mesh, host=self._host, host_weights=sw)
+                              self.mesh, host=self._host, host_weights=sw,
+                              explicit_chunk=self.explicit_chunk)
 
     def reshard(self, mesh: Optional[Mesh],
                 chunk: Optional[int] = None) -> "ShardedDataset":
@@ -346,11 +361,12 @@ class ShardedDataset:
         host = self._host if self._host is not None else \
             np.asarray(self.points)[: self.n]
         return to_device(host, mesh, chunk or self.chunk, self.dtype,
-                         sample_weight=self._host_weights)
+                         sample_weight=self._host_weights,
+                         explicit=(chunk is not None) or self.explicit_chunk)
 
 
 def to_device(X, mesh: Optional[Mesh], chunk: int, dtype,
-              sample_weight=None) -> ShardedDataset:
+              sample_weight=None, explicit: bool = False) -> ShardedDataset:
     """Upload (n, D) host data once; pass-through if already a ShardedDataset
     on a compatible (mesh, chunk).
 
@@ -376,7 +392,7 @@ def to_device(X, mesh: Optional[Mesh], chunk: int, dtype,
         sw = _validate_sample_weight(sample_weight, X.shape[0], X.dtype)
     points, weights = shard_points(X, mesh, chunk, sample_weight=sw)
     return ShardedDataset(points, weights, X.shape[0], chunk, mesh, host=X,
-                          host_weights=sw)
+                          host_weights=sw, explicit_chunk=explicit)
 
 
 def global_sample_rows(x_source: np.ndarray, n_rows: int, k: int,
@@ -447,7 +463,8 @@ def from_process_local(X_local, mesh: Mesh, *,
         chunk = chunk_size or choose_chunk_size(
             -(-n_local // max(1, data_shards)), k_hint, d)
         return to_device(X_local, mesh, chunk, dtype,
-                         sample_weight=sample_weight)
+                         sample_weight=sample_weight,
+                         explicit=chunk_size is not None)
 
     from jax.experimental import multihost_utils
     nproc = jax.process_count()
@@ -480,4 +497,5 @@ def from_process_local(X_local, mesh: Mesh, *,
     w = jax.make_array_from_process_local_data(
         NamedSharding(mesh, P(DATA_AXIS)), w_pad, (n_pad_global,))
     return ShardedDataset(pts, w, n_global, chunk, mesh,
-                          local_rows=n_local)
+                          local_rows=n_local,
+                          explicit_chunk=chunk_size is not None)

@@ -119,3 +119,29 @@ def test_mis_hinted_dataset_fit_matches(data, mesh8, tmp_path):
     km_b = KMeans(k=4, seed=1, mesh=mesh8, dtype=np.float64,
                   verbose=False).fit(data)
     np.testing.assert_allclose(km_a.centroids, km_b.centroids)
+
+
+def test_explicit_chunk_passes_through(data, mesh8):
+    """A user-supplied chunk_size is the documented override: fits must
+    honor it verbatim, never clamp it (r5 review)."""
+    km = KMeans(k=4, seed=1, mesh=mesh8, dtype=np.float64,
+                chunk_size=320, verbose=False)
+    ds = km.cache(data)
+    assert ds.explicit_chunk and ds.chunk == 320
+    assert ds.effective_chunk(10 ** 9) == 320      # huge k: still honored
+    # Auto-chunked datasets are clamped for huge k (with a floor).
+    ds_auto = KMeans(k=4, mesh=mesh8, dtype=np.float64,
+                     verbose=False).cache(data)
+    assert not ds_auto.explicit_chunk
+    # with_weights shares placement AND the explicit flag.
+    assert ds.with_weights(np.ones(len(data))).explicit_chunk
+
+
+def test_clamp_noop_at_the_row_floor():
+    """clamp_chunk_for_k must not shrink chunks at/below the 128-row
+    floor choose_chunk_size deliberately enforces (r5 review: a full-
+    covariance GMM with k*D > budget/128 floors at 128 and must stay
+    there, not scan 8-row tiles)."""
+    from kmeans_tpu.parallel.sharding import clamp_chunk_for_k
+    assert clamp_chunk_for_k(128, 256 * 512, 1 << 23) == 128
+    assert clamp_chunk_for_k(128, 1024 * 1024, 1 << 23) == 128
