@@ -3,7 +3,7 @@
 3.5 ms, a ~1000x scheduling gap) predates ``choose_tiles(fold=...)``;
 and the XLA EM step's 14.2 ms/iter ~19% MFU accounting says the real
 cost driver is the two moment matmuls pinned at ``Precision.HIGHEST``
-(~3x MXU passes each, the price of variances that survive
+(the 6-pass bf16_6x split, the price of variances that survive
 ``S2/R - mu^2`` cancellation — parallel/gmm_step.py:105-116).
 
 Three measured questions, each with a decision rule:
@@ -29,6 +29,34 @@ Shape: N=2M x D=128, k=256 diag (the published 14.2 ms/iter config,
 docs/PERFORMANCE.md "The mixture family").
 
 Run on TPU hardware:  python experiments/exp_gmm_estep_retry.py
+
+MEASURED (TPU v5e via tunnel, 2026-07-31):
+
+  precision ladder (full E-pass, marginal, chunk sweep at each):
+                 16384     32768     65536     131072   var_err(25sig)
+    HIGHEST     14.34     13.79     20.06     28.56     3.024e-2
+    HIGH         9.69      9.01     14.78     27.23     3.024e-2
+    DEFAULT      8.15      7.29     13.11     27.27     4.126e-2
+
+  1. HIGH is INDISTINGUISHABLE from HIGHEST on the r3 failure probe
+     (3.024e-2 vs 3.024e-2 max relative variance error — the probe's
+     own sampling noise at n=262144) and 1.53x faster -> WIRED into
+     _estep_tile (gmm_step.py).  DEFAULT degrades the probe (4.1e-2,
+     still under the 5% bar but a real ~2.8e-2 marginal error) for
+     only 1.24x more -> stays rejected.  Full/tied scatter moments
+     keep HIGHEST: this ladder only probed the diag moment structure.
+     Shipped-loop effect: 14.2 -> 8.37 ms/iter (~33% MFU) measured on
+     the full device EM fit at this shape.
+  2. Chunk 32768 stays optimal at EVERY precision (16384 within 8%,
+     65536+ collapses) — the r3 2^23-element budget rule is refreshed,
+     no change.
+  3. The r3 Pallas kernel under the r4 tile_n=1024: 3350 -> 4.16 ms
+     per 524288-row E-pass (chained marginal) — the r3 rejection was a
+     TILE-RULE artifact, not kernel structure.  Still 1.2x behind the
+     HIGHEST XLA pass and ~1.8x behind the newly-wired HIGH pass at
+     the same size (the kernel serializes softmax against the moment
+     matmuls that XLA overlaps) -> rejection REFRESHED with the gap
+     explained; the r3 tile rule (13.66 ms) is retired either way.
 """
 
 import sys

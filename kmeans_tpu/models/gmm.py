@@ -244,7 +244,8 @@ class GaussianMixture:
             -(-X.shape[0] // data_shards), eff_k, X.shape[1],
             budget_elems=EM_CHUNK_BUDGET)
         return to_device(X, mesh, chunk, self.dtype,
-                         sample_weight=sample_weight)
+                         sample_weight=sample_weight,
+                         explicit=self.chunk_size is not None)
 
     @property
     def _k_pad(self) -> int:

@@ -102,18 +102,24 @@ def _estep_tile(x, w, means, inv_var, log_det, log_weights,
     # Weighted responsibilities via the shared cross-model-axis softmax
     # (one implementation for every covariance type).
     resp, lse = _softmax_resp(logp, w, model_shards)
-    # Moment accumulators run at HIGHEST matmul precision: on TPU, "f32"
+    # Moment accumulators run at HIGH matmul precision: on TPU, "f32"
     # dots execute with bf16-rounded products by default (fine for the
     # responsibility softmax above — relative logp error ~2^-8 barely
     # moves a softmax), but the M-step's variance is the DIFFERENCE
     # S2/R - mu^2, which survives only while |mu|/sigma < ~sqrt(2^8) ~ 16
     # per dim under bf16 products.  Clusters offset ~25 sigma from the
     # global mean collapsed to reg_covar on hardware (r3, found driving
-    # the v5e; invisible on CPU where f32 dots are exact).  HIGHEST
-    # (3-pass bf16 ~ true f32) restores the CPU bound (~2^12 sigma) for
-    # the two moment matmuls only — ~2x the E-step's MXU work, the price
-    # of correct covariances in the matmul formulation.
-    hi = lax.Precision.HIGHEST
+    # the v5e; invisible on CPU where f32 dots are exact).  r3 pinned
+    # HIGHEST (the 6-pass bf16_6x split ~ true f32); the r5 precision
+    # ladder (experiments/exp_gmm_estep_retry.py, real v5e) measured
+    # HIGH (the 3-pass bf16_3x split) INDISTINGUISHABLE from HIGHEST on
+    # the r3 failure shape (25+ sigma offsets: max relative variance
+    # error 3.024e-2 vs 3.024e-2 — the probe's own sampling noise)
+    # while cutting the full E-pass 13.79 -> 9.01 ms at 2M x 128 k=256
+    # (20 -> 31% MFU); DEFAULT (one bf16-product pass) degrades the
+    # probe to 4.1e-2 and stays rejected.  HIGH it is — for the two
+    # moment matmuls only.
+    hi = lax.Precision.HIGH
     return EStats(
         resp_sum=jnp.sum(resp, axis=0),
         xsum=lax.dot_general(resp, x, (((0,), (0,)), ((), ())),

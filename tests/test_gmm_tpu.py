@@ -6,8 +6,11 @@ difference S2/R - mu^2 once a cluster's mean sits more than ~16 sigma
 from the centering shift (r3: covariances collapsed to reg_covar and
 the log-likelihood went POSITIVE via the density-spike singularity,
 found only by driving the chip — the CPU suite computes exact f32 dots
-and cannot see it).  The moment matmuls now run at Precision.HIGHEST
-(gmm_step._estep_tile); this pins that on hardware.
+and cannot see it).  The moment matmuls now run at Precision.HIGH —
+r3 pinned HIGHEST; the r5 ladder (experiments/exp_gmm_estep_retry.py)
+measured HIGH indistinguishable on the failure shape and 1.53x faster
+(gmm_step._estep_tile) — and this pins the survival bound on hardware
+either way.
 """
 
 import jax
