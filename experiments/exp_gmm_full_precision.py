@@ -1,0 +1,193 @@
+"""FULL-covariance moment-precision ladder (r5 follow-up to
+exp_gmm_estep_retry.py): the diag ladder measured HIGH (3-pass bf16_3x)
+indistinguishable from HIGHEST (6-pass bf16_6x ~ f32) on the r3
+variance-collapse shape and 1.53x faster, and it was wired into
+_estep_tile.  The FULL-covariance scatter moment
+(``einsum('ck,cd,ce->kde')``, parallel/gmm_step._scan_estats_full) kept
+HIGHEST because its cancellation structure — the covariance is
+``scatter/R - mu mu^T`` — was NOT probed.  This experiment probes it.
+
+Two measured questions, same decision rules as the diag ladder:
+
+1. **Covariance-survival probe** at each precision: the r3 failure
+   shape (clusters offset up to ~50 sigma from the centering shift,
+   true covariance 4*I), one E-pass with perfectly-specified
+   parameters, then ``C_k = scatter_k/r_k - mu_k mu_k^T``.  PASS =
+   every diagonal within 5% of truth AND max |off-diagonal| within 5%
+   of the true variance (the full-covariance failure mode has an extra
+   axis: off-diagonal garbage, not just diagonal collapse).  If HIGH
+   passes at HIGHEST-equivalent error, wire HIGH into the scatter/xsum
+   moments of ``_scan_estats_full`` (the tied path's per-fit total
+   scatter is loop-INVARIANT — one pass per fit, no per-iteration
+   speedup to claim — and stays HIGHEST either way).
+
+2. **Timing ladder**: marginal ms per full E-pass at N=1M x D=64,
+   k=32 full components (tile width k*D = 2048 -> EM-budget chunk
+   4096), whole chain in one dispatch, gap ramped to a ~1.5 s big
+   chain (the r5 harness rule).
+
+Run on TPU hardware:  python experiments/exp_gmm_full_precision.py
+(measured results are appended below after the run — decision rules
+above are committed BEFORE measuring).
+"""
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+N, D, K = 1_048_576, 64, 32
+PEAK_TFLOPS = 197.0
+# logp transform einsum 2*N*k*D^2 + scatter einsum 2*N*k*D^2 (+ small
+# xsum/quad terms, uncounted) per E-pass.
+REAL_TFLOP_PER_PASS = 4.0 * N * K * D * D / 1e12
+
+
+def estep_full_variant(x, w, means, prec_chol, log_det_half, log_w, *,
+                      chunk, precision):
+    """Chunked FULL-covariance E pass with configurable moment
+    precision (everything else identical to _scan_estats_full)."""
+    from kmeans_tpu.parallel.gmm_step import (_log_prob_full_chunk,
+                                              _softmax_resp)
+
+    k, d = means.shape
+    n_chunks = x.shape[0] // chunk
+    xs = (x.reshape(n_chunks, chunk, d), w.reshape(n_chunks, chunk))
+
+    def body(carry, ch):
+        xc, wc = ch
+        logp = _log_prob_full_chunk(xc, means, prec_chol, log_det_half,
+                                    log_w)
+        resp, lse = _softmax_resp(logp, wc, 1)
+        r, s1, sc, ll = carry
+        return (r + jnp.sum(resp, axis=0),
+                s1 + lax.dot_general(resp, xc, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=xc.dtype,
+                                     precision=precision),
+                sc + jnp.einsum("ck,cd,ce->kde", resp, xc, xc,
+                                preferred_element_type=xc.dtype,
+                                precision=precision),
+                ll + jnp.sum(jnp.where(wc > 0, lse * wc, 0.0))), None
+
+    init = (jnp.zeros((k,), x.dtype), jnp.zeros((k, d), x.dtype),
+            jnp.zeros((k, d, d), x.dtype), jnp.zeros((), x.dtype))
+    out, _ = lax.scan(body, init, xs)
+    return out
+
+
+def bench_pass(x, w, params, *, chunk, precision):
+    """Marginal ms/E-pass, whole chain in one dispatch, gap ramped to a
+    ~1.5 s big chain (the r5 harness rule)."""
+    from kmeans_tpu.benchmarks import measure_marginal
+
+    means, prec_chol, log_det_half, log_w = params
+
+    @jax.jit
+    def run(x, w, means, n_it):
+        def body(i, means):
+            r, s1, sc, ll = estep_full_variant(
+                x, w, means, prec_chol, log_det_half, log_w,
+                chunk=chunk, precision=precision)
+            # Every accumulator feeds the carry so nothing is DCE'd.
+            return means + 0.0 * (s1 / jnp.maximum(r, 1.0)[:, None]
+                                  + jnp.einsum("kdd->kd", sc) + ll)
+        return jnp.sum(lax.fori_loop(0, n_it, body, means))
+
+    def timed(n_it):
+        t0 = time.perf_counter()
+        float(run(x, w, means, n_it))
+        return time.perf_counter() - t0
+
+    timed(2)
+    t_small = timed(2)
+    gap, TARGET, CAP = 16, 1.5, 100_000
+    while True:
+        t_big = timed(2 + gap)
+        if t_big >= TARGET or gap >= CAP:
+            break
+        per_iter = max((t_big - t_small) / gap, 1e-9)
+        gap = int(min(CAP, min(gap * 25, max(TARGET / per_iter, gap * 5))))
+    margin, spread, _ = measure_marginal(
+        lambda: timed(2), lambda: timed(2 + gap), reps=5)
+    return margin / gap * 1e3, gap, spread
+
+
+def survival_probe(precision):
+    """r3 failure shape, full-covariance edition: one E-pass with
+    perfect parameters; returns (max diag rel err, max |offdiag|/var)."""
+    rng = np.random.default_rng(0)
+    n_small, k_small = 262_144, 8
+    true_var = 4.0
+    offsets = np.linspace(0, 50, k_small)
+    comp = rng.integers(0, k_small, n_small)
+    x_np = (offsets[comp][:, None] * np.sqrt(true_var)
+            + rng.normal(size=(n_small, D)) * np.sqrt(true_var))
+    x = jnp.asarray(x_np, jnp.float32)
+    w = jnp.ones((n_small,), jnp.float32)
+    shift = jnp.mean(x, axis=0)
+    means0 = np.asarray(offsets[:, None] * np.sqrt(true_var)
+                        * np.ones((k_small, D)), np.float32)
+    prec_chol = np.broadcast_to(
+        np.eye(D, dtype=np.float32) / np.sqrt(true_var),
+        (k_small, D, D)).copy()
+    log_det_half = np.full((k_small,), -0.5 * D * np.log(true_var),
+                           np.float32)
+    log_w = np.full((k_small,), -np.log(k_small), np.float32)
+    params = (jnp.asarray(means0) - shift[None, :], jnp.asarray(prec_chol),
+              jnp.asarray(log_det_half), jnp.asarray(log_w))
+
+    @jax.jit
+    def one_pass(xc, wc):
+        return estep_full_variant(xc - shift[None, :], wc, *params,
+                                  chunk=32_768, precision=precision)
+
+    r, s1, sc, _ = one_pass(x, w)
+    mu = np.asarray(s1 / r[:, None], np.float64)
+    C = np.asarray(sc / r[:, None, None], np.float64) \
+        - mu[:, :, None] * mu[:, None, :]
+    diag = np.diagonal(C, axis1=1, axis2=2)
+    diag_err = float(np.max(np.abs(diag - true_var) / true_var))
+    off = C.copy()
+    off[:, np.arange(D), np.arange(D)] = 0.0
+    offdiag_err = float(np.max(np.abs(off)) / true_var)
+    return diag_err, offdiag_err
+
+
+def main():
+    assert jax.default_backend() == "tpu", "run on TPU hardware"
+    from kmeans_tpu.models.gmm import EM_CHUNK_BUDGET
+    chunk = max(128, EM_CHUNK_BUDGET // (K * D) // 8 * 8)
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (N, D), jnp.float32)
+    w = jnp.ones((N,), jnp.float32)
+    rng = np.random.default_rng(1)
+    means = jnp.asarray(rng.normal(size=(K, D)), jnp.float32)
+    prec_chol = jnp.asarray(np.broadcast_to(
+        np.eye(D, dtype=np.float32), (K, D, D)).copy())
+    log_det_half = jnp.zeros((K,), jnp.float32)
+    log_w = jnp.full((K,), -np.log(K), jnp.float32)
+    params = (means, prec_chol, log_det_half, log_w)
+
+    print(f"shape: N={N} D={D} k={K} full, chunk={chunk}", flush=True)
+    for prec_name, prec in [("HIGHEST", lax.Precision.HIGHEST),
+                            ("HIGH", lax.Precision.HIGH),
+                            ("DEFAULT", lax.Precision.DEFAULT)]:
+        diag_err, off_err = survival_probe(prec)
+        print(f"  {prec_name:<8} probe: diag_err={diag_err:.2e} "
+              f"offdiag_err={off_err:.2e}", flush=True)
+        ms, gap, spread = bench_pass(x, w, params, chunk=chunk,
+                                     precision=prec)
+        mfu = REAL_TFLOP_PER_PASS / (ms / 1e3) / PEAK_TFLOPS
+        print(f"  {prec_name:<8} {ms:7.2f} ms/pass {mfu:5.1%} MFU "
+              f"(gap {gap}, spread {spread:.1%})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

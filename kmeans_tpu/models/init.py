@@ -139,14 +139,28 @@ def _weighted_kmeanspp_host(X: np.ndarray, w: np.ndarray, k: int,
         raise ValueError(
             f"Not enough data points ({int((w > 0).sum())}) to initialize "
             f"{k} clusters")
-    x = jnp.asarray(X)
     centers = np.empty((k, X.shape[1]), dtype=X.dtype)
     centers[0] = X[rng.choice(n, p=w / w.sum())]   # first draw ~ weights
-    mind2 = jnp.full((n,), jnp.inf, dtype=x.dtype)
+    # Small arrays (every kmeans|| reduction: ~10k candidate rows) run
+    # the distance maintenance in PURE numpy: the device path costs one
+    # device->host transfer PER DRAW, and on a tunneled platform that
+    # round trip is ~120 ms — 1023 draws made the k=1024 kmeans||
+    # reduce take 126 s while the numpy loop is milliseconds (r5,
+    # time-to-solution run).  Large arrays keep the device path: there
+    # the O(n*d) per-draw distance update dwarfs the transfer.
+    on_host = X.size <= (1 << 22)
+    x = X.astype(np.float64, copy=False) if on_host else jnp.asarray(X)
+    mind2 = (np.full((n,), np.inf) if on_host
+             else jnp.full((n,), jnp.inf, dtype=x.dtype))
     for i in range(1, k):
-        mind2 = _update_mind2(x, mind2, jnp.asarray(centers[i - 1]))
-        # D^2 weighting scaled by sample weights: p ~ w * mind2.
-        p = w * np.maximum(np.asarray(mind2, dtype=np.float64), 0.0)
+        if on_host:
+            diff = x - centers[i - 1].astype(np.float64)
+            mind2 = np.minimum(mind2, (diff * diff).sum(axis=1))
+            p = w * np.maximum(mind2, 0.0)
+        else:
+            mind2 = _update_mind2(x, mind2, jnp.asarray(centers[i - 1]))
+            # D^2 weighting scaled by sample weights: p ~ w * mind2.
+            p = w * np.maximum(np.asarray(mind2, dtype=np.float64), 0.0)
         total = p.sum()
         if not np.isfinite(total) or total <= 0:
             idx = rng.choice(n, p=w / w.sum())  # degenerate: coincident pts
@@ -249,14 +263,43 @@ def _parallel_round(weights, mind2, phi, key, ell, cap: int):
 
 @functools.partial(jax.jit, donate_argnums=(1,))
 def _fold_candidates(points, mind2, cands, valid):
-    """mind2 <- min(mind2, d²(points, c)) for each valid candidate row."""
-    def body(m, cv):
-        c, v = cv
-        d2 = jnp.sum((points - c[None, :]) ** 2, axis=1)
-        return jnp.where(v, jnp.minimum(m, d2), m), None
+    """mind2 <- min(mind2, d²(points, c)) over all valid candidate rows,
+    as ONE chunked matmul-form distance pass.
 
-    mind2, _ = jax.lax.scan(body, mind2, (cands, valid))
-    return mind2
+    r5 rewrite: the original scanned candidates one at a time, each step
+    broadcasting (points - c)² over the full array — a re-read of the
+    whole dataset PER CANDIDATE (10.5 TB of HBM traffic per round at
+    10M x 128 with the 2048-candidate cap; measured 348 s of k-means||
+    init in the time-to-solution run).  The matmul form reads points
+    once per round and puts the distance work on the MXU.  Invalid
+    candidate rows get ``+inf`` squared norms, so they can never win the
+    min — same semantics as the masked scan."""
+    from kmeans_tpu.ops.assign import pairwise_sq_dists
+
+    n, d = points.shape
+    cap = cands.shape[0]
+    # (chunk, cap) distance tile bounded at 2^23 elems; cap treated as
+    # >= 64 so a 1-candidate fold doesn't slice GB-scale windows.
+    chunk = int(min(n, max(128, (1 << 23) // max(cap, 64) // 8 * 8)))
+    n_chunks = -(-n // chunk)
+
+    def body(i, m):
+        # Clamped sliding window: the last window may overlap the
+        # previous one — min is idempotent, re-minning rows is free.
+        start = jnp.minimum(i * chunk, n - chunk)
+        zero = jnp.zeros((), start.dtype)
+        xc = jax.lax.dynamic_slice(points, (start, zero), (chunk, d))
+        mc = jax.lax.dynamic_slice(m, (start,), (chunk,))
+        # HIGHEST cross-term: the fold's answer is the distance VALUE —
+        # a covered point must read ~0, and bf16-rounded products would
+        # leave it |x||c|*2^-8 of sampling mass (see pairwise_sq_dists).
+        d2 = pairwise_sq_dists(xc, cands,
+                               precision=jax.lax.Precision.HIGHEST)
+        d2 = jnp.where(valid[None, :], d2, jnp.inf)
+        best = jnp.minimum(mc, jnp.min(d2, axis=1))
+        return jax.lax.dynamic_update_slice(m, best, (start,))
+
+    return jax.lax.fori_loop(0, n_chunks, body, mind2)
 
 
 def kmeans_parallel_init(X, k: int, seed: int, *, rounds: int = 5,
@@ -329,7 +372,10 @@ def kmeans_parallel_init(X, k: int, seed: int, *, rounds: int = 5,
 
     # Weight candidates by their nearest-candidate cell mass: one fused
     # pass of the SAME step kernel with candidates as "centroids".
-    chunk = 512
+    # Chunk by the shared budget rule — the old hardcoded 512 meant a
+    # ~19,500-step scan at the 10M headline (r5).
+    from kmeans_tpu.parallel.sharding import choose_chunk_size
+    chunk = choose_chunk_size(points.shape[0], len(cands), points.shape[1])
     pad = (-points.shape[0]) % chunk
     pts_pad = jnp.pad(points, ((0, pad), (0, 0)))
     w_pad = jnp.pad(weights, (0, pad))
@@ -469,8 +515,13 @@ def _stream_round_block(x, w, cands, phi_prev, ell, key, cap: int):
     compile once per round, not once per block length; unweighted
     streams pass the bare mask (w=1 on real rows)."""
     from kmeans_tpu.ops.assign import pairwise_sq_dists
+    # HIGHEST cross-term for the same reason as _fold_candidates: the
+    # D^2 VALUE is the sampling mass, and bf16 products would leave
+    # covered rows |x||c|*2^-8 instead of ~0.
     d2 = jnp.maximum(
-        jnp.min(pairwise_sq_dists(x, cands, mode="matmul"), axis=1), 0.0)
+        jnp.min(pairwise_sq_dists(x, cands, mode="matmul",
+                                  precision=jax.lax.Precision.HIGHEST),
+                axis=1), 0.0)
     d2w = d2 * w                                   # weighted D^2 mass;
     phi_b = jnp.sum(d2w)                           # padding rows: 0
     p = jnp.minimum(1.0, ell * d2w /

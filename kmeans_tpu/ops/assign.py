@@ -62,7 +62,8 @@ def _accum_dtype(dtype) -> jnp.dtype:
 
 
 def pairwise_sq_dists(x: jax.Array, centroids: jax.Array,
-                      mode: str = "matmul") -> jax.Array:
+                      mode: str = "matmul",
+                      precision=None) -> jax.Array:
     """Squared Euclidean distances, (n, k) for x:(n, D), centroids:(k, D).
 
     ``mode='matmul'`` uses the expanded form — one (n,D)@(D,k) matmul, the
@@ -70,6 +71,12 @@ def pairwise_sq_dists(x: jax.Array, centroids: jax.Array,
     ``norm(centroids - point)``, kmeans_spark.py:153).  ``mode='direct'``
     materializes (n,k,D) differences — numerically exact (no cancellation),
     used for small problems / parity testing.
+
+    ``precision`` feeds the cross-term ``dot_general`` (matmul mode only).
+    The default (TPU: bf16-rounded products) is right for ASSIGNMENT —
+    only boundary ties can flip — but callers whose answer is the
+    distance VALUE near zero (the kmeans|| D² fold: a covered point must
+    read ~0, not |x||c|·2^-8) should pass ``lax.Precision.HIGHEST``.
     """
     acc = _accum_dtype(x.dtype)
     if mode == "direct":
@@ -90,7 +97,8 @@ def pairwise_sq_dists(x: jax.Array, centroids: jax.Array,
     c2 = jnp.sum(c * c, axis=-1)[None, :]                  # (1, k)
     xc = jax.lax.dot_general(
         x.astype(mm), c.astype(mm), (((1,), (1,)), ((), ())),
-        preferred_element_type=acc)                        # (n, k) on the MXU
+        preferred_element_type=acc,
+        precision=precision)                               # (n, k) on the MXU
     # Clamp: cancellation in the expanded form can produce tiny negatives.
     return jnp.maximum(x2 + c2 - 2.0 * xc, 0.0)
 

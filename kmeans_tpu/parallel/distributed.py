@@ -670,7 +670,18 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                                     need_farthest=need_farthest,
                                     need_sse_pc=False, x2w=x2w,
                                     w_col=w_col)
-            st = jax.vmap(local)(cents)
+            if mode in PALLAS_MODES:
+                # vmapping a pallas_call over the restart axis
+                # MATERIALIZES the unbatched points operand R times
+                # (r5, found by the 10M x R=4 time-to-solution run:
+                # a 4 x 5.1 GB broadcast OOMed the 16 GB chip).  The
+                # restarts run sequentially inside the same dispatch
+                # instead — at pallas shapes (k >= 512) a single
+                # restart already saturates the MXU, so the batching
+                # win the vmap bought at small k does not exist here.
+                st = lax.map(local, cents)
+            else:
+                st = jax.vmap(local)(cents)
             off = jnp.asarray(m_idx * k_local, jnp.int32)
             sums = lax.psum(jax.vmap(lambda s: lax.dynamic_update_slice(
                 jnp.zeros((k_pad, d), acc), s.astype(acc),
@@ -757,6 +768,15 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
         out_specs=(P(None, None), P(), P(None), P(None), P(None), P(),
                    P(None)),
         check_vma=False)
+    if mode in PALLAS_MODES:
+        # The lax.map-wrapped kernel call sits inside a fusion whose
+        # per-restart carries push XLA's default 16 MB scoped-vmem pool
+        # ~2% over (observed at 10M x R=4 on v5e); the kernel itself
+        # budgets against the separate 100 MB pltpu VMEM limit, so
+        # doubling the scoped pool for THIS program is safe headroom,
+        # not a tuning change.
+        return jax.jit(mapped, compiler_options={
+            "xla_tpu_scoped_vmem_limit_kib": 32768})
     return jax.jit(mapped)
 
 
