@@ -27,8 +27,32 @@ Two measured questions, same decision rules as the diag ladder:
    chain (the r5 harness rule).
 
 Run on TPU hardware:  python experiments/exp_gmm_full_precision.py
-(measured results are appended below after the run — decision rules
-above are committed BEFORE measuring).
+(decision rules above were committed BEFORE measuring).
+
+MEASURED (TPU v5e via tunnel, 2026-07-31, N=1M x D=64, k=32 full,
+chunk=4096):
+
+  precision   ms/E-pass   MFU    probe diag_err   probe offdiag_err
+    HIGHEST     27.50    10.1%      2.07e-02          2.39e-02
+    HIGH        17.99    15.5%      2.53e-02          2.27e-02
+    DEFAULT     11.91    23.4%      2.04e-02          2.40e-02
+
+  1. HIGH passes at HIGHEST-equivalent error (all probe stats ~2e-2 =
+     the probe's own noise scale for a max over k*D^2 entries; the 5%
+     bar is cleared 2x over) and is 1.53x faster -> WIRED into
+     _scan_estats_full's moments (gmm_step.py).
+  2. DEFAULT ALSO passes this probe (2.04e-2/2.40e-2) — unlike the
+     diag ladder, where it showed real marginal degradation.  Kept
+     rejected anyway: the full probe's max-statistic is visibly
+     jumpier (HIGH's diag_err 2.53e-2 > HIGHEST's 2.07e-2 is already
+     probe noise), a single passing run is not evidence DEFAULT's
+     known 2^-8 product rounding is safe across shapes, and the diag
+     family's measured degradation is the controlling precedent.
+  3. Tied stays HIGHEST everywhere: its per-iteration xsum feeds the
+     T - sum R_k mu mu^T cancellation through a DIFFERENT structure
+     (loop-invariant total scatter) that this ladder did not probe,
+     and its total-scatter term is once-per-fit (no per-iteration
+     speedup to claim).
 """
 
 import sys

@@ -296,7 +296,9 @@ def _fold_candidates(points, mind2, cands, valid):
         d2 = pairwise_sq_dists(xc, cands,
                                precision=jax.lax.Precision.HIGHEST)
         d2 = jnp.where(valid[None, :], d2, jnp.inf)
-        best = jnp.minimum(mc, jnp.min(d2, axis=1))
+        # pairwise_sq_dists accumulates in at least f32; cast back so
+        # float16 mind2 buffers round-trip (r5 review).
+        best = jnp.minimum(mc, jnp.min(d2, axis=1).astype(m.dtype))
         return jax.lax.dynamic_update_slice(m, best, (start,))
 
     return jax.lax.fori_loop(0, n_chunks, body, mind2)

@@ -328,18 +328,19 @@ def _refill_empty_slots(new, is_empty, skip, points, weights, n_orig, d,
     loops drained one slot per iteration (r2 VERDICT weak #3).
 
     The draw sequence is bit-identical to the host engine's on-device
-    sampler (``sharding._gumbel_rows`` keyed by ``[seed, iteration+1]``):
-    draw ``i`` is a Gumbel over the FULL padded global row space keyed by
-    ``fold_in(PRNGKey(seed_i), i)``, masked to positive-weight rows,
-    argmax first-max-wins (per-shard argmax picks the lowest local index,
-    the gathered argmax picks the lowest shard — together the lowest
-    global index, same as the host engine's global argmax), and the
-    winner's weight is zeroed so draws are without replacement.  Each
-    shard generates all ``n_glob`` Gumbel values and slices its own
-    segment — O(n_glob) per draw rather than O(n_glob / shards), the
-    price of bit-matching a draw defined on the global index space; the
-    ``fori_loop`` runs ZERO trips on iterations without empties, so
-    normal iterations pay nothing.
+    sampler (``sharding._gumbel_rows`` keyed by ``[seed, iteration+1]``,
+    which since r5 is ONE Gumbel array + ``top_k``): all of a restart's
+    draws share one ``PRNGKey(seed_i)``-seeded Gumbel array over the
+    FULL padded global row space, masked to positive-weight rows, and
+    draw ``i`` takes the i-th largest score — realized here as
+    sequential argmax with winner-remasking over the FIXED array, which
+    is exactly top-k order (per-shard argmax picks the lowest local
+    index, the gathered argmax picks the lowest shard — together the
+    lowest global index, same as top_k's first-occurrence tie rule).
+    Each shard generates all ``n_glob`` Gumbel values and slices its own
+    segment — the price of bit-matching a draw defined on the global
+    index space; the ``fori_loop`` runs ZERO trips on iterations
+    without empties, so normal iterations pay nothing.
 
     ``skip`` (traced 0/1) skips that many leading empty slots — the
     'farthest' policy fills the first empty with the farthest point and
@@ -380,18 +381,28 @@ def _refill_empty_slots_batched(new, is_empty, skip, points, weights,
                          - skip, 0)                               # (R,)
     rank = jnp.cumsum(is_empty.astype(jnp.int32), axis=1) - 1
 
+    # One Gumbel array per restart for ALL its draws (the one-shot
+    # top-k protocol); each shard slices its local segment once.  Gated
+    # under the same no-empties condition as the draw loop, so normal
+    # iterations still pay nothing (review r5: hoisted unconditionally,
+    # this generated n_glob Gumbels per restart EVERY iteration).
+    max_draw = jnp.max(n_draw)
+    gs_loc = lax.cond(
+        max_draw > 0,
+        lambda: jax.vmap(lambda k: lax.dynamic_slice(
+            jax.random.gumbel(k, (n_glob,), jnp.float32),
+            (d_idx * n_orig,), (n_orig,)))(keys),
+        lambda: jnp.zeros((R, n_orig), jnp.float32))         # (R, n_orig)
+
     def body(i, carry):
         new_c, mask = carry                                  # (R, n_orig)
 
-        def one(key_r, mask_r):
-            g = jax.random.gumbel(jax.random.fold_in(key_r, i), (n_glob,),
-                                  jnp.float32)
-            g_loc = lax.dynamic_slice(g, (d_idx * n_orig,), (n_orig,))
+        def one(g_loc, mask_r):
             score = jnp.where(mask_r > 0, g_loc, -jnp.inf)
             j = jnp.argmax(score)
             return score[j], j
 
-        ss, js = jax.vmap(one)(keys, mask)                   # (R,), (R,)
+        ss, js = jax.vmap(one)(gs_loc, mask)                 # (R,), (R,)
         rows_l = points[js, :d].astype(acc)                  # (R, d)
         ss_g = lax.all_gather(ss, DATA_AXIS)                 # (S, R)
         js_g = lax.all_gather(js, DATA_AXIS)
@@ -417,7 +428,7 @@ def _refill_empty_slots_batched(new, is_empty, skip, points, weights,
 
     w0 = jnp.broadcast_to(weights[:n_orig].astype(jnp.float32),
                           (R, n_orig))
-    new, _ = lax.fori_loop(0, jnp.max(n_draw), body, (new, w0))
+    new, _ = lax.fori_loop(0, max_draw, body, (new, w0))
     return new
 
 

@@ -28,26 +28,23 @@ from kmeans_tpu.parallel.mesh import DATA_AXIS, mesh_shape
 @functools.partial(jax.jit, static_argnames=("m",))
 def _gumbel_rows(points, weights, seed, m: int):
     """Draw ``m`` distinct positive-weight rows, uniformly, fully on
-    device: per draw, a seeded Gumbel-argmax over the masked weights (an
-    O(n) reduction — no sort), then the drawn row's mask is zeroed so
-    draws are without replacement.  GSPMD-parallel over sharded inputs
-    (the argmax and the row gather lower to cross-shard collectives), so
-    it works on multi-host process-local datasets where no host can index
-    the global row space — the capability gap behind r1 VERDICT #6."""
-    n, d = points.shape
-    key = jax.random.PRNGKey(seed)
-
-    def body(i, carry):
-        rows, mask = carry
-        g = jax.random.gumbel(jax.random.fold_in(key, i), (n,), jnp.float32)
-        score = jnp.where(mask > 0, g, -jnp.inf)
-        idx = jnp.argmax(score)
-        return rows.at[i].set(points[idx]), mask.at[idx].set(0)
-
-    rows, _ = jax.lax.fori_loop(
-        0, m, body,
-        (jnp.zeros((m, d), points.dtype), weights.astype(jnp.float32)))
-    return rows
+    device: ONE seeded Gumbel top-k over the masked rows.  Gumbel-top-k
+    IS sequential Gumbel-argmax-with-remasking in distribution (uniform
+    without replacement over positive-weight rows), but costs one O(n)
+    ``top_k`` instead of m sequential argmax+scatter rounds — the r5
+    time-to-solution run measured the sequential loop at 4.7 s for
+    k=1024 over 10M rows, dominating a warm fit's wall time; the
+    one-shot draw is ~0.25 s.  (Draw IDENTITIES change vs the r1-r4
+    loop — still deterministic per seed, and the distribution is the
+    same.)  GSPMD-parallel over sharded inputs (the top_k and the row
+    gather lower to cross-shard collectives), so it works on multi-host
+    process-local datasets where no host can index the global row space
+    — the capability gap behind r1 VERDICT #6."""
+    n, _ = points.shape
+    g = jax.random.gumbel(jax.random.PRNGKey(seed), (n,), jnp.float32)
+    score = jnp.where(weights > 0, g, -jnp.inf)
+    _, idx = jax.lax.top_k(score, m)
+    return points[idx]
 
 
 #: Below this many (n_local * k) elements the whole local shard runs as
@@ -319,8 +316,8 @@ class ShardedDataset:
         seed = int(np.random.SeedSequence(seed_seq).generate_state(1)[0]
                    % (2 ** 31))
         # Cap at the positive-weight population like the host-copy engine:
-        # an uncapped _gumbel_rows would "draw" row 0 once the without-
-        # replacement mask is exhausted, installing a zero-weight row.
+        # past it, the top-k draw runs out of -inf-masked winners and
+        # would install zero-weight rows (lowest-index ones first).
         take = min(m, int(jnp.sum(self.weights > 0)))
         if take == 0:
             return np.empty((0, self.d))
