@@ -25,6 +25,14 @@ multiplier stops drifting with host load; a live probe is still run and
 logged as a drift check (other shapes use the live probe directly).
 
 Env overrides: BENCH_N, BENCH_D, BENCH_K, BENCH_ITERS, BENCH_MODE.
+
+BENCH_STREAM=1 switches to the STREAMED-EPOCH benchmark instead
+(``kmeans_tpu.benchmarks.bench_stream``): ``fit_stream`` epoch cost off
+an on-disk ``.npy`` with the double-buffered input pipeline ON
+(prefetch=2) vs OFF (0), interleaved marginal pairs, one JSON line.
+Env: BENCH_STREAM_N / _D / _K / _BLOCK_ROWS / _EPOCHS / _PATH
+(accelerator default = the declared bigger-than-HBM config, 40M x 128
+k=1024 in 2M-row blocks; CPU default scales down to 1M x 32).
 """
 
 from __future__ import annotations
@@ -104,6 +112,30 @@ def main() -> None:
     enable_compilation_cache()
     backend = jax.default_backend()
     on_accel = backend not in ("cpu",)
+
+    if os.environ.get("BENCH_STREAM"):
+        # Streamed-epoch benchmark (fit_stream, disk blocks through the
+        # double-buffered pipeline): prefetch on vs off by the marginal
+        # method, one JSON line.  The declared bigger-than-HBM shape on
+        # a 16 GB chip is N=40M x D=128 (20 GB of f32 rows, block_rows
+        # 2M — ~(prefetch+2) x 1 GB resident); the CPU default is
+        # scaled down so the harness stays runnable anywhere.  Env:
+        # BENCH_STREAM_N/D/K/BLOCK_ROWS/EPOCHS/PATH.
+        from kmeans_tpu.benchmarks import bench_stream
+        sn = int(os.environ.get("BENCH_STREAM_N",
+                                40_000_000 if on_accel else 1_000_000))
+        sd = int(os.environ.get("BENCH_STREAM_D",
+                                128 if on_accel else 32))
+        sk = int(os.environ.get("BENCH_STREAM_K",
+                                1024 if on_accel else 64))
+        sb = int(os.environ.get("BENCH_STREAM_BLOCK_ROWS",
+                                2_000_000 if on_accel else 125_000))
+        se = int(os.environ.get("BENCH_STREAM_EPOCHS", 4))
+        log(f"bench: STREAM mode backend={backend} N={sn} D={sd} k={sk} "
+            f"block_rows={sb} epochs_gap={se}")
+        bench_stream(sn, sd, sk, sb, se,
+                     path=os.environ.get("BENCH_STREAM_PATH"))
+        return
     # Default = the BASELINE.json NORTH-STAR config (10M x 128, k=1024)
     # on accelerators.  Affordable as a default since r3 because the
     # dataset is generated ON DEVICE (below): the former 5 GB host

@@ -33,6 +33,10 @@ init = X[rng.choice(len(X), 10, replace=False)].copy()
 
 km = KMeans(k=10, seed=42, compute_sse=True, empty_cluster="keep",
             init=init, max_iter=30, verbose=False)
+# Each epoch streams through the double-buffered pipeline: a background
+# producer reads + uploads block i+1 while block i computes
+# (prefetch=2 is the default; prefetch=0 restores the synchronous path
+# — the trajectory is bit-identical either way).
 km.fit_stream(iter_npy_blocks(path, block_rows=50_000))   # 6 blocks/epoch
 print("streamed fit: iterations", km.iterations_run,
       "SSE", round(km.sse_history[-1], 1))

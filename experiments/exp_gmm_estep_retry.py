@@ -43,8 +43,13 @@ MEASURED (TPU v5e via tunnel, 2026-07-31):
      own sampling noise at n=262144) and 1.53x faster -> WIRED into
      _estep_tile (gmm_step.py).  DEFAULT degrades the probe (4.1e-2,
      still under the 5% bar but a real ~2.8e-2 marginal error) for
-     only 1.24x more -> stays rejected.  Full/tied scatter moments
-     keep HIGHEST: this ladder only probed the diag moment structure.
+     only 1.24x more -> stays rejected.  At the time of this ladder,
+     full/tied scatter moments kept HIGHEST (this ladder only probed
+     the diag moment structure); the dedicated full-covariance ladder
+     (exp_gmm_full_precision.py, same round) subsequently relaxed FULL
+     to HIGH on its own 25-sigma survival probe — only TIED keeps
+     HIGHEST (its cancellation runs through the loop-invariant total
+     scatter no ladder has probed).
      Shipped-loop effect: 14.2 -> 8.37 ms/iter (~33% MFU) measured on
      the full device EM fit at this shape.
   2. Chunk 32768 stays optimal at EVERY precision (16384 within 8%,

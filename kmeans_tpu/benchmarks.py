@@ -300,6 +300,187 @@ def bench_config(name: str, iters: int, mode: str) -> Dict:
     return result
 
 
+def bench_stream(n: int, d: int, k: int, block_rows: int, epochs: int,
+                 path=None, prefetch: int = 2) -> Dict:
+    """Streamed-epoch benchmark: `fit_stream` epoch cost with the
+    double-buffered pipeline ON (``prefetch``) vs OFF (0), plus the
+    in-memory device-loop iteration at the same shape for context.
+
+    Method (the repo's marginal protocol): per-epoch cost is the median
+    of 5 interleaved marginals between a 1-epoch and a (1+epochs)-epoch
+    ``fit_stream`` (fixed explicit init, tolerance~0, 'keep' policy —
+    no early convergence), which cancels the init/setup/compile share
+    exactly; ``measure_marginal`` reports the (max-min)/median spread
+    for the <=5% publication bar.  Blocks come off disk through
+    ``iter_npy_blocks`` (mmap), so the measured quantity includes the
+    real read + decode + host->device transfer per block — the costs
+    the prefetcher exists to overlap.  The dataset .npy is written once
+    (seeded) and reused.
+    """
+    import os
+    import tempfile
+
+    import jax
+    from kmeans_tpu.data.io import iter_npy_blocks
+    from kmeans_tpu.models.kmeans import KMeans
+
+    if path is None:
+        path = os.path.join(tempfile.gettempdir(),
+                            f"kmeans_tpu_stream_{n}x{d}.npy")
+    if os.path.exists(path):
+        # A stale explicit BENCH_STREAM_PATH must never silently
+        # benchmark a different shape than the published metric name
+        # claims (the default path embeds n x d; an override bypasses
+        # that guard).
+        shape = np.load(path, mmap_mode="r").shape
+        if shape != (n, d):
+            raise ValueError(
+                f"BENCH_STREAM dataset {path} has shape {shape}, not "
+                f"({n}, {d}) — delete it or point BENCH_STREAM_PATH at "
+                f"a matching file")
+    else:
+        _log(f"[stream] writing {path} ({n * d * 4 / 1e9:.2f} GB) ...")
+        rng = np.random.default_rng(42)
+        out = np.lib.format.open_memmap(path, mode="w+",
+                                        dtype=np.float32, shape=(n, d))
+        step = max(1, min(block_rows, 1 << 22))
+        for lo in range(0, n, step):
+            hi = min(lo + step, n)
+            out[lo:hi] = rng.uniform(-1.0, 1.0,
+                                     size=(hi - lo, d)).astype(np.float32)
+        out.flush()
+        del out
+
+    mm = np.load(path, mmap_mode="r")
+    rng = np.random.default_rng(7)
+    init = np.asarray(mm[np.sort(rng.choice(n, size=k, replace=False))],
+                      dtype=np.float32)
+    del mm
+
+    def run(pf: int, n_epochs: int) -> float:
+        km = KMeans(k=k, max_iter=n_epochs, tolerance=1e-30, seed=0,
+                    init=init, empty_cluster="keep", compute_sse=False,
+                    verbose=False)
+        start = time.perf_counter()
+        km.fit_stream(iter_npy_blocks(path, block_rows), d=d,
+                      prefetch=pf)
+        elapsed = time.perf_counter() - start
+        assert km.iterations_run == n_epochs
+        return elapsed
+
+    # INTERLEAVED variant comparison (the BASELINE.md rule for every
+    # cross-variant number: both settings must see the same host-drift
+    # window).  Each rep measures one (small, big) marginal pair per
+    # prefetch setting back-to-back; the published overlap speedup is
+    # the median of the PER-REP ratios, so slow drift that moves both
+    # settings together cancels — a sequential prefetch-0-series-then-
+    # prefetch-2-series design measured 1.8x and 0.7x for the SAME
+    # binary across two drift windows on a shared host.
+    for pf in (0, prefetch):
+        run(pf, 1)
+        run(pf, 1 + epochs)                      # warm both programs
+    m0s, m2s = [], []
+    reps = 5
+    for rep in range(reps + 1):
+        m0 = max(run(0, 1 + epochs) - run(0, 1), 1e-9)
+        m2 = max(run(prefetch, 1 + epochs) - run(prefetch, 1), 1e-9)
+        if rep == 0:
+            continue                             # burn-in pair (outlier)
+        m0s.append(m0)
+        m2s.append(m2)
+        _log(f"[stream] rep {rep}/{reps}: prefetch0 "
+             f"{m0 / epochs:.3f} s/epoch, prefetch{prefetch} "
+             f"{m2 / epochs:.3f} s/epoch, speedup {m0 / m2:.2f}x")
+    ratios = sorted(a / b for a, b in zip(m0s, m2s))
+    speedup = float(np.median(ratios))
+    ratio_spread = (max(ratios) - min(ratios)) / speedup
+    p0 = float(np.median(m0s)) / epochs
+    p2 = float(np.median(m2s)) / epochs
+    s0 = (max(m0s) - min(m0s)) / float(np.median(m0s))
+    s2 = (max(m2s) - min(m2s)) / float(np.median(m2s))
+    _log(f"[stream] prefetch=0: {p0:.3f} s/epoch (spread "
+         f"{s0 * 100:.0f}%); prefetch={prefetch}: {p2:.3f} s/epoch "
+         f"(spread {s2 * 100:.0f}%); overlap speedup {speedup:.2f}x "
+         f"(ratio spread {ratio_spread * 100:.0f}%)")
+
+    # In-memory device-loop iteration at the same shape (the published
+    # per-config method) — quantifies what streaming costs over a
+    # device-resident fit when the data DOES fit.
+    in_mem = None
+    try:
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from kmeans_tpu.parallel import distributed as dist
+        from kmeans_tpu.parallel.mesh import (DATA_AXIS, make_mesh,
+                                              mesh_shape)
+        from kmeans_tpu.parallel.sharding import choose_chunk_size
+        mesh = make_mesh()
+        data_shards, model_shards = mesh_shape(mesh)
+        chunk = choose_chunk_size(-(-n // data_shards), k, d)
+        n_pad = -(-n // (data_shards * chunk)) * (data_shards * chunk)
+        gen = jax.jit(
+            lambda key: (jax.random.uniform(key, (n_pad, d), jnp.float32,
+                                            -1.0, 1.0),
+                         (jnp.arange(n_pad) < n).astype(jnp.float32)),
+            out_shardings=(NamedSharding(mesh, P(DATA_AXIS, None)),
+                           NamedSharding(mesh, P(DATA_AXIS))))
+        points, weights = gen(jax.random.PRNGKey(42))
+        cents = jax.device_put(dist.pad_centroids(init, model_shards),
+                               dist.centroid_sharding(mesh))
+
+        def build(mi):
+            return dist.make_fit_fn(mesh, chunk_size=chunk, mode="matmul",
+                                    k_real=k, max_iter=mi, tolerance=0.0,
+                                    empty_policy="keep")
+
+        def timed(fn, mi):
+            seeds = jax.device_put(np.zeros((mi,), np.uint32))
+            t0 = time.perf_counter()
+            out = fn(points, weights, cents, seeds)
+            int(out[1])
+            return time.perf_counter() - t0
+
+        f_s, f_b = build(2), build(2 + epochs)
+        timed(f_s, 2), timed(f_b, 2 + epochs)          # compile
+        m, sp, _ = measure_marginal(lambda: timed(f_s, 2),
+                                    lambda: timed(f_b, 2 + epochs),
+                                    reps=5)
+        in_mem = m / epochs
+        _log(f"[stream] in-memory device loop: {in_mem * 1e3:.1f} ms/iter"
+             f" (spread {sp * 100:.0f}%)")
+    except Exception as e:                 # noqa: BLE001 — context only
+        _log(f"[stream] in-memory comparison skipped: {e}")
+
+    result = {
+        # Same publication rule as bench_config: rows whose spread
+        # exceeds the 5% bar are flagged, never silently published.
+        # The bar is applied to the RATIO spread — the published
+        # comparison — since absolute epoch times on a shared host
+        # carry the drift the interleaving exists to cancel.
+        "indicative_only": bool(ratio_spread > 0.05),
+        "metric": f"kmeans_stream_epoch_N{n}_D{d}_k{k}",
+        "value": round(p2, 4),
+        "unit": "s/epoch (streamed, prefetch on)",
+        "prefetch": prefetch,
+        "block_rows": block_rows,
+        "epochs_gap": epochs,
+        "prefetch0_s_per_epoch": round(p0, 4),
+        "prefetch_s_per_epoch": round(p2, 4),
+        "overlap_speedup": round(speedup, 3),
+        "overlap_speedup_spread": round(ratio_spread, 3),
+        "spread_prefetch0": round(s0, 3),
+        "spread_prefetch": round(s2, 3),
+        "in_memory_ms_per_iter": (round(in_mem * 1e3, 3)
+                                  if in_mem else None),
+        "stream_overhead_vs_in_memory": (round(p0 / in_mem, 2)
+                                         if in_mem else None),
+        "platform": jax.default_backend(),
+        "n_devices": len(jax.devices()),
+    }
+    print(json.dumps(result), flush=True)
+    return result
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="kmeans_tpu benchmarks")
     parser.add_argument("--configs", default=",".join(DEFAULT_CONFIGS))

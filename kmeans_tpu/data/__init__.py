@@ -1,6 +1,8 @@
 """Dataset generation and loading for tests and benchmarks."""
 
 from kmeans_tpu.data.synthetic import make_blobs, make_uniform
-from kmeans_tpu.data.io import from_npy, from_raw
+from kmeans_tpu.data.io import from_npy, from_raw, iter_npy_blocks
+from kmeans_tpu.data.prefetch import prefetch_iter
 
-__all__ = ["make_blobs", "make_uniform", "from_npy", "from_raw"]
+__all__ = ["make_blobs", "make_uniform", "from_npy", "from_raw",
+           "iter_npy_blocks", "prefetch_iter"]

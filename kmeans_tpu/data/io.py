@@ -28,17 +28,79 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from kmeans_tpu.parallel.mesh import DATA_AXIS, mesh_shape
 from kmeans_tpu.parallel.sharding import (ShardedDataset, choose_chunk_size,
                                           to_device)
+from kmeans_tpu.data.prefetch import check_prefetch, prefetch_iter
+
+
+class _ReadaheadReader:
+    """Read-ahead wrapper for a ``read_rows(lo, hi)`` shard callback.
+
+    ``jax.make_array_from_callback`` pulls one shard slice at a time;
+    with a slow source (cold mmap pages, network filesystems) each
+    slice's disk read serializes against the device placement of the
+    previous one.  This wrapper predicts the next ``depth`` contiguous
+    same-sized ranges after every read and materializes them in ONE
+    background thread, so the disk read of shard i+1 overlaps the
+    transfer of shard i.  A mispredicted range (out-of-order callback
+    invocation, which JAX does not forbid) is only a cache miss — the
+    read happens synchronously, correctness is unaffected.  Memory
+    cost: up to ``depth`` extra slices resident on the host.
+    """
+
+    def __init__(self, read_rows, n: int, depth: int):
+        import concurrent.futures
+        self._read = read_rows
+        self._n = n
+        self._depth = depth
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="kmeans_tpu-readahead")
+        self._pending: dict = {}       # (lo, hi) -> Future
+
+    def __call__(self, lo: int, hi: int) -> np.ndarray:
+        fut = self._pending.pop((lo, hi), None)
+        if fut is None and self._pending:
+            # Mispredicted (out-of-order callback invocation): drop the
+            # stale predictions so readahead re-anchors to the actual
+            # cursor — keeping them would both pin their slices and
+            # permanently disable scheduling via the depth cap.
+            for stale in self._pending.values():
+                stale.cancel()
+            self._pending.clear()
+        out = fut.result() if fut is not None else self._read(lo, hi)
+        self._schedule(hi, hi - lo)
+        return out
+
+    def _schedule(self, start: int, size: int) -> None:
+        for _ in range(self._depth):
+            lo, hi = start, min(start + size, self._n)
+            if hi <= lo or len(self._pending) >= self._depth:
+                break
+            if (lo, hi) not in self._pending:
+                self._pending[(lo, hi)] = self._pool.submit(
+                    self._read, lo, hi)
+            start = hi
 
 
 def _sharded_from_source(read_rows, n: int, d: int, mesh: Mesh,
                          chunk: int, dtype,
                          sample_weight: Optional[np.ndarray],
                          host_handle,
-                         explicit_chunk: bool = False) -> ShardedDataset:
+                         explicit_chunk: bool = False,
+                         prefetch: int = 0) -> ShardedDataset:
     """Build a ShardedDataset whose shards pull rows via ``read_rows(lo, hi)``
-    — each callback materializes only its own slice."""
+    — each callback materializes only its own slice.  ``prefetch > 0``
+    wraps the reader in a :class:`_ReadaheadReader` of that depth, so
+    the disk read of the next shard slice overlaps the placement of the
+    current one."""
     data_shards, _ = mesh_shape(mesh)
     dtype = np.dtype(dtype)
+    # Readahead predicts the NEXT contiguous row range, which on a
+    # multi-host mesh belongs to ANOTHER host past this host's last
+    # local shard — it would read (and pin) up to ``depth`` never-
+    # consumed slices and break the module's touch-only-local-bytes
+    # contract, so it is single-process only.
+    prefetch = check_prefetch(prefetch)
+    if prefetch and jax.process_count() == 1:
+        read_rows = _ReadaheadReader(read_rows, n, prefetch)
     n_pad = math.ceil(n / (data_shards * chunk)) * (data_shards * chunk)
 
     sw = None
@@ -93,7 +155,8 @@ def _resolve_chunk(n: int, d: int, k_hint: int, mesh: Mesh,
 def from_npy(path, mesh: Mesh, *, chunk_size: Optional[int] = None,
              dtype=np.float32, k_hint: int = 16,
              budget_elems: Optional[int] = None,
-             sample_weight: Optional[np.ndarray] = None) -> ShardedDataset:
+             sample_weight: Optional[np.ndarray] = None,
+             prefetch: int = 2) -> ShardedDataset:
     """Shard a 2-D ``.npy`` file onto the mesh without loading it whole.
 
     ``k_hint`` feeds the automatic chunk-size choice (the (chunk, k)
@@ -104,6 +167,12 @@ def from_npy(path, mesh: Mesh, *, chunk_size: Optional[int] = None,
     tiles than K-Means; docs/PERFORMANCE.md).  With ``mesh=None`` this falls back to a
     plain in-memory upload (single-device paths have no per-shard slicing
     to exploit).
+
+    ``prefetch`` (default 2) reads ahead that many shard slices in a
+    background thread so disk IO overlaps device placement
+    (``data.prefetch``); ``prefetch=0`` restores the fully synchronous
+    load.  Host memory grows by up to ``prefetch`` slices either way —
+    the per-shard (not whole-file) residency contract is unchanged.
     """
     mm = np.load(path, mmap_mode="r")
     if mm.ndim != 2:
@@ -122,7 +191,8 @@ def from_npy(path, mesh: Mesh, *, chunk_size: Optional[int] = None,
 
     return _sharded_from_source(read_rows, n, d, mesh, chunk, dtype,
                                 sample_weight, host_handle=mm,
-                                explicit_chunk=chunk_size is not None)
+                                explicit_chunk=chunk_size is not None,
+                                prefetch=prefetch)
 
 
 def from_raw(path, shape: Tuple[int, int], mesh: Mesh, *,
@@ -130,10 +200,12 @@ def from_raw(path, shape: Tuple[int, int], mesh: Mesh, *,
              dtype=np.float32, k_hint: int = 16,
              budget_elems: Optional[int] = None,
              offset: int = 0,
-             sample_weight: Optional[np.ndarray] = None) -> ShardedDataset:
+             sample_weight: Optional[np.ndarray] = None,
+             prefetch: int = 2) -> ShardedDataset:
     """Shard a headerless binary file of ``shape`` row-major ``file_dtype``
     values (e.g. exported feature matrices) onto the mesh, reading each
-    shard's byte range only."""
+    shard's byte range only.  ``prefetch`` reads ahead like
+    :func:`from_npy`'s."""
     n, d = shape
     mm = np.memmap(path, dtype=file_dtype, mode="r", offset=offset,
                    shape=(n, d))
@@ -149,14 +221,27 @@ def from_raw(path, shape: Tuple[int, int], mesh: Mesh, *,
 
     return _sharded_from_source(read_rows, n, d, mesh, chunk, dtype,
                                 sample_weight, host_handle=mm,
-                                explicit_chunk=chunk_size is not None)
+                                explicit_chunk=chunk_size is not None,
+                                prefetch=prefetch)
 
 
-def iter_npy_blocks(path, block_rows: int, *, dtype=None):
+def iter_npy_blocks(path, block_rows: int, *, dtype=None,
+                    prefetch: int = 0):
     """Factory for ``KMeans.fit_stream``: returns a zero-argument callable
     that yields consecutive (<= block_rows, D) slices of a 2-D ``.npy``
-    via mmap — only one block is ever resident in host memory, so the file
-    can exceed both HBM and host RAM.
+    via mmap — at most ``prefetch + 2`` blocks are ever resident in host
+    memory (``prefetch`` queued + one in flight in the producer + the
+    one being consumed; ``data.prefetch``'s memory contract), so the
+    file can exceed both HBM and host RAM.
+
+    ``prefetch`` (default 0) materializes that many blocks ahead in a
+    background thread (``data.prefetch.prefetch_iter``) — useful when
+    driving your OWN consumption loop over a slow source.  The model
+    streaming surfaces (``fit_stream``/``predict_stream``/...) already
+    prefetch decode + device placement internally, and their producer
+    thread drives this generator's disk reads off the consumer thread
+    too, so stacking both is redundant (harmless, but doubles the
+    resident-block count).
 
     Usage::
 
@@ -164,8 +249,9 @@ def iter_npy_blocks(path, block_rows: int, *, dtype=None):
     """
     if block_rows <= 0:
         raise ValueError(f"block_rows must be positive, got {block_rows}")
+    prefetch = check_prefetch(prefetch)
 
-    def make_blocks():
+    def iter_blocks():
         arr = np.load(path, mmap_mode="r")
         if arr.ndim != 2:
             raise ValueError(f"{path} must contain a 2-D array, "
@@ -173,5 +259,8 @@ def iter_npy_blocks(path, block_rows: int, *, dtype=None):
         for start in range(0, arr.shape[0], block_rows):
             block = np.asarray(arr[start: start + block_rows])
             yield block if dtype is None else block.astype(dtype)
+
+    def make_blocks():
+        return prefetch_iter(iter_blocks(), prefetch)
 
     return make_blocks

@@ -1,0 +1,197 @@
+"""Double-buffered input pipeline: overlap host IO/transfer with compute.
+
+The streaming surfaces (``KMeans.fit_stream``, ``GaussianMixture.
+fit_stream``, the predict/transform/score streams) consume host blocks
+one at a time.  Without prefetch, each block's disk read and
+host->device transfer serializes against the device step that consumes
+it — on a tunneled transport (~7-10 MB/s measured, docs/PERFORMANCE.md)
+the transfer IS the whole cost of a streamed epoch.  ``prefetch_iter``
+is the repo's one input-pipeline primitive: a bounded background
+producer (thread + ``queue.Queue(maxsize=prefetch)``) that reads block
+i+1 from the source — and runs the caller's ``stage`` callback, which
+is where the consumers put their decode + ``jax.device_put`` onto the
+data-mesh sharding — while block i's step computes on device.
+
+Contract (pinned by tests/test_prefetch.py):
+
+* **Order-preserving and semantics-free.**  Items are yielded in source
+  order; ``stage`` runs once per item in that order.  Only WHERE the
+  work happens moves (a thread), never WHAT is computed — so a
+  ``prefetch=0`` and a ``prefetch>0`` run of the same fit are
+  bit-identical (the parity oracle the streamed-fit tests pin).
+* **prefetch=0 is the synchronous path** — no thread, no queue; the
+  generator applies ``stage`` inline.  It is the fallback AND the
+  reference behavior every prefetch>0 run must reproduce exactly.
+* **Reader errors surface at the consumer.**  Any exception raised by
+  the source iterable or by ``stage`` (in the producer thread) is
+  re-raised from the consumer's ``next()`` at the position where the
+  failing item would have appeared — stream-shape validation errors
+  keep their call-site visibility.
+* **No leaked threads.**  Closing the generator early (``close()``,
+  ``break``, GC of a partial epoch) signals the producer, drains the
+  queue so a blocked ``put`` wakes, and JOINS the thread before
+  returning.  The producer never blocks forever: every ``put`` polls a
+  stop event.
+
+Memory contract: up to ``prefetch`` staged items live in the queue plus
+one in flight in the producer — a streamed fit's device footprint grows
+from 1 block to at most ``prefetch + 2`` blocks.  That is the standard
+staging-buffer trade; size ``prefetch`` (default 2 at the call sites)
+against block size accordingly.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, Optional
+
+__all__ = ["prefetch_iter", "check_prefetch", "close_source"]
+
+# Poll period for the producer's stop-aware queue puts.  Short enough
+# that generator close() never waits noticeably, long enough to cost
+# nothing while the queue has room.
+_PUT_POLL_S = 0.05
+
+
+def check_prefetch(prefetch) -> int:
+    """Validate a ``prefetch`` knob: an int >= 0 (0 = synchronous)."""
+    p = int(prefetch)
+    if p < 0 or p != prefetch:
+        raise ValueError(f"prefetch must be an int >= 0, got {prefetch!r}")
+    return p
+
+
+def prefetch_iter(source: Iterable, prefetch: int,
+                  stage: Optional[Callable] = None) -> Iterator:
+    """Iterate ``source`` with ``prefetch`` items staged ahead.
+
+    ``stage(item)`` (optional) maps each raw item to what the consumer
+    receives; with ``prefetch > 0`` it runs in the producer thread —
+    put the expensive per-item work there (disk read materialization,
+    decode, ``jax.device_put``) so it overlaps the consumer's device
+    compute.  ``prefetch=0`` applies ``stage`` inline with no thread.
+    """
+    prefetch = check_prefetch(prefetch)
+    if prefetch == 0:
+        return _sync_iter(source, stage)
+    return _PrefetchIterator(source, prefetch, stage)
+
+
+def close_source(it) -> None:
+    """Propagate close to a closeable iterator (a generator, or a nested
+    _PrefetchIterator — e.g. ``iter_npy_blocks(..., prefetch=N)`` feeding
+    a prefetched fit); a no-op for plain iterators.  Abandoning a
+    wrapper or a peeked stream must reap the source's thread/frame
+    deterministically, not wait for cyclic GC."""
+    close = getattr(it, "close", None)
+    if close is not None:
+        close()
+
+
+def _sync_iter(source, stage):
+    it = iter(source)
+    try:
+        for item in it:
+            yield stage(item) if stage is not None else item
+    finally:
+        close_source(it)
+
+
+class _PrefetchIterator:
+    """Generator-protocol iterator backed by one producer thread.
+
+    Implemented as a class (not a generator function) so ``close()`` is
+    an explicit, idempotent join point — and so an abandoned iterator's
+    ``__del__`` still reaps the thread.
+    """
+
+    def __init__(self, source, prefetch: int, stage):
+        self._q: queue.Queue = queue.Queue(maxsize=prefetch)
+        self._stop = threading.Event()
+        self._source = iter(source)
+        self._thread = threading.Thread(
+            target=self._produce, args=(self._source, stage),
+            name="kmeans_tpu-prefetch", daemon=True)
+        self._done = False
+        self._thread.start()
+
+    # ------------------------------------------------------- producer side
+
+    def _put(self, msg) -> bool:
+        """Stop-aware put: never blocks past a close().  Returns False
+        when the consumer signalled stop (the message is dropped)."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(msg, timeout=_PUT_POLL_S)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self, it, stage) -> None:
+        try:
+            for item in it:
+                staged = stage(item) if stage is not None else item
+                if not self._put(("item", staged)):
+                    return                      # closed early
+                del staged                      # queue owns the reference
+            self._put(("done", None))
+        except BaseException as e:              # noqa: BLE001 — re-raised
+            self._put(("error", e))             # at the consumer
+
+    # ------------------------------------------------------- consumer side
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        while True:
+            try:
+                kind, val = self._q.get(timeout=_PUT_POLL_S)
+                break
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    # Producer died without a terminal message (should
+                    # be impossible — _produce's except posts one) and
+                    # the queue is drained: stop rather than hang.
+                    try:
+                        kind, val = self._q.get_nowait()
+                        break
+                    except queue.Empty:
+                        self.close()
+                        raise StopIteration from None
+        if kind == "item":
+            return val
+        self.close()
+        if kind == "error":
+            raise val
+        raise StopIteration                     # kind == "done"
+
+    def close(self) -> None:
+        """Signal the producer, drain the queue, join the thread.
+        Idempotent; called on exhaustion, error, early ``close()``/
+        ``break``, and GC."""
+        if self._done:
+            return
+        self._done = True
+        self._stop.set()
+        # Drain so a producer blocked in put() sees the stop event on
+        # its next poll instead of racing a full queue.
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join()
+        # After the join no one is executing the source; close it too
+        # (nested prefetchers/generators must not linger until GC).
+        close_source(self._source)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:       # interpreter shutdown — nothing to do
+            pass

@@ -76,7 +76,7 @@ _MOM_CACHE: dict = {}
 
 def _sharded_reduction(mesh, k: int, chunk: int, kind: str):
     from jax.sharding import PartitionSpec as P
-    from kmeans_tpu.parallel.mesh import DATA_AXIS
+    from kmeans_tpu.parallel.mesh import DATA_AXIS, shard_map
     key = (mesh, k, chunk, kind)
     if key in _MOM_CACHE:
         return _MOM_CACHE[key]
@@ -123,7 +123,7 @@ def _sharded_reduction(mesh, k: int, chunk: int, kind: str):
         in_specs = (P(DATA_AXIS, None), P(DATA_AXIS), P(None, None))
         out_specs = (P(None), P(None))
 
-    mapped = jax.shard_map(run, mesh=mesh, in_specs=in_specs,
+    mapped = shard_map(run, mesh=mesh, in_specs=in_specs,
                            out_specs=out_specs, check_vma=False)
     _MOM_CACHE[key] = jax.jit(mapped)
     return _MOM_CACHE[key]
@@ -254,7 +254,7 @@ def _silhouette_mesh_fn(mesh, k: int, chunk: int, col_block: int):
     if key in _SIL_CACHE:
         return _SIL_CACHE[key]
     from jax.sharding import PartitionSpec as P
-    from kmeans_tpu.parallel.mesh import DATA_AXIS
+    from kmeans_tpu.parallel.mesh import DATA_AXIS, shard_map
 
     def run(xrows, lrows, Xfull, lfull, counts):
         nc = xrows.shape[0] // chunk
@@ -268,7 +268,7 @@ def _silhouette_mesh_fn(mesh, k: int, chunk: int, col_block: int):
         _, s = lax.scan(body, None, xs)
         return s.reshape(-1)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         run, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None, None),
                   P(None), P(None)),

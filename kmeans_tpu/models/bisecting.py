@@ -225,7 +225,8 @@ class BisectingKMeans(KMeans):
         self.cluster_sizes_ = np.array([wsize[i] for i in range(k_out)])
         return self
 
-    def fit_stream(self, make_blocks, *, d=None):
+    def fit_stream(self, make_blocks, *, d=None, resume=False,
+                   prefetch=2):
         """Blocked: the inherited ``fit_stream`` would run plain flat Lloyd
         — no bisecting tree, stale ``cluster_sse_``/``labels_`` semantics
         (ADVICE r1).  Bisecting needs random row access for its per-split

@@ -52,6 +52,7 @@ diag, (k,) spherical, (D, D) tied, (k, D, D) full.
 
 from __future__ import annotations
 
+import contextlib
 import math
 import time
 from typing import Optional
@@ -92,6 +93,15 @@ _HARD_INV_VAR = 1e6
 # data-loader users can request EM-sized chunks:
 # ``data.io.from_npy(..., budget_elems=EM_CHUNK_BUDGET)``.
 EM_CHUNK_BUDGET = 1 << 23
+# Row cap for clamped FOREIGN datasets (``_eff_chunk``): the EM chunk
+# sweep (experiments/exp_gmm_estep_retry.py, re-swept at every
+# precision) measured 32768 rows optimal with 65536+ collapsing at the
+# probe shape, so a dataset whose baked-in chunk survived the element
+# budget on k alone (small-k fits of a large single-chunk shard) is
+# additionally bounded near that plateau rather than scanning wherever
+# the budget allows (ADVICE r5 low).  ``_dataset``'s own auto choice is
+# budget-driven and unchanged.
+EM_MAX_CHUNK = 32768
 
 # Weighted-mean pass for the centering shift (GSPMD: XLA inserts the
 # cross-shard collectives for the sharded matvec itself).  The zero-
@@ -260,10 +270,14 @@ class GaussianMixture:
         the same EM_CHUNK_BUDGET as ``_dataset``'s own chunk choice —
         the EM pass measured SMALLER tiles 2x faster (chunk-sizing note
         in ``_dataset``), so the K-Means single-chunk budget must not
-        leak in through foreign datasets (r5 review)."""
+        leak in through foreign datasets (r5 review) — and additionally
+        bounded by the measured EM row plateau (``EM_MAX_CHUNK``), so a
+        small-k clamp that survives the element budget still lands near
+        the measured optimum instead of e.g. 50,000 rows."""
         eff_k = (self.n_components * ds.d
                  if self.covariance_type == "full" else self.n_components)
-        return ds.effective_chunk(eff_k, EM_CHUNK_BUDGET)
+        return ds.effective_chunk(eff_k, EM_CHUNK_BUDGET,
+                                  max_chunk=EM_MAX_CHUNK)
 
     def _shift(self) -> np.ndarray:
         """The centering shift (data's global mean), zeros pre-fit."""
@@ -627,8 +641,8 @@ class GaussianMixture:
         self.restart_lower_bounds_ = np.asarray(lls, np.float64)
         return self
 
-    def fit_stream(self, make_blocks, *,
-                   d: Optional[int] = None) -> "GaussianMixture":
+    def fit_stream(self, make_blocks, *, d: Optional[int] = None,
+                   prefetch: int = 2) -> "GaussianMixture":
         """EXACT EM over data larger than device memory — the mixture
         analogue of ``KMeans.fit_stream`` (r3 VERDICT #6: the E-step
         statistics are the same dense host-summable accumulators the
@@ -657,18 +671,36 @@ class GaussianMixture:
         additionally ~20 streamed Lloyd epochs — pass explicit
         ``means_init`` to skip), and one hard-assignment epoch for the
         initial responsibilities.
+
+        ``prefetch`` (default 2): every data pass (centering, tied
+        scatter, hard-assignment, EM epochs) stages the next block's
+        read + decode + device placement in a bounded background
+        producer while the current block computes
+        (``data.prefetch.prefetch_iter`` — the same machinery and
+        bit-identical-trajectory contract as ``KMeans.fit_stream``);
+        0 = the synchronous path.  The streamed init passes stay
+        synchronous (once per fit; their reservoir state is
+        consumption-order-bound anyway).
         """
+        from kmeans_tpu.data.prefetch import (check_prefetch, close_source,
+                                              prefetch_iter)
         from kmeans_tpu.parallel.sharding import shard_points
         from kmeans_tpu.models.init import (_split_block,
                                             streamed_forgy_init,
                                             streamed_kmeans_parallel_init)
+        prefetch = check_prefetch(prefetch)
         if d is None:
+            # close_source: a prefetching source must have its producer
+            # thread reaped when the peek abandons it after one item.
+            peek_it = iter(make_blocks())
             try:
-                item = next(iter(make_blocks()))
+                item = next(peek_it)
             except StopIteration:
                 raise ValueError(
                     "make_blocks() yielded no rows — it must return a "
                     "FRESH iterable on every call") from None
+            finally:
+                close_source(peek_it)
             peek = np.asarray(item[0] if isinstance(item, tuple) else item,
                               dtype=self.dtype)
             if peek.ndim != 2:
@@ -686,17 +718,19 @@ class GaussianMixture:
         sx = np.zeros(d)
         sw_total = 0.0
         n_rows = n_pos = 0
-        for item in make_blocks():
-            block, bw = _split_block(item, d, np.float64)
-            n_rows += block.shape[0]
-            if bw is None:
-                sx += block.sum(axis=0)
-                sw_total += block.shape[0]
-                n_pos += block.shape[0]
-            else:
-                sx += (block * bw[:, None]).sum(axis=0)
-                sw_total += float(bw.sum())
-                n_pos += int((bw > 0).sum())
+        with contextlib.closing(prefetch_iter(
+                make_blocks(), prefetch,
+                lambda item: _split_block(item, d, np.float64))) as it:
+            for block, bw in it:
+                n_rows += block.shape[0]
+                if bw is None:
+                    sx += block.sum(axis=0)
+                    sw_total += block.shape[0]
+                    n_pos += block.shape[0]
+                else:
+                    sx += (block * bw[:, None]).sum(axis=0)
+                    sw_total += float(bw.sum())
+                    n_pos += int((bw > 0).sum())
         if n_rows == 0:
             raise ValueError("make_blocks() yielded no rows — it must "
                              "return a FRESH iterable on every call")
@@ -711,32 +745,43 @@ class GaussianMixture:
         chunk = self.chunk_size
         step_fn = None
 
+        def stage_block(item):
+            """Producer-side share of one block (background thread when
+            ``prefetch > 0``): decode + pad + device placement, so block
+            i+1's IO/transfer overlaps block i's E-pass.  Chunk is sized
+            from the FIRST real block; the queue hand-off publishes it
+            to the consumer before the staged block arrives."""
+            nonlocal chunk
+            block, bw = _split_block(item, d, self.dtype)
+            if chunk is None:
+                data_shards, _ = mesh_shape(mesh)
+                eff_k = k * d if ct == "full" else k
+                chunk = choose_chunk_size(
+                    -(-block.shape[0] // data_shards), eff_k, d,
+                    budget_elems=EM_CHUNK_BUDGET)
+            pts, w = shard_points(block, mesh, chunk, sample_weight=bw)
+            return pts, w
+
         def epoch_stats(tables_list):
             """One pass accumulating each table set's E statistics in
             float64 on the host.  ``tables_list`` holds per-restart
             step arguments (post points/weights)."""
-            nonlocal chunk, step_fn
+            nonlocal step_fn
             acc = [None] * len(tables_list)
-            for item in make_blocks():
-                block, bw = _split_block(item, d, self.dtype)
-                if step_fn is None:
-                    data_shards, _ = mesh_shape(mesh)
-                    eff_k = k * d if ct == "full" else k
-                    chunk = chunk or choose_chunk_size(
-                        -(-block.shape[0] // data_shards), eff_k, d,
-                        budget_elems=EM_CHUNK_BUDGET)
-                    step_fn = _get_fns(mesh, chunk, ct)[0]
-                pts, w = shard_points(block, mesh, chunk,
-                                      sample_weight=bw)
-                outs = [step_fn(pts, w, *t) for t in tables_list]
-                for i, st in enumerate(outs):
-                    st = jax.device_get(st)
-                    tr = self._trim(st)
-                    tr = type(tr)(*[np.asarray(f, np.float64)
-                                    if np.ndim(f) else float(f)
-                                    for f in tr])
-                    acc[i] = tr if acc[i] is None else type(tr)(
-                        *[a + b for a, b in zip(acc[i], tr)])
+            with contextlib.closing(prefetch_iter(
+                    make_blocks(), prefetch, stage_block)) as it:
+                for pts, w in it:
+                    if step_fn is None:
+                        step_fn = _get_fns(mesh, chunk, ct)[0]
+                    outs = [step_fn(pts, w, *t) for t in tables_list]
+                    for i, st in enumerate(outs):
+                        st = jax.device_get(st)
+                        tr = self._trim(st)
+                        tr = type(tr)(*[np.asarray(f, np.float64)
+                                        if np.ndim(f) else float(f)
+                                        for f in tr])
+                        acc[i] = tr if acc[i] is None else type(tr)(
+                            *[a + b for a, b in zip(acc[i], tr)])
             if acc[0] is None:
                 raise ValueError(
                     "make_blocks() yielded no rows — it must return a "
@@ -750,15 +795,20 @@ class GaussianMixture:
                 (mesh, "gmm_total_scatter"),
                 lambda: make_total_scatter_fn(mesh))
             T = np.zeros((d, d))
-            for item in make_blocks():
+
+            def stage_scatter(item):
                 block, bw = _split_block(item, d, self.dtype)
-                pts, w = shard_points(
+                return shard_points(
                     block, mesh, chunk or choose_chunk_size(
                         -(-block.shape[0] // mesh_shape(mesh)[0]), k, d,
                         budget_elems=EM_CHUNK_BUDGET),
                     sample_weight=bw)
-                T += np.asarray(ts_fn(pts, w, jnp.asarray(
-                    shift.astype(self.dtype))), np.float64)
+
+            shift_dev = jnp.asarray(shift.astype(self.dtype))
+            with contextlib.closing(prefetch_iter(
+                    make_blocks(), prefetch, stage_scatter)) as it:
+                for pts, w in it:
+                    T += np.asarray(ts_fn(pts, w, shift_dev), np.float64)
             self._total_scatter = T
 
         # ---- per-restart means over the FULL stream.
@@ -791,7 +841,7 @@ class GaussianMixture:
                                 max_iter=20, verbose=False,
                                 mesh=mesh, compute_labels=False,
                                 empty_cluster="resample")
-                    km.fit_stream(make_blocks, d=d)
+                    km.fit_stream(make_blocks, d=d, prefetch=prefetch)
                     refined.append(np.asarray(km.centroids, np.float64))
                 means_list = refined
 
@@ -1156,20 +1206,24 @@ class GaussianMixture:
     def predict_proba(self, X) -> np.ndarray:
         return np.exp(self._posterior(X)[1])
 
-    def predict_stream(self, make_blocks):
+    def predict_stream(self, make_blocks, *, prefetch: int = 2):
         """Component labels for a bigger-than-memory dataset, one block
         at a time — the inference complement of ``fit_stream`` (mirrors
-        ``KMeans.predict_stream``).  Yields one int32 (m,) array per
-        block of ``make_blocks()``."""
+        ``KMeans.predict_stream``, including its ``prefetch`` staging
+        knob).  Yields one int32 (m,) array per block of
+        ``make_blocks()``."""
         self._check_fitted()
-        return (lab for lab, _, _ in self._posterior_stream(make_blocks))
+        return (lab for lab, _, _ in
+                self._posterior_stream(make_blocks, prefetch=prefetch))
 
-    def score_samples_stream(self, make_blocks):
+    def score_samples_stream(self, make_blocks, *, prefetch: int = 2):
         """Per-sample log-likelihood log p(x), one block at a time."""
         self._check_fitted()
-        return (lse for _, _, lse in self._posterior_stream(make_blocks))
+        return (lse for _, _, lse in
+                self._posterior_stream(make_blocks, prefetch=prefetch))
 
-    def _posterior_stream(self, make_blocks):
+    def _posterior_stream(self, make_blocks, prefetch: int = 0):
+        from kmeans_tpu.data.prefetch import prefetch_iter
         from kmeans_tpu.parallel.sharding import shard_points
         mesh = self._resolve_mesh()
         data_shards, _ = mesh_shape(mesh)
@@ -1177,8 +1231,11 @@ class GaussianMixture:
         k = self.n_components
         from kmeans_tpu.models.init import _block_of
         params = None
-        for block in make_blocks():
-            block = _block_of(block)         # weights irrelevant here
+
+        def stage(item):
+            # Producer-side decode + device placement (prefetch > 0):
+            # block i+1 stages while block i's E-pass computes.
+            block = _block_of(item)          # weights irrelevant here
             block = np.ascontiguousarray(np.asarray(block,
                                                     dtype=self.dtype))
             if block.ndim != 2 or block.shape[1] != d:
@@ -1186,15 +1243,19 @@ class GaussianMixture:
             chunk = self.chunk_size or choose_chunk_size(
                 -(-block.shape[0] // data_shards), k, d,
                 budget_elems=EM_CHUNK_BUDGET)
-            _, predict_fn = _get_fns(mesh, chunk, self.covariance_type)
-            if params is None:
-                params = self._params_dev(mesh)
             pts, _ = shard_points(block, mesh, chunk)
-            labels, logr, lse = predict_fn(pts, *params)
-            m = block.shape[0]
-            yield (np.asarray(labels)[:m],
-                   np.asarray(logr)[:m, :k].astype(np.float64),
-                   np.asarray(lse)[:m].astype(np.float64))
+            return block.shape[0], chunk, pts
+
+        with contextlib.closing(prefetch_iter(make_blocks(), prefetch,
+                                              stage)) as it:
+            for m, chunk, pts in it:
+                _, predict_fn = _get_fns(mesh, chunk, self.covariance_type)
+                if params is None:
+                    params = self._params_dev(mesh)
+                labels, logr, lse = predict_fn(pts, *params)
+                yield (np.asarray(labels)[:m],
+                       np.asarray(logr)[:m, :k].astype(np.float64),
+                       np.asarray(lse)[:m].astype(np.float64))
 
     def score_samples(self, X) -> np.ndarray:
         """Per-sample log-likelihood log p(x) under the mixture."""

@@ -443,15 +443,22 @@ def _reservoir_pass(make_blocks, cap: int, k: int, d: int, seeds,
     cap-row Algorithm-R reservoir per restart over the POSITIVE-weight
     rows of the whole stream (the in-memory ``forgy_init`` weight rule).
     Raises the standard n<k error.  Returns (reservoirs, n_rows)."""
+    from kmeans_tpu.data.prefetch import close_source
     res = [_EpochReservoir(cap, d, np.random.default_rng([s, salt]))
            for s in seeds]
     n = 0
-    for item in make_blocks():
-        block, bw = _split_block(item, d, np.float64)
-        b = block if bw is None else block[bw > 0]
-        n += len(b)
-        for r in res:
-            r.offer(b)
+    # close_source in finally: a decode error mid-pass must reap a
+    # prefetching source's producer thread, not leave it to cyclic GC.
+    it = iter(make_blocks())
+    try:
+        for item in it:
+            block, bw = _split_block(item, d, np.float64)
+            b = block if bw is None else block[bw > 0]
+            n += len(b)
+            for r in res:
+                r.offer(b)
+    finally:
+        close_source(it)
     if n < k:
         raise ValueError(
             f"Not enough data points ({n}) to initialize {k} clusters")
@@ -562,13 +569,18 @@ def streamed_kmeans_parallel_init(make_blocks, k: int, seeds, d: int,
     cap = int(min(max(2 * k, 256), 2048))
     res = [_EpochReservoir(1, d, np.random.default_rng([s, 0xF1257]))
            for s in seeds]
+    from kmeans_tpu.data.prefetch import close_source
     n = 0
-    for item in make_blocks():                       # pass: first cand + n
-        block, bw = _split_block(item, d, np.float64)
-        b = block if bw is None else block[bw > 0]
-        n += len(b)
-        for r in res:
-            r.offer(b)
+    it = iter(make_blocks())                         # pass: first cand + n
+    try:
+        for item in it:
+            block, bw = _split_block(item, d, np.float64)
+            b = block if bw is None else block[bw > 0]
+            n += len(b)
+            for r in res:
+                r.offer(b)
+    finally:
+        close_source(it)
     if n < k:
         raise ValueError(
             f"Not enough data points ({n}) to initialize {k} clusters")
@@ -582,12 +594,16 @@ def streamed_kmeans_parallel_init(make_blocks, k: int, seeds, d: int,
         reduction weighted."""
         from kmeans_tpu.parallel.sharding import pad_points
         mult = -(-cap // 512) * 512      # >= cap AND a 512-chunk multiple
-        for item in make_blocks():
-            block, bw = _split_block(item, d, dtype)
-            x, w = pad_points(block, mult)
-            if bw is not None:
-                w[: block.shape[0]] *= bw.astype(w.dtype)
-            yield x, w
+        it = iter(make_blocks())
+        try:
+            for item in it:
+                block, bw = _split_block(item, d, dtype)
+                x, w = pad_points(block, mult)
+                if bw is not None:
+                    w[: block.shape[0]] *= bw.astype(w.dtype)
+                yield x, w
+        finally:
+            close_source(it)
 
     phi = np.zeros(R)
     for x, w in epoch_blocks():                      # pass: initial phi

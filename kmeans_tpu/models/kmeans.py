@@ -38,6 +38,7 @@ all the broadcast/shuffle/collect traffic.
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import List, Optional, Union
 
@@ -635,7 +636,7 @@ class KMeans:
         return self
 
     def fit_stream(self, make_blocks, *, d: Optional[int] = None,
-                   resume: bool = False) -> "KMeans":
+                   resume: bool = False, prefetch: int = 2) -> "KMeans":
         """EXACT full-batch Lloyd over data larger than device memory.
 
         ``make_blocks()`` returns a fresh iterable of (n_i, D) host blocks;
@@ -699,11 +700,26 @@ class KMeans:
         ``sample_weight`` (streamed inits draw uniformly over
         POSITIVE-weight rows, the in-memory rule; the streamed kmeans||
         weights its D² mass).
+
+        ``prefetch`` (default 2): each epoch runs through a bounded
+        background producer (``data.prefetch.prefetch_iter``) that
+        reads/decodes block i+1 and starts its ``jax.device_put`` onto
+        the data-mesh sharding while block i's step computes — on
+        IO/transfer-bound streams the epoch cost drops toward
+        max(IO, compute) instead of their sum (measured numbers in
+        docs/PERFORMANCE.md "Streaming pipeline").  ``prefetch=0`` is
+        the synchronous path; the trajectory is BIT-IDENTICAL either
+        way (only where the work happens moves, never its order —
+        pinned by tests/test_prefetch.py).  Device residency grows from
+        1 to at most ``prefetch + 2`` blocks.
         """
+        from kmeans_tpu.data.prefetch import (check_prefetch, close_source,
+                                              prefetch_iter)
         from kmeans_tpu.parallel.sharding import shard_points
         from kmeans_tpu.models.init import (STREAM_INITIALIZERS,
                                             _split_block,
                                             streamed_init_sample)
+        prefetch = check_prefetch(prefetch)
         log = IterationLogger(self.verbose and jax.process_index() == 0)
         muted = IterationLogger(False)
         log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
@@ -711,9 +727,16 @@ class KMeans:
         explicit_init = not isinstance(self.init, str) \
             and not callable(self.init)
         if d is None:
-            item = next(iter(make_blocks()))
-            peek = np.asarray(item[0] if isinstance(item, tuple) else item,
-                              dtype=self.dtype)
+            # close_source: a prefetching source (e.g.
+            # iter_npy_blocks(prefetch=N)) must have its producer
+            # thread reaped when the peek abandons it after one item.
+            peek_it = iter(make_blocks())
+            try:
+                item = next(peek_it)
+                peek = np.asarray(item[0] if isinstance(item, tuple)
+                                  else item, dtype=self.dtype)
+            finally:
+                close_source(peek_it)
             if peek.ndim != 2:
                 raise ValueError(f"blocks must be 2-D (m, D), got shape "
                                  f"{peek.shape}")
@@ -802,47 +825,68 @@ class KMeans:
         R = len(states)
         want_reservoir = self.empty_cluster == "resample"
         acc = np.float64
-        step_fn = chunk = None                     # sized from first block
+        step_fn = chunk = mode = None              # sized from first block
+
+        def stage(item):
+            """Producer-side share of one block (with ``prefetch > 0``
+            this runs in the background thread): decode + pad +
+            ``device_put`` onto the data-mesh sharding — block i+1's IO
+            and transfer overlap block i's step.  Chunk/mode are sized
+            from the FIRST real block; only the producer writes them,
+            and the queue hand-off publishes them before the staged
+            block reaches the consumer."""
+            nonlocal chunk, mode
+            block, bw = _split_block(item, d, self.dtype)
+            if chunk is None:                      # chunk from a REAL block
+                chunk = self._chunk_for(block.shape[0], d)
+                mode = self._mode(block.shape[0], d)
+            pts, w = shard_points(block, mesh, chunk, sample_weight=bw)
+            return block, bw, pts, w
 
         def epoch(active, cents_dev, iteration, score_only=False):
             """One pass over the stream accumulating every active
             restart's dense statistics (shared IO, R x compute)."""
-            nonlocal step_fn, chunk
+            nonlocal step_fn
             sums = [np.zeros((self.k, d), acc) for _ in active]
             counts = [np.zeros((self.k,), acc) for _ in active]
             sse = [0.0] * len(active)
             far = [(-1.0, None)] * len(active)
             n_seen = 0
-            for item in make_blocks():
-                block, bw = _split_block(item, d, self.dtype)
-                if step_fn is None:                # chunk from a REAL block
-                    _, _, step_fn, _, chunk = self._setup(block.shape[0], d)
-                if want_reservoir and not score_only:
-                    # Uniform over POSITIVE-weight rows — the in-memory
-                    # 'resample' engine's rule (zero-weight rows must
-                    # never seed a centroid).
-                    offer = block if bw is None else block[bw > 0]
-                    for st_r in active:
-                        st_r.meta.reservoir.offer(offer)
-                n_seen += block.shape[0]
-                pts, w = shard_points(block, mesh, chunk,
-                                      sample_weight=bw)
-                # Dispatch every restart's step BEFORE any transfer, then
-                # ONE combined device_get per restart — each separate
-                # np.asarray pays a full host round trip on tunneled
-                # platforms, and an early transfer would also serialize
-                # the remaining restarts' dispatches behind it.
-                outs = [step_fn(pts, w, cents_dev[i])
-                        for i in range(len(active))]
-                for i, st in enumerate(outs):
-                    s_h, c_h, sse_h, fd_h, fp_h = jax.device_get(
-                        (st.sums, st.counts, st.sse, st.farthest_dist,
-                         st.farthest_point))
-                    sums[i] += np.asarray(s_h, dtype=acc)[: self.k]
-                    counts[i] += np.asarray(c_h, dtype=acc)[: self.k]
-                    sse[i] += float(sse_h)
-                    if float(fd_h) > far[i][0]:
-                        far[i] = (float(fd_h), np.asarray(fp_h, dtype=acc))
+            # contextlib.closing: a consumer-side error mid-epoch must
+            # join the producer thread deterministically (the thread's
+            # target holds a reference cycle to the iterator, so GC
+            # alone reaps it too late).
+            with contextlib.closing(prefetch_iter(make_blocks(),
+                                                  prefetch, stage)) as it:
+                for block, bw, pts, w in it:
+                    if step_fn is None:
+                        step_fn, _ = _get_step_fns(mesh, chunk, mode)
+                    if want_reservoir and not score_only:
+                        # Uniform over POSITIVE-weight rows — the in-memory
+                        # 'resample' engine's rule (zero-weight rows must
+                        # never seed a centroid).  Offers stay CONSUMER-side
+                        # in block order: the reservoir draw stream (and so
+                        # the trajectory) is prefetch-invariant.
+                        offer = block if bw is None else block[bw > 0]
+                        for st_r in active:
+                            st_r.meta.reservoir.offer(offer)
+                    n_seen += block.shape[0]
+                    # Dispatch every restart's step BEFORE any transfer, then
+                    # ONE combined device_get per restart — each separate
+                    # np.asarray pays a full host round trip on tunneled
+                    # platforms, and an early transfer would also serialize
+                    # the remaining restarts' dispatches behind it.
+                    outs = [step_fn(pts, w, cents_dev[i])
+                            for i in range(len(active))]
+                    for i, st in enumerate(outs):
+                        s_h, c_h, sse_h, fd_h, fp_h = jax.device_get(
+                            (st.sums, st.counts, st.sse, st.farthest_dist,
+                             st.farthest_point))
+                        sums[i] += np.asarray(s_h, dtype=acc)[: self.k]
+                        counts[i] += np.asarray(c_h, dtype=acc)[: self.k]
+                        sse[i] += float(sse_h)
+                        if float(fd_h) > far[i][0]:
+                            far[i] = (float(fd_h), np.asarray(fp_h, dtype=acc))
             if n_seen == 0:
                 raise ValueError(
                     f"make_blocks() yielded no rows on iteration "
@@ -1203,14 +1247,17 @@ class KMeans:
         local = np.concatenate([blocks[s] for s in sorted(blocks)])
         return local[: ds.local_rows]
 
-    def predict_stream(self, make_blocks):
+    def predict_stream(self, make_blocks, *, prefetch: int = 2):
         """Labels for a bigger-than-HBM dataset, one block at a time.
 
         The streaming complement of ``fit_stream``: ``make_blocks()``
         yields (m, D) arrays (e.g. ``data.io.iter_npy_blocks``); this
         generator yields one int32 (m,) label array per block, uploading
         only a block at a time.  Blocks may vary in size (each distinct
-        padded size compiles once).  Usage::
+        padded size compiles once).  ``prefetch`` (default 2) stages the
+        next blocks' read + decode + device placement in a background
+        thread while the current block's assignment computes
+        (``fit_stream``'s knob; 0 = synchronous).  Usage::
 
             labels = np.concatenate(list(km.predict_stream(blocks)))
         """
@@ -1219,9 +1266,10 @@ class KMeans:
         # the returned generator.
         if self.centroids is None:
             raise ValueError("Model must be fitted before prediction")
-        return self._predict_stream_blocks(make_blocks)
+        return self._predict_stream_blocks(make_blocks, prefetch)
 
-    def _iter_stream_blocks(self, make_blocks, *, with_weights: bool):
+    def _iter_stream_blocks(self, make_blocks, *, with_weights: bool,
+                            prefetch: int = 0, stage_extra=None):
         """Shared scaffolding of every streaming inference/scoring
         surface (predict/transform/score streams): decode each item
         ((block, weights) pairs kept or dropped per ``with_weights``),
@@ -1229,34 +1277,62 @@ class KMeans:
         fitted centroids ONCE, and raise the FRESH-iterable error on an
         empty stream (an exhausted generator must not silently produce
         zero output — review r4).  Yields
-        (block, weights_or_None, cents_dev, mesh, model_shards)."""
+        (block, weights_or_None, extra, cents_dev, mesh, model_shards).
+
+        ``prefetch``/``stage_extra``: with ``prefetch > 0`` the decode —
+        and ``stage_extra(block, bw)``, the caller's hook for its
+        per-block device placement — run in a background producer
+        thread ``prefetch`` blocks ahead (``data.prefetch``); ``extra``
+        is ``stage_extra``'s return (None without the hook)."""
+        from kmeans_tpu.data.prefetch import check_prefetch, prefetch_iter
         from kmeans_tpu.models.init import _block_of, _split_block
+        prefetch = check_prefetch(prefetch)
         mesh = self._resolve_mesh()
         _, model_shards = mesh_shape(mesh)
         d = self.centroids.shape[1]
         cents_dev = None
         empty = True
-        for item in make_blocks():
+
+        def stage(item):
             raw = item if with_weights else _block_of(item)
             block, bw = _split_block(raw, d, self.dtype)
-            empty = False
-            if cents_dev is None:
-                cents_dev = self._put_centroids(
-                    np.asarray(self.centroids), mesh, model_shards)
-            yield block, bw, cents_dev, mesh, model_shards
+            extra = stage_extra(block, bw) if stage_extra is not None \
+                else None
+            return block, bw, extra
+
+        # closing: a consumer abandoning this generator early (break /
+        # close()) must join the producer thread deterministically — the
+        # thread target's reference cycle keeps GC from reaping it
+        # promptly.
+        with contextlib.closing(prefetch_iter(make_blocks(), prefetch,
+                                              stage)) as it:
+            for block, bw, extra in it:
+                empty = False
+                if cents_dev is None:
+                    cents_dev = self._put_centroids(
+                        np.asarray(self.centroids), mesh, model_shards)
+                yield block, bw, extra, cents_dev, mesh, model_shards
         if empty:
             raise ValueError(
                 "make_blocks() yielded no rows — it must return a FRESH "
                 "iterable on every call")
 
-    def _predict_stream_blocks(self, make_blocks):
+    def _predict_stream_blocks(self, make_blocks, prefetch: int = 0):
         from kmeans_tpu.parallel.sharding import shard_points
-        for block, _, cents_dev, mesh, _ in self._iter_stream_blocks(
-                make_blocks, with_weights=False):
+
+        def stage_extra(block, bw):
+            # Device placement of the NEXT block overlaps the current
+            # block's assignment pass (prefetch > 0).
             chunk = self._chunk_for(*block.shape)
+            pts, _ = shard_points(block, self._resolve_mesh(), chunk)
+            return chunk, pts
+
+        for block, _, (chunk, pts), cents_dev, mesh, _ in \
+                self._iter_stream_blocks(make_blocks, with_weights=False,
+                                         prefetch=prefetch,
+                                         stage_extra=stage_extra):
             _, predict_fn = _get_step_fns(mesh, chunk,
                                           self._mode(*block.shape))
-            pts, _ = shard_points(block, mesh, chunk)
             yield np.asarray(predict_fn(pts, cents_dev))[: block.shape[0]]
 
     def fit_predict(self, X, y=None) -> np.ndarray:
@@ -1295,16 +1371,23 @@ class KMeans:
         return out
 
     def transform_stream(self, make_blocks, *,
-                         block_rows: Optional[int] = None):
+                         block_rows: Optional[int] = None,
+                         prefetch: int = 2):
         """Streaming ``transform``: yields (m, k) Euclidean-distance tiles
         for successive row blocks of ``make_blocks()`` (bounded host AND
         device memory — the complement of ``predict_stream``).  Input
-        blocks larger than ``block_rows`` are split."""
+        blocks larger than ``block_rows`` are split.  ``prefetch``
+        (default 2) reads/decodes input blocks ahead in a background
+        thread (the per-tile device placement stays consumer-side —
+        tile splitting is row-budgeted, see ``block_rows``); 0 =
+        synchronous."""
         if self.centroids is None:
             raise ValueError("Model must be fitted before prediction")
-        return self._transform_stream_blocks(make_blocks, block_rows)
+        return self._transform_stream_blocks(make_blocks, block_rows,
+                                             prefetch)
 
-    def _transform_stream_blocks(self, make_blocks, block_rows):
+    def _transform_stream_blocks(self, make_blocks, block_rows,
+                                 prefetch: int = 0):
         from kmeans_tpu.parallel.sharding import shard_points
         data_shards, _ = mesh_shape(self._resolve_mesh())
         # The full (n, k) matrix only exists on the host; pallas/auto map
@@ -1319,8 +1402,8 @@ class KMeans:
         # small-k/large-D transform upload an unbounded input block.
         block = block_rows or max(
             8192 * data_shards, (1 << 26) // max(self.k + d_model, 1))
-        for raw, _, cents_dev, mesh, _ in self._iter_stream_blocks(
-                make_blocks, with_weights=False):
+        for raw, _, _, cents_dev, mesh, _ in self._iter_stream_blocks(
+                make_blocks, with_weights=False, prefetch=prefetch):
             for start in range(0, raw.shape[0], block):
                 xb = np.ascontiguousarray(raw[start: start + block])
                 chunk = self._chunk_for(*xb.shape)
@@ -1342,22 +1425,31 @@ class KMeans:
         stats = step_fn(ds.points, ds.weights, cents_dev)
         return -float(stats.sse)
 
-    def score_stream(self, make_blocks) -> float:
+    def score_stream(self, make_blocks, *, prefetch: int = 2) -> float:
         """Negative SSE of a block stream under the fitted centroids —
         the scoring complement of ``fit_stream``/``predict_stream`` (one
         pass, bounded device memory; items may be (block, weights)
-        pairs).  An empty/exhausted stream raises rather than returning
-        a perfect -0.0 score."""
+        pairs).  ``prefetch`` (default 2) stages the next blocks' read +
+        decode + device placement while the current block's pass
+        computes (0 = synchronous).  An empty/exhausted stream raises
+        rather than returning a perfect -0.0 score."""
         from kmeans_tpu.parallel.sharding import shard_points
         if self.centroids is None:
             raise ValueError("Model must be fitted before prediction")
-        sse = 0.0
-        for block, bw, cents_dev, mesh, _ in self._iter_stream_blocks(
-                make_blocks, with_weights=True):
+
+        def stage_extra(block, bw):
             chunk = self._chunk_for(*block.shape)
+            pts, w = shard_points(block, self._resolve_mesh(), chunk,
+                                  sample_weight=bw)
+            return chunk, pts, w
+
+        sse = 0.0
+        for block, bw, (chunk, pts, w), cents_dev, mesh, _ in \
+                self._iter_stream_blocks(make_blocks, with_weights=True,
+                                         prefetch=prefetch,
+                                         stage_extra=stage_extra):
             step_fn, _ = _get_step_fns(mesh, chunk,
                                        self._mode(*block.shape))
-            pts, w = shard_points(block, mesh, chunk, sample_weight=bw)
             sse += float(step_fn(pts, w, cents_dev).sse)
         return -sse
 

@@ -600,7 +600,8 @@ class MiniBatchKMeans(KMeans):
         self._set_fit_data(X)
         return self
 
-    def fit_stream(self, make_blocks, *, d=None):
+    def fit_stream(self, make_blocks, *, d=None, resume=False,
+                   prefetch=2):
         """Blocked: the inherited exact-Lloyd ``fit_stream`` would silently
         bypass mini-batch semantics (ADVICE r1).  For streaming, feed blocks
         through ``partial_fit``; for an exact bigger-than-memory fit, use

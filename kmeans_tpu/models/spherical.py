@@ -137,16 +137,20 @@ class SphericalKMeans(KMeans):
                         np.asarray(item, np.float64)).astype(self.dtype)
         return wrapped
 
-    def fit_stream(self, make_blocks, *, d=None,
-                   resume: bool = False) -> "SphericalKMeans":
+    def fit_stream(self, make_blocks, *, d=None, resume: bool = False,
+                   prefetch: int = 2) -> "SphericalKMeans":
         return super().fit_stream(self._normalized_blocks(make_blocks),
-                                  d=d, resume=resume)
+                                  d=d, resume=resume, prefetch=prefetch)
 
-    def _iter_stream_blocks(self, make_blocks, *, with_weights: bool):
+    def _iter_stream_blocks(self, make_blocks, *, with_weights: bool,
+                            prefetch: int = 0, stage_extra=None):
         """One choke point for every streaming inference/scoring surface
         (predict/transform/score streams all route through here): wrapping
         per public method instead let ``score_stream`` ship un-normalized
         (advisor r4), and a future base-class stream method would repeat
-        that bug.  ``fit_stream`` has its own path and wraps separately."""
+        that bug.  ``fit_stream`` has its own path and wraps separately.
+        With ``prefetch > 0`` the normalization runs in the producer
+        thread too (the wrapped generator is driven from there)."""
         return super()._iter_stream_blocks(
-            self._normalized_blocks(make_blocks), with_weights=with_weights)
+            self._normalized_blocks(make_blocks), with_weights=with_weights,
+            prefetch=prefetch, stage_extra=stage_extra)

@@ -513,11 +513,13 @@ def _call(points, weights, centroids, *, tile_n, tile_k, bf16, interpret,
                    pltpu.VMEM((2, tile_n, 1), jnp.int32)]
 
     grid = (n_tiles + 1,) if pipelined else (n_tiles,)
+    # CompilerParams was TPUCompilerParams before jax 0.6 — same fields.
+    params_cls = getattr(pltpu, "CompilerParams", None) or \
+        pltpu.TPUCompilerParams
     outs = pl.pallas_call(
         kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
         out_shape=out_shape, scratch_shapes=scratch,
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=params_cls(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(*((x, w, c, h) if with_stats else (x, c, h)))
     if not with_stats:

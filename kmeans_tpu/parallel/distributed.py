@@ -35,7 +35,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kmeans_tpu.ops.assign import (StepStats, _accum_dtype, accumulate_chunk,
                                    init_stats, pairwise_sq_dists)
-from kmeans_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, mesh_shape
+from kmeans_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS, axis_size,
+                                      mesh_shape, shard_map)
 
 # Sentinel coordinate for centroid-table padding rows (when k doesn't divide
 # the model axis).  Large enough that no real point ever selects a padding
@@ -295,7 +296,7 @@ def make_step_fn(mesh: Mesh, *, chunk_size: int,
         return StepStats(sums_full, counts_full, sse, far_ds[j], far_ps[j],
                          sse_pc_full)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         step, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(MODEL_AXIS, None)),
         out_specs=StepStats(P(None, None), P(None), P(), P(), P(None),
@@ -372,7 +373,7 @@ def _refill_empty_slots_batched(new, is_empty, skip, points, weights,
     slot keeps its old centroid, the host path's under-return rule
     (kmeans_spark.py:201-204, kmeans.py._handle_empty); the host device
     engine caps its draw count the same way."""
-    data_shards = lax.axis_size(DATA_AXIS)
+    data_shards = axis_size(DATA_AXIS)
     d_idx = lax.axis_index(DATA_AXIS)
     n_glob = n_orig * data_shards
     R = new.shape[0]
@@ -586,7 +587,7 @@ def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
             cond, body, state)
         return cents[:k_real], i, sse_hist, shift_hist, counts[:k_real]
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         fit, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(MODEL_AXIS, None),
                   P(None)),
@@ -772,7 +773,7 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
         return (cents[best, :k_real], n_iters[best], sse_hist[best],
                 shift_hist[best], counts_out[best, :k_real], best, final_sse)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         fit, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS),
                   P(None, MODEL_AXIS, None), P(None, None)),
@@ -864,7 +865,7 @@ def make_minibatch_step_fn(mesh: Mesh, *, batch_per_shard: int,
 
     stats_spec = StepStats(P(None, None), P(None), P(), P(), P(None),
                            P(None))
-    mapped = jax.shard_map(
+    mapped = shard_map(
         step, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(MODEL_AXIS, None),
                   P(None), P()),
@@ -1088,7 +1089,7 @@ def make_minibatch_fit_fn(mesh: Mesh, *, batch_per_shard: int,
         return (cents[:k_real], seen[:k_real], i, sse_hist, shift_hist,
                 counts[:k_real])
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         fit, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(MODEL_AXIS, None),
                   P(None), P(), P(None)),
@@ -1149,7 +1150,7 @@ def make_predict_fn(mesh: Mesh, *, chunk_size: int,
         _, labels = lax.scan(body, None, xs)
         return labels.reshape(-1)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         predict, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(MODEL_AXIS, None)),
         out_specs=P(DATA_AXIS),
@@ -1180,7 +1181,7 @@ def make_transform_fn(mesh: Mesh, *, chunk_size: int,
         _, out = lax.scan(body, None, xs)
         return out.reshape(-1, k_local)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         dists, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(MODEL_AXIS, None)),
         out_specs=P(DATA_AXIS, MODEL_AXIS),

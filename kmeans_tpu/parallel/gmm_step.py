@@ -63,7 +63,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from kmeans_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, mesh_shape
+from kmeans_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS, mesh_shape,
+                                      shard_map)
 
 _LOG2PI = math.log(2.0 * math.pi)
 
@@ -200,7 +201,7 @@ def make_gmm_step_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
         return _embed_psum(st, k_local * model_shards, k_local,
                            model_shards)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         step, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None),
                   P(MODEL_AXIS, None), P(MODEL_AXIS, None), P(MODEL_AXIS),
@@ -228,7 +229,7 @@ def make_gmm_predict_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
                 log_weights),
             points, chunk_size, k_local, d, model_shards)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         predict, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(None), P(MODEL_AXIS, None),
                   P(MODEL_AXIS, None), P(MODEL_AXIS), P(MODEL_AXIS)),
@@ -407,7 +408,7 @@ def make_gmm_step_full_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
         return _embed_psum_full(st, k_local * model_shards, k_local,
                                 model_shards)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         step, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None),
                   P(MODEL_AXIS, None), P(MODEL_AXIS, None, None),
@@ -475,7 +476,7 @@ def make_gmm_step_tied_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
         return _embed_psum(st, k_local * model_shards, k_local,
                            model_shards)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         step, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None),
                   P(MODEL_AXIS, None), P(None, None), P(), P(MODEL_AXIS)),
@@ -496,7 +497,7 @@ def make_total_scatter_fn(mesh: Mesh) -> Callable:
                             precision=lax.Precision.HIGHEST)
         return lax.psum(t, DATA_AXIS)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         total, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None)),
         out_specs=P(None, None), check_vma=False)
@@ -621,7 +622,7 @@ def make_gmm_multi_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
         return (means_c[best], var[best], log_w[best], n_it[best],
                 hist[best], conv[best], best, final_lls)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         fit, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None),
                   P(None, None, None), P(None, None, None),
@@ -717,7 +718,7 @@ def make_gmm_fit_full_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
             cond, body, state)
         return means_c, cov, log_w, it, hist, conv
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         fit, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None),
                   P(None, None), P(None, None, None), P(None)),
@@ -807,7 +808,7 @@ def make_gmm_fit_tied_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
             cond, body, state)
         return means_c, cov, log_w, it, hist, conv
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         fit, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None),
                   P(None, None), P(None, None), P(None)),
@@ -830,7 +831,7 @@ def make_gmm_predict_full_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
                 log_weights),
             points, chunk_size, k_local, d, model_shards)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         predict, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(None), P(MODEL_AXIS, None),
                   P(MODEL_AXIS, None, None), P(MODEL_AXIS),
@@ -854,7 +855,7 @@ def make_gmm_predict_tied_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
                 log_weights),
             points, chunk_size, k_local, d, model_shards)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         predict, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(None), P(MODEL_AXIS, None),
                   P(None, None), P(), P(MODEL_AXIS)),
@@ -981,7 +982,7 @@ def make_gmm_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
             cond, body, state)
         return means_c, var, log_w, it, hist, conv
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         fit, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None),
                   P(None, None), P(None, None), P(None)),

@@ -20,6 +20,31 @@ DATA_AXIS = "data"    # shards the N points (DP — the reference's partitions)
 MODEL_AXIS = "model"  # shards the k centroids (TP/EP analogue; optional)
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """Version-portable ``shard_map``: newer JAX exposes it as
+    ``jax.shard_map(..., check_vma=...)``; on older installs (< 0.6) it
+    lives in ``jax.experimental.shard_map`` and the replication-check
+    kwarg is named ``check_rep``.  Every kernel builder routes through
+    here so the whole SPMD surface works on both."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
+def axis_size(name: str) -> int:
+    """Version-portable STATIC mesh-axis size inside a mapped body:
+    ``lax.axis_size`` where it exists (newer JAX), else the classic
+    ``psum(1, axis)`` idiom, which constant-folds to a Python int at
+    trace time on older installs."""
+    from jax import lax
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(name)
+    return lax.psum(1, name)
+
+
 def make_mesh(data: Optional[int] = None, model: int = 1,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Build a (data, model) mesh over the available devices.

@@ -50,9 +50,17 @@ def initialize(coordinator_address: Optional[str] = None,
     or running single-process (so the same script runs everywhere)."""
     # NOTE: probe via jax.distributed.is_initialized(), NOT
     # jax.process_count() — the latter initializes the XLA backends, which
-    # would make the distributed handshake below impossible.
-    if jax.distributed.is_initialized():
-        return                          # already initialized
+    # would make the distributed handshake below impossible.  Older JAX
+    # (< 0.6) has no is_initialized(); its documented equivalent is the
+    # distributed global_state client probe.
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        if probe():
+            return                      # already initialized
+    else:
+        from jax._src import distributed as _dist
+        if getattr(_dist.global_state, "client", None) is not None:
+            return                      # already initialized
     explicit = any(a is not None for a in
                    (coordinator_address, num_processes, process_id))
     try:

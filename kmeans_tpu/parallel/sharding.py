@@ -62,7 +62,7 @@ SINGLE_CHUNK_ELEMS = 1 << 26
 
 def choose_chunk_size(n_local: int, k: int, d: int,
                       budget_elems: Optional[int] = None,
-                      max_chunk: int = 1 << 17) -> int:
+                      max_chunk: Optional[int] = None) -> int:
     """Pick the scan chunk size for the fused assign+reduce pass.
 
     Two measured regimes (experiments/exp_small_shapes.py has the r5
@@ -93,15 +93,27 @@ def choose_chunk_size(n_local: int, k: int, d: int,
     """
     if budget_elems is None:
         if n_local * max(k, 1) <= SINGLE_CHUNK_ELEMS:
-            return int(max(128, -(-n_local // 8) * 8))
+            one = int(max(128, -(-n_local // 8) * 8))
+            if max_chunk is not None:
+                # A caller passing an EXPLICIT cap (None = unspecified,
+                # so even an explicit 2^17 counts) keeps it in the
+                # single-chunk region — the shortcut deliberately
+                # exceeds the implicit default cap (that is its whole
+                # point), but it must not silently violate a stated
+                # contract (ADVICE r5 low).
+                one = min(one, int(max(128, (max_chunk // 8) * 8)))
+            return one
         budget_elems = 1 << 25
+    if max_chunk is None:
+        max_chunk = 1 << 17
     chunk = max(128, min(n_local, budget_elems // max(k, 1), max_chunk))
     chunk = min(chunk, max(n_local, 128))
     return int(max(8, (chunk // 8) * 8))
 
 
 def clamp_chunk_for_k(chunk: int, k: int,
-                      budget_elems: int = SINGLE_CHUNK_ELEMS) -> int:
+                      budget_elems: int = SINGLE_CHUNK_ELEMS,
+                      max_chunk: Optional[int] = None) -> int:
     """Bound the (chunk, k) fit-time temporary when the REAL k exceeds
     the ``k_hint`` a dataset's chunk was auto-chosen with (r5 review
     finding): a ``from_npy(..., k_hint=16)`` load of a 4M-row shard gets
@@ -114,6 +126,12 @@ def clamp_chunk_for_k(chunk: int, k: int,
     (chunk', k) tile fits ``budget_elems`` — a divisor, because the
     dataset's padding committed to whole-``chunk`` multiples per shard
     (shard_points), so only divisors re-chunk without re-padding.
+    ``max_chunk`` (optional) additionally bounds the clamped divisor by
+    a scan-regime row cap — EM callers pass their measured plateau
+    (``models.gmm.EM_MAX_CHUNK``) so mis-hinted foreign datasets land
+    near it instead of wherever the element budget alone allows
+    (ADVICE r5 low).
+
     No-op when the tile already fits (every auto-chosen chunk whose
     hint matched the fitted k); when ``chunk`` is already at or below
     the 128-row floor ``choose_chunk_size`` enforces — clamping below
@@ -123,20 +141,49 @@ def clamp_chunk_for_k(chunk: int, k: int,
     ``chunk`` is not a multiple of 8 — an explicit user ``chunk_size``
     outside the auto rule's 8-row grid must pass through untouched,
     because only true divisors of the committed chunk re-chunk safely
-    and ``chunk // 8`` would silently floor it."""
-    if chunk * max(k, 1) <= budget_elems or chunk <= 128 or chunk % 8:
+    and ``chunk // 8`` would silently floor it.
+
+    Divisor-pathology fallback (ADVICE r5 medium): when the committed
+    chunk has no multiple-of-8 divisor that is both >= 128 and within
+    the budget (sparse divisor structure — e.g. a 4,000,008-row
+    single-chunk shard, whose divisors jump from 24 straight to
+    1,333,336), the budget-honoring answer would scan degenerate
+    sub-sublane tiles (~167k 24-row scan steps for that shard at
+    k=1024).  Instead the SMALLEST multiple-of-8 divisor >= 128 is
+    returned — accepting the budget overshoot — with a ``UserWarning``
+    naming the pathology and the fix (reshard, or load with the real
+    ``k_hint``/an explicit ``chunk_size``)."""
+    fits = chunk * max(k, 1) <= budget_elems and \
+        (max_chunk is None or chunk <= max_chunk)
+    if fits or chunk <= 128 or chunk % 8:
         return chunk
     target = max(8, budget_elems // max(k, 1))
+    if max_chunk is not None:
+        target = min(target, max(8, max_chunk))
     base = chunk // 8
-    best = 1
+    best = 1          # largest divisor*8 within target
+    small = base      # smallest divisor*8 that is >= 128
     i = 1
     while i * i <= base:
         if base % i == 0:
             for cand in (i, base // i):
                 if cand * 8 <= target and cand > best:
                     best = cand
+                if cand * 8 >= 128 and cand < small:
+                    small = cand
         i += 1
-    return best * 8
+    if best * 8 >= 128:
+        return best * 8
+    import warnings
+    warnings.warn(
+        f"clamp_chunk_for_k: the committed chunk {chunk} has no "
+        f"multiple-of-8 divisor between 128 and the {target}-row "
+        f"budget for k={k}; using {small * 8} rows (budget overshoot) "
+        f"instead of degenerate {best * 8}-row scan tiles — reshard the "
+        f"dataset or load it with the real k_hint / an explicit "
+        f"chunk_size to avoid the oversized tile", UserWarning,
+        stacklevel=3)
+    return small * 8
 
 
 def pad_points(x: np.ndarray, multiple: int) -> Tuple[np.ndarray, np.ndarray]:
@@ -230,7 +277,8 @@ class ShardedDataset:
         return np.dtype(str(self.points.dtype))
 
     def effective_chunk(self, k: int,
-                        budget_elems: int = SINGLE_CHUNK_ELEMS) -> int:
+                        budget_elems: int = SINGLE_CHUNK_ELEMS,
+                        max_chunk: Optional[int] = None) -> int:
         """The chunk fits should scan this dataset with for a model of
         ``k`` clusters/components: ``self.chunk`` unless that would
         materialize an oversized (chunk, k) tile because the load-time
@@ -238,12 +286,16 @@ class ShardedDataset:
         (clamp_chunk_for_k).  Models pass their real TILE width here —
         k, or k*D for modes staging (chunk, k, D) tensors — instead of
         reading ``.chunk`` directly; EM callers pass their own measured
-        ``budget_elems`` (models.gmm.EM_CHUNK_BUDGET).  An EXPLICIT
-        user chunk (loader/model ``chunk_size``) passes through
-        untouched — it is the documented override."""
+        ``budget_elems`` (models.gmm.EM_CHUNK_BUDGET) and plateau row
+        cap (``max_chunk`` = models.gmm.EM_MAX_CHUNK), so mis-hinted
+        foreign datasets land near the measured optimum, not merely
+        inside the element budget.  An EXPLICIT user chunk
+        (loader/model ``chunk_size``) passes through untouched — it is
+        the documented override."""
         if self.explicit_chunk:
             return self.chunk
-        return clamp_chunk_for_k(self.chunk, k, budget_elems)
+        return clamp_chunk_for_k(self.chunk, k, budget_elems,
+                                 max_chunk=max_chunk)
 
     @property
     def labelable(self) -> bool:

@@ -35,8 +35,13 @@ def test_shape_rule_matches_measured_win_loss_regions(monkeypatch):
 
     from kmeans_tpu.ops import pallas_kernels as pk
 
+    # jax.enable_x64 is experimental-only before 0.6.
+    enable_x64 = getattr(jax, "enable_x64", None)
+    if enable_x64 is None:
+        from jax.experimental import enable_x64
+
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
-    with jax.enable_x64(False):
+    with enable_x64(False):
         # Measured wins (BASELINE.md): headline and GloVe-shaped configs.
         assert pk.pallas_preferred(2_000_000, 128, 1024)
         assert pk.pallas_preferred(400_000, 100, 3000)
@@ -50,7 +55,7 @@ def test_shape_rule_matches_measured_win_loss_regions(monkeypatch):
         assert not pk.pallas_preferred(1_000_000, 512, 200_000)
     # x64 always falls back in AUTO mode — a precision contract (the
     # fused kernel is an f32 engine; explicit 'pallas' still works).
-    with jax.enable_x64(True):
+    with enable_x64(True):
         assert not pk.pallas_preferred(2_000_000, 128, 1024)
 
 

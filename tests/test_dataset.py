@@ -96,15 +96,26 @@ def test_clamp_chunk_for_k_divisor_property():
     # Non-multiple-of-8 explicit chunks pass through untouched (only
     # true divisors of the committed chunk re-chunk safely).
     assert clamp_chunk_for_k(1_000_004, 1024) == 1_000_004
-    # Awkward row counts still yield the largest legal divisor (>= 8).
+    # Awkward row counts yield either the largest in-budget divisor
+    # >= the 128-row floor, or — when the divisor structure skips the
+    # whole [128, budget] window — the smallest divisor >= 128 with a
+    # warning (the sparse-divisor fallback, ADVICE r5 medium; the old
+    # contract's sub-128-row results were the pathology it replaces).
+    import warnings
     for chunk in (999_992, 777_768, 123_456_008):
-        c = clamp_chunk_for_k(chunk, 4096, budget_elems=1 << 20)
-        assert chunk % c == 0 and c % 8 == 0
-        assert c * 4096 <= max(1 << 20, 8 * 4096)
-        # Largest: no bigger multiple-of-8 divisor fits the budget.
-        bigger = [v for v in range(c + 8, chunk + 1, 8)
-                  if chunk % v == 0 and v * 4096 <= 1 << 20]
-        assert not bigger
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            c = clamp_chunk_for_k(chunk, 4096, budget_elems=1 << 20)
+        assert chunk % c == 0 and c % 8 == 0 and c >= 128
+        if c * 4096 <= 1 << 20:
+            # In budget: no bigger multiple-of-8 divisor >= 128 fits.
+            bigger = [v for v in range(c + 8, chunk + 1, 8)
+                      if chunk % v == 0 and v * 4096 <= 1 << 20]
+            assert not bigger
+        else:
+            # Fallback: the smallest multiple-of-8 divisor >= 128.
+            smaller = [v for v in range(128, c, 8) if chunk % v == 0]
+            assert not smaller
 
 
 def test_mis_hinted_dataset_fit_matches(data, mesh8, tmp_path):
@@ -135,6 +146,68 @@ def test_explicit_chunk_passes_through(data, mesh8):
     assert not ds_auto.explicit_chunk
     # with_weights shares placement AND the explicit flag.
     assert ds.with_weights(np.ones(len(data))).explicit_chunk
+
+
+def test_clamp_chunk_sparse_divisor_fallback_warns():
+    """Divisor-pathology regression (ADVICE r5 medium): a committed
+    chunk whose divisors skip the [128, budget] window must fall back
+    to the SMALLEST multiple-of-8 divisor >= 128 (budget overshoot,
+    loudly) instead of silently scanning degenerate 24-row tiles —
+    4,000,008 rows at k=1024 is the reported case (divisors of 500001
+    are {1, 3, 166667, 500001})."""
+    import warnings
+    from kmeans_tpu.parallel.sharding import clamp_chunk_for_k
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        c = clamp_chunk_for_k(4_000_008, 1024)
+    assert c == 1_333_336                    # 166667 * 8: smallest >= 128
+    assert 4_000_008 % c == 0 and c % 8 == 0
+    assert any("clamp_chunk_for_k" in str(w.message) for w in rec)
+    # A prime-structured chunk with NO in-window divisor at all keeps
+    # the whole chunk (the only divisor >= 128), still warning.
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        c = clamp_chunk_for_k(8 * 166667, 1024, budget_elems=1 << 20)
+    assert c == 8 * 166667
+    assert any("clamp_chunk_for_k" in str(w.message) for w in rec)
+
+
+def test_choose_chunk_shortcut_honors_max_chunk():
+    """The single-chunk shortcut must not violate an explicit non-default
+    ``max_chunk`` cap (ADVICE r5 low) — while the DEFAULT cap is still
+    deliberately exceeded in the single-chunk region."""
+    from kmeans_tpu.parallel.sharding import choose_chunk_size
+    capped = choose_chunk_size(5000, 4, 8, max_chunk=1024)
+    assert capped <= 1024 and capped % 8 == 0 and capped >= 128
+    # Default cap: the shortcut intentionally returns the whole shard.
+    assert choose_chunk_size(5000, 4, 8) == 5000
+    assert choose_chunk_size(4_000_000, 16, 8) == 4_000_000
+    # An EXPLICIT cap equal to the implicit default is still a stated
+    # contract (None is the unspecified sentinel).
+    assert choose_chunk_size(1_000_000, 16, 8, max_chunk=1 << 17) \
+        == 1 << 17
+    # Sub-floor caps are floored like the scan branch's 128 floor.
+    assert choose_chunk_size(5000, 4, 8, max_chunk=64) == 128
+
+
+def test_gmm_eff_chunk_bounded_by_em_plateau():
+    """GMM's clamp of a mis-hinted foreign dataset is bounded by the
+    measured EM row plateau, not the element budget alone (ADVICE r5
+    low): a 50,000-row committed chunk at small k survives the 2^23
+    budget but must still land at a divisor near EM_MAX_CHUNK."""
+    from kmeans_tpu.models.gmm import EM_MAX_CHUNK, GaussianMixture
+    from kmeans_tpu.parallel.sharding import to_device
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(50_000, 4)).astype(np.float64)
+    ds = to_device(X, None, 50_000, np.float64)    # auto-style commit
+    assert not ds.explicit_chunk
+    gm = GaussianMixture(n_components=64, dtype=np.float64, verbose=False)
+    eff = gm._eff_chunk(ds)
+    assert eff == 25_000                           # largest divisor <= 32768
+    assert ds.chunk % eff == 0 and eff <= EM_MAX_CHUNK
+    # Explicit chunks keep the documented pass-through override.
+    ds_exp = to_device(X, None, 50_000, np.float64, explicit=True)
+    assert gm._eff_chunk(ds_exp) == 50_000
 
 
 def test_clamp_noop_at_the_row_floor():

@@ -285,7 +285,10 @@ def test_initialize_reraises_valueerror_in_cluster_env(monkeypatch):
     def boom(coordinator_address=None, num_processes=None, process_id=None):
         raise ValueError("could not auto-detect coordinator")
 
-    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+    # raising=False: pre-0.6 JAX has no is_initialized to replace — the
+    # shim in multihost.initialize picks up the injected one either way.
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False,
+                        raising=False)
     monkeypatch.setattr(jax.distributed, "initialize", boom)
     monkeypatch.setenv("SLURM_JOB_ID", "12345")
     with pytest.raises(ValueError, match="auto-detect"):

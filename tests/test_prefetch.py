@@ -1,0 +1,357 @@
+"""Double-buffered streaming pipeline (data.prefetch + the ``prefetch``
+knob on every stream consumer): prefetch must move WHERE the per-block
+work happens — a bounded background producer — without changing WHAT is
+computed (bit-identical trajectories vs the synchronous path), must
+re-raise reader errors at the consumer, and must never leak threads."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kmeans_tpu import KMeans
+from kmeans_tpu.data.prefetch import check_prefetch, prefetch_iter
+from kmeans_tpu.data.synthetic import make_blobs
+from kmeans_tpu.models import GaussianMixture
+
+
+@pytest.fixture()
+def data():
+    X, _ = make_blobs(6000, centers=5, n_features=8, random_state=11,
+                      dtype=np.float32)
+    return X
+
+
+def _blocks_of(X, size, weights=None):
+    def make_blocks():
+        for i in range(0, len(X), size):
+            if weights is None:
+                yield X[i: i + size]
+            else:
+                yield X[i: i + size], weights[i: i + size]
+    return make_blocks
+
+
+def _no_leaked_threads(baseline):
+    """Every prefetch producer is named; poll briefly for teardown."""
+    for _ in range(50):
+        alive = [t for t in threading.enumerate()
+                 if t.name.startswith("kmeans_tpu-prefetch")]
+        if len(alive) <= baseline:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _prefetch_threads():
+    return sum(t.name.startswith("kmeans_tpu-prefetch")
+               for t in threading.enumerate())
+
+
+# ------------------------------------------------------------ primitive
+
+
+def test_prefetch_iter_order_and_stage():
+    for prefetch in (0, 1, 2, 5):
+        got = list(prefetch_iter(iter(range(20)), prefetch,
+                                 stage=lambda x: x * x))
+        assert got == [i * i for i in range(20)]
+    assert list(prefetch_iter(iter([]), 2)) == []
+    assert _no_leaked_threads(0)
+
+
+def test_prefetch_validation():
+    with pytest.raises(ValueError, match="prefetch"):
+        check_prefetch(-1)
+    with pytest.raises(ValueError, match="prefetch"):
+        list(prefetch_iter([1], -2))
+
+
+def test_prefetch_iter_source_error_propagates_in_order():
+    def source():
+        yield 1
+        yield 2
+        raise RuntimeError("disk died")
+
+    it = prefetch_iter(source(), 2)
+    assert next(it) == 1
+    assert next(it) == 2
+    with pytest.raises(RuntimeError, match="disk died"):
+        next(it)
+    assert _no_leaked_threads(0)
+
+
+def test_prefetch_iter_stage_error_propagates():
+    def stage(x):
+        if x == 3:
+            raise ValueError("bad block")
+        return x
+
+    got = []
+    with pytest.raises(ValueError, match="bad block"):
+        for v in prefetch_iter(iter(range(10)), 2, stage):
+            got.append(v)
+    assert got == [0, 1, 2]
+    assert _no_leaked_threads(0)
+
+
+def test_prefetch_iter_early_close_joins_thread():
+    it = prefetch_iter(iter(range(1000)), 3)
+    assert next(it) == 0
+    it.close()
+    assert _no_leaked_threads(0)
+    # close is idempotent and the iterator stays exhausted.
+    it.close()
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_prefetch_iter_blocked_producer_unblocks_on_close():
+    """A producer stuck on a FULL queue (consumer stopped pulling) must
+    still join promptly on close."""
+    it = prefetch_iter(iter(range(10_000)), 1)
+    next(it)
+    time.sleep(0.1)          # let the producer fill the queue and block
+    it.close()
+    assert _no_leaked_threads(0)
+
+
+def test_prefetch_iter_runs_stage_in_background_thread():
+    seen = []
+
+    def stage(x):
+        seen.append(threading.current_thread().name)
+        return x
+
+    list(prefetch_iter(iter(range(3)), 2, stage))
+    assert all(n.startswith("kmeans_tpu-prefetch") for n in seen)
+    list(prefetch_iter(iter(range(3)), 0, stage))
+    assert seen[-1] == threading.main_thread().name
+
+
+# ----------------------------------------------- streamed-fit parity
+
+
+def _fit_pair(data, mesh8, **kw):
+    base = dict(k=5, seed=0, compute_sse=True, verbose=False,
+                mesh=mesh8, chunk_size=128, dtype=np.float64)
+    base.update(kw)
+    rng = np.random.RandomState(0)
+    base.setdefault("init", data[rng.choice(len(data), base["k"],
+                                            replace=False)].copy())
+    km0 = KMeans(**base)
+    km0.fit_stream(_blocks_of(data, 1000), prefetch=0)
+    km2 = KMeans(**base)
+    km2.fit_stream(_blocks_of(data, 1000), prefetch=2)
+    return km0, km2
+
+
+def test_kmeans_stream_prefetch_trajectory_bit_identical(data, mesh8):
+    """The acceptance-criteria pin: prefetch=2 and prefetch=0 streamed
+    fits are trajectory-BIT-identical — centroids, iteration count, and
+    the full SSE history."""
+    km0, km2 = _fit_pair(data, mesh8, empty_cluster="keep")
+    assert km0.iterations_run == km2.iterations_run
+    assert np.array_equal(km0.centroids, km2.centroids)
+    assert km0.sse_history == km2.sse_history
+    assert np.array_equal(km0.cluster_sizes_, km2.cluster_sizes_)
+    assert _no_leaked_threads(0)
+
+
+def test_kmeans_stream_prefetch_identical_under_resample(data, mesh8):
+    """The reservoir-fed 'resample' policy draws in consumer block order
+    — prefetch must not perturb the draw stream."""
+    km0, km2 = _fit_pair(data[:40], mesh8, k=8,
+                         empty_cluster="resample", max_iter=12)
+    assert km0.iterations_run == km2.iterations_run
+    assert np.array_equal(km0.centroids, km2.centroids)
+
+
+def test_kmeans_stream_prefetch_identical_weighted_multi_restart(
+        data, mesh8):
+    w = np.random.RandomState(3).uniform(0.1, 2.0,
+                                         len(data)).astype(np.float32)
+    kw = dict(k=4, n_init=2, seed=7, init="forgy", compute_sse=True,
+              empty_cluster="keep", verbose=False, mesh=mesh8,
+              chunk_size=128)
+    km0 = KMeans(**kw)
+    km0.fit_stream(_blocks_of(data, 900, w), prefetch=0)
+    km2 = KMeans(**kw)
+    km2.fit_stream(_blocks_of(data, 900, w), prefetch=2)
+    assert km0.best_restart_ == km2.best_restart_
+    assert np.array_equal(km0.centroids, km2.centroids)
+    assert np.array_equal(km0.restart_inertias_, km2.restart_inertias_)
+
+
+def test_gmm_stream_prefetch_trajectory_bit_identical(data, mesh8):
+    kw = dict(n_components=3, init_params="random", max_iter=6, seed=0,
+              mesh=mesh8, chunk_size=128, verbose=False)
+    g0 = GaussianMixture(**kw)
+    g0.fit_stream(_blocks_of(data, 1000), prefetch=0)
+    g2 = GaussianMixture(**kw)
+    g2.fit_stream(_blocks_of(data, 1000), prefetch=2)
+    assert g0.n_iter_ == g2.n_iter_
+    assert np.array_equal(g0.means_, g2.means_)
+    assert np.array_equal(g0.weights_, g2.weights_)
+    assert np.array_equal(g0.covariances_, g2.covariances_)
+    assert g0.lower_bound_ == g2.lower_bound_
+    assert _no_leaked_threads(0)
+
+
+def test_gmm_tied_stream_prefetch_identical(data, mesh8):
+    """Tied covariance adds the prefetched total-scatter pass."""
+    kw = dict(n_components=3, covariance_type="tied",
+              init_params="random", max_iter=4, seed=0, mesh=mesh8,
+              chunk_size=128, verbose=False)
+    g0 = GaussianMixture(**kw)
+    g0.fit_stream(_blocks_of(data, 1000), prefetch=0)
+    g2 = GaussianMixture(**kw)
+    g2.fit_stream(_blocks_of(data, 1000), prefetch=2)
+    assert np.array_equal(g0.means_, g2.means_)
+    assert np.array_equal(g0.covariances_, g2.covariances_)
+
+
+# ------------------------------------------- inference-stream parity
+
+
+def test_inference_streams_prefetch_identical(data, mesh8):
+    km = KMeans(k=5, seed=0, verbose=False, mesh=mesh8,
+                chunk_size=128).fit(data)
+    mk = _blocks_of(data, 700)
+    l0 = np.concatenate(list(km.predict_stream(mk, prefetch=0)))
+    l2 = np.concatenate(list(km.predict_stream(mk, prefetch=2)))
+    assert np.array_equal(l0, l2)
+    assert km.score_stream(mk, prefetch=0) == km.score_stream(mk,
+                                                              prefetch=2)
+    t0 = np.concatenate(list(km.transform_stream(mk, prefetch=0)))
+    t2 = np.concatenate(list(km.transform_stream(mk, prefetch=2)))
+    assert np.array_equal(t0, t2)
+    gm = GaussianMixture(n_components=3, seed=0, mesh=mesh8,
+                         chunk_size=128, verbose=False).fit(data)
+    p0 = np.concatenate(list(gm.predict_stream(mk, prefetch=0)))
+    p2 = np.concatenate(list(gm.predict_stream(mk, prefetch=2)))
+    assert np.array_equal(p0, p2)
+    s0 = np.concatenate(list(gm.score_samples_stream(mk, prefetch=0)))
+    s2 = np.concatenate(list(gm.score_samples_stream(mk, prefetch=2)))
+    assert np.array_equal(s0, s2)
+    assert _no_leaked_threads(0)
+
+
+# --------------------------------------- failure/shutdown semantics
+
+
+def test_stream_reader_exception_mid_epoch_propagates_no_threads(
+        data, mesh8):
+    """Acceptance-criteria pin: a reader exception mid-epoch reaches the
+    fit_stream caller AND leaves no live producer threads."""
+    def bad_blocks():
+        yield data[:1000]
+        yield data[1000:2000]
+        raise OSError("stream source failed")
+
+    km = KMeans(k=5, seed=0, init=data[:5].copy(), verbose=False,
+                mesh=mesh8, chunk_size=128)
+    with pytest.raises(OSError, match="stream source failed"):
+        km.fit_stream(lambda: bad_blocks(), prefetch=2)
+    assert _no_leaked_threads(0)
+
+    gm = GaussianMixture(n_components=3, init_params="random", seed=0,
+                         mesh=mesh8, chunk_size=128, verbose=False)
+    with pytest.raises(OSError, match="stream source failed"):
+        gm.fit_stream(lambda: bad_blocks(), prefetch=2)
+    assert _no_leaked_threads(0)
+
+
+def test_stream_shape_error_still_points_at_block(data, mesh8):
+    """Validation errors raised by the producer-side decode keep their
+    pointed message at the consumer."""
+    def mixed():
+        yield data[:1000]
+        yield np.zeros((10, 3), np.float32)        # wrong width
+
+    km = KMeans(k=5, seed=0, init=data[:5].copy(), verbose=False,
+                mesh=mesh8, chunk_size=128)
+    with pytest.raises(ValueError, match="block shape"):
+        km.fit_stream(lambda: mixed(), prefetch=2)
+    assert _no_leaked_threads(0)
+
+
+def test_abandoned_predict_stream_generator_joins_thread(data, mesh8):
+    km = KMeans(k=5, seed=0, verbose=False, mesh=mesh8,
+                chunk_size=128).fit(data)
+    gen = km.predict_stream(_blocks_of(data, 500), prefetch=2)
+    next(gen)
+    gen.close()                                    # partial consumption
+    assert _no_leaked_threads(0)
+    gen = km.transform_stream(_blocks_of(data, 500), prefetch=2)
+    next(gen)
+    del gen                                        # GC path
+    assert _no_leaked_threads(0)
+
+
+def test_fit_stream_d_peek_closes_prefetching_source(tmp_path, data):
+    """Regression: the d-inference peek takes ONE item from
+    make_blocks() and abandons the iterator — with a prefetching source
+    (iter_npy_blocks(prefetch=N)) that abandoned producer thread must
+    be reaped immediately, not at some future GC cycle."""
+    from kmeans_tpu.data.io import iter_npy_blocks
+    path = tmp_path / "pts.npy"
+    np.save(path, data)
+    km = KMeans(k=5, seed=0, init=data[:5].copy(), max_iter=2,
+                empty_cluster="keep", verbose=False, chunk_size=128)
+    km.fit_stream(iter_npy_blocks(path, 1000, prefetch=2))  # d peeked
+    assert _no_leaked_threads(0)
+    gm = GaussianMixture(n_components=3, init_params="random", max_iter=2,
+                         seed=0, chunk_size=128, verbose=False)
+    gm.fit_stream(iter_npy_blocks(path, 1000, prefetch=2))
+    assert _no_leaked_threads(0)
+
+
+def test_nested_prefetch_early_close_reaps_inner_thread(tmp_path, data):
+    """Abandoning a prefetched stream whose SOURCE is itself a
+    prefetching iterator (iter_npy_blocks(prefetch=N) under a
+    prefetch>0 consumer) must close the inner producer too — close
+    propagates through the wrapper instead of waiting for cyclic GC."""
+    from kmeans_tpu.data.io import iter_npy_blocks
+    path = tmp_path / "pts.npy"
+    np.save(path, data)
+    km = KMeans(k=5, seed=0, verbose=False, chunk_size=128).fit(data)
+    gen = km.predict_stream(iter_npy_blocks(path, 500, prefetch=2),
+                            prefetch=2)
+    next(gen)
+    gen.close()
+    assert _no_leaked_threads(0)
+    # Same through the synchronous wrapper (prefetch=0 consumer over a
+    # prefetching source).
+    gen = km.predict_stream(iter_npy_blocks(path, 500, prefetch=2),
+                            prefetch=0)
+    next(gen)
+    gen.close()
+    assert _no_leaked_threads(0)
+
+
+def test_iter_npy_blocks_prefetch_knob(tmp_path, data):
+    from kmeans_tpu.data.io import iter_npy_blocks
+    path = tmp_path / "pts.npy"
+    np.save(path, data)
+    sync = [b.copy() for b in iter_npy_blocks(path, 1700)()]
+    pre = [b.copy() for b in iter_npy_blocks(path, 1700, prefetch=2)()]
+    assert len(sync) == len(pre)
+    for a, b in zip(sync, pre):
+        assert np.array_equal(a, b)
+    assert _no_leaked_threads(0)
+    with pytest.raises(ValueError, match="prefetch"):
+        iter_npy_blocks(path, 1700, prefetch=-1)
+
+
+def test_from_npy_readahead_matches_sync(tmp_path, data, mesh8):
+    from kmeans_tpu.data.io import from_npy
+    path = tmp_path / "pts.npy"
+    np.save(path, data.astype(np.float64))
+    ds_sync = from_npy(path, mesh8, dtype=np.float64, prefetch=0)
+    ds_pre = from_npy(path, mesh8, dtype=np.float64, prefetch=2)
+    assert np.array_equal(np.asarray(ds_sync.points),
+                          np.asarray(ds_pre.points))
+    assert np.array_equal(np.asarray(ds_sync.weights),
+                          np.asarray(ds_pre.weights))
