@@ -26,6 +26,12 @@ logged as a drift check (other shapes use the live probe directly).
 
 Env overrides: BENCH_N, BENCH_D, BENCH_K, BENCH_ITERS, BENCH_MODE.
 
+BENCH_INIT=1 switches to the SEEDING-COST benchmark (ISSUE 2): warm
+k-means|| init at BENCH_N/D/K (accelerator default 2M x 128 k=1024 —
+the shape whose legacy init measured 7.4 s warm vs a 0.77 s training
+loop), one-dispatch device pipeline vs the legacy per-round engine,
+one JSON line with the <= 2 s acceptance target recorded.
+
 BENCH_STREAM=1 switches to the STREAMED-EPOCH benchmark instead
 (``kmeans_tpu.benchmarks.bench_stream``): ``fit_stream`` epoch cost off
 an on-disk ``.npy`` with the double-buffered input pipeline ON
@@ -112,6 +118,52 @@ def main() -> None:
     enable_compilation_cache()
     backend = jax.default_backend()
     on_accel = backend not in ("cpu",)
+
+    if os.environ.get("BENCH_INIT"):
+        # Seeding-cost benchmark (ISSUE 2 acceptance): warm k-means||
+        # init, device one-dispatch pipeline vs the legacy per-round
+        # engine, at the shape where the legacy engine's ~5 RTTs + host
+        # reduce measured 7.4 s warm (BASELINE.json.time_to_solution).
+        # Data generated on device, sharded, zero upload.
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from kmeans_tpu.benchmarks import bench_init
+        from kmeans_tpu.parallel.mesh import (DATA_AXIS, make_mesh,
+                                              mesh_shape)
+        from kmeans_tpu.parallel.sharding import (ShardedDataset,
+                                                  choose_chunk_size)
+        n = int(os.environ.get("BENCH_N",
+                               2_000_000 if on_accel else 100_000))
+        d = int(os.environ.get("BENCH_D", 128 if on_accel else 32))
+        k = int(os.environ.get("BENCH_K", 1024 if on_accel else 64))
+        mesh = make_mesh()
+        data_shards, _ = mesh_shape(mesh)
+        chunk = choose_chunk_size(-(-n // data_shards), k, d)
+        n_pad = -(-n // (data_shards * chunk)) * (data_shards * chunk)
+        gen = jax.jit(
+            lambda key: (jax.random.uniform(key, (n_pad, d), jnp.float32,
+                                            -1.0, 1.0),
+                         (jnp.arange(n_pad) < n).astype(jnp.float32)),
+            out_shardings=(NamedSharding(mesh, P(DATA_AXIS, None)),
+                           NamedSharding(mesh, P(DATA_AXIS))))
+        points, weights = gen(jax.random.PRNGKey(42))
+        ds = ShardedDataset(points, weights, n, chunk, mesh)
+        log(f"bench: INIT mode backend={backend} N={n} D={d} k={k}")
+        dev_s, legacy_s = bench_init(ds, k)
+        log(f"bench: warm k-means|| init device {dev_s:.3f}s vs legacy "
+            f"{legacy_s:.3f}s ({legacy_s / max(dev_s, 1e-9):.2f}x)")
+        print(json.dumps({
+            "metric": f"kmeans_parallel_init_warm_N{n}_D{d}_k{k}",
+            "value": round(dev_s, 3),
+            "unit": "s (warm, one-dispatch device pipeline)",
+            "legacy_s": round(legacy_s, 3),
+            "speedup_vs_legacy": round(legacy_s / max(dev_s, 1e-9), 2),
+            "target_s_at_2Mx128_k1024": 2.0,
+            "platform": backend,
+            "n_devices": len(jax.devices()),
+        }))
+        return
 
     if os.environ.get("BENCH_STREAM"):
         # Streamed-epoch benchmark (fit_stream, disk blocks through the

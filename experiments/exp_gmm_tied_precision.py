@@ -1,0 +1,229 @@
+"""TIED-covariance moment-precision ladder (ISSUE 2 satellite; closes the
+loop the r5 full-covariance ladder deliberately left open: "tied stays
+HIGHEST everywhere: its cancellation runs through a loop-invariant total
+scatter this ladder did not probe").
+
+The tied M-step derives the shared covariance as
+
+    Sigma = (T - sum_k R_k mu_k mu_k^T) / W,      mu_k = xsum_k / R_k
+
+with ``T`` the loop-INVARIANT total scatter (one pass per fit, pinned at
+HIGHEST regardless — no per-iteration speedup exists there) and ``xsum``
+the per-iteration E-pass moment currently accumulated at HIGHEST
+(parallel/gmm_step._scan_estats_tied).  The cancellation here is HARSHER
+than the diag family's: at cluster offsets of ~50 sigma the between-
+cluster part of T/W is ~600x the within-cluster variance being recovered,
+and an xsum product-rounding error of relative 2^-8 becomes an absolute
+covariance error of ~2|mu|^2*2^-8 — far above the truth.  Whether the
+3-pass HIGH split (bf16_3x) is already exact ENOUGH is precisely what the
+ladder must measure on hardware.
+
+Two measured questions, decision rules committed BEFORE measuring (the
+repo's ladder methodology, exp_gmm_estep_retry.py / exp_gmm_full_precision.py):
+
+1. **Covariance-survival probe** per precision rung: the r3 failure
+   shape (clusters offset up to ~50 sigma, true covariance 4*I), one
+   tied E-pass with perfectly-specified parameters, T computed at
+   HIGHEST, then ``Sigma = (T - sum_k R_k mu_k mu_k^T)/W``.  PASS =
+   every diagonal within 5% of truth AND max |off-diagonal| within 5%
+   of the true variance.  If HIGH passes at HIGHEST-equivalent error,
+   wire HIGH into ``_scan_estats_tied``'s xsum (and the device tied
+   loop's copy); if it degrades, pin the rejection with these numbers
+   in docs/PERFORMANCE.md.
+
+2. **Timing ladder**: marginal ms per tied E-pass at N=1M x D=64,
+   k=32, whole chain in one dispatch, gap ramped to a ~1.5 s big chain
+   (the r5 harness rule).  The xsum matmul is 6 effective bf16 passes
+   at HIGHEST vs 3 at HIGH, so the available win is bounded by xsum's
+   share of the pass (~1.3-1.6x expected at this shape).
+
+Run on TPU hardware:  python experiments/exp_gmm_tied_precision.py
+CPU mechanics smoke (rungs are identical by construction there — XLA CPU
+executes exact f32 dots at every precision):
+GMM_TIED_ALLOW_CPU=1 python experiments/exp_gmm_tied_precision.py
+
+STATUS (2026-08-03, ISSUE 2 round): no TPU was reachable from this
+container (CPU-only).  CPU smoke run below confirms the harness and the
+by-construction CPU result (all rungs identical error, timing flat);
+the hardware ladder is PINNED for the next hardware session — decision
+rules above are committed, docs/PERFORMANCE.md carries the pin.
+
+CPU smoke (2-core container, N scaled to 262144, probe shape unchanged;
+measured 2026-08-03):
+  HIGHEST  probe: diag_err=6.36e-03 offdiag_err=7.70e-03 (probe noise)
+  HIGH     probe: diag_err=6.36e-03 offdiag_err=7.70e-03 (identical —
+           exact f32 dots on CPU at every rung, by construction)
+  DEFAULT  probe: diag_err=6.36e-03 offdiag_err=7.70e-03 (identical)
+  timing: 538/464/351 ms/pass at 36-76% spread — shared-host noise, not
+  a precision effect (CPU ignores the enum); no decision can be made
+  off-hardware, which is exactly why the pin exists.
+"""
+
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+N, D, K = 1_048_576, 64, 32
+PEAK_TFLOPS = 197.0
+# xt transform 2*N*D^2 + cross 2*N*D*K + xsum 2*N*D*K real FLOPs/E-pass.
+REAL_TFLOP_PER_PASS = (2.0 * N * D * D + 4.0 * N * D * K) / 1e12
+
+
+def estep_tied_variant(x, w, means_t, prec_chol, log_det_half, log_w, *,
+                       chunk, precision):
+    """Chunked TIED E pass with configurable xsum moment precision
+    (everything else identical to _scan_estats_tied)."""
+    from kmeans_tpu.parallel.gmm_step import (_log_prob_tied_chunk,
+                                              _softmax_resp)
+
+    k, d = means_t.shape
+    n_chunks = x.shape[0] // chunk
+    xs = (x.reshape(n_chunks, chunk, d), w.reshape(n_chunks, chunk))
+
+    def body(carry, ch):
+        xc, wc = ch
+        logp = _log_prob_tied_chunk(xc, means_t, prec_chol, log_det_half,
+                                    log_w)
+        resp, lse = _softmax_resp(logp, wc, 1)
+        r, s1, ll = carry
+        return (r + jnp.sum(resp, axis=0),
+                s1 + lax.dot_general(resp, xc, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=xc.dtype,
+                                     precision=precision),
+                ll + jnp.sum(jnp.where(wc > 0, lse * wc, 0.0))), None
+
+    init = (jnp.zeros((k,), x.dtype), jnp.zeros((k, d), x.dtype),
+            jnp.zeros((), x.dtype))
+    out, _ = lax.scan(body, init, xs)
+    return out
+
+
+def bench_pass(x, w, params, *, chunk, precision):
+    """Marginal ms/E-pass, one dispatch, r5 gap-ramp rule."""
+    from kmeans_tpu.benchmarks import measure_marginal
+
+    means_t, prec_chol, log_det_half, log_w = params
+
+    @jax.jit
+    def run(x, w, means_t, n_it):
+        def body(i, m):
+            r, s1, ll = estep_tied_variant(
+                x, w, m, prec_chol, log_det_half, log_w,
+                chunk=chunk, precision=precision)
+            # Accumulators feed the carry so nothing is DCE'd.
+            return m + 0.0 * (s1 / jnp.maximum(r, 1.0)[:, None] + ll)
+        return jnp.sum(lax.fori_loop(0, n_it, body, means_t))
+
+    def timed(n_it):
+        t0 = time.perf_counter()
+        float(run(x, w, means_t, n_it))
+        return time.perf_counter() - t0
+
+    timed(2)
+    t_small = timed(2)
+    gap, TARGET, CAP = 16, 1.5, 100_000
+    while True:
+        t_big = timed(2 + gap)
+        if t_big >= TARGET or gap >= CAP:
+            break
+        per_iter = max((t_big - t_small) / gap, 1e-9)
+        gap = int(min(CAP, min(gap * 25, max(TARGET / per_iter, gap * 5))))
+    margin, spread, _ = measure_marginal(
+        lambda: timed(2), lambda: timed(2 + gap), reps=5)
+    return margin / gap * 1e3, gap, spread
+
+
+def survival_probe(precision, n_small=262_144):
+    """r3 failure shape, tied edition: one E-pass with perfect
+    parameters; T at HIGHEST (the shipped once-per-fit rule); returns
+    (max diag rel err, max |offdiag|/var) of (T - sum R mu mu^T)/W."""
+    rng = np.random.default_rng(0)
+    k_small = 8
+    true_var = 4.0
+    offsets = np.linspace(0, 50, k_small)
+    comp = rng.integers(0, k_small, n_small)
+    x_np = (offsets[comp][:, None] * np.sqrt(true_var)
+            + rng.normal(size=(n_small, D)) * np.sqrt(true_var))
+    x = jnp.asarray(x_np, jnp.float32)
+    w = jnp.ones((n_small,), jnp.float32)
+    shift = jnp.mean(x, axis=0)
+    xc_frame = x - shift[None, :]
+    prec_chol = jnp.asarray(np.eye(D, dtype=np.float32)
+                            / np.sqrt(true_var))
+    means_c = (jnp.asarray(offsets[:, None] * np.sqrt(true_var)
+                           * np.ones((k_small, D)), jnp.float32)
+               - shift[None, :])
+    means_t = means_c @ prec_chol
+    log_det_half = jnp.asarray(-0.5 * D * np.log(true_var), jnp.float32)
+    log_w = jnp.full((k_small,), -np.log(k_small), jnp.float32)
+
+    @jax.jit
+    def one_pass(xc, wc):
+        r, s1, _ = estep_tied_variant(
+            xc, wc, means_t, prec_chol, log_det_half, log_w,
+            chunk=32_768, precision=precision)
+        # Loop-invariant total scatter: HIGHEST always (once per fit).
+        t = lax.dot_general(xc * wc[:, None], xc, (((0,), (0,)), ((), ())),
+                            preferred_element_type=xc.dtype,
+                            precision=lax.Precision.HIGHEST)
+        return r, s1, t
+
+    r, s1, t = one_pass(xc_frame, w)
+    r64 = np.asarray(r, np.float64)
+    mu = np.asarray(s1, np.float64) / r64[:, None]
+    W = r64.sum()
+    C = (np.asarray(t, np.float64)
+         - (r64[:, None, None] * mu[:, :, None] * mu[:, None, :]).sum(0)) / W
+    diag = np.diagonal(C)
+    diag_err = float(np.max(np.abs(diag - true_var) / true_var))
+    off = C - np.diag(np.diagonal(C))
+    offdiag_err = float(np.max(np.abs(off)) / true_var)
+    return diag_err, offdiag_err
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu and not os.environ.get("GMM_TIED_ALLOW_CPU"):
+        raise SystemExit(
+            "run on TPU hardware (the rungs only differ there); "
+            "GMM_TIED_ALLOW_CPU=1 runs the CPU mechanics smoke")
+    n = N if on_tpu else min(N, 262_144)
+    from kmeans_tpu.models.gmm import EM_CHUNK_BUDGET
+    chunk = max(128, EM_CHUNK_BUDGET // max(K, D) // 8 * 8)
+    chunk = min(chunk, n)
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (n, D), jnp.float32)
+    w = jnp.ones((n,), jnp.float32)
+    rng = np.random.default_rng(1)
+    prec_chol = jnp.asarray(np.eye(D, dtype=np.float32))
+    means_t = jnp.asarray(rng.normal(size=(K, D)), jnp.float32)
+    log_det_half = jnp.zeros((), jnp.float32)
+    log_w = jnp.full((K,), -np.log(K), jnp.float32)
+    params = (means_t, prec_chol, log_det_half, log_w)
+
+    print(f"shape: N={n} D={D} k={K} tied, chunk={chunk}, "
+          f"backend={jax.default_backend()}", flush=True)
+    for prec_name, prec in [("HIGHEST", lax.Precision.HIGHEST),
+                            ("HIGH", lax.Precision.HIGH),
+                            ("DEFAULT", lax.Precision.DEFAULT)]:
+        diag_err, off_err = survival_probe(prec)
+        print(f"  {prec_name:<8} probe: diag_err={diag_err:.2e} "
+              f"offdiag_err={off_err:.2e}", flush=True)
+        ms, gap, spread = bench_pass(x, w, params, chunk=chunk,
+                                     precision=prec)
+        mfu = REAL_TFLOP_PER_PASS * (n / N) / (ms / 1e3) / PEAK_TFLOPS
+        print(f"  {prec_name:<8} {ms:7.2f} ms/pass {mfu:5.1%} MFU "
+              f"(gap {gap}, spread {spread:.1%})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -182,7 +182,21 @@ class KMeans:
         host-resident dataset — and otherwise emits a one-time
         :class:`DispatchLatencyHint`; see ``_resolve_host_loop``).
     verbose : reference-style per-iteration prints (kmeans_spark.py:296-304).
+
+    Observability: after ``fit``, ``loop_path_`` records which engine ran
+    ('host' | 'device' | 'device-multi') and ``auto_rtt_`` the dispatch
+    RTT ``host_loop='auto'`` measured (None when no probe ran) — the
+    fields the multichip dry-run artifact publishes (ISSUE 2 satellite:
+    evidence that 'auto' measures the real RTT and takes the device path
+    on high-latency platforms).
     """
+
+    # Device-expressible subclass postprocess: None for plain Lloyd; a
+    # subclass whose ``_postprocess_centroids`` has an exact device
+    # equivalent (parallel.distributed._project_centroids) declares its
+    # name here AND tags the method with ``_device_equivalent`` — that
+    # pair is what lets host_loop=False/'auto' run it in one dispatch.
+    _device_project: Optional[str] = None
 
     def __init__(self, k: int = 3, max_iter: int = 100,
                  tolerance: float = 1e-4, seed: int = 42,
@@ -261,6 +275,8 @@ class KMeans:
         self.verbose = verbose
 
         self.centroids: Optional[np.ndarray] = None   # kmeans_spark.py:44
+        self.loop_path_: Optional[str] = None         # 'host'|'device'|...
+        self.auto_rtt_: Optional[float] = None        # measured by 'auto'
         self.sse_history: List[float] = []            # kmeans_spark.py:45
         self.cluster_sizes_: Optional[np.ndarray] = None
         self.iter_times_: List[float] = []            # wall secs/iteration
@@ -481,7 +497,8 @@ class KMeans:
         measured step (on a tunneled chip the RTT is ~70-100 ms,
         docs/PERFORMANCE.md).  Then, when the device loop is
         semantically interchangeable for this estimator — base-class
-        Lloyd hooks (SphericalKMeans projects host-side), verbose=False
+        Lloyd hooks, or a hook with a declared device equivalent
+        (SphericalKMeans' sphere projection since ISSUE 2), verbose=False
         (per-iteration prints are host-loop-only), single process (the
         decision must not diverge across SPMD processes) — the fit
         switches to the one-dispatch device loop, whose trajectory
@@ -505,6 +522,7 @@ class KMeans:
         # decides alone, and no step is ever timed — a default-config fit
         # there pays only one cached trivial-op round trip (review r5).
         rtt = _dispatch_rtt(mesh)
+        self.auto_rtt_ = rtt        # observability: the dry-run artifact
         if rtt <= 5e-3:
             return True
         key = (mesh, self._eff_chunk(ds), self._mode(ds.n, ds.d),
@@ -529,8 +547,19 @@ class KMeans:
         frac = rtt / max(step_total, 1e-12)
         if frac <= 0.25:
             return True
+        # A postprocess hook blocks the switch UNLESS the class declares
+        # (and the hook is tagged with) an exact device equivalent — how
+        # SphericalKMeans' sphere projection rides the one-dispatch loop
+        # (parallel.distributed._project_centroids); a further override
+        # in a user subclass loses the tag and stays host-side.
+        pp = type(self)._postprocess_centroids
+        pp_device_ok = (
+            pp is KMeans._postprocess_centroids
+            or (self._device_project is not None
+                and getattr(pp, "_device_equivalent", None)
+                == self._device_project))
         base_hooks = (
-            type(self)._postprocess_centroids is KMeans._postprocess_centroids
+            pp_device_ok
             and type(self)._handle_empty is KMeans._handle_empty
             and type(self)._finish_lloyd_iteration
             is KMeans._finish_lloyd_iteration)
@@ -972,6 +1001,7 @@ class KMeans:
             return self._fit_on_device(ds, centroids, start_iter, mesh,
                                        model_shards, log, seed)
 
+        self.loop_path_ = "host"
         cents_dev = self._put_centroids(centroids, mesh, model_shards)
         for iteration in range(start_iter, self.max_iter):
             iter_start = time.perf_counter()
@@ -1055,13 +1085,15 @@ class KMeans:
         chunk = self._eff_chunk(ds)
         key = (mesh, chunk, mode, self.k, iters_left,
                float(self.tolerance), self.empty_cluster, self.compute_sse,
-               "fit")
+               self._device_project, "fit")
         fit_fn = _STEP_CACHE.get_or_create(key, lambda: dist.make_fit_fn(
             mesh, chunk_size=chunk, mode=mode,
             k_real=self.k, max_iter=iters_left,
             tolerance=float(self.tolerance),
             empty_policy=self.empty_cluster,
-            history_sse=self.compute_sse))
+            history_sse=self.compute_sse,
+            project=self._device_project))
+        self.loop_path_ = "device"
         cents_dev = self._put_centroids(centroids, mesh, model_shards)
         fit_start = time.perf_counter()
         cents, n_iters, sse_hist, shift_hist, counts = fit_fn(
@@ -1116,14 +1148,16 @@ class KMeans:
         chunk = self._eff_chunk(ds)
         key = (mesh, chunk, mode, self.k, self.max_iter,
                float(self.tolerance), self.empty_cluster, R,
-               self.compute_sse, "multifit")
+               self.compute_sse, self._device_project, "multifit")
         fit_fn = _STEP_CACHE.get_or_create(
             key, lambda: dist.make_multi_fit_fn(
                 mesh, chunk_size=chunk, mode=mode,
                 k_real=self.k, max_iter=self.max_iter,
                 tolerance=float(self.tolerance),
                 empty_policy=self.empty_cluster, n_init=R,
-                history_sse=self.compute_sse))
+                history_sse=self.compute_sse,
+                project=self._device_project))
+        self.loop_path_ = "device-multi"
         _, model_shards = mesh_shape(mesh)
         inits = np.stack([dist.pad_centroids(
             self._init_centroids(ds, s), model_shards) for s in seeds])

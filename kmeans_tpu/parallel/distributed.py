@@ -433,9 +433,33 @@ def _refill_empty_slots_batched(new, is_empty, skip, points, weights,
     return new
 
 
+def _project_centroids(new, prev, real_mask, project: Optional[str], acc):
+    """Device-expressible subclass postprocess hook of the one-dispatch
+    fit loops (applied after the mean update + empty refill, before the
+    shift test — the same slot as ``KMeans._postprocess_centroids``).
+
+    ``'sphere'`` is SphericalKMeans' hook: re-project each REAL centroid
+    row onto the unit sphere (mean direction = normalized mean); a
+    zero-norm mean (perfectly cancelling members) keeps the previous
+    direction, exactly like the host hook (models/spherical.py).
+    Sentinel padding rows must stay sentinel — normalizing one would turn
+    it into a valid-looking unit row that could win assignments.
+    ``real_mask`` broadcasts over any leading restart axis."""
+    if project is None:
+        return new
+    if project != "sphere":
+        raise ValueError(f"unknown device projection {project!r}")
+    norm = jnp.sqrt(jnp.sum(new * new, axis=-1, keepdims=True))
+    unit = new / jnp.maximum(norm, jnp.finfo(acc).tiny)
+    real_c = real_mask[..., None]
+    return jnp.where(real_c & (norm > 0), unit,
+                     jnp.where(real_c, prev, new))
+
+
 def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                 k_real: int, max_iter: int, tolerance: float,
-                empty_policy: str = "keep", history_sse: bool = True):
+                empty_policy: str = "keep", history_sse: bool = True,
+                project: Optional[str] = None):
     """Build a FULLY ON-DEVICE training loop: one dispatch runs all
     iterations under ``lax.while_loop``.
 
@@ -567,6 +591,7 @@ def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                 new = _refill_empty_slots(
                     new, is_empty, jnp.int32(0), points, w_draw, n_orig,
                     d, empty_seeds[i], acc)
+            new = _project_centroids(new, cents_full, real, project, acc)
             shifts = jnp.sqrt(jnp.sum((new - cents_full) ** 2, axis=1))
             max_shift = jnp.max(jnp.where(real, shifts, 0.0))
             sse_hist = sse_hist.at[i].set(sse)
@@ -599,7 +624,8 @@ def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
 def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                       k_real: int, max_iter: int, tolerance: float,
                       empty_policy: str = "keep", n_init: int,
-                      history_sse: bool = True):
+                      history_sse: bool = True,
+                      project: Optional[str] = None):
     """Build a BATCHED on-device training loop: ``n_init`` independent
     restarts run in ONE dispatch, vmapped over the restart axis.
 
@@ -739,6 +765,8 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                 new = _refill_empty_slots_batched(
                     new, is_empty, jnp.zeros((R,), jnp.int32), points,
                     w_draw, n_orig, d, empty_seeds[:, i], acc)
+            new = _project_centroids(new, cents, real[None, :], project,
+                                     acc)
             shifts = jnp.sqrt(jnp.sum((new - cents) ** 2, axis=2))
             max_shift = jnp.max(jnp.where(real[None, :], shifts, 0.0),
                                 axis=1)                    # (R,)

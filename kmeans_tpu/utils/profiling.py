@@ -49,6 +49,45 @@ def trace(log_dir: Optional[str]):
         yield
 
 
+# --------------------------------------------------------- dispatch log
+# Host->device dispatch accounting for the latency-sensitive paths: call
+# sites that cross the host/device boundary (a jitted call whose result
+# the host consumes, or a device_get) note themselves here, so tests and
+# harnesses can assert structural properties like "the device k-means||
+# pipeline is O(1) dispatches in the round count" (ISSUE 2) without
+# depending on jax internals.  Zero overhead when no log is active.
+
+_DISPATCH_LOG: Optional[list] = None
+
+
+def note_dispatch(label: str) -> None:
+    """Record one host->device dispatch under the active ``log_dispatches``
+    scope (no-op outside one).  Instrumented call sites pass a stable
+    label (e.g. ``'kmeans||/round'``) so counts can be grouped."""
+    if _DISPATCH_LOG is not None:
+        _DISPATCH_LOG.append(label)
+
+
+@contextlib.contextmanager
+def log_dispatches():
+    """Collect dispatch labels noted by instrumented call sites.
+
+    Usage::
+
+        with log_dispatches() as log:
+            kmeans_parallel_init(X, k, seed)
+        assert log.count("kmeans||/device-pipeline") == 1
+
+    Nested scopes shadow (the inner scope collects; the outer resumes
+    afterwards), matching how the tests isolate measurements."""
+    global _DISPATCH_LOG
+    prev, _DISPATCH_LOG = _DISPATCH_LOG, []
+    try:
+        yield _DISPATCH_LOG
+    finally:
+        _DISPATCH_LOG = prev
+
+
 def timed_call(fn, *args, warmup: int = 1, iters: int = 3):
     """(mean_seconds, last_result) of fn(*args), excluding warmup runs."""
     result = None

@@ -134,22 +134,42 @@ def test_auto_respects_host_side_hooks(data, mesh8, monkeypatch):
     assert nk.iterations_run > 0
 
 
-def test_spherical_pins_host_loop(data, mesh8, monkeypatch):
-    """SphericalKMeans requires the host loop: it pins host_loop=True
-    (never the inherited 'auto'), so no RTT probe and no hint ever run —
-    and an explicit True must survive (review r5: pop-and-discard used to
-    replace it with the base default)."""
-    def boom(mesh):
-        raise AssertionError("SphericalKMeans must not probe RTT")
-    monkeypatch.setattr(km_mod, "_dispatch_rtt", boom)
+def test_spherical_auto_switches_on_high_latency(data, mesh8, monkeypatch):
+    """ISSUE 2 satellite (drops the r5 host_loop=True pin): the sphere
+    projection now has a device twin folded into the one-dispatch loop,
+    so SphericalKMeans resolves 'auto' exactly like the base class —
+    high simulated RTT + verbose=False switches to the device loop, and
+    the trajectory matches the host loop."""
+    monkeypatch.setattr(km_mod, "_dispatch_rtt", lambda mesh: 1.0)
     calls = _spy_device_paths(monkeypatch)
-    for kw in ({}, {"host_loop": True}, {"host_loop": "auto"}):
-        sk = SphericalKMeans(k=4, seed=0, mesh=mesh8, verbose=False, **kw)
-        assert sk.host_loop is True
+    kw = dict(k=4, seed=0, mesh=mesh8, verbose=False, compute_sse=True,
+              dtype=np.float64, empty_cluster="keep")
+    sk = SphericalKMeans(host_loop="auto", **kw)
+    assert sk.host_loop == "auto"          # inherited default survives
+    with pytest.warns(DispatchLatencyHint, match="one device dispatch"):
         sk.fit(data)
+    assert calls == ["device"]
+    host = SphericalKMeans(host_loop=True, **kw).fit(data)
+    np.testing.assert_allclose(sk.centroids, host.centroids, atol=1e-9)
+    np.testing.assert_allclose(sk.sse_history, host.sse_history, rtol=1e-9)
+    np.testing.assert_allclose(np.linalg.norm(sk.centroids, axis=1), 1.0,
+                               atol=1e-12)
+
+
+def test_spherical_subclass_override_stays_host(data, mesh8, monkeypatch):
+    """A user subclass overriding _postprocess_centroids loses the
+    device-equivalent tag: 'auto' must keep it on the host loop."""
+    monkeypatch.setattr(km_mod, "_dispatch_rtt", lambda mesh: 1.0)
+    calls = _spy_device_paths(monkeypatch)
+
+    class Nudged(SphericalKMeans):
+        def _postprocess_centroids(self, centroids, prev=None):
+            return super()._postprocess_centroids(centroids, prev)
+
+    with pytest.warns(DispatchLatencyHint, match="host-side hooks"):
+        Nudged(k=4, seed=0, mesh=mesh8, verbose=False,
+               empty_cluster="keep").fit(data)
     assert calls == []
-    with pytest.raises(ValueError, match="host_loop=True"):
-        SphericalKMeans(k=4, host_loop=False)
 
 
 def test_minibatch_auto_switches_on_high_latency(data, mesh8, monkeypatch):

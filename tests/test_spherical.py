@@ -85,9 +85,66 @@ def test_zero_rows_tolerated(mesh8):
     assert labels.shape == (X.shape[0],)
 
 
-def test_host_loop_false_rejected():
-    with pytest.raises(ValueError, match="host_loop"):
-        SphericalKMeans(k=3, host_loop=False)
+@pytest.mark.parametrize("mesh_name", ["mesh1", "mesh8", "mesh4x2"])
+def test_spherical_device_loop_matches_host(mesh_name, request):
+    """ISSUE 2 satellite: the sphere projection is folded into the
+    one-dispatch device loop's update step — host_loop=False must
+    reproduce the host loop's trajectory exactly (the same parity pin
+    tests/test_device_loop.py holds for the base KMeans)."""
+    mesh = request.getfixturevalue(mesh_name)
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(3000, 8)) + 2.0 * rng.integers(0, 5, size=(3000, 1))
+    kw = dict(k=5, max_iter=25, seed=42, compute_sse=True, mesh=mesh,
+              dtype=np.float64, empty_cluster="keep", verbose=False)
+    host = SphericalKMeans(host_loop=True, **kw).fit(X)
+    dev = SphericalKMeans(host_loop=False, **kw).fit(X)
+    assert dev.iterations_run == host.iterations_run
+    np.testing.assert_allclose(dev.centroids, host.centroids, atol=1e-9)
+    np.testing.assert_allclose(dev.sse_history, host.sse_history, rtol=1e-9)
+    np.testing.assert_allclose(np.linalg.norm(dev.centroids, axis=1), 1.0,
+                               atol=1e-12)
+
+
+def test_spherical_device_multi_restart_matches_host(mesh8):
+    """Batched n_init sweep composes with the sphere projection on
+    device: winner and trajectory match the host's sequential restarts."""
+    X, _ = _directional_data(seed=12)
+    kw = dict(k=3, max_iter=20, seed=7, n_init=3, init="forgy",
+              compute_sse=True, mesh=mesh8, dtype=np.float64,
+              empty_cluster="keep", verbose=False)
+    host = SphericalKMeans(host_loop=True, **kw).fit(X)
+    dev = SphericalKMeans(host_loop=False, **kw).fit(X)
+    assert dev.best_restart_ == host.best_restart_
+    np.testing.assert_allclose(dev.restart_inertias_,
+                               host.restart_inertias_, rtol=1e-9)
+    np.testing.assert_allclose(dev.centroids, host.centroids, atol=1e-9)
+
+
+def test_spherical_device_loop_empty_resample(mesh8):
+    """'resample' refill inside the spherical device loop: refilled rows
+    are (normalized) data rows, re-projected by the device hook — result
+    matches the host loop on a hostless dataset (the engine both loops
+    share)."""
+    X, _ = _directional_data(seed=13)
+    init = np.concatenate([_normalize(X[:2]), [[0.0, 0.0, -1.0]]])
+
+    def run(host_loop):
+        km = SphericalKMeans(k=3, max_iter=10, seed=3, init=init,
+                             empty_cluster="resample", mesh=mesh8,
+                             dtype=np.float64, host_loop=host_loop,
+                             verbose=False, compute_sse=True)
+        ds = km.cache(X)
+        ds._host = None
+        ds._host_weights = None
+        return km.fit(ds)
+
+    host, dev = run(True), run(False)
+    assert dev.iterations_run == host.iterations_run
+    np.testing.assert_allclose(dev.centroids, host.centroids, atol=1e-9)
+
+
+def _normalize(x):
+    return x / np.linalg.norm(x, axis=1, keepdims=True)
 
 
 def test_foreign_sharded_dataset_rejected(mesh8):
