@@ -39,6 +39,16 @@ an on-disk ``.npy`` with the double-buffered input pipeline ON
 Env: BENCH_STREAM_N / _D / _K / _BLOCK_ROWS / _EPOCHS / _PATH
 (accelerator default = the declared bigger-than-HBM config, 40M x 128
 k=1024 in 2M-row blocks; CPU default scales down to 1M x 32).
+
+BENCH_GMM=1 switches to the GMM E-STEP PIPELINE benchmark (ISSUE 3
+tentpole): the one-dispatch diag EM loop with the software-pipelined
+chunk schedule (pipeline=1) vs the serial oracle (pipeline=0),
+per-rep interleaved marginal ratios + the step-MFU column
+(``kmeans_tpu.benchmarks.bench_gmm_pipeline``).  Accelerator default is
+the pinned decision shape 2M x 128 k=256 diag (target >40% MFU vs the
+33% serial baseline, BASELINE.json ``gmm-estep-pipeline`` row); the CPU
+default scales down to the published gmm family-row shape 200k x 32
+k=32.  Env: BENCH_N / _D / _K / _ITERS, BENCH_GMM_COV.
 """
 
 from __future__ import annotations
@@ -163,6 +173,22 @@ def main() -> None:
             "platform": backend,
             "n_devices": len(jax.devices()),
         }))
+        return
+
+    if os.environ.get("BENCH_GMM"):
+        # GMM E-step pipeline benchmark (ISSUE 3): pipelined vs serial
+        # chunk schedule on the one-dispatch diag EM loop, interleaved
+        # per-rep ratios, step MFU on platforms with a pinned peak.
+        from kmeans_tpu.benchmarks import bench_gmm_pipeline
+        gn = int(os.environ.get("BENCH_N",
+                                2_097_152 if on_accel else 200_000))
+        gd = int(os.environ.get("BENCH_D", 128 if on_accel else 32))
+        gk = int(os.environ.get("BENCH_K", 256 if on_accel else 32))
+        gi = int(os.environ.get("BENCH_ITERS", 20))
+        gct = os.environ.get("BENCH_GMM_COV", "diag")
+        log(f"bench: GMM-PIPELINE mode backend={backend} N={gn} D={gd} "
+            f"k={gk} iters_gap={gi} cov={gct}")
+        bench_gmm_pipeline(gn, gd, gk, gi, cov_type=gct)
         return
 
     if os.environ.get("BENCH_STREAM"):

@@ -1,0 +1,180 @@
+"""Responsibility-exp precision rung (ISSUE 3): bf16 exp after max
+subtraction, decided by the 25-sigma survival probe — never by
+extrapolation.
+
+The E-step's VPU cost is one ``exp`` per (point, component) pair.
+After max subtraction the argument is <= 0 and gmm_step's own analysis
+says relative logp error ~2^-8 "barely moves a softmax" — but that is
+an argument, not a measurement, and the r3 variance-collapse bug came
+from exactly this kind of extrapolation.  ``_softmax_resp`` now takes
+an ``exp_dtype`` rung (None = f32, the shipped default; bf16 the
+candidate: round the subtracted argument to bf16, exp, widen back —
+the normalizer sum/divide stay f32).
+
+DECISION RULES — committed before measurement:
+
+1. **Accuracy gate (runs on THIS container — bf16 rounding is real
+   arithmetic on every backend, unlike the matmul precision flags CPU
+   ignores).**  The r3 failure probe (clusters offset up to ~50 sigma
+   from the centering shift; fitted variance must not collapse toward
+   reg_covar): the bf16-exp rung's max relative variance error must
+   stay (a) under the 5% bar and (b) within 1.5x the f32-exp baseline
+   error on the same draw.  FAIL -> the rung is REJECTED outright and
+   the knob documented as probe-rejected; the timing gate never runs.
+2. **Timing gate (hardware only — the VPU transcendental rate is the
+   quantity at stake and this container has no VPU).**  On TPU at
+   2M x 128 k=256 diag, pipelined schedule: bf16 exp must beat f32 exp
+   by >= 5% per E-pass (interleaved marginal ratio).  PASS both gates
+   -> wire ``exp_dtype=bf16`` as the mixture default (one commit, both
+   numbers in the message).  FAIL timing -> the rung stays available
+   but default-OFF, rejection recorded with the measured ratio.
+
+MEASURED — accuracy gate, this container (CPU, 2026-08-03; bf16
+rounding is genuine arithmetic on every backend, so unlike the matmul
+precision rungs this probe is decisive off-hardware):
+
+  f32 exp   max relative variance error 3.024197e-02
+  bf16 exp  max relative variance error 3.024197e-02  (ratio 1.000000)
+
+(3.024e-2 is the probe's own sampling-noise floor — the same figure the
+r5 HIGHEST/HIGH moment ladder bottomed out at on this draw shape.)  The
+bf16 rounding of the POST-SUBTRACTION argument is invisible to six
+digits of the probe statistic — the softmax is insensitive exactly as
+the 2^-8 analysis predicted, but now it is a measurement.  ACCURACY
+GATE: PASSED.  The rung therefore survives to the hardware timing
+gate, which is pinned for the next hardware session; until it runs the
+default stays ``exp_dtype=None`` (f32) — adopting on accuracy alone
+would claim an unmeasured speedup.
+
+Run:  python experiments/exp_gmm_exp_precision.py        (both gates on
+TPU; accuracy gate only elsewhere)
+"""
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from kmeans_tpu.parallel.gmm_step import _scan_estats
+
+D = 128
+ACCURACY_BAR = 0.05          # rule 1(a)
+ACCURACY_RATIO_BAR = 1.5     # rule 1(b)
+TIMING_BAR = 1.05            # rule 2
+
+
+def survival_probe(exp_dtype):
+    """The r3 hardware failure shape (exp_gmm_estep_retry.variance_probe
+    lineage): clusters offset 0..50 sigma from the centering shift; one
+    E pass through the REAL _scan_estats with the candidate exp rung;
+    returns max relative variance error of the M-step variance."""
+    rng = np.random.default_rng(0)
+    n, k = 262_144, 8
+    true_var = 4.0
+    offsets = np.linspace(0, 50, k)
+    comp = rng.integers(0, k, n)
+    x_np = (offsets[comp][:, None] * np.sqrt(true_var)
+            + rng.normal(size=(n, D)) * np.sqrt(true_var))
+    x = jnp.asarray(x_np, jnp.float32)
+    w = jnp.ones((n,), jnp.float32)
+    shift = jnp.mean(x, axis=0)
+    means_c = jnp.asarray(
+        offsets[:, None] * np.sqrt(true_var) * np.ones((k, D)),
+        jnp.float32) - shift[None, :]
+    inv_var = jnp.full((k, D), 1 / true_var, jnp.float32)
+    log_det = jnp.full((k,), D * np.log(true_var), jnp.float32)
+    log_w = jnp.full((k,), -np.log(k), jnp.float32)
+
+    @jax.jit
+    def one_pass(x, w):
+        return _scan_estats(x, w, means_c, inv_var, log_det, log_w,
+                            shift, chunk_size=32_768, model_shards=1,
+                            pipeline=1, exp_dtype=exp_dtype)
+
+    st = one_pass(x, w)
+    mu = st.xsum / st.resp_sum[:, None]
+    var = np.asarray(st.x2sum / st.resp_sum[:, None] - mu * mu)
+    return float(np.max(np.abs(var - true_var) / true_var))
+
+
+def timing_gate():
+    """Rule 2 (TPU only): interleaved marginal ratio of the pipelined
+    E pass with f32 vs bf16 exp at 2M x 128 k=256."""
+    n, k, chunk, gap = 2_097_152, 256, 32_768, 80
+    x = jax.random.normal(jax.random.PRNGKey(0), (n, D), jnp.float32)
+    w = jnp.ones((n,), jnp.float32)
+    rng = np.random.default_rng(1)
+    means = jnp.asarray(rng.normal(size=(k, D)), jnp.float32)
+    inv_var = jnp.ones((k, D), jnp.float32)
+    log_det = jnp.zeros((k,), jnp.float32)
+    log_w = jnp.full((k,), -np.log(k), jnp.float32)
+    shift = jnp.zeros((D,), jnp.float32)
+
+    def build(n_it, exp_dtype):
+        @jax.jit
+        def run(x, w, m):
+            def body(i, m):
+                st = _scan_estats(x, w, m, inv_var, log_det, log_w,
+                                  shift, chunk_size=chunk,
+                                  model_shards=1, pipeline=1,
+                                  exp_dtype=exp_dtype)
+                return m + 0.0 * (st.loglik + jnp.sum(st.xsum)
+                                  + jnp.sum(st.x2sum)
+                                  + jnp.sum(st.resp_sum))
+            return jnp.sum(lax.fori_loop(0, n_it, body, m))
+
+        float(run(x, w, means))                  # compile + warm ONCE
+        return run
+
+    # Four programs, compiled once — re-jitting per rep would spend the
+    # hardware session recompiling identical chains (review r8).
+    progs = {(n_it, dt): build(n_it, dt)
+             for n_it in (2, 2 + gap) for dt in (None, jnp.bfloat16)}
+
+    def many(n_it, exp_dtype):
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(progs[(n_it, exp_dtype)](x, w, means))
+            times.append(time.perf_counter() - t0)
+        return float(np.median(times))
+
+    ratios = []
+    for _ in range(3):
+        m32 = many(2 + gap, None) - many(2, None)
+        mbf = many(2 + gap, jnp.bfloat16) - many(2, jnp.bfloat16)
+        ratios.append(m32 / max(mbf, 1e-9))
+    r = float(np.median(ratios))
+    print(f"  timing gate: f32/bf16 E-pass ratio {r:.3f}x "
+          f"(bar {TIMING_BAR:.2f}x) -> "
+          f"{'ADOPT bf16 exp' if r >= TIMING_BAR else 'default stays f32'}",
+          flush=True)
+
+
+def main():
+    err_f32 = survival_probe(None)
+    err_bf16 = survival_probe(jnp.bfloat16)
+    ratio = err_bf16 / max(err_f32, 1e-300)
+    ok = err_bf16 <= ACCURACY_BAR and ratio <= ACCURACY_RATIO_BAR
+    print(f"  f32  exp survival probe: var_err={err_f32:.3e}", flush=True)
+    print(f"  bf16 exp survival probe: var_err={err_bf16:.3e} "
+          f"(ratio {ratio:.3f}; bars: abs {ACCURACY_BAR}, ratio "
+          f"{ACCURACY_RATIO_BAR})", flush=True)
+    verdict = "PASSED" if ok else "FAILED — rung REJECTED"
+    print(f"  ACCURACY GATE: {verdict}", flush=True)
+    if ok and jax.default_backend() == "tpu":
+        timing_gate()
+    elif ok:
+        print("  timing gate requires TPU hardware (VPU transcendental "
+              "rate) — pinned for the next hardware session; default "
+              "stays exp_dtype=None", flush=True)
+
+
+if __name__ == "__main__":
+    main()

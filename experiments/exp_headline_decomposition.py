@@ -1,0 +1,155 @@
+"""Headline KMeans MFU decomposition (ISSUE 3 satellite / VERDICT weak
+#8): the ~70% MFU headline has been flat since r2 and the remaining
+~30% has only ever been ASSERTED ("argmin + scatter + DMA") — this
+harness decomposes it by measurement.
+
+Method: a fused device step cannot be timed phase-by-phase from the
+host, so ``parallel.distributed.make_estep_phase_fn`` builds a ladder
+of cumulative-prefix programs over the XLA matmul path —
+
+  distance  the (chunk, k) distance matmul + one cheap tile reduction
+  assign    + argmin / min over the tile
+  reduce    + the one-hot scatter-sum matmul, counts, and the (k, D)
+            cross-shard psum (= the full per-iteration stats pass)
+
+— each measured as a marginal between a 2- and a (2+T)-iteration chain
+(one dispatch each; the repo's standard dispatch-latency cancellation),
+with reps interleaved ACROSS rungs and per-rep differences taken before
+the median (``utils.profiling.measure_phase_ladder``).  Alongside the
+ladder the fused Pallas kernel's full step (the shipped headline mode,
+whose phases cannot be prefix-laddered) is measured with the same
+marginal so the XLA ladder can be scaled onto it.
+
+Caveats printed with the numbers: the 'assign'-'distance' difference is
+argmin-minus-sum (a slight undercount of the argmin reduction); the
+per-iteration psum/DMA lands in 'reduce'; and the residual between the
+'reduce' rung and the published full-fit ms/iter is M-step + while_loop
+overhead.
+
+DECISION RULE (committed now, measured on hardware): decompose the
+headline shape (10M x 128, k=1024).  If one phase owns >= 15% of the
+step (>= half the idle 30%), that phase is the next schedule target and
+an ISSUE should be cut for it (the r8 GMM pipelining is the template);
+if no phase owns >= 15%, the ~70% ceiling is PINNED as measured —
+docs/PERFORMANCE.md "The remaining 30%" records whichever lands.
+
+Run on TPU hardware:  python experiments/exp_headline_decomposition.py
+(CPU smoke runs a scaled-down shape to exercise the harness; a 2-core
+container's numbers decompose XLA:CPU scheduling, not the chip.)
+"""
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import jax
+import numpy as np
+
+from kmeans_tpu.benchmarks import step_mfu
+from kmeans_tpu.parallel import distributed as dist
+from kmeans_tpu.parallel.mesh import make_mesh, mesh_shape
+from kmeans_tpu.parallel.sharding import choose_chunk_size, shard_points
+from kmeans_tpu.utils.profiling import measure_phase_ladder
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        n, d, k, gap = 10_000_000, 128, 1024, 80
+    else:
+        n, d, k, gap = 200_000, 32, 64, 12
+        print("CPU smoke run — harness exercise only; the decision rule "
+              "is a hardware measurement.", flush=True)
+
+    mesh = make_mesh()
+    data_shards, model_shards = mesh_shape(mesh)
+    chunk = choose_chunk_size(-(-n // data_shards), k, d)
+    rng = np.random.default_rng(42)
+    X = rng.uniform(-1, 1, size=(n, d)).astype(np.float32)
+    pts, w = shard_points(X, mesh, chunk)
+    cents = jax.device_put(
+        dist.pad_centroids(X[:k].copy(), model_shards),
+        dist.centroid_sharding(mesh))
+
+    fns = {}
+    for ph in dist.ESTEP_PHASES:
+        fns[ph] = {m: dist.make_estep_phase_fn(
+            mesh, chunk_size=chunk, n_iters=m, phase=ph)
+            for m in (2, 2 + gap)}
+        for m in (2, 2 + gap):
+            float(fns[ph][m](pts, w, cents))         # compile + warm
+
+    def marginal(ph):
+        def measure():
+            t0 = time.perf_counter()
+            float(fns[ph][2](pts, w, cents))
+            t_small = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            float(fns[ph][2 + gap](pts, w, cents))
+            return max(time.perf_counter() - t0 - t_small, 1e-9) / gap
+        return measure
+
+    ladder = measure_phase_ladder(
+        [(ph, marginal(ph)) for ph in dist.ESTEP_PHASES], reps=5)
+    full = ladder[-1]["cumulative"]
+    flops = 4.0 * n * d * k       # distance + scatter matmuls (real MFU)
+    for row in ladder:
+        share = row["seconds"] / full if full > 0 else 0.0
+        print(f"  {row['phase']:9s} {row['seconds'] * 1e3:8.3f} ms/iter "
+              f"({share:5.1%} of the stats pass; spread "
+              f"{row['spread']:.0%})", flush=True)
+    mfu = step_mfu(flops, full)
+    if on_tpu and mfu is not None:
+        print(f"  XLA stats pass: {full * 1e3:.2f} ms/iter = {mfu:.1%} "
+              f"MFU; DECISION RULE: a phase owning >= 15% of the step "
+              f"is the next schedule target, else the ceiling is "
+              f"pinned as measured", flush=True)
+
+    # The shipped headline mode for scale: the fused Pallas kernel's
+    # full step, same marginal method (phases not separable).
+    try:
+        from kmeans_tpu.ops.pallas_kernels import resolve_auto
+        mode = resolve_auto(n, d, k)
+        if mode in dist.PALLAS_MODES:
+            fit_s = dist.make_fit_fn(mesh, chunk_size=chunk, mode=mode,
+                                     k_real=k, max_iter=2,
+                                     tolerance=1e-30, empty_policy="keep",
+                                     history_sse=False)
+            fit_b = dist.make_fit_fn(mesh, chunk_size=chunk, mode=mode,
+                                     k_real=k, max_iter=2 + gap,
+                                     tolerance=1e-30, empty_policy="keep",
+                                     history_sse=False)
+            seeds_s = jax.device_put(np.zeros((2,), np.uint32))
+            seeds_b = jax.device_put(np.zeros((2 + gap,), np.uint32))
+
+            def timed(fn, seeds):
+                t0 = time.perf_counter()
+                out = fn(pts, w, cents, seeds)
+                int(out[1])
+                return time.perf_counter() - t0
+
+            timed(fit_s, seeds_s), timed(fit_b, seeds_b)
+            ms = []
+            for _ in range(5):
+                ms.append((timed(fit_b, seeds_b) - timed(fit_s, seeds_s))
+                          / gap)
+            pallas_iter = float(np.median(ms))
+            print(f"  pallas full step ({mode}): "
+                  f"{pallas_iter * 1e3:.2f} ms/iter "
+                  f"(the shipped headline path — scale the XLA ladder "
+                  f"shares onto this)", flush=True)
+        else:
+            print(f"  auto resolves to {mode!r} at this shape — the XLA "
+                  f"ladder above IS the shipped path", flush=True)
+    except Exception as e:                    # noqa: BLE001 — context only
+        print(f"  pallas comparison skipped: {e}", flush=True)
+
+    print(json.dumps({"shape": [n, d, k], "chunk": chunk,
+                      "ladder": ladder}, default=float))
+
+
+if __name__ == "__main__":
+    main()

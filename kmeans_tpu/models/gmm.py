@@ -100,7 +100,12 @@ EM_CHUNK_BUDGET = 1 << 23
 # budget on k alone (small-k fits of a large single-chunk shard) is
 # additionally bounded near that plateau rather than scanning wherever
 # the budget allows (ADVICE r5 low).  ``_dataset``'s own auto choice is
-# budget-driven and unchanged.
+# budget-driven and unchanged.  The r8 pipelined schedule carries one
+# extra in-flight (chunk, k) logp tile + a centered chunk copy in the
+# scan carry, which shifts the fusion-boundary economics this plateau
+# priced — the re-sweep under pipeline=1 is part of the pinned hardware
+# run (experiments/exp_gmm_pipelined_estep.py; the CPU smoke measured
+# the plateau flat here, so 32768 stands until hardware says otherwise).
 EM_MAX_CHUNK = 32768
 
 # Weighted-mean pass for the centering shift (GSPMD: XLA inserts the
@@ -123,11 +128,12 @@ _STEP_BUILDERS = {
 }
 
 
-def _get_fns(mesh: Mesh, chunk: int, cov_type: str = "diag"):
+def _get_fns(mesh: Mesh, chunk: int, cov_type: str = "diag",
+             pipeline: int = 1):
     step_b, pred_b = _STEP_BUILDERS[cov_type]
     return _STEP_CACHE.get_or_create(
-        (mesh, chunk, "gmm", step_b),
-        lambda: (step_b(mesh, chunk_size=chunk),
+        (mesh, chunk, "gmm", step_b, pipeline),
+        lambda: (step_b(mesh, chunk_size=chunk, pipeline=pipeline),
                  pred_b(mesh, chunk_size=chunk)))
 
 
@@ -148,6 +154,16 @@ class GaussianMixture:
     device instead of the host's float64 — same documented divergence as
     ``KMeans(host_loop=False)``).
 
+    ``pipeline`` ('auto' | 0 | 1) selects the E-step chunk schedule:
+    the software-pipelined two-stage scan that overlaps one chunk's
+    softmax (VPU) with the next chunk's log-density matmuls (MXU), or
+    the serial four-phase body (``pipeline=0`` — the bit-exact parity
+    oracle).  'auto' (default) resolves per platform by measurement:
+    pipelined on accelerators, serial on CPU (where the carried logp
+    tile measured a 0.80x regression with nothing to overlap —
+    ``_resolve_pipeline``).  ``estep_path_`` records which schedule a
+    fit actually ran ('pipelined' | 'serial').
+
     Chunking note: raw-array inputs are chunked with the EM-specific
     ``EM_CHUNK_BUDGET`` (2^23 elements; docs/PERFORMANCE.md — the
     K-Means budget costs ~2x per EM iteration at k=256-class shapes).
@@ -161,7 +177,7 @@ class GaussianMixture:
                     "max_iter", "n_init", "init_params", "weights_init",
                     "means_init", "precisions_init", "seed", "dtype",
                     "mesh", "model_shards", "chunk_size", "host_loop",
-                    "verbose")
+                    "pipeline", "verbose")
 
     def __init__(self, n_components: int = 1, *,
                  covariance_type: str = "diag", tol: float = 1e-3,
@@ -170,7 +186,8 @@ class GaussianMixture:
                  weights_init=None, means_init=None, precisions_init=None,
                  seed: int = 42, dtype=None, mesh: Optional[Mesh] = None,
                  model_shards: int = 1, chunk_size: Optional[int] = None,
-                 host_loop: bool = True, verbose: bool = False):
+                 host_loop: bool = True, pipeline="auto",
+                 verbose: bool = False):
         if covariance_type not in ("diag", "spherical", "tied", "full"):
             raise ValueError(
                 "covariance_type must be one of 'diag', 'spherical', "
@@ -210,8 +227,22 @@ class GaussianMixture:
                              f"False ('auto' is KMeans-only), got "
                              f"{host_loop!r}")
         self.host_loop = bool(host_loop)
+        # E-step chunk schedule (ISSUE 3): 'auto' resolves to the
+        # software-pipelined two-stage scan (stage A: next chunk's
+        # log-density matmuls; stage B: previous chunk's softmax +
+        # moments — parallel.gmm_step._chunked_epass); 0 forces the
+        # serial four-phase body, the bit-exact parity oracle (the
+        # prefetch=0 discipline of r6).
+        if pipeline not in ("auto", 0, 1, True, False):
+            raise ValueError(f"pipeline must be 'auto', 0, or 1; got "
+                             f"{pipeline!r}")
+        self.pipeline = pipeline if pipeline == "auto" else int(pipeline)
         self.verbose = verbose
 
+        # Which E-step schedule the last fit IN THIS PROCESS ran
+        # ('pipelined' | 'serial'); None pre-fit and on loaded models
+        # (the schedule is a per-run resolution, not fitted state).
+        self.estep_path_: Optional[str] = None
         self.weights_: Optional[np.ndarray] = None
         self.means_: Optional[np.ndarray] = None
         self.covariances_: Optional[np.ndarray] = None
@@ -220,6 +251,35 @@ class GaussianMixture:
         self.lower_bound_: float = -np.inf
 
     # ------------------------------------------------------------- plumbing
+
+    def _resolve_pipeline(self) -> int:
+        """Resolve the ``pipeline`` knob to the schedule that runs.
+
+        'auto' is platform-aware, per measurement: the two schedules
+        are bit-exact parity partners (pinned,
+        tests/test_gmm_pipeline.py), so the choice is purely a cost
+        call.  On CPU the skewed schedule's carried logp tile is pure
+        extra memory traffic — no separate VPU/MXU to overlap — and the
+        r8 CPU proxy measured it 0.80x (every interleaved rep slower;
+        BASELINE.md) -> 'auto' keeps the serial body there.  On
+        accelerators 'auto' -> 1, the schedule built for the MXU-idle
+        softmax stall; the hardware before/after (>40% MFU target vs
+        the 33% serial baseline at 2M x 128 k=256) is the pinned
+        ``gmm-estep-pipeline`` row in BASELINE.json, whose committed
+        decision rule flips accelerator-'auto' back to 0 if the overlap
+        loses on hardware too.  Every fit records what actually ran in
+        ``estep_path_``."""
+        if self.pipeline == "auto":
+            import jax
+            return 0 if jax.default_backend() == "cpu" else 1
+        return int(self.pipeline)
+
+    def _note_estep_path(self) -> int:
+        """Set the ``estep_path_`` observability attr; returns the
+        resolved pipeline flag."""
+        p = self._resolve_pipeline()
+        self.estep_path_ = "pipelined" if p else "serial"
+        return p
 
     def _resolve_mesh(self) -> Mesh:
         if self.mesh is None:
@@ -566,7 +626,8 @@ class GaussianMixture:
         ds = self._dataset(X, sample_weight)
         mesh = self._resolve_mesh()
         chunk = self._eff_chunk(ds)
-        step_fn, _ = _get_fns(mesh, chunk, self.covariance_type)
+        pipeline = self._note_estep_path()
+        step_fn, _ = _get_fns(mesh, chunk, self.covariance_type, pipeline)
         self._fit_chunk = chunk
         # Centering shift: the dataset's weighted global mean (see module
         # docstring).  One cheap GSPMD pass, fixed for the whole fit.
@@ -711,6 +772,7 @@ class GaussianMixture:
         mesh = self._resolve_mesh()
         ct = self.covariance_type
         k = self.n_components
+        pipeline = self._note_estep_path()
 
         # ---- pass: weighted centering shift (+ positive-row count) in
         # float64 on the host.  Items may be (block, weights) pairs —
@@ -772,7 +834,7 @@ class GaussianMixture:
                     make_blocks(), prefetch, stage_block)) as it:
                 for pts, w in it:
                     if step_fn is None:
-                        step_fn = _get_fns(mesh, chunk, ct)[0]
+                        step_fn = _get_fns(mesh, chunk, ct, pipeline)[0]
                     outs = [step_fn(pts, w, *t) for t in tables_list]
                     for i, st in enumerate(outs):
                         st = jax.device_get(st)
@@ -1048,13 +1110,15 @@ class GaussianMixture:
             log_w0 = log_w0[: len(alive)]
         R_live = len(alive)
         chunk = self._eff_chunk(ds)
+        pipeline = self._note_estep_path()
         key = (mesh, chunk, k, self.max_iter, float(self.tol),
-               float(self.reg_covar), ct, R_live, "gmmmultifit")
+               float(self.reg_covar), ct, R_live, pipeline, "gmmmultifit")
         fit_fn = _STEP_CACHE.get_or_create(
             key, lambda: make_gmm_multi_fit_fn(
                 mesh, chunk_size=chunk, k_real=k,
                 max_iter=self.max_iter, tol=float(self.tol),
-                reg_covar=float(self.reg_covar), cov_type=ct))
+                reg_covar=float(self.reg_covar), cov_type=ct,
+                pipeline=pipeline))
         means_out, var_out, log_w_out, n_it, hist, conv, best, lls = \
             fit_fn(ds.points, ds.weights,
                    jnp.asarray(shift.astype(self.dtype)),
@@ -1117,12 +1181,14 @@ class GaussianMixture:
                    "full": make_gmm_fit_full_fn}[ct]
         kwargs = {"cov_type": ct} if ct in ("diag", "spherical") else {}
         chunk = self._eff_chunk(ds)
+        pipeline = self._note_estep_path()
         key = (mesh, chunk, self.n_components, self.max_iter,
-               float(self.tol), float(self.reg_covar), ct, "gmmfit")
+               float(self.tol), float(self.reg_covar), ct, pipeline,
+               "gmmfit")
         fit_fn = _STEP_CACHE.get_or_create(key, lambda: builder(
             mesh, chunk_size=chunk, k_real=self.n_components,
             max_iter=self.max_iter, tol=float(self.tol),
-            reg_covar=float(self.reg_covar), **kwargs))
+            reg_covar=float(self.reg_covar), pipeline=pipeline, **kwargs))
         k = self.n_components
         k_pad = self._k_pad
         d = self.means_.shape[1]
@@ -1185,8 +1251,13 @@ class GaussianMixture:
         self._check_fitted()
         ds = self._dataset(X)
         mesh = self._resolve_mesh()
+        # Pass the RESOLVED pipeline: the predict builder itself is
+        # schedule-independent, but sharing the fit's cache key avoids
+        # a duplicate entry whose step fn carries a schedule the fit
+        # didn't run (review r8).
         _, predict_fn = _get_fns(mesh, self._eff_chunk(ds),
-                                 self.covariance_type)
+                                 self.covariance_type,
+                                 self._resolve_pipeline())
         labels, logr, lse = predict_fn(ds.points, *self._params_dev(mesh))
         k = self.n_components
         return (np.asarray(labels)[: ds.n],
@@ -1249,7 +1320,9 @@ class GaussianMixture:
         with contextlib.closing(prefetch_iter(make_blocks(), prefetch,
                                               stage)) as it:
             for m, chunk, pts in it:
-                _, predict_fn = _get_fns(mesh, chunk, self.covariance_type)
+                _, predict_fn = _get_fns(mesh, chunk,
+                                         self.covariance_type,
+                                         self._resolve_pipeline())
                 if params is None:
                     params = self._params_dev(mesh)
                 labels, logr, lse = predict_fn(pts, *params)
@@ -1344,6 +1417,7 @@ class GaussianMixture:
             "init_params": self.init_params, "seed": self.seed,
             "model_shards": self.model_shards,
             "chunk_size": self.chunk_size, "host_loop": self.host_loop,
+            "pipeline": self.pipeline,
             "verbose": self.verbose, "dtype": str(self.dtype),
             "weights_": np.asarray(self.weights_)
             if self.weights_ is not None else np.zeros((0,)),
@@ -1380,6 +1454,8 @@ class GaussianMixture:
                  for name in ("weights_init", "means_init",
                               "precisions_init")
                  if f"cfg_{name}" in state}
+        pipe_raw = state.get("pipeline", "auto")
+        pipeline = "auto" if str(pipe_raw) == "auto" else int(pipe_raw)
         model = cls(n_components=int(state["n_components"]),
                     covariance_type=str(state["covariance_type"]),
                     tol=float(state["tol"]),
@@ -1393,6 +1469,7 @@ class GaussianMixture:
                                 if state["chunk_size"] is not None else
                                 None),
                     host_loop=bool(state.get("host_loop", True)),
+                    pipeline=pipeline,
                     verbose=bool(state["verbose"]),
                     dtype=np.dtype(str(state["dtype"])), **inits)
         if state["means_"].size:

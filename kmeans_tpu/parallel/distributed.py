@@ -305,6 +305,94 @@ def make_step_fn(mesh: Mesh, *, chunk_size: int,
     return jax.jit(mapped)
 
 
+#: Ordered phase labels of the assignment pass's cumulative-prefix
+#: ladder (``make_estep_phase_fn``): 'distance' runs only the (chunk, k)
+#: distance matmul (+ a one-pass tile reduction so nothing is DCE'd),
+#: 'assign' adds the argmin/min over the tile, 'reduce' adds the one-hot
+#: scatter-sum matmul, counts, and the cross-shard (k, D) psum — i.e.
+#: the full per-iteration statistics pass.
+ESTEP_PHASES = ("distance", "assign", "reduce")
+
+
+def make_estep_phase_fn(mesh: Mesh, *, chunk_size: int, n_iters: int,
+                        phase: str, mode: str = "matmul") -> Callable:
+    """Phase-prefix iteration chain for the phase-decomposition harness
+    (``utils.profiling.measure_phase_ladder``; ISSUE 3 / VERDICT weak
+    #8): ``n_iters`` repetitions of ONLY the assignment pass's leading
+    phases, all under one dispatch, with a trivial data dependency
+    threading the centroid table through the loop so no iteration is
+    dead-code-eliminated.  Returns a jitted
+    ``(points, weights, centroids_block) -> scalar``; the harness times
+    two chain lengths and takes the marginal, then attributes each
+    phase the rung-to-rung difference.
+
+    Caveats the harness documents alongside its numbers: the 'distance'
+    rung pays one cheap pass over the (chunk, k) tile (a sum) so its
+    matmul cannot be elided — the 'assign' - 'distance' difference is
+    therefore argmin-minus-sum, a slight undercount of the argmin
+    reduction itself; and only the 'reduce' rung carries the per-
+    iteration (k, D) psum, so collective/DMA cost lands in that phase.
+    Pallas modes fuse all phases inside one kernel and cannot be
+    prefix-laddered — the harness ladders the XLA 'matmul' path and
+    reports the fused kernel's full-step time next to it."""
+    if phase not in ESTEP_PHASES:
+        raise ValueError(f"phase must be one of {ESTEP_PHASES}, got "
+                         f"{phase!r}")
+    if mode in PALLAS_MODES:
+        raise ValueError("the fused Pallas kernel has no phase prefixes; "
+                         "ladder mode='matmul' and compare the fused "
+                         "kernel's full step alongside")
+    data_shards, model_shards = mesh_shape(mesh)
+
+    def run(points, weights, centroids_block):
+        k_local, d = centroids_block.shape
+        acc = _accum_dtype(points.dtype)
+        n_chunks = points.shape[0] // chunk_size
+        xs = (points.reshape(n_chunks, chunk_size, d),
+              weights.astype(acc).reshape(n_chunks, chunk_size))
+        select = _model_axis_select(model_shards)
+        axes = (DATA_AXIS, MODEL_AXIS)
+
+        def iter_dep(cents):
+            if phase == "reduce":
+                def body(carry, chunk):
+                    xc, wc = chunk
+                    return accumulate_chunk(
+                        carry, xc, wc, cents, mode=mode, select_fn=select,
+                        need_sse=False, need_farthest=False,
+                        need_sse_pc=False), None
+                st, _ = lax.scan(body, init_stats(k_local, d, acc), xs)
+                sums = lax.psum(st.sums, axes)
+                counts = lax.psum(st.counts, axes)
+                return jnp.sum(sums) + jnp.sum(counts)
+
+            def body(carry, chunk):
+                xc, wc = chunk
+                d2 = pairwise_sq_dists(xc, cents, mode=mode)
+                if phase == "distance":
+                    return carry + jnp.sum(d2), None
+                best = jnp.argmin(d2, axis=1)
+                mind2 = jnp.min(d2, axis=1)
+                return carry + jnp.sum(mind2 * wc) \
+                    + jnp.sum(best.astype(acc)), None
+
+            dep, _ = lax.scan(body, jnp.zeros((), acc), xs)
+            return lax.psum(dep, axes)
+
+        def loop_body(i, cents):
+            return cents + 0.0 * iter_dep(cents)
+
+        out = lax.fori_loop(0, n_iters, loop_body,
+                            centroids_block.astype(acc))
+        return lax.psum(jnp.sum(out), axes) / (data_shards * model_shards)
+
+    mapped = shard_map(
+        run, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(MODEL_AXIS, None)),
+        out_specs=P(), check_vma=False)
+    return jax.jit(mapped)
+
+
 def _empty_seed_array(seed: int, iter0: int, max_iter: int) -> np.ndarray:
     """Per-iteration base seeds for the device loops' empty-cluster draws.
 

@@ -50,6 +50,28 @@ the O(chunk*k_local) matmul tile, negligible on ICI.  Per-shard
 statistics cover the local block and are embedded + psum'd like the
 K-Means step.  Component padding rows (k not divisible by the axis)
 carry ``log_weights = -inf`` so they never receive responsibility.
+
+Software-pipelined E pass (``pipeline=1``, the builder default —
+``GaussianMixture(pipeline='auto')`` resolves it per platform; ISSUE
+3): the
+serial chunk body runs four phases — two log-density matmuls (MXU),
+the max-subtracted exp/softmax (VPU transcendentals), and two moment
+matmuls (MXU) — strictly in sequence, so the MXU idles while the
+(chunk, k) softmax burns one ``exp`` per point-component pair (~33% MFU
+at 2M x 128 k=256, docs/PERFORMANCE.md "The mixture family").  The
+pipelined schedule skews the scan one chunk: each ``lax.scan`` step
+computes chunk i's log-density matmuls (stage A) while CONSUMING chunk
+i-1's carried logp tile — softmax + moment matmuls (stage B) — so the
+two stages have no data dependency inside a step and XLA's scheduler is
+free to overlap stage B's VPU transcendentals with stage A's MXU
+matmuls (the online-softmax stage-overlap discipline of the
+flash-attention literature, applied at chunk rather than tile
+granularity; no Pallas needed).  The carry holds one in-flight
+(chunk, k_local) logp tile plus the centered chunk (HBM-resident
+between steps — the double-buffer cost the chunk-size sweep re-prices,
+``EM_MAX_CHUNK``).  Per chunk the ARITHMETIC is identical to the serial
+body, and chunk statistics fold in the same order, so ``pipeline=0`` is
+the bit-exact parity oracle (the ``prefetch=0`` discipline of r6).
 """
 
 from __future__ import annotations
@@ -92,45 +114,73 @@ def _log_prob_chunk(x, means, inv_var, log_det, log_weights):
             - 0.5 * (quad + log_det[None, :] + d * _LOG2PI))
 
 
+def _diag_stage_fns(means, inv_var, log_det, log_weights,
+                    model_shards: int, acc, exp_dtype=None):
+    """The diag/spherical E pass split into its two pipeline stages —
+    the ONE implementation of this arithmetic (``_estep_tile`` and the
+    chunked scans both call it, so the hard-won precision rules below
+    cannot drift between the oracle and the scan bodies).
+
+    ``logp_fn`` is stage A (the two MXU log-density matmuls);
+    ``consume`` is stage B: the shared cross-model-axis softmax plus
+    the moment accumulators.  Moments run at HIGH matmul precision: on
+    TPU, "f32" dots execute with bf16-rounded products by default (fine
+    for the responsibility softmax — relative logp error ~2^-8 barely
+    moves a softmax), but the M-step's variance is the DIFFERENCE
+    S2/R - mu^2, which survives only while |mu|/sigma < ~sqrt(2^8) ~ 16
+    per dim under bf16 products.  Clusters offset ~25 sigma from the
+    global mean collapsed to reg_covar on hardware (r3, found driving
+    the v5e; invisible on CPU where f32 dots are exact).  r3 pinned
+    HIGHEST (the 6-pass bf16_6x split ~ true f32); the r5 precision
+    ladder (experiments/exp_gmm_estep_retry.py, real v5e) measured
+    HIGH (the 3-pass bf16_3x split) INDISTINGUISHABLE from HIGHEST on
+    the r3 failure shape (25+ sigma offsets: max relative variance
+    error 3.024e-2 vs 3.024e-2 — the probe's own sampling noise)
+    while cutting the full E-pass 13.79 -> 9.01 ms at 2M x 128 k=256
+    (20 -> 31% MFU); DEFAULT (one bf16-product pass) degrades the
+    probe to 4.1e-2 and stays rejected.  HIGH it is — for the two
+    moment matmuls only."""
+    hi = lax.Precision.HIGH
+
+    def logp_fn(xc):
+        return _log_prob_chunk(xc, means, inv_var, log_det, log_weights)
+
+    def consume(carry, logp, xc, wc):
+        resp, lse = _softmax_resp(logp, wc, model_shards,
+                                  exp_dtype=exp_dtype)
+        return EStats(
+            carry.resp_sum + jnp.sum(resp, axis=0),
+            carry.xsum + lax.dot_general(
+                resp, xc, (((0,), (0,)), ((), ())),
+                preferred_element_type=acc, precision=hi),
+            carry.x2sum + lax.dot_general(
+                resp, xc * xc, (((0,), (0,)), ((), ())),
+                preferred_element_type=acc, precision=hi),
+            carry.loglik + jnp.sum(jnp.where(wc > 0, lse * wc, 0.0)))
+
+    return logp_fn, consume
+
+
+def _zero_estats(k_local: int, d: int, acc) -> EStats:
+    return EStats(jnp.zeros((k_local,), acc),
+                  jnp.zeros((k_local, d), acc),
+                  jnp.zeros((k_local, d), acc), jnp.zeros((), acc))
+
+
 def _estep_tile(x, w, means, inv_var, log_det, log_weights,
                 model_shards: int):
     """One chunk's LOCAL-block contribution to EStats.  With the component
     table sharded, the softmax normalizer (row max + denominator) is
     reconstructed globally via pmax/psum over the model axis; the
     statistics stay local to this shard's block.  ``loglik`` is identical
-    on every model shard (the caller divides the cross-axis psum out)."""
-    logp = _log_prob_chunk(x, means, inv_var, log_det, log_weights)
-    # Weighted responsibilities via the shared cross-model-axis softmax
-    # (one implementation for every covariance type).
-    resp, lse = _softmax_resp(logp, w, model_shards)
-    # Moment accumulators run at HIGH matmul precision: on TPU, "f32"
-    # dots execute with bf16-rounded products by default (fine for the
-    # responsibility softmax above — relative logp error ~2^-8 barely
-    # moves a softmax), but the M-step's variance is the DIFFERENCE
-    # S2/R - mu^2, which survives only while |mu|/sigma < ~sqrt(2^8) ~ 16
-    # per dim under bf16 products.  Clusters offset ~25 sigma from the
-    # global mean collapsed to reg_covar on hardware (r3, found driving
-    # the v5e; invisible on CPU where f32 dots are exact).  r3 pinned
-    # HIGHEST (the 6-pass bf16_6x split ~ true f32); the r5 precision
-    # ladder (experiments/exp_gmm_estep_retry.py, real v5e) measured
-    # HIGH (the 3-pass bf16_3x split) INDISTINGUISHABLE from HIGHEST on
-    # the r3 failure shape (25+ sigma offsets: max relative variance
-    # error 3.024e-2 vs 3.024e-2 — the probe's own sampling noise)
-    # while cutting the full E-pass 13.79 -> 9.01 ms at 2M x 128 k=256
-    # (20 -> 31% MFU); DEFAULT (one bf16-product pass) degrades the
-    # probe to 4.1e-2 and stays rejected.  HIGH it is — for the two
-    # moment matmuls only.
-    hi = lax.Precision.HIGH
-    return EStats(
-        resp_sum=jnp.sum(resp, axis=0),
-        xsum=lax.dot_general(resp, x, (((0,), (0,)), ((), ())),
-                             preferred_element_type=x.dtype,
-                             precision=hi),
-        x2sum=lax.dot_general(resp, x * x, (((0,), (0,)), ((), ())),
-                              preferred_element_type=x.dtype,
-                              precision=hi),
-        loglik=jnp.sum(jnp.where(w > 0, lse * w, 0.0)),
-    )
+    on every model shard (the caller divides the cross-axis psum out).
+    Exactly the shared stage pair applied to one chunk and a zero
+    carry (``_diag_stage_fns``)."""
+    k, d = means.shape
+    acc = x.dtype
+    logp_fn, consume = _diag_stage_fns(means, inv_var, log_det,
+                                       log_weights, model_shards, acc)
+    return consume(_zero_estats(k, d, acc), logp_fn(x), x, w)
 
 
 def estep_chunk(x, w, means, inv_var, log_det, log_weights):
@@ -138,30 +188,78 @@ def estep_chunk(x, w, means, inv_var, log_det, log_weights):
     return _estep_tile(x, w, means, inv_var, log_det, log_weights, 1)
 
 
-def _scan_estats(points, weights, means_blk, inv_var_blk, log_det_blk,
-                 log_w_blk, shift, *, chunk_size: int, model_shards: int):
-    """Shard-local chunked E pass -> local-block EStats (pre-psum).
-    ``shift`` centers each chunk in registers; ``means_blk`` must already
-    be in the centered frame."""
-    k_local, d = means_blk.shape
-    acc = points.dtype
+def _chunked_epass(points, weights, shift, *, chunk_size: int,
+                   pipeline: int, logp_fn, consume_fn, init, acc):
+    """The shared chunk loop of every covariance type's E pass.
+
+    ``logp_fn(xc) -> (chunk, k_local) logp`` is stage A (the MXU
+    log-density matmuls); ``consume_fn(stats, logp, xc, wc) -> stats``
+    is stage B (softmax + moment accumulation).  ``xc`` arrives already
+    centered by ``shift``.
+
+    ``pipeline=0`` runs A and B back-to-back per chunk (the serial
+    four-phase body — the parity oracle).  ``pipeline=1`` skews the
+    schedule one chunk: a prologue computes chunk 0's logp outside the
+    scan, each scan step then runs stage A for chunk i and stage B for
+    chunk i-1 (no data dependency between the two inside a step, so XLA
+    can overlap the VPU softmax with the next chunk's MXU matmuls), and
+    an epilogue drains the final in-flight chunk.  Per chunk the
+    arithmetic and the fold order of the statistics are IDENTICAL to
+    the serial body — the schedules are bit-exact parity partners
+    (pinned, tests/test_gmm_pipeline.py)."""
+    d = points.shape[1]
     n_chunks = points.shape[0] // chunk_size
     xs = (points.reshape(n_chunks, chunk_size, d),
           weights.astype(acc).reshape(n_chunks, chunk_size))
 
-    def body(carry, chunk):
-        xc, wc = chunk
-        st = _estep_tile(xc - shift[None, :], wc, means_blk, inv_var_blk,
-                         log_det_blk, log_w_blk, model_shards)
-        return EStats(carry.resp_sum + st.resp_sum,
-                      carry.xsum + st.xsum,
-                      carry.x2sum + st.x2sum,
-                      carry.loglik + st.loglik), None
+    if not pipeline:
+        def body(carry, chunk):
+            xc_raw, wc = chunk
+            xc = xc_raw - shift[None, :]
+            return consume_fn(carry, logp_fn(xc), xc, wc), None
 
-    init = EStats(jnp.zeros((k_local,), acc), jnp.zeros((k_local, d), acc),
-                  jnp.zeros((k_local, d), acc), jnp.zeros((), acc))
-    st, _ = lax.scan(body, init, xs)
-    return st
+        st, _ = lax.scan(body, init, xs)
+        return st
+
+    # Prologue: stage A for chunk 0 (fills the one-chunk logp buffer).
+    x0 = xs[0][0] - shift[None, :]
+    w0 = xs[1][0]
+    rest = (xs[0][1:], xs[1][1:])
+
+    def body(carry, chunk):
+        st, logp_prev, x_prev, w_prev = carry
+        xc_raw, wc = chunk
+        xc = xc_raw - shift[None, :]
+        logp_c = logp_fn(xc)                        # stage A, chunk i
+        st = consume_fn(st, logp_prev, x_prev, w_prev)   # stage B, i-1
+        return (st, logp_c, xc, wc), None
+
+    (st, logp_last, x_last, w_last), _ = lax.scan(
+        body, (init, logp_fn(x0), x0, w0), rest)
+    # Epilogue: stage B for the final in-flight chunk.
+    return consume_fn(st, logp_last, x_last, w_last)
+
+
+def _scan_estats(points, weights, means_blk, inv_var_blk, log_det_blk,
+                 log_w_blk, shift, *, chunk_size: int, model_shards: int,
+                 pipeline: int = 1, exp_dtype=None):
+    """Shard-local chunked E pass -> local-block EStats (pre-psum).
+    ``shift`` centers each chunk in registers; ``means_blk`` must already
+    be in the centered frame.  ``pipeline`` selects the chunk schedule
+    (see ``_chunked_epass``); ``exp_dtype`` the responsibility-exp
+    precision rung (see ``_softmax_resp``)."""
+    k_local, d = means_blk.shape
+    acc = points.dtype
+    # The stage pair (and the HIGH moment-precision rationale) lives in
+    # _diag_stage_fns, shared with the _estep_tile oracle.
+    logp_fn, consume = _diag_stage_fns(means_blk, inv_var_blk,
+                                       log_det_blk, log_w_blk,
+                                       model_shards, acc,
+                                       exp_dtype=exp_dtype)
+    return _chunked_epass(points, weights, shift, chunk_size=chunk_size,
+                          pipeline=pipeline, logp_fn=logp_fn,
+                          consume_fn=consume,
+                          init=_zero_estats(k_local, d, acc), acc=acc)
 
 
 def _embed_psum(st: EStats, k_pad: int, k_local: int, model_shards: int):
@@ -184,20 +282,25 @@ def _embed_psum(st: EStats, k_pad: int, k_local: int, model_shards: int):
     return EStats(resp, xsum, x2sum, ll)
 
 
-def make_gmm_step_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
+def make_gmm_step_fn(mesh: Mesh, *, chunk_size: int, pipeline: int = 1,
+                     exp_dtype=None) -> Callable:
     """Build the jitted SPMD E-step:
     (points, weights, shift, means, inv_var, log_det, log_weights) ->
     EStats over the FULL (k_pad) component table, replicated.  Parameter
     tables arrive row-sharded on the ``model`` axis (replicated when that
     axis is 1); ``means`` must be pre-centered by ``shift`` and the
-    returned ``xsum``/``x2sum`` are in the centered frame."""
+    returned ``xsum``/``x2sum`` are in the centered frame.
+    ``pipeline``/``exp_dtype`` select the chunk schedule and the
+    responsibility-exp precision rung (``_chunked_epass`` /
+    ``_softmax_resp``)."""
     data_shards, model_shards = mesh_shape(mesh)
 
     def step(points, weights, shift, means, inv_var, log_det, log_weights):
         k_local = means.shape[0]
         st = _scan_estats(points, weights, means, inv_var, log_det,
                           log_weights, shift, chunk_size=chunk_size,
-                          model_shards=model_shards)
+                          model_shards=model_shards, pipeline=pipeline,
+                          exp_dtype=exp_dtype)
         return _embed_psum(st, k_local * model_shards, k_local,
                            model_shards)
 
@@ -290,13 +393,27 @@ def _log_prob_tied_chunk(x, means_t, prec_chol, log_det_half, log_weights):
             - 0.5 * (quad + d * _LOG2PI))
 
 
-def _softmax_resp(logp, w, model_shards: int):
+def _softmax_resp(logp, w, model_shards: int, exp_dtype=None):
     """Shared responsibility softmax with the cross-model-axis
-    normalizer reconstruction; returns (resp, lse)."""
+    normalizer reconstruction; returns (resp, lse).
+
+    ``exp_dtype`` is the responsibility-exp precision rung (ISSUE 3):
+    when set (bf16 is the candidate), the max-subtracted argument is
+    rounded to that dtype before ``exp`` and the result widened back —
+    post-subtraction the argument is <= 0 and the module's own analysis
+    says relative logp error ~2^-8 "barely moves a softmax", but per
+    repo discipline the rung is DEFAULT-OFF until the 25-sigma survival
+    probe (experiments/exp_gmm_exp_precision.py, decision rules
+    committed in the script) and a hardware timing gate adopt it; the
+    normalizer sum/divide stay in the accumulation dtype either way."""
     m = jnp.max(logp, axis=1)
     if model_shards > 1:
         m = lax.pmax(m, MODEL_AXIS)
-    p = jnp.exp(logp - m[:, None])
+    z = logp - m[:, None]
+    if exp_dtype is not None:
+        p = jnp.exp(z.astype(exp_dtype)).astype(logp.dtype)
+    else:
+        p = jnp.exp(z)
     denom = jnp.sum(p, axis=1)
     if model_shards > 1:
         denom = lax.psum(denom, MODEL_AXIS)
@@ -306,15 +423,14 @@ def _softmax_resp(logp, w, model_shards: int):
 
 def _scan_estats_full(points, weights, means, prec_chol, log_det_half,
                       log_w, shift, *, chunk_size: int,
-                      model_shards: int) -> EStatsFull:
+                      model_shards: int, pipeline: int = 1,
+                      exp_dtype=None) -> EStatsFull:
     """Shard-local chunked FULL-covariance E pass -> local-block
     EStatsFull (pre-psum).  Shared by the per-dispatch step builder and
-    the on-device fit loop."""
+    the on-device fit loop.  ``pipeline``/``exp_dtype`` as in
+    ``_scan_estats``."""
     k_local, d = means.shape
     acc = points.dtype
-    n_chunks = points.shape[0] // chunk_size
-    xs = (points.reshape(n_chunks, chunk_size, d),
-          weights.astype(acc).reshape(n_chunks, chunk_size))
     # HIGH, not HIGHEST, for the xsum/scatter moments: the r5 FULL-
     # covariance precision ladder (experiments/exp_gmm_full_precision.py,
     # real v5e) measured HIGH at HIGHEST-equivalent error on the 25-sigma
@@ -325,31 +441,30 @@ def _scan_estats_full(points, weights, means, prec_chol, log_det_half,
     # diag ladder, where it showed real degradation.
     hi = lax.Precision.HIGH
 
-    def body(carry, chunk):
-        xc_raw, wc = chunk
-        xc = xc_raw - shift[None, :]
-        logp = _log_prob_full_chunk(xc, means, prec_chol, log_det_half,
+    def logp_fn(xc):
+        return _log_prob_full_chunk(xc, means, prec_chol, log_det_half,
                                     log_w)
-        resp, lse = _softmax_resp(logp, wc, model_shards)
-        st = EStatsFull(
-            resp_sum=jnp.sum(resp, axis=0),
-            xsum=lax.dot_general(resp, xc, (((0,), (0,)), ((), ())),
-                                 preferred_element_type=acc,
-                                 precision=hi),
-            scatter=jnp.einsum("ck,cd,ce->kde", resp, xc, xc,
-                               preferred_element_type=acc, precision=hi),
-            loglik=jnp.sum(jnp.where(wc > 0, lse * wc, 0.0)))
-        return EStatsFull(carry.resp_sum + st.resp_sum,
-                          carry.xsum + st.xsum,
-                          carry.scatter + st.scatter,
-                          carry.loglik + st.loglik), None
+
+    def consume(carry, logp, xc, wc):
+        resp, lse = _softmax_resp(logp, wc, model_shards,
+                                  exp_dtype=exp_dtype)
+        return EStatsFull(
+            carry.resp_sum + jnp.sum(resp, axis=0),
+            carry.xsum + lax.dot_general(
+                resp, xc, (((0,), (0,)), ((), ())),
+                preferred_element_type=acc, precision=hi),
+            carry.scatter + jnp.einsum(
+                "ck,cd,ce->kde", resp, xc, xc,
+                preferred_element_type=acc, precision=hi),
+            carry.loglik + jnp.sum(jnp.where(wc > 0, lse * wc, 0.0)))
 
     init = EStatsFull(jnp.zeros((k_local,), acc),
                       jnp.zeros((k_local, d), acc),
                       jnp.zeros((k_local, d, d), acc),
                       jnp.zeros((), acc))
-    st, _ = lax.scan(body, init, xs)
-    return st
+    return _chunked_epass(points, weights, shift, chunk_size=chunk_size,
+                          pipeline=pipeline, logp_fn=logp_fn,
+                          consume_fn=consume, init=init, acc=acc)
 
 
 def _embed_psum_full(st: EStatsFull, k_pad: int, k_local: int,
@@ -388,14 +503,16 @@ def _prec_chol_dev(cov, tiny):
     return p_chol, ldh
 
 
-def make_gmm_step_full_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
+def make_gmm_step_full_fn(mesh: Mesh, *, chunk_size: int,
+                          pipeline: int = 1, exp_dtype=None) -> Callable:
     """Full-covariance SPMD E-step: (points, weights, shift, means_c,
     prec_chol (k, D, D), log_det_half (k,), log_weights) -> EStatsFull
     replicated.  Parameter tables row-shard on the ``model`` axis
     (components); the xsum/scatter moments accumulate at HIGH matmul
     precision — raised above the bf16 default for the same cancellation
     reason as the diag moments, relaxed from r3's HIGHEST by the r5
-    full-covariance precision ladder (see _scan_estats_full)."""
+    full-covariance precision ladder (see _scan_estats_full).
+    ``pipeline``/``exp_dtype`` as in ``make_gmm_step_fn``."""
     data_shards, model_shards = mesh_shape(mesh)
 
     def step(points, weights, shift, means, prec_chol, log_det_half,
@@ -404,7 +521,8 @@ def make_gmm_step_full_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
         st = _scan_estats_full(points, weights, means, prec_chol,
                                log_det_half, log_weights, shift,
                                chunk_size=chunk_size,
-                               model_shards=model_shards)
+                               model_shards=model_shards,
+                               pipeline=pipeline, exp_dtype=exp_dtype)
         return _embed_psum_full(st, k_local * model_shards, k_local,
                                 model_shards)
 
@@ -421,49 +539,49 @@ def make_gmm_step_full_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
 
 def _scan_estats_tied(points, weights, means_t, prec_chol, log_det_half,
                       log_w, shift, *, chunk_size: int,
-                      model_shards: int) -> EStats:
+                      model_shards: int, pipeline: int = 1,
+                      exp_dtype=None) -> EStats:
     """Shard-local chunked TIED-covariance E pass -> local-block EStats
     with ``x2sum`` elided (the tied M-step derives its covariance from
     the loop-invariant total scatter + means).  Shared by the
-    per-dispatch step builder and the on-device fit loop."""
+    per-dispatch step builder and the on-device fit loop.
+    ``pipeline``/``exp_dtype`` as in ``_scan_estats``."""
     k_local, d = means_t.shape
     acc = points.dtype
-    n_chunks = points.shape[0] // chunk_size
-    xs = (points.reshape(n_chunks, chunk_size, d),
-          weights.astype(acc).reshape(n_chunks, chunk_size))
     hi = lax.Precision.HIGHEST
 
-    def body(carry, chunk):
-        xc_raw, wc = chunk
-        xc = xc_raw - shift[None, :]
-        logp = _log_prob_tied_chunk(xc, means_t, prec_chol,
+    def logp_fn(xc):
+        return _log_prob_tied_chunk(xc, means_t, prec_chol,
                                     log_det_half, log_w)
-        resp, lse = _softmax_resp(logp, wc, model_shards)
-        st = EStats(
-            resp_sum=jnp.sum(resp, axis=0),
-            xsum=lax.dot_general(resp, xc, (((0,), (0,)), ((), ())),
-                                 preferred_element_type=acc,
-                                 precision=hi),
-            x2sum=carry.x2sum,          # elided — not accumulated
-            loglik=jnp.sum(jnp.where(wc > 0, lse * wc, 0.0)))
-        return EStats(carry.resp_sum + st.resp_sum,
-                      carry.xsum + st.xsum, carry.x2sum,
-                      carry.loglik + st.loglik), None
+
+    def consume(carry, logp, xc, wc):
+        resp, lse = _softmax_resp(logp, wc, model_shards,
+                                  exp_dtype=exp_dtype)
+        return EStats(
+            carry.resp_sum + jnp.sum(resp, axis=0),
+            carry.xsum + lax.dot_general(
+                resp, xc, (((0,), (0,)), ((), ())),
+                preferred_element_type=acc, precision=hi),
+            carry.x2sum,                # elided — not accumulated
+            carry.loglik + jnp.sum(jnp.where(wc > 0, lse * wc, 0.0)))
 
     init = EStats(jnp.zeros((k_local,), acc),
                   jnp.zeros((k_local, d), acc),
                   jnp.zeros((k_local, d), acc), jnp.zeros((), acc))
-    st, _ = lax.scan(body, init, xs)
-    return st
+    return _chunked_epass(points, weights, shift, chunk_size=chunk_size,
+                          pipeline=pipeline, logp_fn=logp_fn,
+                          consume_fn=consume, init=init, acc=acc)
 
 
-def make_gmm_step_tied_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
+def make_gmm_step_tied_fn(mesh: Mesh, *, chunk_size: int,
+                          pipeline: int = 1, exp_dtype=None) -> Callable:
     """Tied-covariance SPMD E-step: (points, weights, shift, means_t
     (pre-transformed mu_c @ P), prec_chol (D, D) replicated,
     log_det_half (), log_weights) -> EStats replicated with ``x2sum``
     zero (the tied M-step derives the covariance from the loop-invariant
     total scatter + means, so no per-component second moment is
-    accumulated)."""
+    accumulated).  ``pipeline``/``exp_dtype`` as in
+    ``make_gmm_step_fn``."""
     data_shards, model_shards = mesh_shape(mesh)
 
     def step(points, weights, shift, means_t, prec_chol, log_det_half,
@@ -472,7 +590,8 @@ def make_gmm_step_tied_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
         st = _scan_estats_tied(points, weights, means_t, prec_chol,
                                log_det_half, log_weights, shift,
                                chunk_size=chunk_size,
-                               model_shards=model_shards)
+                               model_shards=model_shards,
+                               pipeline=pipeline, exp_dtype=exp_dtype)
         return _embed_psum(st, k_local * model_shards, k_local,
                            model_shards)
 
@@ -538,7 +657,7 @@ def _predict_from_logp(logp_fn, points, chunk_size, k_local, d,
 
 def make_gmm_multi_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
                           max_iter: int, tol: float, reg_covar: float,
-                          cov_type: str = "diag"):
+                          cov_type: str = "diag", pipeline: int = 1):
     """BATCHED on-device EM: ``n_init`` restarts in ONE dispatch, vmapped
     over the restart axis — the mixture analogue of
     ``distributed.make_multi_fit_fn`` (r4).  Works for the
@@ -576,7 +695,8 @@ def make_gmm_multi_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
                 points, weights, shift, means_c, var, log_w,
                 m_idx=m_idx, k_local=k_local, k_pad=k_pad,
                 chunk_size=chunk_size, model_shards=model_shards,
-                reg_covar=reg_covar, tiny=tiny, acc=acc)
+                reg_covar=reg_covar, tiny=tiny, acc=acc,
+                pipeline=pipeline)
 
         def body(state):
             it, means_c, var, log_w, prev, hist, done, n_it, conv = state
@@ -634,7 +754,8 @@ def make_gmm_multi_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
 
 
 def make_gmm_fit_full_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
-                         max_iter: int, tol: float, reg_covar: float):
+                         max_iter: int, tol: float, reg_covar: float,
+                         pipeline: int = 1):
     """FULL-covariance on-device EM loop: all iterations in ONE dispatch
     (the 'full' analogue of ``make_gmm_fit_fn``, r4 — the r4 host path
     initially shipped full/tied host-loop-only).
@@ -678,7 +799,7 @@ def make_gmm_fit_full_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
                 points, weights, blk(means_c).astype(acc),
                 blk(p_chol).astype(acc), blk(ldh).astype(acc),
                 blk(log_w).astype(acc), shift, chunk_size=chunk_size,
-                model_shards=model_shards)
+                model_shards=model_shards, pipeline=pipeline)
             return _embed_psum_full(st, k_pad, k_local, model_shards)
 
         def body(state):
@@ -729,7 +850,8 @@ def make_gmm_fit_full_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
 
 
 def make_gmm_fit_tied_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
-                         max_iter: int, tol: float, reg_covar: float):
+                         max_iter: int, tol: float, reg_covar: float,
+                         pipeline: int = 1):
     """TIED-covariance on-device EM loop: the total scatter is computed
     ONCE inside the dispatch (loop-invariant), each iteration factors
     the single shared (D, D) covariance, transforms the means, runs the
@@ -769,7 +891,7 @@ def make_gmm_fit_tied_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
                 points, weights, blk(means_t).astype(acc),
                 p_chol.astype(acc), ldh.astype(acc),
                 blk(log_w).astype(acc), shift, chunk_size=chunk_size,
-                model_shards=model_shards)
+                model_shards=model_shards, pipeline=pipeline)
             return _embed_psum(st, k_pad, k_local, model_shards)
 
         def body(state):
@@ -866,7 +988,7 @@ def make_gmm_predict_tied_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
 
 def _diag_estats_block(points, weights, shift, means_c, var, log_w, *,
                        m_idx, k_local, k_pad, chunk_size, model_shards,
-                       reg_covar, tiny, acc):
+                       reg_covar, tiny, acc, pipeline: int = 1):
     """ONE restart's diag/spherical E statistics inside a device loop:
     floor the covariance at max(reg_covar, tiny), slice this shard's
     model block, run the chunked scan, psum-embed.  Shared by the
@@ -882,7 +1004,8 @@ def _diag_estats_block(points, weights, shift, means_c, var, log_w, *,
     st = _scan_estats(points, weights, blk(means_c).astype(acc),
                       blk(inv_var).astype(acc), blk(log_det).astype(acc),
                       blk(log_w).astype(acc), shift,
-                      chunk_size=chunk_size, model_shards=model_shards)
+                      chunk_size=chunk_size, model_shards=model_shards,
+                      pipeline=pipeline)
     return _embed_psum(st, k_pad, k_local, model_shards)
 
 
@@ -909,7 +1032,7 @@ def _diag_m_step(st, *, w_total, reg_covar, tiny, pi_floor, real,
 
 def make_gmm_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
                     max_iter: int, tol: float, reg_covar: float,
-                    cov_type: str = "diag"):
+                    cov_type: str = "diag", pipeline: int = 1):
     """Build the FULLY ON-DEVICE EM loop: all iterations in ONE dispatch
     under ``lax.while_loop`` — the mixture analogue of
     ``distributed.make_fit_fn`` (r2 VERDICT next-round #3).
@@ -950,7 +1073,8 @@ def make_gmm_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
                 points, weights, shift, means_c, var, log_w,
                 m_idx=m_idx, k_local=k_local, k_pad=k_pad,
                 chunk_size=chunk_size, model_shards=model_shards,
-                reg_covar=reg_covar, tiny=tiny, acc=acc)
+                reg_covar=reg_covar, tiny=tiny, acc=acc,
+                pipeline=pipeline)
 
         def body(state):
             it, means_c, var, log_w, prev, hist, _ = state

@@ -88,6 +88,66 @@ def log_dispatches():
         _DISPATCH_LOG = prev
 
 
+# --------------------------------------------------- phase decomposition
+# Shared harness for splitting a fused device loop's per-iteration cost
+# into phases (ISSUE 3 / VERDICT weak #8: "decompose by measurement, not
+# assertion").  A fused program cannot be timed phase-by-phase from the
+# host — XLA fuses and overlaps everything — so the decomposition runs a
+# LADDER of cumulative-prefix programs (phase 1 only; phases 1-2; the
+# full body ...), measures each rung with the same measurement callable,
+# and attributes each phase the per-rep DIFFERENCE between its rung and
+# the previous one.  Reps interleave across rungs so a host-drift window
+# moves every rung together (the BASELINE.md cross-variant rule), and
+# differences are taken per rep before the median.
+
+
+def measure_phase_ladder(rungs, *, reps: int = 5):
+    """Measure a cumulative-phase ladder; returns per-phase costs.
+
+    ``rungs`` is an ordered list of ``(label, measure)`` pairs where
+    ``measure()`` returns the cost (seconds) of the program running all
+    phases up to and including ``label`` — typically a marginal
+    per-iteration measurement so dispatch latency is already cancelled.
+    The first rung's phase cost is its own measurement; each later
+    phase's cost is the per-rep difference to the previous rung,
+    clamped at 0 in ``seconds`` (a negative difference is measurement
+    noise).  ``spread`` is computed from the UNCLAMPED per-rep
+    differences so the clamp can never hide the noise it absorbs: a
+    rung whose differences are all-noise reports ``seconds`` 0 (or
+    near it) with ``spread`` inf — never a fake zero-cost,
+    zero-variance phase.
+
+    Returns a list of dicts: ``{"phase", "seconds", "cumulative",
+    "spread"}`` with ``spread`` the (max-min)/median rule of the
+    repo's publication bar (inf when the median is non-positive but
+    the reps vary; 0 only when the reps are identically zero).
+    """
+    import numpy as np
+
+    labels = [label for label, _ in rungs]
+    samples = {label: [] for label in labels}
+    for _ in range(reps):
+        for label, measure in rungs:
+            samples[label].append(float(measure()))
+    out = []
+    prev = None
+    for label in labels:
+        cur = np.asarray(samples[label])
+        raw = cur if prev is None else cur - prev
+        med_raw = float(np.median(raw))
+        span = float(raw.max() - raw.min())
+        if med_raw > 0:
+            spread = span / med_raw
+        else:
+            spread = float("inf") if span > 0 else 0.0
+        out.append({"phase": label,
+                    "seconds": max(med_raw, 0.0),
+                    "cumulative": float(np.median(cur)),
+                    "spread": spread})
+        prev = cur
+    return out
+
+
 def timed_call(fn, *args, warmup: int = 1, iters: int = 3):
     """(mean_seconds, last_result) of fn(*args), excluding warmup runs."""
     result = None

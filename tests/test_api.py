@@ -5,6 +5,8 @@
 import numpy as np
 import pytest
 
+from conftest import old_jax_rng_skip
+
 from kmeans_tpu import KMeans
 from kmeans_tpu.models import MiniBatchKMeans, kmeanspp_init
 
@@ -133,6 +135,13 @@ def test_non_2d_input_raises(mesh8):
 
 # --- minibatch --------------------------------------------------------------
 
+# atol=0.3 near-convergence was tuned for the batch sequence the
+# >= 0.5 jax stream samples; jax < 0.5 samples different batches and
+# lands ~0.6 off on one coordinate of this 3-blob basin (engine
+# correctness is covered stream-independently by
+# test_minibatch_device.py's host/device parity).  BASELINE.md
+# "Tier-1 environment gates".
+@old_jax_rng_skip
 def test_minibatch_converges_near_fullbatch(mesh8):
     from sklearn.datasets import make_blobs
     X, _ = make_blobs(n_samples=4000, centers=3, n_features=2,

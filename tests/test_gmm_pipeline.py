@@ -1,0 +1,303 @@
+"""Pipelined GMM E-step (ISSUE 3): the software-pipelined chunk
+schedule (``pipeline=1``) against the serial four-phase oracle
+(``pipeline=0``) — the ``prefetch=0`` discipline of r6: the pipelined
+schedule moves WHERE work happens, never its arithmetic or fold order,
+so trajectories must match the oracle bit-for-bit (CPU exact dots;
+1e-6 is the documented bar on bf16-rate hardware dots)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kmeans_tpu.models import GaussianMixture
+from kmeans_tpu.parallel import gmm_step
+from kmeans_tpu.parallel.mesh import make_mesh
+
+
+def _blobs(n=1536, d=6, centers=3, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    comp = rng.integers(0, centers, n)
+    return (comp[:, None] * 5.0
+            + rng.normal(size=(n, d))).astype(dtype)
+
+
+def _fit_pair(ct, mesh, *, pipeline_on=1, host_loop=True, k=3,
+              model_shards=1, X=None, sample_weight=None, chunk=256,
+              max_iter=6):
+    """Fit the same model under both schedules; returns the two fits."""
+    out = []
+    for pipeline in (pipeline_on, 0):
+        g = GaussianMixture(n_components=k, covariance_type=ct,
+                            max_iter=max_iter, seed=0,
+                            init_params="random", host_loop=host_loop,
+                            mesh=mesh, model_shards=model_shards,
+                            chunk_size=chunk, pipeline=pipeline,
+                            verbose=False)
+        g.fit(_blobs() if X is None else X, sample_weight=sample_weight)
+        out.append(g)
+    return out
+
+
+def _assert_trajectory_equal(a, b):
+    assert a.n_iter_ == b.n_iter_
+    assert a.lower_bound_ == b.lower_bound_
+    np.testing.assert_array_equal(a.means_, b.means_)
+    np.testing.assert_array_equal(np.asarray(a.covariances_),
+                                  np.asarray(b.covariances_))
+    np.testing.assert_array_equal(a.weights_, b.weights_)
+
+
+@pytest.mark.parametrize("ct", ["diag", "spherical", "tied", "full"])
+@pytest.mark.parametrize("host_loop", [True, False])
+def test_pipeline_parity_all_covariance_types(ct, host_loop, mesh1):
+    g1, g0 = _fit_pair(ct, mesh1, host_loop=host_loop)
+    assert g1.estep_path_ == "pipelined" and g0.estep_path_ == "serial"
+    _assert_trajectory_equal(g1, g0)
+
+
+@pytest.mark.parametrize("data_shards", [1, 2, 4, 8])
+def test_pipeline_parity_data_meshes(data_shards):
+    """1/2/4/8-way data-parallel virtual meshes: pipelined == serial on
+    every mesh width (chunking per shard differs with the width, so the
+    schedules must agree at each)."""
+    n_dev = len(jax.devices())
+    if n_dev < data_shards:
+        pytest.skip(f"needs {data_shards} devices")
+    mesh = make_mesh(data=data_shards, model=1,
+                     devices=jax.devices()[:data_shards])
+    X = _blobs(n=2048)
+    g1, g0 = _fit_pair("diag", mesh, X=X, chunk=128)
+    _assert_trajectory_equal(g1, g0)
+
+
+@pytest.mark.parametrize("ct", ["diag", "tied", "full"])
+def test_pipeline_parity_model_sharded(ct, mesh4x2):
+    """Component (TP) sharding: the pipelined stage B carries the
+    per-chunk pmax/psum normalizer reconstruction — parity must hold
+    with the collectives inside the skewed schedule."""
+    g1, g0 = _fit_pair(ct, mesh4x2, model_shards=2, k=4, X=_blobs(n=2048))
+    _assert_trajectory_equal(g1, g0)
+
+
+def test_pipeline_parity_component_padding(mesh4x2):
+    """k=3 on a 2-way model axis -> k_pad=4 with a -inf log-weight
+    padding row riding the carried logp tile; it must stay inert in
+    both schedules."""
+    g1, g0 = _fit_pair("diag", mesh4x2, model_shards=2, k=3,
+                      X=_blobs(n=2048))
+    _assert_trajectory_equal(g1, g0)
+    assert np.isclose(g1.weights_.sum(), 1.0)
+
+
+def test_pipeline_parity_zero_weight_padding(mesh1):
+    """Zero-weight rows (the padding contract) contribute nothing under
+    either schedule — including as the FINAL chunk, which the pipelined
+    epilogue drains outside the scan."""
+    X = _blobs(n=1536)
+    w = np.ones(X.shape[0], np.float64)
+    w[-300:] = 0.0                      # zero tail crosses chunk edges
+    g1, g0 = _fit_pair("diag", mesh1, X=X, sample_weight=w)
+    _assert_trajectory_equal(g1, g0)
+    # And the zero rows really were inert: same fit as physically
+    # dropping them (fp-order differs across chunk boundaries -> 1e-6).
+    g_drop = GaussianMixture(n_components=3, max_iter=6, seed=0,
+                             init_params="random", mesh=g0.mesh,
+                             chunk_size=256, pipeline=0, verbose=False)
+    g_drop.fit(X[:-300])
+    np.testing.assert_allclose(g0.means_, g_drop.means_, atol=1e-6)
+
+
+def test_pipeline_parity_multi_restart_device(mesh1):
+    """The batched n_init device sweep threads pipeline through the
+    vmapped loop."""
+    X = _blobs(n=1024)
+    fits = []
+    for pipeline in (1, 0):
+        g = GaussianMixture(n_components=3, max_iter=5, seed=0, n_init=3,
+                            init_params="random", host_loop=False,
+                            mesh=mesh1, chunk_size=256,
+                            pipeline=pipeline, verbose=False).fit(X)
+        fits.append(g)
+    g1, g0 = fits
+    assert g1.best_restart_ == g0.best_restart_
+    np.testing.assert_array_equal(g1.restart_lower_bounds_,
+                                  g0.restart_lower_bounds_)
+    _assert_trajectory_equal(g1, g0)
+
+
+def test_pipeline_parity_fit_stream(mesh1):
+    X = _blobs(n=1200)
+
+    def blocks():
+        for i in range(0, X.shape[0], 400):
+            yield X[i:i + 400]
+
+    fits = []
+    for pipeline in (1, 0):
+        g = GaussianMixture(n_components=3, max_iter=4, seed=0,
+                            init_params="random", mesh=mesh1,
+                            chunk_size=200, pipeline=pipeline,
+                            verbose=False)
+        g.fit_stream(blocks, d=X.shape[1], prefetch=0)
+        fits.append(g)
+    _assert_trajectory_equal(*fits)
+    assert fits[0].estep_path_ == "pipelined"
+
+
+def test_step_level_bit_parity(mesh1):
+    """Scan-level: the two schedules' EStats are bit-identical per
+    dispatch (not merely trajectory-close)."""
+    rng = np.random.default_rng(1)
+    n, d, k, chunk = 2048, 8, 4, 256
+    x = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)
+    w = jnp.asarray(rng.uniform(0, 2, size=(n,)), jnp.float32)
+    shift = jnp.asarray(rng.normal(size=(d,)), jnp.float32)
+    means = jnp.asarray(rng.normal(size=(k, d)), jnp.float32)
+    inv_var = jnp.asarray(rng.uniform(0.5, 2, size=(k, d)), jnp.float32)
+    log_det = -jnp.sum(jnp.log(inv_var), axis=1)
+    log_w = jnp.full((k,), -np.log(k), jnp.float32)
+    args = (x, w, shift, means, inv_var, log_det, log_w)
+    s0 = gmm_step.make_gmm_step_fn(mesh1, chunk_size=chunk,
+                                   pipeline=0)(*args)
+    s1 = gmm_step.make_gmm_step_fn(mesh1, chunk_size=chunk,
+                                   pipeline=1)(*args)
+    for name in s0._fields:
+        np.testing.assert_array_equal(np.asarray(getattr(s0, name)),
+                                      np.asarray(getattr(s1, name)),
+                                      err_msg=name)
+
+
+def test_single_chunk_pipeline(mesh1):
+    """One chunk = prologue + empty scan + epilogue; must equal serial."""
+    g1, g0 = _fit_pair("diag", mesh1, X=_blobs(n=512), chunk=512,
+                      max_iter=4)
+    _assert_trajectory_equal(g1, g0)
+
+
+def test_exp_dtype_rung_runs_and_stays_off_by_default(mesh1):
+    """The bf16 responsibility-exp rung is buildable and close to the
+    f32 softmax (the 25-sigma decision probe lives in
+    experiments/exp_gmm_exp_precision.py); the DEFAULT step builder
+    keeps f32 exp (bit-equal to an explicit exp_dtype=None build)."""
+    rng = np.random.default_rng(2)
+    n, d, k, chunk = 1024, 8, 4, 256
+    x = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)
+    w = jnp.ones((n,), jnp.float32)
+    shift = jnp.zeros((d,), jnp.float32)
+    means = jnp.asarray(rng.normal(size=(k, d)), jnp.float32)
+    inv_var = jnp.ones((k, d), jnp.float32)
+    log_det = jnp.zeros((k,), jnp.float32)
+    log_w = jnp.full((k,), -np.log(k), jnp.float32)
+    args = (x, w, shift, means, inv_var, log_det, log_w)
+    default = gmm_step.make_gmm_step_fn(mesh1, chunk_size=chunk)(*args)
+    explicit = gmm_step.make_gmm_step_fn(mesh1, chunk_size=chunk,
+                                         exp_dtype=None)(*args)
+    np.testing.assert_array_equal(np.asarray(default.resp_sum),
+                                  np.asarray(explicit.resp_sum))
+    bf16 = gmm_step.make_gmm_step_fn(mesh1, chunk_size=chunk,
+                                     exp_dtype=jnp.bfloat16)(*args)
+    np.testing.assert_allclose(np.asarray(bf16.resp_sum),
+                               np.asarray(default.resp_sum), rtol=2e-2)
+    assert np.isfinite(float(bf16.loglik))
+
+
+def test_pipeline_knob_validation_and_params():
+    with pytest.raises(ValueError, match="pipeline"):
+        GaussianMixture(n_components=2, pipeline=2)
+    with pytest.raises(ValueError, match="pipeline"):
+        GaussianMixture(n_components=2, pipeline="yes")
+    g = GaussianMixture(n_components=2)
+    assert g.pipeline == "auto"
+    assert g.get_params()["pipeline"] == "auto"
+    g.set_params(pipeline=0)
+    assert g.pipeline == 0
+    # 'auto' resolves by platform: serial on CPU (the measured 0.80x
+    # regression), pipelined on accelerators.
+    g.set_params(pipeline="auto")
+    expected = 0 if jax.default_backend() == "cpu" else 1
+    assert g._resolve_pipeline() == expected
+
+
+def test_estep_path_attr(mesh1):
+    X = _blobs(n=512)
+    g = GaussianMixture(n_components=2, max_iter=2, seed=0,
+                        init_params="random", mesh=mesh1, chunk_size=256,
+                        pipeline=1, verbose=False).fit(X)
+    assert g.estep_path_ == "pipelined"
+    g = GaussianMixture(n_components=2, max_iter=2, seed=0,
+                        init_params="random", mesh=mesh1, chunk_size=256,
+                        pipeline=0, verbose=False).fit(X)
+    assert g.estep_path_ == "serial"
+
+
+def test_pipeline_save_load_roundtrip(tmp_path, mesh1):
+    X = _blobs(n=512)
+    g = GaussianMixture(n_components=2, max_iter=3, seed=0,
+                        init_params="random", mesh=mesh1, chunk_size=256,
+                        pipeline=0, verbose=False).fit(X)
+    p = tmp_path / "gmm.npz"
+    g.save(p)
+    loaded = GaussianMixture.load(p)
+    assert loaded.pipeline == 0
+    np.testing.assert_array_equal(loaded.means_, g.means_)
+    g_auto = GaussianMixture(n_components=2, max_iter=1, seed=0,
+                             init_params="random", mesh=mesh1,
+                             chunk_size=256, verbose=False).fit(X)
+    g_auto.save(p)
+    assert GaussianMixture.load(p).pipeline == "auto"
+
+
+# ------------------------------------------------ phase-decomposition hooks
+
+def test_measure_phase_ladder_math():
+    """The ladder attributes per-rep differences, medians them, and
+    clamps noise-negative phases at zero."""
+    from kmeans_tpu.utils.profiling import measure_phase_ladder
+    feed = {"a": iter([1.0, 1.2, 1.1]), "b": iter([3.0, 3.2, 3.1]),
+            "c": iter([3.0, 3.1, 3.0])}      # c-b is negative -> clamp
+    rungs = [(name, lambda name=name: next(feed[name]))
+             for name in ("a", "b", "c")]
+    out = measure_phase_ladder(rungs, reps=3)
+    assert [r["phase"] for r in out] == ["a", "b", "c"]
+    assert out[0]["seconds"] == pytest.approx(1.1)
+    assert out[1]["seconds"] == pytest.approx(2.0)
+    assert out[2]["seconds"] == 0.0          # clamped
+    assert out[1]["cumulative"] == pytest.approx(3.1)
+
+
+def test_estep_phase_fn_ladder(mesh4x2):
+    """The phase-prefix programs compile and run on a (data, model)
+    mesh and return finite scalars for every rung (timing itself is a
+    hardware question — experiments/exp_headline_decomposition.py)."""
+    from kmeans_tpu.parallel import distributed as dist
+    from kmeans_tpu.parallel.sharding import shard_points
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1024, 8)).astype(np.float32)
+    pts, w = shard_points(X, mesh4x2, 128)
+    cents = jax.device_put(
+        dist.pad_centroids(X[:6].copy(), 2),
+        dist.centroid_sharding(mesh4x2))
+    for phase in dist.ESTEP_PHASES:
+        fn = dist.make_estep_phase_fn(mesh4x2, chunk_size=128, n_iters=3,
+                                      phase=phase)
+        assert np.isfinite(float(fn(pts, w, cents))), phase
+    with pytest.raises(ValueError, match="phase"):
+        dist.make_estep_phase_fn(mesh4x2, chunk_size=128, n_iters=1,
+                                 phase="softmax")
+    with pytest.raises(ValueError, match="Pallas"):
+        dist.make_estep_phase_fn(mesh4x2, chunk_size=128, n_iters=1,
+                                 phase="distance", mode="pallas")
+
+
+def test_gmm_flops_and_mfu_helpers():
+    from kmeans_tpu.benchmarks import gmm_flops_per_iter, step_mfu
+    assert gmm_flops_per_iter(1000, 8, 4, "diag") == 8.0 * 1000 * 8 * 4
+    full = gmm_flops_per_iter(1000, 8, 4, "full")
+    assert full == 4.0 * 1000 * 4 * 64 + 4.0 * 1000 * 8 * 4
+    with pytest.raises(ValueError):
+        gmm_flops_per_iter(10, 2, 2, "banana")
+    # No pinned peak for the CPU backend -> None (flops still derivable).
+    if jax.default_backend() == "cpu":
+        assert step_mfu(1e9, 1e-3) is None
