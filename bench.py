@@ -26,6 +26,12 @@ logged as a drift check (other shapes use the live probe directly).
 
 Env overrides: BENCH_N, BENCH_D, BENCH_K, BENCH_ITERS, BENCH_MODE.
 
+BENCH_CKPT=1 switches to the SEGMENTED-CHECKPOINT benchmark (ISSUE 4):
+checkpoint_every=N device-loop fit vs the single-dispatch oracle at the
+same shape (extra dispatches + boundary round trips + rotating .npz
+writes), interleaved per-rep ratios.  Env: BENCH_N/D/K/ITERS,
+BENCH_CKPT_EVERY (default 8).
+
 BENCH_INIT=1 switches to the SEEDING-COST benchmark (ISSUE 2): warm
 k-means|| init at BENCH_N/D/K (accelerator default 2M x 128 k=1024 —
 the shape whose legacy init measured 7.4 s warm vs a 0.77 s training
@@ -189,6 +195,22 @@ def main() -> None:
         log(f"bench: GMM-PIPELINE mode backend={backend} N={gn} D={gd} "
             f"k={gk} iters_gap={gi} cov={gct}")
         bench_gmm_pipeline(gn, gd, gk, gi, cov_type=gct)
+        return
+
+    if os.environ.get("BENCH_CKPT"):
+        # Segmented-dispatch cost (ISSUE 4): checkpoint_every=N device
+        # loop vs the single-dispatch oracle, interleaved per-rep
+        # ratios.  Default N matches the docs/PERFORMANCE.md pinned row.
+        from kmeans_tpu.benchmarks import bench_checkpoint_segments
+        cn = int(os.environ.get("BENCH_N",
+                                2_000_000 if on_accel else 200_000))
+        cd = int(os.environ.get("BENCH_D", 128 if on_accel else 32))
+        ck = int(os.environ.get("BENCH_K", 1024 if on_accel else 64))
+        ci = int(os.environ.get("BENCH_ITERS", 32))
+        ce = int(os.environ.get("BENCH_CKPT_EVERY", 8))
+        log(f"bench: CKPT mode backend={backend} N={cn} D={cd} k={ck} "
+            f"iters={ci} every={ce}")
+        bench_checkpoint_segments(cn, cd, ck, ci, ce)
         return
 
     if os.environ.get("BENCH_STREAM"):

@@ -778,6 +778,87 @@ def bench_stream(n: int, d: int, k: int, block_rows: int, epochs: int,
     return result
 
 
+def bench_checkpoint_segments(n: int, d: int, k: int, iters: int,
+                              every: int, reps: int = 5) -> Dict:
+    """Segmented-dispatch cost (ISSUE 4): a ``checkpoint_every=N``
+    device-loop fit vs the single-dispatch oracle at the same shape.
+
+    The segmented fit pays ``ceil(iters/N) - 1`` extra dispatches plus
+    per-boundary host round trips (centroid pull + re-put) and one
+    rotating atomic ``.npz`` write per segment.  Method: the repo's
+    interleaved per-rep protocol — each rep times one (oracle,
+    segmented) FULL-fit pair back-to-back (fixed explicit init,
+    tolerance~0, 'keep' policy, so both run exactly ``iters``
+    iterations; both programs compiled and warmed first), and the
+    published overhead is the median of the per-rep ratios so shared-
+    host drift cancels.  Checkpoints go to a fresh temp dir (local
+    disk; a network filesystem adds its own write latency on top).
+    """
+    import os
+    import tempfile
+
+    import jax
+    from kmeans_tpu.models.kmeans import KMeans
+
+    rng = np.random.default_rng(42)
+    X = rng.uniform(-1.0, 1.0, size=(n, d)).astype(np.float32)
+    init = X[np.sort(rng.choice(n, size=k, replace=False))].copy()
+
+    def run(ck_every, path) -> "KMeans":
+        km = KMeans(k=k, max_iter=iters, tolerance=1e-30, seed=0,
+                    init=init, empty_cluster="keep", compute_sse=False,
+                    host_loop=False, verbose=False)
+        kwargs = ({"checkpoint_every": ck_every, "checkpoint_path": path}
+                  if ck_every else {})
+        km.fit(X, **kwargs)
+        assert km.iterations_run == iters
+        return km
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "bench_ckpt.npz")
+        run(0, None)                               # compile oracle
+        run(every, path)                           # compile all segments
+        o_s, s_s = [], []
+        for rep in range(reps + 1):
+            t0 = time.perf_counter()
+            run(0, None)
+            o = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            seg_km = run(every, path)
+            s = time.perf_counter() - t0
+            if rep == 0:
+                continue                           # burn-in pair
+            o_s.append(o)
+            s_s.append(s)
+            _log(f"[ckpt] rep {rep}/{reps}: oracle {o / iters * 1e3:.2f} "
+                 f"ms/iter, every={every} {s / iters * 1e3:.2f} ms/iter, "
+                 f"overhead {(s / o - 1) * 100:.1f}%")
+    ratios = sorted(s / o for s, o in zip(s_s, o_s))
+    overhead = float(np.median(ratios))
+    ratio_spread = (max(ratios) - min(ratios)) / overhead
+    segments = -(-iters // every)
+    result = {
+        "indicative_only": bool(ratio_spread > 0.05),
+        "metric": f"kmeans_ckpt_overhead_N{n}_D{d}_k{k}_every{every}",
+        "value": round(overhead, 4),
+        "unit": "x (segmented fit wall / single-dispatch oracle wall)",
+        "checkpoint_every": every,
+        "iters": iters,
+        "segments": segments,
+        "extra_dispatches": segments - 1,
+        "oracle_ms_per_iter": round(
+            float(np.median(o_s)) / iters * 1e3, 3),
+        "segmented_ms_per_iter": round(
+            float(np.median(s_s)) / iters * 1e3, 3),
+        "overhead_ratio_spread": round(ratio_spread, 3),
+        "checkpoint_segments_observed": seg_km.checkpoint_segments_,
+        "platform": jax.default_backend(),
+        "n_devices": len(jax.devices()),
+    }
+    print(json.dumps(result), flush=True)
+    return result
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="kmeans_tpu benchmarks")
     parser.add_argument("--configs", default=",".join(DEFAULT_CONFIGS))

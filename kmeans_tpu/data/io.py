@@ -19,7 +19,9 @@ empty-cluster resampling, :196) reads only the k sampled rows from disk.
 from __future__ import annotations
 
 import math
-from typing import Optional, Tuple
+import threading
+import time
+from typing import Callable, Iterable, Optional, Tuple
 
 import jax
 import numpy as np
@@ -28,7 +30,219 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from kmeans_tpu.parallel.mesh import DATA_AXIS, mesh_shape
 from kmeans_tpu.parallel.sharding import (ShardedDataset, choose_chunk_size,
                                           to_device)
-from kmeans_tpu.data.prefetch import check_prefetch, prefetch_iter
+from kmeans_tpu.data.prefetch import (check_prefetch, close_source,
+                                      prefetch_iter)
+
+
+# ------------------------------------------------------------ retrying IO
+#
+# Fault-tolerance layer (ISSUE 4): transient reader errors — flaky block
+# IO on the 7-10 MB/s tunnel, network-filesystem hiccups — must not kill
+# a long fit.  Retry policy: any ``OSError`` is considered transient
+# (``utils.faults.TransientIOError`` is the injected subclass the tests
+# raise); retries are BOUNDED and the backoff schedule is DETERMINISTIC
+# (``io_backoff * 2**(attempt-1)`` seconds, no wall-clock randomness), so
+# a retried fit's trajectory is bit-identical to an unretried one — the
+# retry only re-reads, never reorders or drops data.
+
+class IOStats:
+    """Per-fit IO fault counters (the ``io_retries_used_`` /
+    ``blocks_skipped_`` observability surface).  ``blocks_skipped`` is
+    the count of the most recent COMPLETE pass over the stream (stable
+    across epochs for a deterministic source — it equals the number of
+    bad blocks in the dataset); ``blocks_skipped_total`` accumulates
+    across passes."""
+
+    def __init__(self):
+        self.retries_used = 0
+        self.blocks_skipped = 0
+        self.blocks_skipped_total = 0
+
+
+def check_io_knobs(io_retries, io_backoff) -> Tuple[int, float]:
+    """Validate the retry knobs: retries an int >= 0, backoff a float
+    >= 0 seconds (0 = retry immediately — what deterministic tests
+    use)."""
+    r = int(io_retries)
+    if r < 0 or r != io_retries:
+        raise ValueError(f"io_retries must be an int >= 0, got "
+                         f"{io_retries!r}")
+    b = float(io_backoff)
+    if not (b >= 0.0):
+        raise ValueError(f"io_backoff must be >= 0 seconds, got "
+                         f"{io_backoff!r}")
+    return r, b
+
+
+def _interruptible_sleep(delay: float,
+                         abort: Optional[threading.Event]) -> bool:
+    """Sleep ``delay`` seconds; with an ``abort`` event, wake early and
+    return True when it fires (the caller then gives up the retry) —
+    how an abandoned prefetch consumer reaps a producer stuck in a
+    backoff sleep without waiting the schedule out."""
+    if delay <= 0:
+        return bool(abort is not None and abort.is_set())
+    if abort is None:
+        time.sleep(delay)
+        return False
+    return abort.wait(delay)
+
+
+def retry_call(fn: Callable, *, retries: int, backoff: float,
+               stats: Optional[IOStats] = None,
+               abort: Optional[threading.Event] = None,
+               what: str = "read"):
+    """Run ``fn()`` retrying transient (``OSError``) failures up to
+    ``retries`` times with deterministic exponential backoff.  The
+    final failure (or any non-OSError) propagates unchanged."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except OSError:
+            if attempt >= retries:
+                raise
+            attempt += 1
+            if stats is not None:
+                stats.retries_used += 1
+            if _interruptible_sleep(backoff * (2.0 ** (attempt - 1)),
+                                    abort):
+                raise
+
+
+def _retrying_reader(read_rows: Callable, retries: int, backoff: float,
+                     stats: IOStats) -> Callable:
+    """Wrap a ``read_rows(lo, hi)`` shard callback in the retry policy —
+    slice reads from an mmap are idempotent, so a retry is a plain
+    re-read."""
+    def read(lo: int, hi: int) -> np.ndarray:
+        return retry_call(lambda: read_rows(lo, hi), retries=retries,
+                          backoff=backoff, stats=stats,
+                          what=f"rows [{lo}, {hi})")
+    return read
+
+
+class _ResilientBlockIter:
+    """One pass over a ``make_blocks`` stream with transient-error retry
+    and a non-finite-block quarantine policy.
+
+    Retry semantics exploit the streaming surfaces' existing contract
+    that ``make_blocks()`` returns a FRESH, deterministic iterable on
+    every call: a generator that raised is dead, so a failed ``next()``
+    is retried by re-invoking the factory and fast-forwarding past the
+    blocks already delivered — idempotent re-reads, identical
+    trajectory.  Failures during the fast-forward consume attempts from
+    the same bounded budget.
+
+    Quarantine: every block (and its weights, for ``(block, weights)``
+    items) is scanned for non-finite values — ``on_nonfinite='error'``
+    raises naming the block position (instead of the late NaN-centroid
+    guard), ``'skip'`` drops the block and counts it.  The scan is one
+    cheap memory pass per block and runs in the producer thread under
+    prefetch.
+
+    ``abort()`` (called by ``prefetch._PrefetchIterator.close``) wakes a
+    pending backoff sleep so an abandoned consumer never waits out the
+    schedule.
+    """
+
+    def __init__(self, make_blocks: Callable[[], Iterable], retries: int,
+                 backoff: float, on_nonfinite: str,
+                 stats: Optional[IOStats]):
+        self._make = make_blocks
+        self._retries = retries
+        self._backoff = backoff
+        self._on_nonfinite = on_nonfinite
+        self._stats = stats
+        self._abort = threading.Event()
+        self._it = iter(make_blocks())
+        self._pos = 0                    # raw blocks delivered this pass
+        self._skipped = 0
+
+    def __iter__(self):
+        return self
+
+    def _next_raw(self):
+        attempt = 0
+        fast_forward = 0
+        while True:
+            try:
+                for _ in range(fast_forward):
+                    next(self._it)
+                fast_forward = 0
+                item = next(self._it)
+                self._pos += 1
+                return item
+            except StopIteration:
+                raise
+            except OSError as e:
+                if attempt >= self._retries:
+                    raise
+                attempt += 1
+                if self._stats is not None:
+                    self._stats.retries_used += 1
+                if _interruptible_sleep(
+                        self._backoff * (2.0 ** (attempt - 1)),
+                        self._abort):
+                    raise e
+                close_source(self._it)
+                self._it = iter(self._make())
+                fast_forward = self._pos
+
+    def __next__(self):
+        while True:
+            try:
+                item = self._next_raw()
+            except StopIteration:
+                if self._stats is not None:
+                    self._stats.blocks_skipped = self._skipped
+                raise
+            block = item[0] if isinstance(item, tuple) else item
+            bad = not np.all(np.isfinite(np.asarray(block)))
+            if not bad and isinstance(item, tuple) \
+                    and item[1] is not None:
+                bad = not np.all(np.isfinite(np.asarray(item[1])))
+            if not bad:
+                return item
+            if self._on_nonfinite == "error":
+                raise ValueError(
+                    f"non-finite values in streamed block "
+                    f"{self._pos - 1}; pass on_nonfinite='skip' to "
+                    f"quarantine bad blocks (counted in "
+                    f"blocks_skipped_)")
+            self._skipped += 1
+            if self._stats is not None:
+                self._stats.blocks_skipped_total += 1
+
+    def abort(self) -> None:
+        self._abort.set()
+
+    def close(self) -> None:
+        close_source(self._it)
+
+
+_NONFINITE_POLICIES = ("error", "skip")
+
+
+def resilient_blocks(make_blocks: Callable[[], Iterable], *,
+                     io_retries: int = 0, io_backoff: float = 0.05,
+                     on_nonfinite: str = "error",
+                     stats: Optional[IOStats] = None
+                     ) -> Callable[[], Iterable]:
+    """Wrap a ``make_blocks`` factory with the transient-retry +
+    non-finite-quarantine policy (see :class:`_ResilientBlockIter`).
+    This is the one choke point every streamed fit routes its source
+    through, so ALL passes (init, scatter, EM/Lloyd epochs, scoring) see
+    the same cleaned stream and the statistics stay consistent."""
+    if on_nonfinite not in _NONFINITE_POLICIES:
+        raise ValueError(f"on_nonfinite must be one of "
+                         f"{_NONFINITE_POLICIES}, got {on_nonfinite!r}")
+    io_retries, io_backoff = check_io_knobs(io_retries, io_backoff)
+
+    def make():
+        return _ResilientBlockIter(make_blocks, io_retries, io_backoff,
+                                   on_nonfinite, stats)
+    return make
 
 
 class _ReadaheadReader:
@@ -85,14 +299,27 @@ def _sharded_from_source(read_rows, n: int, d: int, mesh: Mesh,
                          sample_weight: Optional[np.ndarray],
                          host_handle,
                          explicit_chunk: bool = False,
-                         prefetch: int = 0) -> ShardedDataset:
+                         prefetch: int = 0,
+                         io_retries: int = 0,
+                         io_backoff: float = 0.05) -> ShardedDataset:
     """Build a ShardedDataset whose shards pull rows via ``read_rows(lo, hi)``
     — each callback materializes only its own slice.  ``prefetch > 0``
     wraps the reader in a :class:`_ReadaheadReader` of that depth, so
     the disk read of the next shard slice overlaps the placement of the
-    current one."""
+    current one.  ``io_retries > 0`` retries each (idempotent) slice
+    read through the deterministic-backoff policy; the counters land on
+    the returned dataset's ``io_stats`` (fits surface them as
+    ``io_retries_used_``)."""
     data_shards, _ = mesh_shape(mesh)
     dtype = np.dtype(dtype)
+    io_retries, io_backoff = check_io_knobs(io_retries, io_backoff)
+    io_stats = IOStats()
+    if io_retries:
+        # Retry INSIDE the readahead wrapper, so background-thread reads
+        # recover too (a failed readahead future would otherwise only
+        # surface — unretried — at the consuming callback).
+        read_rows = _retrying_reader(read_rows, io_retries, io_backoff,
+                                     io_stats)
     # Readahead predicts the NEXT contiguous row range, which on a
     # multi-host mesh belongs to ANOTHER host past this host's last
     # local shard — it would read (and pin) up to ``depth`` never-
@@ -136,9 +363,11 @@ def _sharded_from_source(read_rows, n: int, d: int, mesh: Mesh,
 
     points = jax.make_array_from_callback((n_pad, d), x_sharding, x_cb)
     weights = jax.make_array_from_callback((n_pad,), w_sharding, w_cb)
-    return ShardedDataset(points, weights, n, chunk, mesh,
-                          host=host_handle, host_weights=sw,
-                          explicit_chunk=explicit_chunk)
+    ds = ShardedDataset(points, weights, n, chunk, mesh,
+                        host=host_handle, host_weights=sw,
+                        explicit_chunk=explicit_chunk)
+    ds.io_stats = io_stats
+    return ds
 
 
 def _resolve_chunk(n: int, d: int, k_hint: int, mesh: Mesh,
@@ -156,7 +385,8 @@ def from_npy(path, mesh: Mesh, *, chunk_size: Optional[int] = None,
              dtype=np.float32, k_hint: int = 16,
              budget_elems: Optional[int] = None,
              sample_weight: Optional[np.ndarray] = None,
-             prefetch: int = 2) -> ShardedDataset:
+             prefetch: int = 2, io_retries: int = 0,
+             io_backoff: float = 0.05) -> ShardedDataset:
     """Shard a 2-D ``.npy`` file onto the mesh without loading it whole.
 
     ``k_hint`` feeds the automatic chunk-size choice (the (chunk, k)
@@ -173,6 +403,12 @@ def from_npy(path, mesh: Mesh, *, chunk_size: Optional[int] = None,
     (``data.prefetch``); ``prefetch=0`` restores the fully synchronous
     load.  Host memory grows by up to ``prefetch`` slices either way —
     the per-shard (not whole-file) residency contract is unchanged.
+
+    ``io_retries``/``io_backoff``: retry transient (``OSError``) slice
+    reads up to ``io_retries`` times with deterministic exponential
+    backoff (``io_backoff * 2**(attempt-1)`` seconds) — slice reads are
+    idempotent, so a retried load is bit-identical.  Retry counts land
+    on the returned dataset's ``io_stats.retries_used``.
     """
     mm = np.load(path, mmap_mode="r")
     if mm.ndim != 2:
@@ -192,7 +428,8 @@ def from_npy(path, mesh: Mesh, *, chunk_size: Optional[int] = None,
     return _sharded_from_source(read_rows, n, d, mesh, chunk, dtype,
                                 sample_weight, host_handle=mm,
                                 explicit_chunk=chunk_size is not None,
-                                prefetch=prefetch)
+                                prefetch=prefetch, io_retries=io_retries,
+                                io_backoff=io_backoff)
 
 
 def from_raw(path, shape: Tuple[int, int], mesh: Mesh, *,
@@ -201,10 +438,12 @@ def from_raw(path, shape: Tuple[int, int], mesh: Mesh, *,
              budget_elems: Optional[int] = None,
              offset: int = 0,
              sample_weight: Optional[np.ndarray] = None,
-             prefetch: int = 2) -> ShardedDataset:
+             prefetch: int = 2, io_retries: int = 0,
+             io_backoff: float = 0.05) -> ShardedDataset:
     """Shard a headerless binary file of ``shape`` row-major ``file_dtype``
     values (e.g. exported feature matrices) onto the mesh, reading each
-    shard's byte range only.  ``prefetch`` reads ahead like
+    shard's byte range only.  ``prefetch`` reads ahead and
+    ``io_retries``/``io_backoff`` retry flaky slice reads like
     :func:`from_npy`'s."""
     n, d = shape
     mm = np.memmap(path, dtype=file_dtype, mode="r", offset=offset,
@@ -222,11 +461,13 @@ def from_raw(path, shape: Tuple[int, int], mesh: Mesh, *,
     return _sharded_from_source(read_rows, n, d, mesh, chunk, dtype,
                                 sample_weight, host_handle=mm,
                                 explicit_chunk=chunk_size is not None,
-                                prefetch=prefetch)
+                                prefetch=prefetch, io_retries=io_retries,
+                                io_backoff=io_backoff)
 
 
 def iter_npy_blocks(path, block_rows: int, *, dtype=None,
-                    prefetch: int = 0):
+                    prefetch: int = 0, io_retries: int = 0,
+                    io_backoff: float = 0.05):
     """Factory for ``KMeans.fit_stream``: returns a zero-argument callable
     that yields consecutive (<= block_rows, D) slices of a 2-D ``.npy``
     via mmap — at most ``prefetch + 2`` blocks are ever resident in host
@@ -246,10 +487,16 @@ def iter_npy_blocks(path, block_rows: int, *, dtype=None,
     Usage::
 
         km.fit_stream(iter_npy_blocks("big.npy", 1_000_000))
+
+    ``io_retries``/``io_backoff`` (default off): retry each block's
+    (idempotent) mmap read through the deterministic-backoff policy —
+    the per-read counters land on the returned callable's ``io_stats``.
     """
     if block_rows <= 0:
         raise ValueError(f"block_rows must be positive, got {block_rows}")
     prefetch = check_prefetch(prefetch)
+    io_retries, io_backoff = check_io_knobs(io_retries, io_backoff)
+    io_stats = IOStats()
 
     def iter_blocks():
         arr = np.load(path, mmap_mode="r")
@@ -257,10 +504,14 @@ def iter_npy_blocks(path, block_rows: int, *, dtype=None,
             raise ValueError(f"{path} must contain a 2-D array, "
                              f"got shape {arr.shape}")
         for start in range(0, arr.shape[0], block_rows):
-            block = np.asarray(arr[start: start + block_rows])
+            block = retry_call(
+                lambda: np.asarray(arr[start: start + block_rows]),
+                retries=io_retries, backoff=io_backoff, stats=io_stats,
+                what=f"block rows [{start}, {start + block_rows})")
             yield block if dtype is None else block.astype(dtype)
 
     def make_blocks():
         return prefetch_iter(iter_blocks(), prefetch)
 
+    make_blocks.io_stats = io_stats
     return make_blocks

@@ -46,7 +46,8 @@ import queue
 import threading
 from typing import Callable, Iterable, Iterator, Optional
 
-__all__ = ["prefetch_iter", "check_prefetch", "close_source"]
+__all__ = ["prefetch_iter", "check_prefetch", "close_source",
+           "abort_source"]
 
 # Poll period for the producer's stop-aware queue puts.  Short enough
 # that generator close() never waits noticeably, long enough to cost
@@ -87,6 +88,19 @@ def close_source(it) -> None:
     close = getattr(it, "close", None)
     if close is not None:
         close()
+
+
+def abort_source(it) -> None:
+    """Wake a source blocked in an interruptible wait (e.g. a
+    ``data.io._ResilientBlockIter`` mid-backoff-sleep) so the thread
+    driving it can exit NOW instead of waiting the retry schedule out;
+    a no-op for sources without an ``abort()`` method.  Distinct from
+    :func:`close_source`: abort is safe to call from ANOTHER thread
+    while the source is being iterated (it only sets an event), close
+    is the join-side cleanup."""
+    ab = getattr(it, "abort", None)
+    if ab is not None:
+        ab()
 
 
 def _sync_iter(source, stage):
@@ -178,6 +192,11 @@ class _PrefetchIterator:
             return
         self._done = True
         self._stop.set()
+        # Wake the source FIRST: a producer inside a retry backoff sleep
+        # (data.io._ResilientBlockIter) must abort immediately — the
+        # join below would otherwise wait out the whole deterministic
+        # backoff schedule (ISSUE 4 shutdown-hardening satellite).
+        abort_source(self._source)
         # Drain so a producer blocked in put() sees the stop event on
         # its next poll instead of racing a full queue.
         while True:

@@ -88,10 +88,17 @@ class BisectingKMeans(KMeans):
         k-specific and cannot seed a k=2 subproblem)."""
         return self.init if isinstance(self.init, str) else "k-means++"
 
-    def _fit(self, X, *, sample_weight, resume) -> "BisectingKMeans":
-        if resume:
-            raise ValueError("BisectingKMeans does not support resume=True "
-                             "(splits are not checkpointable mid-tree)")
+    def _fit(self, X, *, sample_weight, resume, checkpoint_every: int = 0,
+             checkpoint_path=None) -> "BisectingKMeans":
+        checkpoint_every = self._check_ckpt(checkpoint_every,
+                                            checkpoint_path)
+        tree = getattr(self, "_tree_state", None)
+        if resume and tree is None:
+            raise ValueError(
+                "BisectingKMeans resume needs a split-boundary "
+                "checkpoint: fit with checkpoint_every=N + "
+                "checkpoint_path, then fit(X, resume=<path>) — a plain "
+                "save() holds no mid-tree state")
         verbose = self.verbose and jax.process_index() == 0
         log = IterationLogger(verbose)
         X = self._apply_sample_weight(X, sample_weight)
@@ -112,20 +119,42 @@ class BisectingKMeans(KMeans):
                 f"initialize {self.k} clusters")
 
         log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
-        self.sse_history = []
-        self.iter_times_ = []
+        self.checkpoint_segments_ = 0 if checkpoint_every else None
 
-        labels = np.zeros(n, dtype=np.int32)
-        # Per-leaf state, keyed by leaf id (ids stay contiguous 0..n_leaves-1:
-        # child 0 of a split keeps the parent's id, child 1 takes the next
-        # free id).
-        cents = {0: None}
-        sse = {0: np.inf}          # root is always the first split target
-        wsize = {0: float(base_w.sum())}
-        members = {0: int((base_w > 0).sum())}
+        if resume:
+            # Rebuild the split tree at the checkpointed boundary: every
+            # later split is a pure function of (seed, split index) and
+            # these arrays, so the continuation is bit-identical to the
+            # uninterrupted run (the per-split inner-fit seeds derive
+            # from the ABSOLUTE split index).
+            if tree["labels"].shape != (n,):
+                raise ValueError(
+                    f"checkpointed split tree was built on "
+                    f"{tree['labels'].shape[0]} rows; resume got {n} — "
+                    f"pass the same dataset the fit started on")
+            start_split = int(tree["splits_done"])
+            labels = np.asarray(tree["labels"], np.int32).copy()
+            cents = {i: np.asarray(c, np.float64)
+                     for i, c in enumerate(tree["cents"])}
+            sse = {i: float(v) for i, v in enumerate(tree["sse"])}
+            wsize = {i: float(v) for i, v in enumerate(tree["wsize"])}
+            members = {i: int(v) for i, v in enumerate(tree["members"])}
+        else:
+            start_split = 0
+            self.sse_history = []
+            self.iter_times_ = []
+            self._tree_state = None      # no stale tree in checkpoints
+            labels = np.zeros(n, dtype=np.int32)
+            # Per-leaf state, keyed by leaf id (ids stay contiguous
+            # 0..n_leaves-1: child 0 of a split keeps the parent's id,
+            # child 1 takes the next free id).
+            cents = {0: None}
+            sse = {0: np.inf}      # root is always the first split target
+            wsize = {0: float(base_w.sum())}
+            members = {0: int((base_w > 0).sum())}
 
         import time as _time
-        for split in range(self.k - 1):
+        for split in range(start_split, self.k - 1):
             t0 = _time.perf_counter()
             splittable = [c for c in cents
                           if members[c] >= 2 and
@@ -188,6 +217,11 @@ class BisectingKMeans(KMeans):
                     + (f", total SSE = {total:.4f}"
                        if self.compute_sse else ""))
             self.iterations_run = split + 1
+            if checkpoint_every and (split + 1) % checkpoint_every == 0:
+                self._snapshot_tree(split + 1, labels, cents, sse, wsize,
+                                    members)
+                self.checkpoint_segments_ += 1
+                self._write_autockpt(checkpoint_path, split + 1)
 
         k_out = len(cents)
         if k_out == 1:
@@ -223,10 +257,35 @@ class BisectingKMeans(KMeans):
         self.labels_ = labels
         self.cluster_sse_ = np.array([sse[i] for i in range(k_out)])
         self.cluster_sizes_ = np.array([wsize[i] for i in range(k_out)])
+        if checkpoint_every and self.iterations_run % checkpoint_every \
+                and self.iterations_run:
+            # Off-cadence tail (k-1 not a multiple of N): the finished
+            # tree is still durably on disk.
+            self._snapshot_tree(self.iterations_run, labels, cents, sse,
+                                wsize, members)
+            self.checkpoint_segments_ += 1
+            self._write_autockpt(checkpoint_path, self.iterations_run)
         return self
 
+    def _snapshot_tree(self, splits_done: int, labels, cents, sse, wsize,
+                       members) -> None:
+        """Freeze the split tree at a boundary (all leaves have centroids
+        once the first split landed) — the arrays a checkpointed resume
+        rebuilds the leaf dicts from."""
+        L = len(cents)
+        self._tree_state = {
+            "splits_done": int(splits_done),
+            "labels": np.asarray(labels, np.int32).copy(),
+            "cents": np.stack([np.asarray(cents[i], np.float64)
+                               for i in range(L)]),
+            "sse": np.asarray([sse[i] for i in range(L)], np.float64),
+            "wsize": np.asarray([wsize[i] for i in range(L)], np.float64),
+            "members": np.asarray([members[i] for i in range(L)],
+                                  np.int64),
+        }
+
     def fit_stream(self, make_blocks, *, d=None, resume=False,
-                   prefetch=2):
+                   prefetch=2, **kwargs):
         """Blocked: the inherited ``fit_stream`` would run plain flat Lloyd
         — no bisecting tree, stale ``cluster_sse_``/``labels_`` semantics
         (ADVICE r1).  Bisecting needs random row access for its per-split
@@ -241,7 +300,35 @@ class BisectingKMeans(KMeans):
     def _state_dict(self) -> dict:
         state = super()._state_dict()
         state["bisecting_strategy"] = self.bisecting_strategy
+        tree = getattr(self, "_tree_state", None)
+        if tree is not None:
+            # Mid-tree auto-checkpoint state (ISSUE 4): the (n,) label
+            # array plus per-leaf tables — what fit(resume=<path>) needs
+            # to continue splitting bit-identically.  Only present on
+            # fits run with checkpoint_every > 0; plain save() stays
+            # O(k*D).
+            state["tree_labels"] = tree["labels"]
+            state["tree_cents"] = tree["cents"]
+            state["tree_sse"] = tree["sse"]
+            state["tree_wsize"] = tree["wsize"]
+            state["tree_members"] = tree["members"]
+            state["tree_splits_done"] = int(tree["splits_done"])
         return state
+
+    def _restore_state(self, state: dict) -> None:
+        super()._restore_state(state)
+        # Clear-then-restore: a stale in-memory tree must never shadow
+        # the checkpoint being restored.
+        self._tree_state = None
+        if "tree_labels" in state:
+            self._tree_state = {
+                "splits_done": int(state["tree_splits_done"]),
+                "labels": np.asarray(state["tree_labels"], np.int32),
+                "cents": np.asarray(state["tree_cents"], np.float64),
+                "sse": np.asarray(state["tree_sse"], np.float64),
+                "wsize": np.asarray(state["tree_wsize"], np.float64),
+                "members": np.asarray(state["tree_members"], np.int64),
+            }
 
     @classmethod
     def _load_kwargs(cls, state: dict) -> dict:

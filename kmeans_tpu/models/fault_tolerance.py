@@ -1,0 +1,92 @@
+"""Shared auto-checkpointing plumbing for every model family (ISSUE 4).
+
+One mixin carries the three pieces every fault-tolerant fit needs:
+
+* ``_check_ckpt`` — knob validation (``checkpoint_every``/``_path``
+  pairing, n_init=1 — a restart sweep re-initializes, so a partial
+  sweep has no well-defined resume point);
+* ``_write_autockpt`` — the rotating atomic write
+  (``utils.checkpoint.save_state_rotating`` under the multi-host
+  primary-gated barrier) followed by the deterministic fault-injection
+  boundary hook (``utils.faults.on_checkpoint``) — fired only AFTER the
+  checkpoint is durable, so an injected kill always leaves a valid
+  resume point;
+* ``_resolve_resume`` — ``resume`` may be a checkpoint PATH: load it
+  (falling back to the last-good ``.prev`` rotation with a warning on
+  corruption), sanity-check the model class / cluster count, restore
+  the fitted state, and continue as ``resume=True``.
+
+Host classes provide ``_state_dict()`` / ``_restore_state(state)`` (the
+same pair ``save``/``load`` use) and declare ``_ckpt_k_attr`` — the
+cluster-count constructor attribute ('k' for the K-Means families,
+'n_components' for the mixture) checked against the checkpoint.
+"""
+
+from __future__ import annotations
+
+import os
+
+from kmeans_tpu.utils import checkpoint as ckpt
+from kmeans_tpu.utils import faults
+
+
+class AutoCheckpointMixin:
+
+    _ckpt_k_attr = "k"
+
+    def _check_ckpt(self, checkpoint_every, checkpoint_path) -> int:
+        """Validate the auto-checkpoint knobs (shared by every family's
+        fit/fit_stream)."""
+        n = int(checkpoint_every)
+        if n < 0 or n != checkpoint_every:
+            raise ValueError(f"checkpoint_every must be an int >= 0, got "
+                             f"{checkpoint_every!r}")
+        if n > 0 and checkpoint_path is None:
+            raise ValueError("checkpoint_every > 0 requires "
+                             "checkpoint_path")
+        if n == 0 and checkpoint_path is not None:
+            raise ValueError("checkpoint_path requires "
+                             "checkpoint_every >= 1")
+        if n > 0 and self.n_init != 1:
+            raise ValueError(
+                "auto-checkpointing (checkpoint_every > 0) requires "
+                "n_init == 1: a restart sweep re-initializes, so a "
+                "partially-swept fit has no well-defined resume point")
+        return n
+
+    def _write_autockpt(self, path, iteration: int) -> None:
+        """One rotating atomic checkpoint (multi-host primary-gated,
+        barriered per segment) + the deterministic fault-injection
+        boundary hook."""
+        ckpt.save_state_primary(path, self._state_dict(),
+                                f"kmeans_tpu.autockpt.{iteration}",
+                                rotate=True)
+        faults.on_checkpoint(iteration, path)
+
+    def _resolve_resume(self, resume):
+        """Normalize the ``resume`` argument; a path loads the
+        checkpoint (with ``.prev`` fallback) into this model first."""
+        if not isinstance(resume, (str, os.PathLike)):
+            return bool(resume)
+        state, used_prev = ckpt.load_state_with_fallback(resume)
+        if used_prev:
+            import warnings
+            warnings.warn(
+                f"checkpoint {resume} is unreadable; resuming from the "
+                f"last-good rotation {ckpt.prev_path(resume)} (one "
+                f"checkpoint interval older, same trajectory)",
+                UserWarning, stacklevel=3)
+        cls_name = state.get("model_class", type(self).__name__)
+        if cls_name != type(self).__name__:
+            raise ValueError(
+                f"checkpoint {resume} was written by {cls_name}, not "
+                f"{type(self).__name__}; load it with {cls_name}.load "
+                f"or resume with the matching model class")
+        k_attr = self._ckpt_k_attr
+        if k_attr in state and int(state[k_attr]) != getattr(self, k_attr):
+            raise ValueError(
+                f"checkpoint {resume} holds a {k_attr}="
+                f"{int(state[k_attr])} model; this model has "
+                f"{k_attr}={getattr(self, k_attr)}")
+        self._restore_state(state)
+        return True

@@ -77,6 +77,7 @@ from kmeans_tpu.parallel.gmm_step import (EStats, EStatsFull,
 from kmeans_tpu.parallel.mesh import MODEL_AXIS, make_mesh, mesh_shape
 from kmeans_tpu.parallel.sharding import (ShardedDataset, choose_chunk_size,
                                           to_device)
+from kmeans_tpu.models.fault_tolerance import AutoCheckpointMixin
 from kmeans_tpu.utils.validation import check_finite_array
 
 from kmeans_tpu.utils.cache import LRUCache
@@ -137,7 +138,7 @@ def _get_fns(mesh: Mesh, chunk: int, cov_type: str = "diag",
                  pred_b(mesh, chunk_size=chunk)))
 
 
-class GaussianMixture:
+class GaussianMixture(AutoCheckpointMixin):
     """sklearn-style diagonal GMM, data-sharded over the TPU mesh.
 
     Parameters follow ``sklearn.mixture.GaussianMixture`` where they
@@ -178,6 +179,8 @@ class GaussianMixture:
                     "means_init", "precisions_init", "seed", "dtype",
                     "mesh", "model_shards", "chunk_size", "host_loop",
                     "pipeline", "verbose")
+
+    _ckpt_k_attr = "n_components"    # AutoCheckpointMixin resume check
 
     def __init__(self, n_components: int = 1, *,
                  covariance_type: str = "diag", tol: float = 1e-3,
@@ -249,6 +252,18 @@ class GaussianMixture:
         self.converged_: bool = False
         self.n_iter_: int = 0
         self.lower_bound_: float = -np.inf
+        # Fault-tolerance observability (ISSUE 4), mirroring KMeans'.
+        self.io_retries_used_: int = 0
+        self.blocks_skipped_: int = 0
+        self.checkpoint_segments_: Optional[int] = None
+        # Raw accumulation-dtype device-loop tables (means_c/cov/log_w +
+        # the carried convergence baseline) captured at the last segment
+        # boundary or device-loop finish: the device loop works in the
+        # CENTERED frame, and round-tripping through the float64
+        # shift-added ``means_`` is not bit-exact ((a + s) - s != a), so
+        # bit-exact device-loop resume restores these instead.  None for
+        # host-loop fits (whose float64 attrs ARE the exact carry).
+        self._dev_tables: Optional[dict] = None
 
     # ------------------------------------------------------------- plumbing
 
@@ -610,8 +625,9 @@ class GaussianMixture:
         pi = np.maximum(R / max(w_total, 1e-300), 1e-300)
         return w_total, (pi / pi.sum(), mu, var)
 
-    def fit(self, X, sample_weight=None, *,
-            resume: bool = False) -> "GaussianMixture":
+    def fit(self, X, sample_weight=None, *, resume=False,
+            checkpoint_every: int = 0,
+            checkpoint_path=None) -> "GaussianMixture":
         """Fit by EM.  ``resume=True`` continues EM from the CURRENT
         fitted parameters for up to ``max_iter`` further iterations
         (sklearn's ``warm_start`` capability; composes with
@@ -622,8 +638,24 @@ class GaussianMixture:
         ``jax_default_matmul_precision='highest'``); under default
         bf16-rate TPU dots borderline responsibilities can diverge the
         two trajectories percent-level on overlapping clusters — the
-        same documented class as the streamed-vs-in-memory comparison."""
+        same documented class as the streamed-vs-in-memory comparison.
+
+        Fault tolerance (ISSUE 4): ``resume`` may be a checkpoint PATH
+        (loaded with the ``.prev`` corrupt fallback), and
+        ``checkpoint_every=N`` + ``checkpoint_path`` auto-checkpoints
+        every N EM iterations with the rotating atomic writer — the
+        one-dispatch device loop becomes segmented (the convergence
+        baseline rides the dispatch as a traced argument and the raw
+        centered-frame tables are checkpointed, so both segmentation
+        AND kill+resume are bit-exact against the ``checkpoint_every=0``
+        oracle; the float64 host loop is bit-exact through its fitted
+        attributes alone).  Requires ``n_init=1``."""
+        checkpoint_every = self._check_ckpt(checkpoint_every,
+                                            checkpoint_path)
+        resume = self._resolve_resume(resume)
         ds = self._dataset(X, sample_weight)
+        self.io_retries_used_ = getattr(
+            getattr(ds, "io_stats", None), "retries_used", 0)
         mesh = self._resolve_mesh()
         chunk = self._eff_chunk(ds)
         pipeline = self._note_estep_path()
@@ -647,7 +679,9 @@ class GaussianMixture:
             if self.n_init != 1:
                 raise ValueError("fit(resume=True) requires n_init == 1 "
                                  "(the restart sweep re-initializes)")
-            self._fit_one(ds, mesh, step_fn, self.seed, resume=True)
+            self._fit_one(ds, mesh, step_fn, self.seed, resume=True,
+                          checkpoint_every=checkpoint_every,
+                          checkpoint_path=checkpoint_path)
             return self
         seeds = self._restart_seeds()
         self.best_restart_ = 0
@@ -665,7 +699,9 @@ class GaussianMixture:
         last_err = None
         for r, seed in enumerate(seeds):
             try:
-                self._fit_one(ds, mesh, step_fn, seed)
+                self._fit_one(ds, mesh, step_fn, seed,
+                              checkpoint_every=checkpoint_every,
+                              checkpoint_path=checkpoint_path)
             except Exception as e:
                 # A failed restart (e.g. the device loop's non-finite-
                 # loglik error) must not discard earlier successful
@@ -685,11 +721,17 @@ class GaussianMixture:
                 return self
             lls.append(self.lower_bound_)
             if best is None or self.lower_bound_ > best["ll"]:
+                # The raw device tables travel WITH the winner: restoring
+                # only the sklearn-frame attrs would leave _dev_tables
+                # holding the LAST restart's carry, and a later
+                # save()+resume would silently continue a losing
+                # trajectory (review r9).
                 best = {"ll": self.lower_bound_, "restart": r,
                         "weights_": self.weights_, "means_": self.means_,
                         "covariances_": self.covariances_,
                         "converged_": self.converged_,
-                        "n_iter_": self.n_iter_}
+                        "n_iter_": self.n_iter_,
+                        "_dev_tables": self._dev_tables}
         if best is None:
             raise last_err
         self.weights_ = best["weights_"]
@@ -700,10 +742,14 @@ class GaussianMixture:
         self.lower_bound_ = best["ll"]
         self.best_restart_ = best["restart"]
         self.restart_lower_bounds_ = np.asarray(lls, np.float64)
+        self._dev_tables = best["_dev_tables"]
         return self
 
     def fit_stream(self, make_blocks, *, d: Optional[int] = None,
-                   prefetch: int = 2) -> "GaussianMixture":
+                   resume=False, prefetch: int = 2,
+                   checkpoint_every: int = 0, checkpoint_path=None,
+                   io_retries: int = 0, io_backoff: float = 0.05,
+                   on_nonfinite: str = "error") -> "GaussianMixture":
         """EXACT EM over data larger than device memory — the mixture
         analogue of ``KMeans.fit_stream`` (r3 VERDICT #6: the E-step
         statistics are the same dense host-summable accumulators the
@@ -742,7 +788,20 @@ class GaussianMixture:
         0 = the synchronous path.  The streamed init passes stay
         synchronous (once per fit; their reservoir state is
         consumption-order-bound anyway).
+
+        Fault tolerance (ISSUE 4, matching ``KMeans.fit_stream``):
+        ``resume`` (bool or checkpoint path; requires ``n_init=1``)
+        continues EM from the current parameters for up to ``max_iter``
+        further epochs; ``checkpoint_every=N`` + ``checkpoint_path``
+        writes a rotating atomic checkpoint every N epochs;
+        ``io_retries``/``io_backoff`` retry transient block reads by
+        deterministic epoch replay; ``on_nonfinite='error'|'skip'``
+        names or quarantines non-finite streamed blocks (every pass —
+        shift, scatter, init, EM — sees the same cleaned stream).
+        Observability: ``io_retries_used_``, ``blocks_skipped_``,
+        ``checkpoint_segments_``.
         """
+        from kmeans_tpu.data.io import IOStats, resilient_blocks
         from kmeans_tpu.data.prefetch import (check_prefetch, close_source,
                                               prefetch_iter)
         from kmeans_tpu.parallel.sharding import shard_points
@@ -750,6 +809,16 @@ class GaussianMixture:
                                             streamed_forgy_init,
                                             streamed_kmeans_parallel_init)
         prefetch = check_prefetch(prefetch)
+        checkpoint_every = self._check_ckpt(checkpoint_every,
+                                            checkpoint_path)
+        resume = self._resolve_resume(resume) and self.means_ is not None
+        if resume and self.n_init != 1:
+            raise ValueError("fit_stream resume requires n_init == 1")
+        io_stats = IOStats()
+        make_blocks = resilient_blocks(
+            make_blocks, io_retries=io_retries, io_backoff=io_backoff,
+            on_nonfinite=on_nonfinite, stats=io_stats)
+        self.checkpoint_segments_ = 0 if checkpoint_every else None
         if d is None:
             # close_source: a prefetching source must have its producer
             # thread reaped when the peek abandons it after one item.
@@ -873,6 +942,39 @@ class GaussianMixture:
                     T += np.asarray(ts_fn(pts, w, shift_dev), np.float64)
             self._total_scatter = T
 
+        class _RS:
+            def __init__(self):
+                self.done = False
+                self.failed = False
+                self.prev = -np.inf
+                self.ll = -np.inf
+                self.n_iter = 0
+
+        if resume:
+            # Continue EM from the current float64 parameters: the
+            # stream passes above re-derive shift/scatter exactly (same
+            # deterministic stream), the restored ``lower_bound_`` is
+            # the convergence baseline, and the epoch index continues
+            # from ``n_iter_`` — so an epoch-boundary kill+resume runs
+            # the identical per-epoch trajectory.  Like fit(resume=True)
+            # the resumed call grants max_iter FURTHER epochs, so the
+            # final state is bit-identical to the uninterrupted fit
+            # whenever that fit converges within its own budget (the
+            # case the parity tests pin); a budget-exhausted fit resumes
+            # with fresh headroom instead.
+            base_iter = self.n_iter_
+            params = [(np.asarray(self.weights_, np.float64),
+                       np.asarray(self.means_, np.float64),
+                       np.asarray(self.covariances_, np.float64))]
+            states = [_RS()]
+            states[0].prev = self.lower_bound_
+            states[0].ll = self.lower_bound_
+            states[0].n_iter = base_iter
+            return self._fit_stream_epochs(
+                mesh, shift, params, states, base_iter, epoch_stats,
+                io_stats, checkpoint_every, checkpoint_path)
+
+        base_iter = 0
         # ---- per-restart means over the FULL stream.
         seeds = self._restart_seeds()
         if self.means_init is not None:
@@ -912,15 +1014,32 @@ class GaussianMixture:
                        for m in means_list]
         hard_stats = epoch_stats(hard_tables)
 
-        class _RS:
-            def __init__(self):
-                self.done = False
-                self.failed = False
-                self.prev = -np.inf
-                self.ll = -np.inf
-                self.n_iter = 0
-
         states = [_RS() for _ in means_list]
+        params = []
+        w_total0 = None
+        for m, st in zip(means_list, hard_stats):
+            w_total0, (pi, mu_c, var) = self._m_step(st)
+            mu = (mu_c + shift) if self.means_init is None else m
+            if self.weights_init is not None:
+                pi = np.asarray(self.weights_init, np.float64)
+                pi = pi / pi.sum()
+            if self.precisions_init is not None:
+                var = self._cov_from_precisions_init()
+            params.append((pi, mu, var))
+        if w_total0 is not None and w_total0 <= 0:
+            raise ValueError("total sample weight must be positive")
+
+        return self._fit_stream_epochs(
+            mesh, shift, params, states, base_iter, epoch_stats,
+            io_stats, checkpoint_every, checkpoint_path)
+
+    def _fit_stream_epochs(self, mesh, shift, params, states, base_iter,
+                           epoch_stats, io_stats, checkpoint_every,
+                           checkpoint_path) -> "GaussianMixture":
+        """The interleaved exact-EM epoch loop + winner selection shared
+        by fresh and resumed ``fit_stream`` runs.  ``base_iter`` offsets
+        the epoch index (absolute, so checkpoint cadence and restored
+        convergence baselines continue the uninterrupted schedule)."""
         last_err = None
 
         def fail_restart(i, err):
@@ -937,22 +1056,9 @@ class GaussianMixture:
             states[i].failed = states[i].done = True
             states[i].ll = -np.inf
             last_err = err
-        params = []
-        w_total0 = None
-        for m, st in zip(means_list, hard_stats):
-            w_total0, (pi, mu_c, var) = self._m_step(st)
-            mu = (mu_c + shift) if self.means_init is None else m
-            if self.weights_init is not None:
-                pi = np.asarray(self.weights_init, np.float64)
-                pi = pi / pi.sum()
-            if self.precisions_init is not None:
-                var = self._cov_from_precisions_init()
-            params.append((pi, mu, var))
-        if w_total0 is not None and w_total0 <= 0:
-            raise ValueError("total sample weight must be positive")
 
         # ---- interleaved exact-EM epochs.
-        for it in range(1, self.max_iter + 1):
+        for it in range(base_iter + 1, base_iter + self.max_iter + 1):
             live = []
             tables = []
             for i, s in enumerate(states):
@@ -993,6 +1099,20 @@ class GaussianMixture:
                 if abs(st.ll - st.prev) < self.tol:
                     st.done = True
                 st.prev = st.ll
+            # Epoch-boundary rotating checkpoint (single-restart only,
+            # enforced by _check_ckpt): publish the post-epoch params so
+            # the checkpoint is a valid bit-exact resume point.
+            if checkpoint_every and it % checkpoint_every == 0 \
+                    and not states[0].failed:
+                pi, mu, var = params[0]
+                self.weights_, self.means_, self.covariances_ = \
+                    pi, mu, var
+                self.lower_bound_ = states[0].ll
+                self.converged_ = states[0].done
+                self.n_iter_ = states[0].n_iter
+                self._dev_tables = None      # float64 host-frame carry
+                self.checkpoint_segments_ += 1
+                self._write_autockpt(checkpoint_path, it)
 
         # ---- winner (highest final lower bound, the in-memory rule).
         if all(s.failed for s in states):
@@ -1007,24 +1127,38 @@ class GaussianMixture:
         self.best_restart_ = best
         self.restart_lower_bounds_ = (np.asarray(lls, np.float64)
                                       if len(states) > 1 else None)
+        self._dev_tables = None
+        self.io_retries_used_ = io_stats.retries_used
+        self.blocks_skipped_ = io_stats.blocks_skipped
+        if checkpoint_every and self.n_iter_ % checkpoint_every:
+            self.checkpoint_segments_ += 1
+            self._write_autockpt(checkpoint_path, self.n_iter_)
         return self
 
     def _fit_one(self, ds, mesh, step_fn, seed: int,
-                 resume: bool = False) -> None:
+                 resume: bool = False, checkpoint_every: int = 0,
+                 checkpoint_path=None) -> None:
         if not resume:
             # Continue-from-current (resume) skips the re-init; the
             # iteration counter carries over on both loops, and the
-            # host loop's convergence baseline carries over too (the
-            # device kernel starts its in-dispatch tol history fresh —
-            # at worst one extra iteration, like KMeans' device resume).
+            # convergence baseline carries over on both too (the device
+            # kernel receives it as the traced ``prev0`` argument —
+            # ISSUE 4 made the device resume exact, not one-extra-
+            # iteration approximate).
             w_total = self._init_params(ds, step_fn, seed)
             if w_total <= 0:
                 raise ValueError("total sample weight must be positive")
         if not self.host_loop:
             return self._fit_on_device(
-                ds, mesh, base_iter=self.n_iter_ if resume else 0)
+                ds, mesh, base_iter=self.n_iter_ if resume else 0,
+                resume=resume, checkpoint_every=checkpoint_every,
+                checkpoint_path=checkpoint_path)
 
         self.converged_ = False
+        # The float64 host loop's exact carry IS its fitted attributes;
+        # stale raw device tables must not survive into its checkpoints.
+        self._dev_tables = None
+        self.checkpoint_segments_ = 0 if checkpoint_every else None
         base = self.n_iter_ if resume else 0
         prev = self.lower_bound_ if resume else -np.inf
         shift = self._shift()
@@ -1051,10 +1185,18 @@ class GaussianMixture:
             if not np.isfinite(self.lower_bound_):
                 raise ValueError(
                     f"non-finite log-likelihood at EM iteration {it}")
+            # Absolute-index cadence (after the non-finite guard: never
+            # checkpoint a poisoned state).
+            if checkpoint_every and it % checkpoint_every == 0:
+                self.checkpoint_segments_ += 1
+                self._write_autockpt(checkpoint_path, it)
             if abs(self.lower_bound_ - prev) < self.tol:
                 self.converged_ = True
                 break
             prev = self.lower_bound_
+        if checkpoint_every and self.n_iter_ % checkpoint_every:
+            self.checkpoint_segments_ += 1
+            self._write_autockpt(checkpoint_path, self.n_iter_)
 
     def _fit_on_device_multi(self, ds, mesh, step_fn,
                              seeds) -> "GaussianMixture":
@@ -1159,69 +1301,29 @@ class GaussianMixture:
         self.lower_bound_ = float(hist[-1]) if n else -np.inf
         self.best_restart_ = int(best)
         self.restart_lower_bounds_ = np.asarray(lls, np.float64)
+        self._dev_tables = None     # no single-trajectory carry to keep
         if self.verbose:
             print(f"EM batched restarts: best {self.best_restart_ + 1} of "
                   f"{R}, mean log-likelihood = {self.lower_bound_:.6f}",
                   flush=True)
         return self
 
-    def _fit_on_device(self, ds, mesh, base_iter: int = 0) -> None:
-        """All EM iterations in ONE dispatch (``host_loop=False``) — the
-        mixture analogue of ``KMeans._fit_on_device``.  All four
-        covariance types: diag/spherical via ``make_gmm_fit_fn``,
-        full/tied via their own loops (batched on-device Cholesky per
-        iteration; a component collapsing to non-PD surfaces as the
-        loud non-finite-loglik error — the float64 host loop gives the
-        pointed ill-defined-covariance message instead).  ``base_iter``
-        offsets ``n_iter_`` for resumed fits (the loop itself always
-        starts from the CURRENT parameter tables)."""
+    @staticmethod
+    def _pack_dev_tables(ct, means_out, cov_out, log_w_out, prev) -> dict:
+        """The raw device-loop carry in checkpointable form (ONE place:
+        the segment-boundary and post-loop publications must stay
+        identical)."""
+        return {"cov_type": ct, "means_c": np.asarray(means_out),
+                "cov": np.asarray(cov_out),
+                "log_w": np.asarray(log_w_out), "prev_ll": prev}
+
+    def _ingest_device_tables(self, means_out, cov_out, log_w_out,
+                              shift) -> None:
+        """Host-side publication of the device loop's raw tables into
+        the sklearn-frame fitted attributes (shift added back in
+        float64; spherical collapses its broadcast variance)."""
         ct = self.covariance_type
-        builder = {"diag": make_gmm_fit_fn, "spherical": make_gmm_fit_fn,
-                   "tied": make_gmm_fit_tied_fn,
-                   "full": make_gmm_fit_full_fn}[ct]
-        kwargs = {"cov_type": ct} if ct in ("diag", "spherical") else {}
-        chunk = self._eff_chunk(ds)
-        pipeline = self._note_estep_path()
-        key = (mesh, chunk, self.n_components, self.max_iter,
-               float(self.tol), float(self.reg_covar), ct, pipeline,
-               "gmmfit")
-        fit_fn = _STEP_CACHE.get_or_create(key, lambda: builder(
-            mesh, chunk_size=chunk, k_real=self.n_components,
-            max_iter=self.max_iter, tol=float(self.tol),
-            reg_covar=float(self.reg_covar), pipeline=pipeline, **kwargs))
         k = self.n_components
-        k_pad = self._k_pad
-        d = self.means_.shape[1]
-        shift = self._shift()
-        log_w0 = np.full((k_pad,), -np.inf, self.dtype)
-        log_w0[:k] = np.log(np.maximum(self.weights_, 1e-300))
-        if ct in ("diag", "spherical"):
-            cv = np.maximum(
-                self._diag_view(),
-                max(self.reg_covar, float(np.finfo(self.dtype).tiny)))
-            # The device loop carries FULL replicated tables (each shard
-            # slices its block per iteration, like KMeans' make_fit_fn).
-            mc, cov0, _ = self._pad_tables(
-                (self.means_ - shift).astype(self.dtype),
-                cv.astype(self.dtype), log_w0[:k])
-        elif ct == "full":
-            mc = np.zeros((k_pad, d), self.dtype)
-            mc[:k] = (self.means_ - shift).astype(self.dtype)
-            cov0 = np.broadcast_to(np.eye(d, dtype=self.dtype),
-                                   (k_pad, d, d)).copy()
-            cov0[:k] = np.asarray(self.covariances_, self.dtype)
-        else:                                     # tied
-            mc = np.zeros((k_pad, d), self.dtype)
-            mc[:k] = (self.means_ - shift).astype(self.dtype)
-            cov0 = np.asarray(self.covariances_, self.dtype)
-        means_out, cov_out, log_w_out, it, hist, conv = fit_fn(
-            ds.points, ds.weights, jnp.asarray(shift.astype(self.dtype)),
-            jnp.asarray(mc), jnp.asarray(cov0), jnp.asarray(log_w0))
-        n = int(it)
-        hist = np.asarray(hist, np.float64)[:n]
-        if n and not np.all(np.isfinite(hist)):
-            raise ValueError(
-                f"non-finite log-likelihood at EM iteration {n}")
         self.means_ = np.asarray(means_out, np.float64)[:k] + shift
         cv_out = np.asarray(cov_out, np.float64)
         if ct == "spherical":
@@ -1234,11 +1336,137 @@ class GaussianMixture:
             self.covariances_ = cv_out[:k]
         w = np.exp(np.asarray(log_w_out, np.float64)[:k])
         self.weights_ = w / w.sum()
-        self.converged_ = bool(conv)
-        self.n_iter_ = base_iter + n
-        self.lower_bound_ = float(hist[-1]) if n else -np.inf
+
+    def _fit_on_device(self, ds, mesh, base_iter: int = 0,
+                       resume: bool = False, checkpoint_every: int = 0,
+                       checkpoint_path=None) -> None:
+        """All EM iterations in ONE dispatch (``host_loop=False``) — the
+        mixture analogue of ``KMeans._fit_on_device``.  All four
+        covariance types: diag/spherical via ``make_gmm_fit_fn``,
+        full/tied via their own loops (batched on-device Cholesky per
+        iteration; a component collapsing to non-PD surfaces as the
+        loud non-finite-loglik error — the float64 host loop gives the
+        pointed ill-defined-covariance message instead).  ``base_iter``
+        offsets ``n_iter_`` for resumed fits.
+
+        ``checkpoint_every=N`` segments the dispatch (ISSUE 4): the
+        convergence baseline ``prev0`` rides each segment as a traced
+        argument (the exact acc-dtype value the in-loop carry held at
+        the boundary), the raw centered-frame tables hand off between
+        segments without any host cast, and the SAME raw tables land in
+        the rotating checkpoint (``_dev_tables``) — so segmented ==
+        single-dispatch bit-exactly, and kill+resume restores the raw
+        carry instead of round-tripping through the float64 shift-added
+        attributes (which would not be bit-exact).  A resume WITHOUT
+        raw tables (host-loop or pre-ISSUE-4 checkpoint) reconstructs
+        from the fitted attributes and seeds ``prev0`` with
+        ``lower_bound_``."""
+        ct = self.covariance_type
+        builder = {"diag": make_gmm_fit_fn, "spherical": make_gmm_fit_fn,
+                   "tied": make_gmm_fit_tied_fn,
+                   "full": make_gmm_fit_full_fn}[ct]
+        kwargs = {"cov_type": ct} if ct in ("diag", "spherical") else {}
+        chunk = self._eff_chunk(ds)
+        pipeline = self._note_estep_path()
+        k = self.n_components
+        k_pad = self._k_pad
+        d = self.means_.shape[1]
+        shift = self._shift()
+        acc = np.promote_types(self.dtype, np.float32)
+
+        raw = self._dev_tables if resume else None
+        if raw is not None and raw["cov_type"] == ct and \
+                raw["means_c"].shape == (k_pad, d):
+            mc = np.asarray(raw["means_c"])
+            cov0 = np.asarray(raw["cov"])
+            log_w0 = np.asarray(raw["log_w"])
+            prev = float(raw["prev_ll"])
+        else:
+            log_w0 = np.full((k_pad,), -np.inf, self.dtype)
+            log_w0[:k] = np.log(np.maximum(self.weights_, 1e-300))
+            if ct in ("diag", "spherical"):
+                cv = np.maximum(
+                    self._diag_view(),
+                    max(self.reg_covar, float(np.finfo(self.dtype).tiny)))
+                # The device loop carries FULL replicated tables (each
+                # shard slices its block per iteration, like KMeans'
+                # make_fit_fn).
+                mc, cov0, _ = self._pad_tables(
+                    (self.means_ - shift).astype(self.dtype),
+                    cv.astype(self.dtype), log_w0[:k])
+            elif ct == "full":
+                mc = np.zeros((k_pad, d), self.dtype)
+                mc[:k] = (self.means_ - shift).astype(self.dtype)
+                cov0 = np.broadcast_to(np.eye(d, dtype=self.dtype),
+                                       (k_pad, d, d)).copy()
+                cov0[:k] = np.asarray(self.covariances_, self.dtype)
+            else:                                     # tied
+                mc = np.zeros((k_pad, d), self.dtype)
+                mc[:k] = (self.means_ - shift).astype(self.dtype)
+                cov0 = np.asarray(self.covariances_, self.dtype)
+            prev = float(self.lower_bound_) if resume else -np.inf
+
+        self.checkpoint_segments_ = 0 if checkpoint_every else None
+        shift_dev = jnp.asarray(shift.astype(self.dtype))
+        tables = (jnp.asarray(mc), jnp.asarray(cov0), jnp.asarray(log_w0))
+        hist_parts = []
+        it_done = 0
+        converged = False
+        while True:
+            seg = (min(checkpoint_every, self.max_iter - it_done)
+                   if checkpoint_every else self.max_iter - it_done)
+            key = (mesh, chunk, k, seg, float(self.tol),
+                   float(self.reg_covar), ct, pipeline, "gmmfit")
+            fit_fn = _STEP_CACHE.get_or_create(key, lambda: builder(
+                mesh, chunk_size=chunk, k_real=k, max_iter=seg,
+                tol=float(self.tol), reg_covar=float(self.reg_covar),
+                pipeline=pipeline, **kwargs))
+            means_out, cov_out, log_w_out, it, hist, conv = fit_fn(
+                ds.points, ds.weights, shift_dev, *tables,
+                np.asarray(prev, acc))
+            n = int(it)
+            hist_np = np.asarray(hist, np.float64)[:n]
+            if n and not np.all(np.isfinite(hist_np)):
+                raise ValueError(
+                    f"non-finite log-likelihood at EM iteration "
+                    f"{it_done + n}")
+            hist_parts.append(hist_np)
+            it_done += n
+            converged = bool(conv)
+            if n:
+                # The NEXT segment's baseline must be the exact
+                # acc-dtype value the in-loop carry held — read it from
+                # the returned history, not the float64 attrs.
+                prev = float(np.asarray(hist)[n - 1])
+            if not checkpoint_every:
+                break
+            self.checkpoint_segments_ += 1
+            self._ingest_device_tables(means_out, cov_out, log_w_out,
+                                       shift)
+            self.converged_ = converged
+            self.n_iter_ = base_iter + it_done
+            if it_done:
+                self.lower_bound_ = float(hist_parts[-1][-1]) \
+                    if len(hist_parts[-1]) else self.lower_bound_
+            self._dev_tables = self._pack_dev_tables(
+                ct, means_out, cov_out, log_w_out, prev)
+            self._write_autockpt(checkpoint_path, base_iter + it_done)
+            if converged or it_done >= self.max_iter:
+                break
+            tables = (means_out, cov_out, log_w_out)   # no host cast
+
+        hist_all = (np.concatenate(hist_parts) if hist_parts
+                    else np.zeros(0))
+        n_total = it_done
+        self._ingest_device_tables(means_out, cov_out, log_w_out, shift)
+        self._dev_tables = self._pack_dev_tables(
+            ct, means_out, cov_out, log_w_out, prev)
+        self.converged_ = converged
+        self.n_iter_ = base_iter + n_total
+        self.lower_bound_ = float(hist_all[-1]) if n_total else -np.inf
         if self.verbose:
-            print(f"EM device loop: {n} iterations, mean log-likelihood = "
+            print(f"EM device loop: {n_total} iterations, "
+                  f"mean log-likelihood = "
                   f"{self.lower_bound_:.6f}", flush=True)
 
     # ------------------------------------------------------------ inference
@@ -1401,13 +1629,9 @@ class GaussianMixture:
 
     # ------------------------------------------------- checkpoint / pickle
 
-    def save(self, path) -> None:
-        """Checkpoint fitted state AND explicit init arrays (mirrors
-        ``KMeans.save`` — the reference has no serialization at all,
-        SURVEY.md §5).  Multi-host: call on EVERY process; the shared
-        primary-gated writer (``checkpoint.save_state_primary``) handles
-        the single-writer + barrier contract."""
-        from kmeans_tpu.utils import checkpoint as ckpt
+    def _state_dict(self) -> dict:
+        """Serializable state (shared by ``save`` and the rotating
+        auto-checkpoint writer)."""
         state = {
             "model_class": type(self).__name__,
             "n_components": self.n_components,
@@ -1444,7 +1668,57 @@ class GaussianMixture:
             val = getattr(self, name)
             if val is not None:
                 state[f"cfg_{name}"] = np.asarray(val)
-        ckpt.save_state_primary(path, state, "kmeans_tpu.gmm.save")
+        # Raw device-loop tables (see __init__): what makes a device-
+        # loop resume bit-exact — the centered-frame acc-dtype carry
+        # plus the in-dispatch convergence baseline.
+        raw = self._dev_tables
+        if raw is not None:
+            state["dev_means_c"] = np.asarray(raw["means_c"])
+            state["dev_cov"] = np.asarray(raw["cov"])
+            state["dev_log_w"] = np.asarray(raw["log_w"])
+            state["dev_prev_ll"] = float(raw["prev_ll"])
+            state["dev_cov_type"] = raw["cov_type"]
+        return state
+
+    def save(self, path) -> None:
+        """Checkpoint fitted state AND explicit init arrays (mirrors
+        ``KMeans.save`` — the reference has no serialization at all,
+        SURVEY.md §5).  Multi-host: call on EVERY process; the shared
+        primary-gated writer (``checkpoint.save_state_primary``) handles
+        the single-writer + barrier contract."""
+        from kmeans_tpu.utils import checkpoint as ckpt
+        ckpt.save_state_primary(path, self._state_dict(),
+                                "kmeans_tpu.gmm.save")
+
+    def _restore_state(self, state: dict) -> None:
+        """Restore fitted attributes from a ``_state_dict`` payload
+        (shared by ``load`` and path-``resume``)."""
+        if state["means_"].size:
+            self.weights_ = np.asarray(state["weights_"], np.float64)
+            self.means_ = np.asarray(state["means_"], np.float64)
+            self.covariances_ = np.asarray(state["covariances_"],
+                                           np.float64)
+            self.shift_ = np.asarray(state["shift_"], np.float64)
+            self.converged_ = bool(state["converged_"])
+            self.n_iter_ = int(state["n_iter_"])
+            self.lower_bound_ = float(state["lower_bound_"])
+            self.best_restart_ = int(state.get("best_restart_", 0))
+            rlb = state.get("restart_lower_bounds_")
+            self.restart_lower_bounds_ = (
+                np.asarray(rlb, np.float64)
+                if rlb is not None and rlb.size else None)
+        # Clear-then-restore: a stale in-memory carry from an earlier
+        # fit must never shadow the checkpoint.
+        self._dev_tables = None
+        if "dev_means_c" in state:
+            self._dev_tables = {
+                "cov_type": str(state.get("dev_cov_type",
+                                          self.covariance_type)),
+                "means_c": np.asarray(state["dev_means_c"]),
+                "cov": np.asarray(state["dev_cov"]),
+                "log_w": np.asarray(state["dev_log_w"]),
+                "prev_ll": float(state["dev_prev_ll"]),
+            }
 
     @classmethod
     def load(cls, path) -> "GaussianMixture":
@@ -1472,20 +1746,7 @@ class GaussianMixture:
                     pipeline=pipeline,
                     verbose=bool(state["verbose"]),
                     dtype=np.dtype(str(state["dtype"])), **inits)
-        if state["means_"].size:
-            model.weights_ = np.asarray(state["weights_"], np.float64)
-            model.means_ = np.asarray(state["means_"], np.float64)
-            model.covariances_ = np.asarray(state["covariances_"],
-                                            np.float64)
-            model.shift_ = np.asarray(state["shift_"], np.float64)
-            model.converged_ = bool(state["converged_"])
-            model.n_iter_ = int(state["n_iter_"])
-            model.lower_bound_ = float(state["lower_bound_"])
-            model.best_restart_ = int(state.get("best_restart_", 0))
-            rlb = state.get("restart_lower_bounds_")
-            model.restart_lower_bounds_ = (
-                np.asarray(rlb, np.float64)
-                if rlb is not None and rlb.size else None)
+        model._restore_state(state)
         return model
 
     def __getstate__(self) -> dict:

@@ -53,6 +53,7 @@ from kmeans_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh, mesh_shap
 from kmeans_tpu.parallel.sharding import (ShardedDataset, choose_chunk_size,
                                           to_device)
 from kmeans_tpu.models.init import resolve_init
+from kmeans_tpu.models.fault_tolerance import AutoCheckpointMixin
 from kmeans_tpu.utils.logging import IterationLogger
 from kmeans_tpu.utils.validation import check_finite_array, validate_params
 from kmeans_tpu.utils import checkpoint as ckpt
@@ -126,7 +127,7 @@ def _get_step_fns(mesh: Mesh, chunk_size: int, mode: str):
         ))
 
 
-class KMeans:
+class KMeans(AutoCheckpointMixin):
     """Distributed K-Means on a TPU mesh (scikit-learn-style API).
 
     Parameters (first five = the reference's full config surface,
@@ -277,6 +278,13 @@ class KMeans:
         self.centroids: Optional[np.ndarray] = None   # kmeans_spark.py:44
         self.loop_path_: Optional[str] = None         # 'host'|'device'|...
         self.auto_rtt_: Optional[float] = None        # measured by 'auto'
+        # Fault-tolerance observability (ISSUE 4): transient-IO retries
+        # consumed by the last fit's data path, streamed blocks
+        # quarantined by on_nonfinite='skip', and checkpoint segments
+        # executed under checkpoint_every=N.
+        self.io_retries_used_: int = 0
+        self.blocks_skipped_: int = 0
+        self.checkpoint_segments_: Optional[int] = None
         self.sse_history: List[float] = []            # kmeans_spark.py:45
         self.cluster_sizes_: Optional[np.ndarray] = None
         self.iter_times_: List[float] = []            # wall secs/iteration
@@ -384,8 +392,9 @@ class KMeans:
 
     # ------------------------------------------------------------------- fit
 
-    def fit(self, X, y=None, *, sample_weight=None, resume: bool = False,
-            profile_dir: Optional[str] = None) -> "KMeans":
+    def fit(self, X, y=None, *, sample_weight=None, resume=False,
+            profile_dir: Optional[str] = None, checkpoint_every: int = 0,
+            checkpoint_path=None) -> "KMeans":
         """Fit on (n, D) array-like or a cached ShardedDataset.
         Returns self (kmeans_spark.py:239-319).  ``y`` is ignored
         (sklearn estimator-protocol compatibility).
@@ -394,15 +403,35 @@ class KMeans:
         sklearn-style, beyond the reference.  ``resume=True`` continues from
         the current ``centroids`` / ``iterations_run`` (e.g. after
         ``KMeans.load``) instead of re-initializing — a capability the
-        reference lacks (no checkpointing, SURVEY.md §5).
+        reference lacks (no checkpointing, SURVEY.md §5).  ``resume`` may
+        also be a checkpoint PATH: the fitted state is loaded from it
+        first — falling back to the last-good ``<path>.prev`` rotation
+        (with a warning) when the file is torn/corrupt — and the fit
+        continues from there.
         ``profile_dir`` captures a ``jax.profiler`` device trace of the fit
         (the reference's only instrumentation is wall-clock pairs,
         SURVEY.md §5); per-iteration wall times land in ``iter_times_``
         either way.
+
+        ``checkpoint_every=N`` (with ``checkpoint_path``) auto-checkpoints
+        the fit every N iterations with an atomic, rotating write
+        (``utils.checkpoint.save_state_rotating``): the one-dispatch
+        device loop becomes SEGMENTED — ceil(max_iter/N) dispatches with
+        a checkpoint between segments — and the host loop checkpoints in
+        place.  ``checkpoint_every=0`` (default) is bit-identical to the
+        unsegmented fit (the parity oracle pinned by
+        ``tests/test_faults.py``), and a kill+``fit(resume=path)`` resume
+        at any boundary reproduces the uninterrupted trajectory
+        bit-exactly.  Requires ``n_init=1`` (a restart sweep
+        re-initializes; a partial sweep has no well-defined resume).
+        Observability: ``checkpoint_segments_``.
         """
         from kmeans_tpu.utils import profiling
+        resume = self._resolve_resume(resume)
         with profiling.trace(profile_dir):
-            self._fit(X, sample_weight=sample_weight, resume=resume)
+            self._fit(X, sample_weight=sample_weight, resume=resume,
+                      checkpoint_every=checkpoint_every,
+                      checkpoint_path=checkpoint_path)
         # Materialize labels_ eagerly (sklearn semantics) — one extra fused
         # assignment pass, after which the device-resident dataset reference
         # is released so fit() never leaves HBM pinned.  Skipped when
@@ -609,13 +638,18 @@ class KMeans:
                 f"(lets 'auto' switch itself) to reclaim it")
         return True
 
-    def _fit(self, X, *, sample_weight, resume) -> "KMeans":
+    def _fit(self, X, *, sample_weight, resume, checkpoint_every: int = 0,
+             checkpoint_path=None) -> "KMeans":
         # Multi-host: only process 0 narrates (every host computes the same
         # replicated statistics, so logs would be identical k-fold spam).
+        checkpoint_every = self._check_ckpt(checkpoint_every,
+                                            checkpoint_path)
         log = IterationLogger(self.verbose and jax.process_index() == 0)
         X = self._apply_sample_weight(X, sample_weight)
         ds, mesh, model_shards, step_fn, _ = self._prepare(X)
         self._set_fit_data(ds)                        # feeds lazy labels_
+        self.io_retries_used_ = getattr(
+            getattr(ds, "io_stats", None), "retries_used", 0)
         log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
         self.best_restart_ = 0
         self.restart_inertias_ = None
@@ -624,7 +658,8 @@ class KMeans:
             centroids = np.asarray(self.centroids, dtype=self.dtype)
             return self._run_restart(ds, mesh, model_shards, step_fn,
                                      centroids, self.iterations_run,
-                                     self.seed, log)
+                                     self.seed, log,
+                                     checkpoint_every, checkpoint_path)
 
         seeds = self._restart_seeds()
 
@@ -642,7 +677,8 @@ class KMeans:
             self.iterations_run = 0
             self.iter_times_ = []
             self._run_restart(ds, mesh, model_shards, step_fn, centroids,
-                              0, seed, log)
+                              0, seed, log, checkpoint_every,
+                              checkpoint_path)
             if len(seeds) == 1:
                 return self
             inertia = self._final_inertia(ds, mesh, model_shards, step_fn)
@@ -665,7 +701,10 @@ class KMeans:
         return self
 
     def fit_stream(self, make_blocks, *, d: Optional[int] = None,
-                   resume: bool = False, prefetch: int = 2) -> "KMeans":
+                   resume=False, prefetch: int = 2,
+                   checkpoint_every: int = 0, checkpoint_path=None,
+                   io_retries: int = 0, io_backoff: float = 0.05,
+                   on_nonfinite: str = "error") -> "KMeans":
         """EXACT full-batch Lloyd over data larger than device memory.
 
         ``make_blocks()`` returns a fresh iterable of (n_i, D) host blocks;
@@ -741,7 +780,22 @@ class KMeans:
         way (only where the work happens moves, never its order —
         pinned by tests/test_prefetch.py).  Device residency grows from
         1 to at most ``prefetch + 2`` blocks.
+
+        Fault tolerance (ISSUE 4): ``checkpoint_every=N`` (+
+        ``checkpoint_path``) writes a rotating atomic checkpoint every N
+        epochs (single-restart only), and ``resume`` may be a checkpoint
+        path (``.prev`` corrupt fallback included).  ``io_retries``/
+        ``io_backoff`` retry transient (``OSError``) block reads with a
+        deterministic exponential backoff by re-invoking ``make_blocks``
+        and fast-forwarding — the FRESH-iterable contract the streamed
+        fit already requires — so a recovered epoch is bit-identical.
+        ``on_nonfinite='error'`` (default) raises naming the first
+        non-finite streamed block; ``'skip'`` quarantines bad blocks
+        (every pass sees the same cleaned stream, so the statistics stay
+        consistent).  Observability: ``io_retries_used_``,
+        ``blocks_skipped_``, ``checkpoint_segments_``.
         """
+        from kmeans_tpu.data.io import IOStats, resilient_blocks
         from kmeans_tpu.data.prefetch import (check_prefetch, close_source,
                                               prefetch_iter)
         from kmeans_tpu.parallel.sharding import shard_points
@@ -749,6 +803,14 @@ class KMeans:
                                             _split_block,
                                             streamed_init_sample)
         prefetch = check_prefetch(prefetch)
+        checkpoint_every = self._check_ckpt(checkpoint_every,
+                                            checkpoint_path)
+        resume = self._resolve_resume(resume)
+        io_stats = IOStats()
+        make_blocks = resilient_blocks(
+            make_blocks, io_retries=io_retries, io_backoff=io_backoff,
+            on_nonfinite=on_nonfinite, stats=io_stats)
+        self.checkpoint_segments_ = 0 if checkpoint_every else None
         log = IterationLogger(self.verbose and jax.process_index() == 0)
         muted = IterationLogger(False)
         log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
@@ -963,6 +1025,14 @@ class KMeans:
                     st_r.done = True
                     if st_r is states[0]:
                         log.converged(iteration + 1)
+            # Epoch-boundary rotating checkpoint (single-restart only,
+            # enforced by _check_ckpt): the estimator attrs already
+            # reflect this epoch's finish, and resume at any boundary is
+            # bit-exact (empty-cluster reservoirs are re-seeded per
+            # ABSOLUTE epoch index, never carried across epochs).
+            if checkpoint_every and (iteration + 1) % checkpoint_every == 0:
+                self.checkpoint_segments_ += 1
+                self._write_autockpt(checkpoint_path, iteration + 1)
 
         # ---- winner selection (true final inertia, one scoring epoch)
         if R > 1:
@@ -986,6 +1056,11 @@ class KMeans:
         self.iter_times_ = winner.iter_times
         self.iterations_run = winner.iters
         self.cluster_sizes_ = winner.sizes
+        self.io_retries_used_ = io_stats.retries_used
+        self.blocks_skipped_ = io_stats.blocks_skipped
+        if checkpoint_every and self.iterations_run % checkpoint_every:
+            self.checkpoint_segments_ += 1
+            self._write_autockpt(checkpoint_path, self.iterations_run)
         self._fit_ds, self._labels_cache = None, None
         self._labels_error = ("labels_ is not materialized by fit_stream "
                               "(the dataset never resides in memory); call "
@@ -993,15 +1068,22 @@ class KMeans:
         return self
 
     def _run_restart(self, ds, mesh, model_shards, step_fn, centroids,
-                     start_iter, seed, log) -> "KMeans":
+                     start_iter, seed, log, checkpoint_every: int = 0,
+                     checkpoint_path=None) -> "KMeans":
         """One restart: the reference's full fit loop (kmeans_spark.py:
         239-319), host- or device-side per ``host_loop`` (with 'auto'
-        resolved against this platform's measured dispatch latency)."""
+        resolved against this platform's measured dispatch latency).
+        ``checkpoint_every=N`` writes a rotating atomic checkpoint every
+        N completed iterations (host loop: in place; device loop: the
+        fit becomes segmented, see ``_fit_on_device``)."""
         if not self._resolve_host_loop(ds, mesh, model_shards, step_fn):
             return self._fit_on_device(ds, centroids, start_iter, mesh,
-                                       model_shards, log, seed)
+                                       model_shards, log, seed,
+                                       checkpoint_every, checkpoint_path)
 
         self.loop_path_ = "host"
+        # None (not a stale count) when this fit writes no checkpoints.
+        self.checkpoint_segments_ = 0 if checkpoint_every else None
         cents_dev = self._put_centroids(centroids, mesh, model_shards)
         for iteration in range(start_iter, self.max_iter):
             iter_start = time.perf_counter()
@@ -1014,10 +1096,21 @@ class KMeans:
                 centroids, sums, counts,
                 float(stats.sse) if self.compute_sse else 0.0, stats, ds,
                 iteration, log, seed, iter_start)
+            # The cadence is ABSOLUTE in the iteration index (like the
+            # mini-batch reassignment cadence), so a resumed fit keeps
+            # the uninterrupted run's checkpoint schedule.
+            if checkpoint_every and (iteration + 1) % checkpoint_every == 0:
+                self.checkpoint_segments_ += 1
+                self._write_autockpt(checkpoint_path, iteration + 1)
             if max_shift < self.tolerance:           # kmeans_spark.py:310-313
                 log.converged(iteration + 1)
                 break
             cents_dev = self._put_centroids(centroids, mesh, model_shards)
+        if checkpoint_every and self.iterations_run % checkpoint_every:
+            # Off-cadence tail (convergence or max_iter between
+            # boundaries): the final state is still durably on disk.
+            self.checkpoint_segments_ += 1
+            self._write_autockpt(checkpoint_path, self.iterations_run)
         return self
 
     def _finish_lloyd_iteration(self, centroids, sums, counts, sse_val,
@@ -1071,37 +1164,89 @@ class KMeans:
         return new_centroids, max_shift
 
     def _fit_on_device(self, ds, centroids, start_iter, mesh, model_shards,
-                       log, seed=None) -> "KMeans":
+                       log, seed=None, checkpoint_every: int = 0,
+                       checkpoint_path=None) -> "KMeans":
         """Whole-fit-in-one-dispatch path (``host_loop=False``): every
         iteration runs inside a device-side ``lax.while_loop`` — no
         per-iteration host synchronization.  See
-        parallel.distributed.make_fit_fn for semantics and trade-offs."""
+        parallel.distributed.make_fit_fn for semantics and trade-offs.
+
+        ``checkpoint_every=N`` SEGMENTS the dispatch: ceil(iters/N)
+        device loops of (up to) N iterations each, with a rotating
+        atomic checkpoint — and the fault-injection boundary hook —
+        between segments.  The hand-off re-puts the boundary centroids
+        through exactly the ``_put_centroids`` path a resumed fit uses,
+        so kill-at-any-boundary + resume is bit-identical to running
+        through, and (since the loop's accumulation dtype equals the
+        compute dtype for f32/f64) the segmented trajectory is
+        bit-identical to the ``checkpoint_every=0`` single dispatch —
+        the parity oracle pinned by tests/test_faults.py.  Per-iteration
+        seed schedules are ABSOLUTE (``_empty_seed_array(seed, it0,
+        seg)``), so segment boundaries never re-draw."""
         seed = self.seed if seed is None else seed
-        iters_left = self.max_iter - start_iter
         mode = self._mode(ds.n, ds.d)
-        # Seeds travel as a traced ARGUMENT (not a baked constant), so
-        # fits differing only by seed/start_iter — restarts, bisecting
-        # splits, resumes — reuse one compiled program.
         chunk = self._eff_chunk(ds)
-        key = (mesh, chunk, mode, self.k, iters_left,
-               float(self.tolerance), self.empty_cluster, self.compute_sse,
-               self._device_project, "fit")
-        fit_fn = _STEP_CACHE.get_or_create(key, lambda: dist.make_fit_fn(
-            mesh, chunk_size=chunk, mode=mode,
-            k_real=self.k, max_iter=iters_left,
-            tolerance=float(self.tolerance),
-            empty_policy=self.empty_cluster,
-            history_sse=self.compute_sse,
-            project=self._device_project))
         self.loop_path_ = "device"
+        self.checkpoint_segments_ = 0 if checkpoint_every else None
+        base_hist = list(self.sse_history)
         cents_dev = self._put_centroids(centroids, mesh, model_shards)
+        sse_parts, shift_parts = [], []
+        it0 = start_iter
         fit_start = time.perf_counter()
-        cents, n_iters, sse_hist, shift_hist, counts = fit_fn(
-            ds.points, ds.weights, cents_dev,
-            dist._empty_seed_array(seed, start_iter, iters_left))
-        self._finish_device_fit(cents, int(n_iters), start_iter, sse_hist,
-                                shift_hist, counts,
-                                time.perf_counter() - fit_start, log)
+        while True:
+            seg = (min(checkpoint_every, self.max_iter - it0)
+                   if checkpoint_every else self.max_iter - it0)
+            seg = max(seg, 0)
+            # Seeds travel as a traced ARGUMENT (not a baked constant),
+            # so fits differing only by seed/start_iter — restarts,
+            # bisecting splits, resumes, later segments — reuse one
+            # compiled program per segment length.
+            key = (mesh, chunk, mode, self.k, seg,
+                   float(self.tolerance), self.empty_cluster,
+                   self.compute_sse, self._device_project, "fit")
+            fit_fn = _STEP_CACHE.get_or_create(
+                key, lambda: dist.make_fit_fn(
+                    mesh, chunk_size=chunk, mode=mode,
+                    k_real=self.k, max_iter=seg,
+                    tolerance=float(self.tolerance),
+                    empty_policy=self.empty_cluster,
+                    history_sse=self.compute_sse,
+                    project=self._device_project))
+            cents, n_iters, sse_hist, shift_hist, counts = fit_fn(
+                ds.points, ds.weights, cents_dev,
+                dist._empty_seed_array(seed, it0, seg))
+            n = int(n_iters)
+            it0 += n
+            sse_parts.append(np.asarray(sse_hist, np.float64)[:n])
+            shift_parts.append(np.asarray(shift_hist, np.float64)[:n])
+            if not checkpoint_every:
+                break
+            self.checkpoint_segments_ += 1
+            converged = n < seg or (n > 0 and
+                                    shift_parts[-1][-1] < self.tolerance)
+            cents_host = np.asarray(cents, dtype=self.dtype)
+            if not np.all(np.isfinite(cents_host)):  # don't checkpoint NaN
+                raise ValueError(
+                    f"NaN or Inf detected in centroids at iteration "
+                    f"{it0}")
+            # Publish the boundary state so the checkpoint is a valid
+            # resume point, then write + fire the injection hook.
+            self.centroids = cents_host
+            self.cluster_sizes_ = np.asarray(counts, dtype=np.int64)
+            self.iterations_run = it0
+            if self.compute_sse:
+                self.sse_history = base_hist + [
+                    float(s) for part in sse_parts for s in part]
+            self._write_autockpt(checkpoint_path, it0)
+            if converged or it0 >= self.max_iter:
+                break
+            cents_dev = self._put_centroids(cents_host, mesh, model_shards)
+        self.sse_history = base_hist
+        self._finish_device_fit(
+            cents, it0 - start_iter, start_iter,
+            np.concatenate(sse_parts) if sse_parts else np.zeros(0),
+            np.concatenate(shift_parts) if shift_parts else np.zeros(0),
+            counts, time.perf_counter() - fit_start, log)
         return self
 
     def _finish_device_fit(self, cents, n_iters: int, start_iter: int,

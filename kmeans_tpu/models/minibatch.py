@@ -102,21 +102,37 @@ class MiniBatchKMeans(KMeans):
 
     # ------------------------------------------------------------------- fit
 
-    def fit(self, X, y=None, *, sample_weight=None,
-            resume: bool = False) -> "MiniBatchKMeans":
+    def fit(self, X, y=None, *, sample_weight=None, resume=False,
+            checkpoint_every: int = 0,
+            checkpoint_path=None) -> "MiniBatchKMeans":
         """Fit with mini-batch Sculley updates.  ``sample_weight``
         follows sklearn's MiniBatch semantics: rows are SAMPLED
         uniformly and the weights scale every batch statistic (sums,
         counts, lifetime ``seen``) — exactly what sklearn's
-        ``MiniBatchKMeans.fit(X, sample_weight=...)`` does."""
+        ``MiniBatchKMeans.fit(X, sample_weight=...)`` does.
+
+        ``resume`` may be a checkpoint path (``.prev`` corrupt fallback
+        included), and ``checkpoint_every=N`` auto-checkpoints every N
+        iterations with the rotating atomic writer — the one-dispatch
+        device loop becomes segmented exactly like ``KMeans.fit``'s
+        (both engines key iteration i's randomness off the ABSOLUTE
+        ``(seed, i)``, so boundaries never re-draw and resume is
+        bit-exact)."""
+        checkpoint_every = self._check_ckpt(checkpoint_every,
+                                            checkpoint_path)
+        resume = self._resolve_resume(resume)
         if self.sampling == "host":
             # The host engine exists for X bigger than device memory:
             # weights stay on the host (routing through cache() would
             # upload the whole dataset, review r4).
             return self._fit_host(X, sample_weight=sample_weight,
-                                  resume=resume)
+                                  resume=resume,
+                                  checkpoint_every=checkpoint_every,
+                                  checkpoint_path=checkpoint_path)
         X = self._apply_sample_weight(X, sample_weight)
-        self._fit_device(X, resume=resume)
+        self._fit_device(X, resume=resume,
+                         checkpoint_every=checkpoint_every,
+                         checkpoint_path=checkpoint_path)
         # Multi-host process-local fits materialize labels_ HERE, while
         # every process is still executing fit: deferring the global
         # assignment dispatch to a later labels_ read or pickle on ONE
@@ -131,10 +147,21 @@ class MiniBatchKMeans(KMeans):
         return self
 
     def _resume_or_init(self, init_src, resume: bool):
-        """Shared fit prelude: (centroids float64, start_iter, seen)."""
+        """Shared fit prelude: (centroids float64, start_iter, seen).
+
+        Resume prefers the ``_centroids_f64`` carry over the public
+        ``centroids`` attr: the per-iteration Sculley engines interpolate
+        in float64 and only CAST to the model dtype for publication, so
+        resuming from the cast copy would lose the carry's low bits and
+        break bit-exact kill/resume parity for float32 models (ISSUE 4;
+        the one-dispatch device loop carries the compute dtype, for
+        which the f64 round trip is exact either way)."""
         if resume and self.centroids is not None:
-            return (np.asarray(self.centroids, dtype=np.float64),
-                    self.iterations_run,
+            carried = getattr(self, "_centroids_f64", None)
+            cents = (np.asarray(carried, dtype=np.float64)
+                     if carried is not None
+                     else np.asarray(self.centroids, dtype=np.float64))
+            return (cents, self.iterations_run,
                     np.asarray(self._seen, dtype=np.float64))
         centroids = self._select_init(init_src).astype(np.float64)
         self.sse_history = []
@@ -248,7 +275,8 @@ class MiniBatchKMeans(KMeans):
                 f"reclaim it")
         return True
 
-    def _fit_device(self, X, *, resume: bool) -> "MiniBatchKMeans":
+    def _fit_device(self, X, *, resume: bool, checkpoint_every: int = 0,
+                    checkpoint_path=None) -> "MiniBatchKMeans":
         """On-device sampling engine: resident dataset, one dispatch per
         iteration (sampling + batch statistics fused)."""
         import jax
@@ -281,7 +309,9 @@ class MiniBatchKMeans(KMeans):
         if not self._resolve_host_loop_mb(mesh):
             return self._fit_device_loop(ds, mesh, model_shards, bs_local,
                                          centroids, start_iter, seen,
-                                         base_key, log)
+                                         base_key, log, checkpoint_every,
+                                         checkpoint_path)
+        self.checkpoint_segments_ = 0 if checkpoint_every else None
 
         # auto resolves against the BATCH row count — that's what the
         # kernel would process per pass.
@@ -338,73 +368,131 @@ class MiniBatchKMeans(KMeans):
                 candidates=cand_rows, cand_valid=cand_valid,
                 do_reassign=do_re)
             self.iter_times_.append(time.perf_counter() - t0)
+            if checkpoint_every and (iteration + 1) % checkpoint_every == 0:
+                self.checkpoint_segments_ += 1
+                self._write_autockpt(checkpoint_path, iteration + 1)
             if max_shift < self.tolerance:
                 log.converged(iteration + 1)
                 break
+        if checkpoint_every and self.iterations_run % checkpoint_every:
+            self.checkpoint_segments_ += 1
+            self._write_autockpt(checkpoint_path, self.iterations_run)
         return self
 
     def _fit_device_loop(self, ds, mesh, model_shards, bs_local, centroids,
-                         start_iter, seen, base_key,
-                         log) -> "MiniBatchKMeans":
+                         start_iter, seen, base_key, log,
+                         checkpoint_every: int = 0,
+                         checkpoint_path=None) -> "MiniBatchKMeans":
         """Whole-mini-batch-fit-in-one-dispatch (``host_loop=False``): no
         per-iteration host sync at all — on tunneled chips the per-
         iteration path is dispatch-bound (~5 round trips/iter vs sub-ms
         batch compute).  Same key schedule as the per-iteration path, so
-        the two produce the same batch sequence."""
+        the two produce the same batch sequence.
+
+        ``checkpoint_every=N`` segments the dispatch exactly like
+        ``KMeans._fit_on_device``: the loop keys every batch draw and
+        the reassignment cadence off the ABSOLUTE iteration
+        (``iter0 + i``), and the carried (centroids, seen) state round-
+        trips the boundary through the same dtype casts a resumed fit
+        applies — so segmented == single-dispatch bit-exactly (f32/f64)
+        and kill+resume == uninterrupted."""
         import jax
         from kmeans_tpu.parallel import distributed as dist
 
-        iters_left = self.max_iter - start_iter
-        if iters_left <= 0:
+        if self.max_iter - start_iter <= 0:
             return self
         mode = self._mode(bs_local, ds.d)
         from kmeans_tpu.parallel.mesh import mesh_shape
         data_shards, _ = mesh_shape(mesh)
         re_every = self._reassign_every(bs_local * data_shards)
-        cache_key = (mesh, bs_local, mode, self.k, iters_left,
-                     float(self.tolerance), self.compute_sse,
-                     float(self.reassignment_ratio), re_every, "mbfit")
-        fit_fn = _STEP_CACHE.get_or_create(
-            cache_key, lambda: dist.make_minibatch_fit_fn(
-                mesh, batch_per_shard=bs_local, mode=mode,
-                k_real=self.k, max_iter=iters_left,
-                tolerance=float(self.tolerance),
-                history_sse=self.compute_sse,
-                reassignment_ratio=float(self.reassignment_ratio),
-                reassign_every=re_every))
+        self.checkpoint_segments_ = 0 if checkpoint_every else None
+        base_hist = list(self.sse_history)
         cents_dev = self._put_centroids(centroids.astype(self.dtype), mesh,
                                         model_shards)
+        seen_arr = np.asarray(seen, dtype=self.dtype)
+        sse_parts, shift_parts = [], []
+        it0 = start_iter
         t0 = time.perf_counter()
-        cents, seen_out, n_iters, sse_hist, shift_hist, counts = fit_fn(
-            ds.points, ds.weights, cents_dev, base_key,
-            np.int32(start_iter), np.asarray(seen, dtype=self.dtype))
-        n_iters = int(n_iters)
+        while True:
+            seg = (min(checkpoint_every, self.max_iter - it0)
+                   if checkpoint_every else self.max_iter - it0)
+            cache_key = (mesh, bs_local, mode, self.k, seg,
+                         float(self.tolerance), self.compute_sse,
+                         float(self.reassignment_ratio), re_every, "mbfit")
+            fit_fn = _STEP_CACHE.get_or_create(
+                cache_key, lambda: dist.make_minibatch_fit_fn(
+                    mesh, batch_per_shard=bs_local, mode=mode,
+                    k_real=self.k, max_iter=seg,
+                    tolerance=float(self.tolerance),
+                    history_sse=self.compute_sse,
+                    reassignment_ratio=float(self.reassignment_ratio),
+                    reassign_every=re_every))
+            cents, seen_out, n_iters, sse_hist, shift_hist, counts = \
+                fit_fn(ds.points, ds.weights, cents_dev, base_key,
+                       np.int32(it0), seen_arr)
+            n = int(n_iters)
+            it0 += n
+            sse_parts.append(np.asarray(sse_hist, np.float64)[:n])
+            shift_parts.append(np.asarray(shift_hist, np.float64)[:n])
+            if not checkpoint_every:
+                break
+            self.checkpoint_segments_ += 1
+            converged = n < seg or (n > 0 and
+                                    shift_parts[-1][-1] < self.tolerance)
+            cents_host = np.asarray(cents, dtype=self.dtype)
+            if not np.all(np.isfinite(cents_host)):  # don't checkpoint NaN
+                raise ValueError(
+                    f"NaN or Inf detected in centroids at iteration "
+                    f"{it0}")
+            # Boundary state -> valid resume point, then write + hook.
+            self.centroids = cents_host
+            self._centroids_f64 = np.asarray(cents_host, dtype=np.float64)
+            self._seen = np.asarray(seen_out, dtype=np.float64)
+            self.cluster_sizes_ = np.asarray(counts, dtype=np.int64)
+            self.iterations_run = it0
+            if self.compute_sse:
+                self.sse_history = base_hist + [
+                    float(s) for part in sse_parts for s in part]
+            self._write_autockpt(checkpoint_path, it0)
+            if converged or it0 >= self.max_iter:
+                break
+            cents_dev = self._put_centroids(cents_host, mesh, model_shards)
+            seen_arr = np.asarray(self._seen, dtype=self.dtype)
         elapsed = time.perf_counter() - t0
+        n_total = it0 - start_iter
+        self.sse_history = base_hist
 
         self.centroids = np.asarray(cents, dtype=self.dtype)
         if not np.all(np.isfinite(self.centroids)):
             raise ValueError(
                 f"NaN or Inf detected in centroids at iteration "
-                f"{start_iter + n_iters}")
+                f"{start_iter + n_total}")
+        # The device loop's carry IS the compute dtype — publish its
+        # exact f64 image so a later resume (which round-trips through
+        # _centroids_f64) continues bit-identically.
+        self._centroids_f64 = np.asarray(self.centroids, dtype=np.float64)
         self._seen = np.asarray(seen_out, dtype=np.float64)
         self.cluster_sizes_ = np.asarray(counts, dtype=np.int64)
-        self.iterations_run = start_iter + n_iters
-        self.iter_times_.extend([elapsed / max(n_iters, 1)] * n_iters)
-        sse_hist = np.asarray(sse_hist, dtype=np.float64)[:n_iters]
-        shift_hist = np.asarray(shift_hist, dtype=np.float64)[:n_iters]
+        self.iterations_run = start_iter + n_total
+        self.iter_times_.extend([elapsed / max(n_total, 1)] * n_total)
+        sse_hist = (np.concatenate(sse_parts) if sse_parts
+                    else np.zeros(0))
+        shift_hist = (np.concatenate(shift_parts) if shift_parts
+                      else np.zeros(0))
         if self.compute_sse:
             self.sse_history.extend(float(s) for s in sse_hist)
         log.iteration(self.iterations_run - 1,
-                      float(shift_hist[-1]) if n_iters else 0.0,
+                      float(shift_hist[-1]) if n_total else 0.0,
                       list(self.cluster_sizes_),
                       self.sse_history[-1] if
                       (self.compute_sse and self.sse_history) else None)
-        if n_iters and shift_hist[-1] < self.tolerance:
+        if n_total and shift_hist[-1] < self.tolerance:
             log.converged(self.iterations_run)
         return self
 
     def _fit_host(self, X, y=None, *, sample_weight=None,
-                  resume: bool = False) -> "MiniBatchKMeans":
+                  resume: bool = False, checkpoint_every: int = 0,
+                  checkpoint_path=None) -> "MiniBatchKMeans":
         """Host sampling engine (the r1 path): per-iteration host
         ``rng.choice`` + batch upload.  Use when X exceeds device
         memory — weights are validated and kept on the host (no full
@@ -441,6 +529,7 @@ class MiniBatchKMeans(KMeans):
         centroids, start_iter, seen = self._resume_or_init(
             as_source(X, hw), resume)
         log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
+        self.checkpoint_segments_ = 0 if checkpoint_every else None
 
         for iteration in range(start_iter, self.max_iter):
             # Per-iteration derived RNG: batch i is a pure function of
@@ -454,9 +543,15 @@ class MiniBatchKMeans(KMeans):
                 X[idx], centroids, seen, iteration, log,
                 batch_weight=hw[idx] if hw is not None else None,
                 total_w=total_w)
+            if checkpoint_every and (iteration + 1) % checkpoint_every == 0:
+                self.checkpoint_segments_ += 1
+                self._write_autockpt(checkpoint_path, iteration + 1)
             if max_shift < self.tolerance:
                 log.converged(iteration + 1)
                 break
+        if checkpoint_every and self.iterations_run % checkpoint_every:
+            self.checkpoint_segments_ += 1
+            self._write_autockpt(checkpoint_path, self.iterations_run)
         # labels_ stays LAZY here (first access runs one full-X pass):
         # mini-batch training deliberately avoids full-N passes, and
         # _fit_ds is the host array — no device memory is pinned.
@@ -561,6 +656,7 @@ class MiniBatchKMeans(KMeans):
                       (self.compute_sse and self.sse_history) else None)
 
         self.centroids = new_centroids.astype(self.dtype)
+        self._centroids_f64 = np.asarray(new_centroids, dtype=np.float64)
         self.cluster_sizes_ = counts.astype(np.int64)
         self.iterations_run = iteration + 1
         self._seen = seen.copy()
@@ -601,7 +697,7 @@ class MiniBatchKMeans(KMeans):
         return self
 
     def fit_stream(self, make_blocks, *, d=None, resume=False,
-                   prefetch=2):
+                   prefetch=2, **kwargs):
         """Blocked: the inherited exact-Lloyd ``fit_stream`` would silently
         bypass mini-batch semantics (ADVICE r1).  For streaming, feed blocks
         through ``partial_fit``; for an exact bigger-than-memory fit, use
@@ -619,11 +715,22 @@ class MiniBatchKMeans(KMeans):
         state["reassignment_ratio"] = self.reassignment_ratio
         state["seen_counts"] = np.asarray(getattr(self, "_seen",
                                                   np.zeros(self.k)))
+        carried = getattr(self, "_centroids_f64", None)
+        if carried is not None:
+            # The float64 Sculley carry (see _resume_or_init) — without
+            # it a resumed float32 model restarts from the cast copy and
+            # drifts off the uninterrupted trajectory by the cast error.
+            state["centroids_f64"] = np.asarray(carried, np.float64)
         return state
 
     def _restore_state(self, state: dict) -> None:
         super()._restore_state(state)
         self._seen = np.asarray(state["seen_counts"])
+        carried = state.get("centroids_f64")
+        # Explicitly clear on pre-carry checkpoints: a stale in-memory
+        # carry from an earlier fit must not shadow the restored state.
+        self._centroids_f64 = (np.asarray(carried, np.float64)
+                               if carried is not None else None)
 
     @classmethod
     def _load_kwargs(cls, state: dict) -> dict:

@@ -139,10 +139,21 @@ class SphericalKMeans(KMeans):
                         np.asarray(item, np.float64)).astype(self.dtype)
         return wrapped
 
-    def fit_stream(self, make_blocks, *, d=None, resume: bool = False,
-                   prefetch: int = 2) -> "SphericalKMeans":
+    def fit_stream(self, make_blocks, *, d=None, resume=False,
+                   prefetch: int = 2, checkpoint_every: int = 0,
+                   checkpoint_path=None, io_retries: int = 0,
+                   io_backoff: float = 0.05,
+                   on_nonfinite: str = "error") -> "SphericalKMeans":
+        # The fault-tolerance knobs wrap OUTSIDE the normalization (base
+        # class order), so retry replays re-normalize deterministically
+        # and the non-finite scan sees what the fit would consume.
         return super().fit_stream(self._normalized_blocks(make_blocks),
-                                  d=d, resume=resume, prefetch=prefetch)
+                                  d=d, resume=resume, prefetch=prefetch,
+                                  checkpoint_every=checkpoint_every,
+                                  checkpoint_path=checkpoint_path,
+                                  io_retries=io_retries,
+                                  io_backoff=io_backoff,
+                                  on_nonfinite=on_nonfinite)
 
     def _iter_stream_blocks(self, make_blocks, *, with_weights: bool,
                             prefetch: int = 0, stage_extra=None):

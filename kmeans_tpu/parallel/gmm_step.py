@@ -769,14 +769,16 @@ def make_gmm_fit_full_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
     non-finite error (the device loop cannot raise sklearn's pointed
     ill-defined-covariance message; the float64 host loop can).
 
-    Returns ``fit(points, weights, shift, means0_c, cov0, log_w0) ->
-    (means_c, cov, log_w, n_iter, ll_hist[max_iter], converged)``,
-    everything replicated, tables (k_pad, ...) with padding components
-    carried as ``log_w = -inf``.
+    Returns ``fit(points, weights, shift, means0_c, cov0, log_w0,
+    prev0) -> (means_c, cov, log_w, n_iter, ll_hist[max_iter],
+    converged)``, everything replicated, tables (k_pad, ...) with
+    padding components carried as ``log_w = -inf``.  ``prev0`` seeds
+    the convergence baseline (``-inf`` fresh; segmented/resumed fits
+    pass the last iteration's mean loglik — see ``make_gmm_fit_fn``).
     """
     data_shards, model_shards = mesh_shape(mesh)
 
-    def fit(points, weights, shift, means0, cov0, log_w0):
+    def fit(points, weights, shift, means0, cov0, log_w0, prev0):
         k_pad, d = means0.shape
         k_local = k_pad // model_shards
         acc = points.dtype
@@ -833,7 +835,7 @@ def make_gmm_fit_full_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
         eye = jnp.broadcast_to(jnp.eye(d, dtype=acc), cov0.shape)
         cov_start = jnp.where(real[:, None, None], cov0.astype(acc), eye)
         state = (jnp.int32(0), means0.astype(acc), cov_start,
-                 log_w0.astype(acc), jnp.asarray(-jnp.inf, acc),
+                 log_w0.astype(acc), jnp.asarray(prev0).astype(acc),
                  jnp.zeros((max_iter,), acc), jnp.asarray(False))
         it, means_c, cov, log_w, _, hist, conv = lax.while_loop(
             cond, body, state)
@@ -842,7 +844,7 @@ def make_gmm_fit_full_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
     mapped = shard_map(
         fit, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None),
-                  P(None, None), P(None, None, None), P(None)),
+                  P(None, None), P(None, None, None), P(None), P()),
         out_specs=(P(None, None), P(None, None, None), P(None), P(),
                    P(), P()),
         check_vma=False)
@@ -857,11 +859,14 @@ def make_gmm_fit_tied_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
     the single shared (D, D) covariance, transforms the means, runs the
     tied E pass, and M-steps via ``(T - sum_k R_k mu_k mu_k^T)/W``.
 
-    Returns ``fit(points, weights, shift, means0_c, cov0, log_w0) ->
-    (means_c, cov (D, D), log_w, n_iter, ll_hist, converged)``."""
+    Returns ``fit(points, weights, shift, means0_c, cov0, log_w0,
+    prev0) -> (means_c, cov (D, D), log_w, n_iter, ll_hist,
+    converged)``.  ``prev0`` seeds the convergence baseline (``-inf``
+    fresh; segmented/resumed fits pass the last iteration's mean
+    loglik — see ``make_gmm_fit_fn``)."""
     data_shards, model_shards = mesh_shape(mesh)
 
-    def fit(points, weights, shift, means0, cov0, log_w0):
+    def fit(points, weights, shift, means0, cov0, log_w0, prev0):
         k_pad, d = means0.shape
         k_local = k_pad // model_shards
         acc = points.dtype
@@ -924,7 +929,7 @@ def make_gmm_fit_tied_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
             return (it < max_iter) & ~conv
 
         state = (jnp.int32(0), means0.astype(acc), cov0.astype(acc),
-                 log_w0.astype(acc), jnp.asarray(-jnp.inf, acc),
+                 log_w0.astype(acc), jnp.asarray(prev0).astype(acc),
                  jnp.zeros((max_iter,), acc), jnp.asarray(False))
         it, means_c, cov, log_w, _, hist, conv = lax.while_loop(
             cond, body, state)
@@ -933,7 +938,7 @@ def make_gmm_fit_tied_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
     mapped = shard_map(
         fit, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None),
-                  P(None, None), P(None, None), P(None)),
+                  P(None, None), P(None, None), P(None), P()),
         out_specs=(P(None, None), P(None, None), P(None), P(), P(), P()),
         check_vma=False)
     return jax.jit(mapped)
@@ -1046,15 +1051,20 @@ def make_gmm_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
     ``10 * tiny``, mixing weights at ``max(1e-300, tiny(acc))`` — for
     float64 these equal the host constants exactly.
 
-    Returns ``fit(points, weights, shift, means0_c, var0, log_w0) ->
-    (means_c, var, log_w, n_iter, ll_hist[max_iter], converged)`` with
-    everything replicated; ``means0_c``/``means_c`` are in the centered
-    frame (caller adds ``shift`` back), tables are (k_pad, ...) with
-    padding components carried as ``log_w = -inf``.
+    Returns ``fit(points, weights, shift, means0_c, var0, log_w0,
+    prev0) -> (means_c, var, log_w, n_iter, ll_hist[max_iter],
+    converged)`` with everything replicated; ``means0_c``/``means_c``
+    are in the centered frame (caller adds ``shift`` back), tables are
+    (k_pad, ...) with padding components carried as ``log_w = -inf``.
+    ``prev0`` seeds the convergence baseline (the previous iteration's
+    mean log-likelihood): ``-inf`` for a fresh fit; a SEGMENTED or
+    resumed fit passes the last completed iteration's value so the
+    ``|ll - prev| < tol`` test is identical to an uninterrupted loop
+    crossing that boundary (ISSUE 4 — bit-exact checkpoint parity).
     """
     data_shards, model_shards = mesh_shape(mesh)
 
-    def fit(points, weights, shift, means0, var0, log_w0):
+    def fit(points, weights, shift, means0, var0, log_w0, prev0):
         k_pad, d = means0.shape
         k_local = k_pad // model_shards
         acc = points.dtype
@@ -1100,7 +1110,7 @@ def make_gmm_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
             return (it < max_iter) & ~conv
 
         state = (jnp.int32(0), means0.astype(acc), var0.astype(acc),
-                 log_w0.astype(acc), jnp.asarray(-jnp.inf, acc),
+                 log_w0.astype(acc), jnp.asarray(prev0).astype(acc),
                  jnp.zeros((max_iter,), acc), jnp.asarray(False))
         it, means_c, var, log_w, _, hist, conv = lax.while_loop(
             cond, body, state)
@@ -1109,7 +1119,7 @@ def make_gmm_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
     mapped = shard_map(
         fit, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None),
-                  P(None, None), P(None, None), P(None)),
+                  P(None, None), P(None, None), P(None), P()),
         out_specs=(P(None, None), P(None, None), P(None), P(), P(), P()),
         check_vma=False)
     return jax.jit(mapped)

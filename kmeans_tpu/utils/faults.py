@@ -1,0 +1,195 @@
+"""Deterministic fault injection for the fault-tolerance layer (ISSUE 4).
+
+Every recovery claim in this repo is *proved* by re-running the real code
+path under an injected, seeded failure — never by mocking the code under
+test.  This module is the one place those injections live:
+
+* :class:`TransientIOError` — the canonical retryable error.  The retry
+  machinery (``data.io.retry_call`` / ``resilient_blocks``) treats any
+  ``OSError`` as transient; tests raise this subclass so a retried
+  failure is distinguishable from a real environment error.
+* :class:`SimulatedPreemption` — what an injected "kill" raises.  It
+  deliberately does NOT subclass ``OSError``: a preemption must never be
+  swallowed by an IO retry loop.
+* ``fail_first_attempts(fn, k)`` — wrap any callable (a shard
+  ``read_rows``, a segment dispatch) so its first ``k`` invocations
+  raise; deterministic, counted.
+* ``flaky_blocks(make_blocks, ...)`` — a block stream whose Nth block
+  read fails the first K times it is attempted (across epochs AND
+  across retry replays), then succeeds forever.
+* ``poison_blocks(make_blocks, ...)`` — NaN-poison one block of every
+  epoch, exercising the ``on_nonfinite`` quarantine policy.
+* ``inject_kill_after_iteration(j)`` — arm the checkpoint-boundary
+  hook: the fit engines call :func:`on_checkpoint` immediately AFTER
+  each rotating checkpoint write, and the armed hook raises
+  :class:`SimulatedPreemption` once the boundary iteration reaches
+  ``j`` — the deterministic stand-in for a TPU preemption landing
+  between segments.
+
+All state is explicit (closures / context managers); nothing here is
+active unless a test arms it, and the hooks cost one empty-list check
+per checkpoint in production.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Callable, Iterable, List, Optional
+
+import numpy as np
+
+__all__ = [
+    "TransientIOError", "SimulatedPreemption", "on_checkpoint",
+    "inject_kill_after_iteration", "fail_first_attempts", "flaky_blocks",
+    "poison_blocks",
+]
+
+
+class TransientIOError(IOError):
+    """A retryable (injected) IO failure — an ``OSError`` subclass, so
+    the production retry machinery handles it exactly like a real flaky
+    read on the 7-10 MB/s tunnel."""
+
+
+class SimulatedPreemption(RuntimeError):
+    """Injected kill at a checkpoint boundary.  NOT an ``OSError``:
+    preemptions must propagate out of the fit, never be retried."""
+
+
+# --------------------------------------------------------------- hooks
+
+# Checkpoint-boundary hook registry.  The fit engines call
+# ``on_checkpoint(iteration, path)`` right after every successful
+# rotating checkpoint write (segment boundary on the device loops,
+# every-N iteration on the host loops, epoch boundary on the streamed
+# fits).  Hooks are (callable, lock-free append/remove) — production
+# pays one truthiness check.
+_CHECKPOINT_HOOKS: List[Callable[[int, object], None]] = []
+_HOOK_LOCK = threading.Lock()
+
+
+def on_checkpoint(iteration: int, path) -> None:
+    """Fire the checkpoint-boundary hooks (called by the fit engines
+    AFTER the checkpoint for ``iteration`` completed iterations is
+    durably on disk — so a hook that kills the process models a
+    preemption whose last checkpoint is valid)."""
+    if _CHECKPOINT_HOOKS:
+        for hook in list(_CHECKPOINT_HOOKS):
+            hook(iteration, path)
+
+
+@contextlib.contextmanager
+def inject_kill_after_iteration(j: int):
+    """Arm a one-shot kill: the FIRST checkpoint boundary whose
+    completed-iteration count is >= ``j`` raises
+    :class:`SimulatedPreemption`.  One-shot so the resumed fit (same
+    process, hook still armed would otherwise re-kill) runs to
+    completion; re-enter the context to kill again.  Yields a dict with
+    the observed kill iteration (``fired_at``, None if never fired)."""
+    record = {"fired_at": None}
+
+    def hook(iteration: int, path) -> None:
+        if record["fired_at"] is None and iteration >= j:
+            record["fired_at"] = iteration
+            raise SimulatedPreemption(
+                f"injected preemption after iteration {iteration} "
+                f"(armed at {j}); last checkpoint: {path}")
+
+    with _HOOK_LOCK:
+        _CHECKPOINT_HOOKS.append(hook)
+    try:
+        yield record
+    finally:
+        with _HOOK_LOCK:
+            if hook in _CHECKPOINT_HOOKS:
+                _CHECKPOINT_HOOKS.remove(hook)
+
+
+# ------------------------------------------------------------ callables
+
+def fail_first_attempts(fn: Callable, k: int,
+                        exc_factory: Callable[[int], BaseException]
+                        = None) -> Callable:
+    """Wrap ``fn`` so its first ``k`` invocations raise (then it passes
+    through forever).  The wrapper carries a ``.state`` dict with
+    ``'calls'`` (total invocations) and ``'failures'`` (raised so far)
+    counters — the "fail-first-K-dispatch-attempts" injection point.
+    Deterministic: no randomness, the attempt counter is the only
+    state."""
+    if exc_factory is None:
+        exc_factory = lambda i: TransientIOError(  # noqa: E731
+            f"injected transient failure (attempt {i + 1}/{k})")
+    state = {"calls": 0, "failures": 0}
+
+    def wrapped(*args, **kwargs):
+        i = state["calls"]
+        state["calls"] += 1
+        if i < k:
+            state["failures"] += 1
+            raise exc_factory(i)
+        return fn(*args, **kwargs)
+
+    wrapped.state = state
+    return wrapped
+
+
+# -------------------------------------------------------- block streams
+
+def flaky_blocks(make_blocks: Callable[[], Iterable], *,
+                 fail_block: int, fail_times: int,
+                 exc_factory: Optional[Callable[[int], BaseException]]
+                 = None) -> Callable[[], Iterable]:
+    """A ``make_blocks`` whose block ``fail_block`` (0-based position
+    within each epoch) raises the first ``fail_times`` times that
+    position is READ — counted across epochs and across retry replays,
+    so with ``io_retries >= fail_times`` the fit recovers and with
+    fewer it must surface the error.  The wrapper carries
+    ``.state['failures']`` for assertions."""
+    if exc_factory is None:
+        exc_factory = lambda i: TransientIOError(  # noqa: E731
+            f"injected flaky read of block {fail_block} "
+            f"(failure {i + 1}/{fail_times})")
+    state = {"failures": 0}
+
+    def make():
+        def gen():
+            for pos, item in enumerate(make_blocks()):
+                if pos == fail_block and state["failures"] < fail_times:
+                    i = state["failures"]
+                    state["failures"] += 1
+                    raise exc_factory(i)
+                yield item
+        return gen()
+
+    make.state = state
+    return make
+
+
+def poison_blocks(make_blocks: Callable[[], Iterable], *,
+                  block: int, value: float = np.nan,
+                  row: int = 0, col: int = 0) -> Callable[[], Iterable]:
+    """A ``make_blocks`` that poisons one element of block ``block``
+    (0-based position) with ``value`` (default NaN) every epoch —
+    the deterministic stand-in for a corrupted streamed block, used to
+    prove the ``on_nonfinite='error'|'skip'`` quarantine policy.  The
+    source items are not mutated (each poisoned block is a copy)."""
+
+    def make():
+        def gen():
+            for pos, item in enumerate(make_blocks()):
+                if pos != block:
+                    yield item
+                    continue
+                if isinstance(item, tuple):
+                    b, w = item
+                    b = np.array(b, copy=True)
+                    b[row, col] = value
+                    yield b, w
+                else:
+                    b = np.array(item, copy=True)
+                    b[row, col] = value
+                    yield b
+        return gen()
+
+    return make

@@ -1,10 +1,16 @@
 """Checkpoint / resume (beyond-reference capability, SURVEY.md §5:
-the reference has no model serialization at all)."""
+the reference has no model serialization at all) — plus the ISSUE 4
+hardening: torn-file detection, last-good ``.prev`` rotation, and the
+format-version gate in both directions."""
+
+import json
 
 import numpy as np
+import pytest
 from sklearn.datasets import make_blobs
 
 from kmeans_tpu import KMeans
+from kmeans_tpu.utils import checkpoint as ckpt
 
 
 def _data():
@@ -83,3 +89,75 @@ def test_resume_matches_uninterrupted(tmp_path, mesh8):
     assert resumed.iterations_run == full.iterations_run
     np.testing.assert_allclose(resumed.sse_history, full.sse_history,
                                rtol=1e-12)
+
+
+# ------------------------------------------- ISSUE 4 file-level hardening
+
+def test_load_state_corrupt_names_file(tmp_path):
+    p = tmp_path / "c.npz"
+    p.write_bytes(b"definitely not an npz")
+    with pytest.raises(ckpt.CheckpointCorruptError, match="c.npz"):
+        ckpt.load_state(p)
+
+
+def test_load_state_truncated_npz(tmp_path):
+    p = tmp_path / "t.npz"
+    ckpt.save_state(p, {"a": np.arange(1000.0), "x": 1})
+    data = p.read_bytes()
+    p.write_bytes(data[: len(data) // 2])         # torn mid-write copy
+    with pytest.raises(ckpt.CheckpointCorruptError, match="t.npz"):
+        ckpt.load_state(p)
+
+
+def test_rotation_keeps_last_good(tmp_path):
+    p = tmp_path / "r.npz"
+    ckpt.save_state_rotating(p, {"x": 1})
+    assert not ckpt.prev_path(p).exists()         # nothing to rotate yet
+    ckpt.save_state_rotating(p, {"x": 2})
+    state, used_prev = ckpt.load_state_with_fallback(p)
+    assert state["x"] == 2 and not used_prev
+    p.write_bytes(b"torn")
+    state, used_prev = ckpt.load_state_with_fallback(p)
+    assert state["x"] == 1 and used_prev
+
+
+def test_fallback_both_unreadable_raises(tmp_path):
+    p = tmp_path / "b.npz"
+    ckpt.save_state_rotating(p, {"x": 1})
+    ckpt.save_state_rotating(p, {"x": 2})
+    p.write_bytes(b"torn")
+    ckpt.prev_path(p).write_bytes(b"also torn")
+    with pytest.raises(ckpt.CheckpointCorruptError,
+                       match="also unreadable"):
+        ckpt.load_state_with_fallback(p)
+
+
+def _rewrite_version(src, dst, version):
+    with np.load(src) as z:
+        meta = json.loads(str(z["__meta__"]))
+        arrays = {k: z[k] for k in z.files if k != "__meta__"}
+    meta["__format_version__"] = version
+    np.savez(dst, __meta__=json.dumps(meta), **arrays)
+
+
+def test_format_version_newer_rejected_actionably(tmp_path):
+    p = tmp_path / "v.npz"
+    ckpt.save_state(p, {"x": 1})
+    newer = tmp_path / "newer.npz"
+    _rewrite_version(p, newer, ckpt.FORMAT_VERSION + 1)
+    with pytest.raises(ValueError, match="NEWER kmeans_tpu"):
+        ckpt.load_state(newer)
+
+
+def test_format_version_older_rejected(tmp_path):
+    p = tmp_path / "v.npz"
+    ckpt.save_state(p, {"x": 1})
+    older = tmp_path / "older.npz"
+    _rewrite_version(p, older, ckpt.FORMAT_VERSION - 1)
+    with pytest.raises(ValueError, match="obsolete format version"):
+        ckpt.load_state(older)
+    # Version mismatches are NOT corruption: they must never silently
+    # fall back to a .prev written by the same (mismatched) build.
+    with pytest.raises(ValueError) as ei:
+        ckpt.load_state(older)
+    assert not isinstance(ei.value, ckpt.CheckpointCorruptError)

@@ -1,0 +1,499 @@
+"""Preemption-safe fits (ISSUE 4): every recovery claim is PROVED under
+deterministic fault injection (``utils.faults``), never mocked.
+
+* Segmented auto-checkpointing (``checkpoint_every=N``) is pinned
+  BIT-IDENTICAL to the ``checkpoint_every=0`` single-dispatch oracle at
+  N in {1, 3, max_iter} — the r6 ``prefetch=0`` / r8 ``pipeline=0``
+  parity-oracle discipline.
+* Kill-at-iteration-j (``faults.inject_kill_after_iteration`` at the
+  checkpoint boundary) followed by ``fit(resume=<path>)`` reproduces the
+  uninterrupted trajectory bit-exactly for ALL FIVE model families, on
+  host AND device loops, across 1/2/4/8-way data meshes and TP centroid
+  sharding.
+* Transient-IO retry (deterministic exponential backoff, epoch replay),
+  the non-finite block quarantine, and the corrupt-checkpoint ``.prev``
+  fallback are each exercised through the real streamed-fit code path.
+"""
+
+import os
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+
+from kmeans_tpu import KMeans
+from kmeans_tpu.data import io as data_io
+from kmeans_tpu.models import (BisectingKMeans, GaussianMixture,
+                               MiniBatchKMeans, SphericalKMeans)
+from kmeans_tpu.parallel.mesh import make_mesh
+from kmeans_tpu.utils import checkpoint as ckpt
+from kmeans_tpu.utils import faults
+
+
+def _blobs(n=2000, d=3, centers=4, rs=9):
+    # n=2000/rs=9 runs ~17 Lloyd iterations at tolerance=1e-12 — long
+    # enough that every kill boundary below lands MID-fit (a fit that
+    # converges before the armed boundary would never fire the kill).
+    X, _ = make_blobs(n_samples=n, centers=centers, n_features=d,
+                      random_state=rs)
+    return X.astype(np.float32)
+
+
+def _blocks_of(X, rows=256):
+    def make_blocks():
+        def gen():
+            for i in range(0, X.shape[0], rows):
+                yield X[i: i + rows]
+        return gen()
+    return make_blocks
+
+
+def _fit_killed(model, j, fit_call):
+    """Run ``fit_call(model)`` with a kill armed at checkpoint boundary
+    ``j``; assert the preemption actually fired."""
+    with faults.inject_kill_after_iteration(j) as rec:
+        with pytest.raises(faults.SimulatedPreemption):
+            fit_call(model)
+    assert rec["fired_at"] is not None and rec["fired_at"] >= j
+    return rec["fired_at"]
+
+
+def _assert_same_kmeans(a, b):
+    assert a.iterations_run == b.iterations_run
+    np.testing.assert_array_equal(a.centroids, b.centroids)
+    np.testing.assert_array_equal(np.asarray(a.sse_history),
+                                  np.asarray(b.sse_history))
+
+
+# ------------------------------------------------- segmented == oracle
+
+@pytest.mark.parametrize("every", [1, 3, 30])
+@pytest.mark.parametrize("host_loop", [True, False])
+def test_segmented_matches_single_dispatch(tmp_path, mesh8, every,
+                                           host_loop):
+    """ceil(max_iter/N) dispatches with rotating checkpoints between
+    them == the one-dispatch (checkpoint_every=0) oracle, bitwise."""
+    X = _blobs()
+    kw = dict(k=4, max_iter=30, tolerance=1e-12, seed=1, compute_sse=True,
+              mesh=mesh8, host_loop=host_loop, verbose=False)
+    oracle = KMeans(**kw).fit(X)
+    seg = KMeans(**kw).fit(X, checkpoint_every=every,
+                           checkpoint_path=tmp_path / "seg.npz")
+    _assert_same_kmeans(seg, oracle)
+    assert seg.checkpoint_segments_ >= 1
+    if not host_loop:
+        # Device loop: segment count is the dispatch count.
+        assert seg.checkpoint_segments_ == -(-seg.iterations_run // every)
+
+
+@pytest.mark.parametrize("every", [1, 3, 16])
+def test_gmm_segmented_matches_single_dispatch(tmp_path, mesh8, every):
+    X = _blobs()
+    kw = dict(n_components=4, tol=1e-7, max_iter=16, init_params="random",
+              seed=0, mesh=mesh8, host_loop=False, verbose=False)
+    oracle = GaussianMixture(**kw).fit(X)
+    seg = GaussianMixture(**kw).fit(
+        X, checkpoint_every=every, checkpoint_path=tmp_path / "g.npz")
+    assert seg.n_iter_ == oracle.n_iter_
+    assert seg.converged_ == oracle.converged_
+    assert seg.lower_bound_ == oracle.lower_bound_
+    np.testing.assert_array_equal(seg.means_, oracle.means_)
+    np.testing.assert_array_equal(seg.covariances_, oracle.covariances_)
+
+
+# ------------------------------------------- kill -> resume, bit-exact
+
+@pytest.mark.parametrize("data_shards", [1, 2, 4, 8])
+@pytest.mark.parametrize("host_loop", [True, False])
+def test_kmeans_kill_resume_across_meshes(tmp_path, data_shards,
+                                          host_loop):
+    """Injected kill at a checkpoint boundary, then resume-from-path:
+    trajectory bit-identical to the uninterrupted fit on every mesh
+    width."""
+    import jax
+    if len(jax.devices()) < data_shards:
+        pytest.skip("needs %d devices" % data_shards)
+    mesh = make_mesh(data=data_shards, model=1,
+                     devices=jax.devices()[:data_shards])
+    X = _blobs()
+    kw = dict(k=4, max_iter=25, tolerance=1e-12, seed=1, compute_sse=True,
+              mesh=mesh, host_loop=host_loop, verbose=False)
+    full = KMeans(**kw).fit(X)
+    p = tmp_path / "ck.npz"
+    _fit_killed(KMeans(**kw), 4,
+                lambda m: m.fit(X, checkpoint_every=2, checkpoint_path=p))
+    resumed = KMeans(**kw)
+    resumed.fit(X, resume=p)
+    _assert_same_kmeans(resumed, full)
+
+
+@pytest.mark.parametrize("host_loop", [True, False])
+def test_kmeans_kill_resume_tp_sharding(tmp_path, mesh4x2, host_loop):
+    """Same pin under 2-way TP centroid sharding (the multihost
+    primary-write path's sharded-table case on one process)."""
+    X = _blobs()
+    kw = dict(k=6, max_iter=25, tolerance=1e-12, seed=1, compute_sse=True,
+              mesh=mesh4x2, model_shards=2, empty_cluster="keep",
+              host_loop=host_loop, verbose=False)
+    full = KMeans(**kw).fit(X)
+    p = tmp_path / "tp.npz"
+    _fit_killed(KMeans(**kw), 4,
+                lambda m: m.fit(X, checkpoint_every=2, checkpoint_path=p))
+    resumed = KMeans(**kw)
+    resumed.fit(X, resume=p)
+    _assert_same_kmeans(resumed, full)
+
+
+@pytest.mark.parametrize("engine",
+                         ["device-loop", "device-step", "host-sampling"])
+def test_minibatch_kill_resume(tmp_path, mesh8, engine):
+    X = _blobs(n=2000)
+    kw = dict(k=4, max_iter=24, tolerance=1e-12, seed=3, batch_size=256,
+              compute_sse=True, mesh=mesh8, verbose=False,
+              host_loop=(engine != "device-loop"),
+              sampling=("host" if engine == "host-sampling" else "device"))
+    full = MiniBatchKMeans(**kw).fit(X)
+    p = tmp_path / "mb.npz"
+    _fit_killed(MiniBatchKMeans(**kw), 10,
+                lambda m: m.fit(X, checkpoint_every=5, checkpoint_path=p))
+    resumed = MiniBatchKMeans(**kw)
+    resumed.fit(X, resume=p)
+    assert resumed.iterations_run == full.iterations_run
+    np.testing.assert_array_equal(resumed.centroids, full.centroids)
+    np.testing.assert_array_equal(resumed._seen, full._seen)
+
+
+@pytest.mark.parametrize("cov_type", ["diag", "full"])
+@pytest.mark.parametrize("host_loop", [True, False])
+def test_gmm_kill_resume(tmp_path, mesh8, cov_type, host_loop):
+    """EM kill/resume, diag + full, host loop (float64 attrs are the
+    exact carry) AND device loop (raw centered-frame tables + traced
+    prev0 baseline are the exact carry)."""
+    X = _blobs(n=1500)
+    kw = dict(n_components=4, covariance_type=cov_type, tol=1e-7,
+              max_iter=40, init_params="random", seed=0, mesh=mesh8,
+              host_loop=host_loop, verbose=False)
+    full = GaussianMixture(**kw).fit(X)
+    assert full.n_iter_ > 6      # the kill must land mid-fit
+    p = tmp_path / "g.npz"
+    _fit_killed(GaussianMixture(**kw), 6,
+                lambda m: m.fit(X, checkpoint_every=3, checkpoint_path=p))
+    resumed = GaussianMixture(**kw)
+    resumed.fit(X, resume=p)
+    assert resumed.n_iter_ == full.n_iter_
+    assert resumed.converged_ == full.converged_
+    assert resumed.lower_bound_ == full.lower_bound_
+    np.testing.assert_array_equal(resumed.means_, full.means_)
+    np.testing.assert_array_equal(resumed.covariances_, full.covariances_)
+    np.testing.assert_array_equal(resumed.weights_, full.weights_)
+
+
+def test_gmm_kill_resume_tp_sharding(tmp_path, mesh4x2):
+    X = _blobs(n=1500)
+    kw = dict(n_components=4, tol=1e-7, max_iter=40, init_params="random",
+              seed=0, mesh=mesh4x2, model_shards=2, host_loop=False,
+              verbose=False)
+    full = GaussianMixture(**kw).fit(X)
+    p = tmp_path / "gtp.npz"
+    _fit_killed(GaussianMixture(**kw), 4,
+                lambda m: m.fit(X, checkpoint_every=2, checkpoint_path=p))
+    resumed = GaussianMixture(**kw)
+    resumed.fit(X, resume=p)
+    assert resumed.n_iter_ == full.n_iter_
+    np.testing.assert_array_equal(resumed.means_, full.means_)
+
+
+@pytest.mark.parametrize("host_loop", [True, False])
+def test_spherical_kill_resume(tmp_path, mesh8, host_loop):
+    X = _blobs(d=4)
+    kw = dict(k=4, max_iter=25, tolerance=1e-12, seed=3, compute_sse=True,
+              mesh=mesh8, empty_cluster="keep", host_loop=host_loop,
+              verbose=False)
+    full = SphericalKMeans(**kw).fit(X)
+    p = tmp_path / "sp.npz"
+    _fit_killed(SphericalKMeans(**kw), 4,
+                lambda m: m.fit(X, checkpoint_every=2, checkpoint_path=p))
+    resumed = SphericalKMeans(**kw)
+    resumed.fit(X, resume=p)
+    _assert_same_kmeans(resumed, full)
+    assert np.allclose(np.linalg.norm(resumed.centroids, axis=1), 1.0,
+                       atol=1e-5)
+
+
+@pytest.mark.parametrize("host_loop", [True, False])
+def test_bisecting_kill_resume(tmp_path, mesh8, host_loop):
+    """Split-boundary checkpointing: kill after split j, resume rebuilds
+    the tree and continues — final centroids, hierarchical labels, and
+    per-leaf SSE all bit-identical."""
+    X = _blobs(n=1500, d=4, centers=6, rs=2)
+    kw = dict(k=6, max_iter=20, tolerance=1e-10, seed=7, compute_sse=True,
+              mesh=mesh8, host_loop=host_loop, verbose=False)
+    full = BisectingKMeans(**kw).fit(X)
+    p = tmp_path / "bk.npz"
+    _fit_killed(BisectingKMeans(**kw), 3,
+                lambda m: m.fit(X, checkpoint_every=1, checkpoint_path=p))
+    resumed = BisectingKMeans(**kw)
+    resumed.fit(X, resume=p)
+    assert resumed.iterations_run == full.iterations_run
+    np.testing.assert_array_equal(resumed.centroids, full.centroids)
+    np.testing.assert_array_equal(resumed.labels_, full.labels_)
+    np.testing.assert_array_equal(resumed.cluster_sse_, full.cluster_sse_)
+
+
+def test_bisecting_resume_without_tree_checkpoint_errors(mesh8):
+    X = _blobs(centers=3)
+    m = BisectingKMeans(k=3, mesh=mesh8, verbose=False).fit(X)
+    with pytest.raises(ValueError, match="split-boundary checkpoint"):
+        m.fit(X, resume=True)
+
+
+def test_fit_stream_kill_resume(tmp_path, mesh8):
+    X = _blobs()
+    make_blocks = _blocks_of(X)
+    kw = dict(k=4, max_iter=20, tolerance=1e-12, seed=1, compute_sse=True,
+              mesh=mesh8, verbose=False)
+    full = KMeans(**kw)
+    full.fit_stream(make_blocks, prefetch=0)
+    p = tmp_path / "s.npz"
+    _fit_killed(KMeans(**kw), 3,
+                lambda m: m.fit_stream(make_blocks, prefetch=0,
+                                       checkpoint_every=3,
+                                       checkpoint_path=p))
+    resumed = KMeans(**kw)
+    resumed.fit_stream(make_blocks, prefetch=0, resume=p)
+    _assert_same_kmeans(resumed, full)
+
+
+def test_gmm_fit_stream_kill_resume(tmp_path, mesh8):
+    X = _blobs(n=1200, centers=3, rs=5)
+    make_blocks = _blocks_of(X, rows=300)
+    kw = dict(n_components=3, tol=1e-9, max_iter=30, init_params="random",
+              seed=0, mesh=mesh8, verbose=False)
+    full = GaussianMixture(**kw)
+    full.fit_stream(make_blocks, prefetch=0)
+    assert full.n_iter_ > 2
+    p = tmp_path / "gs.npz"
+    _fit_killed(GaussianMixture(**kw), 2,
+                lambda m: m.fit_stream(make_blocks, prefetch=0,
+                                       checkpoint_every=2,
+                                       checkpoint_path=p))
+    resumed = GaussianMixture(**kw)
+    resumed.fit_stream(make_blocks, prefetch=0, resume=p)
+    assert resumed.n_iter_ == full.n_iter_
+    assert resumed.lower_bound_ == full.lower_bound_
+    np.testing.assert_array_equal(resumed.means_, full.means_)
+
+
+def test_kill_leaves_valid_checkpoint(tmp_path, mesh8):
+    """The injection hook fires only AFTER the write is durable: the
+    checkpoint on disk at kill time loads and reflects the boundary."""
+    X = _blobs()
+    p = tmp_path / "k.npz"
+    kw = dict(k=4, max_iter=25, tolerance=1e-12, seed=1, mesh=mesh8,
+              host_loop=False, verbose=False)
+    fired = _fit_killed(
+        KMeans(**kw), 6,
+        lambda m: m.fit(X, checkpoint_every=3, checkpoint_path=p))
+    state = ckpt.load_state(p)
+    assert int(state["iterations_run"]) == fired
+    assert state["centroids"].shape == (4, 3)
+
+
+# -------------------------------------------- retry / backoff / skips
+
+def test_stream_retry_recovers_bit_exact(tmp_path, mesh8):
+    """A block read failing 3 times mid-epoch, with io_retries >= 3,
+    recovers by deterministic epoch replay — trajectory bit-identical
+    to the clean stream, retries counted."""
+    X = _blobs()
+    clean = _blocks_of(X)
+    kw = dict(k=4, max_iter=15, tolerance=1e-12, seed=1, compute_sse=True,
+              mesh=mesh8, verbose=False)
+    ref = KMeans(**kw)
+    ref.fit_stream(clean, prefetch=0)
+    flaky = faults.flaky_blocks(clean, fail_block=2, fail_times=3)
+    m = KMeans(**kw)
+    m.fit_stream(flaky, prefetch=2, io_retries=5, io_backoff=0.0)
+    _assert_same_kmeans(m, ref)
+    assert m.io_retries_used_ == 3
+    assert flaky.state["failures"] == 3
+
+
+def test_stream_retry_budget_exhausted_raises(mesh8):
+    X = _blobs()
+    flaky = faults.flaky_blocks(_blocks_of(X), fail_block=1,
+                                fail_times=5)
+    m = KMeans(k=4, max_iter=5, seed=1, mesh=mesh8, verbose=False)
+    with pytest.raises(faults.TransientIOError):
+        m.fit_stream(flaky, prefetch=0, io_retries=2, io_backoff=0.0)
+
+
+def test_nonfinite_block_error_names_position(mesh8):
+    X = _blobs()
+    poisoned = faults.poison_blocks(_blocks_of(X), block=1)
+    m = KMeans(k=4, max_iter=5, seed=1, mesh=mesh8, verbose=False)
+    with pytest.raises(ValueError, match="block 1"):
+        m.fit_stream(poisoned, prefetch=0)
+
+
+def test_nonfinite_skip_quarantines_block(mesh8):
+    """on_nonfinite='skip': the poisoned block is dropped from EVERY
+    pass — the fit equals a fit of the stream without that block, and
+    the skip counter records it."""
+    X = _blobs()
+    rows = 256
+    keep = np.concatenate([X[:rows], X[2 * rows:]])   # block 1 removed
+    kw = dict(k=4, max_iter=15, tolerance=1e-12, seed=1, compute_sse=True,
+              mesh=mesh8, verbose=False)
+    ref = KMeans(**kw)
+    ref.fit_stream(_blocks_of(keep, rows), prefetch=0)
+    poisoned = faults.poison_blocks(_blocks_of(X, rows), block=1)
+    m = KMeans(**kw)
+    m.fit_stream(poisoned, prefetch=0, on_nonfinite="skip")
+    _assert_same_kmeans(m, ref)
+    assert m.blocks_skipped_ == 1
+
+
+def test_gmm_stream_retry_and_skip(mesh8):
+    X = _blobs(n=1200, centers=3, rs=5)
+    clean = _blocks_of(X, rows=300)
+    kw = dict(n_components=3, tol=1e-7, max_iter=10, init_params="random",
+              seed=0, mesh=mesh8, verbose=False)
+    ref = GaussianMixture(**kw)
+    ref.fit_stream(clean, prefetch=0)
+    flaky = faults.flaky_blocks(clean, fail_block=1, fail_times=2)
+    m = GaussianMixture(**kw)
+    m.fit_stream(flaky, prefetch=0, io_retries=3, io_backoff=0.0)
+    np.testing.assert_array_equal(m.means_, ref.means_)
+    assert m.io_retries_used_ == 2
+    poisoned = faults.poison_blocks(clean, block=2)
+    m2 = GaussianMixture(**kw)
+    m2.fit_stream(poisoned, prefetch=0, on_nonfinite="skip")
+    assert m2.blocks_skipped_ == 1
+    assert np.isfinite(m2.lower_bound_)
+
+
+def test_fail_first_attempts_retry_call():
+    """The fail-first-K-dispatch-attempts injection point against the
+    bounded deterministic retry primitive itself."""
+    stats = data_io.IOStats()
+    flaky = faults.fail_first_attempts(lambda: 42, 2)
+    assert data_io.retry_call(flaky, retries=3, backoff=0.0,
+                              stats=stats) == 42
+    assert stats.retries_used == 2
+    assert flaky.state == {"calls": 3, "failures": 2}
+    flaky2 = faults.fail_first_attempts(lambda: 42, 3)
+    with pytest.raises(faults.TransientIOError):
+        data_io.retry_call(flaky2, retries=2, backoff=0.0)
+
+
+def test_from_npy_io_retries_knob(tmp_path, mesh8):
+    """The shard-read retry knob on the out-of-core loader: clean load
+    works with retries armed and exposes the counter surface."""
+    X = _blobs()
+    path = tmp_path / "x.npy"
+    np.save(path, X)
+    ds = data_io.from_npy(path, mesh8, k_hint=4, io_retries=2,
+                          io_backoff=0.0)
+    assert ds.io_stats.retries_used == 0
+    m = KMeans(k=4, max_iter=5, seed=1, verbose=False).fit(ds)
+    assert m.io_retries_used_ == 0
+    np.testing.assert_allclose(np.asarray(ds.points)[: ds.n], X,
+                               rtol=1e-6)
+
+
+def test_iter_npy_blocks_retry(tmp_path):
+    X = _blobs()
+    path = tmp_path / "x.npy"
+    np.save(path, X)
+    mk = data_io.iter_npy_blocks(path, 256, io_retries=2, io_backoff=0.0)
+    out = np.concatenate(list(mk()))
+    np.testing.assert_array_equal(out, X)
+    assert mk.io_stats.retries_used == 0
+
+
+# ------------------------------------------------ knob validation etc.
+
+def test_checkpoint_knob_validation(mesh8):
+    X = _blobs()
+    m = KMeans(k=4, mesh=mesh8, verbose=False)
+    with pytest.raises(ValueError, match="requires\\s+checkpoint_path"):
+        m.fit(X, checkpoint_every=2)
+    with pytest.raises(ValueError, match="checkpoint_every >= 1"):
+        m.fit(X, checkpoint_path="x.npz")
+    with pytest.raises(ValueError, match="int >= 0"):
+        m.fit(X, checkpoint_every=-1, checkpoint_path="x.npz")
+    multi = KMeans(k=4, n_init=3, mesh=mesh8, verbose=False)
+    with pytest.raises(ValueError, match="n_init == 1"):
+        multi.fit(X, checkpoint_every=2, checkpoint_path="x.npz")
+
+
+def test_resume_rejects_mismatched_model(tmp_path, mesh8):
+    X = _blobs()
+    p = tmp_path / "m.npz"
+    KMeans(k=4, max_iter=3, mesh=mesh8, verbose=False).fit(
+        X, checkpoint_every=1, checkpoint_path=p)
+    with pytest.raises(ValueError, match="k=4"):
+        KMeans(k=5, mesh=mesh8, verbose=False).fit(X, resume=p)
+    with pytest.raises(ValueError, match="KMeans"):
+        MiniBatchKMeans(k=4, mesh=mesh8, verbose=False).fit(X, resume=p)
+
+
+def test_resume_falls_back_to_prev_after_torn_file(tmp_path, mesh8):
+    """Satellite: write a torn checkpoint over the newest rotation and
+    resume anyway — the `.prev` last-good state (one boundary older, on
+    the same trajectory) finishes bit-identically."""
+    X = _blobs()
+    kw = dict(k=4, max_iter=25, tolerance=1e-12, seed=1, compute_sse=True,
+              mesh=mesh8, host_loop=False, verbose=False)
+    full = KMeans(**kw).fit(X)
+    p = tmp_path / "r.npz"
+    _fit_killed(KMeans(**kw), 6,
+                lambda m: m.fit(X, checkpoint_every=3, checkpoint_path=p))
+    p.write_bytes(b"torn mid-write")      # newest checkpoint corrupted
+    resumed = KMeans(**kw)
+    with pytest.warns(UserWarning, match="last-good rotation"):
+        resumed.fit(X, resume=p)
+    _assert_same_kmeans(resumed, full)
+
+
+def test_gmm_restart_sweep_raw_tables_match_winner(mesh8):
+    """Review r9 regression: the sequential restart sweep must carry the
+    WINNER's raw device tables — it used to leave the LAST restart's, so
+    a later save()+fit(resume=path) silently continued a losing
+    trajectory while the fitted attrs described the winner."""
+    X = _blobs(n=1500)
+    gm = GaussianMixture(n_components=4, covariance_type="tied", n_init=3,
+                         tol=1e-7, max_iter=15, init_params="random",
+                         seed=0, mesh=mesh8, host_loop=False,
+                         verbose=False)
+    gm.fit(X)
+    assert gm._dev_tables is not None
+    # _ingest_device_tables defines means_ = f64(means_c) + shift; the
+    # carried raw tables must reproduce the published winner exactly.
+    recon = np.asarray(gm._dev_tables["means_c"], np.float64)[:4] \
+        + gm._shift()
+    np.testing.assert_array_equal(recon, gm.means_)
+
+
+def test_checkpoint_segments_resets_between_fits(tmp_path, mesh8):
+    """Review r9: a non-checkpointed fit after a checkpointed one must
+    read None, not the previous fit's stale segment count."""
+    X = _blobs()
+    for host_loop in (True, False):
+        m = KMeans(k=4, max_iter=6, seed=1, mesh=mesh8,
+                   host_loop=host_loop, verbose=False)
+        m.fit(X, checkpoint_every=2, checkpoint_path=tmp_path / "c.npz")
+        assert m.checkpoint_segments_ >= 1
+        m.fit(X)
+        assert m.checkpoint_segments_ is None
+
+
+def test_checkpoint_oracle_default_untouched(tmp_path, mesh8):
+    """checkpoint_every=0 (the default) writes nothing and reports no
+    segments — the oracle path is byte-for-byte today's behavior."""
+    X = _blobs()
+    m = KMeans(k=4, max_iter=5, seed=1, mesh=mesh8, host_loop=False,
+               verbose=False).fit(X)
+    assert m.checkpoint_segments_ is None
+    assert list(tmp_path.iterdir()) == []

@@ -378,11 +378,11 @@ def test_restart_failure_keeps_best_so_far(monkeypatch):
     orig = GaussianMixture._fit_one
     calls = {"n": 0}
 
-    def flaky(self, ds, mesh, step_fn, seed):
+    def flaky(self, ds, mesh, step_fn, seed, **kwargs):
         calls["n"] += 1
         if calls["n"] == 3:                       # last restart blows up
             raise ValueError("non-finite log-likelihood at EM iteration 1")
-        return orig(self, ds, mesh, step_fn, seed)
+        return orig(self, ds, mesh, step_fn, seed, **kwargs)
 
     monkeypatch.setattr(GaussianMixture, "_fit_one", flaky)
     with pytest.warns(UserWarning, match="restart 3/3 failed"):
@@ -392,7 +392,7 @@ def test_restart_failure_keeps_best_so_far(monkeypatch):
     assert gm.restart_lower_bounds_.shape == (3,)
     assert gm.restart_lower_bounds_[2] == -np.inf
     # All restarts failing propagates the error.
-    def always_fail(self, ds, mesh, step_fn, seed):
+    def always_fail(self, ds, mesh, step_fn, seed, **kwargs):
         raise ValueError("non-finite log-likelihood at EM iteration 1")
 
     monkeypatch.setattr(GaussianMixture, "_fit_one", always_fail)

@@ -355,3 +355,32 @@ def test_from_npy_readahead_matches_sync(tmp_path, data, mesh8):
                           np.asarray(ds_pre.points))
     assert np.array_equal(np.asarray(ds_sync.weights),
                           np.asarray(ds_pre.weights))
+
+
+def test_consumer_abandons_mid_retry_no_leaked_threads(data):
+    """ISSUE 4 shutdown hardening: the consumer kills the generator
+    while the producer is INSIDE an injected retry-backoff sleep — the
+    close must abort the sleep (``abort_source``), join the producer,
+    and leak no thread, without waiting out the backoff schedule."""
+    from kmeans_tpu.data.io import resilient_blocks
+    from kmeans_tpu.utils import faults
+
+    # Block 1 fails every attempt; a 60 s backoff would hang a close()
+    # that merely joined the thread.  flaky_blocks' counter proves the
+    # producer actually entered the retry loop before the abandon.
+    flaky = faults.flaky_blocks(_blocks_of(data, 1500), fail_block=1,
+                                fail_times=10 ** 6)
+    source = resilient_blocks(flaky, io_retries=5, io_backoff=60.0)
+    it = prefetch_iter(source(), prefetch=2)
+    first = next(it)                       # producer races ahead to the
+    assert np.array_equal(first, data[:1500])   # failing block 1
+    for _ in range(100):                   # wait until it is mid-retry
+        if flaky.state["failures"]:
+            break
+        time.sleep(0.02)
+    assert flaky.state["failures"] >= 1
+    t0 = time.perf_counter()
+    it.close()                             # consumer abandons the epoch
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 10.0, f"close() waited out the backoff ({elapsed:.1f}s)"
+    assert _no_leaked_threads(0)
