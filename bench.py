@@ -201,7 +201,10 @@ def main() -> None:
         # Segmented-dispatch cost (ISSUE 4): checkpoint_every=N device
         # loop vs the single-dispatch oracle, interleaved per-rep
         # ratios.  Default N matches the docs/PERFORMANCE.md pinned row.
-        from kmeans_tpu.benchmarks import bench_checkpoint_segments
+        # Followed by the ELASTIC-RESUME row (ISSUE 5): save + canonical
+        # gather + reshard-resume wall onto a half-width mesh.
+        from kmeans_tpu.benchmarks import (bench_checkpoint_segments,
+                                           bench_cross_mesh_resume)
         cn = int(os.environ.get("BENCH_N",
                                 2_000_000 if on_accel else 200_000))
         cd = int(os.environ.get("BENCH_D", 128 if on_accel else 32))
@@ -211,6 +214,7 @@ def main() -> None:
         log(f"bench: CKPT mode backend={backend} N={cn} D={cd} k={ck} "
             f"iters={ci} every={ce}")
         bench_checkpoint_segments(cn, cd, ck, ci, ce)
+        bench_cross_mesh_resume(cn, cd, ck, ci, ce)
         return
 
     if os.environ.get("BENCH_STREAM"):

@@ -20,6 +20,7 @@ from kmeans_tpu.models.minibatch import MiniBatchKMeans
 from kmeans_tpu.models.bisecting import BisectingKMeans
 from kmeans_tpu.models.spherical import SphericalKMeans
 from kmeans_tpu.models.gmm import GaussianMixture
+from kmeans_tpu.models.fault_tolerance import NumericalDivergenceError
 from kmeans_tpu.parallel.mesh import make_mesh
 from kmeans_tpu.parallel.sharding import ShardedDataset
 
@@ -27,4 +28,5 @@ __version__ = "0.1.0"
 
 __all__ = ["KMeans", "MiniBatchKMeans", "BisectingKMeans",
            "SphericalKMeans", "GaussianMixture", "DispatchLatencyHint",
-           "ShardedDataset", "make_mesh", "__version__"]
+           "NumericalDivergenceError", "ShardedDataset", "make_mesh",
+           "__version__"]

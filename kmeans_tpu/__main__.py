@@ -21,11 +21,14 @@ def main() -> int:
     if cmd == "fit":
         from kmeans_tpu.cli import main as fit_main
         return fit_main(rest)
+    if cmd == "ckpt-info":
+        from kmeans_tpu.cli import ckpt_info_main
+        return ckpt_info_main(rest)
     if cmd == "report":
         from kmeans_tpu.utils.diagram import main as report_main
         return report_main(rest)
-    print(f"unknown command {cmd!r}; available: suite, bench, fit, report",
-          file=sys.stderr)
+    print(f"unknown command {cmd!r}; available: suite, bench, fit, "
+          f"ckpt-info, report", file=sys.stderr)
     return 2
 
 

@@ -859,6 +859,85 @@ def bench_checkpoint_segments(n: int, d: int, k: int, iters: int,
     return result
 
 
+def bench_cross_mesh_resume(n: int, d: int, k: int, iters: int,
+                            every: int, reps: int = 5) -> Dict:
+    """Elastic-resume cost (ISSUE 5): what topology portability adds —
+    one canonical gather at save (already a host ``numpy`` state: the
+    rotating ``.npz`` write IS the gather) and one re-shard at resume
+    (checkpoint load + re-pad for the new mesh + device placement +
+    the first segment dispatch, program pre-compiled).
+
+    Method: fit with ``checkpoint_every`` on a mesh over ALL devices,
+    then resume the checkpoint on a HALF-width mesh (the preempted
+    slice coming back smaller — the elasticity scenario).  Per rep:
+    ``save_ms`` times one rotating checkpoint write; ``resume_ms``
+    times ``fit(resume=path)`` end-to-end on the half mesh for ONE
+    segment of further iterations (both meshes' programs compiled and
+    warmed first).  Medians published; single-device platforms skip
+    (no second topology to resume on)."""
+    import os
+    import tempfile
+
+    import jax
+    from kmeans_tpu.models.kmeans import KMeans
+    from kmeans_tpu.parallel.mesh import make_mesh
+    from kmeans_tpu.utils import checkpoint as ckpt
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        result = {"metric": "cross_mesh_resume", "skipped":
+                  "needs >= 2 devices for two topologies"}
+        print(json.dumps(result), flush=True)
+        return result
+    mesh_w = make_mesh(data=n_dev, model=1)
+    mesh_r = make_mesh(data=n_dev // 2, model=1,
+                       devices=jax.devices()[: n_dev // 2])
+    rng = np.random.default_rng(42)
+    X = rng.uniform(-1.0, 1.0, size=(n, d)).astype(np.float32)
+    init = X[np.sort(rng.choice(n, size=k, replace=False))].copy()
+    kw = dict(k=k, tolerance=1e-30, seed=0, init=init,
+              empty_cluster="keep", compute_sse=False, host_loop=False,
+              verbose=False)
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "bench_xmesh.npz")
+        writer = KMeans(max_iter=iters, mesh=mesh_w, **kw)
+        writer.fit(X, checkpoint_every=every, checkpoint_path=path)
+        # Warm the resume mesh's program (same segment length).
+        KMeans(max_iter=every, mesh=mesh_r, **kw).fit(X)
+        save_s, resume_s = [], []
+        for rep in range(reps + 1):
+            t0 = time.perf_counter()
+            ckpt.save_state_rotating(path, writer._state_dict())
+            sv = time.perf_counter() - t0
+            res = KMeans(max_iter=iters + every, mesh=mesh_r, **kw)
+            t0 = time.perf_counter()
+            res.fit(X, resume=path)
+            rs = time.perf_counter() - t0
+            if rep == 0:
+                continue                              # burn-in
+            save_s.append(sv)
+            resume_s.append(rs)
+            _log(f"[xmesh] rep {rep}/{reps}: save {sv * 1e3:.1f} ms, "
+                 f"resume-on-{n_dev // 2}-way {rs * 1e3:.1f} ms "
+                 f"({res.iterations_run - iters} iters run)")
+        assert res.iterations_run > iters     # the resume really continued
+    result = {
+        "metric": f"cross_mesh_resume_N{n}_D{d}_k{k}",
+        "value": round(float(np.median(resume_s)) * 1e3, 2),
+        "unit": "ms (load + re-shard + one further segment on the "
+                "half-width mesh)",
+        "write_mesh_data_shards": n_dev,
+        "resume_mesh_data_shards": n_dev // 2,
+        "save_ms": round(float(np.median(save_s)) * 1e3, 2),
+        "segment_iters": every,
+        "platform": jax.default_backend(),
+        "n_devices": n_dev,
+    }
+    print(json.dumps(result), flush=True)
+    return result
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="kmeans_tpu benchmarks")
     parser.add_argument("--configs", default=",".join(DEFAULT_CONFIGS))

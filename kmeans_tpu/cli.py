@@ -117,5 +117,56 @@ def main(argv=None) -> int:
     return 0
 
 
+def ckpt_info_main(argv=None) -> int:
+    """``python -m kmeans_tpu ckpt-info <path>`` — print a checkpoint's
+    metadata block (model class, k, completed iteration, the mesh shape
+    it was written on, format/jax versions) and whether the ``.prev``
+    last-good rotation exists and loads: the operator-facing half of
+    torn-checkpoint debugging (ISSUE 5).  Exit code 0 when a usable
+    state was found (primary OR ``.prev``), 2 otherwise."""
+    parser = argparse.ArgumentParser(
+        prog="python -m kmeans_tpu ckpt-info",
+        description="Describe a kmeans_tpu checkpoint (topology "
+                    "metadata + last-good rotation status)")
+    parser.add_argument("path", help="checkpoint path (.npz)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable JSON only")
+    args = parser.parse_args(argv)
+
+    from kmeans_tpu.utils.checkpoint import describe_checkpoint
+    info = describe_checkpoint(args.path)
+    if args.json:
+        print(json.dumps(info, indent=2))
+        return 0 if info.get("source") else 2
+    if info.get("source") is None:
+        print(f"error: {info['path']}: no loadable state "
+              f"(primary: {info.get('primary_error')}; "
+              f".prev exists: {info['prev_exists']}"
+              + (f", loads: {info.get('prev_loads')}"
+                 if info["prev_exists"] else "") + ")",
+              file=sys.stderr)
+        return 2
+    mesh = info.get("written_on_mesh") or {}
+    lines = [
+        f"checkpoint      : {info['path']}  [read from "
+        f"{info['source']}]",
+        f"model           : {info.get('model_class')} "
+        f"(k={info.get('k')}, iteration {info.get('iteration')})",
+        f"written on mesh : data_shards="
+        f"{mesh.get('data_shards')}, model_shards="
+        f"{mesh.get('model_shards')} (informational — state is "
+        f"canonical; resume re-shards for any topology)",
+        f"format version  : {info.get('format_version')}   "
+        f"jax {info.get('jax_version')}   dtype {info.get('dtype')}",
+        f".prev rotation  : exists={info['prev_exists']}"
+        + (f", loads={info['prev_loads']}" if info["prev_exists"]
+           else ""),
+    ]
+    if info.get("primary_error"):
+        lines.append(f"primary error   : {info['primary_error']}")
+    print("\n".join(lines))
+    return 0
+
+
 if __name__ == "__main__":
     sys.exit(main())

@@ -11,8 +11,9 @@ from kmeans_tpu.models.minibatch import MiniBatchKMeans
 from kmeans_tpu.models.bisecting import BisectingKMeans
 from kmeans_tpu.models.spherical import SphericalKMeans
 from kmeans_tpu.models.gmm import GaussianMixture
+from kmeans_tpu.models.fault_tolerance import NumericalDivergenceError
 from kmeans_tpu.models.init import forgy_init, kmeanspp_init
 
 __all__ = ["KMeans", "MiniBatchKMeans", "BisectingKMeans",
            "SphericalKMeans", "GaussianMixture", "DispatchLatencyHint",
-           "forgy_init", "kmeanspp_init"]
+           "NumericalDivergenceError", "forgy_init", "kmeanspp_init"]

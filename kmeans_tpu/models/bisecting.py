@@ -253,7 +253,10 @@ class BisectingKMeans(KMeans):
         self.centroids = np.stack(
             [np.asarray(cents[i], dtype=self.dtype) for i in range(k_out)])
         if not np.all(np.isfinite(self.centroids)):  # kmeans_spark.py:289-290
-            raise ValueError("NaN or Inf detected in centroids")
+            # Divergence-rollback exit (ISSUE 5): iteration == splits
+            # completed; the last-good split-boundary checkpoint (when
+            # one is active) is restored before the error propagates.
+            self._raise_divergence("centroids", self.iterations_run)
         self.labels_ = labels
         self.cluster_sse_ = np.array([sse[i] for i in range(k_out)])
         self.cluster_sizes_ = np.array([wsize[i] for i in range(k_out)])

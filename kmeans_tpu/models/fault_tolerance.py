@@ -1,10 +1,13 @@
-"""Shared auto-checkpointing plumbing for every model family (ISSUE 4).
+"""Shared auto-checkpointing + elastic-recovery plumbing for every
+model family (ISSUE 4 + ISSUE 5).
 
-One mixin carries the three pieces every fault-tolerant fit needs:
+One mixin carries the pieces every fault-tolerant fit needs:
 
 * ``_check_ckpt`` — knob validation (``checkpoint_every``/``_path``
   pairing, n_init=1 — a restart sweep re-initializes, so a partial
-  sweep has no well-defined resume point);
+  sweep has no well-defined resume point); also records the ACTIVE
+  checkpoint path for the divergence-rollback machinery and resets the
+  per-fit recovery counters (``oom_backoffs_``/``effective_chunk_``);
 * ``_write_autockpt`` — the rotating atomic write
   (``utils.checkpoint.save_state_rotating`` under the multi-host
   primary-gated barrier) followed by the deterministic fault-injection
@@ -14,7 +17,26 @@ One mixin carries the three pieces every fault-tolerant fit needs:
 * ``_resolve_resume`` — ``resume`` may be a checkpoint PATH: load it
   (falling back to the last-good ``.prev`` rotation with a warning on
   corruption), sanity-check the model class / cluster count, restore
-  the fitted state, and continue as ``resume=True``.
+  the fitted state, and continue as ``resume=True``.  State is
+  CANONICAL (unsharded, topology-independent — see
+  ``utils.checkpoint``), so the resuming model may sit on a different
+  mesh size or TP layout than the writer (ISSUE 5 elasticity);
+* ``_dispatch_oom_safe`` — the OOM-graceful segment dispatcher: a
+  ``RESOURCE_EXHAUSTED``/``XlaRuntimeError`` from a device-loop
+  segment halves the effective scan chunk (largest committed-chunk
+  divisor, floored at ``sharding.MIN_CHUNK`` — the same divisor rule
+  as ``clamp_chunk_for_k``), re-builds the step fn, and replays the
+  segment from the boundary state (== the last checkpoint); bounded
+  attempts, ``oom_backoffs_``/``effective_chunk_`` observability, and
+  an injection point (``faults.on_segment_dispatch``) INSIDE the try
+  block so the recovery is proven through the real code path;
+* ``_raise_divergence`` — the divergence-rollback exit: on a
+  non-finite trajectory the fitted state is rolled back to the
+  last-good checkpoint (when one is active and loads) before
+  :class:`NumericalDivergenceError` — naming the iteration and the
+  offending quantity — propagates, so a diverged long fit keeps its
+  last healthy state instead of losing everything to a post-hoc NaN
+  error.
 
 Host classes provide ``_state_dict()`` / ``_restore_state(state)`` (the
 same pair ``save``/``load`` use) and declare ``_ckpt_k_attr`` — the
@@ -30,13 +52,86 @@ from kmeans_tpu.utils import checkpoint as ckpt
 from kmeans_tpu.utils import faults
 
 
+class NumericalDivergenceError(ValueError):
+    """The fit's trajectory went non-finite (ISSUE 5).  Carries
+    ``iteration`` (the first diverged iteration), ``quantity``
+    ('centroids' | 'log-likelihood' | 'covariance'),
+    ``rolled_back_to`` (the iteration of the last-good checkpoint the
+    model was restored to, None when no checkpoint was active), and
+    ``checkpoint_path``.  A ``ValueError`` subclass whose message keeps
+    the historical phrasing ("NaN or Inf detected in centroids…" /
+    "non-finite log-likelihood…"), so existing handlers keep working.
+    """
+
+    _PHRASE = {
+        "centroids": "NaN or Inf detected in centroids at iteration {i}",
+        "log-likelihood": "non-finite log-likelihood at EM iteration {i}",
+        "covariance": "ill-defined empirical covariance at EM "
+                      "iteration {i}",
+    }
+
+    def __init__(self, quantity: str, iteration: int, *,
+                 rolled_back_to=None, checkpoint_path=None, detail=""):
+        self.quantity = quantity
+        self.iteration = int(iteration)
+        self.rolled_back_to = rolled_back_to
+        self.checkpoint_path = checkpoint_path
+        msg = self._PHRASE.get(quantity,
+                               f"non-finite {quantity} at iteration "
+                               "{i}").format(i=iteration)
+        if detail:
+            msg += f" ({detail})"
+        if rolled_back_to is not None:
+            msg += (f"; fitted state rolled back to the last-good "
+                    f"checkpoint (iteration {rolled_back_to}, "
+                    f"{checkpoint_path}) — inspect, adjust, and continue "
+                    f"with fit(resume=<path>)")
+        elif checkpoint_path is not None:
+            msg += (f"; the last-good checkpoint at {checkpoint_path} "
+                    f"could not be restored")
+        super().__init__(msg)
+
+
+#: Message tags XLA uses for device memory exhaustion (the
+#: ``XlaRuntimeError`` classification surface; jaxlib has no stable
+#: exception subclass per status code): the RESOURCE_EXHAUSTED status
+#: name and the allocator's "out of memory" phrase.  Deliberately NO
+#: bare "OOM" substring — an unrelated runtime error merely mentioning
+#: it must not be absorbed into 12 chunk-halving replays (review r10).
+#: ``faults.SimulatedOOM`` carries the first tag so injection
+#: exercises this exact test.
+_OOM_TAGS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+
+#: Bounded backoff: more halvings than any real chunk ladder needs
+#: (2^17 -> 128 is 10 steps), small enough that a persistent
+#: non-memory RESOURCE_EXHAUSTED cannot loop long.
+MAX_OOM_BACKOFFS = 12
+
+
+def is_oom_error(e: BaseException) -> bool:
+    """True when ``e`` is a device memory-exhaustion failure worth
+    retrying at a smaller chunk: an ``XlaRuntimeError`` (or any
+    ``RuntimeError``, covering the injected :class:`SimulatedOOM`)
+    whose message carries one of XLA's OOM tags.  Preemptions
+    (:class:`faults.SimulatedPreemption`) are explicitly excluded —
+    they must propagate, never be absorbed by a retry loop."""
+    if isinstance(e, faults.SimulatedPreemption):
+        return False
+    if not isinstance(e, (RuntimeError, MemoryError)):
+        return False
+    return any(tag in str(e) for tag in _OOM_TAGS)
+
+
 class AutoCheckpointMixin:
 
     _ckpt_k_attr = "k"
 
     def _check_ckpt(self, checkpoint_every, checkpoint_path) -> int:
         """Validate the auto-checkpoint knobs (shared by every family's
-        fit/fit_stream)."""
+        fit/fit_stream).  Also the per-fit reset point for the elastic
+        recovery machinery: records the active checkpoint path (what
+        ``_raise_divergence`` rolls back to) and zeroes the
+        ``oom_backoffs_``/``effective_chunk_`` observability attrs."""
         n = int(checkpoint_every)
         if n < 0 or n != checkpoint_every:
             raise ValueError(f"checkpoint_every must be an int >= 0, got "
@@ -52,7 +147,122 @@ class AutoCheckpointMixin:
                 "auto-checkpointing (checkpoint_every > 0) requires "
                 "n_init == 1: a restart sweep re-initializes, so a "
                 "partially-swept fit has no well-defined resume point")
+        self._active_ckpt_path = checkpoint_path if n > 0 else None
+        # Rollback is only legal once THIS fit has a stake in the path:
+        # a checkpoint it wrote, or the state it resumed from.  Without
+        # this, a diverging fit that reuses a path from an earlier,
+        # unrelated fit would silently restore that fit's stale state
+        # (review r10).
+        self._ckpt_written_this_fit = False
+        self.oom_backoffs_ = 0
+        self.effective_chunk_ = None
         return n
+
+    def _ckpt_meta(self) -> dict:
+        """The topology metadata block stamped into every checkpoint
+        (``utils.checkpoint.topology_meta``): mesh shape / TP layout
+        written on, jax version, dtype, format version."""
+        return ckpt.topology_meta(
+            mesh=getattr(self, "mesh", None),
+            model_shards=getattr(self, "model_shards", None),
+            dtype=getattr(self, "dtype", None))
+
+    def _dispatch_oom_safe(self, dispatch, chunk: int, segment: int):
+        """Run ``dispatch(chunk)`` with OOM-graceful degradation
+        (ISSUE 5): a ``RESOURCE_EXHAUSTED`` device failure halves the
+        effective chunk to the largest committed-chunk divisor
+        (``sharding.backoff_chunk``, floored at ``MIN_CHUNK``),
+        re-builds the step fn (the caller's ``dispatch`` closure keys
+        its compile cache by chunk), and replays the segment from the
+        boundary state — which IS the last checkpoint, so nothing is
+        lost.  Attempts are bounded (``MAX_OOM_BACKOFFS`` per fit);
+        exhaustion or an un-backoffable chunk re-raises the ORIGINAL
+        error with the remedy chained in.  Returns ``(result, chunk)``
+        — the chunk that succeeded, sticky for later segments.
+
+        The ``faults.on_segment_dispatch`` injection point fires INSIDE
+        the try block, so an injected ``SimulatedOOM`` exercises
+        exactly the recovery a real XLA OOM takes."""
+        import warnings
+        import jax
+        while True:
+            try:
+                faults.on_segment_dispatch(segment, chunk)
+                result = dispatch(chunk)
+                # Materialize INSIDE the try: JAX dispatch is async, so
+                # a real device RESOURCE_EXHAUSTED raised during
+                # execution would otherwise surface later, at the
+                # caller's first np.asarray — outside this recovery
+                # path (review r10).  The outputs are small (tables +
+                # histories), so the sync costs one round trip the
+                # segment boundary pays anyway.
+                jax.block_until_ready(result)
+                return result, chunk
+            except Exception as e:           # noqa: BLE001 — reclassified
+                if not is_oom_error(e):
+                    raise
+                from kmeans_tpu.parallel.sharding import backoff_chunk
+                smaller = backoff_chunk(chunk)
+                if smaller is None or self.oom_backoffs_ >= \
+                        MAX_OOM_BACKOFFS:
+                    # Plain RuntimeError (not type(e) — injected OOMs
+                    # have a structured constructor), original chained.
+                    raise RuntimeError(
+                        f"{e}; chunk backoff exhausted at {chunk} rows "
+                        f"after {self.oom_backoffs_} halving(s) — this "
+                        f"working set does not fit at the minimum scan "
+                        f"chunk; shrink k/D, add devices, or resume the "
+                        f"checkpoint on a larger mesh") from e
+                self.oom_backoffs_ += 1
+                self.effective_chunk_ = smaller
+                warnings.warn(
+                    f"device OOM dispatching segment {segment} at chunk "
+                    f"{chunk}; retrying at chunk {smaller} "
+                    f"(backoff {self.oom_backoffs_}/{MAX_OOM_BACKOFFS}; "
+                    f"the segment replays from the last checkpoint "
+                    f"boundary, trajectory unchanged)", UserWarning,
+                    stacklevel=3)
+                chunk = smaller
+
+    def _raise_divergence(self, quantity: str, iteration: int,
+                          detail: str = ""):
+        """Roll the fitted state back to the last-good checkpoint (when
+        one is active and still loads) and raise
+        :class:`NumericalDivergenceError` naming the iteration and the
+        offending quantity.  Without an active checkpoint the error
+        still names the quantity/iteration — strictly more information
+        than the old post-hoc ``ValueError``."""
+        path = getattr(self, "_active_ckpt_path", None)
+        # Only a checkpoint THIS fit has a stake in may be restored: one
+        # it wrote, or the very state it resumed from.  A stale file an
+        # earlier fit left at a reused path stays untouched (review
+        # r10) — the error still names the path so the operator can
+        # inspect it.
+        own = getattr(self, "_ckpt_written_this_fit", False) or (
+            path is not None
+            and getattr(self, "_resumed_from", None) == os.fspath(path))
+        rolled = None
+        if path is not None and own:
+            try:
+                state, _ = ckpt.load_state_with_fallback(path)
+            except Exception:
+                state = None
+            k_attr = self._ckpt_k_attr
+            if state is not None and \
+                    state.get("model_class", type(self).__name__) \
+                    == type(self).__name__ and \
+                    int(state.get(k_attr, getattr(self, k_attr))) \
+                    == getattr(self, k_attr):
+                self._restore_state(state)
+                rolled = int(state.get("iterations_run",
+                                       state.get("n_iter_", 0)))
+        # Name the path only when a rollback was actually eligible: a
+        # fit with no stake in the file must not send the operator off
+        # to debug "could not be restored" for a checkpoint that was
+        # never this fit's to restore (review r10).
+        raise NumericalDivergenceError(
+            quantity, iteration, rolled_back_to=rolled,
+            checkpoint_path=path if own else None, detail=detail)
 
     def _write_autockpt(self, path, iteration: int) -> None:
         """One rotating atomic checkpoint (multi-host primary-gated,
@@ -61,13 +271,16 @@ class AutoCheckpointMixin:
         ckpt.save_state_primary(path, self._state_dict(),
                                 f"kmeans_tpu.autockpt.{iteration}",
                                 rotate=True)
+        self._ckpt_written_this_fit = True
         faults.on_checkpoint(iteration, path)
 
     def _resolve_resume(self, resume):
         """Normalize the ``resume`` argument; a path loads the
         checkpoint (with ``.prev`` fallback) into this model first."""
         if not isinstance(resume, (str, os.PathLike)):
+            self._resumed_from = None
             return bool(resume)
+        self._resumed_from = os.fspath(resume)
         state, used_prev = ckpt.load_state_with_fallback(resume)
         if used_prev:
             import warnings

@@ -256,6 +256,16 @@ class GaussianMixture(AutoCheckpointMixin):
         self.io_retries_used_: int = 0
         self.blocks_skipped_: int = 0
         self.checkpoint_segments_: Optional[int] = None
+        # Elastic recovery observability (ISSUE 5): OOM chunk-backoff
+        # count / the device loop's effective chunk (None when no
+        # device loop ran; equals the committed chunk on healthy fits —
+        # `oom_backoffs_ > 0` is the backoff signal), Cholesky
+        # jitter-ladder retries (full/tied host path), and the active
+        # checkpoint path the divergence rollback restores from.
+        self.oom_backoffs_: int = 0
+        self.effective_chunk_: Optional[int] = None
+        self.cov_jitter_retries_: int = 0
+        self._active_ckpt_path = None
         # Raw accumulation-dtype device-loop tables (means_c/cov/log_w +
         # the carried convergence baseline) captured at the last segment
         # boundary or device-loop finish: the device loop works in the
@@ -414,7 +424,60 @@ class GaussianMixture(AutoCheckpointMixin):
             np.log(np.diagonal(L, axis1=-2, axis2=-1)), axis=-1)
         return p_chol, log_det_half
 
-    def _params_dev(self, mesh):
+    def _prec_chol_guarded(self, cov: np.ndarray):
+        """The FIT-path precision Cholesky (ISSUE 5 satellite): on a
+        non-PD batch, identify the offending components and retry their
+        decomposition with an escalating diagonal jitter ladder
+        (``reg_covar * 10^j``, j = 1..3), recording the retries in
+        ``cov_jitter_retries_`` — a borderline component one ulp past
+        PD (f32 accumulation, near-singleton clusters) continues
+        instead of killing an hours-long fit.  The ladder exhausting
+        (or ``reg_covar == 0``: nothing to escalate) raises the
+        actionable ill-defined-covariance error NAMING the component
+        index rather than propagating NaNs.  Healthy batches take the
+        plain ``_prec_chol`` path untouched — zero cost, identical
+        arithmetic (jitter never mixes into PD components)."""
+        try:
+            return self._prec_chol(cov)
+        except ValueError:
+            pass
+        single = cov.ndim == 2          # tied: one shared (D, D)
+        batch = cov[None] if single else cov
+        batch = np.array(batch, dtype=np.float64, copy=True)
+        d = batch.shape[-1]
+        bad = []
+        for idx in range(batch.shape[0]):
+            for j in range(4):          # j=0 is the unjittered retry
+                jitter = self.reg_covar * (10.0 ** j) if j else 0.0
+                try:
+                    np.linalg.cholesky(batch[idx]
+                                       + jitter * np.eye(d))
+                except np.linalg.LinAlgError:
+                    continue
+                if j:
+                    self.cov_jitter_retries_ += 1
+                    batch[idx] += jitter * np.eye(d)
+                break
+            else:
+                bad.append(idx)
+        if bad:
+            names = ("the shared tied covariance" if single else
+                     f"component(s) {bad}")
+            raise ValueError(
+                f"Fitting the mixture model failed because some "
+                f"components have ill-defined empirical covariance "
+                f"({names} stayed non-PD through the jitter ladder "
+                f"reg_covar * 10^j, j <= 3, reg_covar="
+                f"{self.reg_covar!r}). Try to decrease the number of "
+                f"components, or increase reg_covar.") from None
+        import warnings
+        warnings.warn(
+            f"non-PD covariance rescued by the jitter ladder "
+            f"(cov_jitter_retries_={self.cov_jitter_retries_}); "
+            f"consider a larger reg_covar", UserWarning, stacklevel=3)
+        return self._prec_chol(batch[0] if single else batch)
+
+    def _params_dev(self, mesh, guard_cholesky: bool = False):
         """Device-placed E-step parameter tables, per covariance type.
 
         diag/spherical: (shift, means_c, inv_var, log_det, log_w) — the
@@ -423,7 +486,17 @@ class GaussianMixture(AutoCheckpointMixin):
         tiny (review r4: a 1e-300 float64 floor flushes to 0 in f32).
         tied: (shift, means_t = mu_c @ P, P (D,D), log_det_half, log_w).
         full: (shift, means_c, P (k,D,D), log_det_half (k,), log_w).
-        """
+
+        ``guard_cholesky`` (FIT paths only): route full/tied precision
+        factorization through the jitter ladder
+        (``_prec_chol_guarded``) so a mid-fit borderline non-PD
+        component is rescued.  Inference (predict/score) keeps the
+        strict raise — a fitted model whose covariances cannot factor
+        must fail loudly, not silently score against jittered densities
+        (review r10), and ``cov_jitter_retries_`` stays a pure fit-time
+        audit counter."""
+        prec_chol = self._prec_chol_guarded if guard_cholesky \
+            else self._prec_chol
         shift = self._shift()
         log_w = np.log(np.maximum(self.weights_, 1e-300))
         ct = self.covariance_type
@@ -444,8 +517,8 @@ class GaussianMixture(AutoCheckpointMixin):
         lw = np.full((k_pad,), -np.inf, self.dtype)
         lw[:k] = log_w
         if ct == "tied":
-            p_chol, ldh = self._prec_chol(np.asarray(self.covariances_,
-                                                     np.float64))
+            p_chol, ldh = prec_chol(
+                np.asarray(self.covariances_, np.float64))
             mt = np.zeros((k_pad, d), self.dtype)
             mt[:k] = ((self.means_ - shift) @ p_chol).astype(self.dtype)
             return (jnp.asarray(shift.astype(self.dtype)),
@@ -454,8 +527,8 @@ class GaussianMixture(AutoCheckpointMixin):
                     jnp.asarray(np.asarray(ldh, self.dtype)),
                     jax.device_put(lw, vec))
         # full
-        p_chol, ldh = self._prec_chol(np.asarray(self.covariances_,
-                                                 np.float64))
+        p_chol, ldh = prec_chol(
+            np.asarray(self.covariances_, np.float64))
         mc = np.zeros((k_pad, d), self.dtype)
         mc[:k] = (self.means_ - shift).astype(self.dtype)
         pc = np.zeros((k_pad, d, d), self.dtype)
@@ -652,6 +725,7 @@ class GaussianMixture(AutoCheckpointMixin):
         attributes alone).  Requires ``n_init=1``."""
         checkpoint_every = self._check_ckpt(checkpoint_every,
                                             checkpoint_path)
+        self.cov_jitter_retries_ = 0
         resume = self._resolve_resume(resume)
         ds = self._dataset(X, sample_weight)
         self.io_retries_used_ = getattr(
@@ -811,6 +885,7 @@ class GaussianMixture(AutoCheckpointMixin):
         prefetch = check_prefetch(prefetch)
         checkpoint_every = self._check_ckpt(checkpoint_every,
                                             checkpoint_path)
+        self.cov_jitter_retries_ = 0
         resume = self._resolve_resume(resume) and self.means_ is not None
         if resume and self.n_init != 1:
             raise ValueError("fit_stream resume requires n_init == 1")
@@ -1068,7 +1143,8 @@ class GaussianMixture(AutoCheckpointMixin):
                 self.weights_, self.means_ = pi, mu
                 self.covariances_ = var
                 try:
-                    tables.append(self._params_dev(mesh))
+                    tables.append(self._params_dev(mesh,
+                                                   guard_cholesky=True))
                 except Exception as e:      # e.g. singular full/tied cov
                     fail_restart(i, e)
                     continue
@@ -1092,6 +1168,10 @@ class GaussianMixture(AutoCheckpointMixin):
                           f"[{(time.perf_counter() - t0) * 1e3:.1f} ms]",
                           flush=True)
                 if not np.isfinite(st.ll):
+                    if len(states) == 1:
+                        # Single restart (the only checkpointable
+                        # configuration): divergence-rollback exit.
+                        self._raise_divergence("log-likelihood", it)
                     fail_restart(i, ValueError(
                         f"non-finite log-likelihood at EM iteration "
                         f"{it}"))
@@ -1165,7 +1245,8 @@ class GaussianMixture(AutoCheckpointMixin):
         for it in range(base + 1, base + self.max_iter + 1):
             t0 = time.perf_counter()
             st: EStats = step_fn(ds.points, ds.weights,
-                                 *self._params_dev(mesh))
+                                 *self._params_dev(mesh,
+                                                   guard_cholesky=True))
             # The per-iteration float64 M-step total (sum of resp sums
             # == total sample weight) normalizes the lower bound — the
             # same reduction class on fresh AND resumed fits (an f32
@@ -1183,8 +1264,9 @@ class GaussianMixture(AutoCheckpointMixin):
                       f"[{(time.perf_counter() - t0) * 1e3:.1f} ms]",
                       flush=True)
             if not np.isfinite(self.lower_bound_):
-                raise ValueError(
-                    f"non-finite log-likelihood at EM iteration {it}")
+                # Divergence-rollback exit (ISSUE 5): restore the
+                # last-good checkpoint (when active) before raising.
+                self._raise_divergence("log-likelihood", it)
             # Absolute-index cadence (after the non-finite guard: never
             # checkpoint a poisoned state).
             if checkpoint_every and it % checkpoint_every == 0:
@@ -1376,10 +1458,37 @@ class GaussianMixture(AutoCheckpointMixin):
 
         raw = self._dev_tables if resume else None
         if raw is not None and raw["cov_type"] == ct and \
-                raw["means_c"].shape == (k_pad, d):
-            mc = np.asarray(raw["means_c"])
-            cov0 = np.asarray(raw["cov"])
-            log_w0 = np.asarray(raw["log_w"])
+                raw["means_c"].ndim == 2 and \
+                raw["means_c"].shape[0] >= k and \
+                raw["means_c"].shape[1] == d:
+            # Re-pad the CANONICAL carry for THIS mesh's model-axis
+            # layout (ISSUE 5 — the checkpoint may come from any
+            # topology).  Padding components are exactly the inert
+            # constants the loop carries for them (zero means,
+            # unit/identity covariance, -inf log-weight: they never
+            # receive responsibility and the loop re-asserts them every
+            # iteration), so the REAL components' trajectory is
+            # bit-identical whatever k_pad the writer used.  In-memory
+            # carries from a fit on this same mesh arrive already
+            # padded (shape[0] == k_pad >= k) — trimming to k first
+            # makes both sources take the one code path.
+            raw_mc = np.asarray(raw["means_c"])[:k]
+            raw_cov = np.asarray(raw["cov"])
+            raw_lw = np.asarray(raw["log_w"])[:k]
+            mc = np.zeros((k_pad, d), raw_mc.dtype)
+            mc[:k] = raw_mc
+            if ct in ("diag", "spherical"):
+                cov0 = np.ones((k_pad, d), raw_cov.dtype)
+                cov0[:k] = raw_cov[:k]
+            elif ct == "full":
+                cov0 = np.broadcast_to(
+                    np.eye(d, dtype=raw_cov.dtype),
+                    (k_pad, d, d)).copy()
+                cov0[:k] = raw_cov[:k]
+            else:                               # tied: shared (D, D)
+                cov0 = raw_cov
+            log_w0 = np.full((k_pad,), -np.inf, raw_lw.dtype)
+            log_w0[:k] = raw_lw
             prev = float(raw["prev_ll"])
         else:
             log_w0 = np.full((k_pad,), -np.inf, self.dtype)
@@ -1407,29 +1516,41 @@ class GaussianMixture(AutoCheckpointMixin):
             prev = float(self.lower_bound_) if resume else -np.inf
 
         self.checkpoint_segments_ = 0 if checkpoint_every else None
+        self.effective_chunk_ = chunk
         shift_dev = jnp.asarray(shift.astype(self.dtype))
         tables = (jnp.asarray(mc), jnp.asarray(cov0), jnp.asarray(log_w0))
         hist_parts = []
         it_done = 0
+        seg_idx = 0
         converged = False
         while True:
             seg = (min(checkpoint_every, self.max_iter - it_done)
                    if checkpoint_every else self.max_iter - it_done)
-            key = (mesh, chunk, k, seg, float(self.tol),
-                   float(self.reg_covar), ct, pipeline, "gmmfit")
-            fit_fn = _STEP_CACHE.get_or_create(key, lambda: builder(
-                mesh, chunk_size=chunk, k_real=k, max_iter=seg,
-                tol=float(self.tol), reg_covar=float(self.reg_covar),
-                pipeline=pipeline, **kwargs))
-            means_out, cov_out, log_w_out, it, hist, conv = fit_fn(
-                ds.points, ds.weights, shift_dev, *tables,
-                np.asarray(prev, acc))
+
+            # Chunk is a dispatch parameter: a RESOURCE_EXHAUSTED from
+            # the segment halves it, rebuilds the kernel, and replays
+            # from this boundary (== the last checkpoint, ISSUE 5).
+            def dispatch(c, _seg=seg, _tables=tables, _prev=prev):
+                key = (mesh, c, k, _seg, float(self.tol),
+                       float(self.reg_covar), ct, pipeline, "gmmfit")
+                fit_fn = _STEP_CACHE.get_or_create(key, lambda: builder(
+                    mesh, chunk_size=c, k_real=k, max_iter=_seg,
+                    tol=float(self.tol), reg_covar=float(self.reg_covar),
+                    pipeline=pipeline, **kwargs))
+                return fit_fn(ds.points, ds.weights, shift_dev,
+                              *_tables, np.asarray(_prev, acc))
+
+            (means_out, cov_out, log_w_out, it, hist, conv), chunk = \
+                self._dispatch_oom_safe(dispatch, chunk, seg_idx)
+            seg_idx += 1
             n = int(it)
             hist_np = np.asarray(hist, np.float64)[:n]
             if n and not np.all(np.isfinite(hist_np)):
-                raise ValueError(
-                    f"non-finite log-likelihood at EM iteration "
-                    f"{it_done + n}")
+                # The in-loop finite-ll flag stopped the dispatch at the
+                # diverging iteration; roll back to the last-good
+                # checkpoint and name it (ISSUE 5).
+                self._raise_divergence("log-likelihood",
+                                       base_iter + it_done + n)
             hist_parts.append(hist_np)
             it_done += n
             converged = bool(conv)
@@ -1662,6 +1783,9 @@ class GaussianMixture(AutoCheckpointMixin):
                 if getattr(self, "restart_lower_bounds_", None) is not None
                 else np.zeros((0,)),
         }
+        # Topology metadata block (ISSUE 5): informational — the state
+        # below is canonical/unsharded, so resume works on any mesh.
+        state.update(self._ckpt_meta())
         # Explicit init arrays are CONFIG, not fitted state: a loaded
         # model that is re-fit must seed exactly like the original.
         for name in ("weights_init", "means_init", "precisions_init"):
@@ -1670,12 +1794,25 @@ class GaussianMixture(AutoCheckpointMixin):
                 state[f"cfg_{name}"] = np.asarray(val)
         # Raw device-loop tables (see __init__): what makes a device-
         # loop resume bit-exact — the centered-frame acc-dtype carry
-        # plus the in-dispatch convergence baseline.
+        # plus the in-dispatch convergence baseline.  Stored CANONICAL
+        # (trimmed to the real k — ISSUE 5): the in-memory carry is
+        # padded to THIS mesh's model-axis multiple, but padding
+        # components are exactly the constants the loop start
+        # constructs (zero means, unit/identity covariance, -inf
+        # log-weight — they are inert and re-derivable), so trimming
+        # here and re-padding at resume for WHATEVER TP layout the
+        # resuming model has reproduces the carry bit-for-bit.
         raw = self._dev_tables
         if raw is not None:
-            state["dev_means_c"] = np.asarray(raw["means_c"])
-            state["dev_cov"] = np.asarray(raw["cov"])
-            state["dev_log_w"] = np.asarray(raw["log_w"])
+            k = self.n_components
+            cov = np.asarray(raw["cov"])
+            state["dev_means_c"] = np.asarray(raw["means_c"])[:k]
+            # tied carries one SHARED (D, D) covariance — no component
+            # axis to trim; diag/spherical (k_pad, D) and full
+            # (k_pad, D, D) trim to the real k.
+            state["dev_cov"] = cov if raw["cov_type"] == "tied" \
+                else cov[:k]
+            state["dev_log_w"] = np.asarray(raw["log_w"])[:k]
             state["dev_prev_ll"] = float(raw["prev_ll"])
             state["dev_cov_type"] = raw["cov_type"]
         return state
@@ -1711,12 +1848,20 @@ class GaussianMixture(AutoCheckpointMixin):
         # fit must never shadow the checkpoint.
         self._dev_tables = None
         if "dev_means_c" in state:
+            ct = str(state.get("dev_cov_type", self.covariance_type))
+            k = self.n_components
+            cov = np.asarray(state["dev_cov"])
+            # Canonicalize on the way in (ISSUE 5): r9 checkpoints
+            # stored the tables PADDED to the writer's model-axis
+            # multiple; trimming to the real k makes every checkpoint
+            # topology-portable — ``_fit_on_device`` re-pads for the
+            # RESUMING mesh's layout (padding components are the inert
+            # loop constants, so this is bit-exact).
             self._dev_tables = {
-                "cov_type": str(state.get("dev_cov_type",
-                                          self.covariance_type)),
-                "means_c": np.asarray(state["dev_means_c"]),
-                "cov": np.asarray(state["dev_cov"]),
-                "log_w": np.asarray(state["dev_log_w"]),
+                "cov_type": ct,
+                "means_c": np.asarray(state["dev_means_c"])[:k],
+                "cov": cov if ct == "tied" else cov[:k],
+                "log_w": np.asarray(state["dev_log_w"])[:k],
                 "prev_ll": float(state["dev_prev_ll"]),
             }
 

@@ -285,6 +285,15 @@ class KMeans(AutoCheckpointMixin):
         self.io_retries_used_: int = 0
         self.blocks_skipped_: int = 0
         self.checkpoint_segments_: Optional[int] = None
+        # Elastic recovery observability (ISSUE 5): OOM chunk-backoff
+        # count and the effective scan chunk the last device-loop fit
+        # ended on (None when no device loop ran; equals the committed
+        # chunk on healthy fits — `oom_backoffs_ > 0` is the backoff
+        # signal), plus the active checkpoint path the divergence
+        # rollback restores from.
+        self.oom_backoffs_: int = 0
+        self.effective_chunk_: Optional[int] = None
+        self._active_ckpt_path = None
         self.sse_history: List[float] = []            # kmeans_spark.py:45
         self.cluster_sizes_: Optional[np.ndarray] = None
         self.iter_times_: List[float] = []            # wall secs/iteration
@@ -1141,11 +1150,12 @@ class KMeans(AutoCheckpointMixin):
                     sse_val > self.sse_history[-2] + 1e-6:
                 log.warn_sse_increase(self.sse_history[-2], sse_val)
 
-        # Numerical-stability guard (kmeans_spark.py:289-290).
+        # Numerical-stability guard (kmeans_spark.py:289-290), upgraded
+        # to the divergence-rollback exit (ISSUE 5): when a checkpoint
+        # is active the fitted state rolls back to the last-good one
+        # before the error — naming the iteration — propagates.
         if not np.all(np.isfinite(new_centroids)):
-            raise ValueError(
-                f"NaN or Inf detected in centroids at iteration "
-                f"{iteration + 1}")
+            self._raise_divergence("centroids", iteration + 1)
 
         shifts = np.linalg.norm(
             new_centroids.astype(np.float64) -
@@ -1188,33 +1198,43 @@ class KMeans(AutoCheckpointMixin):
         chunk = self._eff_chunk(ds)
         self.loop_path_ = "device"
         self.checkpoint_segments_ = 0 if checkpoint_every else None
+        self.effective_chunk_ = chunk
         base_hist = list(self.sse_history)
         cents_dev = self._put_centroids(centroids, mesh, model_shards)
         sse_parts, shift_parts = [], []
         it0 = start_iter
+        seg_idx = 0
         fit_start = time.perf_counter()
         while True:
             seg = (min(checkpoint_every, self.max_iter - it0)
                    if checkpoint_every else self.max_iter - it0)
             seg = max(seg, 0)
+
             # Seeds travel as a traced ARGUMENT (not a baked constant),
             # so fits differing only by seed/start_iter — restarts,
             # bisecting splits, resumes, later segments — reuse one
-            # compiled program per segment length.
-            key = (mesh, chunk, mode, self.k, seg,
-                   float(self.tolerance), self.empty_cluster,
-                   self.compute_sse, self._device_project, "fit")
-            fit_fn = _STEP_CACHE.get_or_create(
-                key, lambda: dist.make_fit_fn(
-                    mesh, chunk_size=chunk, mode=mode,
-                    k_real=self.k, max_iter=seg,
-                    tolerance=float(self.tolerance),
-                    empty_policy=self.empty_cluster,
-                    history_sse=self.compute_sse,
-                    project=self._device_project))
-            cents, n_iters, sse_hist, shift_hist, counts = fit_fn(
-                ds.points, ds.weights, cents_dev,
-                dist._empty_seed_array(seed, it0, seg))
+            # compiled program per segment length.  The chunk is a
+            # dispatch PARAMETER so the OOM backoff can rebuild the
+            # step fn at a smaller tile and replay the segment from
+            # this boundary (== the last checkpoint, ISSUE 5).
+            def dispatch(c, _seg=seg, _it0=it0):
+                key = (mesh, c, mode, self.k, _seg,
+                       float(self.tolerance), self.empty_cluster,
+                       self.compute_sse, self._device_project, "fit")
+                fit_fn = _STEP_CACHE.get_or_create(
+                    key, lambda: dist.make_fit_fn(
+                        mesh, chunk_size=c, mode=mode,
+                        k_real=self.k, max_iter=_seg,
+                        tolerance=float(self.tolerance),
+                        empty_policy=self.empty_cluster,
+                        history_sse=self.compute_sse,
+                        project=self._device_project))
+                return fit_fn(ds.points, ds.weights, cents_dev,
+                              dist._empty_seed_array(seed, _it0, _seg))
+
+            (cents, n_iters, sse_hist, shift_hist, counts), chunk = \
+                self._dispatch_oom_safe(dispatch, chunk, seg_idx)
+            seg_idx += 1
             n = int(n_iters)
             it0 += n
             sse_parts.append(np.asarray(sse_hist, np.float64)[:n])
@@ -1226,9 +1246,10 @@ class KMeans(AutoCheckpointMixin):
                                     shift_parts[-1][-1] < self.tolerance)
             cents_host = np.asarray(cents, dtype=self.dtype)
             if not np.all(np.isfinite(cents_host)):  # don't checkpoint NaN
-                raise ValueError(
-                    f"NaN or Inf detected in centroids at iteration "
-                    f"{it0}")
+                # The in-loop all-finite flag stopped the dispatch at
+                # the diverging iteration; roll back to the last-good
+                # checkpoint and name it (ISSUE 5).
+                self._raise_divergence("centroids", it0)
             # Publish the boundary state so the checkpoint is a valid
             # resume point, then write + fire the injection hook.
             self.centroids = cents_host
@@ -1260,9 +1281,9 @@ class KMeans(AutoCheckpointMixin):
         self.iter_times_.extend([elapsed / max(n_iters, 1)] * n_iters)
         self.centroids = np.asarray(cents, dtype=self.dtype)
         if not np.all(np.isfinite(self.centroids)):   # kmeans_spark.py:289
-            raise ValueError(
-                f"NaN or Inf detected in centroids at iteration "
-                f"{start_iter + n_iters}")
+            # The all-finite loop flag stopped the dispatch at the
+            # diverging iteration; roll back + name it (ISSUE 5).
+            self._raise_divergence("centroids", start_iter + n_iters)
         self.cluster_sizes_ = np.asarray(counts, dtype=np.int64)
         self.iterations_run = start_iter + n_iters
         sse_hist = np.asarray(sse_hist, dtype=np.float64)[:n_iters]
@@ -1765,6 +1786,11 @@ class KMeans(AutoCheckpointMixin):
             "iterations_run": self.iterations_run,
             "dtype": str(self.dtype),
         }
+        # Topology metadata block (ISSUE 5): the mesh shape / TP layout
+        # this state was written on, jax version, format version — all
+        # informational (state itself is canonical/unsharded; resume
+        # re-shards it for whatever topology the resuming model has).
+        state.update(self._ckpt_meta())
         if isinstance(self.init, str):
             state["init"] = self.init
         elif not callable(self.init):

@@ -441,9 +441,10 @@ class MiniBatchKMeans(KMeans):
                                     shift_parts[-1][-1] < self.tolerance)
             cents_host = np.asarray(cents, dtype=self.dtype)
             if not np.all(np.isfinite(cents_host)):  # don't checkpoint NaN
-                raise ValueError(
-                    f"NaN or Inf detected in centroids at iteration "
-                    f"{it0}")
+                # Divergence-rollback exit (ISSUE 5): the in-loop
+                # all-finite flag stopped the dispatch at the diverging
+                # iteration; restore the last-good checkpoint + name it.
+                self._raise_divergence("centroids", it0)
             # Boundary state -> valid resume point, then write + hook.
             self.centroids = cents_host
             self._centroids_f64 = np.asarray(cents_host, dtype=np.float64)
@@ -464,9 +465,7 @@ class MiniBatchKMeans(KMeans):
 
         self.centroids = np.asarray(cents, dtype=self.dtype)
         if not np.all(np.isfinite(self.centroids)):
-            raise ValueError(
-                f"NaN or Inf detected in centroids at iteration "
-                f"{start_iter + n_total}")
+            self._raise_divergence("centroids", start_iter + n_total)
         # The device loop's carry IS the compute dtype — publish its
         # exact f64 image so a later resume (which round-trips through
         # _centroids_f64) continues bit-identically.
@@ -643,9 +642,7 @@ class MiniBatchKMeans(KMeans):
                 seen[slots] = kept.min() if kept.size else 0.0
 
         if not np.all(np.isfinite(new_centroids)):
-            raise ValueError(
-                f"NaN or Inf detected in centroids at iteration "
-                f"{iteration + 1}")
+            self._raise_divergence("centroids", iteration + 1)
         if self.compute_sse:
             self.sse_history.append(sse * sse_scale)  # scaled batch estimate
 
@@ -671,6 +668,13 @@ class MiniBatchKMeans(KMeans):
         if sample_weight is not None:
             raise ValueError("partial_fit does not support sample_weight; "
                              "fold weights into batch construction")
+        # partial_fit is not a checkpointed session: clear any ownership
+        # flags a previous fit() left, so a diverging batch raises in
+        # place instead of rolling the model back to that fit's stale
+        # checkpoint and destroying the incremental progress (review
+        # r10).
+        self._active_ckpt_path = None
+        self._ckpt_written_this_fit = False
         X = np.ascontiguousarray(np.asarray(X, dtype=self.dtype))
         if X.ndim != 2:
             raise ValueError(f"X must be 2-D (n, D), got shape {X.shape}")

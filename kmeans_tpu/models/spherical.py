@@ -21,6 +21,14 @@ around the unchanged SPMD step:
 No new kernel, no new collective, no second code path — the distance
 matmul, one-hot scatter-sum, psum, empty-cluster policies, checkpointing,
 and mesh sharding are all inherited.
+
+Elastic resume (ISSUE 5): inherited unchanged from :class:`KMeans` —
+checkpoints are canonical (k, D) unit-direction tables with the topology
+metadata block, so a spherical fit checkpointed on one mesh resumes on
+any other (``tests/test_elastic.py`` pins the cross-mesh matrix cell);
+the OOM chunk backoff and divergence rollback
+(``NumericalDivergenceError``) apply to the projected device loop
+exactly as to plain Lloyd.
 """
 
 from __future__ import annotations

@@ -652,7 +652,7 @@ def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
             return sums, counts, sse, far_d, far_p
 
         def body(state):
-            i, cents_full, _, sse_hist, shift_hist, _ = state
+            i, cents_full, _, sse_hist, shift_hist, _, _ = state
             cents_block = lax.dynamic_slice(
                 cents_full, (jnp.asarray(m_idx * k_local, jnp.int32),
                              jnp.int32(0)), (k_local, d))
@@ -684,19 +684,27 @@ def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
             max_shift = jnp.max(jnp.where(real, shifts, 0.0))
             sse_hist = sse_hist.at[i].set(sse)
             shift_hist = shift_hist.at[i].set(max_shift)
-            return i + 1, new, max_shift, sse_hist, shift_hist, counts
+            # All-finite flag (ISSUE 5): a blown-up table stops the loop
+            # at the DIVERGING iteration (i+1 after the increment below)
+            # instead of spinning NaNs to max_iter — the host maps the
+            # early exit to a NumericalDivergenceError naming it.  For
+            # healthy fits the flag is constant-true: the arithmetic of
+            # every iteration is untouched (parity oracles unaffected).
+            ok = jnp.all(jnp.isfinite(jnp.where(real[:, None], new, 0.0)))
+            return i + 1, new, max_shift, sse_hist, shift_hist, counts, ok
 
         def cond(state):
-            i, _, max_shift, *_ = state
-            return (i < max_iter) & ((i == 0) | (max_shift >= tolerance))
+            i, _, max_shift, _, _, _, ok = state
+            return (i < max_iter) & ((i == 0) | (max_shift >= tolerance)) \
+                & ok
 
         cents0 = lax.all_gather(centroids_block, MODEL_AXIS,
                                 tiled=True).astype(acc) \
             if model_shards > 1 else centroids_block.astype(acc)
         state = (jnp.int32(0), cents0, jnp.asarray(jnp.inf, acc),
                  jnp.zeros((max_iter,), acc), jnp.zeros((max_iter,), acc),
-                 jnp.zeros((k_pad,), acc))
-        i, cents, _, sse_hist, shift_hist, counts = lax.while_loop(
+                 jnp.zeros((k_pad,), acc), jnp.asarray(True))
+        i, cents, _, sse_hist, shift_hist, counts, _ = lax.while_loop(
             cond, body, state)
         return cents[:k_real], i, sse_hist, shift_hist, counts[:k_real]
 
@@ -1169,7 +1177,7 @@ def make_minibatch_fit_fn(mesh: Mesh, *, batch_per_shard: int,
             return sums, counts, sse, cand
 
         def body(state):
-            i, cents, seen, _, sse_hist, shift_hist, _ = state
+            i, cents, seen, _, sse_hist, shift_hist, _, _ = state
             sums, counts, sse, cand = batch_stats(cents, i)
             seen = seen + counts
             eta = (counts / jnp.maximum(seen, 1.0))[:, None]
@@ -1187,11 +1195,16 @@ def make_minibatch_fit_fn(mesh: Mesh, *, batch_per_shard: int,
             sse_hist = sse_hist.at[i].set(
                 sse * w_total / jnp.maximum(batch_w, 1.0))
             shift_hist = shift_hist.at[i].set(max_shift)
-            return i + 1, new, seen, max_shift, sse_hist, shift_hist, counts
+            # All-finite flag (ISSUE 5) — see make_fit_fn: stop at the
+            # diverging iteration; healthy trajectories are untouched.
+            ok = jnp.all(jnp.isfinite(jnp.where(real[:, None], new, 0.0)))
+            return (i + 1, new, seen, max_shift, sse_hist, shift_hist,
+                    counts, ok)
 
         def cond(state):
-            i, _, _, max_shift, *_ = state
-            return (i < max_iter) & ((i == 0) | (max_shift >= tolerance))
+            i, _, _, max_shift, _, _, _, ok = state
+            return (i < max_iter) & ((i == 0) | (max_shift >= tolerance)) \
+                & ok
 
         cents0 = lax.all_gather(cents_block, MODEL_AXIS,
                                 tiled=True).astype(acc) \
@@ -1199,9 +1212,9 @@ def make_minibatch_fit_fn(mesh: Mesh, *, batch_per_shard: int,
         seen_pad = jnp.pad(seen0.astype(acc), (0, k_pad - k_real))
         state = (jnp.int32(0), cents0, seen_pad, jnp.asarray(jnp.inf, acc),
                  jnp.zeros((max_iter,), acc), jnp.zeros((max_iter,), acc),
-                 jnp.zeros((k_pad,), acc))
-        i, cents, seen, _, sse_hist, shift_hist, counts = lax.while_loop(
-            cond, body, state)
+                 jnp.zeros((k_pad,), acc), jnp.asarray(True))
+        i, cents, seen, _, sse_hist, shift_hist, counts, _ = \
+            lax.while_loop(cond, body, state)
         return (cents[:k_real], seen[:k_real], i, sse_hist, shift_hist,
                 counts[:k_real])
 

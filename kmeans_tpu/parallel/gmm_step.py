@@ -805,7 +805,7 @@ def make_gmm_fit_full_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
             return _embed_psum_full(st, k_pad, k_local, model_shards)
 
         def body(state):
-            it, means_c, cov, log_w, prev, hist, _ = state
+            it, means_c, cov, log_w, prev, hist, _, _ = state
             st = estats(means_c, cov, log_w)
             Rc = jnp.maximum(st.resp_sum, 10 * tiny)
             mu = st.xsum / Rc[:, None]
@@ -824,20 +824,24 @@ def make_gmm_fit_full_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
             hist = hist.at[it].set(ll)
             conv = jnp.abs(ll - prev) < tol
             eye = jnp.broadcast_to(jnp.eye(d, dtype=acc), cov.shape)
+            # All-finite flag (ISSUE 5) — see make_gmm_fit_fn: a non-PD
+            # component's NaN loglik stops the loop at the diverging
+            # iteration instead of spinning to max_iter.
             return (it + 1, jnp.where(real[:, None], mu, means_c),
                     jnp.where(real[:, None, None], new_cov, eye),
-                    new_log_w, ll, hist, conv)
+                    new_log_w, ll, hist, conv, jnp.isfinite(ll))
 
         def cond(state):
-            it, *_, conv = state
-            return (it < max_iter) & ~conv
+            it, *_, conv, ok = state
+            return (it < max_iter) & ~conv & ok
 
         eye = jnp.broadcast_to(jnp.eye(d, dtype=acc), cov0.shape)
         cov_start = jnp.where(real[:, None, None], cov0.astype(acc), eye)
         state = (jnp.int32(0), means0.astype(acc), cov_start,
                  log_w0.astype(acc), jnp.asarray(prev0).astype(acc),
-                 jnp.zeros((max_iter,), acc), jnp.asarray(False))
-        it, means_c, cov, log_w, _, hist, conv = lax.while_loop(
+                 jnp.zeros((max_iter,), acc), jnp.asarray(False),
+                 jnp.asarray(True))
+        it, means_c, cov, log_w, _, hist, conv, _ = lax.while_loop(
             cond, body, state)
         return means_c, cov, log_w, it, hist, conv
 
@@ -900,7 +904,7 @@ def make_gmm_fit_tied_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
             return _embed_psum(st, k_pad, k_local, model_shards)
 
         def body(state):
-            it, means_c, cov, log_w, prev, hist, _ = state
+            it, means_c, cov, log_w, prev, hist, _, _ = state
             st = estats(means_c, cov, log_w)
             Rc = jnp.maximum(st.resp_sum, 10 * tiny)
             mu = st.xsum / Rc[:, None]
@@ -921,17 +925,20 @@ def make_gmm_fit_tied_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
             ll = st.loglik / w_total
             hist = hist.at[it].set(ll)
             conv = jnp.abs(ll - prev) < tol
+            # All-finite flag (ISSUE 5) — see make_gmm_fit_fn.
             return (it + 1, jnp.where(real[:, None], mu, means_c),
-                    new_cov, new_log_w, ll, hist, conv)
+                    new_cov, new_log_w, ll, hist, conv,
+                    jnp.isfinite(ll))
 
         def cond(state):
-            it, *_, conv = state
-            return (it < max_iter) & ~conv
+            it, *_, conv, ok = state
+            return (it < max_iter) & ~conv & ok
 
         state = (jnp.int32(0), means0.astype(acc), cov0.astype(acc),
                  log_w0.astype(acc), jnp.asarray(prev0).astype(acc),
-                 jnp.zeros((max_iter,), acc), jnp.asarray(False))
-        it, means_c, cov, log_w, _, hist, conv = lax.while_loop(
+                 jnp.zeros((max_iter,), acc), jnp.asarray(False),
+                 jnp.asarray(True))
+        it, means_c, cov, log_w, _, hist, conv, _ = lax.while_loop(
             cond, body, state)
         return means_c, cov, log_w, it, hist, conv
 
@@ -1087,7 +1094,7 @@ def make_gmm_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
                 pipeline=pipeline)
 
         def body(state):
-            it, means_c, var, log_w, prev, hist, _ = state
+            it, means_c, var, log_w, prev, hist, _, _ = state
             st = estats(means_c, var, log_w)
             # The CARRIED/returned variance is floored at tiny too — a
             # var of exactly 0 would make the fitted model's precisions_
@@ -1101,18 +1108,23 @@ def make_gmm_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
             ll = st.loglik / w_total
             hist = hist.at[it].set(ll)
             conv = jnp.abs(ll - prev) < tol
+            # All-finite flag (ISSUE 5): a non-finite log-likelihood
+            # stops the loop at the DIVERGING iteration (|NaN - prev| <
+            # tol is False, so without the flag the loop would spin NaNs
+            # to max_iter); healthy trajectories are untouched.
             return (it + 1, jnp.where(real[:, None], mu, means_c),
                     jnp.where(real[:, None], new_var, var), new_log_w,
-                    ll, hist, conv)
+                    ll, hist, conv, jnp.isfinite(ll))
 
         def cond(state):
-            it, *_, conv = state
-            return (it < max_iter) & ~conv
+            it, *_, conv, ok = state
+            return (it < max_iter) & ~conv & ok
 
         state = (jnp.int32(0), means0.astype(acc), var0.astype(acc),
                  log_w0.astype(acc), jnp.asarray(prev0).astype(acc),
-                 jnp.zeros((max_iter,), acc), jnp.asarray(False))
-        it, means_c, var, log_w, _, hist, conv = lax.while_loop(
+                 jnp.zeros((max_iter,), acc), jnp.asarray(False),
+                 jnp.asarray(True))
+        it, means_c, var, log_w, _, hist, conv, _ = lax.while_loop(
             cond, body, state)
         return means_c, var, log_w, it, hist, conv
 

@@ -186,6 +186,42 @@ def clamp_chunk_for_k(chunk: int, k: int,
     return small * 8
 
 
+#: choose_chunk_size's hard floor — the smallest chunk the auto rule
+#: ever emits (one TPU lane-width of rows).  ``backoff_chunk`` will not
+#: shrink below it: past this point the scan tiles are degenerate and a
+#: genuine OOM needs a different remedy (smaller k, more chips).
+MIN_CHUNK = 128
+
+
+def backoff_chunk(chunk: int, floor: int = MIN_CHUNK) -> Optional[int]:
+    """The next-smaller chunk for OOM-graceful degradation (ISSUE 5):
+    the LARGEST divisor of ``chunk`` that is ``<= chunk // 2`` and
+    ``>= floor`` — a divisor, because the dataset's padding committed to
+    whole-``chunk`` multiples per shard (``shard_points``), so only
+    divisors re-chunk the already-placed array without re-padding
+    (the same rule as ``clamp_chunk_for_k``).  Multiple-of-8 divisors
+    (the f32 sublane grid every auto-chosen chunk lives on) are
+    preferred; off-grid divisors are accepted only when no on-grid one
+    exists (explicit user chunks).  Returns ``None`` when no further
+    backoff is possible (``chunk`` already at or below the floor, or no
+    divisor in range) — the caller then re-raises the original OOM."""
+    if chunk <= floor:
+        return None
+    best_grid = best_any = None
+    i = 1
+    while i * i <= chunk:
+        if chunk % i == 0:
+            for cand in (i, chunk // i):
+                if floor <= cand <= chunk // 2:
+                    if cand % 8 == 0 and (best_grid is None
+                                          or cand > best_grid):
+                        best_grid = cand
+                    if best_any is None or cand > best_any:
+                        best_any = cand
+        i += 1
+    return best_grid if best_grid is not None else best_any
+
+
 def pad_points(x: np.ndarray, multiple: int) -> Tuple[np.ndarray, np.ndarray]:
     """Pad rows of (n, D) to a multiple; return (padded, 0/1 weights)."""
     n = x.shape[0]

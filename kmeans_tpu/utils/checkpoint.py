@@ -22,6 +22,22 @@ Fault-tolerance contract (ISSUE 4):
 * **Version gate** — a ``__format_version__`` NEWER than this build is
   rejected with an actionable message (upgrade, don't KeyError); an
   older one with its own message (re-save with a matching build).
+
+Elastic-resume contract (ISSUE 5):
+
+* **Canonical, unsharded state** — every checkpoint stores the fitted
+  state in topology-independent form: host ``numpy`` arrays at their
+  REAL shapes (``(k, D)`` centroid/mean tables, never the model-axis
+  padded ``(k_pad, ...)`` a particular TP layout commits to).  A
+  ``fit(resume=<path>)`` on ANY mesh size / TP sharding re-pads and
+  re-shards the canonical state for the resuming topology — the cost is
+  one gather at save time (already paid: states are host arrays) and
+  one re-shard at resume (the same ``device_put`` a fresh fit pays).
+* **Topology metadata** — ``topology_meta()`` stamps the mesh shape the
+  checkpoint was WRITTEN on (data/model shards), the jax version, the
+  compute dtype, and the format version into the JSON meta block;
+  ``describe_checkpoint`` (the ``python -m kmeans_tpu ckpt-info``
+  backend) reads it without constructing a model.
 """
 
 from __future__ import annotations
@@ -124,10 +140,16 @@ def load_state(path) -> Dict[str, Any]:
     return _load_state_at(_normalize(path))
 
 
-def _load_state_at(path: Path) -> Dict[str, Any]:
-    """Load an EXACT path (no .npz normalization — also serves the
-    ``.prev`` rotation slot), translating every parse-level failure into
-    a :class:`CheckpointCorruptError` naming the file."""
+def _parse_npz(path: Path, materialize: bool):
+    """Shared parse of a checkpoint ``.npz``: returns
+    ``(meta_dict, arrays)`` with every parse-level failure translated
+    into a :class:`CheckpointCorruptError` naming the file and the
+    format version gate applied.  ``materialize=False`` reads ONLY the
+    JSON ``__meta__`` member (``np.load`` is lazy per member; the zip
+    central directory at the file's tail still catches torn writes) and
+    returns ``arrays=None`` — the one corruption-classification rule
+    serving both the full loader and the metadata-only ``ckpt-info``
+    path (review r10)."""
     try:
         with np.load(path, allow_pickle=False) as z:
             if "__meta__" not in z.files:
@@ -135,7 +157,8 @@ def _load_state_at(path: Path) -> Dict[str, Any]:
                     path, "missing __meta__ record — not a kmeans_tpu "
                           "checkpoint")
             raw_meta = str(z["__meta__"])
-            arrays = {k: z[k] for k in z.files if k != "__meta__"}
+            arrays = {k: z[k] for k in z.files if k != "__meta__"} \
+                if materialize else None
     except (zipfile.BadZipFile, EOFError, OSError, KeyError,
             ValueError) as e:
         # np.load surfaces torn/garbage files as BadZipFile OR plain
@@ -148,12 +171,19 @@ def _load_state_at(path: Path) -> Dict[str, Any]:
         raise CheckpointCorruptError(path, f"{type(e).__name__}: {e}") \
             from e
     try:
-        state: Dict[str, Any] = json.loads(raw_meta)
+        meta: Dict[str, Any] = json.loads(raw_meta)
     except json.JSONDecodeError as e:
         raise CheckpointCorruptError(path, f"unparseable __meta__: {e}") \
             from e
-    ver = state.pop("__format_version__", None)
+    ver = meta.pop("__format_version__", None)
     _check_version(path, ver)           # version errors are NOT corruption
+    return meta, arrays
+
+
+def _load_state_at(path: Path) -> Dict[str, Any]:
+    """Load an EXACT path (no .npz normalization — also serves the
+    ``.prev`` rotation slot)."""
+    state, arrays = _parse_npz(path, materialize=True)
     state.update(arrays)
     return state
 
@@ -195,3 +225,92 @@ def load_state_with_fallback(path) -> Tuple[Dict[str, Any], bool]:
             raise CheckpointCorruptError(
                 path, f"{primary_err}; last-good fallback {prev} also "
                       f"unreadable ({e})") from e
+
+
+# ------------------------------------------------- topology metadata
+
+
+def topology_meta(mesh=None, model_shards=None, dtype=None) -> Dict[str, Any]:
+    """The metadata block every checkpoint carries (ISSUE 5): the mesh
+    shape the state was written on, the TP (model-axis) layout, the
+    compute dtype, the jax version, and the format version — all
+    JSON-serializable.  The block is INFORMATIONAL: resume never
+    requires the shapes to match (state is canonical/unsharded), but
+    the operator-facing ``ckpt-info`` command and the cross-mesh tests
+    read it to know what topology a checkpoint came from."""
+    import jax
+    data_shards = None
+    if mesh is not None:
+        from kmeans_tpu.parallel.mesh import mesh_shape
+        data_shards, model_shards = mesh_shape(mesh)
+    return {
+        "meta_format_version": FORMAT_VERSION,
+        "meta_jax_version": jax.__version__,
+        "meta_mesh_data_shards": data_shards,
+        "meta_mesh_model_shards": (int(model_shards)
+                                   if model_shards is not None else None),
+        "meta_dtype": str(dtype) if dtype is not None else None,
+    }
+
+
+def _read_meta_at(path: Path) -> Dict[str, Any]:
+    """Parse ONLY the JSON ``__meta__`` member of a checkpoint (no
+    array materialization — a multi-GB state describes in
+    milliseconds).  Torn/truncated writes still surface as
+    :class:`CheckpointCorruptError` via the zip central directory at
+    the file's tail; per-array corruption with an intact directory is
+    only caught by a full ``load_state`` (which ``fit(resume=...)``
+    performs anyway)."""
+    meta, _ = _parse_npz(path, materialize=False)
+    return meta
+
+
+def describe_checkpoint(path) -> Dict[str, Any]:
+    """Operator-facing summary of a checkpoint (the ``ckpt-info``
+    backend): model class, cluster count, completed iteration, the
+    topology metadata block, and whether the ``.prev`` last-good
+    rotation exists and its metadata reads.  Never constructs a model
+    and never materializes the array payload (``_read_meta_at`` — a
+    multi-GB checkpoint describes in milliseconds); works on
+    checkpoints from any family.  A corrupt/missing PRIMARY file is
+    reported (``primary_error``) with the summary taken from ``.prev``
+    when that still reads — the torn-checkpoint debugging surface."""
+    path = _normalize(path)
+    prev = prev_path(path)
+    out: Dict[str, Any] = {"path": str(path), "primary_error": None,
+                           "prev_exists": prev.exists(),
+                           "prev_loads": None, "source": None}
+    state = None
+    try:
+        state = _read_meta_at(path)
+        out["source"] = "primary"
+    except (CheckpointCorruptError, FileNotFoundError, ValueError) as e:
+        out["primary_error"] = str(e)
+    if out["prev_exists"]:
+        try:
+            prev_state = _read_meta_at(prev)
+            out["prev_loads"] = True
+            if state is None:
+                state = prev_state
+                out["source"] = "prev"
+        except (CheckpointCorruptError, ValueError) as e:
+            out["prev_loads"] = False
+            out["prev_error"] = str(e)
+    if state is None:
+        return out
+    k = state.get("k", state.get("n_components"))
+    out.update({
+        "model_class": state.get("model_class"),
+        "k": int(k) if k is not None else None,
+        "iteration": int(state.get("iterations_run",
+                                   state.get("n_iter_", 0))),
+        "format_version": int(state.get("meta_format_version",
+                                        FORMAT_VERSION)),
+        "jax_version": state.get("meta_jax_version"),
+        "dtype": state.get("meta_dtype", state.get("dtype")),
+        "written_on_mesh": {
+            "data_shards": state.get("meta_mesh_data_shards"),
+            "model_shards": state.get("meta_mesh_model_shards"),
+        },
+    })
+    return out

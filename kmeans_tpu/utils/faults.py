@@ -25,6 +25,13 @@ test.  This module is the one place those injections live:
   :class:`SimulatedPreemption` once the boundary iteration reaches
   ``j`` — the deterministic stand-in for a TPU preemption landing
   between segments.
+* ``inject_oom_on_segment(j)`` — arm the segment-dispatch hook: the
+  device-loop fit engines call :func:`on_segment_dispatch` immediately
+  before dispatching each segment, and the armed hook raises
+  :class:`SimulatedOOM` (message-compatible with XLA's
+  ``RESOURCE_EXHAUSTED``) the first ``times`` times segment ``j`` is
+  attempted — proving the OOM chunk-backoff recovery (ISSUE 5) through
+  the real dispatch loop, not a mock.
 
 All state is explicit (closures / context managers); nothing here is
 active unless a test arms it, and the hooks cost one empty-list check
@@ -40,9 +47,10 @@ from typing import Callable, Iterable, List, Optional
 import numpy as np
 
 __all__ = [
-    "TransientIOError", "SimulatedPreemption", "on_checkpoint",
-    "inject_kill_after_iteration", "fail_first_attempts", "flaky_blocks",
-    "poison_blocks",
+    "TransientIOError", "SimulatedPreemption", "SimulatedOOM",
+    "on_checkpoint", "on_segment_dispatch",
+    "inject_kill_after_iteration", "inject_oom_on_segment",
+    "fail_first_attempts", "flaky_blocks", "poison_blocks",
 ]
 
 
@@ -55,6 +63,22 @@ class TransientIOError(IOError):
 class SimulatedPreemption(RuntimeError):
     """Injected kill at a checkpoint boundary.  NOT an ``OSError``:
     preemptions must propagate out of the fit, never be retried."""
+
+
+class SimulatedOOM(RuntimeError):
+    """Injected device out-of-memory at a segment dispatch.  A
+    ``RuntimeError`` whose message carries XLA's ``RESOURCE_EXHAUSTED``
+    tag — the exact classification surface the production backoff
+    (``models.fault_tolerance.is_oom_error``) matches real
+    ``XlaRuntimeError`` OOMs on, so the injected failure exercises the
+    same detection path as a real one."""
+
+    def __init__(self, segment: int, chunk: int):
+        self.segment = segment
+        self.chunk = chunk
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: injected device OOM dispatching "
+            f"segment {segment} at chunk {chunk}")
 
 
 # --------------------------------------------------------------- hooks
@@ -104,6 +128,49 @@ def inject_kill_after_iteration(j: int):
         with _HOOK_LOCK:
             if hook in _CHECKPOINT_HOOKS:
                 _CHECKPOINT_HOOKS.remove(hook)
+
+
+# Segment-dispatch hook registry (ISSUE 5): the device-loop fit engines
+# call ``on_segment_dispatch(segment, chunk)`` immediately BEFORE each
+# segment dispatch (inside the OOM-backoff try block, so an injected
+# RESOURCE_EXHAUSTED takes exactly the recovery path a real one would).
+_SEGMENT_HOOKS: List[Callable[[int, int], None]] = []
+
+
+def on_segment_dispatch(segment: int, chunk: int) -> None:
+    """Fire the segment-dispatch hooks (called by the device-loop fit
+    engines right before dispatching segment ``segment`` with scan
+    chunk ``chunk``).  Production cost: one truthiness check."""
+    if _SEGMENT_HOOKS:
+        for hook in list(_SEGMENT_HOOKS):
+            hook(segment, chunk)
+
+
+@contextlib.contextmanager
+def inject_oom_on_segment(j: int, times: int = 1):
+    """Arm a deterministic device-OOM injection: the first ``times``
+    dispatch attempts of segment ``j`` raise :class:`SimulatedOOM`
+    (counted across backoff retries, so ``times=1`` proves one halving
+    recovers and ``times > max backoffs`` proves the bounded-attempts
+    re-raise).  Yields a record dict with ``fired`` (count) and
+    ``chunks`` (the chunk size each attempt was about to dispatch
+    with)."""
+    record = {"fired": 0, "chunks": []}
+
+    def hook(segment: int, chunk: int) -> None:
+        if segment == j and record["fired"] < times:
+            record["fired"] += 1
+            record["chunks"].append(chunk)
+            raise SimulatedOOM(segment, chunk)
+
+    with _HOOK_LOCK:
+        _SEGMENT_HOOKS.append(hook)
+    try:
+        yield record
+    finally:
+        with _HOOK_LOCK:
+            if hook in _SEGMENT_HOOKS:
+                _SEGMENT_HOOKS.remove(hook)
 
 
 # ------------------------------------------------------------ callables
@@ -168,28 +235,51 @@ def flaky_blocks(make_blocks: Callable[[], Iterable], *,
 
 def poison_blocks(make_blocks: Callable[[], Iterable], *,
                   block: int, value: float = np.nan,
-                  row: int = 0, col: int = 0) -> Callable[[], Iterable]:
-    """A ``make_blocks`` that poisons one element of block ``block``
-    (0-based position) with ``value`` (default NaN) every epoch —
-    the deterministic stand-in for a corrupted streamed block, used to
-    prove the ``on_nonfinite='error'|'skip'`` quarantine policy.  The
-    source items are not mutated (each poisoned block is a copy)."""
+                  row: int = 0, col: Optional[int] = 0, rows: int = 1,
+                  from_epoch: int = 0) -> Callable[[], Iterable]:
+    """A ``make_blocks`` that poisons block ``block`` (0-based position)
+    with ``value`` — the deterministic stand-in for a corrupted
+    streamed block.  Two injection shapes:
+
+    * ``col=<int>`` (default): a ``rows``-high column slab
+      ``b[row:row+rows, col] = value`` — with the NaN default this
+      proves the ``on_nonfinite='error'|'skip'`` quarantine policy.
+    * ``col=None``: a full-width slab ``b[row:row+rows, :] = value`` —
+      with a huge FINITE value (e.g. ``2e38``) the block passes the IO
+      finite check but the identically-poisoned rows land in one
+      cluster and overflow the f32 device accumulator, driving the
+      FIT's trajectory non-finite: the deterministic trigger for the
+      divergence-rollback path (ISSUE 5), which the IO quarantine must
+      NOT intercept.
+
+    ``from_epoch=N`` delays the poison until the (0-based) Nth
+    invocation of ``make_blocks`` — a fit healthy for several epochs
+    (accumulating checkpoints) then hit mid-fit, so the rollback has a
+    last-good state to restore.  The source items are never mutated
+    (each poisoned block is a copy); the wrapper carries
+    ``.state['epochs']`` for assertions."""
+    state = {"epochs": 0}
 
     def make():
+        epoch = state["epochs"]
+        state["epochs"] += 1
+
         def gen():
             for pos, item in enumerate(make_blocks()):
-                if pos != block:
+                if pos != block or epoch < from_epoch:
                     yield item
                     continue
                 if isinstance(item, tuple):
                     b, w = item
-                    b = np.array(b, copy=True)
-                    b[row, col] = value
-                    yield b, w
                 else:
-                    b = np.array(item, copy=True)
-                    b[row, col] = value
-                    yield b
+                    b, w = item, None
+                b = np.array(b, copy=True)
+                if col is None:
+                    b[row: row + rows, :] = value
+                else:
+                    b[row: row + rows, col] = value
+                yield b if w is None else (b, w)
         return gen()
 
+    make.state = state
     return make

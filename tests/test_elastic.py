@@ -1,0 +1,691 @@
+"""Elastic resume (ISSUE 5): topology-portable checkpoints, OOM-graceful
+chunk backoff, and in-loop divergence rollback — every claim proved
+through the real code path under deterministic injection
+(``utils.faults``), the ISSUE-4 discipline.
+
+Parity classes (measured on this platform, pinned accordingly):
+
+* **Bit-exact cross-mesh** — the K-Means family's device/host loops at
+  ``dtype=float64``: f32-width data sums EXACTLY in f64 (24-bit
+  mantissas + small exponent spread < 53 bits), so the psum/scan
+  regrouping a different mesh width or scan chunk implies is invariant
+  and the centroid trajectory is bitwise identical.  The cross-mesh
+  resume matrix and the injected-OOM replay pin this with
+  ``assert_array_equal``.  (``sse_history`` is a deliberate f32
+  reduction — ``distributed._sse_from_stats`` — and is compared
+  to rtol instead.)
+* **Last-ulp cross-mesh** — the mixture E-pass accumulates
+  softmax-weighted moments whose exponent spread defeats exact f64
+  summation: cross-mesh GMM trajectories agree to ~1e-14 relative
+  (measured 4e-15 at the test shapes) with identical iteration counts;
+  pinned with tight ``allclose``.  Same-topology resume through the
+  CANONICAL (trimmed) table round trip stays bitwise — pinned.
+* **Stream-divergent** — MiniBatch draws its batches per-shard, so a
+  different data-mesh width IS a different batch sequence (the r5
+  forgy-note class of documented RNG-stream divergence): cross-mesh
+  resume is pinned to run/complete with the same iteration budget and
+  a healthy final state, not bitwise.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+
+from kmeans_tpu import KMeans, NumericalDivergenceError
+from kmeans_tpu.models import (BisectingKMeans, GaussianMixture,
+                               MiniBatchKMeans, SphericalKMeans)
+from kmeans_tpu.models.fault_tolerance import is_oom_error
+from kmeans_tpu.parallel.mesh import make_mesh
+from kmeans_tpu.parallel.sharding import backoff_chunk
+from kmeans_tpu.utils import checkpoint as ckpt
+from kmeans_tpu.utils import faults
+
+WIDTHS = (1, 2, 4, 8)
+
+
+def _mesh(w, m=1):
+    if len(jax.devices()) < w * m:
+        pytest.skip(f"needs {w * m} devices")
+    return make_mesh(data=w, model=m, devices=jax.devices()[: w * m])
+
+
+def _blobs(n=2000, d=3, centers=4, rs=9):
+    # n=2000/rs=9 runs ~17 Lloyd iterations at tolerance=1e-12 (the
+    # test_faults fixture): long enough that every kill boundary below
+    # lands MID-fit.
+    X, _ = make_blobs(n_samples=n, centers=centers, n_features=d,
+                      random_state=rs)
+    return X.astype(np.float32)
+
+
+def _blocks_of(X, rows=256):
+    def make_blocks():
+        def gen():
+            for i in range(0, X.shape[0], rows):
+                yield X[i: i + rows]
+        return gen()
+    return make_blocks
+
+
+def _fit_killed(model, j, fit_call):
+    with faults.inject_kill_after_iteration(j) as rec:
+        with pytest.raises(faults.SimulatedPreemption):
+            fit_call(model)
+    assert rec["fired_at"] is not None and rec["fired_at"] >= j
+    return rec["fired_at"]
+
+
+# ------------------------------------------- cross-mesh parity matrix
+
+_KM_KW = dict(k=4, max_iter=14, tolerance=1e-12, seed=1, compute_sse=True,
+              empty_cluster="keep", host_loop=False, verbose=False,
+              dtype=np.float64)
+
+# Module-level caches so the {1,2,4,8} x {1,2,4,8} matrix costs
+# 4 uninterrupted fits + 4 killed checkpoints, not 16 of each.
+_FULL_RUNS: dict = {}
+_CKPTS: dict = {}
+
+
+def _full_on(width) -> KMeans:
+    if width not in _FULL_RUNS:
+        _FULL_RUNS[width] = KMeans(mesh=_mesh(width), **_KM_KW).fit(
+            _blobs())
+    return _FULL_RUNS[width]
+
+
+def _ckpt_from(width, tmp_path_factory) -> str:
+    if width not in _CKPTS:
+        p = str(tmp_path_factory.mktemp(f"xmesh{width}") / "ck.npz")
+        _fit_killed(
+            KMeans(mesh=_mesh(width), **_KM_KW), 4,
+            lambda m: m.fit(_blobs(), checkpoint_every=2,
+                            checkpoint_path=p))
+        _CKPTS[width] = p
+    return _CKPTS[width]
+
+
+@pytest.mark.parametrize("resume_w", WIDTHS)
+@pytest.mark.parametrize("write_w", WIDTHS)
+def test_kmeans_cross_mesh_matrix(tmp_path_factory, write_w, resume_w):
+    """The full write-on-N x resume-on-M matrix, device loop, float64:
+    a checkpoint killed mid-fit on an N-way mesh resumes on an M-way
+    mesh BIT-identical (centroids, iteration count) to the
+    uninterrupted fit on the M-way mesh — the acceptance pin."""
+    full = _full_on(resume_w)
+    p = _ckpt_from(write_w, tmp_path_factory)
+    info = ckpt.describe_checkpoint(p)
+    assert info["written_on_mesh"]["data_shards"] == write_w
+    resumed = KMeans(mesh=_mesh(resume_w), **_KM_KW)
+    resumed.fit(_blobs(), resume=p)
+    assert resumed.iterations_run == full.iterations_run
+    np.testing.assert_array_equal(resumed.centroids, full.centroids)
+    # SSE history is a deliberate f32 device reduction (not part of the
+    # trajectory) — regrouping across meshes moves the last ulp.
+    np.testing.assert_allclose(resumed.sse_history, full.sse_history,
+                               rtol=1e-6)
+
+
+def test_kmeans_cross_mesh_host_loop(tmp_path):
+    """Host-loop cell: the f64 host finish consumes f64-exact device
+    statistics, so write-on-8 -> resume-on-2 is bitwise there too."""
+    kw = dict(_KM_KW, host_loop=True)
+    X = _blobs()
+    full = KMeans(mesh=_mesh(2), **kw).fit(X)
+    p = tmp_path / "host.npz"
+    _fit_killed(KMeans(mesh=_mesh(8), **kw), 4,
+                lambda m: m.fit(X, checkpoint_every=2,
+                                checkpoint_path=p))
+    resumed = KMeans(mesh=_mesh(2), **kw)
+    resumed.fit(X, resume=p)
+    assert resumed.iterations_run == full.iterations_run
+    np.testing.assert_array_equal(resumed.centroids, full.centroids)
+
+
+@pytest.mark.parametrize("write_w,resume_w", [(8, 2), (2, 8)])
+def test_bisecting_cross_mesh(tmp_path, write_w, resume_w):
+    X = _blobs(n=1500, d=4, centers=6, rs=2)
+    kw = dict(k=6, max_iter=18, tolerance=1e-10, seed=7, compute_sse=True,
+              host_loop=False, verbose=False, dtype=np.float64)
+    full = BisectingKMeans(mesh=_mesh(resume_w), **kw).fit(X)
+    p = tmp_path / "bk.npz"
+    _fit_killed(BisectingKMeans(mesh=_mesh(write_w), **kw), 3,
+                lambda m: m.fit(X, checkpoint_every=1,
+                                checkpoint_path=p))
+    resumed = BisectingKMeans(mesh=_mesh(resume_w), **kw)
+    resumed.fit(X, resume=p)
+    assert resumed.iterations_run == full.iterations_run
+    np.testing.assert_array_equal(resumed.centroids, full.centroids)
+    np.testing.assert_array_equal(resumed.labels_, full.labels_)
+
+
+@pytest.mark.parametrize("write_w,resume_w", [(8, 2), (2, 8)])
+def test_spherical_cross_mesh(tmp_path, write_w, resume_w):
+    """Spherical projects through full-mantissa divisions whose last
+    ulp is platform-fusion-sensitive: iteration counts pin exactly,
+    directions to 1e-12 (measured 1 ulp at this shape)."""
+    X = _blobs(d=4)
+    kw = dict(k=4, max_iter=20, tolerance=1e-12, seed=3, compute_sse=True,
+              empty_cluster="keep", host_loop=False, verbose=False,
+              dtype=np.float64)
+    full = SphericalKMeans(mesh=_mesh(resume_w), **kw).fit(X)
+    p = tmp_path / "sp.npz"
+    _fit_killed(SphericalKMeans(mesh=_mesh(write_w), **kw), 4,
+                lambda m: m.fit(X, checkpoint_every=2,
+                                checkpoint_path=p))
+    resumed = SphericalKMeans(mesh=_mesh(resume_w), **kw)
+    resumed.fit(X, resume=p)
+    assert resumed.iterations_run == full.iterations_run
+    np.testing.assert_allclose(resumed.centroids, full.centroids,
+                               rtol=0, atol=1e-12)
+    assert np.allclose(np.linalg.norm(resumed.centroids, axis=1), 1.0,
+                       atol=1e-9)
+
+
+@pytest.mark.parametrize("cov_type", ["diag", "full", "tied",
+                                      "spherical"])
+@pytest.mark.parametrize("write_w,resume_w", [(8, 2), (2, 8)])
+def test_gmm_cross_mesh(tmp_path, cov_type, write_w, resume_w):
+    """Mixture cells, all four covariance types: iteration counts and
+    convergence pin exactly; parameters to the measured last-ulp
+    cross-mesh class (softmax-weighted f64 moments regroup at ~1e-15;
+    see module docstring)."""
+    X = _blobs(n=1500)
+    kw = dict(n_components=4, covariance_type=cov_type, tol=1e-6,
+              max_iter=60, init_params="random", seed=0, host_loop=False,
+              verbose=False, dtype=np.float64)
+    full = GaussianMixture(mesh=_mesh(resume_w), **kw).fit(X)
+    assert full.converged_          # the comparison needs a settled run
+    p = tmp_path / "g.npz"
+    _fit_killed(GaussianMixture(mesh=_mesh(write_w), **kw), 4,
+                lambda m: m.fit(X, checkpoint_every=2,
+                                checkpoint_path=p))
+    resumed = GaussianMixture(mesh=_mesh(resume_w), **kw)
+    resumed.fit(X, resume=p)
+    assert resumed.n_iter_ == full.n_iter_
+    assert resumed.converged_ == full.converged_
+    np.testing.assert_allclose(resumed.means_, full.means_,
+                               rtol=1e-10, atol=1e-11)
+    np.testing.assert_allclose(resumed.covariances_, full.covariances_,
+                               rtol=1e-9, atol=1e-11)
+    np.testing.assert_allclose(resumed.weights_, full.weights_,
+                               rtol=1e-9, atol=1e-12)
+
+
+def test_gmm_cross_tp_layout(tmp_path):
+    """TP-layout portability with k NOT divisible by the model axis
+    (k=5: k_pad differs between TP=2 and TP=1): the canonical trimmed
+    dev tables re-pad for the resuming layout.  Also pins the
+    same-layout round trip through the canonical format BITWISE —
+    trimming + re-padding must reproduce the padded carry exactly."""
+    X = _blobs()
+    kw = dict(n_components=5, tol=1e-6, max_iter=80, init_params="random",
+              seed=0, host_loop=False, verbose=False, dtype=np.float64)
+    mesh_tp = _mesh(4, 2)
+    full_tp = GaussianMixture(mesh=mesh_tp, model_shards=2, **kw).fit(X)
+    assert full_tp.converged_
+    p = tmp_path / "gtp.npz"
+    _fit_killed(
+        GaussianMixture(mesh=mesh_tp, model_shards=2, **kw), 4,
+        lambda m: m.fit(X, checkpoint_every=2, checkpoint_path=p))
+    # Same layout: canonical round trip is bit-exact.
+    same = GaussianMixture(mesh=mesh_tp, model_shards=2, **kw)
+    same.fit(X, resume=p)
+    assert same.n_iter_ == full_tp.n_iter_
+    np.testing.assert_array_equal(same.means_, full_tp.means_)
+    np.testing.assert_array_equal(same.covariances_,
+                                  full_tp.covariances_)
+    # Different TP layout (k_pad 6 -> 5): last-ulp class.
+    full_dp = GaussianMixture(mesh=_mesh(8), **kw).fit(X)
+    other = GaussianMixture(mesh=_mesh(8), **kw)
+    other.fit(X, resume=p)
+    assert other.n_iter_ == full_dp.n_iter_
+    np.testing.assert_allclose(other.means_, full_dp.means_,
+                               rtol=1e-10, atol=1e-11)
+
+
+@pytest.mark.parametrize("write_w,resume_w", [(8, 2), (2, 8)])
+def test_minibatch_cross_mesh_runs(tmp_path, write_w, resume_w):
+    """MiniBatch samples per shard: a different mesh width IS a
+    different (deterministic) batch stream — the r5 forgy-note class of
+    documented RNG divergence, so the cross-mesh pin is behavioral:
+    the resume loads, keeps the iteration budget, and lands a healthy
+    state near the uninterrupted run's quality."""
+    X = _blobs(n=2000)
+    kw = dict(k=4, max_iter=24, tolerance=1e-12, seed=3, batch_size=256,
+              compute_sse=True, host_loop=False, verbose=False,
+              dtype=np.float64)
+    full = MiniBatchKMeans(mesh=_mesh(resume_w), **kw).fit(X)
+    p = tmp_path / "mb.npz"
+    _fit_killed(MiniBatchKMeans(mesh=_mesh(write_w), **kw), 10,
+                lambda m: m.fit(X, checkpoint_every=5,
+                                checkpoint_path=p))
+    resumed = MiniBatchKMeans(mesh=_mesh(resume_w), **kw)
+    resumed.fit(X, resume=p)
+    assert resumed.iterations_run == full.iterations_run
+    assert np.all(np.isfinite(resumed.centroids))
+    assert resumed.centroids.shape == full.centroids.shape
+    # Same data, same k: the two topologies' fits must land in the
+    # same quality basin even though the batch streams differ.
+    assert abs(resumed.score(X) - full.score(X)) \
+        <= 0.1 * abs(full.score(X))
+
+
+def test_f32_cross_mesh_is_distributional(tmp_path):
+    """float32 accumulation regroups inexactly across mesh widths, so
+    the f32 cross-mesh pin is equal-in-distribution (documented in
+    docs/PERFORMANCE.md "Elastic resume"): the resume runs and the
+    final inertia matches the uninterrupted run's to rounding."""
+    X = _blobs()
+    kw = dict(k=4, max_iter=14, tolerance=1e-12, seed=1,
+              empty_cluster="keep", host_loop=False, verbose=False)
+    full = KMeans(mesh=_mesh(2), **kw).fit(X)
+    p = tmp_path / "f32.npz"
+    _fit_killed(KMeans(mesh=_mesh(8), **kw), 4,
+                lambda m: m.fit(X, checkpoint_every=2,
+                                checkpoint_path=p))
+    resumed = KMeans(mesh=_mesh(2), **kw)
+    resumed.fit(X, resume=p)
+    assert resumed.iterations_run == full.iterations_run
+    assert abs(resumed.score(X) - full.score(X)) \
+        <= 1e-3 * abs(full.score(X))
+
+
+# --------------------------------------------------- OOM chunk backoff
+
+def test_backoff_chunk_rules():
+    assert backoff_chunk(256) == 128
+    assert backoff_chunk(131072) == 65536
+    assert backoff_chunk(1024) == 512
+    assert backoff_chunk(384) == 192
+    assert backoff_chunk(128) is None          # at the floor
+    assert backoff_chunk(64) is None
+    assert backoff_chunk(250) is None          # no divisor >= 128
+    # Off-grid chunks fall back to any divisor >= the floor.
+    assert backoff_chunk(300) == 150
+
+
+def test_is_oom_classification():
+    assert is_oom_error(faults.SimulatedOOM(0, 256))
+    assert is_oom_error(RuntimeError("RESOURCE_EXHAUSTED: Out of "
+                                     "memory allocating 1024 bytes"))
+    assert not is_oom_error(faults.SimulatedPreemption("kill"))
+    assert not is_oom_error(ValueError("RESOURCE_EXHAUSTED"))
+    assert not is_oom_error(RuntimeError("something else"))
+
+
+def test_oom_backoff_replays_segment_bitwise(tmp_path):
+    """Injected RESOURCE_EXHAUSTED on segment 1: the chunk halves
+    (256 -> 128), the segment replays from the checkpoint boundary, and
+    the f64 trajectory is reproduced BITWISE vs the no-OOM run."""
+    X = _blobs()
+    kw = dict(k=4, max_iter=14, tolerance=1e-12, seed=1,
+              compute_sse=True, empty_cluster="keep", host_loop=False,
+              verbose=False, chunk_size=256, dtype=np.float64)
+    clean = KMeans(mesh=_mesh(8), **kw).fit(
+        X, checkpoint_every=3, checkpoint_path=tmp_path / "c.npz")
+    m = KMeans(mesh=_mesh(8), **kw)
+    with faults.inject_oom_on_segment(1) as rec:
+        with pytest.warns(UserWarning, match="retrying at chunk 128"):
+            m.fit(X, checkpoint_every=3,
+                  checkpoint_path=tmp_path / "o.npz")
+    assert rec["fired"] == 1 and rec["chunks"] == [256]
+    assert m.oom_backoffs_ == 1
+    assert m.effective_chunk_ == 128
+    assert m.iterations_run == clean.iterations_run
+    np.testing.assert_array_equal(m.centroids, clean.centroids)
+    # SSE is the deliberate f32 reduction; a chunk change regroups it.
+    np.testing.assert_allclose(m.sse_history, clean.sse_history,
+                               rtol=1e-6)
+
+
+def test_oom_backoff_gmm_device_loop(tmp_path):
+    X = _blobs(n=1500)
+    kw = dict(n_components=4, tol=1e-6, max_iter=60,
+              init_params="random", seed=0, host_loop=False,
+              verbose=False, chunk_size=256, dtype=np.float64)
+    clean = GaussianMixture(mesh=_mesh(8), **kw).fit(
+        X, checkpoint_every=3, checkpoint_path=tmp_path / "c.npz")
+    m = GaussianMixture(mesh=_mesh(8), **kw)
+    with faults.inject_oom_on_segment(1) as rec:
+        with pytest.warns(UserWarning, match="retrying at chunk 128"):
+            m.fit(X, checkpoint_every=3,
+                  checkpoint_path=tmp_path / "o.npz")
+    assert rec["fired"] == 1
+    assert m.oom_backoffs_ == 1 and m.effective_chunk_ == 128
+    assert m.n_iter_ == clean.n_iter_
+    np.testing.assert_allclose(m.means_, clean.means_,
+                               rtol=1e-10, atol=1e-11)
+
+
+def test_oom_backoff_exhausted_reraises(tmp_path):
+    """At the 128-row floor no further backoff exists: the original
+    RESOURCE_EXHAUSTED propagates with the remedy chained in, and the
+    counters record the attempts that were made."""
+    X = _blobs()
+    m = KMeans(k=4, max_iter=10, tolerance=1e-12, seed=1,
+               empty_cluster="keep", host_loop=False, verbose=False,
+               chunk_size=128, mesh=_mesh(8))
+    with faults.inject_oom_on_segment(0):
+        with pytest.raises(RuntimeError, match="chunk backoff "
+                                               "exhausted"):
+            m.fit(X, checkpoint_every=3,
+                  checkpoint_path=tmp_path / "x.npz")
+    assert m.oom_backoffs_ == 0
+
+
+def test_oom_counters_reset_between_fits(tmp_path):
+    X = _blobs()
+    kw = dict(k=4, max_iter=8, tolerance=1e-12, seed=1,
+              empty_cluster="keep", host_loop=False, verbose=False,
+              chunk_size=256, mesh=_mesh(8))
+    m = KMeans(**kw)
+    with faults.inject_oom_on_segment(0):
+        with pytest.warns(UserWarning, match="retrying at chunk"):
+            m.fit(X, checkpoint_every=4,
+                  checkpoint_path=tmp_path / "a.npz")
+    assert m.oom_backoffs_ == 1
+    m.fit(X)
+    assert m.oom_backoffs_ == 0 and m.effective_chunk_ == 256
+
+
+def test_preemption_is_never_absorbed_by_backoff(tmp_path):
+    """A SimulatedPreemption fired at a boundary must pass straight
+    through the OOM machinery (is_oom_error excludes it)."""
+    X = _blobs()
+    m = KMeans(k=4, max_iter=10, tolerance=1e-12, seed=1,
+               empty_cluster="keep", host_loop=False, verbose=False,
+               chunk_size=256, mesh=_mesh(8))
+    _fit_killed(m, 2, lambda mm: mm.fit(
+        X, checkpoint_every=2, checkpoint_path=tmp_path / "p.npz"))
+    assert m.oom_backoffs_ == 0
+
+
+# ------------------------------------------------- divergence rollback
+
+def test_stream_divergence_rolls_back_to_last_good(tmp_path):
+    """A mid-fit poisoned block (huge FINITE values: passes the IO
+    finite check, overflows the f32 device accumulator) diverges the
+    trajectory; the fit rolls back to the last-good checkpoint and the
+    error names the iteration and quantity."""
+    X = _blobs(n=2000)
+    poisoned = faults.poison_blocks(
+        _blocks_of(X), block=3, value=2e38, row=0, rows=4, col=None,
+        from_epoch=5)
+    p = tmp_path / "div.npz"
+    m = KMeans(k=4, max_iter=20, tolerance=1e-12, seed=1,
+               compute_sse=True, mesh=_mesh(8), verbose=False)
+    with pytest.raises(NumericalDivergenceError) as ei:
+        m.fit_stream(poisoned, d=3, prefetch=0, checkpoint_every=2,
+                     checkpoint_path=p)
+    e = ei.value
+    assert e.quantity == "centroids"
+    assert e.rolled_back_to is not None
+    assert f"iteration {e.iteration}" in str(e)
+    assert "rolled back" in str(e)
+    state = ckpt.load_state(p)
+    assert int(state["iterations_run"]) == e.rolled_back_to
+    np.testing.assert_array_equal(m.cluster_centers_,
+                                  state["centroids"])
+    assert np.all(np.isfinite(m.cluster_centers_))
+
+
+def test_device_loop_divergence_stops_early_and_rolls_back(tmp_path):
+    """The in-loop all-finite flag exits the dispatch AT the diverging
+    iteration (not max_iter later); resume-onto-poisoned-data is the
+    in-memory trigger: the checkpointed prefix state survives."""
+    X = _blobs()
+    p = tmp_path / "g.npz"
+    kw = dict(k=4, max_iter=6, tolerance=1e-12, seed=1, mesh=_mesh(8),
+              host_loop=False, verbose=False)
+    KMeans(**kw).fit(X, checkpoint_every=2, checkpoint_path=p)
+    good = ckpt.load_state(p)
+    pX = X.copy()
+    pX[100] = np.nan                   # corrupted re-materialized data
+    m = KMeans(**dict(kw, max_iter=40))
+    with pytest.raises(NumericalDivergenceError) as ei:
+        m.fit(pX, resume=p, checkpoint_every=2, checkpoint_path=p)
+    e = ei.value
+    assert e.quantity == "centroids"
+    # Early exit: the NaN lands in iteration 7 (first of the resumed
+    # segment), nowhere near the 40-iteration budget.
+    assert e.iteration == int(good["iterations_run"]) + 1
+    assert e.rolled_back_to == int(good["iterations_run"])
+    np.testing.assert_array_equal(m.cluster_centers_,
+                                  good["centroids"])
+
+
+def test_divergence_never_restores_a_stale_foreign_checkpoint(tmp_path):
+    """Review r10: a fit that reuses a checkpoint path from an EARLIER,
+    unrelated fit and diverges before writing its own first checkpoint
+    must NOT silently restore the stale file's state — rollback is only
+    legal for a checkpoint this fit wrote or resumed from."""
+    X = _blobs()
+    p = tmp_path / "stale.npz"
+    kw = dict(k=4, max_iter=6, tolerance=1e-12, seed=1, mesh=_mesh(8),
+              host_loop=False, verbose=False)
+    KMeans(**kw).fit(X, checkpoint_every=2, checkpoint_path=p)  # fit A
+    stale = ckpt.load_state(p)
+    pX = _blobs(rs=3)                      # fit B: different data
+    pX[5] = np.nan
+    b = KMeans(**kw)
+    with pytest.raises(NumericalDivergenceError) as ei:
+        b.fit(pX, checkpoint_every=2, checkpoint_path=p)
+    assert ei.value.rolled_back_to is None
+    assert b.cluster_centers_ is None or not np.array_equal(
+        b.cluster_centers_, stale["centroids"])
+
+
+def test_partial_fit_divergence_keeps_incremental_progress(tmp_path):
+    """Review r10: partial_fit is not a checkpointed session — a
+    diverging batch must raise IN PLACE, never roll the model back to
+    the stale checkpoint a previous fit() left at the path (which
+    would silently destroy all incremental progress since)."""
+    X = _blobs()
+    p = tmp_path / "mbfit.npz"
+    m = MiniBatchKMeans(k=4, max_iter=6, tolerance=1e-12, seed=3,
+                        batch_size=256, mesh=_mesh(8), verbose=False)
+    m.fit(X, checkpoint_every=2, checkpoint_path=p)
+    fit_iters = m.iterations_run
+    for i in range(5):
+        m.partial_fit(X[i * 200: (i + 1) * 200])
+    assert m.iterations_run == fit_iters + 5
+    healthy = np.array(m.centroids)
+    bad = X[:200].copy()
+    bad[3] = np.inf
+    with pytest.raises(NumericalDivergenceError) as ei:
+        m.partial_fit(bad)
+    assert ei.value.rolled_back_to is None
+    assert ei.value.checkpoint_path is None
+    np.testing.assert_array_equal(m.centroids, healthy)
+    assert m.iterations_run == fit_iters + 5
+
+
+@pytest.mark.parametrize("host_loop", [True, False])
+def test_divergence_without_checkpoint_is_plain_error(host_loop):
+    """Un-checkpointed fits keep the historical ValueError contract
+    (NumericalDivergenceError subclasses it, message phrase intact) —
+    with the iteration/quantity now attached and nothing rolled back."""
+    X = _blobs()
+    pX = X.copy()
+    pX[7] = np.inf
+    m = KMeans(k=4, max_iter=8, tolerance=1e-12, seed=1, mesh=_mesh(8),
+               host_loop=host_loop, verbose=False)
+    with pytest.raises(ValueError,
+                       match="NaN or Inf detected in centroids") as ei:
+        m.fit(pX)
+    assert isinstance(ei.value, NumericalDivergenceError)
+    assert ei.value.rolled_back_to is None
+
+
+def test_gmm_stream_divergence_rolls_back(tmp_path):
+    X = _blobs(n=1200, centers=3, rs=5)
+    poisoned = faults.poison_blocks(
+        _blocks_of(X, rows=300), block=2, value=2e38, row=0, rows=4,
+        col=None, from_epoch=6)
+    p = tmp_path / "gdiv.npz"
+    gm = GaussianMixture(n_components=3, tol=1e-9, max_iter=30,
+                         init_params="random", seed=0, mesh=_mesh(8),
+                         verbose=False)
+    with pytest.raises(NumericalDivergenceError) as ei:
+        gm.fit_stream(poisoned, d=3, prefetch=0, checkpoint_every=2,
+                      checkpoint_path=p)
+    e = ei.value
+    assert e.quantity == "log-likelihood"
+    assert "non-finite log-likelihood" in str(e)
+    assert e.rolled_back_to is not None
+    state = ckpt.load_state(p)
+    np.testing.assert_array_equal(gm.means_, state["means_"])
+    assert np.all(np.isfinite(gm.means_))
+
+
+# -------------------------------------------- Cholesky jitter ladder
+
+def test_cholesky_jitter_ladder_rescues_borderline():
+    gm = GaussianMixture(n_components=2, covariance_type="full",
+                         reg_covar=1e-4, seed=0, verbose=False)
+    d = 3
+    good = np.eye(d)
+    # Indefinite by a hair: smallest eigenvalue -1e-5, inside the
+    # reg_covar * 10^j <= 0.1 ladder's reach.
+    bad = np.eye(d)
+    bad[0, 0] = -1e-5
+    covs = np.stack([good, bad])
+    with pytest.warns(UserWarning, match="jitter ladder"):
+        p_chol, ldh = gm._prec_chol_guarded(covs)
+    assert gm.cov_jitter_retries_ >= 1
+    assert np.all(np.isfinite(p_chol)) and np.all(np.isfinite(ldh))
+
+
+def test_cholesky_jitter_ladder_exhausts_actionably():
+    gm = GaussianMixture(n_components=2, covariance_type="full",
+                         reg_covar=1e-9, seed=0, verbose=False)
+    bad = -np.eye(3)                   # hopeless: -1 eigenvalues
+    covs = np.stack([np.eye(3), bad])
+    with pytest.raises(ValueError) as ei:
+        gm._prec_chol_guarded(covs)
+    msg = str(ei.value)
+    assert "ill-defined empirical covariance" in msg
+    assert "component(s) [1]" in msg
+    assert gm.cov_jitter_retries_ == 0
+
+
+def test_cholesky_ladder_is_fit_only_inference_stays_strict():
+    """Review r10: the jitter ladder serves the FIT path only — predict
+    on a model whose covariances cannot factor must raise the strict
+    ill-defined error, not silently score jittered densities, and the
+    fit-time audit counter must not move."""
+    X = _blobs(d=3)
+    gm = GaussianMixture(n_components=2, covariance_type="full",
+                         reg_covar=1e-4, max_iter=3,
+                         init_params="random", seed=0, mesh=_mesh(8),
+                         verbose=False).fit(X)
+    gm.covariances_ = np.stack([np.eye(3), -np.eye(3)])
+    before = gm.cov_jitter_retries_
+    with pytest.raises(ValueError,
+                       match="ill-defined empirical covariance"):
+        gm.predict(X[:16])
+    assert gm.cov_jitter_retries_ == before
+
+
+def test_cholesky_ladder_tied_names_shared_cov():
+    gm = GaussianMixture(n_components=2, covariance_type="tied",
+                         reg_covar=0.0, seed=0, verbose=False)
+    with pytest.raises(ValueError, match="shared tied covariance"):
+        gm._prec_chol_guarded(-np.eye(3))
+
+
+# --------------------------------------- metadata + ckpt-info command
+
+def test_checkpoint_carries_topology_metadata(tmp_path):
+    X = _blobs()
+    p = tmp_path / "meta.npz"
+    KMeans(k=4, max_iter=4, seed=1, mesh=_mesh(4, 2), model_shards=2,
+           verbose=False).fit(X, checkpoint_every=2, checkpoint_path=p)
+    info = ckpt.describe_checkpoint(p)
+    assert info["source"] == "primary"
+    assert info["model_class"] == "KMeans"
+    assert info["k"] == 4
+    assert info["iteration"] >= 2
+    assert info["written_on_mesh"] == {"data_shards": 4,
+                                       "model_shards": 2}
+    assert info["format_version"] == ckpt.FORMAT_VERSION
+    assert info["jax_version"] == jax.__version__
+    assert info["prev_exists"] and info["prev_loads"]
+
+
+def test_metadata_present_in_every_family(tmp_path):
+    X = _blobs(d=4, centers=4)
+    models = [
+        KMeans(k=4, max_iter=2, verbose=False, mesh=_mesh(8)),
+        MiniBatchKMeans(k=4, max_iter=2, batch_size=128, verbose=False,
+                        mesh=_mesh(8)),
+        SphericalKMeans(k=4, max_iter=2, verbose=False, mesh=_mesh(8)),
+        BisectingKMeans(k=3, max_iter=2, verbose=False, mesh=_mesh(8)),
+        GaussianMixture(n_components=3, max_iter=2,
+                        init_params="random", verbose=False,
+                        mesh=_mesh(8)),
+    ]
+    for m in models:
+        m.fit(X)
+        state = m._state_dict()
+        assert state["meta_mesh_data_shards"] == 8, type(m).__name__
+        assert state["meta_format_version"] == ckpt.FORMAT_VERSION
+        assert state["meta_jax_version"] == jax.__version__
+
+
+def test_ckpt_info_cli(tmp_path, capsys):
+    from kmeans_tpu.cli import ckpt_info_main
+    X = _blobs()
+    p = tmp_path / "cli.npz"
+    KMeans(k=4, max_iter=4, seed=1, mesh=_mesh(8), verbose=False).fit(
+        X, checkpoint_every=2, checkpoint_path=p)
+    assert ckpt_info_main([str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "KMeans" in out and "data_shards=8" in out
+    assert ".prev rotation  : exists=True, loads=True" in out
+    # Torn primary: the summary comes from .prev, exit code still 0.
+    p.write_bytes(b"torn mid-write")
+    assert ckpt_info_main([str(p), "--json"]) == 0
+    import json
+    info = json.loads(capsys.readouterr().out)
+    assert info["source"] == "prev" and info["primary_error"]
+    # Both unreadable: exit code 2.
+    ckpt.prev_path(p).write_bytes(b"also torn")
+    assert ckpt_info_main([str(p)]) == 2
+
+
+def test_legacy_padded_gmm_checkpoint_still_resumes(tmp_path):
+    """An r9-era checkpoint stored the dev tables PADDED; the canonical
+    loader trims them on the way in, so old checkpoints keep resuming
+    bit-exactly on the topology they were written on."""
+    X = _blobs()
+    kw = dict(n_components=5, tol=1e-6, max_iter=80,
+              init_params="random", seed=0, host_loop=False,
+              verbose=False, dtype=np.float64, model_shards=2)
+    mesh = _mesh(4, 2)
+    full = GaussianMixture(mesh=mesh, **kw).fit(X)
+    assert full.converged_
+    p = tmp_path / "legacy.npz"
+    _fit_killed(GaussianMixture(mesh=mesh, **kw), 4,
+                lambda m: m.fit(X, checkpoint_every=2,
+                                checkpoint_path=p))
+    # Re-write the checkpoint with PADDED tables (the r9 layout).
+    state = ckpt.load_state(p)
+    k_pad, d = 6, 3
+    mc = np.zeros((k_pad, d), state["dev_means_c"].dtype)
+    mc[:5] = state["dev_means_c"]
+    cv = np.ones((k_pad, d), state["dev_cov"].dtype)
+    cv[:5] = state["dev_cov"]
+    lw = np.full((k_pad,), -np.inf, state["dev_log_w"].dtype)
+    lw[:5] = state["dev_log_w"]
+    state["dev_means_c"], state["dev_cov"], state["dev_log_w"] = \
+        mc, cv, lw
+    ckpt.save_state(p, state)
+    resumed = GaussianMixture(mesh=mesh, **kw)
+    resumed.fit(X, resume=p)
+    assert resumed.n_iter_ == full.n_iter_
+    np.testing.assert_array_equal(resumed.means_, full.means_)

@@ -249,12 +249,12 @@ def test_gmm_fit_stream_restart_resilience(Xc, mesh8, monkeypatch):
     orig = GaussianMixture._params_dev
     calls = {"n": 0}
 
-    def flaky(self, mesh):
+    def flaky(self, mesh, **kw):       # kw: guard_cholesky (ISSUE 5)
         calls["n"] += 1
         if calls["n"] == 2:            # second restart's first epoch
             raise ValueError(
                 "ill-defined empirical covariance (synthetic)")
-        return orig(self, mesh)
+        return orig(self, mesh, **kw)
 
     monkeypatch.setattr(GaussianMixture, "_params_dev", flaky)
     with pytest.warns(UserWarning, match="restart 2/3 failed"):
