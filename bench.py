@@ -46,6 +46,13 @@ Env: BENCH_STREAM_N / _D / _K / _BLOCK_ROWS / _EPOCHS / _PATH
 (accelerator default = the declared bigger-than-HBM config, 40M x 128
 k=1024 in 2M-row blocks; CPU default scales down to 1M x 32).
 
+BENCH_SERVE=1 switches to the SERVING latency/QPS benchmark (ISSUE 6):
+a resident warm K-Means model served through the micro-batching
+engine — batched-vs-sequential-dispatch speedup (interleaved per-rep
+ratios) plus p50/p99 request latency and QPS at 1/8/64/512-request
+batches (``kmeans_tpu.benchmarks.bench_serving``).  Env: BENCH_N/D/K,
+BENCH_SERVE_BATCHES, BENCH_SERVE_WAIT_MS.
+
 BENCH_GMM=1 switches to the GMM E-STEP PIPELINE benchmark (ISSUE 3
 tentpole): the one-dispatch diag EM loop with the software-pipelined
 chunk schedule (pipeline=1) vs the serial oracle (pipeline=0),
@@ -215,6 +222,26 @@ def main() -> None:
             f"iters={ci} every={ce}")
         bench_checkpoint_segments(cn, cd, ck, ci, ce)
         bench_cross_mesh_resume(cn, cd, ck, ci, ce)
+        return
+
+    if os.environ.get("BENCH_SERVE"):
+        # Serving latency/QPS benchmark (ISSUE 6): micro-batched
+        # dispatch vs sequential per-request dispatch at 1/8/64/512-
+        # request batches against a resident warm model, interleaved
+        # per-rep speedup ratios + p50/p99 request latencies under the
+        # batching timer.  Env: BENCH_N/D/K, BENCH_SERVE_BATCHES,
+        # BENCH_SERVE_WAIT_MS.
+        from kmeans_tpu.benchmarks import bench_serving
+        vn = int(os.environ.get("BENCH_N",
+                                2_000_000 if on_accel else 200_000))
+        vd = int(os.environ.get("BENCH_D", 128 if on_accel else 32))
+        vk = int(os.environ.get("BENCH_K", 1024 if on_accel else 64))
+        vb = tuple(int(b) for b in os.environ.get(
+            "BENCH_SERVE_BATCHES", "1,8,64,512").split(","))
+        vw = float(os.environ.get("BENCH_SERVE_WAIT_MS", 2.0))
+        log(f"bench: SERVE mode backend={backend} N={vn} D={vd} k={vk} "
+            f"batches={vb} max_wait_ms={vw}")
+        bench_serving(vn, vd, vk, batch_sizes=vb, max_wait_ms=vw)
         return
 
     if os.environ.get("BENCH_STREAM"):

@@ -24,11 +24,14 @@ def main() -> int:
     if cmd == "ckpt-info":
         from kmeans_tpu.cli import ckpt_info_main
         return ckpt_info_main(rest)
+    if cmd == "serve":
+        from kmeans_tpu.cli import serve_main
+        return serve_main(rest)
     if cmd == "report":
         from kmeans_tpu.utils.diagram import main as report_main
         return report_main(rest)
     print(f"unknown command {cmd!r}; available: suite, bench, fit, "
-          f"ckpt-info, report", file=sys.stderr)
+          f"ckpt-info, serve, report", file=sys.stderr)
     return 2
 
 

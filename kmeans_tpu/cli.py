@@ -117,6 +117,125 @@ def main(argv=None) -> int:
     return 0
 
 
+def serve_main(argv=None) -> int:
+    """``python -m kmeans_tpu serve --model <ckpt> [--model <ckpt> ...]``
+    — stdin/JSONL request loop over the serving engine (ISSUE 6; no
+    network dependency — pipe requests in, read results out).
+
+    Protocol: one JSON object per input line.
+
+    * ``{"model": "<id>", "x": [[...], ...]}`` — label the rows;
+      optional ``"op"``: ``predict`` (default) | ``transform`` |
+      ``score_rows`` | ``predict_proba`` | ``score_samples`` (family
+      permitting), optional ``"id"`` echoed back.  Reply line:
+      ``{"model":..., "op":..., "result": [...]}``.  With a single
+      resident model ``"model"`` may be omitted.
+    * ``{"stats": true}`` — reply with the engine stats snapshot
+      (models resident, dispatches, batch-fill histogram).
+
+    A malformed/poisoned request errors ITS line
+    (``{"error": ...}``) and the loop keeps serving.  On EOF the
+    engine drains; ``--json`` prints a final stats line to stdout
+    (``ckpt-info --json`` style), otherwise a human summary goes to
+    stderr.  Exit 0 after a clean drain, 2 when no model loaded."""
+    parser = argparse.ArgumentParser(
+        prog="python -m kmeans_tpu serve",
+        description="Serve fitted-model checkpoints over a stdin/JSONL "
+                    "request loop (resident warm-kernel engine; each "
+                    "line dispatches immediately — the pipe is serial)")
+    parser.add_argument("--model", action="append", required=True,
+                        metavar="CKPT", dest="models",
+                        help="checkpoint path (repeatable; any family)")
+    parser.add_argument("--id", action="append", default=None,
+                        dest="ids", help="model id for the matching "
+                        "--model (default: file stem)")
+    parser.add_argument("--quantize", choices=["bf16"], default=None,
+                        help="serve K-Means-family assignment through "
+                             "the bf16 distance fast path")
+    parser.add_argument("--max-wait-ms", type=float, default=2.0,
+                        help="micro-batch flush timer for the engine's "
+                             "queue (default 2.0; the serial stdin loop "
+                             "itself dispatches immediately)")
+    parser.add_argument("--buckets", default="8,64,512,4096",
+                        help="request-batch bucket ladder")
+    parser.add_argument("--no-warmup", action="store_true",
+                        help="skip pre-compiling the bucket shapes")
+    parser.add_argument("--json", action="store_true",
+                        help="print the final stats snapshot as JSON "
+                             "on stdout")
+    args = parser.parse_args(argv)
+
+    from kmeans_tpu.serving import ServingEngine
+    ids = args.ids or []
+    if len(ids) > len(args.models):
+        print("error: more --id flags than --model flags",
+              file=sys.stderr)
+        return 2
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    engine = ServingEngine(buckets=buckets,
+                           max_wait_ms=args.max_wait_ms)
+    try:
+        for i, path in enumerate(args.models):
+            mid = ids[i] if i < len(ids) else None
+            try:
+                mid = engine.load(path, mid, quantize=args.quantize)
+            except Exception as e:       # noqa: BLE001 — operator-facing
+                print(f"error: cannot load {path}: {e}", file=sys.stderr)
+                return 2
+            spec = engine.registry.spec(mid)
+            print(f"serve: resident {mid!r}: {spec['model_class']} "
+                  f"k={spec['k']} d={spec['d']} dtype={spec['dtype']}"
+                  + (f" quantize={args.quantize}" if args.quantize
+                     and spec["family"] == "kmeans" else ""),
+                  file=sys.stderr)
+        if not args.no_warmup:
+            n = engine.warmup()
+            print(f"serve: warmed {n} bucket shapes", file=sys.stderr)
+        default_model = engine.models()[0] \
+            if len(engine.models()) == 1 else None
+
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line)
+                if req.get("stats"):
+                    print(json.dumps(engine.stats()), flush=True)
+                    continue
+                model_id = req.get("model", default_model)
+                if model_id is None:
+                    raise ValueError(
+                        "request must name a 'model' (several are "
+                        "resident)")
+                op = req.get("op", "predict")
+                # The stdin loop is strictly serial (each reply is
+                # written before the next line is read), so queueing
+                # could never coalesce anything — it would only add the
+                # max_wait_ms flush-timer wait per request.  Dispatch
+                # immediately.
+                result = engine.call(model_id, req["x"], op=op)
+                reply = {"model": model_id, "op": op,
+                         "result": np.asarray(result).tolist()}
+                if "id" in req:
+                    reply["id"] = req["id"]
+                print(json.dumps(reply), flush=True)
+            except Exception as e:       # noqa: BLE001 — per-request
+                print(json.dumps({"error": f"{type(e).__name__}: {e}"}),
+                      flush=True)
+    finally:
+        engine.close()
+    if args.json:
+        print(json.dumps(engine.stats()))
+    else:
+        st = engine.stats()
+        n_req = sum(m["requests"] for m in st["models"].values())
+        print(f"serve: done — {st['models_resident']} models, "
+              f"{n_req} requests, "
+              f"{st['dispatches']} dispatches", file=sys.stderr)
+    return 0
+
+
 def ckpt_info_main(argv=None) -> int:
     """``python -m kmeans_tpu ckpt-info <path>`` — print a checkpoint's
     metadata block (model class, k, completed iteration, the mesh shape

@@ -266,6 +266,10 @@ class GaussianMixture(AutoCheckpointMixin):
         self.effective_chunk_: Optional[int] = None
         self.cov_jitter_retries_: int = 0
         self._active_ckpt_path = None
+        # Warm-serving parameter-table cache (ISSUE 6): ((weights_,
+        # means_, covariances_, mesh) identity token, device tables) —
+        # see ``_params_dev``.
+        self._params_cache = None
         # Raw accumulation-dtype device-loop tables (means_c/cov/log_w +
         # the carried convergence baseline) captured at the last segment
         # boundary or device-loop finish: the device loop works in the
@@ -480,6 +484,17 @@ class GaussianMixture(AutoCheckpointMixin):
     def _params_dev(self, mesh, guard_cholesky: bool = False):
         """Device-placed E-step parameter tables, per covariance type.
 
+        INFERENCE calls (``guard_cholesky=False``) are cached on the
+        instance keyed by the fitted arrays' IDENTITY and the mesh
+        (ISSUE 6 satellite): repeated ``predict``/``predict_proba``/
+        ``score_samples`` calls — and every serving-engine dispatch —
+        reuse one host-side factorization + device placement instead of
+        re-deriving the tables per call.  Fit paths re-assign
+        ``means_``/``covariances_``/``weights_`` with fresh arrays
+        every M-step, so the identity check invalidates naturally; the
+        ``guard_cholesky=True`` fit path never caches (its jitter
+        ladder must see the current covariances).
+
         diag/spherical: (shift, means_c, inv_var, log_det, log_w) — the
         precision AND the log-determinant both come from the SAME
         clamped covariance (r2 ADVICE), floored at the COMPUTE dtype's
@@ -495,6 +510,18 @@ class GaussianMixture(AutoCheckpointMixin):
         must fail loudly, not silently score against jittered densities
         (review r10), and ``cov_jitter_retries_`` stays a pure fit-time
         audit counter."""
+        if not guard_cholesky:
+            token = (self.weights_, self.means_, self.covariances_, mesh)
+            cache = getattr(self, "_params_cache", None)
+            if cache is not None and all(a is b for a, b in
+                                         zip(cache[0], token)):
+                return cache[1]
+            params = self._params_dev_build(mesh, guard_cholesky=False)
+            self._params_cache = (token, params)
+            return params
+        return self._params_dev_build(mesh, guard_cholesky=True)
+
+    def _params_dev_build(self, mesh, guard_cholesky: bool = False):
         prec_chol = self._prec_chol_guarded if guard_cholesky \
             else self._prec_chol
         shift = self._shift()
@@ -1616,6 +1643,24 @@ class GaussianMixture(AutoCheckpointMixin):
     def predict(self, X) -> np.ndarray:
         return self._posterior(X)[0]
 
+    def fitted_state(self) -> dict:
+        """Serving handle (ISSUE 6): the read-only description the
+        serving engine needs to hold this mixture resident.  GMMs are
+        NOT stackable on a batched model axis (per-component covariance
+        structure has no shared packed-table form) — mixed-model
+        routing dispatches them per model."""
+        self._check_fitted()
+        return {
+            "family": "gmm",
+            "model_class": type(self).__name__,
+            "k": int(self.n_components),
+            "d": int(self.means_.shape[1]),
+            "dtype": np.dtype(self.dtype).str,
+            "stackable": False,
+            "normalize_inputs": False,
+            "ops": ("predict", "predict_proba", "score_samples"),
+        }
+
     def fit_predict(self, X, y=None, *, sample_weight=None) -> np.ndarray:
         """Fit and return component labels for X (sklearn convention:
         ``y`` is ignored).  X is placed on device ONCE and shared by the
@@ -1900,6 +1945,7 @@ class GaussianMixture(AutoCheckpointMixin):
         model lazily rebuilds a mesh on next use."""
         state = dict(self.__dict__)
         state["mesh"] = None
+        state["_params_cache"] = None     # device arrays don't pickle
         return state
 
     def __deepcopy__(self, memo):
@@ -1910,7 +1956,7 @@ class GaussianMixture(AutoCheckpointMixin):
         new = self.__class__.__new__(self.__class__)
         memo[id(self)] = new
         for name, value in self.__dict__.items():
-            if name == "mesh":
+            if name in ("mesh", "_params_cache"):
                 new.__dict__[name] = value     # share device handles
             else:
                 new.__dict__[name] = _copy.deepcopy(value, memo)

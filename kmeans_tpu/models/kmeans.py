@@ -294,6 +294,9 @@ class KMeans(AutoCheckpointMixin):
         self.oom_backoffs_: int = 0
         self.effective_chunk_: Optional[int] = None
         self._active_ckpt_path = None
+        # Warm-serving placement cache (ISSUE 6): (centroids-identity,
+        # mesh, device table) — see ``_cents_dev``.
+        self._cents_cache = None
         self.sse_history: List[float] = []            # kmeans_spark.py:45
         self.cluster_sizes_: Optional[np.ndarray] = None
         self.iter_times_: List[float] = []            # wall secs/iteration
@@ -398,6 +401,48 @@ class KMeans(AutoCheckpointMixin):
         padded = dist.pad_centroids(
             centroids.astype(self.dtype), model_shards)
         return jax.device_put(padded, dist.centroid_sharding(mesh))
+
+    def _cents_dev(self, mesh: Mesh, model_shards: int) -> jax.Array:
+        """Warm device centroid table (ISSUE 6 satellite): the padded,
+        device-placed fitted table, cached on the instance keyed by the
+        ``centroids`` array IDENTITY and the mesh — repeated same-model
+        inference calls (``predict``/``transform``/``score`` and every
+        serving-engine dispatch) reuse ONE placement instead of paying
+        a k x D host->device transfer per call.  ``fit`` re-assigns
+        ``self.centroids`` with a fresh array every update, so the
+        identity check invalidates naturally; in-place mutation of the
+        fitted array is not a supported way to change a model (assign a
+        new array, or re-fit)."""
+        cents = self.centroids
+        # getattr: states pickled before this cache existed restore
+        # without the attribute.
+        cache = getattr(self, "_cents_cache", None)
+        if cache is not None and cache[0] is cents and cache[1] is mesh:
+            return cache[2]
+        dev = self._put_centroids(np.asarray(cents), mesh, model_shards)
+        self._cents_cache = (cents, mesh, dev)
+        return dev
+
+    def fitted_state(self) -> dict:
+        """Serving handle (ISSUE 6): the read-only description the
+        serving engine needs to hold this model resident — family
+        routing tag, table shape, dtype, whether same-shape instances
+        may be PACKED on a batched model axis for one-dispatch
+        mixed-model routing, whether inputs need row normalization
+        (SphericalKMeans), and the ops the engine may queue for it.
+        Raises before ``fit``."""
+        if self.centroids is None:
+            raise ValueError("Model must be fitted before serving")
+        return {
+            "family": "kmeans",
+            "model_class": type(self).__name__,
+            "k": int(self.k),
+            "d": int(np.asarray(self.centroids).shape[1]),
+            "dtype": np.dtype(self.dtype).str,
+            "stackable": True,
+            "normalize_inputs": False,
+            "ops": ("predict", "transform", "score_rows"),
+        }
 
     # ------------------------------------------------------------------- fit
 
@@ -1423,8 +1468,7 @@ class KMeans(AutoCheckpointMixin):
                     "local rows instead")
             return self._predict_process_local(X)
         ds, mesh, model_shards, _, predict_fn = self._prepare(X)
-        cents_dev = self._put_centroids(
-            np.asarray(self.centroids), mesh, model_shards)
+        cents_dev = self._cents_dev(mesh, model_shards)
         labels = predict_fn(ds.points, cents_dev)
         return np.asarray(labels)[: ds.n]
 
@@ -1436,8 +1480,7 @@ class KMeans(AutoCheckpointMixin):
         ``from_process_local`` places each process's real rows FIRST in
         its contiguous block."""
         _, mesh, model_shards, _, predict_fn = self._prepare(ds)
-        cents_dev = self._put_centroids(
-            np.asarray(self.centroids), mesh, model_shards)
+        cents_dev = self._cents_dev(mesh, model_shards)
         labels = predict_fn(ds.points, cents_dev)
         blocks = {}
         for sh in labels.addressable_shards:
@@ -1509,8 +1552,7 @@ class KMeans(AutoCheckpointMixin):
             for block, bw, extra in it:
                 empty = False
                 if cents_dev is None:
-                    cents_dev = self._put_centroids(
-                        np.asarray(self.centroids), mesh, model_shards)
+                    cents_dev = self._cents_dev(mesh, model_shards)
                 yield block, bw, extra, cents_dev, mesh, model_shards
         if empty:
             raise ValueError(
@@ -1620,8 +1662,7 @@ class KMeans(AutoCheckpointMixin):
         if self.centroids is None:
             raise ValueError("Model must be fitted before prediction")
         ds, mesh, model_shards, step_fn, _ = self._prepare(X)
-        cents_dev = self._put_centroids(
-            np.asarray(self.centroids), mesh, model_shards)
+        cents_dev = self._cents_dev(mesh, model_shards)
         stats = step_fn(ds.points, ds.weights, cents_dev)
         return -float(stats.sse)
 
@@ -1745,6 +1786,7 @@ class KMeans(AutoCheckpointMixin):
         state = dict(self.__dict__)
         state["_fit_ds"] = None
         state["mesh"] = None
+        state["_cents_cache"] = None      # device arrays don't pickle
         return state
 
     def __deepcopy__(self, memo):
@@ -1754,7 +1796,7 @@ class KMeans(AutoCheckpointMixin):
         new = self.__class__.__new__(self.__class__)
         memo[id(self)] = new
         for name, value in self.__dict__.items():
-            if name in ("mesh", "_fit_ds"):
+            if name in ("mesh", "_fit_ds", "_cents_cache"):
                 new.__dict__[name] = value     # share device-bound objects
             else:
                 new.__dict__[name] = _copy.deepcopy(value, memo)

@@ -121,6 +121,18 @@ class SphericalKMeans(KMeans):
     # routed back to the host loop (kmeans._resolve_host_loop).
     _postprocess_centroids._device_equivalent = "sphere"
 
+    def fitted_state(self) -> dict:
+        """Serving handle (ISSUE 6): same table shape/stacking as the
+        base class, but requests must be row-normalized before
+        assignment — ``normalize_inputs=True`` tells the serving engine
+        to run ``_normalize_rows`` on every request's rows (matching
+        what ``predict`` does via the normalizing ``cache``), so a
+        spherical model can still pack with plain K-Means models of the
+        same (k, D, dtype) in one routed dispatch."""
+        spec = super().fitted_state()
+        spec["normalize_inputs"] = True
+        return spec
+
     def transform(self, X, *, block_rows=None) -> np.ndarray:
         """Chordal distances ``sqrt(2 - 2*cos)`` to each centroid, (n, k);
         cosine similarity is ``1 - d**2 / 2``.  Rows are L2-normalized by

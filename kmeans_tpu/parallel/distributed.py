@@ -1228,12 +1228,18 @@ def make_minibatch_fit_fn(mesh: Mesh, *, batch_per_shard: int,
 
 
 def make_predict_fn(mesh: Mesh, *, chunk_size: int,
-                    mode: str = "matmul") -> Callable:
+                    mode: str = "matmul",
+                    donate_points: bool = False) -> Callable:
     """Build the jitted SPMD label assignment: (points, centroids) -> labels.
 
     Replaces ``predict``'s lazy per-partition closure (kmeans_spark.py:343-350)
     with an eager sharded argmin; the returned labels are sharded along the
     data axis (global indices into the un-padded centroid table).
+
+    ``donate_points=True`` donates the points buffer to the dispatch
+    (ISSUE 6: the serving engine's per-request staging buffer is
+    single-use, so XLA may reuse its memory for the output) — never set
+    it for a retained ``ShardedDataset``, whose points outlive the call.
     """
     data_shards, model_shards = mesh_shape(mesh)
 
@@ -1283,6 +1289,161 @@ def make_predict_fn(mesh: Mesh, *, chunk_size: int,
         predict, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(MODEL_AXIS, None)),
         out_specs=P(DATA_AXIS),
+        check_vma=False)
+    return jax.jit(mapped, donate_argnums=(0,) if donate_points else ())
+
+
+def make_assign_margin_fn(mesh: Mesh, *, chunk_size: int,
+                          mode: str = "matmul_bf16") -> Callable:
+    """Guarded-assignment primitive for the serving bf16 fast path
+    (ISSUE 6): (points, centroids) -> (labels, margin, scale), all
+    data-sharded per row —
+
+    * ``labels``: argmin of the (possibly quantized) distances,
+    * ``margin``: second-best minus best distance (the argmin's safety
+      gap),
+    * ``scale``: ``|x|^2 + max_k |c_k|^2`` — the magnitude the bf16
+      cross-term error is relative to (ops/assign.py: bf16 inputs
+      round at ~2^-8, so the distance error is O(2^-7 * scale) and two
+      distances can swap order only inside an O(2^-6 * scale) margin).
+
+    The serving engine keeps a bf16 label only when
+    ``margin > tie_rtol * scale`` (tie_rtol 2^-5 = 4x the bound) and
+    recomputes the flagged near-tie rows at f32 — which is what makes
+    the quantized path's labels BIT-EQUAL to the f32 oracle by
+    construction instead of only on well-separated data.  Data-parallel
+    meshes only (the serving engine rejects quantization under TP
+    centroid sharding).
+    """
+    data_shards, model_shards = mesh_shape(mesh)
+    if model_shards != 1:
+        raise ValueError(
+            "make_assign_margin_fn requires a data-parallel mesh "
+            f"(model_shards == 1, got {model_shards})")
+
+    def assign(points, centroids_block):
+        k_local, d = centroids_block.shape
+        n_chunks = points.shape[0] // chunk_size
+        xs = points.reshape(n_chunks, chunk_size, d)
+        acc = jnp.promote_types(points.dtype, jnp.float32)
+        c2max = jnp.max(jnp.sum(
+            centroids_block.astype(acc) ** 2, axis=1))
+
+        def body(_, xc):
+            d2 = pairwise_sq_dists(xc, centroids_block, mode=mode)
+            best = jnp.argmin(d2, axis=1).astype(jnp.int32)
+            d1 = jnp.min(d2, axis=1)
+            # Second-best: mask the winner column, take the min again.
+            masked = jnp.where(
+                jax.nn.one_hot(best, k_local, dtype=bool),
+                jnp.asarray(jnp.inf, d2.dtype), d2)
+            d2nd = jnp.min(masked, axis=1)
+            scale = jnp.sum(xc.astype(acc) ** 2, axis=1) + c2max
+            return None, (best, (d2nd - d1).astype(acc), scale)
+
+        _, (labels, margin, scale) = lax.scan(body, None, xs)
+        return (labels.reshape(-1), margin.reshape(-1),
+                scale.reshape(-1))
+
+    mapped = shard_map(
+        assign, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(MODEL_AXIS, None)),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+def make_score_rows_fn(mesh: Mesh, *, chunk_size: int,
+                       mode: str = "matmul") -> Callable:
+    """Per-row squared distance to the nearest centroid:
+    (points, centroids) -> mind2 (n,), data-sharded.
+
+    The serving engine's per-request scoring primitive (ISSUE 6): a
+    request's K-Means score is ``-sum`` of its rows' slice, so one
+    coalesced dispatch scores every member request.  Distances come
+    from the SAME ``pairwise_sq_dists`` mode ladder as assignment
+    (matmul/bf16); the fused training step's SSE is the same quantity
+    reduced on device, so per-request sums agree to f32 summation
+    order (rtol), not bitwise.
+    """
+    data_shards, model_shards = mesh_shape(mesh)
+
+    def score_rows(points, centroids_block):
+        k_local, d = centroids_block.shape
+        n_chunks = points.shape[0] // chunk_size
+        xs = points.reshape(n_chunks, chunk_size, d)
+
+        def body(_, xc):
+            if mode in PALLAS_MODES:
+                from kmeans_tpu.ops.pallas_kernels import pallas_assign
+                _, mind2 = pallas_assign(
+                    xc, centroids_block, bf16=(mode == "pallas_bf16"),
+                    interpret=jax.default_backend() != "tpu")
+            else:
+                d2 = pairwise_sq_dists(xc, centroids_block, mode=mode)
+                mind2 = jnp.min(d2, axis=1)
+            if model_shards > 1:
+                mind2 = jnp.min(lax.all_gather(mind2, MODEL_AXIS), axis=0)
+            return None, mind2
+
+        _, mind2 = lax.scan(body, None, xs)
+        return mind2.reshape(-1)
+
+    mapped = shard_map(
+        score_rows, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(MODEL_AXIS, None)),
+        out_specs=P(DATA_AXIS),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+def make_multi_predict_fn(mesh: Mesh, *, chunk_size: int,
+                          mode: str = "matmul",
+                          n_models: int) -> Callable:
+    """Batched-model assignment for routed mixed-model serving batches
+    (ISSUE 6): (points (n, D), centroid stack (M, k, D)) -> labels
+    (M, n) — every row labeled under EVERY packed model in ONE
+    dispatch; the caller selects ``labels[model_of_row, row]``.
+
+    This is the ``make_multi_fit_fn`` restart-batching idiom applied to
+    inference: the model axis is vmapped straight onto the MXU (batched
+    dot_general), so a mixed batch routed across M same-shape resident
+    models costs one dispatch instead of M — the M-fold distance
+    compute is the price, and at serving batch sizes (<= the 4096
+    bucket) it is dispatch latency, not FLOPs, that dominates.
+
+    Data-parallel meshes only (the packed table is replicated; under TP
+    centroid sharding the engine falls back to per-model dispatches).
+    Pallas modes map to their matmul-form equivalents — the fused
+    kernel has no batched-model variant.
+    """
+    data_shards, model_shards = mesh_shape(mesh)
+    if model_shards != 1:
+        raise ValueError(
+            "make_multi_predict_fn requires a data-parallel mesh "
+            f"(model_shards == 1, got {model_shards}); packed serving "
+            "falls back to per-model dispatches under TP sharding")
+    if mode in PALLAS_MODES:
+        mode = "matmul_bf16" if mode == "pallas_bf16" else "matmul"
+
+    def predict(points, cents_stack):
+        d = points.shape[1]
+        n_chunks = points.shape[0] // chunk_size
+        xs = points.reshape(n_chunks, chunk_size, d)
+
+        def body(_, xc):
+            def one(cb):
+                d2 = pairwise_sq_dists(xc, cb, mode=mode)
+                return jnp.argmin(d2, axis=1).astype(jnp.int32)
+            return None, jax.vmap(one)(cents_stack)      # (M, chunk)
+
+        _, labels = lax.scan(body, None, xs)             # (c, M, chunk)
+        return jnp.moveaxis(labels, 1, 0).reshape(n_models, -1)
+
+    mapped = shard_map(
+        predict, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(None, None, None)),
+        out_specs=P(None, DATA_AXIS),
         check_vma=False)
     return jax.jit(mapped)
 

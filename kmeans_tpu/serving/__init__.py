@@ -1,0 +1,30 @@
+"""Online serving subsystem (ISSUE 6): resident warm-kernel model
+server with micro-batching, multi-model residency, and a bf16
+quantized-distance fast path.
+
+Entry points:
+
+* :class:`ServingEngine` — hold fitted models resident on the mesh and
+  serve ``predict``/``transform``/``score``/``predict_proba`` with
+  compile-once warm kernels (``serving.engine``).
+* :class:`MicroBatchQueue` / :class:`ServingFuture` — Clipper-style
+  adaptive micro-batching of concurrent small requests
+  (``serving.batching``).
+* :class:`ModelRegistry` — multi-model residency + checkpoint loading
+  + same-shape pack groups (``serving.registry``).
+
+CLI: ``python -m kmeans_tpu serve --model <ckpt> [--model <ckpt> ...]``
+(stdin/JSONL request loop, no network dependency).  Benchmarks:
+``BENCH_SERVE=1 python bench.py`` and
+``experiments/exp_serving_load.py``.
+"""
+
+from kmeans_tpu.serving.batching import (MicroBatchQueue,
+                                         ServingClosedError,
+                                         ServingFuture)
+from kmeans_tpu.serving.engine import ResidentModel, ServingEngine
+from kmeans_tpu.serving.registry import ModelRegistry, load_fitted
+
+__all__ = ["ServingEngine", "ResidentModel", "MicroBatchQueue",
+           "ServingFuture", "ServingClosedError", "ModelRegistry",
+           "load_fitted"]

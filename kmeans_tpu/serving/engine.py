@@ -1,0 +1,686 @@
+"""Resident warm-kernel model server (ISSUE 6 tentpole).
+
+The fit-time story (r6-r10) made training fast; the north star —
+"heavy traffic from millions of users" — is assignment/scoring QPS,
+and before this subsystem every ``predict`` call paid eager dispatch,
+a fresh k x D parameter upload, and (on tunneled platforms) the
+~70-100 ms RTT documented in docs/PERFORMANCE.md, with no way to
+amortize across concurrent small requests.  The engine fixes all
+three:
+
+* **Resident models.**  ``add_model``/``load`` place a fitted model's
+  tables on the mesh ONCE (``KMeans._cents_dev`` /
+  ``GaussianMixture._params_dev`` instance caches — the same caches
+  plain ``model.predict`` now uses, so engine and direct calls share
+  one placement AND one compiled-function cache,
+  ``models.kmeans._STEP_CACHE``).
+* **Warm kernels, bucketed shapes.**  Requests pad to a small ladder
+  of batch buckets (default 8/64/512/4096), so each (model family,
+  bucket, dtype, mode) compiles once and every later dispatch reuses
+  the executable.  On accelerators the per-dispatch staging buffer is
+  DONATED (``make_predict_fn(donate_points=True)``) — it is single-use
+  by construction.
+* **Micro-batching.**  Concurrent small requests for the same model
+  coalesce into one padded dispatch (``serving.batching``): bucketed
+  sizes, a ``max_wait_ms`` flush timer, per-request result slices,
+  rows never mixed across models inside a buffer.
+* **Multi-model residency + routing.**  A registry
+  (``serving.registry``) holds many fitted models; same-shape
+  K-Means-family models pack on a batched model axis
+  (``parallel.distributed.make_multi_predict_fn`` — the
+  ``make_multi_fit_fn`` restart-batching idiom applied to inference),
+  so a routed mixed-model batch is still ONE dispatch where shapes
+  align.
+* **Quantized fast path.**  ``quantize='bf16'`` serves assignment
+  through the existing ``matmul_bf16`` distance mode (bf16 ``-2x·cᵀ``
+  cross term, f32 norms + accumulation).  Labels are ordering-robust
+  where distances round; ``verify_quantized`` pins a probe batch's
+  labels bit-equal to the f32 path and reports the distance rtol —
+  the acceptance gate tests/test_serving_parity.py enforces.
+
+Parity contract: for every resident family the serving path produces
+labels BIT-EQUAL to the model's own ``predict`` — the engine routes
+through the same compiled assignment programs, modes, and resident
+tables, so this is by construction, and tests/test_serving_parity.py
+pins it across 1/2/4/8-way virtual meshes.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from kmeans_tpu.models import kmeans as kmeans_mod
+from kmeans_tpu.parallel import distributed as dist
+from kmeans_tpu.parallel.mesh import make_mesh, mesh_shape
+from kmeans_tpu.parallel.sharding import choose_chunk_size, shard_points
+from kmeans_tpu.serving.batching import (DEFAULT_BUCKETS, MicroBatchQueue,
+                                         ServingFuture, bucket_for,
+                                         check_buckets)
+from kmeans_tpu.serving.registry import ModelRegistry
+
+__all__ = ["ServingEngine", "ResidentModel"]
+
+# bf16 fast-path mode map: which f32-class distance mode each serving
+# mode quantizes to.  'direct' has no quantized form and stays exact.
+_BF16_MODES = {"matmul": "matmul_bf16", "pallas": "pallas_bf16",
+               "auto": "matmul_bf16"}
+
+# Near-tie guard for the quantized assignment (ISSUE 6): a bf16 label
+# is kept only when its argmin margin exceeds this fraction of the
+# row's distance scale (|x|^2 + max|c|^2).  The bf16 cross-term error
+# bound is ~2^-6 * scale on a distance DIFFERENCE
+# (distributed.make_assign_margin_fn); 2^-5 is that bound doubled —
+# flagged rows recompute at f32, which makes quantized labels
+# bit-equal to the f32 oracle BY CONSTRUCTION, not just on separated
+# data (the failure the end-to-end verify drive caught: 14/1000 flips
+# on boundary rows of a 6-cluster blob set under plain bf16 argmin).
+BF16_TIE_RTOL = 2.0 ** -5
+
+
+class ResidentModel:
+    """One resident model: the fitted estimator + its serving spec +
+    per-model counters.  Device tables live on the MODEL's own caches
+    (``_cents_dev`` / ``_params_dev``), so direct ``model.predict``
+    calls and engine dispatches share one placement."""
+
+    def __init__(self, model_id: str, model, spec: dict, quantize):
+        self.model_id = model_id
+        self.model = model
+        self.spec = spec
+        self.quantize = quantize
+        self.requests = 0
+        self.rows = 0
+        self.dispatches = 0
+        # Rows the bf16 near-tie guard re-labeled at f32 (audit trail
+        # of the exactness guarantee; 0 on separated traffic).
+        self.bf16_corrected_rows = 0
+
+    def preprocess(self, rows: np.ndarray) -> np.ndarray:
+        """Per-request input canonicalization: exactly what the model's
+        own ``predict`` does to a raw array (SphericalKMeans
+        normalizes rows in float64 before casting — the ``cache``
+        path's arithmetic, bit for bit)."""
+        dtype = np.dtype(self.spec["dtype"])
+        if self.spec["normalize_inputs"]:
+            from kmeans_tpu.models.spherical import _normalize_rows
+            return _normalize_rows(
+                np.asarray(rows, np.float64)).astype(dtype)
+        return np.asarray(rows, dtype=dtype)
+
+
+class ServingEngine:
+    """Multi-model online serving over one mesh.
+
+    Parameters
+    ----------
+    mesh : jax.sharding.Mesh or None
+        The mesh every resident model serves from (None = all devices,
+        data-parallel).  ``add_model`` re-points each model's ``mesh``
+        here so direct calls and serving dispatches agree.
+    buckets : ascending request-batch size ladder (compile once per
+        bucket; oversize batches round up to a multiple of the top).
+    max_wait_ms : float
+        Micro-batch flush timer — the longest a queued request waits
+        for co-batchable traffic (latency floor of the ``submit``
+        path; ``predict`` dispatches immediately).
+    clock, start : forwarded to :class:`MicroBatchQueue` (injectable
+        clock / no-worker mode for deterministic tests).
+    donate : 'auto' | bool
+        Donate the per-dispatch staging buffer to the assignment
+        program.  'auto' = on accelerators only (CPU ignores donation
+        and would warn).
+    """
+
+    def __init__(self, *, mesh=None, buckets=DEFAULT_BUCKETS,
+                 max_wait_ms: float = 2.0, clock=None, start: bool = True,
+                 donate="auto"):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.buckets = check_buckets(buckets)
+        self.registry = ModelRegistry()
+        self._residents: Dict[str, ResidentModel] = {}
+        if donate == "auto":
+            donate = jax.default_backend() not in ("cpu",)
+        self._donate = bool(donate)
+        # (tuple of model ids) -> (per-model centroid identity tokens,
+        # device-placed (M, k, D) stack) for packed mixed-model routing.
+        self._pack_cache: Dict[tuple, tuple] = {}
+        self._lock = threading.Lock()
+        # warmup() probes run through the real dispatch path; this
+        # thread-local flag makes _record (and the bf16 audit counter)
+        # skip them so stats reflect served traffic only — a rollback
+        # snapshot would race concurrent requests and miss the audit
+        # counter (review finding).
+        self._tls = threading.local()
+        # Bucket-fill histogram: bucket -> [dispatches, real rows].
+        self._fill: Dict[int, List[int]] = {}
+        self.dispatches = 0
+        self.packed_dispatches = 0
+        self.queue = MicroBatchQueue(
+            self._dispatch, buckets=self.buckets,
+            max_wait_ms=max_wait_ms, clock=clock, start=start,
+            validate=self._validate)
+
+    # -------------------------------------------------------- residency
+
+    def add_model(self, model_id: str, model, *,
+                  quantize: Optional[str] = None) -> ResidentModel:
+        """Make a FITTED model resident.  ``quantize='bf16'`` serves
+        its assignment through the bf16 cross-term fast path (labels
+        pinned against the f32 path by ``verify_quantized``)."""
+        if quantize not in (None, "bf16"):
+            raise ValueError(f"quantize must be None or 'bf16', got "
+                             f"{quantize!r}")
+        if quantize == "bf16" and mesh_shape(self.mesh)[1] != 1:
+            raise ValueError(
+                "quantize='bf16' requires a data-parallel mesh (the "
+                "guarded assignment has no TP centroid-sharding form); "
+                "serve this model unquantized or use model_shards=1")
+        spec = self.registry.register(model_id, model)
+        # One mesh for everything resident: direct model calls and
+        # serving dispatches must hit the same compiled programs.
+        model.mesh = self.mesh
+        if spec["family"] == "gmm":
+            quantize = None       # bf16 assign is a K-Means-family path
+        rm = ResidentModel(model_id, model, spec, quantize)
+        self._residents[model_id] = rm
+        return rm
+
+    def load(self, path, model_id: Optional[str] = None, *,
+             quantize: Optional[str] = None) -> str:
+        """Load a topology-portable checkpoint (any family, any mesh it
+        was written on — r10) and make it resident."""
+        mid, model = self.registry.load(path, model_id)
+        # registry.load registered it; wrap without re-registering.
+        self.registry.remove(mid)
+        self.add_model(mid, model, quantize=quantize)
+        return mid
+
+    def remove(self, model_id: str) -> None:
+        self.registry.remove(model_id)
+        del self._residents[model_id]
+        with self._lock:
+            self._pack_cache = {ids: v for ids, v in
+                                self._pack_cache.items()
+                                if model_id not in ids}
+
+    def models(self) -> List[str]:
+        return self.registry.ids()
+
+    def _rm(self, model_id: str) -> ResidentModel:
+        try:
+            return self._residents[model_id]
+        except KeyError:
+            raise KeyError(
+                f"no resident model {model_id!r}; resident: "
+                f"{sorted(self._residents)}") from None
+
+    # ------------------------------------------------------- validation
+
+    def _validate(self, model_id, op: str, rows) -> np.ndarray:
+        """Canonicalize one request's rows; every failure here is
+        per-request (submit-time poison isolation)."""
+        rm = self._rm(model_id)
+        if op not in rm.spec["ops"]:
+            raise ValueError(
+                f"op {op!r} not served for model {model_id!r} "
+                f"(family {rm.spec['family']}); available: "
+                f"{rm.spec['ops']}")
+        rows = np.asarray(rows)
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        if rows.ndim != 2 or rows.shape[1] != rm.spec["d"]:
+            raise ValueError(
+                f"request rows must be (m, {rm.spec['d']}) for model "
+                f"{model_id!r}, got shape {rows.shape}")
+        if rows.shape[0] == 0:
+            raise ValueError("request must contain at least one row")
+        block = rm.preprocess(rows)
+        if not np.all(np.isfinite(block)):
+            raise ValueError(
+                f"request for model {model_id!r} contains non-finite "
+                f"values")
+        return block
+
+    # --------------------------------------------------------- dispatch
+
+    def _record(self, rm: ResidentModel, bucket: int, m: int,
+                n_requests: int = 1) -> None:
+        if getattr(self._tls, "warming", False):
+            return
+        with self._lock:
+            self.dispatches += 1
+            rm.dispatches += 1
+            rm.requests += n_requests
+            rm.rows += m
+            fill = self._fill.setdefault(bucket, [0, 0])
+            fill[0] += 1
+            fill[1] += m
+
+    def _kmeans_modes(self, rm: ResidentModel, B: int) -> Tuple[str, str]:
+        """(assign mode, transform mode) for a bucket-B dispatch —
+        the model's own 'auto' resolution, then the bf16 fast-path
+        substitution when this resident is quantized."""
+        mode = rm.model._mode(B, rm.spec["d"])
+        if rm.quantize == "bf16":
+            mode = _BF16_MODES.get(mode, mode)
+        tmode = {"auto": "matmul", "pallas": "matmul",
+                 "pallas_bf16": "matmul_bf16"}.get(mode, mode)
+        return mode, tmode
+
+    def _predict_fn(self, chunk: int, mode: str):
+        """The assignment program for one bucket shape.  CPU: exactly
+        ``KMeans.predict``'s cached function (ONE shared cache —
+        ISSUE 6 satellite).  Accelerators: a donating twin under its
+        own key (the shared fn must never donate a retained
+        ShardedDataset's points)."""
+        if not self._donate:
+            return kmeans_mod._get_step_fns(self.mesh, chunk, mode)[1]
+        return kmeans_mod._STEP_CACHE.get_or_create(
+            (self.mesh, chunk, mode, "serve-donate"),
+            lambda: dist.make_predict_fn(
+                self.mesh, chunk_size=chunk, mode=mode,
+                donate_points=True))
+
+    def _serve_chunk(self, rm: ResidentModel, B: int) -> int:
+        """Scan chunk for a bucket-B dispatch: always the AUTO
+        (VMEM-budget) rule at the bucket shape — NEVER the model's
+        explicit training ``chunk_size`` (review finding: a model
+        fitted with chunk_size=2M would pad an 8-row request to
+        data_shards x 2M zero rows per dispatch).  Per-row labels are
+        chunk-invariant, so this cannot change results vs the
+        model's own ``predict``."""
+        data_shards, model_shards = mesh_shape(self.mesh)
+        return choose_chunk_size(
+            -(-B // data_shards),
+            max(rm.model._tile_k(B, rm.spec["d"]), model_shards),
+            rm.spec["d"])
+
+    def _stage(self, rm: ResidentModel, rows: np.ndarray
+               ) -> Tuple[np.ndarray, int, int]:
+        """Pad validated rows into this request batch's bucket buffer."""
+        m = rows.shape[0]
+        B = bucket_for(m, self.buckets)
+        d = rm.spec["d"]
+        buf = np.zeros((B, d), dtype=np.dtype(rm.spec["dtype"]))
+        buf[:m] = rows
+        return buf, m, B
+
+    def _dispatch(self, model_id, op: str, rows: np.ndarray) -> np.ndarray:
+        """One coalesced batch -> per-row result array (axis 0 aligned
+        with ``rows``; the queue slices per request)."""
+        rm = self._rm(model_id)
+        if rm.spec["family"] == "gmm":
+            return self._dispatch_gmm(rm, op, rows)
+        return self._dispatch_kmeans(rm, op, rows)
+
+    def _dispatch_kmeans(self, rm: ResidentModel, op: str,
+                         rows: np.ndarray) -> np.ndarray:
+        model = rm.model
+        buf, m, B = self._stage(rm, rows)
+        mode, tmode = self._kmeans_modes(rm, B)
+        chunk = self._serve_chunk(rm, B)
+        data_shards, model_shards = mesh_shape(self.mesh)
+        cents_dev = model._cents_dev(self.mesh, model_shards)
+        pts, _ = shard_points(buf, self.mesh, chunk)
+        if op == "predict":
+            if rm.quantize == "bf16":
+                out, corrected = self._assign_bf16_guarded(
+                    rm, buf, pts, cents_dev, chunk, m)
+                if corrected and not getattr(self._tls, "warming", False):
+                    with self._lock:
+                        rm.bf16_corrected_rows += corrected
+            else:
+                out = np.asarray(self._predict_fn(chunk, mode)(
+                    pts, cents_dev))[:m]
+        elif op == "transform":
+            tfn = kmeans_mod._STEP_CACHE.get_or_create(
+                (self.mesh, chunk, tmode, "transform"),
+                lambda: dist.make_transform_fn(
+                    self.mesh, chunk_size=chunk, mode=tmode))
+            out = np.asarray(tfn(pts, cents_dev))[:m, : rm.spec["k"]]
+        elif op == "score_rows":
+            sfn = kmeans_mod._STEP_CACHE.get_or_create(
+                (self.mesh, chunk, mode, "score_rows"),
+                lambda: dist.make_score_rows_fn(
+                    self.mesh, chunk_size=chunk, mode=mode))
+            out = np.asarray(sfn(pts, cents_dev))[:m]
+        else:                               # unreachable past _validate
+            raise ValueError(f"unknown op {op!r}")
+        self._record(rm, B, m)
+        return out
+
+    def _assign_bf16_guarded(self, rm: ResidentModel, buf: np.ndarray,
+                             pts, cents_dev, chunk: int, m: int
+                             ) -> Tuple[np.ndarray, int]:
+        """The quantized fast path with exact argmin tie-break
+        verification: bf16 distances decide every row whose argmin
+        margin clears ``BF16_TIE_RTOL`` of the row's distance scale;
+        the (rare) flagged near-tie rows are re-labeled by one small
+        f32 dispatch.  Result: labels bit-equal to the f32 oracle BY
+        CONSTRUCTION — the bf16 error bound can only reorder distances
+        inside the guarded margin.  Returns (labels, corrected_count);
+        the CALLER owns the audit counter (verify_quantized probes
+        through here without touching the resident's state)."""
+        fn = kmeans_mod._STEP_CACHE.get_or_create(
+            (self.mesh, chunk, "assign-margin"),
+            lambda: dist.make_assign_margin_fn(
+                self.mesh, chunk_size=chunk, mode="matmul_bf16"))
+        labels, margin, scale = fn(pts, cents_dev)
+        labels = np.array(np.asarray(labels)[:m])
+        margin = np.asarray(margin)[:m]
+        scale = np.asarray(scale)[:m]
+        near = np.flatnonzero(margin <= BF16_TIE_RTOL * scale)
+        if near.size:
+            # f32 correction ride-along: its own (small) bucket, the
+            # SHARED f32 predict program.
+            sub = np.ascontiguousarray(buf[near])
+            sub_buf, n_sub, B_sub = self._stage(rm, sub)
+            sub_chunk = self._serve_chunk(rm, B_sub)
+            sub_pts, _ = shard_points(sub_buf, self.mesh, sub_chunk)
+            # The model's OWN f32-class mode (not the bf16 map) — the
+            # corrected rows must match whatever `model.predict` runs.
+            f32_mode = rm.model._mode(B_sub, rm.spec["d"])
+            exact = np.asarray(self._predict_fn(sub_chunk, f32_mode)(
+                sub_pts, rm.model._cents_dev(
+                    self.mesh, mesh_shape(self.mesh)[1])))[:n_sub]
+            labels[near] = exact
+        return labels, int(near.size)
+
+    def _dispatch_gmm(self, rm: ResidentModel, op: str,
+                      rows: np.ndarray) -> np.ndarray:
+        """Mixture ops ride the model's own ``_posterior`` — parity
+        with ``GaussianMixture.predict`` is by construction, and the
+        ISSUE-6 ``_params_dev`` cache makes it warm (tables placed
+        once, compiled pass reused per bucket shape)."""
+        buf, m, B = self._stage(rm, rows)
+        labels, logr, lse = rm.model._posterior(buf)
+        self._record(rm, B, m)
+        if op == "predict":
+            return labels[:m]
+        if op == "predict_proba":
+            return np.exp(logr)[:m]
+        return lse[:m]                      # 'score_samples'
+
+    # ----------------------------------------------------- public calls
+
+    def call(self, model_id, rows, *, op: str = "predict") -> np.ndarray:
+        """Immediate (un-queued) warm dispatch of one request — the
+        latency floor.  This is the right path for a strictly serial
+        caller (e.g. the ``serve`` CLI's stdin loop): going through
+        ``submit`` instead would pay the ``max_wait_ms`` flush timer on
+        every request for coalescing that can never happen (review
+        finding).  Use ``submit`` when concurrent callers can share a
+        dispatch."""
+        return self._dispatch(model_id, op,
+                              self._validate(model_id, op, rows))
+
+    def predict(self, model_id, rows) -> np.ndarray:
+        """Immediate (un-queued) warm dispatch — the latency floor."""
+        return self.call(model_id, rows)
+
+    def submit(self, model_id, rows, *, op: str = "predict"
+               ) -> ServingFuture:
+        """Queue one request for micro-batching; returns a future whose
+        ``result()`` is this request's own rows' slice."""
+        return self.queue.submit(model_id, rows, op=op)
+
+    def score(self, model_id, rows) -> float:
+        """Model-family score of one request batch: K-Means negative
+        SSE (sum of per-row nearest squared distances, f64 host sum);
+        GMM mean per-sample log-likelihood (sklearn conventions)."""
+        rm = self._rm(model_id)
+        if rm.spec["family"] == "gmm":
+            lse = self._dispatch(model_id, "score_samples",
+                                 self._validate(model_id,
+                                                "score_samples", rows))
+            return float(np.mean(lse))
+        mind2 = self._dispatch(model_id, "score_rows",
+                               self._validate(model_id, "score_rows",
+                                              rows))
+        return -float(np.sum(np.asarray(mind2, np.float64)))
+
+    def predict_multi(self, requests: Sequence[Tuple[str, np.ndarray]]
+                      ) -> List[np.ndarray]:
+        """Routed mixed-model batch: one (model_id, rows) pair per
+        request, results in request order.
+
+        Requests whose models share a pack group (same-(k, D, dtype)
+        K-Means family, data-parallel mesh) are served by ONE packed
+        dispatch — every packed row labeled under every packed model
+        (``make_multi_predict_fn``), each request keeping its own
+        model's labels.  Everything else dispatches per model.  Labels
+        are pinned equal to per-model sequential ``predict`` results
+        (tests/test_serving_parity.py)."""
+        blocks = [self._validate(mid, "predict", rows)
+                  for mid, rows in requests]
+        _, model_shards = mesh_shape(self.mesh)
+        groups: Dict[tuple, List[int]] = {}
+        singles: List[int] = []
+        for i, (mid, _) in enumerate(requests):
+            key = self.registry.group_key(self._rm(mid).spec)
+            if key is None or model_shards != 1:
+                singles.append(i)
+            else:
+                groups.setdefault(key, []).append(i)
+        out: List[Optional[np.ndarray]] = [None] * len(requests)
+        for key, idxs in groups.items():
+            ids = []
+            for i in idxs:
+                if requests[i][0] not in ids:
+                    ids.append(requests[i][0])
+            if len(ids) < 2:
+                singles.extend(idxs)
+                continue
+            packed = self._dispatch_packed(
+                ids, [(requests[i][0], blocks[i]) for i in idxs])
+            for i, lab in zip(idxs, packed):
+                out[i] = lab
+        for i in singles:
+            out[i] = self._dispatch(requests[i][0], "predict", blocks[i])
+        return out
+
+    def _pack_stack(self, ids: Tuple[str, ...]):
+        """Device-placed (M, k, D) centroid stack for a pack, cached and
+        invalidated on any member's ``centroids`` identity change."""
+        rms = [self._rm(mid) for mid in ids]
+        tokens = tuple(rm.model.centroids for rm in rms)
+        with self._lock:
+            cached = self._pack_cache.get(ids)
+            if cached is not None and all(
+                    a is b for a, b in zip(cached[0], tokens)):
+                return cached[1]
+        dtype = np.dtype(rms[0].spec["dtype"])
+        stack = np.stack([np.asarray(rm.model.centroids, dtype=dtype)
+                          for rm in rms])
+        dev = jax.device_put(stack)
+        with self._lock:
+            self._pack_cache[ids] = (tokens, dev)
+        return dev
+
+    def _dispatch_packed(self, ids: List[str],
+                         items: List[Tuple[str, np.ndarray]]
+                         ) -> List[np.ndarray]:
+        """One batched-model dispatch over every item's rows; returns
+        per-item label arrays (item order preserved)."""
+        ids = tuple(ids)
+        slot = {mid: j for j, mid in enumerate(ids)}
+        rms = {mid: self._rm(mid) for mid in ids}
+        rows = np.concatenate([b for _, b in items], axis=0)
+        first = rms[ids[0]]
+        d = first.spec["d"]
+        buf, m, B = self._stage(first, rows)
+        # Packed routing serves at the f32-class mode even when members
+        # are quantized: make_multi_predict_fn has no near-tie guard,
+        # and plain bf16 argmin is NOT label-exact (review finding —
+        # 19/28 flips on boundary rows), so exactness wins over the
+        # bf16 rate until a guarded packed form is built and measured.
+        mode = first.model._mode(B, d)
+        chunk = self._serve_chunk(first, B)
+        fn = kmeans_mod._STEP_CACHE.get_or_create(
+            (self.mesh, chunk, mode, len(ids), "multipredict"),
+            lambda: dist.make_multi_predict_fn(
+                self.mesh, chunk_size=chunk, mode=mode,
+                n_models=len(ids)))
+        pts, _ = shard_points(buf, self.mesh, chunk)
+        stack = self._pack_stack(ids)
+        labels_all = np.asarray(fn(pts, stack))      # (M, B_padded)
+        # ONE physical dispatch: the global count and the bucket-fill
+        # histogram record it once (with the batch's total real rows);
+        # per-model counters record each member's share (a member's
+        # `dispatches` counts dispatches that INCLUDED it, so per-model
+        # sums may exceed the global count for packed traffic).
+        with self._lock:
+            self.packed_dispatches += 1
+            self.dispatches += 1
+            fill = self._fill.setdefault(B, [0, 0])
+            fill[0] += 1
+            fill[1] += m
+            for mid in ids:
+                rms[mid].dispatches += 1
+            for mid, block in items:
+                rms[mid].requests += 1
+                rms[mid].rows += block.shape[0]
+        results = []
+        off = 0
+        for mid, block in items:
+            mb = block.shape[0]
+            results.append(labels_all[slot[mid], off: off + mb].copy())
+            off += mb
+        return results
+
+    # ----------------------------------------------- bf16 verification
+
+    def verify_quantized(self, model_id, rows) -> dict:
+        """Pin the bf16 fast path against the f32 oracle on a probe
+        batch: labels must be BIT-EQUAL (argmin is ordering-robust
+        where distances round — ties are the only flip risk), distances
+        compared by relative error.  Returns
+        ``{"labels_equal", "label_mismatches", "dist_max_rel"}``; the
+        acceptance tests assert ``labels_equal`` on separated data."""
+        rm = self._rm(model_id)
+        if rm.spec["family"] == "gmm":
+            raise ValueError("verify_quantized applies to the K-Means "
+                             "family bf16 assignment fast path")
+        if mesh_shape(self.mesh)[1] != 1:
+            raise ValueError(
+                "verify_quantized requires a data-parallel mesh — the "
+                "guarded bf16 assignment has no TP form (quantization "
+                "is rejected under TP sharding)")
+        block = self._validate(model_id, "predict", rows)
+        # Probe WITHOUT touching the resident's live quantize flag —
+        # concurrent queued traffic must keep its configured mode (and
+        # its corrected_rows audit unpolluted, review finding).
+        buf, m, B = self._stage(rm, block)
+        chunk = self._serve_chunk(rm, B)
+        model_shards = mesh_shape(self.mesh)[1]
+        cents_dev = rm.model._cents_dev(self.mesh, model_shards)
+        pts, _ = shard_points(buf, self.mesh, chunk)
+        lab_q, corrected = self._assign_bf16_guarded(
+            rm, buf, pts, cents_dev, chunk, m)
+        f32_mode = rm.model._mode(B, rm.spec["d"])
+        lab_f = np.asarray(self._predict_fn(chunk, f32_mode)(
+            shard_points(buf, self.mesh, chunk)[0], cents_dev))[:m]
+
+        def _distances(tmode):
+            tfn = kmeans_mod._STEP_CACHE.get_or_create(
+                (self.mesh, chunk, tmode, "transform"),
+                lambda: dist.make_transform_fn(
+                    self.mesh, chunk_size=chunk, mode=tmode))
+            return np.asarray(tfn(
+                shard_points(buf, self.mesh, chunk)[0],
+                cents_dev))[:m, : rm.spec["k"]]
+
+        dist_q = _distances("matmul_bf16")
+        dist_f = _distances("matmul")
+        mism = int(np.sum(lab_q != lab_f))
+        # bf16's error model is ~2^-8 relative to the |x||c| product
+        # magnitude (ops/assign.py) — near-zero distances carry
+        # cancellation-AMPLIFIED relative error by construction, so the
+        # honest normalization is each row's distance SCALE (its max
+        # distance), not the individual (possibly ~0) entry.
+        f64q = dist_q.astype(np.float64)
+        f64f = dist_f.astype(np.float64)
+        scale = np.maximum(np.max(np.abs(f64f), axis=1, keepdims=True),
+                           np.finfo(np.float64).tiny)
+        rel = np.abs(f64q - f64f) / scale
+        return {"labels_equal": mism == 0,
+                "label_mismatches": mism,
+                # Rows the near-tie guard re-labeled at f32 for this
+                # probe — the price of exactness (0 on separated data).
+                "corrected_rows": corrected,
+                "dist_max_rel": float(np.max(rel))}
+
+    # ------------------------------------------------------------ stats
+
+    def warmup(self, model_id=None, *, buckets=None) -> int:
+        """Pre-compile the predict path for each bucket shape (cold
+        compiles otherwise land on the first unlucky request).  Returns
+        the number of warm dispatches run (counted separately from
+        serving stats)."""
+        ids = [model_id] if model_id is not None else self.models()
+        buckets = self.buckets if buckets is None else \
+            check_buckets(buckets)
+        n = 0
+        # The _tls.warming flag (checked in _record and the bf16 audit
+        # increment) keeps these probes out of the serving stats without
+        # a counter rollback — concurrent requests on other threads keep
+        # recording normally.
+        self._tls.warming = True
+        try:
+            for mid in ids:
+                rm = self._rm(mid)
+                for B in buckets:
+                    probe = np.zeros((B, rm.spec["d"]),
+                                     np.dtype(rm.spec["dtype"]))
+                    probe[:, 0] = 1.0       # finite, unit rows
+                    self._dispatch(mid, "predict",
+                                   self._validate(mid, "predict", probe))
+                    n += 1
+        finally:
+            self._tls.warming = False
+        return n
+
+    def stats(self) -> dict:
+        """Operator-facing snapshot: models resident, dispatch counts,
+        batch-fill histogram (the ``serve --json`` payload)."""
+        with self._lock:
+            fill = {
+                int(b): {"dispatches": v[0], "rows": v[1],
+                         "fill": round(v[1] / (v[0] * b), 4)
+                         if v[0] else 0.0}
+                for b, v in sorted(self._fill.items())}
+            models = {
+                mid: {"family": rm.spec["family"],
+                      "model_class": rm.spec["model_class"],
+                      "k": rm.spec["k"], "d": rm.spec["d"],
+                      "dtype": rm.spec["dtype"],
+                      "quantize": rm.quantize,
+                      "requests": rm.requests, "rows": rm.rows,
+                      "dispatches": rm.dispatches,
+                      "bf16_corrected_rows": rm.bf16_corrected_rows}
+                for mid, rm in sorted(self._residents.items())}
+            return {
+                "models_resident": len(models),
+                "models": models,
+                "dispatches": self.dispatches,
+                "packed_dispatches": self.packed_dispatches,
+                "queue": self.queue.stats(),
+                "batch_fill": fill,
+                "buckets": list(self.buckets),
+            }
+
+    # -------------------------------------------------------- lifecycle
+
+    def close(self) -> None:
+        """Drain the queue and join its worker (idempotent)."""
+        self.queue.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,552 @@
+"""Serving-path parity (ISSUE 6 acceptance): for every resident model
+family the engine's labels are BIT-EQUAL to the model's own
+``predict`` across 1/2/4/8-way virtual meshes; the bf16 fast path is
+pinned label-exact with scale-relative distance comparison; a
+multi-model routed batch equals per-model sequential results; and the
+engine/``predict`` share one compiled-function + placement cache
+(VERDICT C9 follow-through)."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+
+from kmeans_tpu import (GaussianMixture, KMeans, MiniBatchKMeans,
+                        SphericalKMeans)
+from kmeans_tpu.models import BisectingKMeans
+from kmeans_tpu.models import kmeans as kmeans_mod
+from kmeans_tpu.parallel.mesh import make_mesh
+from kmeans_tpu.serving import ModelRegistry, ServingEngine, load_fitted
+
+WIDTHS = (1, 2, 4, 8)
+
+
+def _mesh(w, m=1):
+    if len(jax.devices()) < w * m:
+        pytest.skip(f"needs {w * m} devices")
+    return make_mesh(data=w, model=m, devices=jax.devices()[: w * m])
+
+
+@pytest.fixture(scope="module")
+def data():
+    X, _ = make_blobs(n_samples=3000, centers=6, n_features=8,
+                      random_state=3)
+    return X.astype(np.float32)
+
+
+def _engine(mesh, **kw):
+    kw.setdefault("max_wait_ms", 1.0)
+    return ServingEngine(mesh=mesh, **kw)
+
+
+FAMILIES = {
+    "kmeans": lambda: KMeans(k=5, seed=0, verbose=False, max_iter=25),
+    "minibatch": lambda: MiniBatchKMeans(k=5, seed=0, verbose=False,
+                                         batch_size=256, max_iter=30),
+    "bisecting": lambda: BisectingKMeans(k=5, seed=0, verbose=False),
+    "spherical": lambda: SphericalKMeans(k=5, seed=0, verbose=False,
+                                         max_iter=25),
+    "gmm": lambda: GaussianMixture(n_components=4, seed=0),
+}
+
+
+@pytest.mark.parametrize("width", WIDTHS)
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_serving_labels_bitequal_to_predict(family, width, data):
+    mesh = _mesh(width)
+    model = FAMILIES[family]()
+    model.fit(data)
+    model.mesh = None                     # engine re-points to its mesh
+    with _engine(mesh) as eng:
+        eng.add_model("m", model)
+        for m_rows in (1, 7, 64, 300):    # several buckets incl. padding
+            probe = data[: m_rows]
+            want = model.predict(probe)
+            got = eng.predict("m", probe)
+            np.testing.assert_array_equal(got, want)
+            fut = eng.submit("m", probe)  # queued path, same contract
+            np.testing.assert_array_equal(fut.result(timeout=30.0), want)
+
+
+def test_serving_under_tp_centroid_sharding(data):
+    """Model-axis (TP) sharded mesh: the engine serves through the same
+    owner-reconstructing predict program; packed routing falls back to
+    per-model dispatches (make_multi_predict_fn is DP-only)."""
+    mesh = _mesh(4, 2) if len(jax.devices()) >= 8 else _mesh(1, 2)
+    km = KMeans(k=6, seed=0, verbose=False, max_iter=25,
+                model_shards=2).fit(data)
+    km.mesh = None
+    km2 = KMeans(k=6, seed=11, verbose=False, max_iter=25,
+                 model_shards=2).fit(data)
+    km2.mesh = None
+    with _engine(mesh) as eng:
+        eng.add_model("a", km)
+        eng.add_model("b", km2)
+        np.testing.assert_array_equal(eng.predict("a", data[:100]),
+                                      km.predict(data[:100]))
+        outs = eng.predict_multi([("a", data[:50]), ("b", data[50:90])])
+        np.testing.assert_array_equal(outs[0], km.predict(data[:50]))
+        np.testing.assert_array_equal(outs[1], km2.predict(data[50:90]))
+        assert eng.packed_dispatches == 0       # TP fallback path
+
+
+def test_gmm_proba_and_score_samples_parity(data):
+    mesh = _mesh(min(4, len(jax.devices())))
+    gm = GaussianMixture(n_components=4, seed=0,
+                         covariance_type="diag").fit(data)
+    gm.mesh = None
+    with _engine(mesh) as eng:
+        eng.add_model("gm", gm)
+        probe = data[:123]
+        np.testing.assert_array_equal(
+            eng.submit("gm", probe).result(timeout=30.0),
+            gm.predict(probe))
+        np.testing.assert_array_equal(
+            eng.submit("gm", probe, op="predict_proba").result(
+                timeout=30.0),
+            gm.predict_proba(probe))
+        np.testing.assert_array_equal(
+            eng.submit("gm", probe, op="score_samples").result(
+                timeout=30.0),
+            gm.score_samples(probe))
+        assert np.isclose(eng.score("gm", probe), gm.score(probe),
+                          rtol=0, atol=0)
+
+
+@pytest.mark.parametrize("cov", ["full", "tied", "spherical"])
+def test_gmm_covariance_types_serve(cov, data):
+    mesh = _mesh(min(2, len(jax.devices())))
+    gm = GaussianMixture(n_components=3, seed=0,
+                         covariance_type=cov).fit(data)
+    gm.mesh = None
+    with _engine(mesh) as eng:
+        eng.add_model("gm", gm)
+        probe = data[:57]
+        np.testing.assert_array_equal(eng.predict("gm", probe),
+                                      gm.predict(probe))
+
+
+def test_bf16_fast_path_labels_exact_distances_rtol(data):
+    """The quantized path's labels must be BIT-EQUAL on separated data
+    (argmin is ordering-robust where distances round); distances agree
+    to the bf16 input-rounding class (~2^-8) relative to each row's
+    distance scale."""
+    mesh = _mesh(min(4, len(jax.devices())))
+    km = KMeans(k=5, seed=0, verbose=False, max_iter=25).fit(data)
+    km.mesh = None
+    with _engine(mesh) as eng:
+        rm = eng.add_model("q", km, quantize="bf16")
+        assert rm.quantize == "bf16"
+        probe = data[:400]
+        # Serving through the quantized resident: labels == f32 oracle.
+        np.testing.assert_array_equal(eng.predict("q", probe),
+                                      km.predict(probe))
+        report = eng.verify_quantized("q", probe)
+        assert report["labels_equal"] and report["label_mismatches"] == 0
+        # bf16 cross-term: ~2^-8 relative to the row scale, with
+        # cancellation headroom; the f32 path would be ~1e-7.
+        assert 0.0 < report["dist_max_rel"] < 0.05
+        with pytest.raises(ValueError, match="quantize"):
+            eng.add_model("bad", km, quantize="int4")
+
+
+def test_bf16_near_tie_rows_corrected_exactly(data):
+    """The exactness guard: probe rows sitting ON Voronoi boundaries
+    (midpoints of centroid pairs, nudged by ~1e-4) have argmin margins
+    inside the bf16 error bound — plain bf16 argmin WOULD flip some of
+    them (the end-to-end drive measured 14/1000 flips on ordinary
+    blobs).  The guarded path re-labels the flagged rows at f32, so
+    labels stay bit-equal AND the correction counter proves the guard
+    actually fired."""
+    mesh = _mesh(min(2, len(jax.devices())))
+    km = KMeans(k=5, seed=0, verbose=False, max_iter=25).fit(data)
+    km.mesh = None
+    C = np.asarray(km.centroids, np.float64)
+    mids = []
+    rng = np.random.default_rng(0)
+    for i in range(len(C)):
+        for j in range(i + 1, len(C)):
+            mid = (C[i] + C[j]) / 2.0
+            mids.append(mid * (1.0 + 1e-4 * rng.standard_normal()))
+    probe = np.asarray(mids, np.float32)
+    with _engine(mesh) as eng:
+        rm = eng.add_model("q", km, quantize="bf16")
+        got = eng.predict("q", probe)
+        np.testing.assert_array_equal(got, km.predict(probe))
+        assert rm.bf16_corrected_rows > 0        # the guard fired
+        report = eng.verify_quantized("q", probe)
+        assert report["labels_equal"]
+        assert report["corrected_rows"] > 0
+        assert eng.stats()["models"]["q"]["bf16_corrected_rows"] > 0
+
+
+def test_packed_routing_of_quantized_models_stays_exact(data):
+    """Review regression: packed multi-model routing has no bf16
+    near-tie guard, so it must serve at f32 even when every member is
+    quantized — a Voronoi-midpoint mixed batch must still equal the
+    per-model sequential (guarded) results bit-for-bit."""
+    mesh = _mesh(min(2, len(jax.devices())))
+    a = KMeans(k=5, seed=0, verbose=False, max_iter=25).fit(data)
+    b = KMeans(k=5, seed=9, verbose=False, max_iter=25).fit(data)
+    a.mesh = b.mesh = None
+    C = np.asarray(a.centroids, np.float64)
+    mids = np.asarray([(C[i] + C[j]) / 2.0
+                       for i in range(len(C))
+                       for j in range(i + 1, len(C))], np.float32)
+    with _engine(mesh) as eng:
+        eng.add_model("a", a, quantize="bf16")
+        eng.add_model("b", b, quantize="bf16")
+        outs = eng.predict_multi([("a", mids), ("b", mids)])
+        np.testing.assert_array_equal(outs[0], a.predict(mids))
+        np.testing.assert_array_equal(outs[1], b.predict(mids))
+        assert eng.packed_dispatches == 1
+        # Stats coherence: the packed dispatch is ONE physical dispatch
+        # in the global count and the fill histogram.
+        st = eng.stats()
+        assert st["dispatches"] == 1
+        assert sum(v["dispatches"]
+                   for v in st["batch_fill"].values()) == 1
+
+
+def test_bf16_rejected_under_tp_sharding(data):
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    mesh = _mesh(1, 2)
+    km = KMeans(k=4, seed=0, verbose=False, max_iter=10,
+                model_shards=2).fit(data)
+    km.mesh = None
+    with _engine(mesh) as eng:
+        with pytest.raises(ValueError, match="data-parallel"):
+            eng.add_model("q", km, quantize="bf16")
+
+
+def test_bf16_differs_from_f32_distances(data):
+    """Guard that the fast path actually quantizes (a no-op 'bf16' mode
+    would trivially pass the parity pin)."""
+    mesh = _mesh(1)
+    km = KMeans(k=5, seed=0, verbose=False, max_iter=25).fit(data)
+    km.mesh = None
+    with _engine(mesh) as eng:
+        eng.add_model("q", km, quantize="bf16")
+        report = eng.verify_quantized("q", data[:200])
+        assert report["dist_max_rel"] > 1e-5
+
+
+def test_multi_model_routed_batch_matches_sequential(data):
+    """Three same-shape K-Means-family models (incl. a spherical one —
+    its rows normalize before packing): one routed mixed batch ==
+    per-model sequential predicts, via ONE packed dispatch."""
+    mesh = _mesh(min(4, len(jax.devices())))
+    a = KMeans(k=5, seed=0, verbose=False, max_iter=25).fit(data)
+    b = KMeans(k=5, seed=9, verbose=False, max_iter=25).fit(data)
+    s = SphericalKMeans(k=5, seed=3, verbose=False, max_iter=25).fit(data)
+    for m in (a, b, s):
+        m.mesh = None
+    with _engine(mesh) as eng:
+        eng.add_model("a", a)
+        eng.add_model("b", b)
+        eng.add_model("s", s)
+        reqs = [("a", data[:40]), ("s", data[40:100]),
+                ("b", data[100:110]), ("a", data[110:150])]
+        outs = eng.predict_multi(reqs)
+        np.testing.assert_array_equal(outs[0], a.predict(data[:40]))
+        np.testing.assert_array_equal(outs[1], s.predict(data[40:100]))
+        np.testing.assert_array_equal(outs[2], b.predict(data[100:110]))
+        np.testing.assert_array_equal(outs[3], a.predict(data[110:150]))
+        assert eng.packed_dispatches == 1
+        # A GMM (unstackable) mixed in routes per-model, same results.
+        gm = GaussianMixture(n_components=3, seed=0).fit(data)
+        gm.mesh = None
+        eng.add_model("gm", gm)
+        outs = eng.predict_multi([("a", data[:20]), ("gm", data[:20])])
+        np.testing.assert_array_equal(outs[0], a.predict(data[:20]))
+        np.testing.assert_array_equal(outs[1], gm.predict(data[:20]))
+
+
+def test_kmeans_score_rtol_and_transform_parity(data):
+    mesh = _mesh(min(2, len(jax.devices())))
+    km = KMeans(k=5, seed=0, verbose=False, max_iter=25).fit(data)
+    km.mesh = None
+    with _engine(mesh) as eng:
+        eng.add_model("m", km)
+        probe = data[:97]
+        # score: same quantity, different (per-row f64 host) summation
+        # order than the fused device SSE -> rtol, not bitwise.
+        assert np.isclose(eng.score("m", probe), km.score(probe),
+                          rtol=1e-5)
+        tile = eng.submit("m", probe, op="transform").result(
+            timeout=30.0)
+        np.testing.assert_allclose(tile, km.transform(probe),
+                                   rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------- caching
+
+
+def test_predict_placement_cached_on_model(data):
+    """ISSUE 6 satellite: repeated same-shape predicts place the
+    centroid table ONCE (the _cents_dev instance cache), and a re-fit
+    invalidates it (fresh centroids array identity)."""
+    km = KMeans(k=4, seed=0, verbose=False, max_iter=10).fit(data)
+    calls = []
+    orig = KMeans._put_centroids
+
+    def counting(self, cents, mesh, model_shards):
+        calls.append(1)
+        return orig(self, cents, mesh, model_shards)
+
+    KMeans._put_centroids = counting
+    try:
+        km.predict(data[:64])
+        n_after_first = len(calls)
+        km.predict(data[:64])
+        km.predict(data[:32])                # different shape, same table
+        assert len(calls) == n_after_first   # no re-placement
+        km.fit(data)                         # fresh centroids array
+        km.predict(data[:64])
+        assert len(calls) > n_after_first    # cache invalidated
+    finally:
+        KMeans._put_centroids = orig
+
+
+def test_engine_and_predict_share_step_cache(data):
+    """The engine's assignment program for a bucket shape IS the one
+    ``KMeans.predict`` compiled (one shared _STEP_CACHE — no duplicate
+    executables for the same (mesh, chunk, mode))."""
+    mesh = _mesh(min(2, len(jax.devices())))
+    km = KMeans(k=4, seed=0, verbose=False, max_iter=10).fit(data)
+    km.mesh = mesh
+    probe = np.zeros((64, data.shape[1]), np.float32)
+    km.predict(probe)                        # compile via the model
+    before = len(kmeans_mod._STEP_CACHE)
+    with _engine(mesh) as eng:
+        eng.add_model("m", km)
+        eng.predict("m", probe)              # same bucket shape
+    assert len(kmeans_mod._STEP_CACHE) == before
+
+
+def test_explicit_chunk_size_model_serves_at_bucket_chunk(data):
+    """A model fitted with an explicit training ``chunk_size`` must NOT
+    impose it on serving dispatches (review finding: chunk_size=2M
+    would pad an 8-row request to data_shards x 2M rows per call) —
+    the engine sizes its scan chunk from the bucket shape."""
+    from kmeans_tpu.serving import engine as engine_mod
+    mesh = _mesh(min(2, len(jax.devices())))
+    big = 65536                              # >> every default bucket
+    km = KMeans(k=4, seed=0, verbose=False, max_iter=10,
+                chunk_size=big).fit(data)
+    ref = KMeans(k=4, seed=0, verbose=False, max_iter=10).fit(data)
+    km.mesh = None
+    served_chunks = []
+    orig = engine_mod.shard_points
+
+    def spying(buf, mesh_, chunk, *a, **kw):
+        served_chunks.append(chunk)
+        return orig(buf, mesh_, chunk, *a, **kw)
+
+    engine_mod.shard_points = spying
+    try:
+        with _engine(mesh) as eng:
+            rm = eng.add_model("m", km)
+            assert eng._serve_chunk(rm, 8) < big
+            got = eng.predict("m", data[:3])
+            fut = eng.submit("m", data[:3])  # queued path too
+            np.testing.assert_array_equal(fut.result(timeout=30.0), got)
+    finally:
+        engine_mod.shard_points = orig
+    np.testing.assert_array_equal(got, ref.predict(data[:3]))
+    assert served_chunks and all(c < big for c in served_chunks)
+
+
+def test_warmup_excluded_from_stats_bf16_audit(data):
+    """warmup() probes through the real bf16 guarded path must not
+    pollute ``bf16_corrected_rows`` (review finding: the old counter
+    rollback missed it).  Centroids are placed so the warm-up probe
+    rows (1.0 in column 0) tie exactly — every probe row triggers the
+    near-tie correction."""
+    mesh = _mesh(1)
+    km = KMeans(k=2, seed=0, verbose=False, max_iter=5).fit(data)
+    cents = np.zeros((2, data.shape[1]), np.float32)
+    cents[0, 1], cents[1, 1] = 1.0, -1.0     # equidistant from e1 probes
+    km.centroids = cents
+    km.mesh = None
+    with _engine(mesh) as eng:
+        eng.add_model("m", km, quantize="bf16")
+        n = eng.warmup()
+        assert n == len(eng.buckets)
+        st = eng.stats()
+        assert st["dispatches"] == 0 and st["batch_fill"] == {}
+        assert st["models"]["m"]["bf16_corrected_rows"] == 0
+        # Served traffic on the tie rows DOES audit corrections.
+        probe = np.zeros((4, data.shape[1]), np.float32)
+        probe[:, 0] = 1.0
+        eng.predict("m", probe)
+        assert eng.stats()["models"]["m"]["bf16_corrected_rows"] > 0
+
+
+def test_gmm_params_dev_cached(data):
+    gm = GaussianMixture(n_components=3, seed=0).fit(data)
+    mesh = gm._resolve_mesh()
+    p1 = gm._params_dev(mesh)
+    p2 = gm._params_dev(mesh)
+    assert all(a is b for a, b in zip(p1, p2))
+    gm.fit(data)                             # fresh fitted arrays
+    p3 = gm._params_dev(mesh)
+    assert p3 is not p1 and not all(a is b for a, b in zip(p1, p3))
+
+
+# ----------------------------------------------------- registry + ckpts
+
+
+def test_registry_load_all_families_roundtrip(tmp_path, data):
+    mesh = _mesh(min(2, len(jax.devices())))
+    models = {name: FAMILIES[name]().fit(data)
+              for name in ("kmeans", "spherical", "gmm")}
+    with _engine(mesh) as eng:
+        for name, model in models.items():
+            path = tmp_path / f"{name}.npz"
+            model.save(path)
+            mid = eng.load(path)
+            assert mid == name
+            np.testing.assert_array_equal(
+                eng.predict(mid, data[:80]), models[name].predict(
+                    data[:80]))
+        stats = eng.stats()
+        assert stats["models_resident"] == 3
+        assert stats["models"]["gmm"]["family"] == "gmm"
+
+
+def test_registry_semantics(tmp_path, data):
+    km = KMeans(k=3, seed=0, verbose=False, max_iter=5).fit(data)
+    reg = ModelRegistry()
+    reg.register("a", km)
+    with pytest.raises(ValueError, match="already resident"):
+        reg.register("a", km)
+    with pytest.raises(KeyError, match="no resident model"):
+        reg.get("zzz")
+    # Unfitted models are rejected at registration.
+    with pytest.raises(ValueError, match="fitted"):
+        reg.register("b", KMeans(k=3, verbose=False))
+    # Collision-suffixed ids on load.
+    km.save(tmp_path / "a.npz")
+    mid, _ = reg.load(tmp_path / "a.npz")
+    assert mid == "a-2"
+    assert reg.ids() == ["a", "a-2"]
+    # Pack groups: same (k, d, dtype) K-Means family.
+    assert list(reg.pack_groups().values()) == [["a", "a-2"]]
+    reg.remove("a-2")
+    assert reg.pack_groups() == {}
+
+
+def test_load_fitted_rejects_unknown_class(tmp_path, data):
+    km = KMeans(k=3, seed=0, verbose=False, max_iter=5).fit(data)
+    state = km._state_dict()
+    state["model_class"] = "FancyModel"
+    from kmeans_tpu.utils import checkpoint as ckpt
+    path = tmp_path / "weird.npz"
+    ckpt.save_state(path, state)
+    with pytest.raises(ValueError, match="FancyModel"):
+        load_fitted(path)
+
+
+def test_fitted_state_specs(data):
+    km = KMeans(k=4, seed=0, verbose=False, max_iter=5).fit(data)
+    spec = km.fitted_state()
+    assert spec["family"] == "kmeans" and spec["stackable"]
+    assert spec["d"] == data.shape[1]
+    sk = SphericalKMeans(k=4, seed=0, verbose=False, max_iter=5).fit(data)
+    assert sk.fitted_state()["normalize_inputs"]
+    gm = GaussianMixture(n_components=3, seed=0).fit(data)
+    gspec = gm.fitted_state()
+    assert gspec["family"] == "gmm" and not gspec["stackable"]
+    with pytest.raises(ValueError, match="fitted"):
+        KMeans(k=3, verbose=False).fitted_state()
+
+
+# ------------------------------------------------- engine-level behavior
+
+
+def test_engine_validation_and_stats(data):
+    mesh = _mesh(1)
+    km = KMeans(k=4, seed=0, verbose=False, max_iter=10).fit(data)
+    km.mesh = None
+    with _engine(mesh) as eng:
+        eng.add_model("m", km)
+        # Submit-time poison isolation: bad requests fail alone...
+        bad_width = eng.submit("m", np.zeros((2, 3), np.float32))
+        nan_rows = eng.submit("m", np.full((1, data.shape[1]), np.nan,
+                                           np.float32))
+        unknown = eng.submit("zzz", data[:1])
+        bad_op = eng.submit("m", data[:1], op="predict_proba")
+        good = eng.submit("m", data[:2])
+        np.testing.assert_array_equal(good.result(timeout=30.0),
+                                      km.predict(data[:2]))
+        for fut, match in ((bad_width, "rows must be"),
+                           (nan_rows, "non-finite"),
+                           (unknown, "no resident model"),
+                           (bad_op, "not served")):
+            assert match in str(fut.exception(timeout=30.0))
+        # 1-D convenience: a single row without the batch axis.
+        one = eng.predict("m", data[0])
+        assert one.shape == (1,)
+        stats = eng.stats()
+        assert stats["models_resident"] == 1
+        assert stats["dispatches"] >= 2
+        fills = stats["batch_fill"]
+        assert fills and all(0 < v["fill"] <= 1 for v in fills.values())
+        json.dumps(stats)                    # JSON-serializable contract
+
+
+def test_engine_warmup_excluded_from_stats(data):
+    mesh = _mesh(1)
+    km = KMeans(k=4, seed=0, verbose=False, max_iter=10).fit(data)
+    km.mesh = None
+    with _engine(mesh) as eng:
+        eng.add_model("m", km)
+        n = eng.warmup()
+        assert n == len(eng.buckets)
+        st = eng.stats()
+        assert st["dispatches"] == 0 and st["batch_fill"] == {}
+
+
+def test_serve_cli_jsonl_loop(tmp_path, data, monkeypatch, capsys):
+    """The ``serve`` CLI satellite: JSONL request loop over stdin, per-
+    request errors isolated, stats line, final --json stats output."""
+    import io
+
+    from kmeans_tpu.cli import serve_main
+    km = KMeans(k=4, seed=0, verbose=False, max_iter=10).fit(data)
+    km.save(tmp_path / "km.npz")
+    want = km.predict(data[:3]).tolist()
+    lines = [
+        json.dumps({"x": data[:3].tolist(), "id": "r1"}),
+        json.dumps({"stats": True}),
+        json.dumps({"model": "nope", "x": [[0.0] * data.shape[1]]}),
+        "not json at all",
+    ]
+    monkeypatch.setattr("sys.stdin", io.StringIO("\n".join(lines) + "\n"))
+    rc = serve_main(["--model", str(tmp_path / "km.npz"), "--json",
+                     "--no-warmup", "--max-wait-ms", "1.0"])
+    assert rc == 0
+    out_lines = [json.loads(ln) for ln in
+                 capsys.readouterr().out.strip().splitlines()]
+    assert out_lines[0]["result"] == want and out_lines[0]["id"] == "r1"
+    assert out_lines[1]["models_resident"] == 1       # stats request
+    assert "error" in out_lines[2] and "error" in out_lines[3]
+    final = out_lines[-1]                             # --json stats
+    # The serial stdin loop dispatches immediately (no queue — going
+    # through submit would pay the flush timer per request for
+    # coalescing that can never happen), so requests land on the
+    # per-model counters, not the queue's.
+    assert final["models"]["km"]["requests"] >= 1
+    assert final["models"]["km"]["model_class"] == "KMeans"
+
+
+def test_serve_cli_missing_checkpoint(tmp_path, capsys):
+    from kmeans_tpu.cli import serve_main
+    rc = serve_main(["--model", str(tmp_path / "nope.npz")])
+    assert rc == 2
+    assert "cannot load" in capsys.readouterr().err
