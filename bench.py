@@ -244,6 +244,30 @@ def main() -> None:
         bench_serving(vn, vd, vk, batch_sizes=vb, max_wait_ms=vw)
         return
 
+    if os.environ.get("BENCH_SWEEP"):
+        # Multi-k sweep benchmark (ISSUE 7): the batched fit-many/
+        # pick-best sweep (one vmapped dispatch for all (k, restart)
+        # members) vs the sequential per-member oracle, interleaved
+        # per-rep ratios + the wasted-FLOPs (padding economics) column.
+        # CPU proxy default: 200k x 32, k 2..17, n_init=2 (the pinned
+        # acceptance config, >= 2x); accelerator default: the 10M x 128
+        # headline family with the >= 3x decision rule.
+        from kmeans_tpu.benchmarks import bench_sweep
+        from kmeans_tpu.sweep import parse_k_range
+        wn = int(os.environ.get("BENCH_N",
+                                10_000_000 if on_accel else 200_000))
+        wd = int(os.environ.get("BENCH_D", 128 if on_accel else 32))
+        # Same half-open 'lo:hi[:step]' / comma grammar as the CLI's
+        # --k-range, so a bench config reproduces verbatim through the
+        # sweep subcommand (default 2:18 = k in {2..17}).
+        ks = parse_k_range(os.environ.get("BENCH_SWEEP_KRANGE", "2:18"))
+        wi = int(os.environ.get("BENCH_ITERS", 10))
+        wni = int(os.environ.get("BENCH_SWEEP_NINIT", 2))
+        log(f"bench: SWEEP mode backend={backend} N={wn} D={wd} "
+            f"k={ks[0]}..{ks[-1]} n_init={wni} max_iter={wi}")
+        bench_sweep(wn, wd, ks, wni, wi)
+        return
+
     if os.environ.get("BENCH_STREAM"):
         # Streamed-epoch benchmark (fit_stream, disk blocks through the
         # double-buffered pipeline): prefetch on vs off by the marginal

@@ -1,0 +1,66 @@
+"""Model selection: batched multi-k sweeps — fit-many, pick-best, in
+O(1) dispatches.
+
+Real users don't know k.  The classic workflow — fit k_max models,
+plot the elbow / silhouette / BIC curve, pick one — pays k_max full
+fits.  ``sweep()`` collapses the whole grid into ONE vmapped device
+dispatch: every (k, restart) member is padded to k_max with inert
+components and rides the batched restart machinery, then the criterion
+curve is scored in a constant number of further dispatches.
+
+Run: ``python examples/08_model_selection_sweep.py``
+"""
+
+import numpy as np
+
+from kmeans_tpu import GaussianMixture, KMeans
+from kmeans_tpu.data.synthetic import make_blobs
+
+# Ground truth: 5 well-separated blobs (so the curves have a clean
+# answer to find).
+X, _ = make_blobs(40_000, centers=5, n_features=16, random_state=7,
+                  dtype=np.float32)
+
+# --- Elbow sweep: k ∈ {2..9} × 2 restarts = 16 fits, ONE dispatch ----
+km = KMeans(k=2, seed=0, n_init=2, max_iter=50, empty_cluster="keep",
+            verbose=False)
+res = km.sweep(X, k_range=range(2, 10), criterion="inertia")
+print(f"elbow sweep: selected k={res.selected_k} in "
+      f"{res.n_dispatches} device dispatch(es)")
+for k, score in zip(res.k_range, res.scores):
+    bar = "#" * max(1, int(40 * score / res.scores[0]))
+    print(f"  k={k}: inertia {score:12.1f}  {bar}")
+
+# The winner is a normally-fitted model: predict/score/save all work.
+best = res.best_model
+print(f"best model: k={best.k}, {best.iterations_run} iterations, "
+      f"restart {res.selected_restart} won of {res.member_scores.shape[1]}")
+labels = best.predict(X[:1000])
+print(f"labels of 1000 rows -> {np.bincount(labels)}")
+
+# --- Silhouette criterion: same batched fit, batched scoring ---------
+res_sil = KMeans(k=2, seed=0, max_iter=50, empty_cluster="keep",
+                 verbose=False).sweep(
+    X[::5], k_range=range(2, 8), criterion="silhouette")
+print(f"silhouette sweep: selected k={res_sil.selected_k} "
+      f"({res_sil.n_dispatches} dispatches; scores "
+      f"{np.round(res_sil.scores, 3).tolist()})")
+
+# --- BIC sweep for mixtures: the principled k selector ---------------
+gm = GaussianMixture(n_components=2, covariance_type="diag", seed=0,
+                     max_iter=40, init_params="random", verbose=False)
+res_bic = gm.sweep(X, k_range=range(2, 10), criterion="bic")
+print(f"BIC sweep: selected k={res_bic.selected_k} in "
+      f"{res_bic.n_dispatches} dispatch(es)")
+for k, score in zip(res_bic.k_range, res_bic.scores):
+    mark = " <-- min" if k == res_bic.selected_k else ""
+    print(f"  k={k}: BIC {score:14.1f}{mark}")
+
+# The sequential oracle (batched=0) is the parity/debug path: same
+# members, one fit per member — what the batched sweep must match.
+res_seq = KMeans(k=2, seed=0, n_init=2, max_iter=50,
+                 empty_cluster="keep", verbose=False).sweep(
+    X, k_range=range(2, 10), criterion="inertia", batched=0)
+assert res_seq.selected_k == res.selected_k
+print(f"sequential oracle agrees: k={res_seq.selected_k} "
+      f"({res_seq.n_dispatches} dispatches vs {res.n_dispatches})")

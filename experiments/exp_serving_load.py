@@ -1,12 +1,11 @@
-"""Serving load-generator harness (ISSUE 6): closed-loop concurrent
-clients against the resident warm-kernel engine — the end-to-end QPS /
-latency artifact behind the BASELINE.md r11 serving rows.
+"""Serving load-generator harness (ISSUE 6 closed loop + ISSUE 7 open
+loop): load against the resident warm-kernel engine — the end-to-end
+QPS / latency artifact behind the BASELINE.md r11 serving rows.
 
-Method: one K-Means model resident in a ``ServingEngine``; C client
-THREADS each submit single-row ``predict`` requests back-to-back
-through the micro-batch queue (closed loop — a client's next request
-leaves when its previous one completes, the standard way to measure a
-latency/throughput curve without an open-loop arrival model), for a
+CLOSED LOOP (default): one K-Means model resident in a
+``ServingEngine``; C client THREADS each submit single-row ``predict``
+requests back-to-back through the micro-batch queue (closed loop — a
+client's next request leaves when its previous one completes), for a
 fixed per-client request budget.  Concurrency sweeps 1/8/64/512
 clients; per level the harness reports:
 
@@ -21,23 +20,59 @@ clients; per level the harness reports:
 * the sequential-dispatch baseline QPS at the same request count (one
   ``engine.predict`` per request, no queue) and the resulting speedup.
 
-DECISION RULE (committed now, measured per platform): micro-batching
-earns its complexity where concurrent traffic exists — the acceptance
-bar is batched QPS >= 2x the sequential baseline at >= 8 concurrent
-clients.  On the CPU container the bar is already cleared (~4x at 8,
-published r11); the HARDWARE run (tunneled chip, ~70-100 ms dispatch
-RTT — docs/PERFORMANCE.md) is where the amortization is existential:
-sequential per-request QPS is bounded by ~1/RTT (~10-14 QPS) and the
-batched path should clear 100x at 512 clients.  If hardware ever
-measures batched < sequential at >= 8 clients, the queue defaults
-(max_wait_ms, buckets) are wrong for that platform and the row must be
-published as a rejection with the engine defaulting to direct
-dispatch.
+OPEN LOOP (``SERVE_MODE=open``, the r11 REMAINING item, landed with
+ISSUE 7 so sweep-selected models can be load-tested at fixed QPS): a
+dispatcher submits single-row requests at a FIXED offered arrival rate
+— arrivals do not wait for completions, so the measurement is free of
+coordinated omission (a closed loop silently slows its own arrivals
+when the server stalls; an open loop charges the stall to every
+request scheduled behind it).  Per offered rate the harness reports
+p50/p99 latency measured from each request's SCHEDULED arrival time
+(send lag — the dispatcher falling behind, e.g. on an inline
+flush-on-full dispatch — is part of the number, by design), achieved
+QPS, rows per dispatch, and max send lag.  Rates default to
+{25,50,75,90}% of a closed-loop calibration run's peak QPS at 64
+clients.  ``SERVE_SWEEP=1`` selects the model's k with
+``KMeans.sweep`` (ISSUE 7) instead of taking SERVE_K as given — the
+sweep-selected-then-load-tested workflow end to end.
+
+DECISION RULES (committed now, measured per platform):
+
+* closed loop — micro-batching earns its complexity where concurrent
+  traffic exists: the acceptance bar is batched QPS >= 2x the
+  sequential baseline at >= 8 concurrent clients.  On the CPU
+  container the bar is already cleared (~4x at 8, published r11); the
+  HARDWARE run (tunneled chip, ~70-100 ms dispatch RTT —
+  docs/PERFORMANCE.md) is where the amortization is existential:
+  sequential per-request QPS is bounded by ~1/RTT (~10-14 QPS) and
+  the batched path should clear 100x at 512 clients.  If hardware
+  ever measures batched < sequential at >= 8 clients, the queue
+  defaults (max_wait_ms, buckets) are wrong for that platform and the
+  row must be published as a rejection with the engine defaulting to
+  direct dispatch.
+* open loop — the engine must SUSTAIN half its closed-loop peak: at
+  offered load = 0.5x the calibration QPS, p99 (from scheduled
+  arrival) <= max_wait_ms + 10x the direct single-dispatch latency,
+  AND the end-of-run drain (wall past the last scheduled arrival
+  until the final completion — the backlog the offered window left
+  behind; it grows linearly with run length iff the rate exceeds
+  capacity) <= the same bound.  A naive achieved/offered >= 95% rule
+  is NOT used: for a finite run the final drain is charged to the
+  wall either way, biasing the ratio low at exactly the rates a long
+  run would sustain.  The first swept rate violating either bound is
+  the knee; the largest sustained rate publishes as
+  ``max_sustained_qps``.  A violation AT the 0.5x point is a
+  rejection: the queue cannot absorb its own calibration traffic and
+  its defaults must be re-tuned for that platform.
 
 Run:  python experiments/exp_serving_load.py
 Env:  SERVE_N / SERVE_D / SERVE_K (model shape), SERVE_CLIENTS
       (comma list, default 1,8,64,512), SERVE_REQS (per client,
-      default 64), SERVE_WAIT_MS (default 2.0).
+      default 64), SERVE_WAIT_MS (default 2.0),
+      SERVE_MODE (closed|open, default closed), SERVE_RATES (comma
+      list of offered QPS; default auto-calibrated), SERVE_OPEN_REQS
+      (requests per rate, default 512), SERVE_SWEEP (1 = pick k via
+      KMeans.sweep over SERVE_SWEEP_KRANGE, default '4:65:4').
 """
 
 import json
@@ -109,6 +144,165 @@ def run_level(engine, pool, clients: int, reqs: int):
     }
 
 
+def run_open_loop(engine, pool, rate_qps: float, n_reqs: int):
+    """One open-loop offered-rate level; returns the metrics row.
+
+    A dispatcher thread submits at scheduled instants t0 + i/rate
+    without waiting for completions; latency is completion minus the
+    SCHEDULED arrival (so a stalled server — or the dispatcher itself
+    falling behind on an inline flush-on-full dispatch — is charged to
+    every request queued behind the stall; no coordinated omission).
+    Completion times come from a small FIFO waiter pool: the queue
+    dispatches FIFO per model so completions land near submission
+    order, and 8 concurrent waiters absorb the residual reordering
+    (batch-boundary granularity, well under the ms-scale latencies
+    being measured).
+    """
+    import queue as queue_mod
+    done_q = queue_mod.Queue()
+    lats = []
+    failures = [0]
+    lock = threading.Lock()
+
+    def waiter():
+        # A failed/timed-out request must not kill the waiter thread —
+        # that silently drops every sample routed to it and skews the
+        # published percentiles.  Count it and keep draining; the row
+        # publishes ``failed`` and the judge treats any failure as
+        # not-sustained (an overloaded level is exactly where timeouts
+        # appear, and it is the answer, not noise).
+        while True:
+            item = done_q.get()
+            if item is None:
+                return
+            sched, fut = item
+            try:
+                fut.result(timeout=120.0)
+            except Exception:
+                with lock:
+                    failures[0] += 1
+                continue
+            t = time.perf_counter()
+            with lock:
+                lats.append(t - sched)
+
+    waiters = [threading.Thread(target=waiter) for _ in range(8)]
+    for w in waiters:
+        w.start()
+
+    rng = np.random.default_rng(1234)
+    idx = rng.integers(0, pool.shape[0], size=n_reqs)
+    interval = 1.0 / rate_qps
+    max_send_lag = 0.0
+    d0 = engine.stats()["dispatches"]
+    t0 = time.perf_counter()
+    for i in range(n_reqs):
+        sched = t0 + i * interval
+        now = time.perf_counter()
+        if sched > now:
+            time.sleep(sched - now)
+        fut = engine.submit("serve", pool[idx[i]][None, :])
+        max_send_lag = max(max_send_lag, time.perf_counter() - sched)
+        done_q.put((sched, fut))
+    for _ in waiters:
+        done_q.put(None)
+    for w in waiters:
+        w.join()
+    wall = time.perf_counter() - t0
+    d1 = engine.stats()["dispatches"]
+    lats = np.sort(np.asarray(lats))
+    sched_duration = (n_reqs - 1) * interval
+    # Percentiles cover COMPLETED requests only; ``failed`` > 0 marks
+    # the row biased (and all-failed publishes null percentiles rather
+    # than crashing on an empty array).
+    return {
+        "mode": "open",
+        "offered_qps": round(rate_qps, 1),
+        "achieved_qps": round((n_reqs - failures[0]) / wall, 1),
+        "requests": n_reqs,
+        "failed": failures[0],
+        "p50_ms": (round(float(np.percentile(lats, 50)) * 1e3, 3)
+                   if lats.size else None),
+        "p99_ms": (round(float(np.percentile(lats, 99)) * 1e3, 3)
+                   if lats.size else None),
+        "drain_ms": round(max(wall - sched_duration, 0.0) * 1e3, 3),
+        "max_send_lag_ms": round(max_send_lag * 1e3, 3),
+        "rows_per_dispatch": round(n_reqs / max(d1 - d0, 1), 2),
+    }
+
+
+def open_loop_sweep(engine, pool, wait_ms: float):
+    """The tail-latency-vs-offered-load curve + the committed decision
+    (module docstring): calibrate peak QPS closed-loop at 64 clients,
+    sweep SERVE_RATES (default {25,50,75,90}% of peak), and judge the
+    0.5x-peak point against p99 AND end-of-run drain <= max_wait_ms +
+    10x the direct single-dispatch latency (docstring rationale)."""
+    n_open = int(os.environ.get("SERVE_OPEN_REQS", 512))
+
+    # Direct single-dispatch latency (no queue, no timer): the p99
+    # bound's scale term.
+    for _ in range(8):                       # warm
+        engine.predict("serve", pool[:1])
+    t0 = time.perf_counter()
+    n_direct = 64
+    for i in range(n_direct):
+        engine.predict("serve", pool[i % pool.shape[0]][None, :])
+    direct_s = (time.perf_counter() - t0) / n_direct
+
+    rates_env = os.environ.get("SERVE_RATES", "")
+    cal_qps = None
+    if rates_env:
+        rates = [float(r) for r in rates_env.split(",")]
+    else:
+        cal = run_level(engine, pool, clients=64,
+                        reqs=int(os.environ.get("SERVE_REQS", 64)))
+        cal_qps = cal["qps"]
+        print(json.dumps({"mode": "open-calibration", **cal}),
+              flush=True)
+        rates = [round(cal_qps * f, 1) for f in (0.25, 0.5, 0.75, 0.9)]
+    p99_bound_ms = wait_ms + 10 * direct_s * 1e3
+
+    # Discarded warm-up level: the first open-loop burst after the
+    # closed-loop calibration consistently eats a scheduler cold-start
+    # spike (waiter threads + queue worker warming up) that is not a
+    # property of any offered rate.  Capped at ~2 s of paced traffic —
+    # its only job is waking the threads, and an uncapped 128-request
+    # warm-up at a low pinned SERVE_RATES would stall the run for
+    # 128/rate seconds before the first measured level.
+    n_warm = min(128, n_open, max(8, int(2.0 * rates[0])))
+    run_open_loop(engine, pool, rates[0], n_warm)
+
+    rows = []
+    for rate in rates:
+        row = run_open_loop(engine, pool, rate, n_open)
+        row["sustained"] = bool(row["failed"] == 0
+                                and row["p99_ms"] is not None
+                                and row["p99_ms"] <= p99_bound_ms
+                                and row["drain_ms"] <= p99_bound_ms)
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+
+    sustained = [r["offered_qps"] for r in rows if r["sustained"]]
+    verdict = {
+        "mode": "open",
+        "direct_dispatch_ms": round(direct_s * 1e3, 3),
+        "p99_bound_ms": round(p99_bound_ms, 3),
+        "calibration_qps": cal_qps,
+        "max_sustained_qps": max(sustained) if sustained else 0.0,
+    }
+    if cal_qps is not None:
+        half = min(rows, key=lambda r: abs(r["offered_qps"]
+                                           - 0.5 * cal_qps))
+        verdict["passed"] = bool(half["sustained"])
+        verdict["decision"] = (
+            "engine sustains 0.5x its closed-loop peak open-loop"
+            if half["sustained"] else
+            "REJECTION: queue cannot absorb 0.5x its own calibration "
+            "traffic — re-tune max_wait_ms/buckets for this platform")
+    print(json.dumps(verdict), flush=True)
+    return rows
+
+
 def main():
     backend = jax.default_backend()
     on_accel = backend not in ("cpu",)
@@ -121,19 +315,43 @@ def main():
     reqs = int(os.environ.get("SERVE_REQS", 64))
     wait_ms = float(os.environ.get("SERVE_WAIT_MS", 2.0))
 
+    mode = os.environ.get("SERVE_MODE", "closed")
+
     rng = np.random.default_rng(42)
     X = rng.uniform(-1.0, 1.0, size=(n, d)).astype(np.float32)
-    init = X[np.sort(rng.choice(n, size=k, replace=False))].copy()
-    model = KMeans(k=k, max_iter=5, seed=0, init=init,
-                   empty_cluster="keep", verbose=False).fit(X)
+    if os.environ.get("SERVE_SWEEP", "") == "1":
+        # ISSUE 7 workflow end to end: pick k by a batched multi-k
+        # sweep, then load-test the selected model.
+        ks = os.environ.get("SERVE_SWEEP_KRANGE", "4:65:4")
+        sweep_res = KMeans(k=2, max_iter=5, seed=0,
+                           empty_cluster="keep",
+                           verbose=False).sweep(X, k_range=ks,
+                                                criterion="inertia")
+        model, k = sweep_res.best_model, sweep_res.selected_k
+        print(json.dumps({"sweep_selected_k": k,
+                          "sweep_dispatches": sweep_res.n_dispatches,
+                          "k_range": ks}), flush=True)
+    else:
+        init = X[np.sort(rng.choice(n, size=k, replace=False))].copy()
+        model = KMeans(k=k, max_iter=5, seed=0, init=init,
+                       empty_cluster="keep", verbose=False).fit(X)
     pool = rng.uniform(-1.0, 1.0, size=(4096, d)).astype(np.float32)
 
     print(f"serving load: backend={backend} devices="
           f"{len(jax.devices())} model k={k} d={d} (fit on {n:,} rows), "
-          f"{reqs} reqs/client, max_wait_ms={wait_ms}", file=sys.stderr)
+          f"{reqs} reqs/client, max_wait_ms={wait_ms}, mode={mode}",
+          file=sys.stderr)
     engine = ServingEngine(max_wait_ms=wait_ms)
     engine.add_model("serve", model)
     engine.warmup()
+
+    if mode == "open":
+        open_loop_sweep(engine, pool, wait_ms)
+        st = engine.stats()
+        print(f"serving load: batch_fill={st['batch_fill']}",
+              file=sys.stderr)
+        engine.close()
+        return
 
     rows = []
     for c in clients:

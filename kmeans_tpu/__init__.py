@@ -23,10 +23,11 @@ from kmeans_tpu.models.gmm import GaussianMixture
 from kmeans_tpu.models.fault_tolerance import NumericalDivergenceError
 from kmeans_tpu.parallel.mesh import make_mesh
 from kmeans_tpu.parallel.sharding import ShardedDataset
+from kmeans_tpu.sweep import SweepResult
 
 __version__ = "0.1.0"
 
 __all__ = ["KMeans", "MiniBatchKMeans", "BisectingKMeans",
            "SphericalKMeans", "GaussianMixture", "DispatchLatencyHint",
-           "NumericalDivergenceError", "ShardedDataset", "make_mesh",
-           "__version__"]
+           "NumericalDivergenceError", "ShardedDataset", "SweepResult",
+           "make_mesh", "__version__"]

@@ -21,6 +21,9 @@ def main() -> int:
     if cmd == "fit":
         from kmeans_tpu.cli import main as fit_main
         return fit_main(rest)
+    if cmd == "sweep":
+        from kmeans_tpu.cli import sweep_main
+        return sweep_main(rest)
     if cmd == "ckpt-info":
         from kmeans_tpu.cli import ckpt_info_main
         return ckpt_info_main(rest)
@@ -31,7 +34,7 @@ def main() -> int:
         from kmeans_tpu.utils.diagram import main as report_main
         return report_main(rest)
     print(f"unknown command {cmd!r}; available: suite, bench, fit, "
-          f"ckpt-info, serve, report", file=sys.stderr)
+          f"sweep, ckpt-info, serve, report", file=sys.stderr)
     return 2
 
 

@@ -1050,6 +1050,112 @@ def bench_serving(n: int, d: int, k: int,
     return results
 
 
+def bench_sweep(n: int, d: int, k_values, n_init: int,
+                max_iter: int, reps: int = 3) -> Dict:
+    """Sweep-vs-sequential benchmark (ISSUE 7 acceptance row): the
+    batched multi-k sweep (`KMeans.sweep`, one vmapped fit dispatch for
+    every (k, restart) member) against the sequential per-member oracle
+    (`sweep(batched=0)`, one device-loop fit + one scoring pass per
+    member), at identical work: same cached dataset, same seeds, same
+    fixed iteration count (tolerance 0 so no member converges early —
+    the FLOPs comparison stays honest).
+
+    Method: both paths are warmed (compiles cached), then ``reps``
+    INTERLEAVED (batched, sequential) wall-time pairs reduce to the
+    median of per-rep ratios with the (max-min)/median spread — the
+    repo's drift-cancelling protocol.  The row also publishes the
+    padding economics: batched FLOPs ≈ n_members · cost(k_max) vs
+    Σ cost(k_m) sequential — ``wasted_flops_factor`` is that ratio, the
+    price the one-dispatch form pays for its dispatch/batching wins
+    (break-even discussion in docs/PERFORMANCE.md "Batched k sweeps").
+
+    DECISION RULE (committed now): CPU proxy acceptance is batched
+    >= 2x sequential wall-clock at 200k x 32, k ∈ {2..17}, n_init=2;
+    hardware (10M x 128 on the tunneled chip, where each sequential
+    member pays the ~70-100 ms dispatch RTT and a fresh compile per
+    distinct k) is pinned at >= 3x, else the row publishes as a
+    measured rejection and ``sweep`` documents ``batched=0`` as the
+    default for that platform."""
+    import jax
+
+    from kmeans_tpu.models.kmeans import KMeans
+
+    # ``k_values`` is an already-parsed k list (bench.py feeds it the
+    # CLI's half-open 'lo:hi[:step]' / comma grammar via parse_k_range,
+    # so a bench config reproduces verbatim through the sweep
+    # subcommand).
+    ks = tuple(int(k) for k in k_values)
+    if not ks:
+        raise ValueError("bench_sweep: empty k range")
+    from kmeans_tpu.data.synthetic import make_blobs
+    X = make_blobs(n, max(ks[len(ks) // 2], 2), d, random_state=42,
+                   dtype=np.float32)[0]
+
+    def model():
+        # tolerance below any real shift: every member runs max_iter
+        # (fixed work on both paths; the reference's stress-bench
+        # semantics).
+        return KMeans(k=ks[-1], max_iter=max_iter, tolerance=1e-30,
+                      seed=0, n_init=n_init, empty_cluster="keep",
+                      verbose=False)
+
+    ds = model().cache(X)
+
+    def run_batched():
+        return model().sweep(ds, k_range=ks, criterion="inertia")
+
+    def run_sequential():
+        return model().sweep(ds, k_range=ks, criterion="inertia",
+                             batched=0)
+
+    _log(f"[sweep] warming both paths (N={n} D={d} k={ks[0]}..{ks[-1]} "
+         f"n_init={n_init} max_iter={max_iter}, "
+         f"{len(ks) * n_init} members)...")
+    res_b = run_batched()                      # compile + warm
+    res_s = run_sequential()
+    if res_b.selected_k != res_s.selected_k:
+        _log(f"[sweep] WARNING: batched selected k={res_b.selected_k} "
+             f"!= sequential k={res_s.selected_k}")
+
+    tb_s, ts_s = [], []
+    for rep in range(reps):
+        t0 = time.perf_counter()
+        run_batched()
+        tb_s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        run_sequential()
+        ts_s.append(time.perf_counter() - t0)
+        _log(f"[sweep] rep {rep + 1}/{reps}: batched {tb_s[-1]:.3f}s, "
+             f"sequential {ts_s[-1]:.3f}s ({ts_s[-1] / tb_s[-1]:.2f}x)")
+    ratios = sorted(t / b for t, b in zip(ts_s, tb_s))
+    speedup = float(np.median(ratios))
+    spread = (max(ratios) - min(ratios)) / speedup
+    members = len(ks) * n_init
+    waste = members * ks[-1] / (n_init * sum(ks))
+    target = 2.0 if jax.default_backend() == "cpu" else 3.0
+    row = {
+        "metric": f"sweep_vs_sequential_N{n}_D{d}_k{ks[0]}-{ks[-1]}"
+                  f"_ninit{n_init}",
+        "n": n, "d": d, "k_lo": ks[0], "k_hi": ks[-1],
+        "n_init": n_init, "members": members, "max_iter": max_iter,
+        "batched_s": round(float(np.median(tb_s)), 3),
+        "sequential_s": round(float(np.median(ts_s)), 3),
+        "speedup": round(speedup, 2),
+        "spread": round(spread, 3),
+        "indicative_only": bool(spread > 0.05),
+        "dispatches_batched": int(res_b.n_dispatches),
+        "dispatches_sequential": int(res_s.n_dispatches),
+        "wasted_flops_factor": round(waste, 2),
+        "selected_k": int(res_b.selected_k),
+        "decision_target_x": target,
+        "decision_passed": bool(speedup >= target),
+        "platform": jax.default_backend(),
+        "n_devices": len(jax.devices()),
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="kmeans_tpu benchmarks")
     parser.add_argument("--configs", default=",".join(DEFAULT_CONFIGS))

@@ -117,6 +117,135 @@ def main(argv=None) -> int:
     return 0
 
 
+def sweep_main(argv=None) -> int:
+    """``python -m kmeans_tpu sweep`` — batched model selection over k
+    (ISSUE 7): one vmapped device dispatch fits every (k, restart)
+    member of the range, the criterion curve is scored in O(1) further
+    dispatches, and the selected model's artifacts land in
+    ``--out-dir``.
+
+    ``--k-range`` uses the half-open grammar ``lo:hi[:step]`` (``2:33``
+    is k ∈ {2..32}) or a comma list (``2,4,8``); an invalid or empty
+    range exits 2.  ``--criterion`` defaults to the family's standard
+    rule: ``inertia`` (elbow) for kmeans/spherical, ``bic`` for gmm.
+    ``--sequential`` runs the per-member oracle instead (the parity
+    reference; k_max·n_init separate fits).  ``--json`` prints the
+    machine-readable summary (selected k, per-k scores, dispatch
+    count) on stdout."""
+    parser = argparse.ArgumentParser(
+        prog="python -m kmeans_tpu sweep",
+        description="Batched fit-many/pick-best model selection over a "
+                    "k range — one device dispatch for the whole sweep")
+    parser.add_argument("data", help="path to .npy or .npz with (n, D) "
+                        "floats")
+    parser.add_argument("--npz-key", default="",
+                        help=".npz array name (default: first key)")
+    parser.add_argument("--model", choices=("kmeans", "spherical", "gmm"),
+                        default="kmeans")
+    parser.add_argument("--k-range", required=True,
+                        help="half-open 'lo:hi[:step]' (2:33 = k 2..32) "
+                             "or comma list '2,4,8'")
+    parser.add_argument("--criterion", default=None,
+                        help="kmeans/spherical: inertia | silhouette | "
+                             "calinski_harabasz | davies_bouldin; "
+                             "gmm: bic | aic (default: inertia / bic)")
+    parser.add_argument("--n-init", type=int, default=1,
+                        help="restarts per k (default 1)")
+    parser.add_argument("--max-iter", type=int, default=100)
+    parser.add_argument("--tolerance", type=float, default=1e-4)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--init", default="forgy",
+                        help="kmeans family init (default forgy)")
+    parser.add_argument("--cov-type", default="diag",
+                        choices=("diag", "spherical", "tied", "full"),
+                        help="gmm only (batched sweep needs "
+                             "diag/spherical)")
+    parser.add_argument("--sequential", action="store_true",
+                        help="run the per-member oracle (batched=0)")
+    parser.add_argument("--out-dir", default=".",
+                        help="where centroids.npy/sweep.json go")
+    parser.add_argument("--json", action="store_true",
+                        help="print the JSON summary on stdout")
+    parser.add_argument("--quiet", action="store_true")
+    args = parser.parse_args(argv)
+
+    from kmeans_tpu.sweep import (GMM_CRITERIA, KMEANS_CRITERIA,
+                                  parse_k_range)
+    try:
+        ks = parse_k_range(args.k_range)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    criterion = args.criterion or ("bic" if args.model == "gmm"
+                                   else "inertia")
+    table = GMM_CRITERIA if args.model == "gmm" else KMEANS_CRITERIA
+    if criterion not in table:
+        print(f"error: criterion {criterion!r} is not valid for "
+              f"--model {args.model} (valid: {sorted(table)})",
+              file=sys.stderr)
+        return 2
+    try:
+        X = _load_matrix(args.data, args.npz_key)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if X.ndim != 2:
+        print(f"error: expected (n, D) matrix, got shape {X.shape}",
+              file=sys.stderr)
+        return 2
+    if ks[-1] >= X.shape[0]:
+        print(f"error: k range max {ks[-1]} must be < n={X.shape[0]}",
+              file=sys.stderr)
+        return 2
+
+    X = np.asarray(X, dtype=np.float32)
+    if args.model == "gmm":
+        from kmeans_tpu import GaussianMixture
+        model = GaussianMixture(
+            n_components=ks[-1], covariance_type=args.cov_type,
+            max_iter=args.max_iter, tol=args.tolerance, seed=args.seed,
+            n_init=args.n_init, verbose=False)
+    else:
+        from kmeans_tpu import KMeans, SphericalKMeans
+        cls = SphericalKMeans if args.model == "spherical" else KMeans
+        model = cls(k=ks[-1], max_iter=args.max_iter,
+                    tolerance=args.tolerance, seed=args.seed,
+                    init=args.init, n_init=args.n_init, verbose=False)
+
+    start = time.perf_counter()
+    try:
+        result = model.sweep(X, k_range=ks, criterion=criterion,
+                             batched=0 if args.sequential else True)
+    except ValueError as e:
+        # sweep() validates deeper than the pre-checks above can (e.g.
+        # metric criteria need k >= 2, every member non-finite) — same
+        # 'error: ... exit 2' contract as the argument failures.
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    elapsed = time.perf_counter() - start
+
+    summary = result.summary()
+    summary.update({"model": args.model, "n": int(X.shape[0]),
+                    "d": int(X.shape[1]),
+                    "sweep_seconds": round(elapsed, 3)})
+    out = Path(args.out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    best = result.best_model
+    np.save(out / "centroids.npy",
+            best.centroids if args.model != "gmm" else best.means_)
+    (out / "sweep.json").write_text(json.dumps(summary, indent=2))
+    if args.json:
+        print(json.dumps(summary))
+    elif not args.quiet:
+        curve = "  ".join(f"k={k}:{summary['scores'][str(k)]:.4g}"
+                          if summary["scores"][str(k)] is not None
+                          else f"k={k}:-" for k in result.k_range)
+        print(f"sweep: selected k={result.selected_k} by {criterion} "
+              f"({result.n_dispatches} dispatches, {elapsed:.2f}s)\n"
+              f"  {curve}")
+    return 0
+
+
 def serve_main(argv=None) -> int:
     """``python -m kmeans_tpu serve --model <ckpt> [--model <ckpt> ...]``
     — stdin/JSONL request loop over the serving engine (ISSUE 6; no

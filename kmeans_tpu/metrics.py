@@ -31,7 +31,15 @@ __all__ = ["silhouette_score", "silhouette_samples",
            "davies_bouldin_score", "calinski_harabasz_score",
            "adjusted_rand_score", "mutual_info_score",
            "normalized_mutual_info_score",
-           "homogeneity_completeness_v_measure"]
+           "homogeneity_completeness_v_measure",
+           "batched_criterion_scores"]
+
+#: Device dispatches one ``batched_criterion_scores`` call costs —
+#: CONSTANT in the number of members (the sweep engine's O(1)-dispatch
+#: accounting, ISSUE 7): silhouette is one row-sharded pass; CH/DB are
+#: one batched moments pass + one batched scatter pass.
+SWEEP_SCORE_DISPATCHES = {"silhouette": 1, "calinski_harabasz": 2,
+                          "davies_bouldin": 2}
 
 
 def _as_arrays(X, labels):
@@ -309,6 +317,285 @@ def silhouette_score(X, labels, *, sample_size: Optional[int] = None,
             X.shape[0], size=sample_size, replace=False)
         X, labels = X[idx], labels[idx]
     return float(np.mean(silhouette_samples(X, labels, mesh=mesh)))
+
+
+# ---------------------------------------------------- batched (sweep) scoring
+# The model-selection sweep's scoring half (ISSUE 7): score M label sets
+# over the SAME rows in a CONSTANT number of device dispatches — the
+# member axis is batched into the reductions exactly like the sweep's
+# fit batches the restart/k axis, so criterion scoring never costs M
+# host round trips.  Each member may use a different number of clusters;
+# everything is padded to the stack's k_max with all-zero one-hot rows
+# (absent cluster ids simply have zero counts and are compacted away in
+# the host finishing, matching the single-member functions' LabelEncoder
+# behavior bit-for-bit on the present clusters).
+
+
+def _as_arrays_batched(X, labels_stack):
+    X = np.ascontiguousarray(np.asarray(X, dtype=np.float32))
+    L = np.asarray(labels_stack)
+    if X.ndim != 2:
+        raise ValueError(f"X must be 2-D (n, D), got shape {X.shape}")
+    if L.ndim != 2 or L.shape[1] != X.shape[0]:
+        raise ValueError(f"labels_stack must have shape (M, {X.shape[0]}),"
+                         f" got {L.shape}")
+    check_finite_array(X, "Input data contains NaN or Inf values")
+    if np.any(L < 0):
+        raise ValueError("batched labels must be non-negative ints "
+                         "(one compact label set per member)")
+    L = np.ascontiguousarray(L.astype(np.int32))
+    k_max = int(L.max()) + 1
+    # ONE bincount pass serves both the validity rule and the
+    # silhouette counts (an np.unique-per-member loop here re-sorted
+    # every label row just to count distinct values).  A member outside
+    # 2 <= n_labels <= n_samples - 1 does not abort the sweep — it
+    # scores NaN (select_k masks non-finite scores; a k that collapsed
+    # under empty_cluster='keep' is an ANSWER about that k, and the
+    # other members' scores must survive it).
+    counts = np.stack([np.bincount(L[m], minlength=k_max)
+                       for m in range(L.shape[0])])
+    occupied = (counts > 0).sum(axis=1)
+    valid = (occupied >= 2) & (occupied <= X.shape[0] - 1)
+    return X, L, k_max, counts, valid
+
+
+def _pad_chunks_batched(X, L, chunk: int):
+    n = X.shape[0]
+    pad = (-n) % chunk
+    Xp = np.pad(X, ((0, pad), (0, 0)))
+    Lp = np.pad(L, ((0, 0), (0, pad)), constant_values=-1)
+    return Xp, Lp, n
+
+
+def _sharded_reduction_batched(mesh, M: int, k: int, chunk: int,
+                               kind: str):
+    """The batched twins of ``_sharded_reduction``: labels carry a
+    leading member axis (M, n) and the one-hot reductions batch over it
+    in the SAME row-sharded pass — one dispatch scores every member."""
+    from jax.sharding import PartitionSpec as P
+    from kmeans_tpu.parallel.mesh import DATA_AXIS, shard_map
+    key = (mesh, M, k, chunk, "batched_" + kind)
+    if key in _MOM_CACHE:
+        return _MOM_CACHE[key]
+    ids = jnp.arange(k)
+
+    if kind == "moments":
+        def run(xrows, lrows):
+            d = xrows.shape[1]
+            nc = xrows.shape[0] // chunk
+            xs = (xrows.reshape(nc, chunk, d),
+                  jnp.moveaxis(lrows.reshape(M, nc, chunk), 1, 0))
+
+            def body(carry, args):
+                sums, counts = carry
+                xc, lcs = args                           # (M, chunk)
+                oh = (lcs[:, :, None] == ids[None, None, :]) \
+                    .astype(jnp.float32)                 # (M, chunk, k)
+                return (sums + jnp.einsum("mck,cd->mkd", oh, xc),
+                        counts + jnp.sum(oh, axis=1)), None
+
+            a, b = lax.scan(body, (jnp.zeros((M, k, xrows.shape[1])),
+                                   jnp.zeros((M, k))), xs)[0]
+            return lax.psum(a, DATA_AXIS), lax.psum(b, DATA_AXIS)
+
+        in_specs = (P(DATA_AXIS, None), P(None, DATA_AXIS))
+        out_specs = (P(None, None, None), P(None, None))
+    else:                # per-cluster distance sums to own centroid
+        def run(xrows, lrows, centroids):                # (M, k, d)
+            d = xrows.shape[1]
+            nc = xrows.shape[0] // chunk
+            xs = (xrows.reshape(nc, chunk, d),
+                  jnp.moveaxis(lrows.reshape(M, nc, chunk), 1, 0))
+
+            def body(carry, args):
+                s1, s2 = carry
+                xc, lcs = args
+                d2 = jax.vmap(
+                    lambda cb: pairwise_sq_dists(xc, cb))(centroids)
+                oh = (lcs[:, :, None] == ids[None, None, :]) \
+                    .astype(jnp.float32)                 # (M, chunk, k)
+                own_d2 = jnp.sum(d2 * oh, axis=2)        # (M, chunk)
+                return (s1 + jnp.einsum("mck,mc->mk", oh,
+                                        jnp.sqrt(own_d2)),
+                        s2 + jnp.einsum("mck,mc->mk", oh, own_d2)), None
+
+            a, b = lax.scan(body, (jnp.zeros((M, k)), jnp.zeros((M, k))),
+                            xs)[0]
+            return lax.psum(a, DATA_AXIS), lax.psum(b, DATA_AXIS)
+
+        in_specs = (P(DATA_AXIS, None), P(None, DATA_AXIS),
+                    P(None, None, None))
+        out_specs = (P(None, None), P(None, None))
+
+    mapped = shard_map(run, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False)
+    _MOM_CACHE[key] = jax.jit(mapped)
+    return _MOM_CACHE[key]
+
+
+def _place_rows_batched(mesh, Xp, Lp):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from kmeans_tpu.parallel.mesh import DATA_AXIS
+    return (jax.device_put(np.asarray(Xp),
+                           NamedSharding(mesh, P(DATA_AXIS, None))),
+            jax.device_put(np.asarray(Lp),
+                           NamedSharding(mesh, P(None, DATA_AXIS))))
+
+
+def _batched_moments_and_scatter(X, L, k, mesh):
+    """(sums (M,k,d), counts (M,k), s1 (M,k), s2 (M,k)) in exactly TWO
+    row-sharded dispatches — the shared engine of the batched CH/DB
+    scores."""
+    M = L.shape[0]
+    mesh, data_shards, chunk = _mesh_and_chunk(X, mesh)
+    Xp, Lp, n = _pad_chunks_batched(X, L, data_shards * chunk)
+    xr, lr = _place_rows_batched(mesh, Xp, Lp)
+    sums, counts = _sharded_reduction_batched(
+        mesh, M, k, chunk, "moments")(xr, lr)
+    counts = np.asarray(counts, np.float64)
+    sums = np.asarray(sums, np.float64)
+    centroids = sums / np.maximum(counts, 1.0)[..., None]
+    s1, s2 = _sharded_reduction_batched(mesh, M, k, chunk, "scatter")(
+        xr, lr, jnp.asarray(centroids, jnp.float32))
+    return (sums, counts, centroids, np.asarray(s1, np.float64),
+            np.asarray(s2, np.float64), n)
+
+
+def _silhouette_chunk_batched(xc, lcs, Xp, lps, counts, k: int,
+                              col_block: int):
+    """Member-batched ``_silhouette_chunk``: the (chunk, col_block)
+    distance tile is computed ONCE and reduced against every member's
+    one-hot — M label sets share one O(n^2 D) pass instead of running
+    it M times."""
+    d = Xp.shape[1]
+    M = lcs.shape[0]
+    ncb = Xp.shape[0] // col_block
+    ids = jnp.arange(k)
+    cols = (Xp.reshape(ncb, col_block, d),
+            jnp.moveaxis(lps.reshape(M, ncb, col_block), 1, 0))
+
+    def cbody(csums, args):
+        xb, lbs = args                                   # (M, cb)
+        dist = jnp.sqrt(pairwise_sq_dists(xc, xb))       # (chunk, cb)
+        oh = (lbs[:, :, None] == ids[None, None, :]) \
+            .astype(jnp.float32)                         # (M, cb, k)
+        return csums + jnp.einsum("cb,mbk->mck", dist, oh), None
+
+    csums, _ = lax.scan(
+        cbody, jnp.zeros((M, xc.shape[0], k), jnp.float32), cols)
+    own = jnp.take_along_axis(csums, lcs[:, :, None].clip(0),
+                              axis=2)[:, :, 0]           # (M, chunk)
+    own_count = jnp.take_along_axis(counts, lcs.clip(0), axis=1)
+    a = own / jnp.maximum(own_count - 1.0, 1.0)
+    mean_other = csums / jnp.maximum(counts, 1.0)[:, None, :]
+    mask_own = (lcs[:, :, None] == ids[None, None, :])
+    mean_other = jnp.where(mask_own | (counts[:, None, :] == 0),
+                           jnp.inf, mean_other)
+    b = jnp.min(mean_other, axis=2)
+    return jnp.where(own_count <= 1.0, 0.0,
+                     (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30))
+
+
+def _silhouette_mesh_fn_batched(mesh, M: int, k: int, chunk: int,
+                                col_block: int):
+    key = (mesh, M, k, chunk, col_block, "batched")
+    if key in _SIL_CACHE:
+        return _SIL_CACHE[key]
+    from jax.sharding import PartitionSpec as P
+    from kmeans_tpu.parallel.mesh import DATA_AXIS, shard_map
+
+    def run(xrows, lrows, Xfull, lfull, counts):
+        nc = xrows.shape[0] // chunk
+        xs = (xrows.reshape(nc, chunk, -1),
+              jnp.moveaxis(lrows.reshape(M, nc, chunk), 1, 0))
+
+        def body(_, args):
+            xc, lcs = args
+            return None, _silhouette_chunk_batched(
+                xc, lcs, Xfull, lfull, counts, k, col_block)
+
+        _, s = lax.scan(body, None, xs)                  # (nc, M, chunk)
+        return jnp.moveaxis(s, 1, 0).reshape(M, -1)
+
+    mapped = shard_map(
+        run, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(None, DATA_AXIS), P(None, None),
+                  P(None, None), P(None, None)),
+        out_specs=P(None, DATA_AXIS),
+        check_vma=False)
+    _SIL_CACHE[key] = jax.jit(mapped)
+    return _SIL_CACHE[key]
+
+
+def batched_criterion_scores(X, labels_stack, criterion: str, *,
+                             mesh=None, sample_size: Optional[int] = None,
+                             seed: int = 0) -> np.ndarray:
+    """Score M label sets over the same rows in O(1) dispatches.
+
+    ``labels_stack`` is (M, n) — e.g. every sweep winner's labels from
+    one packed-model assignment dispatch.  ``criterion`` is
+    ``'silhouette'`` (one member-batched row-sharded O(n^2 D) pass;
+    ``sample_size`` subsamples the SAME seeded rows for every member,
+    like ``silhouette_score``), ``'calinski_harabasz'`` or
+    ``'davies_bouldin'`` (one batched moments pass + one batched scatter
+    pass, host finishing per member).  Returns (M,) float64 scores that
+    match the single-member functions on each row of the stack
+    (``tests/test_sweep.py`` pins the parity)."""
+    if criterion not in SWEEP_SCORE_DISPATCHES:
+        raise ValueError(f"unknown batched criterion {criterion!r}; "
+                         f"valid: {sorted(SWEEP_SCORE_DISPATCHES)}")
+    if criterion == "silhouette":
+        X = np.asarray(X)
+        L = np.asarray(labels_stack)
+        if sample_size is not None and sample_size < X.shape[0]:
+            idx = np.random.default_rng(seed).choice(
+                X.shape[0], size=sample_size, replace=False)
+            X, L = X[idx], L[:, idx]
+        X, L, k, member_counts, valid = _as_arrays_batched(X, L)
+        M = L.shape[0]
+        mesh, data_shards, chunk = _mesh_and_chunk(X, mesh, lo=128,
+                                                   hi=1024)
+        col_block = min(4096, max(256, X.shape[0]))
+        Xr, Lr, n = _pad_chunks_batched(X, L, data_shards * chunk)
+        Xc, Lc, _ = _pad_chunks_batched(X, L, col_block)
+        counts = jnp.asarray(member_counts.astype(np.float32))
+        fn = _silhouette_mesh_fn_batched(mesh, M, k, chunk, col_block)
+        xr, lr = _place_rows_batched(mesh, Xr, Lr)
+        s = np.asarray(fn(xr, lr, Xc, Lc, counts), np.float64)[:, :n]
+        out = s.mean(axis=1)
+        out[~valid] = np.nan
+        return out
+
+    X, L, k, _, valid = _as_arrays_batched(X, labels_stack)
+    sums, counts, centroids, s1, s2, n = _batched_moments_and_scatter(
+        X, L, k, mesh)
+    M = L.shape[0]
+    out = np.empty((M,), np.float64)
+    for m in range(M):
+        if not valid[m]:
+            out[m] = np.nan
+            continue
+        present = counts[m] > 0
+        km = int(present.sum())
+        cnt = counts[m][present]
+        cen = centroids[m][present]
+        if criterion == "calinski_harabasz":
+            wss = float(s2[m][present].sum())
+            mean = sums[m][present].sum(axis=0) / n
+            bss = float(np.sum(cnt * np.sum((cen - mean) ** 2, axis=1)))
+            out[m] = (1.0 if wss == 0.0
+                      else bss * (n - km) / (wss * (km - 1)))
+        else:                                    # davies_bouldin
+            scatter = s1[m][present] / np.maximum(cnt, 1.0)
+            cd = np.sqrt(np.maximum(np.asarray(pairwise_sq_dists(
+                jnp.asarray(cen, jnp.float32),
+                jnp.asarray(cen, jnp.float32), mode="direct"),
+                np.float64), 0.0))
+            ratio = (scatter[:, None] + scatter[None, :]) \
+                / np.where(cd > 0, cd, np.inf)
+            np.fill_diagonal(ratio, 0.0)
+            out[m] = float(np.mean(ratio.max(axis=1)))
+    return out
 
 
 # --------------------------------------------------------- external metrics

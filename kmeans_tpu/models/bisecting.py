@@ -66,6 +66,9 @@ class BisectingKMeans(KMeans):
     """
 
     _PARAM_NAMES = KMeans._PARAM_NAMES + ("bisecting_strategy",)
+    # The inherited k-sweep engine batches flat Lloyd members; the split
+    # tree is a different fit engine — opt out (ISSUE 7).
+    _sweepable = False
 
     def __init__(self, k: int = 3, max_iter: int = 100,
                  tolerance: float = 1e-4, seed: int = 42,

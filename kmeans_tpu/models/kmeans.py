@@ -555,10 +555,14 @@ class KMeans(AutoCheckpointMixin):
             self.n_init - 1) if self.n_init > 1 else []
         return [self.seed] + [int(s) for s in extra]
 
-    def _init_centroids(self, ds, seed: int) -> np.ndarray:
+    def _init_centroids(self, ds, seed: int,
+                        k: Optional[int] = None) -> np.ndarray:
         # Forgy/k-means++/explicit init (kmeans_spark.py:58-82, :259).
-        centroids = resolve_init(self.init, ds, self.k, seed,
-                                 validate=self._validate_init)
+        # ``k`` overrides ``self.k`` for sweep members — the SAME call a
+        # standalone fit at that k makes, so member inits match their
+        # standalone oracles exactly.
+        centroids = resolve_init(self.init, ds, self.k if k is None else k,
+                                 seed, validate=self._validate_init)
         return self._postprocess_centroids(
             np.asarray(centroids, dtype=np.float64)).astype(self.dtype)
 
@@ -1390,6 +1394,290 @@ class KMeans(AutoCheckpointMixin):
                     float(self.restart_inertias_[self.best_restart_]),
                     winner=True)
         return self
+
+    # ----------------------------------------------------------------- sweep
+
+    # Families whose fit engine is NOT plain batched Lloyd (mini-batch
+    # Sculley updates, bisecting splits) opt out of the inherited sweep.
+    _sweepable = True
+
+    def _sweep_metric_rows(self, X) -> np.ndarray:
+        """Host rows the metric criteria score against — overridden by
+        SphericalKMeans to L2-normalize (its labels live on the unit
+        sphere, so silhouette/CH/DB must too)."""
+        return np.ascontiguousarray(np.asarray(X, dtype=np.float32))
+
+    def sweep(self, X, *, k_range, criterion: str = "inertia",
+              sample_weight=None, batched=True):
+        """Model selection over k: fit every (k, restart) member, score
+        by ``criterion``, return a :class:`~kmeans_tpu.sweep.SweepResult`
+        with the per-k curve and the fitted winner (ISSUE 7 tentpole).
+
+        ``k_range`` — a range/iterable of k values (or the CLI grammar
+        ``"2:33"``, half-open).  ``criterion`` — ``'inertia'`` (elbow
+        rule: kneedle max-distance-below-chord; degenerate ranges
+        < 3 points fall back to min inertia), ``'silhouette'`` /
+        ``'calinski_harabasz'`` (max) or ``'davies_bouldin'`` (min),
+        scored on the fitted labels via the mesh-sharded batched metric
+        passes (`metrics.batched_criterion_scores`) — NOT k_max host
+        round trips.  Silhouette is the full O(n²D) score (sklearn
+        semantics); for large n score the winners yourself via
+        ``metrics.batched_criterion_scores(..., sample_size=)`` (the
+        seeded subsample every member shares).  A winner whose labels
+        collapse below 2 occupied clusters (possible under
+        ``empty_cluster='keep'`` at k far above the data's structure)
+        scores NaN and can never be selected; it does not abort the
+        other k's scores.  Restarts within each k come from this model's
+        ``n_init``/``seed`` exactly like ``fit``'s restart sweep, and
+        the within-k winner is the lowest-inertia restart (sklearn's
+        rule); the criterion then selects ACROSS k on the per-k winners.
+
+        ``batched=True`` (default) pads every member to k_max with
+        inert sentinel components and runs the whole sweep as ONE
+        vmapped device dispatch (`parallel.distributed.make_multi_fit_fn`
+        with a per-member k axis) plus O(1) scoring dispatches.
+        ``batched=0`` is the sequential per-member ORACLE — one
+        device-loop fit per member on the same cached dataset — whose
+        member trajectories the batched path must match (bit-exact for
+        the f64 device-loop class, r10 parity table; the padded FLOPs
+        economics and when sequential wins are in docs/PERFORMANCE.md).
+
+        Notes: requires a string/callable ``init`` (an explicit (k, D)
+        array pins k); metric criteria need host rows (pass an array,
+        or a dataset cached from one) and score unweighted (sklearn
+        semantics).  The returned ``best_model`` has not materialized
+        ``labels_`` — call ``predict``.
+        """
+        from kmeans_tpu import metrics as metrics_mod
+        from kmeans_tpu import sweep as sweep_mod
+
+        if not type(self)._sweepable:
+            raise NotImplementedError(
+                f"sweep() is defined for the full-batch Lloyd families "
+                f"(KMeans, SphericalKMeans), not {type(self).__name__}")
+        if not (isinstance(self.init, str) or callable(self.init)):
+            raise ValueError(
+                "sweep() needs a string or callable init (an explicit "
+                "(k, D) init array pins k); got an array init")
+        ks = sweep_mod.parse_k_range(k_range)
+        sweep_mod.check_criterion(criterion, sweep_mod.KMEANS_CRITERIA)
+        if criterion != "inertia" and ks[0] < 2:
+            raise ValueError(f"criterion {criterion!r} needs k >= 2 "
+                             f"(got k range starting at {ks[0]})")
+        k_max = ks[-1]
+
+        # The engine clone owns dataset placement and chunk choice at
+        # k_max (every member's tiles must fit); members inherit every
+        # other knob from self.
+        engine = sweep_mod.clone_for(self, k=k_max, verbose=False,
+                                     compute_labels=False)
+        X2 = engine._apply_sample_weight(X, sample_weight)
+        ds, mesh, model_shards, step_fn, predict_fn = engine._prepare(X2)
+        if k_max >= ds.n:
+            raise ValueError(f"k_max={k_max} must be < n={ds.n}")
+        seeds = engine._restart_seeds()
+        members = [(k, s) for k in ks for s in seeds]
+        R, n_init = len(members), len(seeds)
+        n_disp = 0
+
+        if batched:
+            states = self._sweep_fit_batched(engine, ds, mesh,
+                                             model_shards, members, k_max)
+            n_disp += 1
+        else:
+            states = self._sweep_fit_sequential(engine, ds, mesh,
+                                                model_shards, step_fn,
+                                                members)
+            n_disp += 2 * R              # fit + inertia pass per member
+        cents, n_iters, sse_hist, counts, finals = states
+
+        inertias, best_r, win_idx = sweep_mod.within_k_winners(
+            finals, len(ks), n_init)
+
+        if criterion == "inertia":
+            scores = inertias[np.arange(len(ks)), best_r]
+        else:
+            labels = self._sweep_labels(engine, ds, mesh, model_shards,
+                                        predict_fn,
+                                        [cents[m][: ks[i]]
+                                         for i, m in enumerate(win_idx)],
+                                        k_max, batched)
+            n_disp += 1 if (batched and model_shards == 1) else len(ks)
+            X_host = (X if not isinstance(X, ShardedDataset)
+                      else X.host)
+            if X_host is None:
+                raise ValueError(
+                    f"criterion {criterion!r} scores host rows; pass an "
+                    f"array (or a dataset cached from one), or use "
+                    f"criterion='inertia' for device-only data")
+            X_rows = self._sweep_metric_rows(X_host)
+            if batched:
+                scores = metrics_mod.batched_criterion_scores(
+                    X_rows, labels, criterion, mesh=mesh)
+                n_disp += metrics_mod.SWEEP_SCORE_DISPATCHES[criterion]
+            else:
+                single = {"silhouette": metrics_mod.silhouette_score,
+                          "calinski_harabasz":
+                              metrics_mod.calinski_harabasz_score,
+                          "davies_bouldin":
+                              metrics_mod.davies_bouldin_score}[criterion]
+
+                def _score_or_nan(lab):
+                    # Match the batched path: a winner whose labels
+                    # collapsed below 2 occupied clusters (possible
+                    # under empty_cluster='keep' at k far above the
+                    # data's structure) scores NaN, it does not abort
+                    # the other k's scores.
+                    try:
+                        return single(X_rows, lab, mesh=mesh)
+                    except ValueError:
+                        return np.nan
+                scores = np.asarray([_score_or_nan(lab)
+                                     for lab in labels], np.float64)
+                n_disp += len(ks) * metrics_mod.SWEEP_SCORE_DISPATCHES[
+                    criterion]
+
+        selected_k, sel, m_sel = sweep_mod.selected_member(
+            ks, scores, criterion, win_idx)
+
+        best = sweep_mod.clone_for(self, k=selected_k)
+        best.mesh = mesh
+        best.centroids = np.asarray(cents[m_sel][:selected_k],
+                                    dtype=self.dtype)
+        best.iterations_run = int(n_iters[m_sel])
+        best.cluster_sizes_ = np.asarray(counts[m_sel][:selected_k],
+                                         np.int64)
+        if self.compute_sse:
+            best.sse_history = [float(s) for s in
+                                sse_hist[m_sel][: int(n_iters[m_sel])]]
+        best.best_restart_ = int(best_r[sel])
+        best.restart_inertias_ = np.asarray(inertias[sel], np.float64)
+        best.loop_path_ = "device-sweep" if batched else "sequential-sweep"
+        best._fit_ds, best._labels_cache = None, None
+        best._labels_error = ("labels_ is not materialized by sweep(); "
+                              "call predict(X) on the selected model")
+
+        return sweep_mod.SweepResult(
+            family="kmeans", criterion=criterion, k_range=ks,
+            scores=np.asarray(scores, np.float64),
+            member_scores=inertias.astype(np.float64),
+            selected_k=selected_k, selected_restart=int(best_r[sel]),
+            best_model=best, n_dispatches=n_disp, batched=bool(batched),
+            n_iters=np.asarray(n_iters).reshape(len(ks), n_init))
+
+    def _sweep_fit_batched(self, engine, ds, mesh, model_shards, members,
+                           k_max: int):
+        """All sweep members in ONE dispatch: per-member inits padded to
+        k_max with inert sentinel rows (the model-axis padding
+        discipline), the per-member k axis riding
+        ``make_multi_fit_fn(k_reals=...)``."""
+        from kmeans_tpu.utils import profiling
+        mode = engine._mode(ds.n, ds.d)
+        member_ks = tuple(k for k, _ in members)
+        R = len(members)
+        # The batched scan materializes an (R, chunk, k_max) tile — R
+        # times the single-model tile the dataset's chunk was budgeted
+        # for.  Clamp by the MEMBER-SCALED tile width (measured 1.9x on
+        # the CPU proxy config: the unclamped 32-member tile blew the
+        # cache hierarchy).  Explicit user chunks pass through untouched;
+        # f64 member parity survives the regrouping (f32-width data sums
+        # exactly in f64 — the r10 invariance argument), f32 lands in
+        # the documented cross-chunk class.
+        chunk = ds.effective_chunk(R * engine._tile_k(ds.n, ds.d))
+        key = (mesh, chunk, mode, k_max, member_ks, self.max_iter,
+               float(self.tolerance), self.empty_cluster,
+               self.compute_sse, self._device_project, "sweepfit")
+        fit_fn = _STEP_CACHE.get_or_create(
+            key, lambda: dist.make_multi_fit_fn(
+                mesh, chunk_size=chunk, mode=mode, k_real=k_max,
+                max_iter=self.max_iter, tolerance=float(self.tolerance),
+                empty_policy=self.empty_cluster, n_init=R,
+                history_sse=self.compute_sse,
+                project=self._device_project, k_reals=member_ks,
+                return_all=True))
+        inits = np.empty((R, k_max, ds.d), self.dtype)
+        for i, (k_m, seed) in enumerate(members):
+            inits[i] = dist.PAD_CENTROID_VALUE
+            inits[i, :k_m] = engine._init_centroids(ds, seed, k=k_m)
+        padded = np.stack([dist.pad_centroids(c, model_shards)
+                           for c in inits])
+        cents_dev = jax.device_put(
+            padded, NamedSharding(mesh, P(None, MODEL_AXIS, None)))
+        seeds_arr = np.stack([dist._empty_seed_array(s, 0, self.max_iter)
+                              for _, s in members])
+        profiling.note_dispatch("sweep/fit")
+        cents, n_iters, sse_hist, _, counts, finals = fit_fn(
+            ds.points, ds.weights, cents_dev, seeds_arr)
+        return (np.asarray(cents), np.asarray(n_iters),
+                np.asarray(sse_hist, np.float64),
+                np.asarray(counts), np.asarray(finals, np.float64))
+
+    def _sweep_fit_sequential(self, engine, ds, mesh, model_shards,
+                              step_fn, members):
+        """The ``batched=0`` oracle: one device-loop fit per member on
+        the SAME cached dataset (same chunking/padding — what makes
+        batched-vs-sequential member parity exact rather than
+        equal-in-distribution), plus one fused inertia pass each."""
+        from kmeans_tpu import sweep as sweep_mod
+        from kmeans_tpu.utils import profiling
+        R = len(members)
+        k_max = max(k for k, _ in members)
+        cents = np.full((R, k_max, ds.d), dist.PAD_CENTROID_VALUE,
+                        np.float64)
+        n_iters = np.zeros((R,), np.int64)
+        sse_hist = np.zeros((R, self.max_iter), np.float64)
+        counts = np.zeros((R, k_max), np.float64)
+        finals = np.full((R,), np.inf, np.float64)
+        for i, (k_m, s) in enumerate(members):
+            m = sweep_mod.clone_for(self, k=k_m, n_init=1, seed=s,
+                                    verbose=False, compute_labels=False,
+                                    host_loop=False)
+            m._eager_labels = False
+            profiling.note_dispatch("sweep/member-fit")
+            m.fit(ds)
+            cents[i, :k_m] = np.asarray(m.centroids, np.float64)
+            n_iters[i] = m.iterations_run
+            hist = np.asarray(m.sse_history, np.float64)
+            sse_hist[i, : hist.size] = hist
+            counts[i, :k_m] = np.asarray(m.cluster_sizes_, np.float64)
+            profiling.note_dispatch("sweep/member-score")
+            finals[i] = float(step_fn(
+                ds.points, ds.weights,
+                m._put_centroids(np.asarray(m.centroids), mesh,
+                                 model_shards)).sse)
+        return cents, n_iters, sse_hist, counts, finals
+
+    def _sweep_labels(self, engine, ds, mesh, model_shards, predict_fn,
+                      winner_cents, k_max: int, batched) -> np.ndarray:
+        """Labels of every per-k winner, (n_k, n): ONE packed-model
+        dispatch (`make_multi_predict_fn`, the serving idiom) on
+        data-parallel meshes; under TP centroid sharding — or on the
+        sequential oracle — per-winner assignment dispatches."""
+        from kmeans_tpu.utils import profiling
+        n_k = len(winner_cents)
+        if batched and model_shards == 1:
+            mode = engine._mode(ds.n, ds.d)
+            # Same member-scaled tile clamp as _sweep_fit_batched: the
+            # packed assignment stages an (n_k, chunk, k_max) tile.
+            chunk = ds.effective_chunk(n_k * engine._tile_k(ds.n, ds.d))
+            key = (mesh, chunk, mode, n_k, "sweeppredict")
+            mp_fn = _STEP_CACHE.get_or_create(
+                key, lambda: dist.make_multi_predict_fn(
+                    mesh, chunk_size=chunk, mode=mode, n_models=n_k))
+            stack = np.full((n_k, k_max, ds.d), dist.PAD_CENTROID_VALUE,
+                            self.dtype)
+            for i, c in enumerate(winner_cents):
+                stack[i, : c.shape[0]] = c
+            profiling.note_dispatch("sweep/labels")
+            labels = np.asarray(mp_fn(ds.points, jnp.asarray(stack)))
+            return labels[:, : ds.n]
+        out = []
+        for c in winner_cents:
+            profiling.note_dispatch("sweep/labels")
+            cd = engine._put_centroids(np.asarray(c, self.dtype), mesh,
+                                       model_shards)
+            out.append(np.asarray(predict_fn(ds.points, cd))[: ds.n])
+        return np.stack(out)
 
     def _postprocess_centroids(self, centroids: np.ndarray,
                                prev: Optional[np.ndarray] = None

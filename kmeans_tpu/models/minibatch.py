@@ -62,6 +62,9 @@ _SAMPLING = ("device", "host")
 class MiniBatchKMeans(KMeans):
     _PARAM_NAMES = KMeans._PARAM_NAMES + ("batch_size", "sampling",
                                           "reassignment_ratio")
+    # The inherited k-sweep engine batches full-batch Lloyd members; the
+    # Sculley update loop is a different engine — opt out (ISSUE 7).
+    _sweepable = False
 
     def __init__(self, k: int = 3, max_iter: int = 100,
                  tolerance: float = 1e-4, seed: int = 42,

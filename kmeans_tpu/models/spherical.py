@@ -133,6 +133,16 @@ class SphericalKMeans(KMeans):
         spec["normalize_inputs"] = True
         return spec
 
+    def _sweep_metric_rows(self, X) -> np.ndarray:
+        """Metric-criterion rows for ``sweep`` (ISSUE 7): the sweep's
+        labels are assignments of L2-NORMALIZED rows (this model's
+        ``cache`` normalizes), so silhouette/CH/DB must score the same
+        unit-sphere geometry — chordal distances on normalized rows,
+        monotone in cosine similarity — or the curve would mix cosine
+        labels with Euclidean-magnitude scatter."""
+        return np.ascontiguousarray(_normalize_rows(
+            np.asarray(X, np.float64)).astype(np.float32))
+
     def transform(self, X, *, block_rows=None) -> np.ndarray:
         """Chordal distances ``sqrt(2 - 2*cos)`` to each centroid, (n, k);
         cosine similarity is ``1 - d**2 / 2``.  Rows are L2-normalized by

@@ -721,7 +721,8 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                       k_real: int, max_iter: int, tolerance: float,
                       empty_policy: str = "keep", n_init: int,
                       history_sse: bool = True,
-                      project: Optional[str] = None):
+                      project: Optional[str] = None,
+                      k_reals=None, return_all: bool = False):
     """Build a BATCHED on-device training loop: ``n_init`` independent
     restarts run in ONE dispatch, vmapped over the restart axis.
 
@@ -751,15 +752,42 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
     feeds ``_handle_empty``), so the batched sweep refills exactly like R
     sequential fits while every seed set shares one compiled program.
 
+    ``k_reals`` generalizes the member axis from restarts to a MODEL-
+    SELECTION sweep (ISSUE 7): a length-``n_init`` sequence of per-member
+    real cluster counts (each <= ``k_real``, which stays the pad target
+    k_max).  Member r's rows ``k_reals[r]..k_pad`` must arrive as INERT
+    sentinel centroids (``PAD_CENTROID_VALUE`` rows — the same padding
+    discipline the model axis already uses): sentinels never win an
+    assignment, so their counts stay zero, they keep their sentinel value
+    through the mean update, are excluded from the empty-refill /
+    projection / shift masks by the per-member ``real`` mask, and every
+    real row's arithmetic is untouched — a member padded k_m -> k_max is
+    bit-identical to its standalone k_m fit wherever the dots are exact
+    (the r10 parity-class table; each distance column and each one-hot
+    scatter row is an independent dot product, and min/argmin over extra
+    sentinel columns is exact).  ``k_reals=None`` keeps the homogeneous
+    restart behavior exactly.
+
     Returns ``fit(points, weights, centroids0[R,k,D],
     empty_seeds[R,max_iter]) -> (best_centroids,
     n_iters_best, sse_hist_best, shift_hist_best, counts_best, best_idx,
-    final_inertias[R])`` with everything replicated.
+    final_inertias[R])`` with everything replicated.  ``return_all=True``
+    returns instead the PER-MEMBER states the sweep engine selects from on
+    the host: ``(centroids[R,k_real,D], n_iters[R], sse_hist[R,max_iter],
+    shift_hist[R,max_iter], counts[R,k_real], final_inertias[R])``.
     """
     if empty_policy not in ("keep", "farthest", "resample"):
         raise ValueError(
             f"on-device loop supports empty_cluster 'keep', 'farthest' or "
             f"'resample', got {empty_policy!r}")
+    if k_reals is not None:
+        k_reals = np.asarray(k_reals, np.int32)
+        if k_reals.shape != (n_init,):
+            raise ValueError(f"k_reals must have shape ({n_init},), got "
+                             f"{k_reals.shape}")
+        if np.any(k_reals < 1) or np.any(k_reals > k_real):
+            raise ValueError(f"k_reals entries must be in [1, {k_real}], "
+                             f"got {k_reals.tolist()}")
     data_shards, model_shards = mesh_shape(mesh)
 
     def fit(points, weights, cents0_blocks, empty_seeds):
@@ -781,7 +809,14 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
             points, weights, w_col = prep_points(points, weights)
         k_pad = k_local * model_shards
         m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
-        real = jnp.arange(k_pad) < k_real          # mask off sentinel rows
+        # Per-member real-row mask (R, k_pad): sentinel rows — the model-
+        # axis padding AND, under a k sweep, each member's inert rows
+        # beyond its own k — are masked out of the empty-refill /
+        # projection / shift tests.  Homogeneous restarts broadcast one
+        # row, so the compiled arithmetic is unchanged.
+        ks = (np.full((n_init,), k_real, np.int32) if k_reals is None
+              else k_reals)
+        real = jnp.asarray(np.arange(k_pad)[None, :] < ks[:, None])
         axes = (DATA_AXIS, MODEL_AXIS)
 
         need_farthest = (empty_policy == "farthest")
@@ -844,7 +879,7 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
             if empty_policy == "farthest":
                 # Host-path semantics per restart: farthest point fills
                 # the first empty, drawn rows fill the rest (same iter).
-                is_empty = (counts <= 0) & real[None, :]   # (R, k_pad)
+                is_empty = (counts <= 0) & real            # (R, k_pad)
                 use_far = jnp.any(is_empty, axis=1) & (far_d >= 0)
 
                 def refill(new_r, far_r, emp_r, use_r):
@@ -857,14 +892,13 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                     new, is_empty, use_far.astype(jnp.int32), points,
                     w_draw, n_orig, d, empty_seeds[:, i], acc)
             elif empty_policy == "resample":
-                is_empty = (counts <= 0) & real[None, :]
+                is_empty = (counts <= 0) & real
                 new = _refill_empty_slots_batched(
                     new, is_empty, jnp.zeros((R,), jnp.int32), points,
                     w_draw, n_orig, d, empty_seeds[:, i], acc)
-            new = _project_centroids(new, cents, real[None, :], project,
-                                     acc)
+            new = _project_centroids(new, cents, real, project, acc)
             shifts = jnp.sqrt(jnp.sum((new - cents) ** 2, axis=2))
-            max_shift = jnp.max(jnp.where(real[None, :], shifts, 0.0),
+            max_shift = jnp.max(jnp.where(real, shifts, 0.0),
                                 axis=1)                    # (R,)
             # Frozen restarts keep their centroids and recorded stats.
             new = jnp.where(done[:, None, None], cents, new)
@@ -893,16 +927,26 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
         # Selection pass: true final inertia of each restart's centroids
         # (SSE always computed here — it IS the selection criterion).
         _, _, final_sse, _, _ = all_stats(cents, True)
+        if return_all:
+            # Sweep mode: selection happens on the HOST (the criterion may
+            # be a batched metric, not inertia) — hand back every member's
+            # final state, trimmed to the pad target k_real; each member's
+            # own trim to k_reals[r] is the caller's.
+            return (cents[:, :k_real], n_iters, sse_hist, shift_hist,
+                    counts_out[:, :k_real], final_sse)
         best = jnp.argmin(final_sse)
         return (cents[best, :k_real], n_iters[best], sse_hist[best],
                 shift_hist[best], counts_out[best, :k_real], best, final_sse)
 
+    out_specs = ((P(None, None, None), P(None), P(None, None),
+                  P(None, None), P(None, None), P(None)) if return_all
+                 else (P(None, None), P(), P(None), P(None), P(None), P(),
+                       P(None)))
     mapped = shard_map(
         fit, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS),
                   P(None, MODEL_AXIS, None), P(None, None)),
-        out_specs=(P(None, None), P(), P(None), P(None), P(None), P(),
-                   P(None)),
+        out_specs=out_specs,
         check_vma=False)
     if mode in PALLAS_MODES:
         # The lax.map-wrapped kernel call sits inside a fusion whose

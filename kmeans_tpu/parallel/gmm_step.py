@@ -657,7 +657,8 @@ def _predict_from_logp(logp_fn, points, chunk_size, k_local, d,
 
 def make_gmm_multi_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
                           max_iter: int, tol: float, reg_covar: float,
-                          cov_type: str = "diag", pipeline: int = 1):
+                          cov_type: str = "diag", pipeline: int = 1,
+                          k_reals=None, return_all: bool = False):
     """BATCHED on-device EM: ``n_init`` restarts in ONE dispatch, vmapped
     over the restart axis — the mixture analogue of
     ``distributed.make_multi_fit_fn`` (r4).  Works for the
@@ -677,7 +678,29 @@ def make_gmm_multi_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
     Returns ``fit(points, weights, shift, means0 (R, k_pad, D),
     var0 (R, k_pad, D), log_w0 (R, k_pad)) -> (means_c, var, log_w,
     n_iter, ll_hist[max_iter], converged, best, final_lls (R,))`` for
-    the winning restart, everything replicated."""
+    the winning restart, everything replicated.
+
+    ``k_reals`` (length-R, each <= ``k_real``) generalizes the member
+    axis to a COMPONENT-COUNT sweep (ISSUE 7): member r's components
+    beyond ``k_reals[r]`` must arrive as the r10 inert-pad constants
+    (zero mean, unit variance, ``log_w = -inf``) — they receive zero
+    responsibility, the per-member ``real`` mask keeps their parameters
+    pinned at the pad constants through every M-step, and the weight
+    renormalization sums only real components, so real-component
+    arithmetic matches the standalone k_m fit to the documented GMM
+    reduction class.  ``return_all=True`` hands every member's final
+    state back for HOST-side selection (BIC/AIC, not the loop's ll):
+    ``(means_c (R,k_pad,D), var (R,k_pad,D), log_w (R,k_pad), n_it (R,),
+    ll_hist (R,max_iter), conv (R,), final_lls (R,), final_scores (R,))``
+    where ``final_scores`` is one EXTRA vmapped E pass over the FINAL
+    parameters — the same fresh-scoring quantity ``GaussianMixture.
+    score``/``bic`` computes, which the in-loop ``final_lls`` (one
+    M-step stale by EM construction) is not."""
+    if k_reals is not None:
+        k_reals = np.asarray(k_reals, np.int32)
+        if np.any(k_reals < 1) or np.any(k_reals > k_real):
+            raise ValueError(f"k_reals entries must be in [1, {k_real}], "
+                             f"got {k_reals.tolist()}")
     data_shards, model_shards = mesh_shape(mesh)
 
     def fit(points, weights, shift, means0, var0, log_w0):
@@ -686,7 +709,14 @@ def make_gmm_multi_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
         acc = points.dtype
         tiny = jnp.asarray(np.finfo(np.dtype(str(acc))).tiny, acc)
         pi_floor = jnp.maximum(jnp.asarray(1e-300, acc), tiny)
-        real = jnp.arange(k_pad) < k_real
+        # Per-member real mask (R, k_pad); homogeneous restarts broadcast
+        # one row (identical arithmetic to the former (k_pad,) mask).
+        if k_reals is not None and k_reals.shape != (R,):
+            raise ValueError(f"k_reals must have shape ({R},), got "
+                             f"{k_reals.shape}")
+        ks = (np.full((R,), k_real, np.int32) if k_reals is None
+              else k_reals)
+        real = jnp.asarray(np.arange(k_pad)[None, :] < ks[:, None])
         m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
         w_total = lax.psum(jnp.sum(weights.astype(acc)), DATA_AXIS)
 
@@ -708,10 +738,10 @@ def make_gmm_multi_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
             # Frozen restarts keep their parameters and recorded state.
             keep = done[:, None, None]
             means_c = jnp.where(keep, means_c,
-                                jnp.where(real[None, :, None], mu,
+                                jnp.where(real[:, :, None], mu,
                                           means_c))
             var = jnp.where(keep, var,
-                            jnp.where(real[None, :, None], new_var, var))
+                            jnp.where(real[:, :, None], new_var, var))
             log_w = jnp.where(done[:, None], log_w, new_log_w)
             hist = hist.at[:, it].set(jnp.where(done, 0.0, ll))
             now_conv = jnp.abs(ll - prev) < tol
@@ -738,17 +768,31 @@ def make_gmm_multi_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
         # diverged restart's NaN is masked to -inf so it cannot win
         # (and NaN would otherwise poison argmax).
         final_lls = jnp.where(jnp.isfinite(prev), prev, -jnp.inf)
+        if return_all:
+            # Sweep mode: ONE extra vmapped E pass scores each member's
+            # FINAL parameters (the fresh quantity BIC/AIC is defined
+            # on), then every member's state goes back for host-side
+            # criterion selection.
+            st = jax.vmap(estats_one)(means_c, var, log_w)
+            final_scores = jnp.where(jnp.isfinite(st.loglik),
+                                     st.loglik / w_total, -jnp.inf)
+            return (means_c, var, log_w, n_it, hist, conv, final_lls,
+                    final_scores)
         best = jnp.argmax(final_lls)
         return (means_c[best], var[best], log_w[best], n_it[best],
                 hist[best], conv[best], best, final_lls)
 
+    out_specs = ((P(None, None, None), P(None, None, None), P(None, None),
+                  P(None), P(None, None), P(None), P(None), P(None))
+                 if return_all
+                 else (P(None, None), P(None, None), P(None), P(),
+                       P(None), P(), P(), P(None)))
     mapped = shard_map(
         fit, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None),
                   P(None, None, None), P(None, None, None),
                   P(None, None)),
-        out_specs=(P(None, None), P(None, None), P(None), P(), P(None),
-                   P(), P(), P(None)),
+        out_specs=out_specs,
         check_vma=False)
     return jax.jit(mapped)
 

@@ -94,3 +94,79 @@ def test_report_command_generates_artifacts(tmp_path):
     pdf = tmp_path / "kmeans_tpu_report.pdf"
     assert png.exists() and png.stat().st_size > 10_000
     assert pdf.exists() and pdf.stat().st_size > 10_000
+
+
+# ------------------------------------------------------------- sweep CLI
+
+
+def test_sweep_cli_kmeans_json(data_file, tmp_path, capsys):
+    from kmeans_tpu.cli import sweep_main
+    out = tmp_path / "sweep_out"
+    rc = sweep_main([str(data_file), "--k-range", "2:7", "--n-init", "2",
+                     "--max-iter", "20", "--out-dir", str(out), "--json"])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["k_range"] == [2, 3, 4, 5, 6]
+    assert summary["selected_k"] in summary["k_range"]
+    assert summary["batched"] is True
+    # O(1) dispatches for the whole inertia sweep: ONE batched fit.
+    assert summary["dispatches"] == 1
+    assert len(summary["member_scores"]) == 5
+    assert all(len(row) == 2 for row in summary["member_scores"])
+    # Artifacts: the selected model's table + the machine summary.
+    k_sel = summary["selected_k"]
+    assert np.load(out / "centroids.npy").shape == (k_sel, 6)
+    disk = json.loads((out / "sweep.json").read_text())
+    assert disk["selected_k"] == k_sel
+
+
+def test_sweep_cli_gmm_bic(data_file, tmp_path, capsys):
+    from kmeans_tpu.cli import sweep_main
+    out = tmp_path / "gmm_sweep"
+    rc = sweep_main([str(data_file), "--model", "gmm", "--k-range", "2,4",
+                     "--criterion", "bic", "--max-iter", "15",
+                     "--out-dir", str(out), "--json"])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["criterion"] == "bic"
+    assert summary["k_range"] == [2, 4]
+    assert summary["dispatches"] == 1
+    assert np.load(out / "centroids.npy").shape[0] == summary["selected_k"]
+
+
+def test_sweep_cli_invalid_range_exits_2(data_file, capsys):
+    from kmeans_tpu.cli import sweep_main
+    # Empty range, garbage, and k >= n all exit 2 with an error line.
+    for bad in ("9:2", "abc", "0:4"):
+        assert sweep_main([str(data_file), "--k-range", bad]) == 2
+        assert "error:" in capsys.readouterr().err
+    assert sweep_main([str(data_file), "--k-range", "2:5000"]) == 2
+
+
+def test_sweep_cli_criterion_family_mismatch(data_file, capsys):
+    from kmeans_tpu.cli import sweep_main
+    assert sweep_main([str(data_file), "--k-range", "2:5",
+                       "--criterion", "bic"]) == 2
+    assert sweep_main([str(data_file), "--model", "gmm", "--k-range",
+                       "2:5", "--criterion", "silhouette"]) == 2
+
+
+def test_sweep_cli_sequential_oracle(data_file, tmp_path, capsys):
+    from kmeans_tpu.cli import sweep_main
+    out_b = tmp_path / "b"
+    out_s = tmp_path / "s"
+    rc = sweep_main([str(data_file), "--k-range", "3:6", "--max-iter",
+                     "15", "--out-dir", str(out_b), "--json"])
+    batched = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    rc = sweep_main([str(data_file), "--k-range", "3:6", "--max-iter",
+                     "15", "--sequential", "--out-dir", str(out_s),
+                     "--json"])
+    seq = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert seq["batched"] is False
+    assert seq["selected_k"] == batched["selected_k"]
+    np.testing.assert_allclose(
+        [batched["scores"][k] for k in map(str, batched["k_range"])],
+        [seq["scores"][k] for k in map(str, seq["k_range"])],
+        rtol=1e-5)

@@ -35,3 +35,21 @@ def test_quickstart_runs():
         cwd=repo_root, env=env)
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "final SSE" in proc.stdout
+
+
+def test_model_selection_sweep_runs():
+    """Example 08 (ISSUE 7): the batched multi-k sweep walkthrough runs
+    end-to-end and its one-dispatch claim + oracle agreement asserts
+    hold (the example itself asserts batched == sequential selection)."""
+    sweep = next(p for p in EXAMPLES if "model_selection_sweep" in p.name)
+    repo_root = sweep.parent.parent
+    env = dict(os.environ, PYTHONPATH=str(repo_root),
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, str(sweep)],
+        capture_output=True, text=True, timeout=600,
+        cwd=repo_root, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "selected k=" in proc.stdout
+    assert "1 device dispatch" in proc.stdout
+    assert "sequential oracle agrees" in proc.stdout

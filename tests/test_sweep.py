@@ -1,0 +1,353 @@
+"""Batched multi-k sweeps (ISSUE 7): fit-many, pick-best in O(1)
+dispatches.
+
+Parity discipline: ``sweep(batched=0)`` — sequential per-member
+device-loop fits on the same cached dataset — is the oracle.  Every
+batched member's trajectory (centroids, iteration counts, histories)
+must match its standalone fit at the matched seed BIT-EXACTLY for the
+K-Means f64 device-loop class (the r10 parity table: each padded
+distance column and one-hot scatter row is an independent dot product,
+and min/argmin over extra sentinel columns is exact); the final-inertia
+SCORES sit in the cross-program f64 reduction class (a vmapped
+reduction tree need not match the unbatched one — ≤ few ulps), and the
+GMM members in the documented GMM reduction class.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from kmeans_tpu import GaussianMixture, KMeans, SphericalKMeans
+from kmeans_tpu import metrics as metrics_mod
+from kmeans_tpu.parallel.mesh import make_mesh
+from kmeans_tpu.sweep import (SweepResult, elbow_index, parse_k_range,
+                              select_k)
+from kmeans_tpu.utils import profiling
+
+
+def blobs(n_per=150, d=4, n_centers=4, seed=0, scale=10.0):
+    # f32-WIDTH values in a float64 array — the r10 f64 parity-class
+    # convention: f32-width data accumulated in f64 sums exactly, so
+    # any reduction regrouping (vmapped vs unbatched, resharded psum)
+    # is invariant and the bit-exact pins below are well-defined.
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=scale, size=(n_centers, d))
+    X = np.concatenate([c + rng.normal(size=(n_per, d))
+                        for c in centers])
+    return X.astype(np.float32).astype(np.float64)
+
+
+# ------------------------------------------------------------- k-range
+
+
+def test_parse_k_range_grammar():
+    assert parse_k_range("2:9") == tuple(range(2, 9))
+    assert parse_k_range("2:9:2") == (2, 4, 6, 8)
+    assert parse_k_range("8,2,4,2") == (2, 4, 8)
+    assert parse_k_range(range(3, 6)) == (3, 4, 5)
+    assert parse_k_range([5, 3]) == (3, 5)
+
+
+@pytest.mark.parametrize("bad", ["9:2", "abc", "2:3:4:5", "", "0:4", 7])
+def test_parse_k_range_invalid(bad):
+    with pytest.raises(ValueError):
+        parse_k_range(bad)
+
+
+def test_elbow_rule():
+    # A clean knee at k=4 on an inertia-like curve.
+    ks = (2, 3, 4, 5, 6, 7)
+    inertias = [100.0, 60.0, 10.0, 9.0, 8.5, 8.2]
+    assert ks[elbow_index(ks, inertias)] == 4
+    assert select_k(ks, inertias, "inertia") == 4
+    # Degenerate: < 3 points falls back to min inertia.
+    assert select_k((2, 3), [100.0, 10.0], "inertia") == 3
+    # Direction rules.
+    assert select_k(ks, [1, 5, 3, 2, 1, 0], "silhouette") == 3
+    assert select_k(ks, [4, 1, 3, 9, 9, 9], "davies_bouldin") == 3
+    assert select_k(ks, [4, 1, 3, 9, 9, 9], "bic") == 3
+
+
+# ------------------------------------------------------- kmeans parity
+
+
+def kw64(**over):
+    kw = dict(max_iter=25, tolerance=1e-10, seed=7, n_init=2,
+              empty_cluster="keep", verbose=False, dtype=np.float64)
+    kw.update(over)
+    return kw
+
+
+def test_kmeans_sweep_batched_matches_oracle_f64():
+    X = blobs()
+    ks = range(2, 8)
+    res = KMeans(k=3, **kw64()).sweep(X, k_range=ks, criterion="inertia")
+    res0 = KMeans(k=3, **kw64()).sweep(X, k_range=ks, criterion="inertia",
+                                       batched=0)
+    # Trajectory parity: bit-exact member iteration counts and the
+    # selected model's centroids (the f64 device-loop class).
+    np.testing.assert_array_equal(res.n_iters, res0.n_iters)
+    assert res.selected_k == res0.selected_k
+    assert res.selected_restart == res0.selected_restart
+    np.testing.assert_array_equal(res.best_model.centroids,
+                                  res0.best_model.centroids)
+    np.testing.assert_array_equal(res.best_model.cluster_sizes_,
+                                  res0.best_model.cluster_sizes_)
+    # Scores: cross-program f64 reduction class (<= few ulps).
+    np.testing.assert_allclose(res.member_scores, res0.member_scores,
+                               rtol=1e-12)
+
+
+def test_kmeans_sweep_member_matches_standalone_fit():
+    """Each batched member == a standalone device-loop fit at (k, seed)
+    on the same cached dataset — the inert k_max padding never perturbs
+    real-member arithmetic (iteration counts and final inertias pinned
+    per member; the selected member's centroids bit-exactly)."""
+    X = blobs(seed=3)
+    engine = KMeans(k=6, **kw64(n_init=1))
+    ds = engine.cache(X)
+    res = engine.sweep(ds, k_range=[3, 5, 6], criterion="inertia")
+    seed = engine._restart_seeds()[0]
+    for i, k in enumerate([3, 5, 6]):
+        st = KMeans(**kw64(k=k, n_init=1, seed=seed, host_loop=False))
+        st.fit(ds)
+        assert res.n_iters[i, 0] == st.iterations_run
+        inertia = -st.score(ds)
+        np.testing.assert_allclose(res.member_scores[i, 0], inertia,
+                                   rtol=1e-12)
+        if k == res.selected_k:
+            np.testing.assert_array_equal(res.best_model.centroids,
+                                          st.centroids)
+
+
+@pytest.mark.parametrize("data,model", [(1, 1), (2, 1), (4, 1), (8, 1),
+                                        (2, 2), (4, 2)])
+def test_kmeans_sweep_mesh_matrix(data, model):
+    """Batched == oracle across the {1,2,4,8}-way mesh matrix including
+    TP centroid sharding (the k_max padding interacts with the model-
+    axis padding)."""
+    X = blobs(n_per=64, d=8, seed=1)
+    mesh = make_mesh(data=data, model=model,
+                     devices=jax.devices()[: data * model])
+    kw = kw64(mesh=mesh, chunk_size=32, max_iter=10)
+    res = KMeans(k=3, **kw).sweep(X, k_range=range(2, 6),
+                                  criterion="inertia")
+    kw2 = kw64(mesh=mesh, chunk_size=32, max_iter=10)
+    res0 = KMeans(k=3, **kw2).sweep(X, k_range=range(2, 6),
+                                    criterion="inertia", batched=0)
+    np.testing.assert_array_equal(res.n_iters, res0.n_iters)
+    np.testing.assert_array_equal(res.best_model.centroids,
+                                  res0.best_model.centroids)
+    np.testing.assert_allclose(res.member_scores, res0.member_scores,
+                               rtol=1e-12)
+    assert res.selected_k == res0.selected_k
+
+
+def test_sweep_dispatch_count_is_O1_in_k_range():
+    """The tentpole's economics: ONE fit dispatch regardless of
+    |k_range| (pinned via utils/profiling.log_dispatches)."""
+    X = blobs(n_per=60)
+    counts = {}
+    for ks in (range(2, 4), range(2, 10)):
+        km = KMeans(k=3, **kw64(max_iter=8))
+        with profiling.log_dispatches() as log:
+            res = km.sweep(X, k_range=ks, criterion="inertia")
+        counts[len(tuple(ks))] = (log.count("sweep/fit"), len(log))
+        assert res.n_dispatches == 1
+    # Same dispatch structure for a 2-wide and an 8-wide range.
+    assert counts[2] == counts[8] == (1, 1)
+
+
+def test_sweep_metric_criteria_batched_vs_sequential():
+    X = blobs(n_per=80, seed=5)
+    for crit in ("calinski_harabasz", "davies_bouldin", "silhouette"):
+        res = KMeans(k=3, **kw64(max_iter=12)).sweep(
+            X, k_range=[2, 3, 4, 5], criterion=crit)
+        res0 = KMeans(k=3, **kw64(max_iter=12)).sweep(
+            X, k_range=[2, 3, 4, 5], criterion=crit, batched=0)
+        np.testing.assert_allclose(res.scores, res0.scores, rtol=1e-5,
+                                   err_msg=crit)
+        assert res.selected_k == res0.selected_k
+        # Criterion scoring is batched: fit + one packed-labels pass +
+        # the O(1) metric passes, NOT O(|k_range|) round trips.
+        assert res.n_dispatches <= 2 + \
+            metrics_mod.SWEEP_SCORE_DISPATCHES[crit]
+
+
+def test_batched_criterion_scores_match_single_fns():
+    X = blobs(n_per=70, seed=9).astype(np.float32)
+    rng = np.random.default_rng(0)
+    L = np.stack([rng.integers(0, 3, X.shape[0]),
+                  rng.integers(0, 5, X.shape[0]),
+                  (X[:, 0] > 0).astype(np.int32)])
+    for crit, single in [
+            ("calinski_harabasz", metrics_mod.calinski_harabasz_score),
+            ("davies_bouldin", metrics_mod.davies_bouldin_score),
+            ("silhouette", metrics_mod.silhouette_score)]:
+        batched = metrics_mod.batched_criterion_scores(X, L, crit)
+        singles = [single(X, L[m]) for m in range(L.shape[0])]
+        np.testing.assert_allclose(batched, singles, rtol=1e-5,
+                                   atol=1e-7, err_msg=crit)
+
+
+def test_batched_silhouette_sample_size_matches_single():
+    # The subsample path mirrors silhouette_score(sample_size=, seed=):
+    # the SAME seeded rows for every member, so batched == singles.
+    X = blobs(n_per=80, seed=4).astype(np.float32)
+    rng = np.random.default_rng(1)
+    L = np.stack([rng.integers(0, 3, X.shape[0]),
+                  rng.integers(0, 4, X.shape[0])])
+    batched = metrics_mod.batched_criterion_scores(
+        X, L, "silhouette", sample_size=100, seed=7)
+    singles = [metrics_mod.silhouette_score(X, L[m], sample_size=100,
+                                            seed=7)
+               for m in range(L.shape[0])]
+    np.testing.assert_allclose(batched, singles, rtol=1e-5, atol=1e-7)
+
+
+def test_batched_criterion_degenerate_member_scores_nan():
+    # One collapsed member (a single occupied cluster) must score NaN
+    # — NOT abort the batch (a sweep winner can collapse under
+    # empty_cluster='keep' at k far above the data's structure).
+    X = blobs(n_per=60, seed=2).astype(np.float32)
+    rng = np.random.default_rng(3)
+    L = np.stack([rng.integers(0, 3, X.shape[0]),
+                  np.zeros(X.shape[0], np.int64),      # degenerate
+                  rng.integers(0, 4, X.shape[0])])
+    for crit, single in [
+            ("calinski_harabasz", metrics_mod.calinski_harabasz_score),
+            ("davies_bouldin", metrics_mod.davies_bouldin_score),
+            ("silhouette", metrics_mod.silhouette_score)]:
+        scores = metrics_mod.batched_criterion_scores(X, L, crit)
+        assert np.isnan(scores[1]), crit
+        np.testing.assert_allclose(
+            scores[[0, 2]], [single(X, L[m]) for m in (0, 2)],
+            rtol=1e-5, atol=1e-7, err_msg=crit)
+
+
+def test_sweep_result_summary_jsonable():
+    import json
+    X = blobs(n_per=40)
+    res = KMeans(k=3, **kw64(max_iter=6)).sweep(X, k_range=[2, 3],
+                                                criterion="inertia")
+    assert isinstance(res, SweepResult)
+    s = json.loads(json.dumps(res.summary()))
+    assert s["selected_k"] == res.selected_k
+    assert s["dispatches"] == res.n_dispatches
+
+
+def test_sweep_empty_policy_resample_parity():
+    """Gumbel empty-refill draws are keyed per member seed — the batched
+    sweep refills exactly like the sequential members (k=8 on 3 tight
+    blobs forces empties)."""
+    rng = np.random.default_rng(2)
+    centers = np.array([[0.0, 0.0], [30.0, 30.0], [60.0, 0.0]])
+    X = np.concatenate([c + 0.2 * rng.normal(size=(50, 2))
+                        for c in centers]).astype(np.float64)
+    kw = kw64(max_iter=12, empty_cluster="resample", n_init=2)
+    res = KMeans(k=3, **kw).sweep(X, k_range=[4, 8], criterion="inertia")
+    kw2 = kw64(max_iter=12, empty_cluster="resample", n_init=2)
+    res0 = KMeans(k=3, **kw2).sweep(X, k_range=[4, 8],
+                                    criterion="inertia", batched=0)
+    np.testing.assert_array_equal(res.n_iters, res0.n_iters)
+    np.testing.assert_allclose(res.member_scores, res0.member_scores,
+                               rtol=1e-12)
+
+
+# ------------------------------------------------------------ spherical
+
+
+def test_spherical_sweep_runs_on_normalized_geometry():
+    rng = np.random.default_rng(4)
+    dirs = rng.normal(size=(3, 8))
+    dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+    X = np.concatenate([
+        (d + 0.15 * rng.normal(size=(80, 8))) * rng.uniform(
+            0.5, 5.0, size=(80, 1)) for d in dirs])
+    sk = SphericalKMeans(k=2, max_iter=15, seed=0, n_init=2,
+                         empty_cluster="keep", verbose=False)
+    res = sk.sweep(X, k_range=range(2, 6), criterion="silhouette")
+    assert res.selected_k in range(2, 6)
+    # The winner is a spherical model: unit-norm centroids.
+    np.testing.assert_allclose(
+        np.linalg.norm(res.best_model.centroids, axis=1), 1.0,
+        atol=1e-4)
+    labels = res.best_model.predict(X[:32])
+    assert labels.shape == (32,)
+
+
+# ------------------------------------------------------------------ GMM
+
+
+def test_gmm_sweep_bic_selects_true_k_and_matches_oracle():
+    rng = np.random.default_rng(0)
+    centers = np.array([[0, 0, 0], [9, 9, 0], [18, 0, 9]], float)
+    X = (centers[rng.integers(0, 3, 900)]
+         + rng.normal(size=(900, 3))).astype(np.float32)
+    kw = dict(covariance_type="diag", max_iter=30, tol=1e-5, seed=3,
+              n_init=2, init_params="random", verbose=False)
+    res = GaussianMixture(n_components=2, **kw).sweep(
+        X, k_range=range(1, 7), criterion="bic")
+    assert res.selected_k == 3
+    assert res.n_dispatches == 1
+    res0 = GaussianMixture(n_components=2, **kw).sweep(
+        X, k_range=range(1, 7), criterion="bic", batched=0)
+    assert res0.selected_k == 3
+    # Documented GMM reduction class: same members, close scores.
+    np.testing.assert_allclose(res.member_scores, res0.member_scores,
+                               rtol=1e-4)
+    np.testing.assert_allclose(res.scores, res0.scores, rtol=1e-3)
+    np.testing.assert_allclose(
+        np.sort(res.best_model.means_, axis=0),
+        np.sort(res0.best_model.means_, axis=0), atol=1e-2)
+    # The fitted winner scores like a normally-fitted model.
+    assert np.isfinite(res.best_model.bic(X))
+
+
+def test_gmm_sweep_aic_and_spherical_cov():
+    rng = np.random.default_rng(1)
+    X = np.concatenate([rng.normal(loc=c, size=(200, 2))
+                        for c in ((0, 0), (8, 8))]).astype(np.float32)
+    gm = GaussianMixture(n_components=2, covariance_type="spherical",
+                         max_iter=20, seed=0, init_params="random",
+                         verbose=False)
+    res = gm.sweep(X, k_range=[1, 2, 3, 4], criterion="aic")
+    assert res.selected_k == 2
+    assert res.best_model.covariances_.shape == (2,)
+
+
+def test_gmm_sweep_full_cov_falls_back_sequential():
+    rng = np.random.default_rng(2)
+    X = np.concatenate([rng.normal(loc=c, size=(150, 2))
+                        for c in ((0, 0), (7, 7))]).astype(np.float32)
+    gm = GaussianMixture(n_components=2, covariance_type="full",
+                         max_iter=15, seed=0, init_params="random",
+                         verbose=False)
+    with pytest.warns(UserWarning, match="diag/spherical"):
+        res = gm.sweep(X, k_range=[1, 2, 3], criterion="bic")
+    assert res.batched is False
+    assert res.selected_k == 2
+    assert res.best_model.covariances_.shape == (2, 2, 2)
+
+
+# ---------------------------------------------------------------- errors
+
+
+def test_sweep_rejects_array_init_and_unsweepable_families():
+    X = blobs(n_per=30)
+    with pytest.raises(ValueError, match="init"):
+        KMeans(k=3, init=X[:3], verbose=False).sweep(
+            X, k_range=[2, 3])
+    from kmeans_tpu import BisectingKMeans, MiniBatchKMeans
+    for cls in (MiniBatchKMeans, BisectingKMeans):
+        with pytest.raises(NotImplementedError):
+            cls(k=3, verbose=False).sweep(X, k_range=[2, 3])
+    with pytest.raises(ValueError, match="criterion"):
+        KMeans(k=3, verbose=False).sweep(X, k_range=[2, 3],
+                                         criterion="bic")
+    with pytest.raises(ValueError, match="must be <"):
+        KMeans(k=3, verbose=False).sweep(X[:5], k_range=[2, 6])
+    with pytest.raises(ValueError, match="means"):
+        GaussianMixture(n_components=2, means_init=X[:2, :],
+                        verbose=False).sweep(X, k_range=[2, 3])
