@@ -62,6 +62,34 @@ the pinned decision shape 2M x 128 k=256 diag (target >40% MFU vs the
 33% serial baseline, BASELINE.json ``gmm-estep-pipeline`` row); the CPU
 default scales down to the published gmm family-row shape 200k x 32
 k=32.  Env: BENCH_N / _D / _K / _ITERS, BENCH_GMM_COV.
+
+BENCH_LLOYD=1 switches to the PIPELINED LLOYD E-STEP benchmark
+(ISSUE 8 tentpole): the one-dispatch K-Means loop with the two-stage
+chunk schedule (pipeline=1) vs the serial bit-exact oracle
+(pipeline=0), interleaved per-rep marginal ratio pairs + step MFU
+(``kmeans_tpu.benchmarks.bench_lloyd_pipeline``).  Accelerator default
+is the 10M x 128 k=1024 headline shape (committed adopt rule: >= 5%);
+the CPU default scales down to 200k x 32 k=64, where a measured
+rejection is the expected publishable outcome (the r8 GMM precedent —
+'auto' resolves serial on CPU).  Env: BENCH_N/_D/_K/_ITERS.
+
+BENCH_GUARD=1 switches to the GUARDED-bf16 DISTANCE RUNG benchmark
+(ISSUE 8 tentpole): distance_mode='matmul_bf16_guarded' vs the f32
+'matmul' class on the one-dispatch loop — centroid BIT-parity asserted
+every run, the corrected-rows audit published with the rate
+(``kmeans_tpu.benchmarks.bench_bf16_guard``; committed adopt rule:
+>= 5% at the headline shape).  Env: BENCH_N/_D/_K/_ITERS.
+
+BENCH_PHASES=1 switches to the MEASURED PER-PHASE CEILING TABLE
+(ISSUE 8c): the r8 cumulative-prefix phase ladder (distance ->
++argmin -> +scatter/psum) with implied-ceiling-if-free columns and the
+committed >= 15% actionability rule, plus a chunk-geometry re-sweep AT
+the benched shape (the 32768-131072 plateau was derived at 2M; adopt
+rule >= 3% shift) — ``kmeans_tpu.benchmarks.bench_phases``, one JSON
+line with both tables.  Accelerator default 10M x 128 k=1024; CPU
+smoke scales to 200k x 32 k=64 (harness exercise — the decision rules
+are hardware measurements).  Env: BENCH_N/_D/_K/_ITERS,
+BENCH_PHASES_CHUNKS (comma list), BENCH_PHASES_NO_SWEEP=1.
 """
 
 from __future__ import annotations
@@ -202,6 +230,46 @@ def main() -> None:
         log(f"bench: GMM-PIPELINE mode backend={backend} N={gn} D={gd} "
             f"k={gk} iters_gap={gi} cov={gct}")
         bench_gmm_pipeline(gn, gd, gk, gi, cov_type=gct)
+        return
+
+    if os.environ.get("BENCH_LLOYD") or os.environ.get("BENCH_GUARD"):
+        # Pipelined-Lloyd / guarded-bf16 rung benchmarks (ISSUE 8):
+        # interleaved per-rep marginal ratio pairs on the one-dispatch
+        # loop; headline shape on accelerators, scaled CPU proxy
+        # otherwise (a measured CPU rejection is a publishable result).
+        from kmeans_tpu.benchmarks import (bench_bf16_guard,
+                                           bench_lloyd_pipeline)
+        ln = int(os.environ.get("BENCH_N",
+                                10_000_000 if on_accel else 200_000))
+        ld = int(os.environ.get("BENCH_D", 128 if on_accel else 32))
+        lk = int(os.environ.get("BENCH_K", 1024 if on_accel else 64))
+        li = int(os.environ.get("BENCH_ITERS", 20))
+        if os.environ.get("BENCH_LLOYD"):
+            log(f"bench: LLOYD-PIPELINE mode backend={backend} N={ln} "
+                f"D={ld} k={lk} iters_gap={li}")
+            bench_lloyd_pipeline(ln, ld, lk, li)
+        if os.environ.get("BENCH_GUARD"):
+            log(f"bench: BF16-GUARD mode backend={backend} N={ln} "
+                f"D={ld} k={lk} iters_gap={li}")
+            bench_bf16_guard(ln, ld, lk, li)
+        return
+
+    if os.environ.get("BENCH_PHASES"):
+        # Measured per-phase ceiling table + chunk re-sweep (ISSUE 8c).
+        from kmeans_tpu.benchmarks import bench_phases
+        pn = int(os.environ.get("BENCH_N",
+                                10_000_000 if on_accel else 200_000))
+        pd = int(os.environ.get("BENCH_D", 128 if on_accel else 32))
+        pk = int(os.environ.get("BENCH_K", 1024 if on_accel else 64))
+        pg = int(os.environ.get("BENCH_ITERS", 20))
+        chunks = os.environ.get("BENCH_PHASES_CHUNKS")
+        chunks = tuple(int(c) for c in chunks.split(",")) if chunks \
+            else None
+        log(f"bench: PHASES mode backend={backend} N={pn} D={pd} k={pk} "
+            f"gap={pg}")
+        bench_phases(pn, pd, pk, gap=pg, chunks=chunks,
+                     skip_sweep=bool(os.environ.get(
+                         "BENCH_PHASES_NO_SWEEP")))
         return
 
     if os.environ.get("BENCH_CKPT"):

@@ -190,6 +190,13 @@ def gmm_flops_per_iter(n: int, d: int, k: int,
     raise ValueError(f"unknown covariance type {cov_type!r}")
 
 
+def kmeans_flops_per_iter(n: int, d: int, k: int) -> float:
+    """Real FLOPs of one Lloyd iteration: 2·N·D·k distance matmul +
+    2·N·D·k one-hot scatter matmul (padding waste gets no credit — the
+    repo's MFU definition, docs/PERFORMANCE.md)."""
+    return 4.0 * n * d * k
+
+
 def step_mfu(flops_per_iter: float, sec_per_iter: float):
     """Measured-FLOPs/peak for the current backend, or None when no
     peak is pinned for it (the CPU container) — the >40%-MFU tentpole
@@ -594,6 +601,351 @@ def bench_gmm_pipeline(n: int, d: int, k: int, iters: int = 20,
         "n_devices": len(jax.devices()),
     }
     print(json.dumps(result), flush=True)
+    return result
+
+
+def _lloyd_bench_setup(n: int, d: int, k: int, seed: int = 42):
+    """Shared staging of the Lloyd schedule/rung benches: a sharded
+    uniform dataset + a fixed explicit init (identical across variants,
+    so the marginal compares SCHEDULES, never init luck)."""
+    from kmeans_tpu.models.kmeans import KMeans
+
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-1, 1, size=(n, d)).astype(np.float32)
+    init = X[np.sort(rng.choice(n, size=k, replace=False))].copy()
+    staging = KMeans(k=k, verbose=False)
+    ds = staging.cache(X)
+    return ds, init
+
+
+def _timed_lloyd_fit(ds, init, k: int, mi: int, *, mode: str,
+                     pipeline: int) -> float:
+    """Wall seconds of one whole-fit dispatch (estimator level, so the
+    measured program is exactly what `KMeans(distance_mode=, pipeline=)`
+    ships; the fixed-iteration tolerance keeps both sides honest)."""
+    from kmeans_tpu.models.kmeans import KMeans
+
+    m = KMeans(k=k, max_iter=mi, tolerance=1e-30, seed=0, init=init,
+               compute_sse=False, compute_labels=False,
+               empty_cluster="keep", host_loop=False, verbose=False,
+               distance_mode=mode, pipeline=pipeline)
+    m._eager_labels = False
+    t0 = time.perf_counter()
+    m.fit(ds)
+    return time.perf_counter() - t0
+
+
+def _interleaved_lloyd_pair(ds, init, k, iters, reps, a_kw, b_kw,
+                            label_a: str, label_b: str, tag: str):
+    """Per-rep interleaved (2, 2+iters) marginal PAIRS for two Lloyd
+    variants -> (per_iter_a, per_iter_b, ratios a/b sorted).  The only
+    way cross-variant numbers are trusted here (the r6 drift rule)."""
+    for kw in (a_kw, b_kw):                  # compile + warm all 4
+        _timed_lloyd_fit(ds, init, k, 2, **kw)
+        _timed_lloyd_fit(ds, init, k, 2 + iters, **kw)
+    mas, mbs = [], []
+    for rep in range(reps + 1):
+        ma = max(_timed_lloyd_fit(ds, init, k, 2 + iters, **a_kw)
+                 - _timed_lloyd_fit(ds, init, k, 2, **a_kw), 1e-9)
+        mb = max(_timed_lloyd_fit(ds, init, k, 2 + iters, **b_kw)
+                 - _timed_lloyd_fit(ds, init, k, 2, **b_kw), 1e-9)
+        if rep == 0:
+            continue                          # burn-in pair
+        mas.append(ma)
+        mbs.append(mb)
+        _log(f"[{tag}] rep {rep}/{reps}: {label_a} "
+             f"{ma / iters * 1e3:.2f} ms/iter, {label_b} "
+             f"{mb / iters * 1e3:.2f} ms/iter, ratio {ma / mb:.3f}x")
+    ratios = sorted(a / b for a, b in zip(mas, mbs))
+    return (float(np.median(mas)) / iters, float(np.median(mbs)) / iters,
+            ratios)
+
+
+def bench_lloyd_pipeline(n: int, d: int, k: int, iters: int = 20,
+                         reps: int = 5) -> Dict:
+    """Pipelined-vs-serial Lloyd E-step benchmark (the ISSUE 8 tentpole's
+    before/after, the bench_gmm_pipeline twin on the flagship path): the
+    one-dispatch K-Means loop with ``pipeline=1`` (two-stage chunk
+    schedule, distance matmul of chunk i overlapping the argmin +
+    scatter epilogue of chunk i-1) vs ``pipeline=0`` (the serial
+    bit-exact oracle), per-rep INTERLEAVED marginal pairs, speedup = the
+    median of per-rep ratios.  Publishes ms/iter for both schedules and
+    the ``step_mfu`` column (None off-TPU; ``flops_per_iter`` always
+    recorded).  Committed decision rule: the pipelined schedule is
+    adopted into accelerator-'auto' only at >= 5% measured speedup on
+    the headline shape; a CPU regression is a publishable measured
+    rejection (the r8 precedent — 'auto' already resolves serial
+    there)."""
+    import jax
+
+    ds, init = _lloyd_bench_setup(n, d, k)
+    p0, p1, ratios = _interleaved_lloyd_pair(
+        ds, init, k, iters, reps,
+        dict(mode="matmul", pipeline=0), dict(mode="matmul", pipeline=1),
+        "serial", "pipelined", "lloyd-pipeline")
+    speedup = float(np.median(ratios))
+    ratio_spread = (max(ratios) - min(ratios)) / speedup
+    flops = kmeans_flops_per_iter(n, d, k)
+    mfu0, mfu1 = step_mfu(flops, p0), step_mfu(flops, p1)
+    _log(f"[lloyd-pipeline] serial {p0 * 1e3:.2f} ms/iter"
+         + (f" ({mfu0:.1%} MFU)" if mfu0 else "")
+         + f"; pipelined {p1 * 1e3:.2f} ms/iter"
+         + (f" ({mfu1:.1%} MFU)" if mfu1 else "")
+         + f"; speedup {speedup:.3f}x (ratio spread "
+         f"{ratio_spread * 100:.0f}%)")
+    result = {
+        "metric": f"lloyd_pipeline_N{n}_D{d}_k{k}",
+        "value": round(p1 * 1e3, 4),
+        "unit": "ms/iter (one-dispatch Lloyd, pipelined schedule)",
+        "serial_ms_per_iter": round(p0 * 1e3, 4),
+        "pipelined_ms_per_iter": round(p1 * 1e3, 4),
+        "overlap_speedup": round(speedup, 4),
+        "overlap_speedup_spread": round(ratio_spread, 3),
+        "indicative_only": bool(ratio_spread > 0.05),
+        "iters_gap": iters,
+        "flops_per_iter": flops,
+        "step_mfu_serial": None if mfu0 is None else round(mfu0, 4),
+        "step_mfu": None if mfu1 is None else round(mfu1, 4),
+        "adopt_rule": ">=1.05x at the headline shape flips "
+                      "accelerator-'auto' to pipelined; CPU 'auto' "
+                      "stays serial either way",
+        "platform": jax.default_backend(),
+        "n_devices": len(jax.devices()),
+    }
+    print(json.dumps(result), flush=True)
+    return result
+
+
+def bench_bf16_guard(n: int, d: int, k: int, iters: int = 20,
+                     reps: int = 5) -> Dict:
+    """Guarded-bf16 training rung benchmark (ISSUE 8): the one-dispatch
+    Lloyd loop under ``distance_mode='matmul_bf16_guarded'`` vs the f32
+    'matmul' class, per-rep interleaved marginal pairs — PLUS the two
+    acceptance properties published alongside the time: (1) the guarded
+    fit's centroids are BIT-equal to the f32 fit's (the by-construction
+    contract, asserted every run, never sampled), and (2) the
+    corrected-rows audit (``bf16_guard_corrected_rows_``) is recorded —
+    a bf16-rate number without its audit row is not a publishable
+    result here.  Committed decision rule: >= 5% measured speedup at
+    the headline shape to recommend the rung (hardware row; on CPU the
+    'f32' matmul already runs the same scalar units, so a ~1.0x or
+    regression is the expected measured outcome — published either
+    way)."""
+    import jax
+
+    from kmeans_tpu.models.kmeans import KMeans
+
+    ds, init = _lloyd_bench_setup(n, d, k)
+    # Acceptance property first (cheap, and a failed property makes the
+    # timing meaningless): bit parity + audit at a real iteration count.
+    pin_kw = dict(k=k, max_iter=8, tolerance=1e-30, seed=0, init=init,
+                  compute_sse=False, compute_labels=False,
+                  empty_cluster="keep", host_loop=False, verbose=False)
+    m_f32 = KMeans(distance_mode="matmul", **pin_kw)
+    m_f32._eager_labels = False
+    m_f32.fit(ds)
+    m_g = KMeans(distance_mode="matmul_bf16_guarded", **pin_kw)
+    m_g._eager_labels = False
+    m_g.fit(ds)
+    parity = bool(np.array_equal(m_f32.centroids, m_g.centroids)
+                  and m_f32.iterations_run == m_g.iterations_run)
+    corrected = m_g.bf16_guard_corrected_rows_
+    # The pin fit may converge before max_iter (a zero-shift fixed point
+    # beats even tolerance=1e-30) — the per-iteration rate divides by
+    # the iterations that actually ran, never the cap.
+    pin_iters = max(m_g.iterations_run, 1)
+    if not parity:
+        raise AssertionError(
+            "guarded bf16 rung broke bit parity with the f32 class — "
+            "do not publish a rate for a wrong answer")
+    p0, p1, ratios = _interleaved_lloyd_pair(
+        ds, init, k, iters, reps,
+        dict(mode="matmul", pipeline=0),
+        dict(mode="matmul_bf16_guarded", pipeline=0),
+        "f32", "bf16-guarded", "bf16-guard")
+    speedup = float(np.median(ratios))
+    ratio_spread = (max(ratios) - min(ratios)) / speedup
+    flops = kmeans_flops_per_iter(n, d, k)
+    mfu1 = step_mfu(flops, p1)
+    _log(f"[bf16-guard] f32 {p0 * 1e3:.2f} ms/iter; guarded "
+         f"{p1 * 1e3:.2f} ms/iter; speedup {speedup:.3f}x (spread "
+         f"{ratio_spread * 100:.0f}%); corrected_rows {corrected} over "
+         f"{pin_iters} iters of {n} rows; parity {parity}")
+    result = {
+        "metric": f"bf16_guard_N{n}_D{d}_k{k}",
+        "value": round(p1 * 1e3, 4),
+        "unit": "ms/iter (one-dispatch Lloyd, guarded bf16 distances)",
+        "f32_ms_per_iter": round(p0 * 1e3, 4),
+        "guarded_ms_per_iter": round(p1 * 1e3, 4),
+        "guard_speedup": round(speedup, 4),
+        "guard_speedup_spread": round(ratio_spread, 3),
+        "indicative_only": bool(ratio_spread > 0.05),
+        "iters_gap": iters,
+        "centroid_bit_parity": parity,
+        "corrected_rows": corrected,
+        "corrected_rows_pin_iters": pin_iters,
+        "corrected_rows_frac": round(corrected / (pin_iters * n), 6),
+        "flops_per_iter": flops,
+        "step_mfu": None if mfu1 is None else round(mfu1, 4),
+        "adopt_rule": ">=1.05x at the headline shape with the "
+                      "corrected-rows audit published",
+        "platform": jax.default_backend(),
+        "n_devices": len(jax.devices()),
+    }
+    print(json.dumps(result), flush=True)
+    return result
+
+
+#: Chunk-geometry re-sweep candidates of the BENCH_PHASES mode: the
+#: measured 32768-131072 plateau (swept at 2M, docs/PERFORMANCE.md) plus
+#: one rung below and one above, so a plateau SHIFT at the 10M shape is
+#: observable in either direction.
+PHASE_SWEEP_CHUNKS = (16384, 32768, 65536, 131072, 262144)
+
+
+def bench_phases(n: int, d: int, k: int, *, gap: int = 20, reps: int = 5,
+                 chunks=None, skip_sweep: bool = False) -> Dict:
+    """The measured per-phase ceiling table + chunk-geometry re-sweep
+    (ISSUE 8c — `BENCH_PHASES=1 python bench.py`): runs the r8
+    cumulative-prefix phase ladder (distance -> +argmin -> +scatter/
+    psum; ``make_estep_phase_fn`` + ``measure_phase_ladder``) at the
+    given shape and emits ``phase_ceiling_table``'s publishable rows
+    (phase ms, share, implied ceiling if that phase were free, the
+    committed >= 15% decision rule), then re-derives the scan-chunk
+    plateau AT THIS SHAPE via full-step marginals per candidate chunk
+    (the 32768-131072 plateau was swept at 2M; the 10M committed chunk
+    had never been re-derived — committed rule: adopt any >= 3% plateau
+    shift).  One JSON line carries both tables."""
+    import jax
+
+    from kmeans_tpu.parallel import distributed as dist
+    from kmeans_tpu.parallel.mesh import make_mesh, mesh_shape
+    from kmeans_tpu.parallel.sharding import (choose_chunk_size,
+                                              shard_points)
+    from kmeans_tpu.utils.profiling import (measure_phase_ladder,
+                                            phase_ceiling_table)
+
+    backend = jax.default_backend()
+    mesh = make_mesh()
+    data_shards, model_shards = mesh_shape(mesh)
+    committed = choose_chunk_size(-(-n // data_shards), k, d)
+    rng = np.random.default_rng(42)
+    X = rng.uniform(-1, 1, size=(n, d)).astype(np.float32)
+    pts, w = shard_points(X, mesh, committed)
+    cents = jax.device_put(
+        dist.pad_centroids(X[:k].copy(), model_shards),
+        dist.centroid_sharding(mesh))
+
+    # --- phase ladder (marginal between 2- and (2+gap)-iteration chains)
+    fns = {}
+    for ph in dist.ESTEP_PHASES:
+        fns[ph] = {m: dist.make_estep_phase_fn(
+            mesh, chunk_size=committed, n_iters=m, phase=ph)
+            for m in (2, 2 + gap)}
+        for m in (2, 2 + gap):
+            float(fns[ph][m](pts, w, cents))          # compile + warm
+
+    def marginal(ph):
+        def measure():
+            t0 = time.perf_counter()
+            float(fns[ph][2](pts, w, cents))
+            t_small = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            float(fns[ph][2 + gap](pts, w, cents))
+            return max(time.perf_counter() - t0 - t_small, 1e-9) / gap
+        return measure
+
+    ladder = measure_phase_ladder(
+        [(ph, marginal(ph)) for ph in dist.ESTEP_PHASES], reps=reps)
+    flops = kmeans_flops_per_iter(n, d, k)
+    peak = PEAK_TFLOPS.get(backend)
+    table = phase_ceiling_table(ladder, flops_per_iter=flops,
+                                peak_tflops=peak)
+    full = ladder[-1]["cumulative"]
+    for row in table:
+        _log(f"[phases] {row['phase']:9s} {row['ms']:8.3f} ms "
+             f"({row['share']:5.1%}; ceiling if free "
+             f"{row['implied_ceiling_speedup']:.3f}x; "
+             f"{'ACTIONABLE' if row['actionable'] else 'pinned'}; "
+             f"spread {row['spread']:.0%})")
+    mfu = step_mfu(flops, full)
+    _log(f"[phases] full stats pass {full * 1e3:.3f} ms/iter"
+         + (f" = {mfu:.1%} MFU" if mfu else ""))
+
+    # --- chunk-geometry re-sweep at THIS shape (full-step marginals)
+    sweep_rows = []
+    if not skip_sweep:
+        cands = [c for c in (chunks or PHASE_SWEEP_CHUNKS)
+                 if c <= -(-n // data_shards)]
+        if committed not in cands:
+            cands.append(committed)
+        seeds_s = np.zeros((2,), np.uint32)
+        seeds_b = np.zeros((2 + gap,), np.uint32)
+        fits = {}
+        for c in sorted(cands):
+            pts_c, w_c = shard_points(X, mesh, c)
+            pair = {}
+            for mi, seeds in ((2, seeds_s), (2 + gap, seeds_b)):
+                fn = dist.make_fit_fn(
+                    mesh, chunk_size=c, mode="matmul", k_real=k,
+                    max_iter=mi, tolerance=1e-30, empty_policy="keep",
+                    history_sse=False)
+                out = fn(pts_c, w_c, cents, seeds)
+                int(out[1])                            # compile + warm
+                pair[mi] = fn
+            fits[c] = (pts_c, w_c, pair)
+
+        def timed_chunk(c, mi):
+            pts_c, w_c, pair = fits[c]
+            seeds = seeds_s if mi == 2 else seeds_b
+            t0 = time.perf_counter()
+            out = pair[mi](pts_c, w_c, cents, seeds)
+            int(out[1])
+            return time.perf_counter() - t0
+
+        samples = {c: [] for c in fits}
+        for _ in range(reps):                         # interleaved
+            for c in sorted(fits):
+                samples[c].append(
+                    max(timed_chunk(c, 2 + gap) - timed_chunk(c, 2),
+                        1e-9) / gap)
+        for c in sorted(fits):
+            med = float(np.median(samples[c]))
+            span = max(samples[c]) - min(samples[c])
+            sweep_rows.append({"chunk": c, "ms_per_iter": med * 1e3,
+                               "spread": span / med if med > 0 else 0.0,
+                               "committed": c == committed})
+            _log(f"[phases] chunk {c:7d}: {med * 1e3:.3f} ms/iter "
+                 f"(spread {span / med:.0%})"
+                 + ("  <- committed" if c == committed else ""))
+        best = min(sweep_rows, key=lambda r: r["ms_per_iter"])
+        base = next(r for r in sweep_rows if r["committed"])
+        shift = base["ms_per_iter"] / best["ms_per_iter"] - 1.0
+        _log(f"[phases] chunk re-sweep: best {best['chunk']} vs "
+             f"committed {committed} ({shift:+.1%}; adopt rule >= 3%)")
+
+    result = {
+        "metric": f"lloyd_phase_ceiling_N{n}_D{d}_k{k}",
+        "value": round(full * 1e3, 4),
+        "unit": "ms/iter (XLA stats pass; ladder shares in table)",
+        "chunk": committed,
+        "ladder": ladder,
+        "ceiling_table": table,
+        "chunk_sweep": sweep_rows,
+        "decision_rules": {
+            "phase_actionable_share": 0.15,
+            "pipelined_vs_serial_adopt": 1.05,
+            "bf16_guard_adopt": 1.05,
+            "chunk_resweep_adopt_shift": 0.03,
+        },
+        "flops_per_iter": flops,
+        "step_mfu": None if mfu is None else round(mfu, 4),
+        "platform": backend,
+        "n_devices": len(jax.devices()),
+    }
+
+    from kmeans_tpu.utils.profiling import sanitize_json
+    print(json.dumps(sanitize_json(result), default=float), flush=True)
     return result
 
 

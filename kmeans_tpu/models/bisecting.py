@@ -189,7 +189,8 @@ class BisectingKMeans(KMeans):
                                       model_shards)
             # Hierarchical membership: every current member goes to its
             # nearest child (consistent tie-breaks with the eval pass below).
-            child = np.asarray(predict_fn(ds.points, two))[:n]
+            child = np.asarray(predict_fn(ds.points, two,
+                                          np.int32(n)))[:n]
             new_id = len(cents)
             mask = labels == target
             labels[mask & (child == 1)] = new_id

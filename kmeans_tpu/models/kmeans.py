@@ -118,13 +118,25 @@ def _dispatch_rtt(mesh: Mesh) -> float:
     return _RTT_CACHE[key]
 
 
-def _get_step_fns(mesh: Mesh, chunk_size: int, mode: str):
-    return _STEP_CACHE.get_or_create(
+def _get_step_fns(mesh: Mesh, chunk_size: int, mode: str,
+                  pipeline: int = 0):
+    # The base entry keys identically to the pre-ISSUE-8 entries, so
+    # every serial caller (predict/score/serving paths) shares one
+    # compile.  predict does not depend on the chunk schedule, so the
+    # pipelined entry holds only its own step fn and REUSES the base
+    # predict fn — never a second identical predict compile.
+    step_fn, predict_fn = _STEP_CACHE.get_or_create(
         (mesh, chunk_size, mode),
         lambda: (
             dist.make_step_fn(mesh, chunk_size=chunk_size, mode=mode),
             dist.make_predict_fn(mesh, chunk_size=chunk_size, mode=mode),
         ))
+    if pipeline:
+        step_fn = _STEP_CACHE.get_or_create(
+            (mesh, chunk_size, mode, pipeline),
+            lambda: dist.make_step_fn(mesh, chunk_size=chunk_size,
+                                      mode=mode, pipeline=pipeline))
+    return step_fn, predict_fn
 
 
 class KMeans(AutoCheckpointMixin):
@@ -171,7 +183,18 @@ class KMeans(AutoCheckpointMixin):
         hardware where it measures faster — k >= 512 and low lane-padding
         waste, see ops.pallas_kernels.pallas_preferred — else the XLA
         'matmul' path) | 'matmul' (MXU form) | 'matmul_bf16' | 'pallas' |
-        'pallas_bf16' | 'direct' (exact; small problems).
+        'pallas_bf16' | 'direct' (exact; small problems) |
+        'matmul_bf16_guarded' (ISSUE 8: the training twin of the serving
+        bf16 fast path — the dominant distance matmul runs at bf16 input
+        rate, and near-tie rows whose argmin margin is inside the bf16
+        error band are re-resolved against a full-precision pass, so
+        labels — and therefore sums, counts, centroids, shifts, and
+        iteration counts — are BIT-equal to 'matmul' by construction;
+        SSE/per-cluster-SSE read the winner's full-precision distance
+        and land in the documented rtol class.  Data-parallel meshes
+        only; `empty_cluster='farthest'` rejected (both pointed errors);
+        `bf16_guard_corrected_rows_` audits the per-fit correction count
+        on device-loop fits).
     host_loop : True (reference per-iteration driver semantics: host-side
         f64 division, per-iteration logging, host empty-cluster policy) |
         False (the WHOLE fit as one device-side ``lax.while_loop``
@@ -182,6 +205,19 @@ class KMeans(AutoCheckpointMixin):
         base-class hooks, single process, and not 'resample' on a
         host-resident dataset — and otherwise emits a one-time
         :class:`DispatchLatencyHint`; see ``_resolve_host_loop``).
+    pipeline : 'auto' (default) | 0 | 1 — the Lloyd E-step chunk
+        schedule (ISSUE 8, the r8 GMM ``_chunked_epass`` discipline on
+        the flagship path): 1 selects the software-pipelined two-stage
+        scan that overlaps chunk i's distance matmul (MXU) with chunk
+        i-1's argmin + one-hot scatter epilogue (VPU + MXU), 0 the
+        serial body — the bit-exact parity oracle (the prefetch=0 /
+        checkpoint_every=0 discipline; the schedules move WHERE work
+        happens, never its arithmetic or fold order).  'auto' resolves
+        per platform: serial on CPU (the carried (chunk, k) tile is pure
+        extra memory traffic with no separate MXU/VPU to overlap — the
+        r8 measured-rejection precedent, re-measured for Lloyd by
+        ``bench_lloyd_pipeline``), pipelined on accelerators.  Pallas
+        modes ignore it (the fused kernel owns its own overlap).
     verbose : reference-style per-iteration prints (kmeans_spark.py:296-304).
 
     Observability: after ``fit``, ``loop_path_`` records which engine ran
@@ -189,7 +225,10 @@ class KMeans(AutoCheckpointMixin):
     RTT ``host_loop='auto'`` measured (None when no probe ran) — the
     fields the multichip dry-run artifact publishes (ISSUE 2 satellite:
     evidence that 'auto' measures the real RTT and takes the device path
-    on high-latency platforms).
+    on high-latency platforms).  ``estep_path_`` records which chunk
+    schedule the last fit ran ('pipelined' | 'serial');
+    ``bf16_guard_corrected_rows_`` the guarded rung's corrected-row
+    audit (None when the rung didn't run a device loop).
     """
 
     # Device-expressible subclass postprocess: None for plain Lloyd; a
@@ -212,6 +251,7 @@ class KMeans(AutoCheckpointMixin):
                  chunk_size: Optional[int] = None,
                  distance_mode: str = "auto",
                  host_loop: Union[bool, str] = "auto",
+                 pipeline: Union[str, int] = "auto",
                  verbose: bool = True):
         self.k = k
         self.max_iter = max_iter
@@ -262,7 +302,23 @@ class KMeans(AutoCheckpointMixin):
         self.mesh = mesh
         self.model_shards = model_shards
         self.chunk_size = chunk_size
+        if distance_mode == dist.GUARDED_MODE \
+                and empty_cluster == "farthest":
+            # Mirror the builder-level rejection at construction so the
+            # knob combination fails before any data moves
+            # (parallel.distributed._check_guarded has the long form).
+            raise ValueError(
+                "distance_mode='matmul_bf16_guarded' does not support "
+                "empty_cluster='farthest' (the farthest-point policy is "
+                "an argmax over min-distance VALUES, which the guarded "
+                "rung reproduces only to ~1 ulp); use 'keep' or "
+                "'resample'")
         self.distance_mode = distance_mode
+        # Lloyd E-step chunk schedule (ISSUE 8; the GMM r8 knob grammar).
+        if pipeline not in ("auto", 0, 1, True, False):
+            raise ValueError(f"pipeline must be 'auto', 0, or 1; got "
+                             f"{pipeline!r}")
+        self.pipeline = pipeline if pipeline == "auto" else int(pipeline)
         if isinstance(host_loop, str):
             if host_loop != "auto":
                 raise ValueError(f"host_loop must be True, False, or "
@@ -278,6 +334,13 @@ class KMeans(AutoCheckpointMixin):
         self.centroids: Optional[np.ndarray] = None   # kmeans_spark.py:44
         self.loop_path_: Optional[str] = None         # 'host'|'device'|...
         self.auto_rtt_: Optional[float] = None        # measured by 'auto'
+        # Which chunk schedule the last fit IN THIS PROCESS ran
+        # ('pipelined' | 'serial'; the GMM estep_path_ convention) and
+        # the guarded bf16 rung's per-fit corrected-row audit (summed
+        # over segments/restarts on device-loop fits; None when the
+        # rung didn't run one — host loops don't surface the count).
+        self.estep_path_: Optional[str] = None
+        self.bf16_guard_corrected_rows_: Optional[int] = None
         # Fault-tolerance observability (ISSUE 4): transient-IO retries
         # consumed by the last fit's data path, streamed blocks
         # quarantined by on_nonfinite='skip', and checkpoint segments
@@ -322,6 +385,46 @@ class KMeans(AutoCheckpointMixin):
         from kmeans_tpu.ops.pallas_kernels import resolve_auto
         return resolve_auto(n, d, self.k)
 
+    def _resolve_pipeline(self, mode: Optional[str] = None) -> int:
+        """Resolve the ``pipeline`` knob to the schedule that runs.
+
+        The Pallas modes resolve to 0 whatever the knob says: the fused
+        kernel owns its own overlap schedule, ``_local_stats`` never
+        consults the flag there, and resolving 0 keeps the step-fn
+        cache from holding two identical compiles of one program.
+
+        The two schedules are bit-exact parity partners (pinned,
+        tests/test_lloyd_pipeline.py), so 'auto' is purely a cost call
+        — the r8 GMM rule: serial on CPU (the carried (chunk, k)
+        distance tile is extra memory traffic with nothing to overlap;
+        the Lloyd re-measure is ``bench_lloyd_pipeline``'s published
+        row), pipelined on accelerators, where the schedule exists to
+        fill the MXU during the argmin/scatter VPU phases — the
+        measured ~3 ms -> 6.3 ms -> ~11 ms serialization of the XLA
+        scan body (docs/PERFORMANCE.md "The remaining 30%"); the
+        pinned hardware row's committed decision rule (>= 5% to adopt)
+        flips accelerator-'auto' back to 0 if the overlap loses
+        on-chip."""
+        if mode is not None and mode in dist.PALLAS_MODES:
+            return 0
+        if self.pipeline == "auto":
+            return 0 if jax.default_backend() == "cpu" else 1
+        return int(self.pipeline)
+
+    def _note_estep_path(self, mode: Optional[str] = None) -> int:
+        """Set the ``estep_path_`` observability attr; returns the
+        resolved pipeline flag (the GMM ``_note_estep_path``
+        convention).  Records what actually runs, not what was asked
+        for: the Pallas modes report 'fused-pallas' (the fused kernel's
+        own overlap schedule — the knob is inert there), mirroring the
+        minibatch path's honest 'serial'."""
+        if mode is not None and mode in dist.PALLAS_MODES:
+            self.estep_path_ = "fused-pallas"
+            return 0
+        p = self._resolve_pipeline(mode)
+        self.estep_path_ = "pipelined" if p else "serial"
+        return p
+
     def _resolve_mesh(self) -> Mesh:
         if self.mesh is None:
             self.mesh = make_mesh(model=self.model_shards)
@@ -350,7 +453,9 @@ class KMeans(AutoCheckpointMixin):
         mesh = self._resolve_mesh()
         _, model_shards = mesh_shape(mesh)
         chunk = self._chunk_for(n, d)
-        step_fn, predict_fn = _get_step_fns(mesh, chunk, self._mode(n, d))
+        mode = self._mode(n, d)
+        step_fn, predict_fn = _get_step_fns(mesh, chunk, mode,
+                                            self._resolve_pipeline(mode))
         return mesh, model_shards, step_fn, predict_fn, chunk
 
     def cache(self, X, sample_weight=None) -> ShardedDataset:
@@ -392,8 +497,9 @@ class KMeans(AutoCheckpointMixin):
         ds = self._dataset(X)
         mesh = self._resolve_mesh()
         _, model_shards = mesh_shape(mesh)
-        step_fn, predict_fn = _get_step_fns(mesh, self._eff_chunk(ds),
-                                            self._mode(ds.n, ds.d))
+        mode = self._mode(ds.n, ds.d)
+        step_fn, predict_fn = _get_step_fns(mesh, self._eff_chunk(ds), mode,
+                                            self._resolve_pipeline(mode))
         return ds, mesh, model_shards, step_fn, predict_fn
 
     def _put_centroids(self, centroids: np.ndarray, mesh: Mesh,
@@ -711,6 +817,8 @@ class KMeans(AutoCheckpointMixin):
         log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
         self.best_restart_ = 0
         self.restart_inertias_ = None
+        self._note_estep_path(self._mode(ds.n, ds.d))
+        self.bf16_guard_corrected_rows_ = None
 
         if resume and self.centroids is not None:
             centroids = np.asarray(self.centroids, dtype=self.dtype)
@@ -872,6 +980,8 @@ class KMeans(AutoCheckpointMixin):
         log = IterationLogger(self.verbose and jax.process_index() == 0)
         muted = IterationLogger(False)
         log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
+        self._note_estep_path()       # provisional; re-noted with the
+        self.bf16_guard_corrected_rows_ = None   # first block's real mode
 
         explicit_init = not isinstance(self.init, str) \
             and not callable(self.init)
@@ -1009,7 +1119,8 @@ class KMeans(AutoCheckpointMixin):
                                                   prefetch, stage)) as it:
                 for block, bw, pts, w in it:
                     if step_fn is None:
-                        step_fn, _ = _get_step_fns(mesh, chunk, mode)
+                        step_fn, _ = _get_step_fns(
+                            mesh, chunk, mode, self._note_estep_path(mode))
                     if want_reservoir and not score_only:
                         # Uniform over POSITIVE-weight rows — the in-memory
                         # 'resample' engine's rule (zero-weight rows must
@@ -1245,6 +1356,10 @@ class KMeans(AutoCheckpointMixin):
         seed = self.seed if seed is None else seed
         mode = self._mode(ds.n, ds.d)
         chunk = self._eff_chunk(ds)
+        pipeline = self._note_estep_path(mode)
+        guarded = (mode == dist.GUARDED_MODE)
+        if guarded and self.bf16_guard_corrected_rows_ is None:
+            self.bf16_guard_corrected_rows_ = 0
         self.loop_path_ = "device"
         self.checkpoint_segments_ = 0 if checkpoint_every else None
         self.effective_chunk_ = chunk
@@ -1269,7 +1384,8 @@ class KMeans(AutoCheckpointMixin):
             def dispatch(c, _seg=seg, _it0=it0):
                 key = (mesh, c, mode, self.k, _seg,
                        float(self.tolerance), self.empty_cluster,
-                       self.compute_sse, self._device_project, "fit")
+                       self.compute_sse, self._device_project, pipeline,
+                       "fit")
                 fit_fn = _STEP_CACHE.get_or_create(
                     key, lambda: dist.make_fit_fn(
                         mesh, chunk_size=c, mode=mode,
@@ -1277,12 +1393,20 @@ class KMeans(AutoCheckpointMixin):
                         tolerance=float(self.tolerance),
                         empty_policy=self.empty_cluster,
                         history_sse=self.compute_sse,
-                        project=self._device_project))
+                        project=self._device_project,
+                        pipeline=pipeline))
                 return fit_fn(ds.points, ds.weights, cents_dev,
                               dist._empty_seed_array(seed, _it0, _seg))
 
-            (cents, n_iters, sse_hist, shift_hist, counts), chunk = \
-                self._dispatch_oom_safe(dispatch, chunk, seg_idx)
+            out, chunk = self._dispatch_oom_safe(dispatch, chunk, seg_idx)
+            if guarded:
+                # Guarded rung: the trailing output is the segment's
+                # corrected-row audit (ISSUE 8).
+                (cents, n_iters, sse_hist, shift_hist, counts,
+                 n_corr) = out
+                self.bf16_guard_corrected_rows_ += int(n_corr)
+            else:
+                cents, n_iters, sse_hist, shift_hist, counts = out
             seg_idx += 1
             n = int(n_iters)
             it0 += n
@@ -1361,9 +1485,12 @@ class KMeans(AutoCheckpointMixin):
         R = len(seeds)
         mode = self._mode(ds.n, ds.d)
         chunk = self._eff_chunk(ds)
+        pipeline = self._note_estep_path(mode)
+        guarded = (mode == dist.GUARDED_MODE)
         key = (mesh, chunk, mode, self.k, self.max_iter,
                float(self.tolerance), self.empty_cluster, R,
-               self.compute_sse, self._device_project, "multifit")
+               self.compute_sse, self._device_project, pipeline,
+               "multifit")
         fit_fn = _STEP_CACHE.get_or_create(
             key, lambda: dist.make_multi_fit_fn(
                 mesh, chunk_size=chunk, mode=mode,
@@ -1371,7 +1498,7 @@ class KMeans(AutoCheckpointMixin):
                 tolerance=float(self.tolerance),
                 empty_policy=self.empty_cluster, n_init=R,
                 history_sse=self.compute_sse,
-                project=self._device_project))
+                project=self._device_project, pipeline=pipeline))
         self.loop_path_ = "device-multi"
         _, model_shards = mesh_shape(mesh)
         inits = np.stack([dist.pad_centroids(
@@ -1382,10 +1509,14 @@ class KMeans(AutoCheckpointMixin):
         self.iterations_run = 0
         self.iter_times_ = []
         fit_start = time.perf_counter()
-        cents, n_iters, sse_hist, shift_hist, counts, best, finals = fit_fn(
+        out = fit_fn(
             ds.points, ds.weights, cents_dev,
             np.stack([dist._empty_seed_array(s, 0, self.max_iter)
                       for s in seeds]))
+        if guarded:
+            *out, n_corr = out
+            self.bf16_guard_corrected_rows_ = int(n_corr)
+        cents, n_iters, sse_hist, shift_hist, counts, best, finals = out
         self.best_restart_ = int(best)
         self.restart_inertias_ = np.asarray(finals, dtype=np.float64)
         self._finish_device_fit(cents, int(n_iters), 0, sse_hist, shift_hist,
@@ -1479,6 +1610,10 @@ class KMeans(AutoCheckpointMixin):
         members = [(k, s) for k in ks for s in seeds]
         R, n_init = len(members), len(seeds)
         n_disp = 0
+        # Fresh observability for THIS sweep (a prior fit's values must
+        # not leak into the summed sequential audit or best_model).
+        self.estep_path_ = None
+        self.bf16_guard_corrected_rows_ = None
 
         if batched:
             states = self._sweep_fit_batched(engine, ds, mesh,
@@ -1553,6 +1688,11 @@ class KMeans(AutoCheckpointMixin):
         best.best_restart_ = int(best_r[sel])
         best.restart_inertias_ = np.asarray(inertias[sel], np.float64)
         best.loop_path_ = "device-sweep" if batched else "sequential-sweep"
+        # The selected model carries the sweep fit's schedule/guard
+        # observability: the documented reading surface is the model
+        # that owns the centroids, not the throwaway sweep engine.
+        best.estep_path_ = self.estep_path_
+        best.bf16_guard_corrected_rows_ = self.bf16_guard_corrected_rows_
         best._fit_ds, best._labels_cache = None, None
         best._labels_error = ("labels_ is not materialized by sweep(); "
                               "call predict(X) on the selected model")
@@ -1584,9 +1724,12 @@ class KMeans(AutoCheckpointMixin):
         # exactly in f64 — the r10 invariance argument), f32 lands in
         # the documented cross-chunk class.
         chunk = ds.effective_chunk(R * engine._tile_k(ds.n, ds.d))
+        pipeline = engine._note_estep_path(mode)
+        guarded = (mode == dist.GUARDED_MODE)
         key = (mesh, chunk, mode, k_max, member_ks, self.max_iter,
                float(self.tolerance), self.empty_cluster,
-               self.compute_sse, self._device_project, "sweepfit")
+               self.compute_sse, self._device_project, pipeline,
+               "sweepfit")
         fit_fn = _STEP_CACHE.get_or_create(
             key, lambda: dist.make_multi_fit_fn(
                 mesh, chunk_size=chunk, mode=mode, k_real=k_max,
@@ -1594,7 +1737,7 @@ class KMeans(AutoCheckpointMixin):
                 empty_policy=self.empty_cluster, n_init=R,
                 history_sse=self.compute_sse,
                 project=self._device_project, k_reals=member_ks,
-                return_all=True))
+                return_all=True, pipeline=pipeline))
         inits = np.empty((R, k_max, ds.d), self.dtype)
         for i, (k_m, seed) in enumerate(members):
             inits[i] = dist.PAD_CENTROID_VALUE
@@ -1606,8 +1749,15 @@ class KMeans(AutoCheckpointMixin):
         seeds_arr = np.stack([dist._empty_seed_array(s, 0, self.max_iter)
                               for _, s in members])
         profiling.note_dispatch("sweep/fit")
-        cents, n_iters, sse_hist, _, counts, finals = fit_fn(
-            ds.points, ds.weights, cents_dev, seeds_arr)
+        out = fit_fn(ds.points, ds.weights, cents_dev, seeds_arr)
+        # The sweep's schedule/guard observability reads from the model
+        # the user called sweep() on (and is copied onto best_model);
+        # the engine clone is a placement vehicle.
+        self.estep_path_ = engine.estep_path_
+        if guarded:
+            *out, n_corr = out
+            self.bf16_guard_corrected_rows_ = int(n_corr)
+        cents, n_iters, sse_hist, _, counts, finals = out
         return (np.asarray(cents), np.asarray(n_iters),
                 np.asarray(sse_hist, np.float64),
                 np.asarray(counts), np.asarray(finals, np.float64))
@@ -1635,6 +1785,14 @@ class KMeans(AutoCheckpointMixin):
             m._eager_labels = False
             profiling.note_dispatch("sweep/member-fit")
             m.fit(ds)
+            # Member fits carry the real schedule/guard observability —
+            # surface it on the sweep's reading model (the batched
+            # path's convention); guard audits sum over members.
+            self.estep_path_ = m.estep_path_
+            if m.bf16_guard_corrected_rows_ is not None:
+                self.bf16_guard_corrected_rows_ = (
+                    (self.bf16_guard_corrected_rows_ or 0)
+                    + m.bf16_guard_corrected_rows_)
             cents[i, :k_m] = np.asarray(m.centroids, np.float64)
             n_iters[i] = m.iterations_run
             hist = np.asarray(m.sse_history, np.float64)
@@ -1676,7 +1834,8 @@ class KMeans(AutoCheckpointMixin):
             profiling.note_dispatch("sweep/labels")
             cd = engine._put_centroids(np.asarray(c, self.dtype), mesh,
                                        model_shards)
-            out.append(np.asarray(predict_fn(ds.points, cd))[: ds.n])
+            out.append(np.asarray(predict_fn(ds.points, cd,
+                                             np.int32(ds.n)))[: ds.n])
         return np.stack(out)
 
     def _postprocess_centroids(self, centroids: np.ndarray,
@@ -1757,7 +1916,7 @@ class KMeans(AutoCheckpointMixin):
             return self._predict_process_local(X)
         ds, mesh, model_shards, _, predict_fn = self._prepare(X)
         cents_dev = self._cents_dev(mesh, model_shards)
-        labels = predict_fn(ds.points, cents_dev)
+        labels = predict_fn(ds.points, cents_dev, np.int32(ds.n))
         return np.asarray(labels)[: ds.n]
 
     def _predict_process_local(self, ds: ShardedDataset) -> np.ndarray:
@@ -1769,7 +1928,11 @@ class KMeans(AutoCheckpointMixin):
         its contiguous block."""
         _, mesh, model_shards, _, predict_fn = self._prepare(ds)
         cents_dev = self._cents_dev(mesh, model_shards)
-        labels = predict_fn(ds.points, cents_dev)
+        # Per-PROCESS padding is interleaved (real rows first per block),
+        # not a global tail — pass the padded total so the guard's
+        # pad-row mask stays off rather than mis-masking real rows.
+        labels = predict_fn(ds.points, cents_dev,
+                            np.int32(ds.points.shape[0]))
         blocks = {}
         for sh in labels.addressable_shards:
             start = sh.index[0].start or 0
@@ -1863,7 +2026,8 @@ class KMeans(AutoCheckpointMixin):
                                          stage_extra=stage_extra):
             _, predict_fn = _get_step_fns(mesh, chunk,
                                           self._mode(*block.shape))
-            yield np.asarray(predict_fn(pts, cents_dev))[: block.shape[0]]
+            yield np.asarray(predict_fn(
+                pts, cents_dev, np.int32(block.shape[0])))[: block.shape[0]]
 
     def fit_predict(self, X, y=None) -> np.ndarray:
         # labels_ is materialized by fit() from the same X — reusing it
@@ -1922,10 +2086,14 @@ class KMeans(AutoCheckpointMixin):
         data_shards, _ = mesh_shape(self._resolve_mesh())
         # The full (n, k) matrix only exists on the host; pallas/auto map
         # to the equivalent matmul form (the fused kernel never
-        # materializes distances).
-        mode = {"auto": "matmul", "pallas": "matmul",
-                "pallas_bf16": "matmul_bf16"}.get(self.distance_mode,
-                                                  self.distance_mode)
+        # materializes distances), and the guarded rung maps to its
+        # f32-class twin (ops.assign.value_mode — the shared rule of
+        # every value-surface call site, incl. the serving engine's
+        # serve-mode table).
+        from kmeans_tpu.ops.assign import value_mode
+        mode = value_mode({"auto": "matmul", "pallas": "matmul",
+                           "pallas_bf16": "matmul_bf16"}.get(
+                               self.distance_mode, self.distance_mode))
         d_model = self.centroids.shape[1]
         # Auto block: ~2^26 elements across BOTH the (block, D) input and
         # the (block, k) output tile — sizing on k alone would let a
@@ -1987,7 +2155,7 @@ class KMeans(AutoCheckpointMixin):
     _PARAM_NAMES = ("k", "max_iter", "tolerance", "seed", "compute_sse",
                     "init", "n_init", "compute_labels", "empty_cluster",
                     "dtype", "mesh", "model_shards", "chunk_size",
-                    "distance_mode", "host_loop", "verbose")
+                    "distance_mode", "host_loop", "pipeline", "verbose")
 
     def get_params(self, deep: bool = True) -> dict:
         """Constructor parameters as a dict (sklearn estimator protocol —
@@ -2111,6 +2279,7 @@ class KMeans(AutoCheckpointMixin):
             "model_shards": self.model_shards,
             "chunk_size": self.chunk_size,
             "host_loop": self.host_loop,
+            "pipeline": self.pipeline,
             "verbose": self.verbose,
             "sse_history": list(map(float, self.sse_history)),
             "iterations_run": self.iterations_run,
@@ -2156,6 +2325,11 @@ class KMeans(AutoCheckpointMixin):
                     model_shards=state["model_shards"],
                     chunk_size=state["chunk_size"],
                     host_loop=state.get("host_loop", True),
+                    # Pre-r13 checkpoints have no pipeline knob ->
+                    # 'auto' (the schedule is a per-run resolution, not
+                    # fitted state).  npz round-trips ints as 0-d arrays.
+                    pipeline=(lambda p: p if isinstance(p, str)
+                              else int(p))(state.get("pipeline", "auto")),
                     verbose=state["verbose"],
                     dtype=np.dtype(state["dtype"]),
                     **cls._load_kwargs(state))

@@ -308,6 +308,17 @@ class MiniBatchKMeans(KMeans):
             self.iter_times_ = []
         log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
         base_key = jax.random.PRNGKey(self.seed)
+        # The mini-batch statistics pass is ONE scan chunk
+        # (batch_per_shard == chunk), so the pipelined Lloyd schedule
+        # DEGENERATES to the serial body whatever the knob says
+        # (distributed.make_minibatch_step_fn) — record what actually
+        # runs, not what was asked for: 'fused-pallas' when the fused
+        # kernel owns the pass (the KMeans._note_estep_path convention),
+        # 'serial' otherwise.
+        self.estep_path_ = ("fused-pallas"
+                            if self._mode(ds.n, ds.d) in dist.PALLAS_MODES
+                            else "serial")
+        self.bf16_guard_corrected_rows_ = None
 
         if not self._resolve_host_loop_mb(mesh):
             return self._fit_device_loop(ds, mesh, model_shards, bs_local,

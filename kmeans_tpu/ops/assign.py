@@ -116,6 +116,120 @@ def assign_chunk(x: jax.Array, centroids: jax.Array, mode: str = "matmul",
     return best, mind2
 
 
+# ------------------------------------------------- guarded bf16 rung
+# Training twin of the serving bf16 fast path (ISSUE 8, reusing the
+# ISSUE 6 near-tie machinery): the dominant (chunk, k) distance matmul
+# runs with bf16 inputs, and a label is KEPT only when its argmin margin
+# (second-best minus best distance) clears ``BF16_GUARD_RTOL`` of the
+# row's distance scale ``|x|^2 + max_k |c_k|^2``.  bf16 inputs round at
+# ~2^-8, so a distance DIFFERENCE carries ~2^-6 * scale of error and two
+# distances can swap order only inside that band; the guard bound is
+# that doubled (2^-5) — flagged rows re-resolve their argmin against a
+# full-precision distance pass, which makes guarded labels bit-equal to
+# the f32-class argmin BY CONSTRUCTION, not just on separated data.
+# This constant is the canonical home of the bound; the serving engine's
+# ``BF16_TIE_RTOL`` re-exports it (one error model, two call sites).
+BF16_GUARD_RTOL = 2.0 ** -5
+
+#: The training distance-mode string of the guarded rung.  It is NOT a
+#: ``pairwise_sq_dists`` mode — the guard acts on the argmin, so it is
+#: resolved at the chunk-consume level (``consume_chunk`` /
+#: ``distance_stage``): the tile itself computes at 'matmul_bf16' rate.
+GUARDED_MODE = "matmul_bf16_guarded"
+
+
+def value_mode(mode: str) -> str:
+    """The mode that computes a mode's distance-VALUE surface.  The
+    guarded rung protects the ARGMIN; where distance values are the
+    output (transform, score, packed multi-predict), its value surface
+    IS the f32 class — the single shared rule every value-surface call
+    site applies (distributed builders, kmeans.py transform, the
+    serving engine's tmode map)."""
+    return "matmul" if mode == GUARDED_MODE else mode
+
+
+def margin_chunk(x: jax.Array, d2: jax.Array, c2max: jax.Array):
+    """Per-row argmin safety data from a precomputed (n, k) distance
+    tile: ``(best, margin, scale)`` with ``margin`` = second-best minus
+    best distance and ``scale`` = ``|x|^2 + max_k |c_k|^2`` (the
+    magnitude the bf16 cross-term error is relative to).  Shared by the
+    serving margin pass (``distributed.make_assign_margin_fn``) and the
+    training guard (``guarded_assign_chunk``) — one error model."""
+    acc = jnp.promote_types(x.dtype, jnp.float32)
+    k = d2.shape[1]
+    best = jnp.argmin(d2, axis=1).astype(jnp.int32)
+    d1 = jnp.min(d2, axis=1)
+    masked = jnp.where(jax.nn.one_hot(best, k, dtype=bool),
+                       jnp.asarray(jnp.inf, d2.dtype), d2)
+    d2nd = jnp.min(masked, axis=1)
+    scale = jnp.sum(x.astype(acc) ** 2, axis=1) + c2max
+    return best, (d2nd - d1).astype(acc), scale
+
+
+def guarded_assign_chunk(x: jax.Array, d2_bf16: jax.Array,
+                         centroids: jax.Array, *,
+                         tie_rtol: float = BF16_GUARD_RTOL,
+                         real_mask=None, valid=None):
+    """Guarded bf16-rate argmin over one chunk: ``(labels, n_corrected)``.
+
+    ``d2_bf16`` is the chunk's 'matmul_bf16' distance tile.  Rows whose
+    argmin margin is within ``tie_rtol`` of their distance scale are
+    re-resolved by ONE full-precision ('matmul') distance pass over the
+    chunk, executed under ``lax.cond`` — chunks without near-ties (the
+    overwhelming majority on real data) never pay it.  The corrected
+    count is the number of FLAGGED rows (the audit quantity the serving
+    path also reports), not the (smaller) number of labels that actually
+    flipped.
+
+    ``real_mask`` (k,) excludes sentinel centroid rows from the distance
+    scale: a 1e12 padding row (multi-fit k-sweep members) would blow
+    ``max_k |c_k|^2`` up ~24 orders and flag EVERY row.  Sentinels never
+    win best or second-best, so the margin itself needs no masking.
+    ``valid`` (n,) excludes rows from the flag (zero-weight data
+    padding): a pad row at the origin has ``d2_k ~= |c_k|^2`` and is a
+    spurious near-tie whenever two centroid norms are close — it
+    contributes nothing to any statistic, so it must not trigger the
+    correction pass or inflate the audit."""
+    acc = jnp.promote_types(x.dtype, jnp.float32)
+    c2 = jnp.sum(centroids.astype(acc) ** 2, axis=1)
+    if real_mask is not None:
+        c2 = jnp.where(real_mask, c2, 0.0)
+    c2max = jnp.max(c2)
+    best, margin, scale = margin_chunk(x, d2_bf16, c2max)
+    near = margin <= tie_rtol * scale
+    if valid is not None:
+        near = near & valid
+
+    def fix():
+        d2f = pairwise_sq_dists(x, centroids, mode="matmul")
+        exact = jnp.argmin(d2f, axis=1).astype(jnp.int32)
+        return jnp.where(near, exact, best)
+
+    labels = lax.cond(jnp.any(near), fix, lambda: best)
+    # dtype pinned: jnp.sum would promote to int64 under x64, breaking
+    # the fixed-width audit carry in the device loops.
+    return labels, jnp.sum(near, dtype=jnp.int32)
+
+
+def _winner_sq_dists(x: jax.Array, centroids: jax.Array,
+                     best: jax.Array, acc) -> jax.Array:
+    """Full-precision squared distance of each row to its (already
+    decided) winner: the same ``|x|^2 + |c|^2 - 2<x,c>`` clamped form as
+    the 'matmul' tile, at 1/k of its FLOPs (one row-dot per point
+    instead of k).  The VALUE equals the f32-class ``min(d2)`` up to the
+    dot's reduction order (~1 ulp relative, measured) — which is why the
+    guarded rung's SSE/per-cluster-SSE land in the repo's existing
+    rtol-compared class (r10: "SSE history is a deliberate reduced
+    quantity, rtol-compared") while labels/sums/counts stay bitwise."""
+    xa = x.astype(acc)
+    cb = centroids.astype(acc)[best]                     # (n, D) gather
+    x2 = jnp.sum(xa * xa, axis=-1)
+    c2 = jnp.sum(cb * cb, axis=-1)
+    xcb = jnp.einsum("nd,nd->n", xa, cb,
+                     preferred_element_type=acc)
+    return jnp.maximum(x2 + c2 - 2.0 * xcb, 0.0)
+
+
 def _scan_chunks(points: jax.Array, weights: jax.Array, chunk_size: int):
     """Reshape (n, D) -> (n_chunks, chunk, D); n must be pre-padded."""
     n, d = points.shape
@@ -140,19 +254,37 @@ def init_stats(k: int, d: int, acc) -> StepStats:
     )
 
 
-def accumulate_chunk(carry: StepStats, xc: jax.Array, wc: jax.Array,
-                     centroids: jax.Array, *, mode: str = "matmul",
-                     select_fn=None, need_sse: bool = True,
-                     need_farthest: bool = True,
-                     need_sse_pc: bool = True) -> StepStats:
-    """Fold one (chunk, D) tile of points into the running StepStats.
+def distance_stage(xc: jax.Array, centroids: jax.Array, *,
+                   mode: str = "matmul") -> jax.Array:
+    """Stage A of the two-stage Lloyd chunk schedule: the (chunk, k)
+    distance tile — the MXU matmul that dominates the pass.  The guarded
+    rung's tile computes at 'matmul_bf16' rate (its guard acts later, in
+    stage B).  Splitting the tile from its consumption is what lets the
+    software-pipelined schedule (ISSUE 8, the r8 ``_chunked_epass``
+    discipline) overlap chunk i's matmul with chunk i-1's argmin +
+    one-hot scatter epilogue."""
+    dmode = "matmul_bf16" if mode == GUARDED_MODE else mode
+    return pairwise_sq_dists(xc, centroids, mode=dmode)
 
-    The single shared accumulation body for BOTH the single-device kernel
-    (``assign_reduce``) and the SPMD step (parallel.distributed): distances
-    on the MXU, one-hot matmul sums/counts (the dense replacement for the
-    reference's keyed shuffle, kmeans_spark.py:169-171), fused SSE (the
-    reference's second pass, :237) and fused farthest-point tracking (the
-    dead ``_reinitialize_empty_cluster`` policy, :84-129, live and free).
+
+def consume_chunk(carry: StepStats, d2: jax.Array, xc: jax.Array,
+                  wc: jax.Array, centroids: jax.Array, *,
+                  mode: str = "matmul", select_fn=None, real_mask=None,
+                  need_sse: bool = True, need_farthest: bool = True,
+                  need_sse_pc: bool = True):
+    """Stage B of the two-stage chunk schedule: fold one (chunk, D) tile
+    of points — whose distance tile ``d2`` stage A already computed —
+    into the running StepStats.  Returns ``(StepStats, n_corrected)``
+    where ``n_corrected`` is the chunk's bf16-guard-flagged row count
+    (constant 0 for every unguarded mode).
+
+    This is the single shared accumulation body for BOTH the
+    single-device kernel (``assign_reduce``) and the SPMD step
+    (parallel.distributed): argmin over the tile, one-hot matmul
+    sums/counts (the dense replacement for the reference's keyed
+    shuffle, kmeans_spark.py:169-171), fused SSE (the reference's second
+    pass, :237) and fused farthest-point tracking (the dead
+    ``_reinitialize_empty_cluster`` policy, :84-129, live and free).
 
     ``select_fn(best_local, mind2_local) -> (mine_mask, mind2_global)`` is
     the hook the centroid-sharded (model-axis) path uses to reconstruct the
@@ -161,14 +293,36 @@ def accumulate_chunk(carry: StepStats, xc: jax.Array, wc: jax.Array,
     The ``need_*`` flags skip the optional statistics' VPU work entirely
     (the corresponding StepStats fields stay at their init values) — the
     TPU analogue of the reference's ``compute_sse=False`` fast path
-    (kmeans_spark.py:34).  With all three off and no select_fn, even the
-    min-distance reduction over the (chunk, k) tile is elided.
+    (kmeans_spark.py:34).
+
+    Guarded rung semantics (``mode='matmul_bf16_guarded'``): labels come
+    from ``guarded_assign_chunk`` (bit-equal to the f32 argmin by
+    construction), the one-hot scatter runs at FULL accumulation
+    precision — so sums/counts/centroids are bit-equal to the 'matmul'
+    class — and the optional min-distance statistics read the winner's
+    full-precision distance (``_winner_sq_dists``, the rtol class).  The
+    farthest-point policy is value-dependent on the min distance and is
+    rejected upstream (parallel.distributed builders).  ``real_mask``
+    (k,) marks real (non-sentinel) centroid rows for the guard's
+    distance scale (``guarded_assign_chunk``); zero-weight rows are
+    excluded from the guard automatically.
     """
     acc = carry.sums.dtype
     k = centroids.shape[0]
     need_min = (need_sse or need_farthest or need_sse_pc
                 or select_fn is not None)
-    best, mind2 = assign_chunk(xc, centroids, mode=mode, need_min=need_min)
+    corrected = jnp.zeros((), jnp.int32)
+    if mode == GUARDED_MODE:
+        # Zero-weight rows (data padding) contribute to no statistic —
+        # keep them out of the flag and the audit; sentinel centroid
+        # rows (real_mask) out of the distance scale.
+        best, corrected = guarded_assign_chunk(
+            xc, d2, centroids, real_mask=real_mask, valid=wc > 0)
+        mind2 = _winner_sq_dists(xc, centroids, best, acc) \
+            if need_min else None
+    else:
+        best = jnp.argmin(d2, axis=1).astype(jnp.int32)  # lowest-index ties
+        mind2 = jnp.min(d2, axis=1) if need_min else None
     if select_fn is None:
         mine = jnp.ones_like(wc)
         mind2_g = mind2
@@ -179,6 +333,9 @@ def accumulate_chunk(carry: StepStats, xc: jax.Array, wc: jax.Array,
     onehot = onehot.astype(acc) * (wc * mine)[:, None]     # (c, k), padded=0
     # bf16 mode also runs the scatter-sum matmul at bf16 input rate (one-hot
     # weights are exact in bf16; only the point coordinates get rounded).
+    # The GUARDED rung keeps the scatter at acc precision: its contract is
+    # sums bit-equal to the f32 class, and the distance matmul (k times
+    # this one's row count of useful work) is where the rate lives.
     mm = jnp.bfloat16 if mode == "matmul_bf16" else acc
     sums = carry.sums + jax.lax.dot_general(
         onehot.astype(mm), xc.astype(mm), (((0,), (0,)), ((), ())),
@@ -200,7 +357,24 @@ def accumulate_chunk(carry: StepStats, xc: jax.Array, wc: jax.Array,
         far_p = jnp.where(better, far_p, carry.farthest_point)
     else:
         far_d, far_p = carry.farthest_dist, carry.farthest_point
-    return StepStats(sums, counts, sse, far_d, far_p, sse_pc)
+    return StepStats(sums, counts, sse, far_d, far_p, sse_pc), corrected
+
+
+def accumulate_chunk(carry: StepStats, xc: jax.Array, wc: jax.Array,
+                     centroids: jax.Array, *, mode: str = "matmul",
+                     select_fn=None, need_sse: bool = True,
+                     need_farthest: bool = True,
+                     need_sse_pc: bool = True) -> StepStats:
+    """Serial stage A + stage B fold of one chunk (the pre-ISSUE-8 body,
+    arithmetic unchanged: ``consume_chunk(distance_stage(...))`` with the
+    guard-audit count dropped).  Callers that schedule the stages
+    themselves (the pipelined scan bodies) or consume the guard audit
+    use the stage pair directly."""
+    d2 = distance_stage(xc, centroids, mode=mode)
+    return consume_chunk(carry, d2, xc, wc, centroids, mode=mode,
+                         select_fn=select_fn, need_sse=need_sse,
+                         need_farthest=need_farthest,
+                         need_sse_pc=need_sse_pc)[0]
 
 
 @functools.partial(jax.jit, static_argnames=("chunk_size", "mode"))

@@ -33,8 +33,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kmeans_tpu.ops.assign import (StepStats, _accum_dtype, accumulate_chunk,
-                                   init_stats, pairwise_sq_dists)
+from kmeans_tpu.ops.assign import (GUARDED_MODE, StepStats, _accum_dtype,
+                                   accumulate_chunk, consume_chunk,
+                                   distance_stage, guarded_assign_chunk,
+                                   init_stats, margin_chunk,
+                                   pairwise_sq_dists, value_mode)
 from kmeans_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS, axis_size,
                                       mesh_shape, shard_map)
 
@@ -207,50 +210,125 @@ def _pallas_local_stats(points, weights, centroids_block, *, mode: str,
 def _local_stats(points, weights, centroids_block, *, chunk_size, mode,
                  model_shards: int, need_sse: bool = True,
                  need_farthest: bool = True, need_sse_pc: bool = True,
-                 x2w=None, w_col=None):
+                 x2w=None, w_col=None, pipeline: int = 0,
+                 real_mask=None):
     """Per-(data,model)-shard pass: scan chunks via the shared
-    ``accumulate_chunk`` body (or one fused Pallas kernel for the 'pallas'
-    modes).  Returned ``sums``/``counts`` cover only this shard's centroid
-    block (embedded later); ``sse``/farthest use the GLOBAL min distance
-    reconstructed across the model axis.  The ``need_*`` flags elide the
-    optional statistics' compute (see ``accumulate_chunk``)."""
+    stage-A/stage-B body (``ops.assign.distance_stage``/``consume_chunk``;
+    one fused Pallas kernel for the 'pallas' modes).  Returns
+    ``(StepStats, corrected)`` — ``corrected`` is the shard-local
+    bf16-guard audit count (constant 0 for unguarded modes).  Returned
+    ``sums``/``counts`` cover only this shard's centroid block (embedded
+    later); ``sse``/farthest use the GLOBAL min distance reconstructed
+    across the model axis.  The ``need_*`` flags elide the optional
+    statistics' compute (see ``consume_chunk``).
+
+    ``pipeline`` selects the chunk schedule (ISSUE 8, the r8
+    ``gmm_step._chunked_epass`` discipline applied to the Lloyd E-step):
+    ``0`` runs stage A (the (chunk, k) distance matmul, MXU) and stage B
+    (argmin + one-hot scatter + stat folds, VPU + MXU epilogue)
+    back-to-back per chunk — the bit-exact parity oracle.  ``1`` skews
+    the schedule one chunk: a prologue computes chunk 0's distance tile
+    outside the scan, each scan step then runs stage A for chunk i and
+    stage B for chunk i-1 (no data dependency between the two inside a
+    step, so XLA can overlap the VPU argmin/scatter epilogue with the
+    next chunk's MXU matmul), and an epilogue drains the final in-flight
+    tile.  Per chunk the arithmetic and the fold order of the statistics
+    are IDENTICAL to the serial body — the schedules are bit-exact
+    parity partners (pinned, tests/test_lloyd_pipeline.py).  The Pallas
+    modes ignore ``pipeline`` (the fused kernel already owns its own
+    overlap schedule); a single-chunk shard degenerates to the serial
+    body (prologue + epilogue with an empty scan is the same program).
+    """
     if mode in PALLAS_MODES:
-        return _pallas_local_stats(points, weights, centroids_block,
-                                   mode=mode, model_shards=model_shards,
-                                   chunk_size=chunk_size,
-                                   need_sse=need_sse,
-                                   need_farthest=need_farthest,
-                                   need_sse_pc=need_sse_pc, x2w=x2w,
-                                   w_col=w_col)[0]
+        st = _pallas_local_stats(points, weights, centroids_block,
+                                 mode=mode, model_shards=model_shards,
+                                 chunk_size=chunk_size,
+                                 need_sse=need_sse,
+                                 need_farthest=need_farthest,
+                                 need_sse_pc=need_sse_pc, x2w=x2w,
+                                 w_col=w_col)[0]
+        return st, jnp.zeros((), jnp.int32)
     k_local, d = centroids_block.shape
     acc = _accum_dtype(points.dtype)
     n_chunks = points.shape[0] // chunk_size
     xs = (points.reshape(n_chunks, chunk_size, d),
           weights.astype(acc).reshape(n_chunks, chunk_size))
     select = _model_axis_select(model_shards)
+    kw = dict(mode=mode, select_fn=select, need_sse=need_sse,
+              need_farthest=need_farthest, need_sse_pc=need_sse_pc,
+              real_mask=real_mask)
+    init = (init_stats(k_local, d, acc), jnp.zeros((), jnp.int32))
+
+    if not pipeline or n_chunks == 1:
+        def body(carry, chunk):
+            st, nc = carry
+            xc, wc = chunk
+            st, c = consume_chunk(
+                st, distance_stage(xc, centroids_block, mode=mode),
+                xc, wc, centroids_block, **kw)
+            return (st, nc + c), None
+
+        (stats, corrected), _ = lax.scan(body, init, xs)
+        return stats, corrected
+
+    # Prologue: stage A for chunk 0 (fills the one-chunk in-flight tile).
+    x0, w0 = xs[0][0], xs[1][0]
+    rest = (xs[0][1:], xs[1][1:])
 
     def body(carry, chunk):
+        st, nc, d2_prev, x_prev, w_prev = carry
         xc, wc = chunk
-        return accumulate_chunk(carry, xc, wc, centroids_block, mode=mode,
-                                select_fn=select, need_sse=need_sse,
-                                need_farthest=need_farthest,
-                                need_sse_pc=need_sse_pc), None
+        d2_c = distance_stage(xc, centroids_block, mode=mode)  # A, chunk i
+        st, c = consume_chunk(st, d2_prev, x_prev, w_prev,
+                              centroids_block, **kw)           # B, i-1
+        return (st, nc + c, d2_c, xc, wc), None
 
-    stats, _ = lax.scan(body, init_stats(k_local, d, acc), xs)
-    return stats
+    carry0 = init + (distance_stage(x0, centroids_block, mode=mode),
+                     x0, w0)
+    (st, nc, d2_last, x_last, w_last), _ = lax.scan(body, carry0, rest)
+    # Epilogue: stage B for the final in-flight chunk.
+    st, c = consume_chunk(st, d2_last, x_last, w_last, centroids_block,
+                          **kw)
+    return st, nc + c
+
+
+def _check_guarded(mode: str, model_shards: int,
+                   empty_policy: Optional[str] = None) -> None:
+    """Builder-level support matrix of the guarded bf16 rung (ISSUE 8)."""
+    if mode != GUARDED_MODE:
+        return
+    if model_shards > 1:
+        raise ValueError(
+            "distance_mode='matmul_bf16_guarded' requires a data-parallel "
+            "mesh (model_shards == 1): the guard re-resolves near-tie "
+            "rows against a full-precision distance pass, which has no "
+            "TP (centroid-sharded) form — the same rejection the serving "
+            "engine applies to quantize='bf16' under TP sharding")
+    if empty_policy == "farthest":
+        raise ValueError(
+            "distance_mode='matmul_bf16_guarded' does not support "
+            "empty_cluster='farthest': the farthest-point policy is an "
+            "argmax over min-distance VALUES, which the guarded rung "
+            "reproduces only to ~1 ulp (the rtol class), not bitwise; "
+            "use 'keep' or 'resample' (label-exact by construction)")
 
 
 def make_step_fn(mesh: Mesh, *, chunk_size: int,
-                 mode: str = "matmul") -> Callable:
+                 mode: str = "matmul", pipeline: int = 0) -> Callable:
     """Build the jitted SPMD step: (points, weights, centroids) -> StepStats.
 
     ``points``/``weights`` sharded P(data)/P(data); ``centroids`` sharded
     P(model) on k (replicated when the model axis is size 1).  All returned
     stats are fully replicated — every host can run the convergence check
     identically, exactly like the reference's driver but with no gather
-    (SURVEY.md §5 backend mapping).
+    (SURVEY.md §5 backend mapping).  ``pipeline`` selects the chunk
+    schedule (``_local_stats``; bit-exact parity partners).  The guarded
+    bf16 rung is supported on data-parallel meshes (labels/sums/counts
+    bit-equal to 'matmul'; its per-dispatch guard audit is not surfaced
+    here — the device fit loops carry it).
     """
     data_shards, model_shards = mesh_shape(mesh)
+    _check_guarded(mode, model_shards)
 
     def step(points, weights, centroids_block):
         k_local, d = centroids_block.shape
@@ -265,9 +343,10 @@ def make_step_fn(mesh: Mesh, *, chunk_size: int,
             # on separated blobs vs 1.2e-6 relative for this form), and
             # is <2% of the ~100 ms host-loop dispatch RTT it rides on.
             x2w = _weighted_sqnorm_total(points, weights)
-        st = _local_stats(points, weights, centroids_block,
-                          chunk_size=chunk_size, mode=mode,
-                          model_shards=model_shards, x2w=x2w)
+        st, _ = _local_stats(points, weights, centroids_block,
+                             chunk_size=chunk_size, mode=mode,
+                             model_shards=model_shards, x2w=x2w,
+                             pipeline=pipeline)
         m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
         # Embed this shard's centroid block into the full table, then one
         # psum over BOTH axes yields replicated global sums/counts.
@@ -547,7 +626,7 @@ def _project_centroids(new, prev, real_mask, project: Optional[str], acc):
 def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                 k_real: int, max_iter: int, tolerance: float,
                 empty_policy: str = "keep", history_sse: bool = True,
-                project: Optional[str] = None):
+                project: Optional[str] = None, pipeline: int = 0):
     """Build a FULLY ON-DEVICE training loop: one dispatch runs all
     iterations under ``lax.while_loop``.
 
@@ -583,12 +662,23 @@ def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
     'keep') — a traced ARGUMENT, not a baked constant, so fits that
     differ only by seed (restarts, bisecting splits, resumes) share one
     compiled program.
+
+    ``pipeline`` selects the chunk schedule of the statistics pass
+    (``_local_stats``; bit-exact parity partners).  Under the guarded
+    bf16 rung (``mode='matmul_bf16_guarded'``, ISSUE 8) the return
+    gains ONE trailing replicated int32 — the total bf16-guard-corrected
+    row count over all iterations and shards (the per-fit audit the
+    model publishes as ``bf16_guard_corrected_rows_``); the rung is
+    rejected under TP sharding and with the 'farthest' policy
+    (``_check_guarded``).
     """
     if empty_policy not in ("keep", "farthest", "resample"):
         raise ValueError(
             f"on-device loop supports empty_cluster 'keep', 'farthest' or "
             f"'resample', got {empty_policy!r}")
     data_shards, model_shards = mesh_shape(mesh)
+    _check_guarded(mode, model_shards, empty_policy)
+    guarded = (mode == GUARDED_MODE)
     # Elide unneeded per-iteration statistics (the reference's own
     # compute_sse speed/observability trade, kmeans_spark.py:34): skipping
     # the SSE/min-distance reductions and farthest tracking saves real VPU
@@ -626,11 +716,16 @@ def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
         real = jnp.arange(k_pad) < k_real          # mask off sentinel rows
 
         def global_stats(cents_block):
-            st = _local_stats(points, weights, cents_block,
-                              chunk_size=chunk_size, mode=mode,
-                              model_shards=model_shards, need_sse=need_sse,
-                              need_farthest=need_farthest,
-                              need_sse_pc=False, x2w=x2w, w_col=w_col)
+            st, corr = _local_stats(points, weights, cents_block,
+                                    chunk_size=chunk_size, mode=mode,
+                                    model_shards=model_shards,
+                                    need_sse=need_sse,
+                                    need_farthest=need_farthest,
+                                    need_sse_pc=False, x2w=x2w,
+                                    w_col=w_col, pipeline=pipeline,
+                                    real_mask=real if guarded else None)
+            if guarded:
+                corr = lax.psum(corr, (DATA_AXIS, MODEL_AXIS))
             off = jnp.asarray(m_idx * k_local, jnp.int32)
             sums = lax.psum(lax.dynamic_update_slice(
                 jnp.zeros((k_pad, d), acc), st.sums, (off, jnp.int32(0))),
@@ -649,14 +744,15 @@ def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                 far_d, far_p = far_ds[j], far_ps[j]
             else:
                 far_d, far_p = st.farthest_dist, st.farthest_point
-            return sums, counts, sse, far_d, far_p
+            return sums, counts, sse, far_d, far_p, corr
 
         def body(state):
-            i, cents_full, _, sse_hist, shift_hist, _, _ = state
+            i, cents_full, _, sse_hist, shift_hist, _, _, corr_tot = state
             cents_block = lax.dynamic_slice(
                 cents_full, (jnp.asarray(m_idx * k_local, jnp.int32),
                              jnp.int32(0)), (k_local, d))
-            sums, counts, sse, far_d, far_p = global_stats(cents_block)
+            sums, counts, sse, far_d, far_p, corr = \
+                global_stats(cents_block)
             mean = sums / jnp.maximum(counts, 1.0)[:, None]
             new = jnp.where((counts > 0)[:, None], mean.astype(acc),
                             cents_full)
@@ -691,10 +787,11 @@ def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
             # healthy fits the flag is constant-true: the arithmetic of
             # every iteration is untouched (parity oracles unaffected).
             ok = jnp.all(jnp.isfinite(jnp.where(real[:, None], new, 0.0)))
-            return i + 1, new, max_shift, sse_hist, shift_hist, counts, ok
+            return (i + 1, new, max_shift, sse_hist, shift_hist, counts,
+                    ok, corr_tot + corr)
 
         def cond(state):
-            i, _, max_shift, _, _, _, ok = state
+            i, _, max_shift, _, _, _, ok, _ = state
             return (i < max_iter) & ((i == 0) | (max_shift >= tolerance)) \
                 & ok
 
@@ -703,16 +800,21 @@ def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
             if model_shards > 1 else centroids_block.astype(acc)
         state = (jnp.int32(0), cents0, jnp.asarray(jnp.inf, acc),
                  jnp.zeros((max_iter,), acc), jnp.zeros((max_iter,), acc),
-                 jnp.zeros((k_pad,), acc), jnp.asarray(True))
-        i, cents, _, sse_hist, shift_hist, counts, _ = lax.while_loop(
-            cond, body, state)
-        return cents[:k_real], i, sse_hist, shift_hist, counts[:k_real]
+                 jnp.zeros((k_pad,), acc), jnp.asarray(True),
+                 jnp.zeros((), jnp.int32))
+        i, cents, _, sse_hist, shift_hist, counts, _, corr_tot = \
+            lax.while_loop(cond, body, state)
+        out = (cents[:k_real], i, sse_hist, shift_hist, counts[:k_real])
+        return out + (corr_tot,) if guarded else out
 
+    out_specs = (P(None, None), P(), P(), P(), P(None))
+    if guarded:
+        out_specs = out_specs + (P(),)
     mapped = shard_map(
         fit, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(MODEL_AXIS, None),
                   P(None)),
-        out_specs=(P(None, None), P(), P(), P(), P(None)),
+        out_specs=out_specs,
         check_vma=False)
     return jax.jit(mapped)
 
@@ -722,7 +824,8 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                       empty_policy: str = "keep", n_init: int,
                       history_sse: bool = True,
                       project: Optional[str] = None,
-                      k_reals=None, return_all: bool = False):
+                      k_reals=None, return_all: bool = False,
+                      pipeline: int = 0):
     """Build a BATCHED on-device training loop: ``n_init`` independent
     restarts run in ONE dispatch, vmapped over the restart axis.
 
@@ -775,11 +878,23 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
     returns instead the PER-MEMBER states the sweep engine selects from on
     the host: ``(centroids[R,k_real,D], n_iters[R], sse_hist[R,max_iter],
     shift_hist[R,max_iter], counts[R,k_real], final_inertias[R])``.
+
+    ``pipeline`` selects the chunk schedule (``_local_stats``).  Under
+    the guarded bf16 rung the member passes run under ``lax.map``
+    instead of ``vmap`` (a vmapped ``lax.cond`` lowers to a select that
+    executes BOTH branches, which would pay the f32 correction tile for
+    every chunk of every member; ``lax.map`` keeps the cond real — the
+    Pallas-mode precedent, and at the guarded rung's target shapes a
+    single member already saturates the MXU) and the return gains one
+    trailing replicated int32: the total corrected-row count over all
+    members/iterations (in BOTH return shapes).
     """
     if empty_policy not in ("keep", "farthest", "resample"):
         raise ValueError(
             f"on-device loop supports empty_cluster 'keep', 'farthest' or "
             f"'resample', got {empty_policy!r}")
+    _check_guarded(mode, mesh_shape(mesh)[1], empty_policy)
+    guarded = (mode == GUARDED_MODE)
     if k_reals is not None:
         k_reals = np.asarray(k_reals, np.int32)
         if k_reals.shape != (n_init,):
@@ -827,10 +942,14 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
             restart's centroid block from its full table, then psum the
             embedded accumulators over both mesh axes.  Optional
             statistics are elided per the need flags."""
-            def local(c_full):
+            def local(c_full, r_mask):
                 blk = lax.dynamic_slice(
                     c_full, (jnp.asarray(m_idx * k_local, jnp.int32),
                              jnp.int32(0)), (k_local, d))
+                # Guarded rung: the MEMBER's real-row mask keeps its
+                # inert sentinel rows (k-sweep padding, 1e12 norms) out
+                # of the guard's distance scale (model_shards == 1 under
+                # the rung, so the block IS the full k_pad table).
                 return _local_stats(points, weights,
                                     blk.astype(points.dtype),
                                     chunk_size=chunk_size, mode=mode,
@@ -838,8 +957,9 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                                     need_sse=need_sse,
                                     need_farthest=need_farthest,
                                     need_sse_pc=False, x2w=x2w,
-                                    w_col=w_col)
-            if mode in PALLAS_MODES:
+                                    w_col=w_col, pipeline=pipeline,
+                                    real_mask=r_mask if guarded else None)
+            if mode in PALLAS_MODES or guarded:
                 # vmapping a pallas_call over the restart axis
                 # MATERIALIZES the unbatched points operand R times
                 # (r5, found by the 10M x R=4 time-to-solution run:
@@ -848,9 +968,12 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                 # instead — at pallas shapes (k >= 512) a single
                 # restart already saturates the MXU, so the batching
                 # win the vmap bought at small k does not exist here.
-                st = lax.map(local, cents)
+                # The guarded rung rides the same path: vmap would turn
+                # its per-chunk correction cond into a both-branches
+                # select (see the builder docstring).
+                st, corrs = lax.map(lambda a: local(*a), (cents, real))
             else:
-                st = jax.vmap(local)(cents)
+                st, corrs = jax.vmap(local)(cents, real)
             off = jnp.asarray(m_idx * k_local, jnp.int32)
             sums = lax.psum(jax.vmap(lambda s: lax.dynamic_update_slice(
                 jnp.zeros((k_pad, d), acc), s.astype(acc),
@@ -869,11 +992,15 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                     far_ps, owner[None, :, None], axis=0)[0]   # (R, d)
             else:
                 far_d, far_p = st.farthest_dist, st.farthest_point
-            return sums, counts, sse, far_d, far_p
+            corr = (lax.psum(jnp.sum(corrs, dtype=jnp.int32), axes)
+                    if guarded else jnp.zeros((), jnp.int32))
+            return sums, counts, sse, far_d, far_p, corr
 
         def body(state):
-            i, cents, done, n_iters, sse_hist, shift_hist, counts_out = state
-            sums, counts, sse, far_d, far_p = all_stats(cents, history_sse)
+            (i, cents, done, n_iters, sse_hist, shift_hist, counts_out,
+             corr_tot) = state
+            sums, counts, sse, far_d, far_p, corr = all_stats(
+                cents, history_sse)
             mean = sums / jnp.maximum(counts, 1.0)[..., None]
             new = jnp.where((counts > 0)[..., None], mean.astype(acc), cents)
             if empty_policy == "farthest":
@@ -908,7 +1035,8 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
             counts_out = jnp.where(done[:, None], counts_out, counts)
             n_iters = jnp.where(done, n_iters, i + 1)
             done = done | (max_shift < tolerance)
-            return i + 1, new, done, n_iters, sse_hist, shift_hist, counts_out
+            return (i + 1, new, done, n_iters, sse_hist, shift_hist,
+                    counts_out, corr_tot + corr)
 
         def cond(state):
             i, _, done, *_ = state
@@ -920,28 +1048,38 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
         state = (jnp.int32(0), cents0,
                  jnp.zeros((R,), bool), jnp.zeros((R,), jnp.int32),
                  jnp.zeros((R, max_iter), acc), jnp.zeros((R, max_iter), acc),
-                 jnp.zeros((R, k_pad), acc))
-        _, cents, _, n_iters, sse_hist, shift_hist, counts_out = \
+                 jnp.zeros((R, k_pad), acc), jnp.zeros((), jnp.int32))
+        _, cents, _, n_iters, sse_hist, shift_hist, counts_out, corr_tot = \
             lax.while_loop(cond, body, state)
 
         # Selection pass: true final inertia of each restart's centroids
         # (SSE always computed here — it IS the selection criterion).
-        _, _, final_sse, _, _ = all_stats(cents, True)
+        # Its guard-corrected count is NOT added to the audit: the
+        # attribute means training-loop flags per iteration on every
+        # path (make_fit_fn counts loop iterations only), and a rate
+        # derived as corrected/(iterations*n) must not be inflated by
+        # the one extra scoring pass.
+        _, _, final_sse, _, _, _ = all_stats(cents, True)
         if return_all:
             # Sweep mode: selection happens on the HOST (the criterion may
             # be a batched metric, not inertia) — hand back every member's
             # final state, trimmed to the pad target k_real; each member's
             # own trim to k_reals[r] is the caller's.
-            return (cents[:, :k_real], n_iters, sse_hist, shift_hist,
-                    counts_out[:, :k_real], final_sse)
-        best = jnp.argmin(final_sse)
-        return (cents[best, :k_real], n_iters[best], sse_hist[best],
-                shift_hist[best], counts_out[best, :k_real], best, final_sse)
+            out = (cents[:, :k_real], n_iters, sse_hist, shift_hist,
+                   counts_out[:, :k_real], final_sse)
+        else:
+            best = jnp.argmin(final_sse)
+            out = (cents[best, :k_real], n_iters[best], sse_hist[best],
+                   shift_hist[best], counts_out[best, :k_real], best,
+                   final_sse)
+        return out + (corr_tot,) if guarded else out
 
     out_specs = ((P(None, None, None), P(None), P(None, None),
                   P(None, None), P(None, None), P(None)) if return_all
                  else (P(None, None), P(), P(None), P(None), P(None), P(),
                        P(None)))
+    if guarded:
+        out_specs = out_specs + (P(),)
     mapped = shard_map(
         fit, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS),
@@ -960,9 +1098,25 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
     return jax.jit(mapped)
 
 
+def _check_minibatch_mode(mode: str) -> None:
+    """The Sculley engines keep the f32-class modes: a mini-batch's
+    statistics pass is ONE chunk (batch_per_shard == chunk), so there is
+    no bf16-rate matmul big enough to guard — and the update itself is a
+    sampled approximation, where a bit-exactness rung has nothing to
+    protect.  Pointed rejection so the knob fails loudly, mirroring the
+    TP rejection (``_check_guarded``)."""
+    if mode == GUARDED_MODE:
+        raise ValueError(
+            "distance_mode='matmul_bf16_guarded' applies to the "
+            "full-batch Lloyd engines (KMeans/SphericalKMeans fit "
+            "paths); the mini-batch Sculley engines run the f32-class "
+            "modes — use 'matmul' (exact) or 'matmul_bf16' (unguarded)")
+
+
 def make_minibatch_step_fn(mesh: Mesh, *, batch_per_shard: int,
                            mode: str = "matmul",
-                           n_candidates: int = 0) -> Callable:
+                           n_candidates: int = 0,
+                           pipeline: int = 0) -> Callable:
     """Build the fused ON-DEVICE mini-batch iteration:
     (points, weights, centroids, key) -> StepStats of a freshly-sampled
     batch — sampling AND statistics in ONE dispatch.
@@ -999,8 +1153,15 @@ def make_minibatch_step_fn(mesh: Mesh, *, batch_per_shard: int,
     mask) for the host-side low-count reassignment decision
     (``_batch_candidates``); the return type becomes
     (stats, cand_rows, cand_valid).
+
+    ``pipeline`` is accepted for knob-surface symmetry with the Lloyd
+    builders but DEGENERATES to the serial body: the batch is exactly
+    one scan chunk (``chunk_size == batch_per_shard``), and a
+    single-chunk pipelined schedule IS the serial schedule
+    (``_local_stats``).
     """
     data_shards, model_shards = mesh_shape(mesh)
+    _check_minibatch_mode(mode)
 
     def step(points, weights, centroids_block, key, iteration):
         k_local, d = centroids_block.shape
@@ -1008,10 +1169,11 @@ def make_minibatch_step_fn(mesh: Mesh, *, batch_per_shard: int,
         base_i = jax.random.fold_in(key, iteration)
         bx, bw = _sample_batch(points, weights, base_i,
                                batch_per_shard, data_shards)
-        st = _local_stats(bx, bw, centroids_block,
-                          chunk_size=batch_per_shard, mode=mode,
-                          model_shards=model_shards, need_sse=True,
-                          need_farthest=False, need_sse_pc=False)
+        st, _ = _local_stats(bx, bw, centroids_block,
+                             chunk_size=batch_per_shard, mode=mode,
+                             model_shards=model_shards, need_sse=True,
+                             need_farthest=False, need_sse_pc=False,
+                             pipeline=pipeline)
         m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
         k = k_local * model_shards
         off = jnp.asarray(m_idx * k_local, jnp.int32)
@@ -1136,7 +1298,7 @@ def make_minibatch_fit_fn(mesh: Mesh, *, batch_per_shard: int,
                           mode: str = "matmul", k_real: int, max_iter: int,
                           tolerance: float, history_sse: bool = True,
                           reassignment_ratio: float = 0.0,
-                          reassign_every: int = 1):
+                          reassign_every: int = 1, pipeline: int = 0):
     """Build the FULLY ON-DEVICE mini-batch training loop: ALL iterations
     (sampling + batch stats + Sculley update) in ONE dispatch under
     ``lax.while_loop`` — the mini-batch analogue of ``make_fit_fn``.
@@ -1168,9 +1330,12 @@ def make_minibatch_fit_fn(mesh: Mesh, *, batch_per_shard: int,
     (centroids, seen, n_iters, sse_hist[max_iter], shift_hist[max_iter],
     counts_last)`` with everything replicated.  ``sse_hist`` entries are
     scaled batch estimates (total weight / batch weight), matching the
-    host path.
+    host path.  ``pipeline`` degenerates to serial here (single-chunk
+    batch pass — see ``make_minibatch_step_fn``); the guarded bf16 rung
+    is rejected (``_check_minibatch_mode``).
     """
     data_shards, model_shards = mesh_shape(mesh)
+    _check_minibatch_mode(mode)
 
     def fit(points, weights, cents_block, key, iter0, seen0):
         k_local, d = cents_block.shape
@@ -1189,11 +1354,12 @@ def make_minibatch_fit_fn(mesh: Mesh, *, batch_per_shard: int,
             base_i = jax.random.fold_in(key, iter0 + i)
             bx, bw = _sample_batch(points, weights, base_i,
                                    batch_per_shard, data_shards)
-            st = _local_stats(bx, bw, blk.astype(points.dtype),
-                              chunk_size=batch_per_shard, mode=mode,
-                              model_shards=model_shards,
-                              need_sse=history_sse, need_farthest=False,
-                              need_sse_pc=False)
+            st, _ = _local_stats(bx, bw, blk.astype(points.dtype),
+                                 chunk_size=batch_per_shard, mode=mode,
+                                 model_shards=model_shards,
+                                 need_sse=history_sse,
+                                 need_farthest=False,
+                                 need_sse_pc=False, pipeline=pipeline)
             off = jnp.asarray(m_idx * k_local, jnp.int32)
             sums = lax.psum(lax.dynamic_update_slice(
                 jnp.zeros((k_pad, d), acc), st.sums,
@@ -1284,10 +1450,26 @@ def make_predict_fn(mesh: Mesh, *, chunk_size: int,
     (ISSUE 6: the serving engine's per-request staging buffer is
     single-use, so XLA may reuse its memory for the output) — never set
     it for a retained ``ShardedDataset``, whose points outlive the call.
+
+    The guarded bf16 rung runs its chunk-level guard here too
+    (``guarded_assign_chunk``), so ``labels_`` materialization and
+    ``predict`` under ``distance_mode='matmul_bf16_guarded'`` are
+    bit-equal to the f32-class labels by construction; rejected under TP
+    sharding like the fit builders.
+
+    The returned callable takes ``(points, centroids_block, n_real)``:
+    ``n_real`` is the REAL (pre-padding) row count, a replicated traced
+    scalar.  The guarded rung uses it to keep zero pad rows out of the
+    near-tie flag — a pad row at the origin has ``d2_k ~= |c_k|^2`` and
+    would fire the f32 correction cond on its chunk (the whole request,
+    for single-chunk serving buckets) whenever two centroid norms are
+    close; its label is sliced off by every caller, so it must never
+    cost a correction pass.  The unguarded modes ignore the argument.
     """
     data_shards, model_shards = mesh_shape(mesh)
+    _check_guarded(mode, model_shards)
 
-    def predict(points, centroids_block):
+    def predict(points, centroids_block, n_real):
         k_local, d = centroids_block.shape
         n_local = points.shape[0]
         m_idx = lax.axis_index(MODEL_AXIS) if model_shards > 1 else 0
@@ -1311,10 +1493,22 @@ def make_predict_fn(mesh: Mesh, *, chunk_size: int,
             return labels
         n_chunks = n_local // chunk_size
         xs = points.reshape(n_chunks, chunk_size, d)
+        if mode == GUARDED_MODE:
+            # This shard's real-row count: padding is a contiguous
+            # global tail, so rows at global index >= n_real are pads.
+            d_idx = lax.axis_index(DATA_AXIS) if data_shards > 1 else 0
+            local_real = n_real.astype(jnp.int32) - d_idx * n_local
 
-        def body(_, xc):
-            d2 = pairwise_sq_dists(xc, centroids_block, mode=mode)
-            best_l = jnp.argmin(d2, axis=1).astype(jnp.int32)
+        def body(_, chunk_in):
+            xc, c_idx = chunk_in
+            d2 = distance_stage(xc, centroids_block, mode=mode)
+            if mode == GUARDED_MODE:
+                rows = c_idx * chunk_size + jnp.arange(chunk_size,
+                                                       dtype=jnp.int32)
+                best_l, _ = guarded_assign_chunk(
+                    xc, d2, centroids_block, valid=rows < local_real)
+            else:
+                best_l = jnp.argmin(d2, axis=1).astype(jnp.int32)
             if model_shards > 1:
                 mind2_l = jnp.min(d2, axis=1)
                 minds = lax.all_gather(mind2_l, MODEL_AXIS)
@@ -1326,12 +1520,13 @@ def make_predict_fn(mesh: Mesh, *, chunk_size: int,
                 best = best_l
             return None, best
 
-        _, labels = lax.scan(body, None, xs)
+        _, labels = lax.scan(
+            body, None, (xs, jnp.arange(n_chunks, dtype=jnp.int32)))
         return labels.reshape(-1)
 
     mapped = shard_map(
         predict, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(MODEL_AXIS, None)),
+        in_specs=(P(DATA_AXIS, None), P(MODEL_AXIS, None), P()),
         out_specs=P(DATA_AXIS),
         check_vma=False)
     return jax.jit(mapped, donate_argnums=(0,) if donate_points else ())
@@ -1374,16 +1569,11 @@ def make_assign_margin_fn(mesh: Mesh, *, chunk_size: int,
             centroids_block.astype(acc) ** 2, axis=1))
 
         def body(_, xc):
+            # Shared chunk-level error model (ops.assign.margin_chunk) —
+            # the training guard (`GUARDED_MODE`) computes exactly the
+            # same (best, margin, scale) triple in-graph.
             d2 = pairwise_sq_dists(xc, centroids_block, mode=mode)
-            best = jnp.argmin(d2, axis=1).astype(jnp.int32)
-            d1 = jnp.min(d2, axis=1)
-            # Second-best: mask the winner column, take the min again.
-            masked = jnp.where(
-                jax.nn.one_hot(best, k_local, dtype=bool),
-                jnp.asarray(jnp.inf, d2.dtype), d2)
-            d2nd = jnp.min(masked, axis=1)
-            scale = jnp.sum(xc.astype(acc) ** 2, axis=1) + c2max
-            return None, (best, (d2nd - d1).astype(acc), scale)
+            return None, margin_chunk(xc, d2, c2max)
 
         _, (labels, margin, scale) = lax.scan(body, None, xs)
         return (labels.reshape(-1), margin.reshape(-1),
@@ -1408,8 +1598,11 @@ def make_score_rows_fn(mesh: Mesh, *, chunk_size: int,
     from the SAME ``pairwise_sq_dists`` mode ladder as assignment
     (matmul/bf16); the fused training step's SSE is the same quantity
     reduced on device, so per-request sums agree to f32 summation
-    order (rtol), not bitwise.
+    order (rtol), not bitwise.  The guarded rung maps to its f32-class
+    'matmul' twin — distance VALUES are the answer here, and the
+    guarded rung's value surface IS the f32 class.
     """
+    mode = value_mode(mode)
     data_shards, model_shards = mesh_shape(mesh)
 
     def score_rows(points, centroids_block):
@@ -1469,6 +1662,9 @@ def make_multi_predict_fn(mesh: Mesh, *, chunk_size: int,
             "falls back to per-model dispatches under TP sharding")
     if mode in PALLAS_MODES:
         mode = "matmul_bf16" if mode == "pallas_bf16" else "matmul"
+    # No guarded packed form (the r11 packed-quantized finding):
+    # exactness wins — serve the stack at the f32 class.
+    mode = value_mode(mode)
 
     def predict(points, cents_stack):
         d = points.shape[1]
@@ -1500,7 +1696,10 @@ def make_transform_fn(mesh: Mesh, *, chunk_size: int,
     (n_local, k_local) tile (r2 VERDICT weak #5: the old transform built
     the full (n, k) matrix on one device, ~41 GB at the 10M headline
     shape).  Rows scan in ``chunk_size`` tiles exactly like the training
-    step; sentinel padding columns are sliced off by the caller."""
+    step; sentinel padding columns are sliced off by the caller.  The
+    guarded rung maps to 'matmul' (distances are the output; its value
+    surface is the f32 class — the kmeans.py serve-mode table rule)."""
+    mode = value_mode(mode)
     data_shards, model_shards = mesh_shape(mesh)
 
     def dists(points, centroids_block):

@@ -65,7 +65,9 @@ from kmeans_tpu.serving.registry import ModelRegistry
 __all__ = ["ServingEngine", "ResidentModel"]
 
 # bf16 fast-path mode map: which f32-class distance mode each serving
-# mode quantizes to.  'direct' has no quantized form and stays exact.
+# mode quantizes to.  'direct' has no quantized form and stays exact;
+# the guarded training rung is ALREADY the guarded bf16 path — no
+# further quantization to apply.
 _BF16_MODES = {"matmul": "matmul_bf16", "pallas": "pallas_bf16",
                "auto": "matmul_bf16"}
 
@@ -78,7 +80,11 @@ _BF16_MODES = {"matmul": "matmul_bf16", "pallas": "pallas_bf16",
 # bit-equal to the f32 oracle BY CONSTRUCTION, not just on separated
 # data (the failure the end-to-end verify drive caught: 14/1000 flips
 # on boundary rows of a 6-cluster blob set under plain bf16 argmin).
-BF16_TIE_RTOL = 2.0 ** -5
+# Since ISSUE 8 the canonical bound lives with the shared guarded-
+# assignment primitive (ops.assign.BF16_GUARD_RTOL) — serving and the
+# training rung ('matmul_bf16_guarded') share ONE error model; this
+# name re-exports it for the existing serving surface.
+from kmeans_tpu.ops.assign import BF16_GUARD_RTOL as BF16_TIE_RTOL
 
 
 class ResidentModel:
@@ -267,8 +273,9 @@ class ServingEngine:
         mode = rm.model._mode(B, rm.spec["d"])
         if rm.quantize == "bf16":
             mode = _BF16_MODES.get(mode, mode)
-        tmode = {"auto": "matmul", "pallas": "matmul",
-                 "pallas_bf16": "matmul_bf16"}.get(mode, mode)
+        from kmeans_tpu.ops.assign import value_mode
+        tmode = value_mode({"auto": "matmul", "pallas": "matmul",
+                            "pallas_bf16": "matmul_bf16"}.get(mode, mode))
         return mode, tmode
 
     def _predict_fn(self, chunk: int, mode: str):
@@ -335,7 +342,7 @@ class ServingEngine:
                         rm.bf16_corrected_rows += corrected
             else:
                 out = np.asarray(self._predict_fn(chunk, mode)(
-                    pts, cents_dev))[:m]
+                    pts, cents_dev, np.int32(m)))[:m]
         elif op == "transform":
             tfn = kmeans_mod._STEP_CACHE.get_or_create(
                 (self.mesh, chunk, tmode, "transform"),
@@ -343,10 +350,15 @@ class ServingEngine:
                     self.mesh, chunk_size=chunk, mode=tmode))
             out = np.asarray(tfn(pts, cents_dev))[:m, : rm.spec["k"]]
         elif op == "score_rows":
+            # Key on the VALUE-surface mode: make_score_rows_fn maps the
+            # guarded rung to 'matmul' internally, so the raw mode would
+            # duplicate an identical compile next to the f32 entry.
+            from kmeans_tpu.ops.assign import value_mode
+            smode = value_mode(mode)
             sfn = kmeans_mod._STEP_CACHE.get_or_create(
-                (self.mesh, chunk, mode, "score_rows"),
+                (self.mesh, chunk, smode, "score_rows"),
                 lambda: dist.make_score_rows_fn(
-                    self.mesh, chunk_size=chunk, mode=mode))
+                    self.mesh, chunk_size=chunk, mode=smode))
             out = np.asarray(sfn(pts, cents_dev))[:m]
         else:                               # unreachable past _validate
             raise ValueError(f"unknown op {op!r}")
@@ -376,7 +388,11 @@ class ServingEngine:
         near = np.flatnonzero(margin <= BF16_TIE_RTOL * scale)
         if near.size:
             # f32 correction ride-along: its own (small) bucket, the
-            # SHARED f32 predict program.
+            # SHARED f32 predict program.  Tagged distinctly so
+            # dispatch-count pins can tell guard traffic from serving
+            # traffic (ISSUE 8 satellite).
+            from kmeans_tpu.utils.profiling import note_dispatch
+            note_dispatch("bf16-guard-fix")
             sub = np.ascontiguousarray(buf[near])
             sub_buf, n_sub, B_sub = self._stage(rm, sub)
             sub_chunk = self._serve_chunk(rm, B_sub)
@@ -386,7 +402,8 @@ class ServingEngine:
             f32_mode = rm.model._mode(B_sub, rm.spec["d"])
             exact = np.asarray(self._predict_fn(sub_chunk, f32_mode)(
                 sub_pts, rm.model._cents_dev(
-                    self.mesh, mesh_shape(self.mesh)[1])))[:n_sub]
+                    self.mesh, mesh_shape(self.mesh)[1]),
+                np.int32(n_sub)))[:n_sub]
             labels[near] = exact
         return labels, int(near.size)
 
@@ -583,7 +600,8 @@ class ServingEngine:
             rm, buf, pts, cents_dev, chunk, m)
         f32_mode = rm.model._mode(B, rm.spec["d"])
         lab_f = np.asarray(self._predict_fn(chunk, f32_mode)(
-            shard_points(buf, self.mesh, chunk)[0], cents_dev))[:m]
+            shard_points(buf, self.mesh, chunk)[0], cents_dev,
+            np.int32(m)))[:m]
 
         def _distances(tmode):
             tfn = kmeans_mod._STEP_CACHE.get_or_create(

@@ -148,6 +148,77 @@ def measure_phase_ladder(rungs, *, reps: int = 5):
     return out
 
 
+#: Decision rule of the phase table (committed BEFORE the hardware run,
+#: the repo's pre-registration discipline): a phase owning at least this
+#: share of the measured step is "actionable" — it becomes the next
+#: schedule target (>= half of the headline's idle ~30%).  Anything
+#: smaller is pinned as part of the measured ceiling.
+PHASE_DECISION_SHARE = 0.15
+
+
+def phase_ceiling_table(ladder, *, flops_per_iter=None,
+                        peak_tflops=None,
+                        decision_share: float = PHASE_DECISION_SHARE):
+    """Turn a ``measure_phase_ladder`` result into the publishable
+    MEASURED-CEILING table (ISSUE 8c): one row per phase with
+
+    * ``ms`` — the phase's marginal cost,
+    * ``share`` — its fraction of the full measured pass,
+    * ``implied_ceiling_speedup`` — ``full / (full - phase)``: the whole-
+      pass speedup IF this phase were completely free (perfectly hidden
+      behind another unit) — the honest upper bound any schedule attack
+      on that phase can buy,
+    * ``implied_ceiling_mfu`` — the MFU the pass would reach at that
+      ceiling (None without ``flops_per_iter``/``peak_tflops``),
+    * ``actionable`` — the committed decision rule: ``share >=
+      decision_share`` (default 15%, >= half the idle ~30%) marks the
+      phase as the next schedule target.
+
+    The full pass is the LAST rung's cumulative median (the complete
+    statistics body); rows carry the ladder's ``spread`` through so a
+    noisy phase can never silently pass the decision rule unflagged.
+    """
+    import numpy as np  # noqa: F811 — mirror measure_phase_ladder
+
+    full = float(ladder[-1]["cumulative"])
+    rows = []
+    for r in ladder:
+        sec = float(r["seconds"])
+        share = sec / full if full > 0 else 0.0
+        remaining = max(full - sec, 1e-12)
+        speedup = full / remaining if full > 0 else 1.0
+        mfu = None
+        if flops_per_iter and peak_tflops and full > 0:
+            mfu = (flops_per_iter / remaining) / (peak_tflops * 1e12)
+        rows.append({
+            "phase": r["phase"],
+            "ms": sec * 1e3,
+            "share": share,
+            "spread": r["spread"],
+            "implied_ceiling_speedup": speedup,
+            "implied_ceiling_mfu": mfu,
+            "actionable": bool(share >= decision_share),
+        })
+    return rows
+
+
+def sanitize_json(obj):
+    """Recursively replace non-finite floats with None: strict JSON has
+    no inf/nan, but a noise-only phase reports ``spread=inf`` by design
+    (``measure_phase_ladder`` — never a fake zero-variance phase).  The
+    shared sanitizer of every artifact that embeds ladder rows
+    (``benchmarks.bench_phases``, exp_headline_decomposition)."""
+    import numpy as np  # noqa: F811 — mirror measure_phase_ladder
+
+    if isinstance(obj, dict):
+        return {k: sanitize_json(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [sanitize_json(v) for v in obj]
+    if isinstance(obj, float) and not np.isfinite(obj):
+        return None
+    return obj
+
+
 def timed_call(fn, *args, warmup: int = 1, iters: int = 3):
     """(mean_seconds, last_result) of fn(*args), excluding warmup runs."""
     result = None

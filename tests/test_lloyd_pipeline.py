@@ -1,0 +1,568 @@
+"""Pipelined Lloyd E-step + guarded bf16 distance rung (ISSUE 8): the
+software-pipelined two-stage chunk schedule (``pipeline=1``) against the
+serial oracle (``pipeline=0``), and ``distance_mode='matmul_bf16_guarded'``
+against its f32 'matmul' twin — the ``prefetch=0`` / ``checkpoint_every=0``
+discipline: both knobs move WHERE work happens (or at what rate the
+distance tile computes), never the arithmetic of any label, sum, or count,
+so trajectories must match the oracle bit-for-bit."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kmeans_tpu.models import KMeans, MiniBatchKMeans, SphericalKMeans
+from kmeans_tpu.ops import assign
+from kmeans_tpu.parallel import distributed as dist
+from kmeans_tpu.parallel.mesh import make_mesh
+
+
+def _blobs(n=2048, d=8, centers=5, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    comp = rng.integers(0, centers, n)
+    return (comp[:, None] * 4.0
+            + rng.normal(size=(n, d))).astype(dtype)
+
+
+def _fit_pair(mesh, *, cls=KMeans, host_loop=False, k=5, X=None,
+              sample_weight=None, chunk=256, max_iter=8, dtype=None,
+              pipeline_on=1, **extra):
+    """Fit the same model under both schedules; returns (pipelined,
+    serial)."""
+    out = []
+    for pipeline in (pipeline_on, 0):
+        m = cls(k=k, max_iter=max_iter, tolerance=1e-7, seed=0,
+                compute_sse=True, mesh=mesh, chunk_size=chunk,
+                host_loop=host_loop, pipeline=pipeline, verbose=False,
+                dtype=dtype, **extra)
+        m.fit(_blobs() if X is None else X, sample_weight=sample_weight)
+        out.append(m)
+    return out
+
+
+def _assert_trajectory_equal(a, b):
+    assert a.iterations_run == b.iterations_run
+    np.testing.assert_array_equal(a.centroids, b.centroids)
+    assert a.sse_history == b.sse_history
+    np.testing.assert_array_equal(a.labels_, b.labels_)
+
+
+def _assert_guard_trajectory_equal(g, f):
+    """The guarded rung's bit-exact contract: labels, centroids, and
+    iteration counts.  SSE reads the winner's full-precision distance
+    (``ops.assign._winner_sq_dists``) — the value equals the f32-class
+    min(d2) up to the dot's reduction order, so the history lands in
+    the repo's documented rtol-compared class, not the bitwise one."""
+    assert g.iterations_run == f.iterations_run
+    np.testing.assert_array_equal(g.centroids, f.centroids)
+    np.testing.assert_array_equal(g.labels_, f.labels_)
+    np.testing.assert_allclose(g.sse_history, f.sse_history, rtol=1e-5)
+
+
+# --------------------------------------------------- pipelined schedule
+
+@pytest.mark.parametrize("host_loop", [True, False])
+def test_pipeline_parity_host_and_device_loops(host_loop, mesh1):
+    m1, m0 = _fit_pair(mesh1, host_loop=host_loop)
+    assert m1.estep_path_ == "pipelined" and m0.estep_path_ == "serial"
+    _assert_trajectory_equal(m1, m0)
+
+
+@pytest.mark.parametrize("data_shards", [1, 2, 4, 8])
+def test_pipeline_parity_data_meshes(data_shards):
+    """1/2/4/8-way data-parallel virtual meshes in the f64 device-loop
+    class: per-shard chunking differs with the width, so the schedules
+    must agree at each (the acceptance-criteria mesh matrix)."""
+    if len(jax.devices()) < data_shards:
+        pytest.skip(f"needs {data_shards} devices")
+    mesh = make_mesh(data=data_shards, model=1,
+                     devices=jax.devices()[:data_shards])
+    X = _blobs(n=2048, dtype=np.float64)
+    m1, m0 = _fit_pair(mesh, X=X, chunk=128, dtype=np.float64)
+    _assert_trajectory_equal(m1, m0)
+
+
+def test_pipeline_parity_model_sharded_with_padding(mesh4x2):
+    """Centroid (TP) sharding with k=5 on a 2-way model axis ->
+    k_pad=6: the sentinel padding row rides the carried distance tile
+    through the skewed schedule and must stay inert in both."""
+    m1, m0 = _fit_pair(mesh4x2, k=5, X=_blobs(n=2048),
+                       empty_cluster="keep")
+    _assert_trajectory_equal(m1, m0)
+
+
+def test_pipeline_parity_spherical(mesh8):
+    X = _blobs(n=2048)
+    m1, m0 = _fit_pair(mesh8, cls=SphericalKMeans, X=X, chunk=128)
+    assert m1.estep_path_ == "pipelined"
+    _assert_trajectory_equal(m1, m0)
+
+
+def test_pipeline_parity_weighted_zero_tail(mesh1):
+    """Zero-weight rows (the padding contract) contribute nothing under
+    either schedule — including as the FINAL chunk, which the pipelined
+    epilogue drains outside the scan."""
+    X = _blobs(n=1536)
+    w = np.ones(X.shape[0], np.float64)
+    w[-300:] = 0.0                      # zero tail crosses chunk edges
+    m1, m0 = _fit_pair(mesh1, X=X, sample_weight=w)
+    _assert_trajectory_equal(m1, m0)
+
+
+def test_pipeline_parity_batched_restarts(mesh1):
+    """The batched n_init device multi-fit threads pipeline through the
+    vmapped member loop; restart selection must agree."""
+    X = _blobs(n=1024)
+    fits = []
+    for pipeline in (1, 0):
+        m = KMeans(k=4, max_iter=6, tolerance=1e-7, seed=0, n_init=3,
+                   init="forgy", compute_sse=True, mesh=mesh1,
+                   chunk_size=256, host_loop=False, pipeline=pipeline,
+                   verbose=False).fit(X)
+        fits.append(m)
+    m1, m0 = fits
+    assert m1.best_restart_ == m0.best_restart_
+    np.testing.assert_array_equal(m1.restart_inertias_,
+                                  m0.restart_inertias_)
+    _assert_trajectory_equal(m1, m0)
+
+
+def test_pipeline_parity_fit_stream(mesh1):
+    X = _blobs(n=1200)
+
+    def blocks():
+        for i in range(0, X.shape[0], 400):
+            yield X[i:i + 400]
+
+    fits = []
+    for pipeline in (1, 0):
+        m = KMeans(k=4, max_iter=5, tolerance=1e-7, seed=0,
+                   compute_sse=True, mesh=mesh1, chunk_size=200,
+                   pipeline=pipeline, verbose=False)
+        m.fit_stream(blocks, d=X.shape[1], prefetch=0)
+        fits.append(m)
+    m1, m0 = fits
+    assert m1.estep_path_ == "pipelined"
+    assert m1.iterations_run == m0.iterations_run
+    np.testing.assert_array_equal(m1.centroids, m0.centroids)
+    assert m1.sse_history == m0.sse_history
+
+
+def test_pipeline_parity_checkpoint_segmented(tmp_path, mesh1):
+    """pipeline x checkpoint_every interplay: the segmented device loop
+    re-dispatches mid-fit; each segment must run the same schedule and
+    the segmented pipelined fit must equal the one-dispatch serial fit
+    bit-for-bit (checkpoint_every=0 is already pinned bit-identical)."""
+    X = _blobs(n=1024)
+    fits = []
+    for pipeline in (1, 0):
+        m = KMeans(k=4, max_iter=6, tolerance=1e-7, seed=0,
+                   compute_sse=True, mesh=mesh1, chunk_size=256,
+                   host_loop=False, pipeline=pipeline, verbose=False)
+        m.fit(X, checkpoint_every=2,
+              checkpoint_path=tmp_path / f"ck{pipeline}.npz")
+        fits.append(m)
+    m1, m0 = fits
+    assert m1.checkpoint_segments_ == m0.checkpoint_segments_ >= 2
+    _assert_trajectory_equal(m1, m0)
+
+
+def test_single_chunk_pipeline(mesh1):
+    """One chunk = prologue + empty scan + epilogue; must equal serial."""
+    m1, m0 = _fit_pair(mesh1, X=_blobs(n=512), chunk=512, max_iter=5)
+    _assert_trajectory_equal(m1, m0)
+
+
+def test_step_level_bit_parity(mesh1):
+    """Dispatch-level: the two schedules' StepStats are bit-identical
+    (not merely trajectory-close), weighted, with every optional
+    statistic on."""
+    rng = np.random.default_rng(1)
+    n, d, k, chunk = 2048, 8, 4, 256
+    x = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)
+    w = jnp.asarray(rng.uniform(0, 2, size=(n,)), jnp.float32)
+    cents = jnp.asarray(rng.normal(size=(k, d)), jnp.float32)
+    s0 = dist.make_step_fn(mesh1, chunk_size=chunk, pipeline=0)(x, w, cents)
+    s1 = dist.make_step_fn(mesh1, chunk_size=chunk, pipeline=1)(x, w, cents)
+    for name in s0._fields:
+        np.testing.assert_array_equal(np.asarray(getattr(s0, name)),
+                                      np.asarray(getattr(s1, name)),
+                                      err_msg=name)
+
+
+def test_pipeline_knob_validation_params_and_auto():
+    with pytest.raises(ValueError, match="pipeline"):
+        KMeans(k=2, pipeline=2)
+    with pytest.raises(ValueError, match="pipeline"):
+        KMeans(k=2, pipeline="yes")
+    m = KMeans(k=2, verbose=False)
+    assert m.pipeline == "auto"
+    assert m.get_params()["pipeline"] == "auto"
+    m.set_params(pipeline=0)
+    assert m.pipeline == 0
+    # 'auto' resolves by platform: serial on CPU (nothing to overlap —
+    # the r8 measured-rejection precedent), pipelined on accelerators.
+    m.set_params(pipeline="auto")
+    expected = 0 if jax.default_backend() == "cpu" else 1
+    assert m._resolve_pipeline() == expected
+
+
+def test_pipeline_save_load_roundtrip(tmp_path, mesh1):
+    X = _blobs(n=512)
+    m = KMeans(k=3, max_iter=4, seed=0, mesh=mesh1, chunk_size=256,
+               pipeline=1, verbose=False).fit(X)
+    p = tmp_path / "km.npz"
+    m.save(p)
+    loaded = KMeans.load(p)
+    assert loaded.pipeline == 1
+    np.testing.assert_array_equal(loaded.centroids, m.centroids)
+    m_auto = KMeans(k=3, max_iter=2, seed=0, mesh=mesh1, chunk_size=256,
+                    verbose=False).fit(X)
+    m_auto.save(p)
+    assert KMeans.load(p).pipeline == "auto"
+
+
+def test_minibatch_pipeline_degenerates_to_serial(mesh1):
+    """The mini-batch statistics pass is ONE scan chunk, so the knob is
+    accepted but the recorded path is what actually runs: serial."""
+    X = _blobs(n=2048)
+    fits = []
+    for pipeline in (1, 0):
+        m = MiniBatchKMeans(k=4, max_iter=10, seed=0, batch_size=512,
+                            mesh=mesh1, pipeline=pipeline,
+                            verbose=False).fit(X)
+        fits.append(m)
+    m1, m0 = fits
+    assert m1.estep_path_ == m0.estep_path_ == "serial"
+    assert m1.iterations_run == m0.iterations_run
+    np.testing.assert_array_equal(m1.centroids, m0.centroids)
+
+
+# ------------------------------------------------- guarded bf16 rung
+
+def _close_pair_init(X, k):
+    """Init whose rows 0/1 are a deliberately CLOSE centroid pair: the
+    exact midpoint of that pair is a guaranteed near-tie at iteration 1
+    (a midpoint of an arbitrary pair is not — a third centroid can sit
+    closer), so salting the data with copies of it makes the guard
+    demonstrably fire — the r11 serving-test pattern, now aimed at the
+    training path."""
+    init = np.asarray(X[:k], X.dtype).copy()
+    init[1] = init[0] + np.asarray(1e-3, X.dtype)
+    mids = np.repeat(((init[0] + init[1]) / 2.0)[None], 8, axis=0)
+    return init, np.concatenate([X, mids.astype(X.dtype)])
+
+
+def _guard_pair(mesh, X, init, *, k=5, max_iter=6, n_init=1,
+                host_loop=False):
+    out = []
+    for mode in ("matmul_bf16_guarded", "matmul"):
+        m = KMeans(k=k, max_iter=max_iter, tolerance=1e-7, seed=0,
+                   init=init, n_init=n_init, compute_sse=True,
+                   empty_cluster="keep", mesh=mesh, chunk_size=256,
+                   host_loop=host_loop, distance_mode=mode,
+                   verbose=False).fit(X)
+        out.append(m)
+    return out
+
+
+def test_guard_fires_and_trajectory_stays_bit_equal(mesh1):
+    """The Voronoi-midpoint regression: the guard FIRES (corrected rows
+    counted in the audit attr) while centroids, labels, SSE decisions
+    and iteration counts stay bit-equal to the f32 class — the
+    by-construction contract, exercised on data where plain bf16 argmin
+    WOULD flip labels."""
+    X = _blobs(n=2048)
+    init, Xg = _close_pair_init(X, 5)
+    mg, mf = _guard_pair(mesh1, Xg, init)
+    assert mg.bf16_guard_corrected_rows_ > 0       # the guard fired
+    assert mf.bf16_guard_corrected_rows_ is None   # f32 class: no audit
+    _assert_guard_trajectory_equal(mg, mf)
+
+
+def test_guard_parity_multiway_mesh(mesh8):
+    """Guarded rung on the multi-shard data-parallel mesh: per-shard
+    guard counts psum into one replicated audit; parity holds across
+    shard boundaries (chunk edges differ from the 1-way mesh)."""
+    X = _blobs(n=2048)
+    init, Xg = _close_pair_init(X, 5)
+    mg, mf = _guard_pair(mesh8, Xg, init)
+    assert mg.bf16_guard_corrected_rows_ > 0
+    _assert_guard_trajectory_equal(mg, mf)
+
+
+def test_guard_parity_batched_restarts(mesh1):
+    """lax.map member loop (NOT vmap — a vmapped cond would pay the f32
+    correction tile for every chunk of every member): audit sums over
+    members, selection bit-agrees with the f32 class."""
+    X = _blobs(n=1024, centers=4)
+    fits = []
+    for mode in ("matmul_bf16_guarded", "matmul"):
+        m = KMeans(k=4, max_iter=5, tolerance=1e-7, seed=0, n_init=3,
+                   init="forgy", compute_sse=True, empty_cluster="keep",
+                   mesh=mesh1, chunk_size=256, host_loop=False,
+                   distance_mode=mode, verbose=False).fit(X)
+        fits.append(m)
+    mg, mf = fits
+    assert mg.bf16_guard_corrected_rows_ is not None
+    assert mg.best_restart_ == mf.best_restart_
+    _assert_guard_trajectory_equal(mg, mf)
+
+
+def test_guard_predict_matches_f32_on_near_ties(mesh1):
+    """predict under the guarded rung runs the chunk-level guard too:
+    Voronoi-midpoint probes label bit-equal to the f32 class."""
+    X = _blobs(n=1024)
+    m = KMeans(k=5, max_iter=10, seed=0, mesh=mesh1, chunk_size=256,
+               verbose=False).fit(X)
+    C = np.asarray(m.centroids, np.float64)
+    rng = np.random.default_rng(0)
+    probe = np.asarray(
+        [(C[i] + C[j]) / 2.0 * (1.0 + 1e-4 * rng.standard_normal())
+         for i in range(len(C)) for j in range(i + 1, len(C))],
+        np.float32)
+    mq = KMeans(k=5, max_iter=1, seed=0, mesh=mesh1, chunk_size=256,
+                distance_mode="matmul_bf16_guarded", verbose=False)
+    mq.centroids = np.asarray(m.centroids)
+    np.testing.assert_array_equal(mq.predict(probe), m.predict(probe))
+
+
+def test_guard_transform_and_score_map_to_f32_class(mesh1):
+    """Distance VALUES are the output of transform/score — the guarded
+    rung's value surface IS the f32 class (the kmeans.py serve-mode
+    table rule), so both must equal the 'matmul' results bitwise."""
+    X = _blobs(n=1024)
+    mf = KMeans(k=4, max_iter=8, seed=0, mesh=mesh1, chunk_size=256,
+                distance_mode="matmul", verbose=False).fit(X)
+    mg = KMeans(k=4, max_iter=8, seed=0, mesh=mesh1, chunk_size=256,
+                distance_mode="matmul_bf16_guarded", verbose=False)
+    mg.centroids = np.asarray(mf.centroids)
+    np.testing.assert_array_equal(mg.transform(X[:256]),
+                                  mf.transform(X[:256]))
+    assert mg.score(X[:256]) == pytest.approx(mf.score(X[:256]))
+
+
+def test_guard_rejected_under_tp_sharding(mesh4x2):
+    """Satellite 5: the rung has no TP form (the guard's f32 re-resolve
+    needs the full centroid table) — pointed error, mirroring the
+    serving quantize rejection."""
+    m = KMeans(k=4, max_iter=2, seed=0, mesh=mesh4x2, chunk_size=256,
+               distance_mode="matmul_bf16_guarded", host_loop=False,
+               verbose=False)
+    with pytest.raises(ValueError, match="data-parallel"):
+        m.fit(_blobs(n=1024))
+
+
+def test_guard_rejected_with_farthest_policy():
+    with pytest.raises(ValueError, match="farthest"):
+        KMeans(k=4, distance_mode="matmul_bf16_guarded",
+               empty_cluster="farthest")
+
+
+def test_guard_rejected_on_minibatch(mesh1):
+    with pytest.raises(ValueError, match="Sculley"):
+        MiniBatchKMeans(k=4, max_iter=4, seed=0, batch_size=512,
+                        mesh=mesh1, verbose=False,
+                        distance_mode="matmul_bf16_guarded"
+                        ).fit(_blobs(n=1024))
+
+
+def test_guard_mode_save_load_and_params(tmp_path, mesh1):
+    X = _blobs(n=512)
+    m = KMeans(k=3, max_iter=4, seed=0, mesh=mesh1, chunk_size=256,
+               host_loop=False, distance_mode="matmul_bf16_guarded",
+               verbose=False).fit(X)
+    assert m.get_params()["distance_mode"] == "matmul_bf16_guarded"
+    p = tmp_path / "g.npz"
+    m.save(p)
+    loaded = KMeans.load(p)
+    assert loaded.distance_mode == "matmul_bf16_guarded"
+    np.testing.assert_array_equal(loaded.centroids, m.centroids)
+
+
+def test_guarded_assign_chunk_unit():
+    """Unit level: the shared guarded-assignment primitive flags exactly
+    the rows inside the margin bound and re-labels them to the f32
+    argmin; well-separated rows never pay the correction."""
+    rng = np.random.default_rng(3)
+    cents = rng.normal(size=(6, 8)).astype(np.float32)
+    cents[1] = cents[0] + 1e-3       # close pair: guaranteed near-tie
+    xs = cents[rng.integers(0, 6, 128)] + \
+        0.01 * rng.normal(size=(128, 8)).astype(np.float32)
+    mids = ((cents[0] + cents[1]) / 2.0)[None, :].repeat(4, 0)
+    x = jnp.asarray(np.concatenate([xs, mids]).astype(np.float32))
+    c = jnp.asarray(cents)
+    d2_bf16 = assign.pairwise_sq_dists(x, c, mode="matmul_bf16")
+    labels, n_corr = assign.guarded_assign_chunk(x, d2_bf16, c)
+    d2_f32 = assign.pairwise_sq_dists(x, c, mode="matmul")
+    np.testing.assert_array_equal(
+        np.asarray(labels), np.asarray(jnp.argmin(d2_f32, axis=1)))
+    assert int(n_corr) >= 4          # every midpoint row was flagged
+    # `valid` excludes rows from flag AND audit (the pad-row contract:
+    # predict/fit padding must never cost a correction pass).
+    valid = jnp.arange(x.shape[0]) < 128        # mask off the midpoints
+    _, n_masked = assign.guarded_assign_chunk(x, d2_bf16, c, valid=valid)
+    assert int(n_masked) < int(n_corr)
+    # `real_mask` keeps sentinel rows out of the distance scale: with a
+    # fake 1e12 pad row appended, an unmasked scale would flag ALL rows.
+    c_pad = jnp.concatenate([c, jnp.full((1, 8), 1e12, c.dtype)])
+    d2_pad = assign.pairwise_sq_dists(x, c_pad, mode="matmul_bf16")
+    _, n_all = assign.guarded_assign_chunk(x, d2_pad, c_pad)
+    assert int(n_all) == x.shape[0]             # the failure mode
+    _, n_real = assign.guarded_assign_chunk(
+        x, d2_pad, c_pad, real_mask=jnp.arange(7) < 6)
+    assert int(n_real) == int(n_corr)           # masked == unpadded
+    # One error model, two call sites: the serving bound IS this bound.
+    from kmeans_tpu.serving.engine import BF16_TIE_RTOL
+    assert BF16_TIE_RTOL is assign.BF16_GUARD_RTOL
+
+
+def test_serving_guard_fix_dispatch_tagged(mesh1):
+    """Satellite 5: the serving engine's f32 correction ride-along is
+    tagged 'bf16-guard-fix' in the dispatch log, so dispatch-count pins
+    can tell guard traffic from serving traffic."""
+    from kmeans_tpu.serving.engine import ServingEngine
+    from kmeans_tpu.utils import profiling
+    X = _blobs(n=1024)
+    km = KMeans(k=5, max_iter=15, seed=0, verbose=False).fit(X)
+    km.mesh = None
+    C = np.asarray(km.centroids, np.float64)
+    probe = np.asarray([(C[i] + C[j]) / 2.0
+                        for i in range(len(C))
+                        for j in range(i + 1, len(C))], np.float32)
+    eng = ServingEngine(mesh=mesh1)
+    try:
+        eng.add_model("q", km, quantize="bf16")
+        with profiling.log_dispatches() as log:
+            eng.predict("q", probe)
+        assert any(lbl == "bf16-guard-fix" for lbl in log)
+    finally:
+        eng.close()
+
+
+def test_guard_sweep_sentinel_padding_not_flagged(mesh1):
+    """Review regression: a batched k-sweep pads member centroid tables
+    to k_max with 1e12 sentinel rows.  The guard's distance scale must
+    exclude them — an unmasked ``max_k |c_k|^2`` would be ~1e24,
+    flagging EVERY row of EVERY member (audit = n*iters*R, correction
+    pass on every chunk).  Selection and trajectories must bit-agree
+    with the f32 sweep, and the audit must stay a boundary-row count."""
+    X = _blobs(n=1024, centers=4)
+    kw = dict(max_iter=8, tolerance=1e-7, seed=7, n_init=1,
+              empty_cluster="keep", verbose=False, mesh=mesh1,
+              chunk_size=256)
+    mg = KMeans(k=3, distance_mode="matmul_bf16_guarded", **kw)
+    rg = mg.sweep(X, k_range=[2, 3, 4], criterion="inertia")
+    mf = KMeans(k=3, distance_mode="matmul", **kw)
+    rf = mf.sweep(X, k_range=[2, 3, 4], criterion="inertia")
+    assert rg.selected_k == rf.selected_k
+    np.testing.assert_array_equal(rg.n_iters, rf.n_iters)
+    np.testing.assert_array_equal(rg.best_model.centroids,
+                                  rf.best_model.centroids)
+    # The audit is a near-tie count, not all-rows-always: strictly less
+    # than ONE full member-pass over the data (the unmasked-sentinel
+    # failure floor is n * iters * members ~ 25k here).
+    assert 0 <= mg.bf16_guard_corrected_rows_ < X.shape[0]
+    # The selected model carries the sweep's observability (the
+    # documented reading surface is the model that owns the centroids).
+    assert rg.best_model.bf16_guard_corrected_rows_ == \
+        mg.bf16_guard_corrected_rows_
+    assert rg.best_model.estep_path_ == mg.estep_path_ is not None
+
+
+def test_guard_zero_weight_padding_not_flagged(mesh1):
+    """Review regression: zero-weight data-padding rows sit at the
+    origin, where d2_k ~= |c_k|^2 — with two centroid norms close they
+    are spurious near-ties.  They contribute to no statistic, so they
+    must not enter the audit or trigger the correction pass.  Mirrored
+    blobs (equal-norm centroid pairs) + a non-multiple-of-chunk n force
+    exactly that configuration; well-separated real rows -> audit 0."""
+    rng = np.random.default_rng(5)
+    base = rng.normal(size=(500, 8)).astype(np.float32) * 0.05
+    X = np.concatenate([base + 4.0, base - 4.0]).astype(np.float32)
+    rng.shuffle(X)
+    X = X[:900]                       # pads to 1024 -> 124 zero rows
+    # Explicit one-row-per-blob init: every REAL row is decisively owned
+    # from iteration 1 (a same-blob k-means++ draw would legitimately
+    # flag the whole first pass and mask the pad-row regression).
+    init = np.stack([X[X.mean(1) > 0][0], X[X.mean(1) < 0][0]])
+    m = KMeans(k=2, max_iter=6, tolerance=1e-7, seed=0, init=init,
+               empty_cluster="keep", mesh=mesh1, chunk_size=256,
+               host_loop=False, distance_mode="matmul_bf16_guarded",
+               verbose=False).fit(X)
+    # Pre-fix floor: the mirrored centroids have EQUAL norms, so every
+    # zero pad row is an exact |c_k|^2 tie -> 124 flags per iteration.
+    assert m.bf16_guard_corrected_rows_ == 0
+
+
+def test_estep_path_fused_pallas(mesh1):
+    """Review regression: the Pallas modes ignore the pipeline knob (the
+    fused kernel owns its own overlap schedule) — estep_path_ must
+    record what actually ran, not 'pipelined'."""
+    X = _blobs(n=1024)
+    m = KMeans(k=4, max_iter=3, seed=0, mesh=mesh1, chunk_size=256,
+               distance_mode="pallas", pipeline=1, host_loop=False,
+               verbose=False).fit(X)
+    assert m.estep_path_ == "fused-pallas"
+    assert m._resolve_pipeline("pallas") == 0   # no duplicate cache key
+
+
+# --------------------------------------- phase table + BENCH_PHASES smoke
+
+def test_phase_ceiling_table_math():
+    """The ceiling table turns ladder rows into shares, implied
+    if-this-phase-were-free speedups, and the committed >= 15%
+    actionability rule."""
+    from kmeans_tpu.utils.profiling import phase_ceiling_table
+    ladder = [
+        {"phase": "distance", "seconds": 0.003, "cumulative": 0.003,
+         "spread": 0.01},
+        {"phase": "assign", "seconds": 0.0033, "cumulative": 0.0063,
+         "spread": 0.02},
+        {"phase": "reduce", "seconds": 0.0047, "cumulative": 0.011,
+         "spread": 0.02},
+    ]
+    table = phase_ceiling_table(ladder, flops_per_iter=1e9,
+                                peak_tflops=100.0)
+    assert [r["phase"] for r in table] == ["distance", "assign", "reduce"]
+    full = 0.011
+    for r, src in zip(table, ladder):
+        assert r["ms"] == pytest.approx(src["seconds"] * 1e3)
+        assert r["share"] == pytest.approx(src["seconds"] / full)
+        assert r["implied_ceiling_speedup"] == pytest.approx(
+            full / (full - src["seconds"]))
+        assert r["actionable"] == (src["seconds"] / full >= 0.15)
+        assert r["implied_ceiling_mfu"] == pytest.approx(
+            1e9 / (full - src["seconds"]) / 1e14)
+    # A sub-threshold phase is pinned, not actionable.
+    small = phase_ceiling_table(
+        [{"phase": "a", "seconds": 0.001, "cumulative": 0.001,
+          "spread": 0.0},
+         {"phase": "b", "seconds": 0.099, "cumulative": 0.1,
+          "spread": 0.0}])
+    assert not small[0]["actionable"] and small[1]["actionable"]
+
+
+def test_bench_phases_cpu_smoke(capsys):
+    """Satellite 6: the BENCH_PHASES harness (phase ladder + ceiling
+    table + chunk-geometry re-sweep) runs end-to-end at a tiny CPU
+    shape inside the tier-1 budget, so the code path can't rot between
+    hardware sessions.  The CPU numbers are a harness exercise — the
+    decision rules are hardware measurements."""
+    from kmeans_tpu.benchmarks import bench_phases
+    result = bench_phases(4096, 8, 8, gap=2, reps=1, chunks=(128, 256))
+    assert result["ceiling_table"] and result["chunk_sweep"]
+    assert {r["phase"] for r in result["ceiling_table"]} == \
+        set(dist.ESTEP_PHASES)
+    assert any(r["committed"] for r in result["chunk_sweep"])
+    rules = result["decision_rules"]
+    assert rules["phase_actionable_share"] == 0.15
+    assert rules["pipelined_vs_serial_adopt"] == 1.05
+    assert rules["chunk_resweep_adopt_shift"] == 0.03
+    # The emitted artifact is one strict-JSON line (inf spreads -> null).
+    import json
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("{")]
+    assert json.loads(lines[-1])["metric"].startswith(
+        "lloyd_phase_ceiling")
