@@ -33,8 +33,11 @@ def main() -> int:
     if cmd == "report":
         from kmeans_tpu.utils.diagram import main as report_main
         return report_main(rest)
+    if cmd == "lint":
+        from kmeans_tpu.cli import lint_main
+        return lint_main(rest)
     print(f"unknown command {cmd!r}; available: suite, bench, fit, "
-          f"sweep, ckpt-info, serve, report", file=sys.stderr)
+          f"sweep, ckpt-info, serve, report, lint", file=sys.stderr)
     return 2
 
 

@@ -1,0 +1,44 @@
+"""Static invariant linter for the kmeans_tpu package (ISSUE 10).
+
+Every rule here is a machine-checked version of an invariant that a
+human review pass has already had to enforce at least once in this
+repo's history: compile-cache keys missing a knob that changes the
+compiled program (two duplicate-compile findings in r13 alone), dead
+private helpers silently bypassed by every call site (`_serve_chunk`,
+r11), audit counters stale across fits (`checkpoint_segments_`, r9),
+and the thread/close discipline of the prefetch producer and the
+serving queue.  The analysis itself is pure stdlib ``ast`` +
+``tokenize``: it never imports or executes the modules it CHECKS, so
+linting triggers no device initialization and no side effects from the
+checked code, and accelerator-only files lint fine on any host.
+(Reaching it via ``python -m kmeans_tpu lint`` still imports the
+package like any other subcommand — jax must be installed, as for the
+rest of the CLI.)
+
+Public surface:
+
+* :func:`lint_paths` — run every rule over a set of files/directories,
+  returning a :class:`Report` (findings + suppression inventory).
+* :data:`RULES` — the rule registry (id -> rule instance).
+* ``python -m kmeans_tpu lint [--json] [paths]`` — the CLI
+  (:mod:`kmeans_tpu.analysis.cli`, re-exported as
+  ``kmeans_tpu.cli.lint_main``); exit 2 on findings.
+
+Suppression grammar (explicit and counted, never silent)::
+
+    some_flagged_line()   # lint: ok(rule-id) — short reason
+
+The comment must name the rule id and carry a non-empty reason after
+an em-dash or hyphen; it applies to its own line or, when written on
+its own line, to the next code line.  Malformed suppressions are
+themselves findings (rule ``suppression``), and the full suppression
+inventory is part of the ``--json`` report so count regressions are
+reviewable.
+"""
+
+from kmeans_tpu.analysis.core import (Finding, Package, Report,
+                                      Suppression, lint_paths)
+from kmeans_tpu.analysis.rules import RULES
+
+__all__ = ["Finding", "Package", "Report", "Suppression", "lint_paths",
+           "RULES"]

@@ -1,0 +1,658 @@
+"""The rule registry: one rule per hand-enforced incident class.
+
+Each rule is an ``ast``-level check with an ``id``, a one-line
+``incident`` citation (the historical review finding it mechanizes),
+and a ``run(package) -> [Finding]``.  Rules never import the modules
+they inspect.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+from typing import Dict, Iterator, List, Optional, Set
+
+from kmeans_tpu.analysis.core import Finding, Module, Package
+
+_BUILTINS = set(dir(builtins))
+
+
+# ------------------------------------------------------------ helpers
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain rooted at a Name, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _value_paths(node: ast.AST) -> Set[str]:
+    """Every maximal Name/Attribute dotted path loaded anywhere inside
+    ``node`` (including within calls/subscripts)."""
+    paths: Set[str] = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Attribute(self, n):
+            p = dotted(n)
+            if p is not None:
+                paths.add(p)
+            else:
+                self.generic_visit(n)
+
+        def visit_Name(self, n):
+            paths.add(n.id)
+
+    V().visit(node)
+    return paths
+
+
+def _bound_in(node: ast.AST) -> Set[str]:
+    """Names bound inside ``node``: lambda/def params, comprehension
+    targets, assignments, with/except/for targets."""
+    bound: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, (ast.Lambda, ast.FunctionDef,
+                          ast.AsyncFunctionDef)):
+            a = n.args
+            for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                        + ([a.vararg] if a.vararg else [])
+                        + ([a.kwarg] if a.kwarg else [])):
+                bound.add(arg.arg)
+        elif isinstance(n, ast.comprehension):
+            for t in ast.walk(n.target):
+                if isinstance(t, ast.Name):
+                    bound.add(t.id)
+        elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            bound.add(n.id)
+        elif isinstance(n, ast.ExceptHandler) and n.name:
+            bound.add(n.name)
+    return bound
+
+
+def _func_params(fn) -> Set[str]:
+    a = fn.args
+    return {arg.arg for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                                + ([a.vararg] if a.vararg else [])
+                                + ([a.kwarg] if a.kwarg else []))}
+
+
+class Rule:
+    id: str = ""
+    incident: str = ""
+
+    def run(self, pkg: Package) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, mod: Module, line: int, message: str) -> Finding:
+        return Finding(rule=self.id, path=mod.rel, line=line,
+                       message=message, incident=self.incident)
+
+
+# ------------------------------------------------------- trace-hazard
+
+#: lax control-flow entry points -> positions of the traced callables.
+_TRACED_ARGS = {
+    "scan": (0,), "while_loop": (0, 1), "fori_loop": (2,),
+    "cond": (1, 2), "associative_scan": (0,), "switch": None,  # 1.. all
+}
+#: Host-cast calls that force a traced value to Python (a trace-time
+#: error at best, a silent constant-fold at worst).
+_HOST_CASTS = {"float", "int", "bool"}
+_HOST_FUNCS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+               "onp.asarray", "onp.array", "jax.device_get"}
+
+
+class TraceHazardRule(Rule):
+    """Host-Python operations inside functions handed to ``lax.scan`` /
+    ``while_loop`` / ``fori_loop`` / ``cond`` in the compiled layers
+    (``parallel/``, ``ops/``): ``float()/int()/bool()`` casts,
+    ``.item()``, ``np.asarray``, Python ``while``, and ``if`` branches
+    whose test reads the traced function's own parameters (the carry /
+    chunk — always tracers inside the compiled body)."""
+
+    id = "trace-hazard"
+    incident = ("would recompile or fail under trace — the class the "
+                "host_loop=False device loops exist to forbid")
+
+    def run(self, pkg: Package) -> Iterator[Finding]:
+        for mod in pkg:
+            p = mod.rel.replace("\\", "/")
+            if "/parallel/" not in p and "/ops/" not in p:
+                continue
+            yield from self._check_module(mod)
+
+    def _traced_functions(self, mod: Module):
+        """(FunctionDef|Lambda) nodes passed to lax control flow."""
+        names: Set[str] = set()
+        lambdas: List[ast.Lambda] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            path = dotted(node.func)
+            if path is None:
+                continue
+            leaf = path.split(".")[-1]
+            if leaf not in _TRACED_ARGS:
+                continue
+            root = path.split(".")[0]
+            if root not in ("lax", "jax") and "lax" not in path:
+                continue
+            positions = _TRACED_ARGS[leaf]
+            args = node.args if positions is None \
+                else [node.args[i] for i in positions if i < len(node.args)]
+            for a in args:
+                if isinstance(a, ast.Name):
+                    names.add(a.id)
+                elif isinstance(a, ast.Lambda):
+                    lambdas.append(a)
+        fns = [n for n in ast.walk(mod.tree)
+               if isinstance(n, ast.FunctionDef) and n.name in names]
+        return fns, lambdas
+
+    def _check_module(self, mod: Module) -> Iterator[Finding]:
+        fns, lambdas = self._traced_functions(mod)
+        for fn in fns:
+            yield from self._check_body(mod, fn, fn.body, _func_params(fn))
+        for lam in lambdas:
+            yield from self._check_body(mod, lam, [lam.body],
+                                        _func_params(lam))
+
+    def _check_body(self, mod: Module, fn, body, params: Set[str]
+                    ) -> Iterator[Finding]:
+        """Scoped walk: a nested def/lambda inside a traced body is
+        traced too, so its params join the set — but only FOR ITS OWN
+        SUBTREE (a sibling's ``c`` must not taint the outer scope)."""
+        for stmt in body:
+            yield from self._check_node(mod, fn, stmt, params)
+
+    def _check_node(self, mod: Module, fn, node: ast.AST,
+                    params: Set[str]) -> Iterator[Finding]:
+        if isinstance(node, ast.FunctionDef):
+            inner = params | _func_params(node)
+            for child in node.body:
+                yield from self._check_node(mod, fn, child, inner)
+            return
+        if isinstance(node, ast.Lambda):
+            yield from self._check_node(
+                mod, fn, node.body, params | _func_params(node))
+            return
+        yield from self._flag_node(mod, fn, node, params)
+        for child in ast.iter_child_nodes(node):
+            yield from self._check_node(mod, fn, child, params)
+
+    def _flag_node(self, mod: Module, fn, node: ast.AST,
+                   params: Set[str]) -> Iterator[Finding]:
+        if isinstance(node, ast.Call):
+            path = dotted(node.func)
+            if path in _HOST_CASTS and node.args and not (
+                    isinstance(node.args[0], ast.Constant)
+                    or self._is_static(node.args[0])):
+                yield self.finding(
+                    mod, node.lineno,
+                    f"host cast {path}() on a value inside a "
+                    f"traced {type(fn).__name__} body")
+            elif path in _HOST_FUNCS:
+                yield self.finding(
+                    mod, node.lineno,
+                    f"{path}() materializes a traced value to "
+                    f"host inside a compiled loop body")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" \
+                    and not node.args:
+                yield self.finding(
+                    mod, node.lineno,
+                    ".item() forces a host sync inside a "
+                    "traced loop body")
+        elif isinstance(node, ast.While):
+            yield self.finding(
+                mod, node.lineno,
+                "Python while-loop inside a traced body (the "
+                "trip count must be lax control flow)")
+        elif isinstance(node, (ast.If, ast.IfExp)):
+            tainted = sorted(_value_paths(node.test) & params)
+            if tainted:
+                yield self.finding(
+                    mod, node.lineno,
+                    f"Python branch on traced parameter"
+                    f" {', '.join(tainted)!s} inside a traced "
+                    f"body (use lax.cond/jnp.where)")
+
+    @staticmethod
+    def _is_static(node: ast.AST) -> bool:
+        """Casts of shapes and lengths are static at trace time."""
+        for n in ast.walk(node):
+            if isinstance(n, ast.Attribute) and n.attr in ("shape",
+                                                           "ndim", "size"):
+                return True
+            if isinstance(n, ast.Call) and dotted(n.func) == "len":
+                return True
+        return False
+
+
+# ---------------------------------------------------------- cache-key
+
+class CacheKeyRule(Rule):
+    """Every ``*_CACHE.get_or_create(key, factory)`` call: each free
+    variable the factory closes over (a local knob of the enclosing
+    function — not a module global) must appear in the key tuple, else
+    two distinct knob values collide on one cache entry (wrong program
+    served) or salt-free twins duplicate-compile."""
+
+    id = "cache-key"
+    incident = ("r13 duplicate-compile class: predict_fn cached "
+                "pipeline-free; serving score_rows key missing "
+                "value_mode")
+
+    def run(self, pkg: Package) -> Iterator[Finding]:
+        for mod in pkg:
+            module_names = mod.module_scope_names()
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "get_or_create"):
+                    continue
+                base = dotted(node.func.value) or ""
+                if not base.split(".")[-1].endswith("_CACHE"):
+                    continue
+                if len(node.args) < 2:
+                    continue
+                yield from self._check_site(mod, node, module_names)
+
+    def _check_site(self, mod: Module, call: ast.Call,
+                    module_names: Set[str]) -> Iterator[Finding]:
+        key_expr = self._resolve_key(mod, call, call.args[0])
+        if key_expr is None:
+            yield self.finding(
+                mod, call.lineno,
+                "cache key is not a tuple literal resolvable in this "
+                "function — the key/knob audit cannot run")
+            return
+        key_paths = _value_paths(key_expr)
+        factory = call.args[1]
+        if isinstance(factory, ast.Lambda):
+            body, bound = factory.body, _bound_in(factory)
+        else:
+            body, bound = factory, _bound_in(factory)
+        knobs = self._free_knobs(body, bound, module_names)
+        missing = sorted(k for k in knobs
+                         if not self._covered(k, key_paths))
+        if missing:
+            yield self.finding(
+                mod, call.lineno,
+                f"factory closes over {', '.join(missing)} but the "
+                f"cache key does not include "
+                f"{'it' if len(missing) == 1 else 'them'} — distinct "
+                f"values would collide on one compiled entry")
+
+    @staticmethod
+    def _resolve_key(mod: Module, call: ast.Call,
+                     key: ast.AST) -> Optional[ast.AST]:
+        """A tuple/constant key is used directly; a ``key`` variable is
+        chased to its nearest preceding tuple assignment in the same
+        function."""
+        if isinstance(key, (ast.Tuple, ast.Constant)):
+            return key
+        if not isinstance(key, ast.Name):
+            return None
+        fn = mod.enclosing(call, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda))
+        if fn is None or isinstance(fn, ast.Lambda):
+            return None
+        best = None
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == key.id
+                    and node.lineno <= call.lineno):
+                if best is None or node.lineno > best.lineno:
+                    best = node
+        if best is not None and isinstance(best.value, (ast.Tuple,
+                                                        ast.Constant)):
+            return best.value
+        return None
+
+    @staticmethod
+    def _free_knobs(body: ast.AST, bound: Set[str],
+                    module_names: Set[str]) -> Set[str]:
+        """Dotted paths in the factory whose root is neither bound in
+        the factory, a module-scope name, nor a builtin — i.e. the
+        closure's captured locals: the knobs."""
+        knobs: Set[str] = set()
+        for path in _value_paths(body):
+            root = path.split(".")[0]
+            if root in bound or root in module_names \
+                    or root in _BUILTINS:
+                continue
+            knobs.add(path)
+        return knobs
+
+    @staticmethod
+    def _covered(knob: str, key_paths: Set[str]) -> bool:
+        """A knob is covered when the key carries it or any prefix of
+        it (keying on ``self.mesh`` covers ``self.mesh.devices``)."""
+        parts = knob.split(".")
+        return any(".".join(parts[:i]) in key_paths
+                   for i in range(1, len(parts) + 1))
+
+
+# ----------------------------------------------------------- dispatch
+
+class DispatchAccountingRule(Rule):
+    """In ``serving/`` and ``parallel/``: a function that *calls* a
+    compiled function (obtained from a ``*_CACHE.get_or_create`` /
+    ``_get_step_fns`` / ``_get_fns`` / ``_predict_fn``) must account
+    the dispatch — ``note_dispatch(...)``, ``._record(...)``, or a
+    ``dispatches`` counter update — so dispatch-count pins and serving
+    stats stay honest as call sites are added."""
+
+    id = "dispatch"
+    incident = ("the O(1)-dispatch pins (ISSUE 2/7) and serving stats "
+                "only hold if every compiled call site is tagged")
+
+    _SOURCES = {"get_or_create", "_get_step_fns", "_get_fns",
+                "_predict_fn"}
+
+    def run(self, pkg: Package) -> Iterator[Finding]:
+        for mod in pkg:
+            p = mod.rel.replace("\\", "/")
+            if "/serving/" not in p and "/parallel/" not in p:
+                continue
+            for fn in ast.walk(mod.tree):
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield from self._check_function(mod, fn)
+
+    def _is_source_call(self, node: ast.AST) -> bool:
+        """Does this expression produce a compiled function?"""
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                path = dotted(n.func) or ""
+                if path.split(".")[-1] in self._SOURCES:
+                    return True
+        return False
+
+    def _check_function(self, mod: Module, fn) -> Iterator[Finding]:
+        # Skip nested defs (the compiled bodies themselves) — only
+        # driver-level functions dispatch.
+        compiled_names: Set[str] = set()
+        call_sites: List[ast.Call] = []
+        accounted = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and self._is_source_call(node.value):
+                for t in node.targets:
+                    for leaf in ast.walk(t):
+                        if isinstance(leaf, ast.Name):
+                            compiled_names.add(leaf.id)
+            if isinstance(node, ast.Call):
+                path = dotted(node.func) or ""
+                leaf = path.split(".")[-1]
+                if leaf == "note_dispatch" or leaf == "_record":
+                    accounted = True
+                # direct invoke:  self._predict_fn(...)(...) or
+                # CACHE.get_or_create(...)(...)
+                if isinstance(node.func, (ast.Call, ast.Subscript)) \
+                        and self._is_source_call(node.func):
+                    call_sites.append(node)
+                elif isinstance(node.func, ast.Name) \
+                        and node.func.id in compiled_names:
+                    call_sites.append(node)
+            if isinstance(node, (ast.AugAssign, ast.Assign)):
+                target = node.target if isinstance(node, ast.AugAssign) \
+                    else (node.targets[0] if node.targets else None)
+                if target is not None and "dispatch" in (
+                        dotted(target) or "").lower():
+                    accounted = True
+        # Functions that only BUILD and return the compiled fn (no
+        # invocation) are accounted at their call sites instead.
+        if call_sites and not accounted:
+            yield self.finding(
+                mod, call_sites[0].lineno,
+                f"{fn.name}() invokes a compiled function but never "
+                f"tags the dispatch (note_dispatch/._record/dispatch "
+                f"counter)")
+
+
+# ------------------------------------------------------------ threads
+
+class ThreadHygieneRule(Rule):
+    """Every ``threading.Thread`` the package creates must have a join
+    on an owner close path: stored on ``self.x`` — some method of the
+    class joins ``self.x``; a local — joined in the same function."""
+
+    id = "thread"
+    incident = ("prefetch producer / serving queue discipline: an "
+                "unjoined worker outlives close() and races teardown")
+
+    def run(self, pkg: Package) -> Iterator[Finding]:
+        for mod in pkg:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) and (
+                        dotted(node.func) in ("threading.Thread",
+                                              "Thread")):
+                    yield from self._check_site(mod, node)
+
+    def _check_site(self, mod: Module, call: ast.Call) -> Iterator[Finding]:
+        parent = mod.parents().get(call)
+        target: Optional[str] = None
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+            target = dotted(parent.targets[0])
+        if target is None:
+            yield self.finding(
+                mod, call.lineno,
+                "Thread created without binding it — nothing can ever "
+                "join it")
+            return
+        if target.startswith("self."):
+            cls = mod.enclosing(call, (ast.ClassDef,))
+            if cls is None or not self._class_joins(cls, target):
+                yield self.finding(
+                    mod, call.lineno,
+                    f"Thread stored on {target} but no method of the "
+                    f"owning class joins it (close()/stop()/__exit__)")
+        else:
+            fn = mod.enclosing(call, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+            if fn is None or not self._scope_joins(fn, target):
+                yield self.finding(
+                    mod, call.lineno,
+                    f"Thread bound to local {target!r} but this "
+                    f"function never joins it")
+
+    @staticmethod
+    def _class_joins(cls: ast.ClassDef, target: str) -> bool:
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "join" \
+                    and (dotted(node.func.value) or "") == target:
+                return True
+        return False
+
+    @staticmethod
+    def _scope_joins(fn, target: str) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "join" \
+                    and (dotted(node.func.value) or "") == target:
+                return True
+        return False
+
+
+# ------------------------------------------------------ counter-reset
+
+class CounterResetRule(Rule):
+    """Classes with a ``fit`` method: every trailing-underscore
+    (fitted/audit) attribute any method assigns must be declared in the
+    init/reset region — ``__init__`` or a ``*reset*`` method of the
+    class or an in-package ancestor — so a read before (or after a
+    differently-pathed) fit sees a defined, deliberately-chosen value
+    instead of a stale one."""
+
+    id = "counter-reset"
+    incident = ("r9 stale-audit class: checkpoint_segments_ survived "
+                "into fits that never set it")
+
+    def run(self, pkg: Package) -> Iterator[Finding]:
+        # EVERY class body is visited, including same-named classes in
+        # different modules (a coverage gate must not drop a class to a
+        # name collision); the by-name map is only for base resolution,
+        # where the first definition wins (ambiguous bases are rare and
+        # resolve conservatively — extra declared attrs, never fewer
+        # checks on the class itself).
+        all_classes: List[tuple] = []               # (Module, ClassDef)
+        classes: Dict[str, ast.ClassDef] = {}
+        for mod in pkg:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef):
+                    all_classes.append((mod, node))
+                    classes.setdefault(node.name, node)
+        for mod, cls in all_classes:
+            if not any(isinstance(n, ast.FunctionDef) and n.name == "fit"
+                       for n in cls.body):
+                continue
+            declared = self._declared_attrs(cls, classes)
+            for method in cls.body:
+                if not isinstance(method, ast.FunctionDef):
+                    continue
+                if self._is_reset_region(method.name):
+                    continue
+                for line, attr in self._stored_attrs(method):
+                    if attr not in declared:
+                        yield self.finding(
+                            mod, line,
+                            f"{cls.name}.{method.name} assigns audit attr "
+                            f"self.{attr} never declared in __init__ "
+                            f"or a *reset* method — stale across fits "
+                            f"and undefined before the first")
+
+    @staticmethod
+    def _is_reset_region(method_name: str) -> bool:
+        return method_name == "__init__" or "reset" in method_name
+
+    def _declared_attrs(self, cls: ast.ClassDef,
+                        classes: Dict[str, ast.ClassDef],
+                        seen: Optional[Set[str]] = None) -> Set[str]:
+        seen = seen if seen is not None else set()
+        if cls.name in seen:
+            return set()
+        seen.add(cls.name)
+        declared: Set[str] = set()
+        for method in cls.body:
+            if isinstance(method, ast.FunctionDef) \
+                    and self._is_reset_region(method.name):
+                declared.update(a for _, a in self._stored_attrs(method))
+        for base in cls.bases:
+            base_name = (dotted(base) or "").split(".")[-1]
+            if base_name in classes:
+                declared.update(self._declared_attrs(
+                    classes[base_name], classes, seen))
+        return declared
+
+    @staticmethod
+    def _stored_attrs(method: ast.FunctionDef):
+        """(line, attr) for every ``self.x_ = ...`` in the method."""
+        for node in ast.walk(method):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                targets = [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self" \
+                        and t.attr.endswith("_") \
+                        and not t.attr.endswith("__") \
+                        and not t.attr.startswith("_"):
+                    yield node.lineno, t.attr
+
+
+# ------------------------------------------------------- dead-private
+
+class DeadPrivateRule(Rule):
+    """Module-level private functions and class-level private methods
+    with zero references anywhere in the linted tree: dead code that
+    every call site silently bypassed."""
+
+    id = "dead-private"
+    incident = ("r11 `_serve_chunk` class: a private helper all call "
+                "sites bypassed")
+
+    def run(self, pkg: Package) -> Iterator[Finding]:
+        defs = []      # (mod, node, qualifier)
+        refs: Dict[str, int] = {}
+        for mod in pkg:
+            parents = mod.parents()
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    name = node.name
+                    parent = parents.get(node)
+                    # Only module-level defs and class methods: a
+                    # nested closure is used where it is defined.
+                    if isinstance(parent, (ast.Module, ast.ClassDef)) \
+                            and name.startswith("_") \
+                            and not name.startswith("__"):
+                        defs.append((mod, node))
+                if isinstance(node, ast.Name):
+                    refs[node.id] = refs.get(node.id, 0) + 1
+                elif isinstance(node, ast.Attribute):
+                    refs[node.attr] = refs.get(node.attr, 0) + 1
+                elif isinstance(node, ast.Call):
+                    # getattr(self, "_x") / monkeypatch.setattr-style
+                    # string references — call arguments only, so a
+                    # docstring merely MENTIONING a helper never keeps
+                    # it alive.
+                    for arg in list(node.args) + [k.value
+                                                  for k in node.keywords]:
+                        for c in ast.walk(arg):
+                            if isinstance(c, ast.Constant) \
+                                    and isinstance(c.value, str):
+                                refs[c.value] = refs.get(c.value, 0) + 1
+        for mod, node in defs:
+            if refs.get(node.name, 0) == 0:
+                yield self.finding(
+                    mod, node.lineno,
+                    f"private helper {node.name}() has zero references "
+                    f"in the linted tree — every call site bypasses it")
+
+
+# -------------------------------------------------------- suppression
+
+class SuppressionFormatRule(Rule):
+    """Malformed suppression comments (missing rule list or reason)
+    and suppressions naming unknown rule ids are findings — a
+    suppression must be auditable, never a silent typo."""
+
+    id = "suppression"
+    incident = ("suppressions are explicit and counted, never silent "
+                "(ISSUE 10 contract)")
+
+    def run(self, pkg: Package) -> Iterator[Finding]:
+        known = set(RULES)
+        for mod in pkg:
+            for line, comment in mod.malformed_suppressions:
+                yield self.finding(
+                    mod, line,
+                    f"malformed lint suppression {comment!r} — use "
+                    f"'# lint: ok(rule-id) — reason'")
+            for sup in mod.suppressions.values():
+                bad = [r for r in sup.rules if r not in known]
+                if bad:
+                    yield self.finding(
+                        mod, sup.line,
+                        f"suppression names unknown rule id"
+                        f" {', '.join(bad)} (known: {sorted(known)})")
+
+
+RULES: Dict[str, Rule] = {rule.id: rule for rule in (
+    TraceHazardRule(), CacheKeyRule(), DispatchAccountingRule(),
+    ThreadHygieneRule(), CounterResetRule(), DeadPrivateRule(),
+    SuppressionFormatRule(),
+)}

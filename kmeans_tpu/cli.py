@@ -365,6 +365,18 @@ def serve_main(argv=None) -> int:
     return 0
 
 
+def lint_main(argv=None) -> int:
+    """``python -m kmeans_tpu lint [--json] [paths]`` — the package's
+    AST invariant linter (ISSUE 10; one rule per historical incident
+    class, docs/ANALYSIS.md).  Thin delegator: the implementation lives
+    in :mod:`kmeans_tpu.analysis.cli`; the analysis never imports or
+    executes the modules it checks, so linting triggers no device
+    initialization.  Exit 0 clean, 2 on findings or a malformed
+    path."""
+    from kmeans_tpu.analysis.cli import main
+    return main(argv)
+
+
 def ckpt_info_main(argv=None) -> int:
     """``python -m kmeans_tpu ckpt-info <path>`` — print a checkpoint's
     metadata block (model class, k, completed iteration, the mesh shape

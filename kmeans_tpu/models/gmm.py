@@ -133,7 +133,7 @@ def _get_fns(mesh: Mesh, chunk: int, cov_type: str = "diag",
              pipeline: int = 1):
     step_b, pred_b = _STEP_BUILDERS[cov_type]
     return _STEP_CACHE.get_or_create(
-        (mesh, chunk, "gmm", step_b, pipeline),
+        (mesh, chunk, "gmm", step_b, pred_b, pipeline),
         lambda: (step_b(mesh, chunk_size=chunk, pipeline=pipeline),
                  pred_b(mesh, chunk_size=chunk)))
 
@@ -252,6 +252,14 @@ class GaussianMixture(AutoCheckpointMixin):
         self.converged_: bool = False
         self.n_iter_: int = 0
         self.lower_bound_: float = -np.inf
+        # Centering shift of the last fit's dataset frame, the winning
+        # restart index, and the per-restart final lower bounds —
+        # declared here (the counter-reset lint discipline) so a read
+        # before the first fit is a defined None/0, never an
+        # AttributeError or a stale survivor from an earlier fit.
+        self.shift_: Optional[np.ndarray] = None
+        self.best_restart_: int = 0
+        self.restart_lower_bounds_: Optional[np.ndarray] = None
         # Fault-tolerance observability (ISSUE 4), mirroring KMeans'.
         self.io_retries_used_: int = 0
         self.blocks_skipped_: int = 0
@@ -1687,7 +1695,11 @@ class GaussianMixture(AutoCheckpointMixin):
         builder = {"diag": make_gmm_fit_fn, "spherical": make_gmm_fit_fn,
                    "tied": make_gmm_fit_tied_fn,
                    "full": make_gmm_fit_full_fn}[ct]
-        kwargs = {"cov_type": ct} if ct in ("diag", "spherical") else {}
+        # Hashable kwargs form, so the dispatch key below can carry the
+        # builder's full static config (cache-key completeness).
+        kw_items = tuple(sorted(
+            ({"cov_type": ct} if ct in ("diag", "spherical")
+             else {}).items()))
         chunk = self._eff_chunk(ds)
         pipeline = self._note_estep_path()
         k = self.n_components
@@ -1772,11 +1784,12 @@ class GaussianMixture(AutoCheckpointMixin):
             # from this boundary (== the last checkpoint, ISSUE 5).
             def dispatch(c, _seg=seg, _tables=tables, _prev=prev):
                 key = (mesh, c, k, _seg, float(self.tol),
-                       float(self.reg_covar), ct, pipeline, "gmmfit")
+                       float(self.reg_covar), ct, pipeline, builder,
+                       kw_items, "gmmfit")
                 fit_fn = _STEP_CACHE.get_or_create(key, lambda: builder(
                     mesh, chunk_size=c, k_real=k, max_iter=_seg,
                     tol=float(self.tol), reg_covar=float(self.reg_covar),
-                    pipeline=pipeline, **kwargs))
+                    pipeline=pipeline, **dict(kw_items)))
                 return fit_fn(ds.points, ds.weights, shift_dev,
                               *_tables, np.asarray(_prev, acc))
 

@@ -363,6 +363,12 @@ class KMeans(AutoCheckpointMixin):
         self.sse_history: List[float] = []            # kmeans_spark.py:45
         self.cluster_sizes_: Optional[np.ndarray] = None
         self.iter_times_: List[float] = []            # wall secs/iteration
+        # Restart-sweep observability: winning restart index and the
+        # per-restart final inertias — declared here (the counter-reset
+        # lint discipline) so a pre-fit read is a defined 0/None, never
+        # an AttributeError or a stale survivor from an earlier fit.
+        self.best_restart_: int = 0
+        self.restart_inertias_: Optional[np.ndarray] = None
         self._fit_ds = None                           # retained for labels_
         self._labels_cache: Optional[np.ndarray] = None
         validate_params(k, max_iter, tolerance)       # kmeans_spark.py:46
@@ -736,6 +742,9 @@ class KMeans(AutoCheckpointMixin):
         # compile+2 dispatches are pure warmup; only a switch discards
         # them (once per shape key) — accepted, the 25% rule needs a
         # measured denominator.
+        # lint: ok(cache-key) — measurement cache: a miss only re-measures
+        # one step, it can never serve a wrong compiled program (the key
+        # spans every static the probe reads).
         step_total = _AUTO_CACHE.get_or_create(key, measure_step)
         frac = rtt / max(step_total, 1e-12)
         if frac <= 0.25:
@@ -1730,11 +1739,14 @@ class KMeans(AutoCheckpointMixin):
                float(self.tolerance), self.empty_cluster,
                self.compute_sse, self._device_project, pipeline,
                "sweepfit")
+        # n_init is written as len(member_ks) so the key's coverage of
+        # every builder knob is self-evident (member_ks is in the key;
+        # R is the same value).
         fit_fn = _STEP_CACHE.get_or_create(
             key, lambda: dist.make_multi_fit_fn(
                 mesh, chunk_size=chunk, mode=mode, k_real=k_max,
                 max_iter=self.max_iter, tolerance=float(self.tolerance),
-                empty_policy=self.empty_cluster, n_init=R,
+                empty_policy=self.empty_cluster, n_init=len(member_ks),
                 history_sse=self.compute_sse,
                 project=self._device_project, k_reals=member_ks,
                 return_all=True, pipeline=pipeline))

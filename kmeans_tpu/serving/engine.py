@@ -61,6 +61,7 @@ from kmeans_tpu.serving.batching import (DEFAULT_BUCKETS, MicroBatchQueue,
                                          ServingFuture, bucket_for,
                                          check_buckets)
 from kmeans_tpu.serving.registry import ModelRegistry
+from kmeans_tpu.utils.profiling import note_dispatch
 
 __all__ = ["ServingEngine", "ResidentModel"]
 
@@ -391,7 +392,6 @@ class ServingEngine:
             # SHARED f32 predict program.  Tagged distinctly so
             # dispatch-count pins can tell guard traffic from serving
             # traffic (ISSUE 8 satellite).
-            from kmeans_tpu.utils.profiling import note_dispatch
             note_dispatch("bf16-guard-fix")
             sub = np.ascontiguousarray(buf[near])
             sub_buf, n_sub, B_sub = self._stage(rm, sub)
@@ -599,6 +599,10 @@ class ServingEngine:
         lab_q, corrected = self._assign_bf16_guarded(
             rm, buf, pts, cents_dev, chunk, m)
         f32_mode = rm.model._mode(B, rm.spec["d"])
+        # Probe traffic is tagged under its own label so dispatch-count
+        # pins can tell verification from serving (dispatch-accounting
+        # lint: every compiled call site routes through note_dispatch).
+        note_dispatch("verify-quantized/f32-oracle")
         lab_f = np.asarray(self._predict_fn(chunk, f32_mode)(
             shard_points(buf, self.mesh, chunk)[0], cents_dev,
             np.int32(m)))[:m]
@@ -608,6 +612,7 @@ class ServingEngine:
                 (self.mesh, chunk, tmode, "transform"),
                 lambda: dist.make_transform_fn(
                     self.mesh, chunk_size=chunk, mode=tmode))
+            note_dispatch("verify-quantized/transform")
             return np.asarray(tfn(
                 shard_points(buf, self.mesh, chunk)[0],
                 cents_dev))[:m, : rm.spec["k"]]

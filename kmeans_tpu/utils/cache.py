@@ -59,5 +59,11 @@ class LRUCache:
     def __len__(self) -> int:
         return len(self._d)
 
+    def keys(self):
+        """Snapshot of the current keys (insertion/recency order) —
+        what ``profiling.recompilation_sentinel`` diffs to assert that
+        repeat same-shape calls add zero compiled entries."""
+        return list(self._d.keys())
+
     def clear(self) -> None:
         self._d.clear()

@@ -219,6 +219,99 @@ def sanitize_json(obj):
     return obj
 
 
+# ---------------------------------------------- recompilation sentinel
+# Runtime twin of the static cache-key lint rule (ISSUE 10): the linter
+# proves every cache key SPANS its builder's knobs; the sentinel proves
+# a warmed path actually REUSES its compiled entries.  It generalizes
+# the r11 "zero new cache entries across repeat same-shape serving
+# calls" one-off into a reusable guard: snapshot every package
+# compile-cache's keys, run the body, and fail loudly on growth.
+
+#: Modules force-imported before cache discovery, so the sentinel sees
+#: every package compile cache even when the caller imported none of
+#: them directly.  Discovery itself is dynamic (any LRUCache module
+#: attribute in any loaded kmeans_tpu module), so a future cache is
+#: covered the moment its module loads.
+_CACHE_MODULES = (
+    "kmeans_tpu.models.kmeans",      # _STEP_CACHE, _AUTO_CACHE
+    "kmeans_tpu.models.gmm",         # _STEP_CACHE (EM families)
+    "kmeans_tpu.models.init",        # _PIPE_CACHE (kmeans|| pipeline)
+)
+
+
+class RecompilationError(AssertionError):
+    """A compile cache grew inside a ``recompilation_sentinel`` scope:
+    some call path re-keyed (and re-compiled) a program the warm path
+    should have reused — the r13 duplicate-compile class at runtime."""
+
+
+def compile_caches() -> dict:
+    """Every module-level :class:`~kmeans_tpu.utils.cache.LRUCache` in
+    the loaded package, as ``{'module.attr': cache}`` (deduplicated by
+    object identity — re-exports keep their defining name)."""
+    import importlib
+    import sys
+
+    from kmeans_tpu.utils.cache import LRUCache
+
+    for name in _CACHE_MODULES:
+        importlib.import_module(name)
+    out = {}
+    seen_ids = set()
+    for name in sorted(n for n in sys.modules
+                       if n.startswith("kmeans_tpu")):
+        mod = sys.modules.get(name)
+        if mod is None:
+            continue
+        for attr, val in sorted(vars(mod).items()):
+            if isinstance(val, LRUCache) and id(val) not in seen_ids:
+                seen_ids.add(id(val))
+                out[f"{name}.{attr}"] = val
+    return out
+
+
+@contextlib.contextmanager
+def recompilation_sentinel(allowed_new: int = 0):
+    """Assert zero compile-cache growth across the ``with`` body.
+
+    Usage (the repeat-same-shape serving/predict guard)::
+
+        model.predict(X)                     # warm the caches
+        with recompilation_sentinel():
+            model.predict(X)                 # must reuse every entry
+            model.predict(X)
+
+    Yields a dict record; on exit ``record['new']`` maps cache names to
+    the keys added inside the scope (empty on the healthy path) and
+    ``record['caches']`` names every cache watched.  More than
+    ``allowed_new`` total new entries raises :class:`RecompilationError`
+    naming each offending cache and key — the message is the debugging
+    artifact, so it carries the actual keys, not just counts.
+    """
+    caches = compile_caches()
+    before = {name: set(c.keys()) for name, c in caches.items()}
+    record = {"new": {}, "caches": sorted(caches)}
+    yield record
+    new = {}
+    total = 0
+    for name, cache in caches.items():
+        added = [k for k in cache.keys() if k not in before[name]]
+        if added:
+            new[name] = added
+            total += len(added)
+    record["new"] = new
+    if total > allowed_new:
+        lines = [f"  {name}: +{len(keys)} entries:" + "".join(
+            f"\n    {repr(k)[:120]}" for k in keys)
+            for name, keys in sorted(new.items())]
+        raise RecompilationError(
+            f"{total} new compile-cache entr"
+            f"{'y' if total == 1 else 'ies'} inside a "
+            f"recompilation_sentinel scope (allowed {allowed_new}) — a "
+            f"warm same-shape path re-keyed a compiled program:\n"
+            + "\n".join(lines))
+
+
 def timed_call(fn, *args, warmup: int = 1, iters: int = 3):
     """(mean_seconds, last_result) of fn(*args), excluding warmup runs."""
     result = None

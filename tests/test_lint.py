@@ -1,0 +1,790 @@
+"""ISSUE 10: the invariant linter and the recompilation sentinel.
+
+Three tiers of coverage:
+
+1. **Fixture pairs per rule** — a minimal snippet every rule fires on
+   and a corrected twin it stays silent on, so each rule is proven by
+   construction (the issue contract: "every rule proven by a firing
+   fixture test").
+2. **Package-wide self-test** — the linter runs over the real shipped
+   tree and must be clean (exit-0 contract of
+   ``python -m kmeans_tpu lint kmeans_tpu/``), with every suppression
+   explicit (reason-bearing) and counted.
+3. **Recompilation sentinel** — the runtime twin: unit semantics
+   (growth raises, naming the cache and key) plus the tier-1 guard
+   that repeat same-shape predict/serve calls across the five model
+   families add ZERO compile-cache entries (the r11 pinned property,
+   generalized into a reusable context manager).
+"""
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import kmeans_tpu
+from kmeans_tpu.analysis import RULES, lint_paths
+from kmeans_tpu.analysis.cli import main as lint_main
+
+PKG_DIR = Path(kmeans_tpu.__file__).parent
+
+
+def run_on(tmp_path, source, subdir="parallel", name="mod.py",
+           rules=None):
+    """Lint one snippet placed under ``tmp_path/<subdir>/`` (the
+    path-scoped rules key on ``parallel``/``ops``/``serving`` path
+    segments) and return the findings list."""
+    d = tmp_path / subdir
+    d.mkdir(parents=True, exist_ok=True)
+    f = d / name
+    f.write_text(source)
+    return lint_paths([f], rules=rules).findings
+
+
+def rule_ids(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# Rule registry surface
+# ---------------------------------------------------------------------------
+
+def test_registry_has_the_required_rules():
+    """The six incident-class rules (plus the suppression-format
+    meta-rule) are registered — the >= 6 acceptance bar."""
+    assert {"trace-hazard", "cache-key", "dispatch", "thread",
+            "counter-reset", "dead-private"} <= set(RULES)
+    assert len(RULES) >= 6
+    for rule in RULES.values():
+        assert rule.id and rule.incident, rule
+
+
+# ---------------------------------------------------------------------------
+# trace-hazard
+# ---------------------------------------------------------------------------
+
+_TRACE_BAD = """
+from jax import lax
+
+
+def make_step():
+    def body(carry, chunk):
+        v = float(carry)              # host cast of a tracer
+        if chunk > 0:                 # Python branch on traced arg
+            v = v + 1
+        import numpy as np
+        a = np.asarray(carry)         # host materialization
+        b = carry.item()              # host sync
+        while v > 0:                  # Python loop in traced body
+            v -= 1
+        return carry, v
+    return lax.scan(body, 0.0, None)
+"""
+
+_TRACE_OK = """
+import jax.numpy as jnp
+from jax import lax
+
+
+def make_step(mode):
+    def body(carry, chunk):
+        if mode == "fast":            # branch on a STATIC closure knob
+            step = carry + 2.0
+        else:
+            step = carry + 1.0
+        n = int(chunk.shape[0])       # shape cast: static at trace time
+        return step, jnp.where(chunk > 0, step, carry)
+    return lax.scan(body, 0.0, None)
+"""
+
+
+def test_trace_hazard_fires(tmp_path):
+    findings = [f for f in run_on(tmp_path, _TRACE_BAD)
+                if f.rule == "trace-hazard"]
+    messages = " | ".join(f.message for f in findings)
+    assert "float()" in messages
+    assert "branch on traced parameter" in messages
+    assert "np.asarray" in messages
+    assert ".item()" in messages
+    assert "while-loop" in messages
+    assert len(findings) == 5
+
+
+def test_trace_hazard_silent_on_static_branches_and_shape_casts(tmp_path):
+    findings = run_on(tmp_path, _TRACE_OK)
+    assert [f for f in findings if f.rule == "trace-hazard"] == []
+
+
+def test_trace_hazard_scoped_to_compiled_layers(tmp_path):
+    """The same hazard OUTSIDE parallel//ops/ is not this rule's
+    business (models' host loops legitimately cast device scalars)."""
+    findings = run_on(tmp_path, _TRACE_BAD, subdir="models")
+    assert [f for f in findings if f.rule == "trace-hazard"] == []
+
+
+def test_trace_hazard_sibling_scope_does_not_leak_params(tmp_path):
+    """A nested def's params are traced only for ITS OWN subtree — a
+    later branch on a same-named STATIC closure variable at the outer
+    level must stay silent (review finding on this PR)."""
+    src = """
+from jax import lax
+
+
+def make(c):                          # static builder knob named 'c'
+    def body(carry, chunk):
+        def inner(c, x):              # nested traced fn, param 'c'
+            return c + x
+        out = inner(carry, chunk)
+        if c == "fast":               # outer 'c' is the STATIC knob
+            out = out * 2
+        return out, out
+    return lax.scan(body, 0.0, None)
+"""
+    findings = run_on(tmp_path, src)
+    assert [f for f in findings if f.rule == "trace-hazard"] == []
+
+
+def test_trace_hazard_while_loop_body_and_lambda(tmp_path):
+    src = """
+from jax import lax
+
+
+def run(x0):
+    def cond(state):
+        return bool(state[0])         # host cast in while cond
+
+    def body(state):
+        return (state[0] - 1, state[1])
+
+    return lax.while_loop(cond, body, x0)
+
+
+def run2(x0):
+    return lax.fori_loop(0, 3, lambda i, c: c + float(c), x0)
+"""
+    findings = [f for f in run_on(tmp_path, src)
+                if f.rule == "trace-hazard"]
+    assert len(findings) == 2         # bool() in cond, float() in lambda
+
+
+# ---------------------------------------------------------------------------
+# cache-key
+# ---------------------------------------------------------------------------
+
+_CACHEKEY_BAD = """
+from kmeans_tpu.utils.cache import LRUCache
+
+_STEP_CACHE = LRUCache(8)
+
+
+def get_fn(mesh, chunk, mode, build):
+    return _STEP_CACHE.get_or_create(
+        (mesh, chunk),
+        lambda: build(mesh, chunk_size=chunk, mode=mode))
+"""
+
+_CACHEKEY_OK = """
+from kmeans_tpu.utils.cache import LRUCache
+
+_STEP_CACHE = LRUCache(8)
+
+
+def get_fn(mesh, chunk, mode, build):
+    return _STEP_CACHE.get_or_create(
+        (mesh, chunk, mode, build, "salt"),
+        lambda: build(mesh, chunk_size=chunk, mode=mode))
+"""
+
+
+def test_cache_key_fires_on_missing_knob(tmp_path):
+    findings = [f for f in run_on(tmp_path, _CACHEKEY_BAD,
+                                  subdir="models")
+                if f.rule == "cache-key"]
+    assert len(findings) == 1
+    assert "mode" in findings[0].message
+    assert "build" in findings[0].message
+
+
+def test_cache_key_silent_when_key_spans_knobs(tmp_path):
+    findings = run_on(tmp_path, _CACHEKEY_OK, subdir="models")
+    assert [f for f in findings if f.rule == "cache-key"] == []
+
+
+def test_cache_key_resolves_key_variable_and_attr_prefix(tmp_path):
+    """A ``key = (...)`` variable is chased to its tuple; keying on
+    ``self.mesh`` covers deeper reads like ``self.mesh.devices``."""
+    src = """
+from kmeans_tpu.utils.cache import LRUCache
+
+_C_CACHE = LRUCache(8)
+
+
+class M:
+    def fn(self, chunk, build):
+        key = (self.mesh, chunk, build, "predict")
+        return _C_CACHE.get_or_create(
+            key, lambda: build(self.mesh.devices, chunk))
+"""
+    findings = run_on(tmp_path, src, subdir="models")
+    assert [f for f in findings if f.rule == "cache-key"] == []
+
+
+def test_cache_key_flags_unresolvable_key(tmp_path):
+    src = """
+from kmeans_tpu.utils.cache import LRUCache
+
+_C_CACHE = LRUCache(8)
+
+
+def fn(key, build):
+    return _C_CACHE.get_or_create(key, lambda: build())
+"""
+    findings = [f for f in run_on(tmp_path, src, subdir="models")
+                if f.rule == "cache-key"]
+    assert len(findings) == 1
+    assert "not a tuple literal" in findings[0].message
+
+
+def test_cache_key_ignores_function_local_imports(tmp_path):
+    """An ``import ... as dist`` inside the function is a static module
+    reference, never a knob (the minibatch.py false-positive class)."""
+    src = """
+from kmeans_tpu.utils.cache import LRUCache
+
+_C_CACHE = LRUCache(8)
+
+
+def fn(mesh, chunk):
+    from kmeans_tpu.parallel import distributed as dist
+    return _C_CACHE.get_or_create(
+        (mesh, chunk), lambda: dist.make_step_fn(mesh, chunk_size=chunk))
+"""
+    findings = run_on(tmp_path, src, subdir="models")
+    assert [f for f in findings if f.rule == "cache-key"] == []
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+_DISPATCH_BAD = """
+from kmeans_tpu.utils.cache import LRUCache
+
+_STEP_CACHE = LRUCache(8)
+
+
+def serve(pts, mesh, chunk, build):
+    fn = _STEP_CACHE.get_or_create((mesh, chunk), lambda: build(mesh))
+    return fn(pts)
+"""
+
+_DISPATCH_OK = """
+from kmeans_tpu.utils.cache import LRUCache
+from kmeans_tpu.utils.profiling import note_dispatch
+
+_STEP_CACHE = LRUCache(8)
+
+
+def serve(pts, mesh, chunk, build):
+    fn = _STEP_CACHE.get_or_create((mesh, chunk), lambda: build(mesh))
+    note_dispatch("serve/predict")
+    return fn(pts)
+"""
+
+
+def test_dispatch_fires_on_untagged_compiled_call(tmp_path):
+    findings = [f for f in run_on(tmp_path, _DISPATCH_BAD,
+                                  subdir="serving")
+                if f.rule == "dispatch"]
+    assert len(findings) == 1
+    assert "serve()" in findings[0].message
+
+
+def test_dispatch_silent_when_tagged(tmp_path):
+    findings = run_on(tmp_path, _DISPATCH_OK, subdir="serving")
+    assert [f for f in findings if f.rule == "dispatch"] == []
+
+
+def test_dispatch_builders_that_only_return_are_exempt(tmp_path):
+    """A function that builds-and-returns the compiled fn (no invoke)
+    is accounted at its call sites, not at the build site."""
+    src = """
+from kmeans_tpu.utils.cache import LRUCache
+
+_STEP_CACHE = LRUCache(8)
+
+
+def get_fn(mesh, chunk, build):
+    return _STEP_CACHE.get_or_create((mesh, chunk), lambda: build(mesh))
+"""
+    findings = run_on(tmp_path, src, subdir="serving")
+    assert [f for f in findings if f.rule == "dispatch"] == []
+
+
+# ---------------------------------------------------------------------------
+# thread
+# ---------------------------------------------------------------------------
+
+_THREAD_BAD = """
+import threading
+
+
+class Prefetcher:
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        pass                              # never joins
+"""
+
+_THREAD_OK = """
+import threading
+
+
+class Prefetcher:
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._stop = True
+        self._thread.join()
+"""
+
+
+def test_thread_fires_without_owner_join(tmp_path):
+    findings = [f for f in run_on(tmp_path, _THREAD_BAD, subdir="data")
+                if f.rule == "thread"]
+    assert len(findings) == 1
+    assert "self._thread" in findings[0].message
+
+
+def test_thread_silent_with_close_join(tmp_path):
+    findings = run_on(tmp_path, _THREAD_OK, subdir="data")
+    assert [f for f in findings if f.rule == "thread"] == []
+
+
+def test_thread_local_variant(tmp_path):
+    bad = """
+import threading
+
+
+def run():
+    t = threading.Thread(target=print)
+    t.start()
+"""
+    ok = bad + "    t.join()\n"
+    assert [f.rule for f in run_on(tmp_path, bad, subdir="data")
+            if f.rule == "thread"] == ["thread"]
+    assert [f for f in run_on(tmp_path, ok, subdir="data",
+                              name="ok.py")
+            if f.rule == "thread"] == []
+
+
+# ---------------------------------------------------------------------------
+# counter-reset
+# ---------------------------------------------------------------------------
+
+_RESET_BAD = """
+class Model:
+    def __init__(self, k):
+        self.k = k
+
+    def fit(self, X):
+        self.segments_ = 3               # never declared at init
+        return self
+"""
+
+_RESET_OK = """
+class Model:
+    def __init__(self, k):
+        self.k = k
+        self.segments_ = None
+
+    def fit(self, X):
+        self.segments_ = 3
+        return self
+"""
+
+
+def test_counter_reset_fires_on_undeclared_audit_attr(tmp_path):
+    findings = [f for f in run_on(tmp_path, _RESET_BAD, subdir="models")
+                if f.rule == "counter-reset"]
+    assert len(findings) == 1
+    assert "segments_" in findings[0].message
+
+
+def test_counter_reset_silent_when_declared(tmp_path):
+    findings = run_on(tmp_path, _RESET_OK, subdir="models")
+    assert [f for f in findings if f.rule == "counter-reset"] == []
+
+
+def test_counter_reset_checks_every_same_named_class(tmp_path):
+    """Two classes sharing a name in different modules are BOTH checked
+    — a name collision must never open a coverage hole in the gate
+    (review finding on this PR)."""
+    clean = """
+class Engine:
+    def __init__(self):
+        self.runs_ = 0
+
+    def fit(self, X):
+        self.runs_ = 1
+        return self
+"""
+    dirty = """
+class Engine:
+    def fit(self, X):
+        self.runs_ = 1               # undeclared in THIS Engine
+        return self
+"""
+    d = tmp_path / "models"
+    d.mkdir()
+    (d / "a.py").write_text(clean)
+    (d / "b.py").write_text(dirty)
+    findings = [f for f in lint_paths([d]).findings
+                if f.rule == "counter-reset"]
+    assert len(findings) == 1
+    assert findings[0].path.endswith("b.py")
+
+
+def test_counter_reset_accepts_ancestor_and_reset_method(tmp_path):
+    """Declaration may live in an in-package base class __init__ or in
+    a *reset* method — the mixin/AutoCheckpoint layout."""
+    src = """
+class Base:
+    def __init__(self):
+        self.retries_ = 0
+
+
+class Model(Base):
+    def _reset_fit_state(self):
+        self.chunks_ = None
+
+    def fit(self, X):
+        self.retries_ = 1
+        self.chunks_ = 2
+        return self
+"""
+    findings = run_on(tmp_path, src, subdir="models")
+    assert [f for f in findings if f.rule == "counter-reset"] == []
+
+
+# ---------------------------------------------------------------------------
+# dead-private
+# ---------------------------------------------------------------------------
+
+_DEAD_BAD = """
+def _orphan(x):
+    return x + 1
+
+
+def used(x):
+    return x
+"""
+
+_DEAD_OK = """
+def _helper(x):
+    return x + 1
+
+
+def used(x):
+    return _helper(x)
+"""
+
+
+def test_dead_private_fires_on_orphan(tmp_path):
+    findings = [f for f in run_on(tmp_path, _DEAD_BAD, subdir="models")
+                if f.rule == "dead-private"]
+    assert len(findings) == 1
+    assert "_orphan" in findings[0].message
+
+
+def test_dead_private_silent_when_referenced(tmp_path):
+    findings = run_on(tmp_path, _DEAD_OK, subdir="models")
+    assert [f for f in findings if f.rule == "dead-private"] == []
+
+
+def test_dead_private_docstring_mention_is_not_a_reference(tmp_path):
+    src = '''
+def _orphan(x):
+    return x
+
+
+def used(x):
+    """Calls nothing; merely mentions _orphan in prose."""
+    return x
+'''
+    findings = [f for f in run_on(tmp_path, src, subdir="models")
+                if f.rule == "dead-private"]
+    assert len(findings) == 1
+
+
+def test_dead_private_string_call_arg_is_a_reference(tmp_path):
+    src = """
+def _hook(x):
+    return x
+
+
+def used(obj):
+    return getattr(obj, "_hook")(1)
+"""
+    findings = run_on(tmp_path, src, subdir="models")
+    assert [f for f in findings if f.rule == "dead-private"] == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+def test_suppression_absorbs_and_is_counted(tmp_path):
+    src = _DEAD_BAD.replace(
+        "def _orphan(x):",
+        "def _orphan(x):  # lint: ok(dead-private) — kept as a fixture")
+    d = tmp_path / "models"
+    d.mkdir()
+    (d / "m.py").write_text(src)
+    report = lint_paths([d])
+    assert [f for f in report.findings if f.rule == "dead-private"] == []
+    assert report.suppressed == 1
+    sup = [s for s in report.suppressions if s.used]
+    assert len(sup) == 1 and sup[0].reason == "kept as a fixture"
+
+
+def test_suppression_on_preceding_comment_line(tmp_path):
+    src = ("# lint: ok(dead-private) — fixture helper\n"
+           + _DEAD_BAD.lstrip("\n"))
+    report = lint_paths([run_dir(tmp_path, src)])
+    assert [f for f in report.findings
+            if f.rule == "dead-private"] == []
+    assert report.suppressed == 1
+
+
+def run_dir(tmp_path, src, name="m.py"):
+    d = tmp_path / "models"
+    d.mkdir(exist_ok=True)
+    (d / name).write_text(src)
+    return d
+
+
+def test_malformed_suppression_is_a_finding(tmp_path):
+    src = "X = 1  # lint: ok(dead-private)\nY = 2  # lint: ok() — why\n"
+    findings = [f for f in lint_paths([run_dir(tmp_path, src)]).findings
+                if f.rule == "suppression"]
+    assert len(findings) == 2
+
+
+def test_unknown_rule_suppression_is_a_finding(tmp_path):
+    src = "X = 1  # lint: ok(no-such-rule) — because\n"
+    findings = [f for f in lint_paths([run_dir(tmp_path, src)]).findings
+                if f.rule == "suppression"]
+    assert len(findings) == 1
+    assert "unknown rule id" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# package-wide self-test (the tier-1 gate)
+# ---------------------------------------------------------------------------
+
+def test_package_lints_clean():
+    """The shipped tree is clean: ``python -m kmeans_tpu lint`` exits 0.
+    Any new violation (or any suppression without a reason) fails
+    tier-1 — the linter IS a test."""
+    report = lint_paths([PKG_DIR])
+    assert report.files > 40
+    assert report.findings == [], "\n".join(
+        f.format() for f in report.findings)
+    for sup in report.suppressions:
+        assert sup.reason, f"reason-less suppression at " \
+                           f"{sup.path}:{sup.line}"
+
+
+def test_package_suppression_inventory_is_small_and_used():
+    """Suppressions are counted; an UNUSED one is stale and must be
+    removed (it would silently mask a future violation)."""
+    report = lint_paths([PKG_DIR])
+    assert len(report.suppressions) <= 3
+    for sup in report.suppressions:
+        assert sup.used > 0, f"stale suppression at " \
+                             f"{sup.path}:{sup.line} absorbs nothing"
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_clean_run_exits_zero(tmp_path, capsys):
+    d = run_dir(tmp_path, "def used(x):\n    return x\n")
+    assert lint_main([str(d)]) == 0
+    assert "0 findings" in capsys.readouterr().out
+
+
+def test_cli_violation_exits_two_with_location(tmp_path, capsys):
+    d = run_dir(tmp_path, _DEAD_BAD)
+    assert lint_main([str(d)]) == 2
+    out = capsys.readouterr().out
+    assert "[dead-private]" in out and "m.py:2" in out
+
+
+def test_cli_json_report(tmp_path, capsys):
+    src = _DEAD_BAD + "\nZ = 1  # lint: ok(thread) — inert example\n"
+    d = run_dir(tmp_path, src)
+    assert lint_main(["--json", str(d)]) == 2
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["counts"].get("dead-private") == 1
+    assert payload["findings"][0]["incident"]
+    # The suppression inventory rides in the JSON (reviewable in CI).
+    assert len(payload["suppressions"]) == 1
+    assert payload["suppressions"][0]["reason"] == "inert example"
+
+
+def test_cli_malformed_path_exits_two(capsys):
+    assert lint_main(["/no/such/lint/target"]) == 2
+    assert "error:" in capsys.readouterr().err
+
+
+def test_cli_non_python_file_exits_two(tmp_path, capsys):
+    f = tmp_path / "notes.txt"
+    f.write_text("hi")
+    assert lint_main([str(f)]) == 2
+    assert "error:" in capsys.readouterr().err
+
+
+def test_cli_unknown_rule_filter_exits_two(capsys):
+    assert lint_main(["--rule", "no-such-rule", str(PKG_DIR)]) == 2
+    assert "unknown rule" in capsys.readouterr().err
+
+
+def test_cli_rule_filter_runs_single_rule(tmp_path, capsys):
+    d = run_dir(tmp_path, _DEAD_BAD)
+    assert lint_main(["--rule", "thread", str(d)]) == 0
+    assert lint_main(["--rule", "dead-private", str(d)]) == 2
+    capsys.readouterr()
+
+
+def test_cli_syntax_error_exits_two(tmp_path, capsys):
+    d = run_dir(tmp_path, "def broken(:\n")
+    assert lint_main([str(d)]) == 2
+    assert "cannot parse" in capsys.readouterr().err
+
+
+def test_main_module_routes_lint(monkeypatch, tmp_path, capsys):
+    """``python -m kmeans_tpu lint`` reaches the analysis CLI."""
+    import kmeans_tpu.__main__ as entry
+    d = run_dir(tmp_path, "def used(x):\n    return x\n")
+    monkeypatch.setattr("sys.argv", ["kmeans_tpu", "lint", str(d)])
+    assert entry.main() == 0
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# recompilation sentinel
+# ---------------------------------------------------------------------------
+
+from kmeans_tpu.utils.profiling import (RecompilationError,  # noqa: E402
+                                        compile_caches,
+                                        recompilation_sentinel)
+
+
+def test_compile_caches_discovers_package_caches():
+    caches = compile_caches()
+    names = set(caches)
+    assert "kmeans_tpu.models.kmeans._STEP_CACHE" in names
+    assert "kmeans_tpu.models.gmm._STEP_CACHE" in names
+    assert "kmeans_tpu.models.init._PIPE_CACHE" in names
+
+
+def test_sentinel_raises_on_growth_naming_cache_and_key():
+    from kmeans_tpu.models import kmeans as km
+    probe = ("recompilation-sentinel-probe",)
+    try:
+        with pytest.raises(RecompilationError) as ei:
+            with recompilation_sentinel():
+                km._STEP_CACHE[probe] = object()
+        msg = str(ei.value)
+        assert "kmeans_tpu.models.kmeans._STEP_CACHE" in msg
+        assert "recompilation-sentinel-probe" in msg
+    finally:
+        km._STEP_CACHE._d.pop(probe, None)
+
+
+def test_sentinel_allowed_new_budget():
+    from kmeans_tpu.models import kmeans as km
+    probe = ("recompilation-sentinel-probe-2",)
+    try:
+        with recompilation_sentinel(allowed_new=1) as rec:
+            km._STEP_CACHE[probe] = object()
+        assert rec["new"] == {
+            "kmeans_tpu.models.kmeans._STEP_CACHE": [probe]}
+    finally:
+        km._STEP_CACHE._d.pop(probe, None)
+
+
+def test_sentinel_clean_scope_records_empty():
+    with recompilation_sentinel() as rec:
+        pass
+    assert rec["new"] == {}
+    assert "kmeans_tpu.models.kmeans._STEP_CACHE" in rec["caches"]
+
+
+# --------------------------------------------- tier-1 five-family guard
+
+@pytest.fixture(scope="module")
+def blob_data():
+    rng = np.random.RandomState(7)
+    centers = rng.randn(4, 6) * 6.0
+    X = np.concatenate([c + rng.randn(50, 6) for c in centers])
+    return X.astype(np.float32)
+
+
+def _families():
+    from kmeans_tpu import (BisectingKMeans, GaussianMixture, KMeans,
+                            MiniBatchKMeans, SphericalKMeans)
+    return {
+        "kmeans": KMeans(k=3, max_iter=5, seed=0, verbose=False),
+        "minibatch": MiniBatchKMeans(k=3, max_iter=6, batch_size=64,
+                                     seed=0, verbose=False),
+        "bisecting": BisectingKMeans(k=3, max_iter=5, seed=0,
+                                     verbose=False),
+        "spherical": SphericalKMeans(k=3, max_iter=5, seed=0,
+                                     verbose=False),
+        "gmm": GaussianMixture(n_components=3, max_iter=5, seed=0),
+    }
+
+
+@pytest.mark.parametrize("family", sorted(_families().keys()))
+def test_repeat_predict_adds_zero_cache_entries(family, blob_data):
+    """The r11 zero-new-entries property as a standing guard: after one
+    warm call, repeat same-shape predict dispatches must reuse every
+    compiled entry across ALL package caches."""
+    model = _families()[family]
+    model.fit(blob_data)
+    warm = model.predict(blob_data)           # compile + place
+    with recompilation_sentinel() as rec:
+        for _ in range(3):
+            got = model.predict(blob_data)
+    np.testing.assert_array_equal(got, warm)
+    assert rec["new"] == {}
+
+
+def test_repeat_serving_calls_add_zero_cache_entries(blob_data):
+    """Same guard through the serving engine: repeat same-bucket
+    requests (predict + score_rows ops) reuse the warm kernels."""
+    from kmeans_tpu import KMeans
+    from kmeans_tpu.serving import ServingEngine
+    model = KMeans(k=3, max_iter=5, seed=0, verbose=False)
+    model.fit(blob_data)
+    model.mesh = None
+    with ServingEngine(max_wait_ms=1.0) as eng:
+        eng.add_model("m", model)
+        probe = blob_data[:17]
+        warm = eng.predict("m", probe)        # compile the bucket
+        eng.call("m", probe, op="score_rows")
+        with recompilation_sentinel() as rec:
+            for _ in range(3):
+                got = eng.predict("m", probe)
+                eng.call("m", probe, op="score_rows")
+        np.testing.assert_array_equal(got, warm)
+        assert rec["new"] == {}
