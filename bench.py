@@ -80,6 +80,15 @@ every run, the corrected-rows audit published with the rate
 (``kmeans_tpu.benchmarks.bench_bf16_guard``; committed adopt rule:
 >= 5% at the headline shape).  Env: BENCH_N/_D/_K/_ITERS.
 
+BENCH_OBS=1 switches to the TELEMETRY-OVERHEAD benchmark (ISSUE 11):
+obs-on (span tracing + heartbeat) vs obs-off fits, interleaved per-rep
+ratios on BOTH the one-dispatch device loop and the telemetry-dense
+per-iteration host loop, plus a cold-cache traced fit emitting the
+span-derived time-to-first-iteration table (trace JSONL artifact at
+BENCH_OBS_TRACE, default artifacts/trace_ttfi.jsonl).  Committed rule:
+<= 1% median overhead on the 200k x 32 k=64 proxy or per-iteration
+spans demote to segment-level.  Env: BENCH_N/_D/_K/_ITERS.
+
 BENCH_PHASES=1 switches to the MEASURED PER-PHASE CEILING TABLE
 (ISSUE 8c): the r8 cumulative-prefix phase ladder (distance ->
 +argmin -> +scatter/psum) with implied-ceiling-if-free columns and the
@@ -270,6 +279,28 @@ def main() -> None:
         bench_phases(pn, pd, pk, gap=pg, chunks=chunks,
                      skip_sweep=bool(os.environ.get(
                          "BENCH_PHASES_NO_SWEEP")))
+        return
+
+    if os.environ.get("BENCH_OBS"):
+        # Telemetry-overhead benchmark (ISSUE 11): obs-on (tracing +
+        # heartbeat) vs obs-off fits, interleaved per-rep ratios, on
+        # both the one-dispatch device loop and the telemetry-dense
+        # per-iteration host loop; plus the cold-cache traced fit whose
+        # span-derived time-to-first-iteration table is the BASELINE
+        # artifact.  Committed rule: <=1% median overhead on the
+        # 200k x 32 k=64 proxy or per-iteration spans go coarse.
+        from kmeans_tpu.benchmarks import bench_obs
+        on_ = int(os.environ.get("BENCH_N",
+                                 2_000_000 if on_accel else 200_000))
+        od = int(os.environ.get("BENCH_D", 128 if on_accel else 32))
+        ok = int(os.environ.get("BENCH_K", 1024 if on_accel else 64))
+        oi = int(os.environ.get("BENCH_ITERS", 20))
+        art = os.environ.get("BENCH_OBS_TRACE",
+                             "artifacts/trace_ttfi.jsonl")
+        os.makedirs(os.path.dirname(art) or ".", exist_ok=True)
+        log(f"bench: OBS mode backend={backend} N={on_} D={od} k={ok} "
+            f"iters={oi} trace={art}")
+        bench_obs(on_, od, ok, iters=oi, artifact_path=art)
         return
 
     if os.environ.get("BENCH_CKPT"):

@@ -36,8 +36,12 @@ def main() -> int:
     if cmd == "lint":
         from kmeans_tpu.cli import lint_main
         return lint_main(rest)
+    if cmd == "trace":
+        from kmeans_tpu.cli import trace_main
+        return trace_main(rest)
     print(f"unknown command {cmd!r}; available: suite, bench, fit, "
-          f"sweep, ckpt-info, serve, report, lint", file=sys.stderr)
+          f"sweep, ckpt-info, serve, report, lint, trace",
+          file=sys.stderr)
     return 2
 
 

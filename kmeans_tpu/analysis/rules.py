@@ -375,12 +375,16 @@ class DispatchAccountingRule(Rule):
                     return True
         return False
 
-    def _check_function(self, mod: Module, fn) -> Iterator[Finding]:
-        # Skip nested defs (the compiled bodies themselves) — only
-        # driver-level functions dispatch.
+    def _compiled_call_sites(self, fn) -> List[ast.Call]:
+        """Invocations of compiled functions inside ``fn``: direct
+        invokes of a ``_SOURCES`` result (``self._predict_fn(...)(...)``,
+        ``CACHE.get_or_create(...)(...)``) and calls through a name a
+        ``_SOURCES`` call was assigned to.  Shared with
+        :class:`ObsSpanRule` — ONE detection heuristic, two rules
+        (dispatch accounting + span coverage), so the definition of "a
+        compiled call site" can never drift between them."""
         compiled_names: Set[str] = set()
-        call_sites: List[ast.Call] = []
-        accounted = False
+        sites: List[ast.Call] = []
         for node in ast.walk(fn):
             if isinstance(node, ast.Assign) \
                     and self._is_source_call(node.value):
@@ -389,18 +393,22 @@ class DispatchAccountingRule(Rule):
                         if isinstance(leaf, ast.Name):
                             compiled_names.add(leaf.id)
             if isinstance(node, ast.Call):
-                path = dotted(node.func) or ""
-                leaf = path.split(".")[-1]
-                if leaf == "note_dispatch" or leaf == "_record":
-                    accounted = True
-                # direct invoke:  self._predict_fn(...)(...) or
-                # CACHE.get_or_create(...)(...)
                 if isinstance(node.func, (ast.Call, ast.Subscript)) \
                         and self._is_source_call(node.func):
-                    call_sites.append(node)
+                    sites.append(node)
                 elif isinstance(node.func, ast.Name) \
                         and node.func.id in compiled_names:
-                    call_sites.append(node)
+                    sites.append(node)
+        return sites
+
+    def _check_function(self, mod: Module, fn) -> Iterator[Finding]:
+        call_sites = self._compiled_call_sites(fn)
+        accounted = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                path = dotted(node.func) or ""
+                if path.split(".")[-1] in ("note_dispatch", "_record"):
+                    accounted = True
             if isinstance(node, (ast.AugAssign, ast.Assign)):
                 target = node.target if isinstance(node, ast.AugAssign) \
                     else (node.targets[0] if node.targets else None)
@@ -415,6 +423,69 @@ class DispatchAccountingRule(Rule):
                 f"{fn.name}() invokes a compiled function but never "
                 f"tags the dispatch (note_dispatch/._record/dispatch "
                 f"counter)")
+
+
+# ----------------------------------------------------------- obs-span
+
+class ObsSpanRule(DispatchAccountingRule):
+    """ISSUE 11 twin of the dispatch-accounting rule: in ``serving/``
+    and ``parallel/``, a driver-level function that invokes a compiled
+    function must also run it under a telemetry span — a ``with``
+    statement whose context manager is a ``span(...)``/``obs.span``/
+    ``trace.span`` call somewhere in the function (``span()`` is the
+    no-op fast path when tracing is off, so coverage costs nothing
+    disabled).  Without this, new dispatch call sites silently fall off
+    the trace timeline the way they used to fall off the dispatch
+    counters (the incident class the r14 ``dispatch`` rule closed)."""
+
+    id = "obs-span"
+    incident = ("ISSUE 11: a compiled dispatch invisible to the span "
+                "timeline — the trace twin of the dispatch-counter "
+                "class")
+
+    def run(self, pkg: Package) -> Iterator[Finding]:
+        for mod in pkg:
+            p = mod.rel.replace("\\", "/")
+            if "/serving/" not in p and "/parallel/" not in p:
+                continue
+            parents = mod.parents()
+            for fn in ast.walk(mod.tree):
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                # Driver-level functions only: a nested closure's call
+                # sites are covered by (and checked through) the
+                # enclosing driver's subtree walk.
+                if not isinstance(parents.get(fn),
+                                  (ast.Module, ast.ClassDef)):
+                    continue
+                yield from self._check_spans(mod, fn)
+
+    def _check_spans(self, mod: Module, fn) -> Iterator[Finding]:
+        call_sites = self._compiled_call_sites(fn)
+        if not call_sites:
+            return
+        if self._has_span(fn):
+            return
+        yield self.finding(
+            mod, call_sites[0].lineno,
+            f"{fn.name}() invokes a compiled function with no enclosing "
+            f"telemetry span — wrap the dispatch in `with "
+            f"obs_trace.span(...)` (a no-op when tracing is off) so it "
+            f"appears on the trace timeline")
+
+    @staticmethod
+    def _has_span(fn) -> bool:
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    leaf = (dotted(expr.func) or "").split(".")[-1]
+                    if leaf in ("span", "tracing"):
+                        return True
+        return False
 
 
 # ------------------------------------------------------------ threads
@@ -653,6 +724,6 @@ class SuppressionFormatRule(Rule):
 
 RULES: Dict[str, Rule] = {rule.id: rule for rule in (
     TraceHazardRule(), CacheKeyRule(), DispatchAccountingRule(),
-    ThreadHygieneRule(), CounterResetRule(), DeadPrivateRule(),
-    SuppressionFormatRule(),
+    ObsSpanRule(), ThreadHygieneRule(), CounterResetRule(),
+    DeadPrivateRule(), SuppressionFormatRule(),
 )}

@@ -949,6 +949,131 @@ def bench_phases(n: int, d: int, k: int, *, gap: int = 20, reps: int = 5,
     return result
 
 
+def bench_obs(n: int, d: int, k: int, iters: int = 20,
+              reps: int = 5, artifact_path=None) -> Dict:
+    """Telemetry-overhead benchmark (ISSUE 11: ``BENCH_OBS=1 python
+    bench.py``): the same fit measured obs-OFF vs obs-ON (tracing +
+    heartbeat active), per-rep INTERLEAVED marginal pairs, overhead =
+    the median of per-rep on/off ratios.  Two rows because the cost
+    model differs:
+
+    * ``device`` — the one-dispatch loop: a handful of spans per fit
+      (segment/dispatch/compile) regardless of iteration count — the
+      headline path's cost.
+    * ``host`` — the per-iteration host loop: one dispatch span + one
+      heartbeat record PER ITERATION — the telemetry-dense worst case
+      the committed rule is judged on.
+
+    Committed decision rule (pre-registered, the repo discipline):
+    median obs-on overhead <= 1% (ratio <= 1.01) on the 200k x 32 k=64
+    CPU proxy (or the headline shape on hardware) keeps the default
+    span set; a measured breach demotes the per-iteration host-loop
+    span to coarse-grained (segment-level only) — published either way.
+
+    Also produces the TTFI ARTIFACT: one cold-cache traced fit whose
+    span-derived time-to-first-iteration table (the
+    ``phase_ceiling_table`` schema) is printed and, with
+    ``artifact_path``, written as the trace JSONL the ``trace
+    summarize`` CLI re-derives it from."""
+    import jax
+
+    from kmeans_tpu.models.kmeans import KMeans
+    from kmeans_tpu.obs.heartbeat import heartbeat as heartbeat_scope
+    from kmeans_tpu.obs import trace as trace_mod
+    from kmeans_tpu.obs.report import (format_phase_table,
+                                       time_to_first_iteration)
+
+    ds, init = _lloyd_bench_setup(n, d, k)
+
+    def timed_fit(mi: int, host_loop: bool) -> float:
+        m = KMeans(k=k, max_iter=mi, tolerance=1e-30, seed=0, init=init,
+                   compute_sse=False, compute_labels=False,
+                   empty_cluster="keep", host_loop=host_loop,
+                   verbose=False)
+        m._eager_labels = False
+        t0 = time.perf_counter()
+        m.fit(ds)
+        return time.perf_counter() - t0
+
+    def timed_obs(mi: int, host_loop: bool) -> float:
+        with trace_mod.tracing(), \
+                heartbeat_scope(callback=lambda rec: None):
+            return timed_fit(mi, host_loop)
+
+    rows = {}
+    for path_name, host_loop in (("device", False), ("host", True)):
+        offs, ons = [], []
+        for rep in range(reps + 1):
+            off = max(timed_fit(2 + iters, host_loop)
+                      - timed_fit(2, host_loop), 1e-9)
+            on = max(timed_obs(2 + iters, host_loop)
+                     - timed_obs(2, host_loop), 1e-9)
+            if rep == 0:
+                continue                       # burn-in pair
+            offs.append(off)
+            ons.append(on)
+            _log(f"[obs:{path_name}] rep {rep}/{reps}: off "
+                 f"{off / iters * 1e3:.3f} ms/iter, on "
+                 f"{on / iters * 1e3:.3f} ms/iter, ratio "
+                 f"{on / off:.4f}x")
+        ratios = sorted(o / f for o, f in zip(ons, offs))
+        overhead = float(np.median(ratios))
+        spread = (max(ratios) - min(ratios)) / overhead
+        rows[path_name] = {
+            "off_ms_per_iter": round(float(np.median(offs))
+                                     / iters * 1e3, 4),
+            "on_ms_per_iter": round(float(np.median(ons))
+                                    / iters * 1e3, 4),
+            "overhead_ratio": round(overhead, 4),
+            "overhead_spread": round(spread, 3),
+            "indicative_only": bool(spread > 0.05),
+            "within_1pct_rule": bool(overhead <= 1.01),
+        }
+        _log(f"[obs:{path_name}] median overhead "
+             f"{overhead:.4f}x (spread {spread * 100:.0f}%)")
+
+    # TTFI artifact: a cold-cache traced fit (odd chunk -> fresh step-
+    # cache keys, forgy -> a real seed span) at the SAME shape.  Fit
+    # from the dataset's retained HOST copy, so the table's place/stage
+    # rows measure a real upload — np.asarray(ds.points) would instead
+    # pull the padded device buffer back over the link first (review
+    # finding).
+    with trace_mod.tracing() as tr:
+        m = KMeans(k=k, max_iter=3, tolerance=1e-30, seed=0,
+                   init="forgy", compute_sse=False, compute_labels=False,
+                   empty_cluster="keep", host_loop=False,
+                   chunk_size=max(1009, k), verbose=False)
+        m._eager_labels = False
+        m.fit(ds.host)
+    ttfi = time_to_first_iteration(tr.records())
+    _log(format_phase_table(ttfi, title=f"ttfi (cold-cache, {n}x{d} "
+                                        f"k={k})"))
+    if artifact_path is not None:
+        tr.write_jsonl(artifact_path)
+        _log(f"[obs] trace artifact written to {artifact_path} "
+             f"(re-derive: python -m kmeans_tpu trace summarize "
+             f"{artifact_path})")
+
+    from kmeans_tpu.utils.profiling import sanitize_json
+    result = {
+        "metric": f"obs_overhead_N{n}_D{d}_k{k}",
+        "value": rows["host"]["overhead_ratio"],
+        "unit": "obs-on/obs-off wall ratio (per-iteration host loop)",
+        "paths": rows,
+        "iters_gap": iters,
+        "decision_rule": "<=1.01 median keeps the default span set; a "
+                         "breach demotes per-iteration spans to "
+                         "segment-level (coarse) — published either "
+                         "way",
+        "ttfi": sanitize_json(ttfi),
+        "trace_artifact": str(artifact_path) if artifact_path else None,
+        "platform": jax.default_backend(),
+        "n_devices": len(jax.devices()),
+    }
+    print(json.dumps(sanitize_json(result)), flush=True)
+    return result
+
+
 def bench_stream(n: int, d: int, k: int, block_rows: int, epochs: int,
                  path=None, prefetch: int = 2) -> Dict:
     """Streamed-epoch benchmark: `fit_stream` epoch cost with the

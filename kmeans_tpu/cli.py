@@ -365,6 +365,81 @@ def serve_main(argv=None) -> int:
     return 0
 
 
+def trace_main(argv=None) -> int:
+    """``python -m kmeans_tpu trace summarize <file.jsonl>`` — analyze
+    a telemetry trace written by ``obs.tracing(path=...)`` (ISSUE 11).
+
+    Prints the per-phase rollup (count / total / p50 / p99 over SELF
+    time — nested child time is excluded, so totals never double-count)
+    and, when the trace holds a ``dispatch`` span, the
+    time-to-first-iteration table (the same ``phase_ceiling_table``
+    schema as the r13 per-iteration ceiling table, with the committed
+    >= 15% "actionable" rule).  ``--json`` emits both machine-readable;
+    ``--chrome out.json`` additionally converts the trace to Chrome
+    ``trace_event`` format for chrome://tracing / Perfetto.  Exit 2 on
+    an unreadable or malformed trace file."""
+    parser = argparse.ArgumentParser(
+        prog="python -m kmeans_tpu trace",
+        description="Summarize a kmeans_tpu telemetry trace (JSONL "
+                    "from obs.tracing): per-phase totals/percentiles + "
+                    "the time-to-first-iteration table")
+    parser.add_argument("action", choices=("summarize",),
+                        help="analysis to run (summarize)")
+    parser.add_argument("file", help="trace JSONL path")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output on stdout")
+    parser.add_argument("--chrome", metavar="OUT.JSON", default=None,
+                        help="also write a Chrome trace_event file")
+    args = parser.parse_args(argv)
+
+    from kmeans_tpu.obs import trace as obs_trace
+    from kmeans_tpu.obs.report import (format_phase_table,
+                                       time_to_first_iteration)
+    try:
+        records = obs_trace.read_jsonl(args.file)
+    except obs_trace.TraceReadError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    summary = obs_trace.summarize(records)
+    try:
+        ttfi = time_to_first_iteration(records)
+    except ValueError:
+        ttfi = None                  # no dispatch span — summary only
+
+    if args.chrome:
+        with open(args.chrome, "w") as f:
+            json.dump({"traceEvents": obs_trace.chrome_events(records),
+                       "displayTimeUnit": "ms"}, f)
+
+    if args.json:
+        from kmeans_tpu.utils.profiling import sanitize_json
+        print(json.dumps(sanitize_json(
+            {"file": args.file, "phases": summary,
+             "time_to_first_iteration": ttfi,
+             "chrome": args.chrome}), indent=2))
+        return 0
+
+    n_spans = sum(1 for r in records if r.get("kind") == "span")
+    n_events = sum(1 for r in records if r.get("kind") == "event")
+    print(f"trace: {args.file} — {n_spans} spans, {n_events} events")
+    print(f"  {'phase':<20} {'count':>6} {'total ms':>10} "
+          f"{'p50 ms':>9} {'p99 ms':>9} {'events':>7}")
+    for name in sorted(summary,
+                       key=lambda n: -summary[n]["total"]):
+        row = summary[name]
+        print(f"  {name:<20} {row['count']:>6} "
+              f"{row['total'] * 1e3:>10.2f} {row['p50'] * 1e3:>9.3f} "
+              f"{row['p99'] * 1e3:>9.3f} {row['events']:>7}")
+    if ttfi is not None:
+        print()
+        print(format_phase_table(ttfi))
+    if args.chrome:
+        print(f"\nchrome trace written to {args.chrome} "
+              f"(load in chrome://tracing or ui.perfetto.dev)")
+    return 0
+
+
 def lint_main(argv=None) -> int:
     """``python -m kmeans_tpu lint [--json] [paths]`` — the package's
     AST invariant linter (ISSUE 10; one rule per historical incident

@@ -27,6 +27,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from kmeans_tpu.obs import metrics_registry as _obs_metrics
+from kmeans_tpu.obs import trace as _obs_trace
 from kmeans_tpu.parallel.mesh import DATA_AXIS, mesh_shape
 from kmeans_tpu.parallel.sharding import (ShardedDataset, choose_chunk_size,
                                           to_device)
@@ -105,6 +107,9 @@ def retry_call(fn: Callable, *, retries: int, backoff: float,
             attempt += 1
             if stats is not None:
                 stats.retries_used += 1
+            # Write-through (ISSUE 11): per-call IOStats stays the
+            # documented surface; the registry keeps the process view.
+            _obs_metrics.REGISTRY.counter("io.retries").inc()
             if _interruptible_sleep(backoff * (2.0 ** (attempt - 1)),
                                     abort):
                 raise
@@ -181,6 +186,7 @@ class _ResilientBlockIter:
                 attempt += 1
                 if self._stats is not None:
                     self._stats.retries_used += 1
+                _obs_metrics.REGISTRY.counter("io.retries").inc()
                 if _interruptible_sleep(
                         self._backoff * (2.0 ** (attempt - 1)),
                         self._abort):
@@ -192,7 +198,11 @@ class _ResilientBlockIter:
     def __next__(self):
         while True:
             try:
-                item = self._next_raw()
+                # 'io.block' span (ISSUE 11): one streamed block read
+                # (retries included — the span measures what the epoch
+                # actually waited for this block).
+                with _obs_trace.span("io.block", index=self._pos):
+                    item = self._next_raw()
             except StopIteration:
                 if self._stats is not None:
                     self._stats.blocks_skipped = self._skipped
@@ -213,6 +223,7 @@ class _ResilientBlockIter:
             self._skipped += 1
             if self._stats is not None:
                 self._stats.blocks_skipped_total += 1
+            _obs_metrics.REGISTRY.counter("io.blocks_skipped").inc()
 
     def abort(self) -> None:
         self._abort.set()
@@ -504,10 +515,14 @@ def iter_npy_blocks(path, block_rows: int, *, dtype=None,
             raise ValueError(f"{path} must contain a 2-D array, "
                              f"got shape {arr.shape}")
         for start in range(0, arr.shape[0], block_rows):
-            block = retry_call(
-                lambda: np.asarray(arr[start: start + block_rows]),
-                retries=io_retries, backoff=io_backoff, stats=io_stats,
-                what=f"block rows [{start}, {start + block_rows})")
+            with _obs_trace.span("io.block", offset=start,
+                                 rows=min(block_rows,
+                                          arr.shape[0] - start)):
+                block = retry_call(
+                    lambda: np.asarray(arr[start: start + block_rows]),
+                    retries=io_retries, backoff=io_backoff,
+                    stats=io_stats,
+                    what=f"block rows [{start}, {start + block_rows})")
             yield block if dtype is None else block.astype(dtype)
 
     def make_blocks():

@@ -46,6 +46,8 @@ import queue
 import threading
 from typing import Callable, Iterable, Iterator, Optional
 
+from kmeans_tpu.obs import trace as _obs_trace
+
 __all__ = ["prefetch_iter", "check_prefetch", "close_source",
            "abort_source"]
 
@@ -146,7 +148,17 @@ class _PrefetchIterator:
     def _produce(self, it, stage) -> None:
         try:
             for item in it:
-                staged = stage(item) if stage is not None else item
+                # The producer's staging share (decode + device_put)
+                # runs under a 'stage' span from THIS thread's tid, so
+                # a chrome timeline shows block i+1's transfer
+                # overlapping the consumer's dispatch spans (any inner
+                # shard_points 'stage' nests; self-time attribution
+                # keeps totals double-count-free).
+                if stage is not None:
+                    with _obs_trace.span("stage", via="prefetch"):
+                        staged = stage(item)
+                else:
+                    staged = item
                 if not self._put(("item", staged)):
                     return                      # closed early
                 del staged                      # queue owns the reference

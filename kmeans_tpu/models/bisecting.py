@@ -30,6 +30,7 @@ import jax
 import numpy as np
 
 from kmeans_tpu.models.kmeans import KMeans, _get_step_fns
+from kmeans_tpu.obs.heartbeat import note_progress as obs_note_progress
 from kmeans_tpu.utils.logging import IterationLogger
 
 _STRATEGIES = ("biggest_sse", "largest_cluster")
@@ -221,6 +222,11 @@ class BisectingKMeans(KMeans):
                     + (f", total SSE = {total:.4f}"
                        if self.compute_sse else ""))
             self.iterations_run = split + 1
+            # Heartbeat (ISSUE 11): one progress record per completed
+            # split — the tree state is host-side already, zero extra
+            # dispatches (no-op with no heartbeat installed).
+            obs_note_progress(self, phase="split", segment=split + 1,
+                              clusters=len(cents))
             if checkpoint_every and (split + 1) % checkpoint_every == 0:
                 self._snapshot_tree(split + 1, labels, cents, sse, wsize,
                                     members)

@@ -48,6 +48,9 @@ from __future__ import annotations
 
 import os
 
+from kmeans_tpu.obs import metrics_registry as obs_metrics
+from kmeans_tpu.obs import trace as obs_trace
+from kmeans_tpu.obs.heartbeat import note_progress as obs_note_progress
 from kmeans_tpu.utils import checkpoint as ckpt
 from kmeans_tpu.utils import faults
 
@@ -182,47 +185,67 @@ class AutoCheckpointMixin:
 
         The ``faults.on_segment_dispatch`` injection point fires INSIDE
         the try block, so an injected ``SimulatedOOM`` exercises
-        exactly the recovery a real XLA OOM takes."""
+        exactly the recovery a real XLA OOM takes.
+
+        Telemetry (ISSUE 11): ONE ``segment`` span wraps the whole
+        retry loop; each attempt is a nested ``dispatch`` span stamped
+        with its chunk and attempt index — so a replayed segment adds
+        attempt spans inside the SAME segment span, never a second
+        segment (the no-double-counting contract
+        tests/test_obs.py pins)."""
         import warnings
         import jax
-        while True:
-            try:
-                faults.on_segment_dispatch(segment, chunk)
-                result = dispatch(chunk)
-                # Materialize INSIDE the try: JAX dispatch is async, so
-                # a real device RESOURCE_EXHAUSTED raised during
-                # execution would otherwise surface later, at the
-                # caller's first np.asarray — outside this recovery
-                # path (review r10).  The outputs are small (tables +
-                # histories), so the sync costs one round trip the
-                # segment boundary pays anyway.
-                jax.block_until_ready(result)
-                return result, chunk
-            except Exception as e:           # noqa: BLE001 — reclassified
-                if not is_oom_error(e):
-                    raise
-                from kmeans_tpu.parallel.sharding import backoff_chunk
-                smaller = backoff_chunk(chunk)
-                if smaller is None or self.oom_backoffs_ >= \
-                        MAX_OOM_BACKOFFS:
-                    # Plain RuntimeError (not type(e) — injected OOMs
-                    # have a structured constructor), original chained.
-                    raise RuntimeError(
-                        f"{e}; chunk backoff exhausted at {chunk} rows "
-                        f"after {self.oom_backoffs_} halving(s) — this "
-                        f"working set does not fit at the minimum scan "
-                        f"chunk; shrink k/D, add devices, or resume the "
-                        f"checkpoint on a larger mesh") from e
-                self.oom_backoffs_ += 1
-                self.effective_chunk_ = smaller
-                warnings.warn(
-                    f"device OOM dispatching segment {segment} at chunk "
-                    f"{chunk}; retrying at chunk {smaller} "
-                    f"(backoff {self.oom_backoffs_}/{MAX_OOM_BACKOFFS}; "
-                    f"the segment replays from the last checkpoint "
-                    f"boundary, trajectory unchanged)", UserWarning,
-                    stacklevel=3)
-                chunk = smaller
+        attempt = 0
+        with obs_trace.span("segment", index=segment):
+            while True:
+                try:
+                    with obs_trace.span("dispatch", tag="fit/segment",
+                                        chunk=chunk, attempt=attempt):
+                        faults.on_segment_dispatch(segment, chunk)
+                        result = dispatch(chunk)
+                        # Materialize INSIDE the try: JAX dispatch is
+                        # async, so a real device RESOURCE_EXHAUSTED
+                        # raised during execution would otherwise
+                        # surface later, at the caller's first
+                        # np.asarray — outside this recovery path
+                        # (review r10).  The outputs are small (tables
+                        # + histories), so the sync costs one round
+                        # trip the segment boundary pays anyway.
+                        jax.block_until_ready(result)
+                    return result, chunk
+                except Exception as e:       # noqa: BLE001 — reclassified
+                    if not is_oom_error(e):
+                        raise
+                    from kmeans_tpu.parallel.sharding import backoff_chunk
+                    smaller = backoff_chunk(chunk)
+                    if smaller is None or self.oom_backoffs_ >= \
+                            MAX_OOM_BACKOFFS:
+                        # Plain RuntimeError (not type(e) — injected
+                        # OOMs have a structured constructor), original
+                        # chained.
+                        raise RuntimeError(
+                            f"{e}; chunk backoff exhausted at {chunk} "
+                            f"rows after {self.oom_backoffs_} "
+                            f"halving(s) — this working set does not "
+                            f"fit at the minimum scan chunk; shrink "
+                            f"k/D, add devices, or resume the "
+                            f"checkpoint on a larger mesh") from e
+                    attempt += 1
+                    self.oom_backoffs_ += 1
+                    self.effective_chunk_ = smaller
+                    # Write-through (ISSUE 11): the per-fit audit attr
+                    # stays the documented surface; the registry keeps
+                    # the process-wide view.
+                    obs_metrics.REGISTRY.counter("fit.oom_backoffs").inc()
+                    warnings.warn(
+                        f"device OOM dispatching segment {segment} at "
+                        f"chunk {chunk}; retrying at chunk {smaller} "
+                        f"(backoff "
+                        f"{self.oom_backoffs_}/{MAX_OOM_BACKOFFS}; the "
+                        f"segment replays from the last checkpoint "
+                        f"boundary, trajectory unchanged)", UserWarning,
+                        stacklevel=3)
+                    chunk = smaller
 
     def _raise_divergence(self, quantity: str, iteration: int,
                           detail: str = ""):
@@ -267,11 +290,16 @@ class AutoCheckpointMixin:
     def _write_autockpt(self, path, iteration: int) -> None:
         """One rotating atomic checkpoint (multi-host primary-gated,
         barriered per segment) + the deterministic fault-injection
-        boundary hook."""
+        boundary hook.  Also the shared HEARTBEAT point (ISSUE 11):
+        every family's segment boundary passes through here, and the
+        boundary state is already host-materialized, so a progress
+        record costs zero extra dispatches."""
         ckpt.save_state_primary(path, self._state_dict(),
                                 f"kmeans_tpu.autockpt.{iteration}",
                                 rotate=True)
         self._ckpt_written_this_fit = True
+        obs_note_progress(self, phase="checkpoint",
+                                    iteration=int(iteration))
         faults.on_checkpoint(iteration, path)
 
     def _resolve_resume(self, resume):

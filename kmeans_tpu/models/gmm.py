@@ -78,12 +78,14 @@ from kmeans_tpu.parallel.mesh import MODEL_AXIS, make_mesh, mesh_shape
 from kmeans_tpu.parallel.sharding import (ShardedDataset, choose_chunk_size,
                                           to_device)
 from kmeans_tpu.models.fault_tolerance import AutoCheckpointMixin
+from kmeans_tpu.obs import trace as obs_trace
+from kmeans_tpu.obs.heartbeat import note_progress as obs_note_progress
 from kmeans_tpu.utils.validation import check_finite_array
 
 from kmeans_tpu.utils.cache import LRUCache
 
 # LRU-bounded like models.kmeans._STEP_CACHE (r3 VERDICT weak #7).
-_STEP_CACHE = LRUCache(64)
+_STEP_CACHE = LRUCache(64, name="gmm._STEP_CACHE")
 # Softmax sharpness for the hard-assignment init pass: with inv_var this
 # large, the nearest-centroid log-density dominates by >>f32 range, so
 # responsibilities are exactly one-hot (sklearn inits from one-hot
@@ -637,6 +639,15 @@ class GaussianMixture(AutoCheckpointMixin):
         return [self.seed] + [int(s) for s in extra]
 
     def _init_params(self, ds: ShardedDataset, step_fn, seed: int):
+        # 'seed' span (ISSUE 11): the mixture's whole parameter-seeding
+        # block — the internal KMeans fit for init_params='kmeans'
+        # contributes its own nested spans (visible as the O(R) member
+        # seeding cost the r12 sweep notes document).
+        with obs_trace.span("seed", strategy=str(self.init_params),
+                            k=self.n_components):
+            return self._init_params_inner(ds, step_fn, seed)
+
+    def _init_params_inner(self, ds: ShardedDataset, step_fn, seed: int):
         d = ds.d
         k = self.n_components
         if self.means_init is not None:
@@ -1279,14 +1290,19 @@ class GaussianMixture(AutoCheckpointMixin):
         shift = self._shift()
         for it in range(base + 1, base + self.max_iter + 1):
             t0 = time.perf_counter()
-            st: EStats = step_fn(ds.points, ds.weights,
-                                 *self._params_dev(mesh,
-                                                   guard_cholesky=True))
-            # The per-iteration float64 M-step total (sum of resp sums
-            # == total sample weight) normalizes the lower bound — the
-            # same reduction class on fresh AND resumed fits (an f32
-            # device-side sum diverged from it at large n, review r4).
-            w_total, (pi, mu_c, var) = self._m_step(self._trim(st))
+            # The 'dispatch' span covers dispatch + the M-step that
+            # materializes the statistics (JAX dispatch is async; the
+            # host sync happens inside _m_step's array reads).
+            with obs_trace.span("dispatch", tag="em/step", iteration=it):
+                st: EStats = step_fn(ds.points, ds.weights,
+                                     *self._params_dev(
+                                         mesh, guard_cholesky=True))
+                # The per-iteration float64 M-step total (sum of resp
+                # sums == total sample weight) normalizes the lower
+                # bound — the same reduction class on fresh AND resumed
+                # fits (an f32 device-side sum diverged from it at
+                # large n, review r4).
+                w_total, (pi, mu_c, var) = self._m_step(self._trim(st))
             if w_total <= 0:
                 raise ValueError("total sample weight must be positive")
             self.weights_, self.means_ = pi, mu_c + shift
@@ -1302,6 +1318,10 @@ class GaussianMixture(AutoCheckpointMixin):
                 # Divergence-rollback exit (ISSUE 5): restore the
                 # last-good checkpoint (when active) before raising.
                 self._raise_divergence("log-likelihood", it)
+            # Heartbeat (ISSUE 11): the EM host loop already
+            # materialized this iteration's state — zero extra
+            # dispatches for the progress record.
+            obs_note_progress(self, phase="iteration")
             # Absolute-index cadence (after the non-finite guard: never
             # checkpoint a poisoned state).
             if checkpoint_every and it % checkpoint_every == 0:

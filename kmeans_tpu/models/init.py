@@ -414,7 +414,7 @@ _CAND_SENTINEL = 1e12
 # reused or every init would recompile (same pattern as kmeans._STEP_CACHE).
 from kmeans_tpu.utils.cache import LRUCache
 
-_PIPE_CACHE = LRUCache(32)
+_PIPE_CACHE = LRUCache(32, name="init._PIPE_CACHE")
 
 # Module-level (compiled once): the positive-row count for hostless
 # datasets — a per-call lambda would re-trace on every init.
@@ -1077,20 +1077,28 @@ def resolve_init(init, X, k: int, seed: int, *,
 
     ``validate=False`` skips redundant full-array finite scans in the named
     strategies (data already validated by the caller); custom callables
-    manage their own validation."""
+    manage their own validation.  A named or callable strategy runs
+    under a ``seed`` span (ISSUE 11: the seeding share of
+    time-to-first-iteration; explicit arrays cost nothing and are not
+    spanned)."""
+    from kmeans_tpu.obs import trace as _obs_trace
     src = as_source(X)
     dtype = np.dtype(str(src.dtype))
     if callable(init):
         host = getattr(src, "host", None)
-        return np.asarray(init(host if host is not None else src, k, seed),
-                          dtype=dtype)
+        with _obs_trace.span("seed", strategy="callable", k=k):
+            return np.asarray(
+                init(host if host is not None else src, k, seed),
+                dtype=dtype)
     if isinstance(init, str):
         try:
             fn = INITIALIZERS[init]
         except KeyError:
             raise ValueError(f"unknown init strategy: {init!r}; "
                              f"options: {sorted(INITIALIZERS)}") from None
-        return np.asarray(fn(src, k, seed, validate=validate), dtype=dtype)
+        with _obs_trace.span("seed", strategy=init, k=k):
+            return np.asarray(fn(src, k, seed, validate=validate),
+                              dtype=dtype)
     arr = np.asarray(init, dtype=dtype)
     if arr.shape != (k, src.d):
         raise ValueError(f"explicit init must have shape ({k}, "

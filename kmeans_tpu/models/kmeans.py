@@ -54,6 +54,8 @@ from kmeans_tpu.parallel.sharding import (ShardedDataset, choose_chunk_size,
                                           to_device)
 from kmeans_tpu.models.init import resolve_init
 from kmeans_tpu.models.fault_tolerance import AutoCheckpointMixin
+from kmeans_tpu.obs import trace as obs_trace
+from kmeans_tpu.obs.heartbeat import note_progress as obs_note_progress
 from kmeans_tpu.utils.logging import IterationLogger
 from kmeans_tpu.utils.validation import check_finite_array, validate_params
 from kmeans_tpu.utils import checkpoint as ckpt
@@ -74,7 +76,7 @@ from kmeans_tpu.models.init import _EpochReservoir
 # for unusual multi-model processes.
 from kmeans_tpu.utils.cache import LRUCache
 
-_STEP_CACHE = LRUCache(64)
+_STEP_CACHE = LRUCache(64, name="kmeans._STEP_CACHE")
 
 
 class DispatchLatencyHint(UserWarning):
@@ -88,7 +90,12 @@ class DispatchLatencyHint(UserWarning):
 # One-time hint bookkeeping + measurement caches for host_loop='auto'.
 _HINTS_EMITTED: set = set()
 _RTT_CACHE: dict = {}          # device-id tuple -> measured RTT seconds
-_AUTO_CACHE = LRUCache(64)     # step key -> measured step seconds
+# key -> measured step seconds.  compile_spans=False: the factory RUNS
+# two training steps (a measurement, not a program build) — tracing it
+# as 'compile' would inflate the TTFI compile row on high-RTT
+# platforms, where host_loop='auto' actually probes (review finding).
+_AUTO_CACHE = LRUCache(64, name="kmeans._AUTO_CACHE",
+                       compile_spans=False)
 
 
 def _hint_once(kind: str, msg: str) -> None:
@@ -1145,17 +1152,24 @@ class KMeans(AutoCheckpointMixin):
                     # np.asarray pays a full host round trip on tunneled
                     # platforms, and an early transfer would also serialize
                     # the remaining restarts' dispatches behind it.
-                    outs = [step_fn(pts, w, cents_dev[i])
-                            for i in range(len(active))]
-                    for i, st in enumerate(outs):
-                        s_h, c_h, sse_h, fd_h, fp_h = jax.device_get(
-                            (st.sums, st.counts, st.sse, st.farthest_dist,
-                             st.farthest_point))
-                        sums[i] += np.asarray(s_h, dtype=acc)[: self.k]
-                        counts[i] += np.asarray(c_h, dtype=acc)[: self.k]
-                        sse[i] += float(sse_h)
-                        if float(fd_h) > far[i][0]:
-                            far[i] = (float(fd_h), np.asarray(fp_h, dtype=acc))
+                    # The 'dispatch' span covers dispatch + transfer
+                    # (the device_get is the sync point; a span around
+                    # the async dispatch alone would time queueing).
+                    with obs_trace.span("dispatch", tag="stream/block",
+                                        restarts=len(active)):
+                        outs = [step_fn(pts, w, cents_dev[i])
+                                for i in range(len(active))]
+                        for i, st in enumerate(outs):
+                            s_h, c_h, sse_h, fd_h, fp_h = jax.device_get(
+                                (st.sums, st.counts, st.sse,
+                                 st.farthest_dist, st.farthest_point))
+                            sums[i] += np.asarray(s_h, dtype=acc)[: self.k]
+                            counts[i] += np.asarray(c_h,
+                                                    dtype=acc)[: self.k]
+                            sse[i] += float(sse_h)
+                            if float(fd_h) > far[i][0]:
+                                far[i] = (float(fd_h),
+                                          np.asarray(fp_h, dtype=acc))
             if n_seen == 0:
                 raise ValueError(
                     f"make_blocks() yielded no rows on iteration "
@@ -1265,11 +1279,21 @@ class KMeans(AutoCheckpointMixin):
         cents_dev = self._put_centroids(centroids, mesh, model_shards)
         for iteration in range(start_iter, self.max_iter):
             iter_start = time.perf_counter()
-            stats: StepStats = step_fn(ds.points, ds.weights, cents_dev)
-            # Host does exactly the driver's O(k*D) work
-            # (kmeans_spark.py:181-188) — in float64 for stable division.
-            sums = np.asarray(stats.sums, dtype=np.float64)[: self.k]
-            counts = np.asarray(stats.counts, dtype=np.float64)[: self.k]
+            # The 'dispatch' span covers the dispatch AND the host
+            # materialization of its statistics (JAX dispatch is async —
+            # a span around the call alone would time µs of queueing,
+            # not the step; the np.asarray below is the sync point).
+            with obs_trace.span("dispatch", tag="lloyd/step",
+                                iteration=iteration):
+                stats: StepStats = step_fn(ds.points, ds.weights,
+                                           cents_dev)
+                # Host does exactly the driver's O(k*D) work
+                # (kmeans_spark.py:181-188) — in float64 for stable
+                # division.
+                sums = np.asarray(stats.sums,
+                                  dtype=np.float64)[: self.k]
+                counts = np.asarray(stats.counts,
+                                    dtype=np.float64)[: self.k]
             centroids, max_shift = self._finish_lloyd_iteration(
                 centroids, sums, counts,
                 float(stats.sse) if self.compute_sse else 0.0, stats, ds,
@@ -1340,6 +1364,11 @@ class KMeans(AutoCheckpointMixin):
         self.cluster_sizes_ = sizes
         self.iterations_run = iteration + 1          # fixes SURVEY §2.1 bug
         self.iter_times_.append(time.perf_counter() - iter_start)
+        # Heartbeat (ISSUE 11): the host loop already materialized this
+        # iteration's state — the progress record reads attrs only,
+        # zero extra dispatches (no-op with no heartbeat installed).
+        obs_note_progress(self, phase="iteration",
+                                    shift=max_shift)
         return new_centroids, max_shift
 
     def _fit_on_device(self, ds, centroids, start_iter, mesh, model_shards,
@@ -1483,6 +1512,11 @@ class KMeans(AutoCheckpointMixin):
                       if n_iters else 0.0, list(self.cluster_sizes_),
                       self.sse_history[-1] if
                       (self.compute_sse and self.sse_history) else None)
+        # End-of-fit heartbeat (ISSUE 11): a one-dispatch fit has no
+        # iteration boundaries (and, unsegmented, no checkpoint ones),
+        # so the completion record is its progress channel.
+        obs_note_progress(self, phase="fit",
+                          shift=float(shift_hist[-1]) if n_iters else 0.0)
         if n_iters and shift_hist[-1] < self.tolerance:
             log.converged(self.iterations_run)
 
@@ -1518,10 +1552,12 @@ class KMeans(AutoCheckpointMixin):
         self.iterations_run = 0
         self.iter_times_ = []
         fit_start = time.perf_counter()
-        out = fit_fn(
-            ds.points, ds.weights, cents_dev,
-            np.stack([dist._empty_seed_array(s, 0, self.max_iter)
-                      for s in seeds]))
+        with obs_trace.span("dispatch", tag="fit/multi", restarts=R):
+            out = fit_fn(
+                ds.points, ds.weights, cents_dev,
+                np.stack([dist._empty_seed_array(s, 0, self.max_iter)
+                          for s in seeds]))
+            out = jax.block_until_ready(out)
         if guarded:
             *out, n_corr = out
             self.bf16_guard_corrected_rows_ = int(n_corr)

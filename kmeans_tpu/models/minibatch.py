@@ -54,6 +54,8 @@ import numpy as np
 
 from kmeans_tpu.models.kmeans import KMeans, _STEP_CACHE
 from kmeans_tpu.models.init import resolve_init
+from kmeans_tpu.obs import trace as obs_trace
+from kmeans_tpu.obs.heartbeat import note_progress as obs_note_progress
 from kmeans_tpu.utils.logging import IterationLogger
 
 _SAMPLING = ("device", "host")
@@ -354,24 +356,28 @@ class MiniBatchKMeans(KMeans):
             t0 = time.perf_counter()
             do_re = bool(n_cand) and ((iteration + 1) % re_every == 0)
             # Batch i is a pure function of (seed, i): resume continues the
-            # exact sequence an uninterrupted run would draw.
-            out = (step_cand_fn if do_re else step_fn)(
-                ds.points, ds.weights,
-                self._put_centroids(
-                    centroids.astype(self.dtype), mesh, model_shards),
-                base_key, np.int32(iteration))
-            # One combined transfer (each separate np.asarray pays a full
-            # host round trip on tunneled platforms).
-            if do_re:
-                stats, cand_rows, cand_valid = out
-                sums_d, counts_d, sse_d, cand_rows, cand_valid = \
-                    jax.device_get((stats.sums, stats.counts, stats.sse,
-                                    cand_rows, cand_valid))
-            else:
-                stats = out
-                sums_d, counts_d, sse_d = jax.device_get(
-                    (stats.sums, stats.counts, stats.sse))
-                cand_rows = cand_valid = None
+            # exact sequence an uninterrupted run would draw.  The
+            # 'dispatch' span covers dispatch + the combined transfer
+            # (the device_get is the sync point).
+            with obs_trace.span("dispatch", tag="minibatch/step",
+                                iteration=iteration):
+                out = (step_cand_fn if do_re else step_fn)(
+                    ds.points, ds.weights,
+                    self._put_centroids(
+                        centroids.astype(self.dtype), mesh, model_shards),
+                    base_key, np.int32(iteration))
+                # One combined transfer (each separate np.asarray pays a
+                # full host round trip on tunneled platforms).
+                if do_re:
+                    stats, cand_rows, cand_valid = out
+                    sums_d, counts_d, sse_d, cand_rows, cand_valid = \
+                        jax.device_get((stats.sums, stats.counts,
+                                        stats.sse, cand_rows, cand_valid))
+                else:
+                    stats = out
+                    sums_d, counts_d, sse_d = jax.device_get(
+                        (stats.sums, stats.counts, stats.sse))
+                    cand_rows = cand_valid = None
             sums = np.asarray(sums_d, dtype=np.float64)[: self.k]
             counts = np.asarray(counts_d, dtype=np.float64)[: self.k]
             batch_w = float(counts.sum())
@@ -441,9 +447,16 @@ class MiniBatchKMeans(KMeans):
                     history_sse=self.compute_sse,
                     reassignment_ratio=float(self.reassignment_ratio),
                     reassign_every=re_every))
-            cents, seen_out, n_iters, sse_hist, shift_hist, counts = \
-                fit_fn(ds.points, ds.weights, cents_dev, base_key,
-                       np.int32(it0), seen_arr)
+            # One 'segment'/'dispatch' span pair per segment (the
+            # mini-batch device loop dispatches directly — it has no
+            # OOM-backoff wrapper — so the span pair mirrors
+            # AutoCheckpointMixin._dispatch_oom_safe's shape).
+            with obs_trace.span("segment", index=len(sse_parts)), \
+                    obs_trace.span("dispatch", tag="fit/segment"):
+                cents, seen_out, n_iters, sse_hist, shift_hist, counts = \
+                    jax.block_until_ready(
+                        fit_fn(ds.points, ds.weights, cents_dev, base_key,
+                               np.int32(it0), seen_arr))
             n = int(n_iters)
             it0 += n
             sse_parts.append(np.asarray(sse_hist, np.float64)[:n])
@@ -671,6 +684,11 @@ class MiniBatchKMeans(KMeans):
         self.cluster_sizes_ = counts.astype(np.int64)
         self.iterations_run = iteration + 1
         self._seen = seen.copy()
+        # Heartbeat (ISSUE 11): both mini-batch host loops finish their
+        # iteration here — state is host-side already, zero extra
+        # dispatches (no-op with no heartbeat installed).
+        obs_note_progress(self, phase="iteration",
+                                    shift=max_shift)
         return new_centroids, seen, max_shift
 
     def partial_fit(self, X, y=None, *,

@@ -1,0 +1,67 @@
+"""Unified telemetry layer (ISSUE 11): spans, metrics, heartbeats.
+
+Three parts, one discipline:
+
+* :mod:`kmeans_tpu.obs.trace` — process-wide span tracing of the
+  lifecycle phases an operator waits on (place/stage/compile/seed/
+  dispatch/segment/checkpoint/io/serve), exported as JSONL and Chrome
+  ``trace_event`` timelines.
+* :mod:`kmeans_tpu.obs.metrics_registry` — typed counters/gauges/
+  histograms the existing ad-hoc signals write through (model audit
+  attrs and serving counters keep their public APIs).
+* :mod:`kmeans_tpu.obs.heartbeat` — opt-in fit-progress records to a
+  callback or JSONL file, driven from boundaries the fit already pays
+  (zero extra dispatches) — the health channel ROADMAP item 1's
+  orchestration loop consumes.
+
+Telemetry is OFF by default and the disabled path is a true no-op
+(one None check); ``obs=0`` is the bit-exact parity oracle, pinned for
+all five model families by tests/test_obs.py.  Quick start::
+
+    from kmeans_tpu import obs
+
+    with obs.tracing("fit.jsonl") as tr:
+        model.fit(X)
+    print(obs.format_phase_table(obs.time_to_first_iteration(
+        tr.records())))
+
+The trace/metrics/heartbeat modules are pure stdlib (no jax/numpy), so
+every layer — including ``utils.cache``, which emits the compile spans
+— can import them without cost or cycles; the report helpers (which
+pull ``utils.profiling``) load lazily.
+"""
+
+from kmeans_tpu.obs.trace import (SPAN_NAMES, TraceReadError, Tracer,
+                                  chrome_events, event, get_tracer,
+                                  read_jsonl, span, summarize, tracing)
+from kmeans_tpu.obs.metrics_registry import (REGISTRY, Counter, Gauge,
+                                             Histogram, MetricsRegistry,
+                                             registry)
+# NOTE: re-exporting the `heartbeat` SCOPE function shadows the
+# `kmeans_tpu.obs.heartbeat` submodule as a package attribute —
+# `from kmeans_tpu.obs import heartbeat` yields the function.  In-
+# package consumers therefore import names straight from the
+# submodule (`from kmeans_tpu.obs.heartbeat import note_progress`),
+# which resolves via sys.modules and is immune to the shadowing.
+from kmeans_tpu.obs.heartbeat import (Heartbeat, get_heartbeat, heartbeat,
+                                      note_progress)
+
+__all__ = [
+    "SPAN_NAMES", "TraceReadError", "Tracer", "chrome_events", "event",
+    "get_tracer", "read_jsonl", "span", "summarize", "tracing",
+    "REGISTRY", "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "registry", "Heartbeat", "get_heartbeat", "heartbeat",
+    "note_progress",
+    # lazy (pull utils.profiling, which imports jax):
+    "ttfi_ladder", "time_to_first_iteration", "format_phase_table",
+]
+
+_LAZY_REPORT = ("ttfi_ladder", "time_to_first_iteration",
+                "format_phase_table", "TTFI_PHASES")
+
+
+def __getattr__(name):
+    if name in _LAZY_REPORT:
+        from kmeans_tpu.obs import report
+        return getattr(report, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,294 @@
+"""Fit-lifecycle heartbeats: periodic progress records for orchestration.
+
+ROADMAP item 1's elastic multi-host orchestration loop needs a
+health/progress channel: "is the fit alive, how far along, what is it
+doing" — without adding dispatches to the training loop.  This module
+is that channel, opt-in and zero-cost when off:
+
+* Models report progress at the host-sync points they ALREADY pay —
+  host-loop iteration finishes, device-loop segment boundaries, and
+  checkpoint writes (``AutoCheckpointMixin._write_autockpt``) — via
+  :func:`note_progress`, a no-op unless a :class:`Heartbeat` is
+  installed.  Zero extra dispatches by construction: every record is
+  assembled from host-side attrs the boundary already materialized.
+* A :class:`Heartbeat` turns those reports into records on a JSONL
+  file and/or a callback.  With ``interval_s`` set, a background
+  thread additionally re-emits the latest record on that cadence
+  (stamped ``"tick": true``) — the liveness signal an orchestrator
+  watches during a long device segment, when no boundary fires.  The
+  thread is joined on ``close()`` (the prefetch shutdown discipline;
+  the ``thread`` lint rule covers it).
+
+Record schema (one JSON object per emission)::
+
+    {"ts": <wall seconds>, "mono": <monotonic seconds>,
+     "family": "kmeans", "model_class": "KMeans", "k": 64,
+     "phase": "iteration" | "segment" | "checkpoint" | "split" | ...,
+     "iteration": 12, "segment": 3, "shift": 1.3e-3,
+     "inertia": 8.1e4, "effective_chunk": 65536, "oom_backoffs": 0,
+     "dispatch_counts": {...},        # registry dispatch.* counters
+     "phase_elapsed": {...},          # tracer per-phase self seconds
+     "tick": true                     # only on timer re-emissions
+    }
+
+Fields are best-effort: a family without an attr simply omits it.
+Pure stdlib; never imports models or jax.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import Callable, Optional
+
+from kmeans_tpu.obs import trace as _trace
+from kmeans_tpu.obs.metrics_registry import registry as _registry
+
+__all__ = ["Heartbeat", "heartbeat", "note_progress", "get_heartbeat"]
+
+#: Process-wide active heartbeat (None = off, the default).
+_ACTIVE: Optional["Heartbeat"] = None
+
+
+#: model attr -> record field, the host-side state a boundary already
+#: materialized (never a device read).
+_MODEL_FIELDS = (
+    ("iterations_run", "iteration"),
+    ("n_iter_", "iteration"),
+    ("effective_chunk_", "effective_chunk"),
+    ("oom_backoffs_", "oom_backoffs"),
+    ("io_retries_used_", "io_retries"),
+    ("checkpoint_segments_", "checkpoint_segments"),
+    ("shift_", "shift"),
+    ("lower_bound_", "lower_bound"),
+)
+
+
+def _model_record(model) -> dict:
+    """Best-effort progress fields from a model's host-side attrs."""
+    rec = {"model_class": type(model).__name__}
+    spec_family = {"GaussianMixture": "gmm"}
+    rec["family"] = spec_family.get(rec["model_class"], "kmeans")
+    k = getattr(model, "k", None) or getattr(model, "n_components", None)
+    if k is not None:
+        rec["k"] = int(k)
+    for attr, field in _MODEL_FIELDS:
+        v = getattr(model, attr, None)
+        if v is not None and field not in rec:
+            try:
+                rec[field] = float(v) if field in ("shift", "lower_bound") \
+                    else int(v)
+            except (TypeError, ValueError):
+                pass
+    hist = getattr(model, "sse_history", None)
+    if hist:
+        rec["inertia"] = float(hist[-1])
+        if len(hist) >= 2 and "shift" not in rec:
+            rec["sse_delta"] = float(hist[-1] - hist[-2])
+    return rec
+
+
+def note_progress(model=None, **fields) -> None:
+    """Report one progress point to the active heartbeat; a true no-op
+    (one None check) when none is installed — the hook every model
+    boundary calls unconditionally."""
+    hb = _ACTIVE
+    if hb is None:
+        return
+    rec = _model_record(model) if model is not None else {}
+    rec.update(fields)
+    hb.beat(rec)
+
+
+def get_heartbeat() -> Optional["Heartbeat"]:
+    return _ACTIVE
+
+
+class Heartbeat:
+    """Progress-record sink: JSONL file and/or callback, optional timer.
+
+    Parameters
+    ----------
+    path : file path for JSONL output (opened lazily, line-buffered,
+        closed by ``close()``); None = no file.
+    callback : ``callback(record: dict)`` invoked per emission (the
+        orchestration-loop hook); exceptions are swallowed after
+        counting (``hb.callback_errors``) — a broken observer must
+        never kill a healthy fit.
+    interval_s : with a value, a background thread re-emits the latest
+        record every ``interval_s`` seconds (stamped ``tick: true``)
+        between boundary reports — the liveness channel.  None (default)
+        = boundary-driven only, no thread.
+    min_period_s : boundary reports are throttled to at most one per
+        this many seconds (0 = every boundary); the latest record
+        always wins, and ``close()`` flushes it so the final state is
+        never lost to the throttle.
+    """
+
+    def __init__(self, path=None, callback: Optional[Callable] = None,
+                 *, interval_s: Optional[float] = None,
+                 min_period_s: float = 0.0):
+        if interval_s is not None and interval_s <= 0:
+            raise ValueError(f"interval_s must be positive or None, got "
+                             f"{interval_s!r}")
+        self.path = path
+        self.callback = callback
+        self.interval_s = interval_s
+        self.min_period_s = float(min_period_s)
+        self.emitted = 0
+        self.callback_errors = 0
+        self.sink_errors = 0
+        self._file = None
+        self._file_failed = False
+        # _lock guards the cheap bookkeeping state only; emission (file
+        # IO + user callback) runs under the REENTRANT _emit_lock so a
+        # slow or re-entrant observer can never stall a boundary beat's
+        # state update or deadlock against itself (review finding).
+        self._lock = threading.Lock()
+        self._emit_lock = threading.RLock()
+        self._latest: Optional[dict] = None
+        self._latest_unflushed = False
+        self._last_emit = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        if interval_s is not None:
+            self._thread = threading.Thread(
+                target=self._tick_loop, name="kmeans_tpu-heartbeat",
+                daemon=True)
+            self._thread.start()
+
+    # -------------------------------------------------------- emission
+    def beat(self, record: dict) -> None:
+        """One boundary report: stamp timestamps, remember as latest,
+        emit (throttled by ``min_period_s``)."""
+        now = time.monotonic()
+        rec = dict(record)
+        rec.setdefault("ts", time.time())
+        rec.setdefault("mono", now)
+        tr = _trace.get_tracer()
+        if tr is not None:
+            rec.setdefault("phase_elapsed", tr.phase_totals())
+        counts = {name: m["value"]
+                  for name, m in _registry().snapshot().items()
+                  if name.startswith("dispatch.")}
+        if counts:
+            rec.setdefault("dispatch_counts", counts)
+        with self._lock:
+            if self._closed:
+                return
+            self._latest = rec
+            if self.min_period_s and \
+                    now - self._last_emit < self.min_period_s:
+                self._latest_unflushed = True
+                return
+            self._last_emit = now
+            self._latest_unflushed = False
+        self._emit(rec)             # IO/callback OUTSIDE the state lock
+
+    def _emit(self, rec: dict) -> None:
+        """Deliver one record to the sinks.  Serialized by the
+        reentrant ``_emit_lock`` (file lines never interleave across
+        the beat and tick threads; a callback that re-enters
+        ``note_progress`` recurses instead of deadlocking).  BOTH sinks
+        are exception-isolated — a full disk or an unserializable user
+        field must never kill the fit being observed; failures are
+        counted (``sink_errors``/``callback_errors``) and, for the
+        file, the sink is disabled after the first failure so a dead
+        disk is not retried per record."""
+        with self._emit_lock:
+            self.emitted += 1
+            # A beat that raced close() must not reopen the closed file
+            # (close() flushes the throttled tail BEFORE flipping
+            # _closed, so the tail still lands).
+            if self.path is not None and not self._file_failed \
+                    and not self._closed:
+                try:
+                    if self._file is None:
+                        self._file = open(self.path, "a")
+                    # default=str: user fields (numpy scalars, paths)
+                    # serialize best-effort rather than raising.
+                    self._file.write(json.dumps(rec, default=str) + "\n")
+                    self._file.flush()
+                except Exception:   # noqa: BLE001 — observer isolation
+                    self.sink_errors += 1
+                    self._file_failed = True
+            if self.callback is not None:
+                try:
+                    self.callback(rec)
+                except Exception:   # noqa: BLE001 — observer isolation
+                    self.callback_errors += 1
+
+    def _tick_loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            with self._lock:
+                if self._closed or self._latest is None:
+                    continue
+                rec = dict(self._latest)
+                rec["tick"] = True
+                rec["ts"] = time.time()
+                rec["mono"] = time.monotonic()
+                self._last_emit = time.monotonic()
+                self._latest_unflushed = False
+            self._emit(rec)
+
+    # ------------------------------------------------------- lifecycle
+    def close(self) -> None:
+        """Flush the last throttled record, stop + JOIN the timer
+        thread, close the file.  Idempotent."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        with self._lock:
+            if self._closed:
+                return
+            tail = self._latest if self._latest_unflushed else None
+            self._latest_unflushed = False
+        if tail is not None:
+            self._emit(tail)
+        with self._lock:
+            self._closed = True
+        with self._emit_lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+@contextlib.contextmanager
+def heartbeat(hb_or_path=None, **kwargs):
+    """Install a heartbeat for the ``with`` body (nested scopes shadow);
+    the heartbeat is CLOSED on exit when this scope constructed it.
+
+    Usage::
+
+        with obs.heartbeat("progress.jsonl", interval_s=5.0) as hb:
+            model.fit(X, checkpoint_every=8, checkpoint_path=p)
+        # progress.jsonl: one record per boundary + 5 s liveness ticks
+    """
+    global _ACTIVE
+    own = not isinstance(hb_or_path, Heartbeat)
+    if not own and kwargs:
+        # A pre-built Heartbeat carries its own configuration; silently
+        # ignoring kwargs here would e.g. drop an interval_s the caller
+        # expects liveness ticks from (review finding).
+        raise ValueError(
+            f"heartbeat() got keyword arguments {sorted(kwargs)} "
+            f"alongside an existing Heartbeat instance — configure the "
+            f"instance at construction, or pass a path/None here")
+    hb = Heartbeat(hb_or_path, **kwargs) if own else hb_or_path
+    prev, _ACTIVE = _ACTIVE, hb
+    try:
+        yield hb
+    finally:
+        _ACTIVE = prev
+        if own:
+            hb.close()

@@ -1,0 +1,108 @@
+"""Time-to-first-iteration report: the per-phase table, from spans alone.
+
+ROADMAP item 5's attack on the 47-324 s "compile+warmup" window needs a
+measured decomposition of what an operator waits for between calling
+``fit`` and the first iteration actually running: dataset placement,
+program build, seeding, and the first dispatch (which, under JAX's lazy
+jit, carries the XLA executable build).  Before ISSUE 11 that
+decomposition existed only as prose in docs/PERFORMANCE.md; this module
+produces it from a trace — run any fit under ``obs.tracing()`` and the
+span records alone yield the table, formatted through the SAME
+``phase_ceiling_table`` rule engine the r13 per-iteration ceiling table
+uses (share-of-total, implied ceiling if the phase were free, the
+committed >= 15% "actionable" decision rule).
+
+Phase attribution rules (deliberate, documented):
+
+* A phase row sums the SELF time (nested children excluded —
+  ``trace.self_times``) of its spans that START before the first
+  ``dispatch`` span starts — the pre-first-iteration window.
+* ``first_dispatch`` is the first ``dispatch`` span's full duration.
+  Under lazy jit it contains trace+lower+XLA-compile+execute of
+  iteration 1; keeping it a single honest row (instead of pretending
+  spans can split it) is why it is named ``first_dispatch`` and not
+  ``iteration``.
+* A segment span is NEVER a phase row (it wraps dispatch attempts);
+  an OOM-replayed segment therefore cannot double-count.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from kmeans_tpu.obs import trace as _trace
+
+__all__ = ["ttfi_ladder", "time_to_first_iteration",
+           "format_phase_table", "TTFI_PHASES"]
+
+#: Lifecycle order of the pre-first-iteration phase rows.
+TTFI_PHASES = ("place", "stage", "trace", "compile", "seed")
+
+
+def ttfi_ladder(records: List[dict]) -> List[dict]:
+    """Span records -> a ``measure_phase_ladder``-shaped ladder
+    (``{"phase", "seconds", "cumulative", "spread"}`` rows in lifecycle
+    order, ending with ``first_dispatch``).  ``spread`` is 0.0: a trace
+    is one observed run, not a repeated measurement — re-trace to
+    estimate variance.  Raises ``ValueError`` when the trace holds no
+    ``dispatch`` span (nothing ran; there is no first iteration to
+    report)."""
+    spans = [r for r in records if r.get("kind") == "span"]
+    dispatches = sorted((s for s in spans if s["name"] == "dispatch"),
+                        key=lambda s: s["t0"])
+    if not dispatches:
+        raise ValueError(
+            "trace holds no 'dispatch' span — nothing was dispatched, "
+            "so there is no first iteration to decompose")
+    fd = dispatches[0]
+    selfs = _trace.self_times(records)
+    totals: Dict[str, float] = {name: 0.0 for name in TTFI_PHASES}
+    for s in spans:
+        if s["name"] in totals and s["t0"] <= fd["t0"]:
+            totals[s["name"]] += selfs[s["id"]]
+    ladder = []
+    cum = 0.0
+    for name in TTFI_PHASES:
+        cum += totals[name]
+        ladder.append({"phase": name, "seconds": totals[name],
+                       "cumulative": cum, "spread": 0.0})
+    cum += fd.get("dur") or 0.0
+    ladder.append({"phase": "first_dispatch",
+                   "seconds": fd.get("dur") or 0.0,
+                   "cumulative": cum, "spread": 0.0})
+    return ladder
+
+
+def time_to_first_iteration(records: List[dict],
+                            decision_share: Optional[float] = None
+                            ) -> List[dict]:
+    """The publishable per-phase time-to-first-iteration table: one row
+    per phase with ``ms`` / ``share`` / ``implied_ceiling_speedup`` /
+    ``actionable`` — ``utils.profiling.phase_ceiling_table`` applied to
+    the span-derived ladder, so the TTFI artifact and the r13 per-
+    iteration ceiling table share one schema and one committed decision
+    rule (>= ``PHASE_DECISION_SHARE`` of the total marks the phase as
+    the next attack surface for ROADMAP item 5)."""
+    from kmeans_tpu.utils import profiling
+    share = profiling.PHASE_DECISION_SHARE if decision_share is None \
+        else decision_share
+    return profiling.phase_ceiling_table(ttfi_ladder(records),
+                                         decision_share=share)
+
+
+def format_phase_table(rows: List[dict], title: str =
+                       "time-to-first-iteration") -> str:
+    """Fixed-width text rendering of a phase table (CLI + dry-run
+    artifact)."""
+    lines = [f"{title}:",
+             f"  {'phase':<16} {'ms':>10} {'share':>7} "
+             f"{'ceiling':>8}  actionable"]
+    for r in rows:
+        ceil = r.get("implied_ceiling_speedup")
+        lines.append(
+            f"  {r['phase']:<16} {r['ms']:>10.2f} {r['share']:>6.1%} "
+            f"{(f'{ceil:.3f}x' if ceil is not None else '-'):>8}  "
+            f"{'YES' if r.get('actionable') else 'no'}")
+    total_ms = sum(r["ms"] for r in rows)
+    lines.append(f"  {'TOTAL':<16} {total_ms:>10.2f}")
+    return "\n".join(lines)

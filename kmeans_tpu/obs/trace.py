@@ -1,0 +1,435 @@
+"""Span tracing: timestamped lifecycle phases, JSONL + Chrome export.
+
+The repo's observability story before ISSUE 11 was a dozen ad-hoc
+signals (``note_dispatch`` labels, ``oom_backoffs_``, serving counters,
+the recompilation sentinel) with no shared schema, no timestamps, and
+no export path.  This module is the shared substrate: a process-wide
+:class:`Tracer` records nested, timestamped SPANS for the lifecycle
+phases an operator actually waits on, and exports them as JSONL (one
+record per line — the ``python -m kmeans_tpu trace summarize`` input)
+and Chrome ``trace_event`` JSON (load in ``chrome://tracing`` or
+Perfetto for the timeline view).
+
+Span taxonomy (the names instrumented call sites use; full catalog with
+the lifecycle diagram in docs/OBSERVABILITY.md):
+
+* ``place`` — dataset upload onto the mesh (``sharding.to_device``).
+* ``stage`` — per-block host->device staging (``shard_points``; the
+  streamed-fit producer thread emits these from its own ``tid``).
+* ``compile`` — a compile-cache MISS: the ``*_STEP_CACHE``-class
+  factory building a program (``utils.cache.LRUCache.get_or_create``
+  emits one per miss, named with the cache and key; the XLA executable
+  build itself is lazy — it lands inside the FIRST ``dispatch`` span
+  after the miss, which is why the time-to-first-iteration report keeps
+  ``first_dispatch`` as its own row).
+* ``trace`` — builder-side program construction inside a compile span
+  (``distributed``/``gmm_step`` builders).
+* ``seed`` — initialization draws (``resolve_init``, GMM init).
+* ``dispatch`` — one host->device dispatch the host then waits on
+  (device-loop segments, host-loop steps); ``note_dispatch`` labels
+  additionally land as instant events under their own names.
+* ``segment`` — one checkpoint segment of a segmented device fit,
+  wrapping its dispatch ATTEMPTS (an OOM-backoff replay adds attempt
+  spans inside the same segment span — never a second segment).
+* ``checkpoint.save`` / ``checkpoint.restore`` — rotating checkpoint
+  writes and resume loads (``utils.checkpoint``).
+* ``io.block`` — one streamed block read (``data.io``).
+* ``serve.request`` / ``serve.flush`` — serving-engine dispatches and
+  micro-batch queue flushes.
+
+Disabled-path contract (the ``obs=0`` parity oracle): with no tracer
+installed, :func:`span` returns a shared null context manager and
+:func:`event` returns immediately — no allocation, no lock, no record.
+Tracing never touches model arithmetic either way, so a traced fit is
+bit-identical to an untraced one (pinned for all five families by
+tests/test_obs.py).
+
+Pure stdlib — importable from every layer (including the linter-adjacent
+``utils.cache``) without pulling in jax/numpy.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from kmeans_tpu.obs.metrics_registry import nearest_rank
+
+__all__ = ["Tracer", "span", "event", "tracing", "get_tracer",
+           "read_jsonl", "summarize", "SPAN_NAMES", "TraceReadError"]
+
+#: The span taxonomy (documentation + the CLI's table ordering; call
+#: sites may add dotted sub-names like ``checkpoint.save``).
+SPAN_NAMES = (
+    "place", "stage", "compile", "trace", "seed", "dispatch", "segment",
+    "checkpoint.save", "checkpoint.restore", "io.block",
+    "serve.request", "serve.flush",
+)
+
+
+class TraceReadError(ValueError):
+    """A trace JSONL file is unreadable or malformed (the CLI's exit-2
+    classification)."""
+
+
+class _NullSpan:
+    """The disabled-path context manager: one shared instance, no state."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+#: Process-wide active tracer (None = telemetry off, the default).
+#: Installed/restored by :func:`tracing`; read by the module-level
+#: fast paths.  A plain attribute (not thread-local): one fit's spans
+#: may come from several threads (the prefetch producer stages blocks),
+#: and they must all land in the same trace.
+_TRACER: Optional["Tracer"] = None
+
+
+class Tracer:
+    """Process-wide span recorder.
+
+    Records are plain dicts (JSON-ready).  Span nesting is tracked with
+    a PER-THREAD stack, so spans opened on the prefetch producer thread
+    nest among themselves and never corrupt the fit thread's stack.
+    Timestamps are ``time.perf_counter()`` relative to the tracer's
+    start (monotonic, sub-µs); ``wall0`` anchors them to wall time for
+    cross-process correlation.
+    """
+
+    def __init__(self):
+        self.wall0 = time.time()
+        self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
+        self._records: List[dict] = []
+        self._tls = threading.local()
+        self._next_id = 0
+        # Incremental per-name SELF-time accumulators: +dur on close,
+        # -dur from the enclosing span's name — so phase_totals() is
+        # O(names), not a re-walk of every record (the heartbeat reads
+        # it per boundary; a full summarize() there would make
+        # tracing+heartbeat quadratic in iterations — review finding).
+        self._phase_self: Dict[str, float] = {}
+
+    # ------------------------------------------------------------ time
+    def _now(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    # ----------------------------------------------------------- spans
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        """One timed, nested span.  Exceptions propagate (the span still
+        closes, stamped ``error`` with the exception type) — tracing a
+        failing fit must record the failure, never mask it."""
+        stack = self._stack()
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+        parent = stack[-1] if stack else None
+        rec = {"kind": "span", "name": name, "id": sid,
+               "parent": parent["id"] if parent else None,
+               "depth": len(stack),
+               "tid": threading.get_ident(),
+               "t0": self._now(), "t1": None, "dur": None}
+        if attrs:
+            rec["attrs"] = _jsonable(attrs)
+        stack.append(rec)
+        try:
+            yield rec
+        except BaseException as e:
+            rec["error"] = type(e).__name__
+            raise
+        finally:
+            stack.pop()
+            rec["t1"] = self._now()
+            rec["dur"] = rec["t1"] - rec["t0"]
+            with self._lock:
+                self._records.append(rec)
+                ps = self._phase_self
+                ps[name] = ps.get(name, 0.0) + rec["dur"]
+                if parent is not None:
+                    # The enclosing span will add its FULL duration
+                    # when it closes; subtracting the child here keeps
+                    # the accumulator a self-time total.
+                    pname = parent["name"]
+                    ps[pname] = ps.get(pname, 0.0) - rec["dur"]
+
+    def event(self, name: str, **attrs) -> None:
+        """One instant (zero-duration) event at the current nesting."""
+        stack = self._stack()
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+            self._records.append({
+                "kind": "event", "name": name, "id": sid,
+                "parent": stack[-1]["id"] if stack else None,
+                "depth": len(stack), "tid": threading.get_ident(),
+                "t0": self._now(), "t1": None, "dur": 0.0,
+                **({"attrs": _jsonable(attrs)} if attrs else {})})
+
+    def instant_span(self, name: str, **attrs) -> None:
+        """A zero-length SPAN (not an event): what the recompilation
+        sentinel emits for cache growth it detected after the fact, so
+        a sentinel violation appears on the timeline as a ``compile``
+        span naming the cache even though the miss itself was not
+        traced."""
+        with self.span(name, **attrs):
+            pass
+
+    # --------------------------------------------------------- reading
+    def records(self) -> List[dict]:
+        """Snapshot of all closed records (spans close at exit; an open
+        span is not yet visible)."""
+        with self._lock:
+            return list(self._records)
+
+    def phase_totals(self) -> Dict[str, float]:
+        """name -> total SELF seconds (nested child time excluded) —
+        the heartbeat's elapsed-per-phase payload.  O(names) from the
+        incremental accumulators, never a record re-walk; a name whose
+        enclosing span is still open may read transiently low (its
+        children already subtracted) — clamped at 0, and exact again
+        once the parent closes.  ``summarize(records())`` is the exact
+        post-hoc computation."""
+        with self._lock:
+            return {name: max(v, 0.0)
+                    for name, v in self._phase_self.items()}
+
+    # --------------------------------------------------------- exports
+    def write_jsonl(self, path) -> None:
+        """One JSON record per line; first line is a header record
+        carrying the wall-clock anchor and pid."""
+        with open(path, "w") as f:
+            self._dump_jsonl(f)
+
+    def to_jsonl(self) -> str:
+        buf = io.StringIO()
+        self._dump_jsonl(buf)
+        return buf.getvalue()
+
+    def _dump_jsonl(self, f) -> None:
+        f.write(json.dumps({"kind": "header", "wall0": self.wall0,
+                            "pid": os.getpid(),
+                            "format": "kmeans_tpu.trace.v1"}) + "\n")
+        for rec in self.records():
+            f.write(json.dumps(rec) + "\n")
+
+    def write_chrome(self, path) -> None:
+        with open(path, "w") as f:
+            json.dump({"traceEvents": chrome_events(self.records()),
+                       "displayTimeUnit": "ms"}, f)
+
+
+def _jsonable(attrs: dict) -> dict:
+    """Attrs must serialize; anything exotic is repr'd (truncated) so a
+    span can never make the export throw."""
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, (str, int, float, bool)) or v is None:
+            out[k] = v
+        else:
+            out[k] = repr(v)[:120]
+    return out
+
+
+def chrome_events(records: List[dict]) -> List[dict]:
+    """Chrome ``trace_event`` array from trace records: complete events
+    (``ph='X'``) for spans, instant events (``ph='i'``) for events —
+    the schema chrome://tracing and Perfetto load directly."""
+    pid = os.getpid()
+    out = []
+    for rec in records:
+        if rec.get("kind") == "header":
+            continue
+        base = {"name": rec["name"], "pid": pid, "tid": rec["tid"],
+                "ts": round(rec["t0"] * 1e6, 3),
+                "args": rec.get("attrs", {})}
+        if rec["kind"] == "span":
+            out.append({**base, "ph": "X",
+                        "dur": round((rec["dur"] or 0.0) * 1e6, 3)})
+        else:
+            out.append({**base, "ph": "i", "s": "t"})
+    out.sort(key=lambda e: e["ts"])
+    return out
+
+
+# --------------------------------------------------- module fast paths
+
+def get_tracer() -> Optional[Tracer]:
+    """The active tracer, or None (telemetry off — the default)."""
+    return _TRACER
+
+
+def active() -> bool:
+    return _TRACER is not None
+
+
+def span(name: str, **attrs):
+    """Context manager recording a span under the active tracer; the
+    shared no-op context when tracing is off (no allocation)."""
+    t = _TRACER
+    if t is None:
+        return _NULL_SPAN
+    return t.span(name, **attrs)
+
+
+def event(name: str, **attrs) -> None:
+    """Instant event under the active tracer; no-op when tracing is off."""
+    t = _TRACER
+    if t is not None:
+        t.event(name, **attrs)
+
+
+def traced_builder(fn):
+    """Decorator for the ``parallel`` program builders: runs the
+    builder under a ``trace`` span (program construction — nested
+    inside the ``compile`` span its cache-miss caller opened) when a
+    tracer is active; one extra Python call and nothing else when off.
+    Named after what the phase IS: the builder assembles/traces the
+    program; the XLA executable build stays lazy and lands in the first
+    ``dispatch`` span."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        t = _TRACER
+        if t is None:
+            return fn(*args, **kwargs)
+        with t.span("trace", builder=fn.__name__):
+            return fn(*args, **kwargs)
+    return wrapper
+
+
+@contextlib.contextmanager
+def tracing(path=None, chrome=None, tracer: Optional[Tracer] = None):
+    """Install a tracer for the ``with`` body (nested scopes shadow,
+    like ``log_dispatches``); on exit restore the previous one and
+    write the JSONL/Chrome exports when paths were given.
+
+    Usage::
+
+        with obs.tracing("fit.jsonl") as tr:
+            model.fit(X)
+        # fit.jsonl now holds the span records; also:
+        table = obs.time_to_first_iteration(tr.records())
+    """
+    global _TRACER
+    t = tracer if tracer is not None else Tracer()
+    prev, _TRACER = _TRACER, t
+    try:
+        yield t
+    finally:
+        _TRACER = prev
+        if path is not None:
+            t.write_jsonl(path)
+        if chrome is not None:
+            t.write_chrome(chrome)
+
+
+# ----------------------------------------------------------- analysis
+
+def read_jsonl(path) -> List[dict]:
+    """Load a trace JSONL file back into records.
+
+    Raises :class:`TraceReadError` for unreadable files, non-JSON
+    lines, or records missing the span schema — the CLI's exit-2
+    classification (a partial file from a crashed writer is malformed,
+    not silently half-summarized)."""
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError as e:
+        raise TraceReadError(f"cannot read trace file {path}: {e}") from e
+    records = []
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            raise TraceReadError(
+                f"{path}:{i + 1}: not a JSON record ({e.msg})") from e
+        if not isinstance(rec, dict) or "kind" not in rec:
+            raise TraceReadError(
+                f"{path}:{i + 1}: not a trace record (missing 'kind')")
+        if rec["kind"] in ("span", "event") and any(
+                field not in rec for field in ("name", "t0", "id")):
+            # 'id' is load-bearing downstream (self_times keys on it);
+            # a truncated/hand-edited record without it must classify
+            # as malformed here, not KeyError deep in summarize.
+            raise TraceReadError(
+                f"{path}:{i + 1}: malformed {rec['kind']} record "
+                f"(missing name/t0/id)")
+        records.append(rec)
+    if not any(r.get("kind") in ("span", "event") for r in records):
+        raise TraceReadError(f"{path}: no span or event records")
+    return records
+
+
+def self_times(records: List[dict]) -> Dict[int, float]:
+    """span id -> EXCLUSIVE seconds (duration minus direct children):
+    the double-count-free attribution nested spans need (a ``stage``
+    span inside a prefetch ``stage`` span must not count twice)."""
+    spans = [r for r in records if r.get("kind") == "span"]
+    child_dur: Dict[int, float] = {}
+    for s in spans:
+        p = s.get("parent")
+        if p is not None:
+            child_dur[p] = child_dur.get(p, 0.0) + (s.get("dur") or 0.0)
+    return {s["id"]: max((s.get("dur") or 0.0)
+                         - child_dur.get(s["id"], 0.0), 0.0)
+            for s in spans}
+
+
+def summarize(records: List[dict]) -> Dict[str, dict]:
+    """Per-phase rollup: ``{name: {count, total, p50, p99, events}}``
+    with ``total``/percentiles over SELF time (nested child time
+    excluded, :func:`self_times`) in seconds.  Instant events roll up
+    as counts under their own names."""
+    selfs = self_times(records)
+    by_name: Dict[str, List[float]] = {}
+    ev_counts: Dict[str, int] = {}
+    for rec in records:
+        if rec.get("kind") == "span":
+            by_name.setdefault(rec["name"], []).append(selfs[rec["id"]])
+        elif rec.get("kind") == "event":
+            ev_counts[rec["name"]] = ev_counts.get(rec["name"], 0) + 1
+    out: Dict[str, dict] = {}
+    for name, vals in by_name.items():
+        vals = sorted(vals)
+        out[name] = {"count": len(vals), "total": sum(vals),
+                     "p50": nearest_rank(vals, 0.50),
+                     "p99": nearest_rank(vals, 0.99),
+                     "events": 0}
+    for name, n in ev_counts.items():
+        row = out.setdefault(name, {"count": 0, "total": 0.0,
+                                    "p50": 0.0, "p99": 0.0, "events": 0})
+        row["events"] += n
+    return out
+
+
+def run_scoped(fn: Callable, *args, **kwargs):
+    """(result, records): run ``fn`` under a fresh tracer and return its
+    records — the programmatic one-shot the report helpers build on."""
+    with tracing() as t:
+        result = fn(*args, **kwargs)
+    return result, t.records()

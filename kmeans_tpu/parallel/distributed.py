@@ -33,6 +33,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from kmeans_tpu.obs import trace as _obs_trace
 from kmeans_tpu.ops.assign import (GUARDED_MODE, StepStats, _accum_dtype,
                                    accumulate_chunk, consume_chunk,
                                    distance_stage, guarded_assign_chunk,
@@ -313,6 +314,7 @@ def _check_guarded(mode: str, model_shards: int,
             "use 'keep' or 'resample' (label-exact by construction)")
 
 
+@_obs_trace.traced_builder
 def make_step_fn(mesh: Mesh, *, chunk_size: int,
                  mode: str = "matmul", pipeline: int = 0) -> Callable:
     """Build the jitted SPMD step: (points, weights, centroids) -> StepStats.
@@ -393,6 +395,7 @@ def make_step_fn(mesh: Mesh, *, chunk_size: int,
 ESTEP_PHASES = ("distance", "assign", "reduce")
 
 
+@_obs_trace.traced_builder
 def make_estep_phase_fn(mesh: Mesh, *, chunk_size: int, n_iters: int,
                         phase: str, mode: str = "matmul") -> Callable:
     """Phase-prefix iteration chain for the phase-decomposition harness
@@ -623,6 +626,7 @@ def _project_centroids(new, prev, real_mask, project: Optional[str], acc):
                      jnp.where(real_c, prev, new))
 
 
+@_obs_trace.traced_builder
 def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                 k_real: int, max_iter: int, tolerance: float,
                 empty_policy: str = "keep", history_sse: bool = True,
@@ -819,6 +823,7 @@ def make_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
     return jax.jit(mapped)
 
 
+@_obs_trace.traced_builder
 def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                       k_real: int, max_iter: int, tolerance: float,
                       empty_policy: str = "keep", n_init: int,
@@ -1113,6 +1118,7 @@ def _check_minibatch_mode(mode: str) -> None:
             "modes — use 'matmul' (exact) or 'matmul_bf16' (unguarded)")
 
 
+@_obs_trace.traced_builder
 def make_minibatch_step_fn(mesh: Mesh, *, batch_per_shard: int,
                            mode: str = "matmul",
                            n_candidates: int = 0,
@@ -1294,6 +1300,7 @@ def apply_reassignment(new, seen, cand_rows, cand_valid, real, do_re,
     return new, seen
 
 
+@_obs_trace.traced_builder
 def make_minibatch_fit_fn(mesh: Mesh, *, batch_per_shard: int,
                           mode: str = "matmul", k_real: int, max_iter: int,
                           tolerance: float, history_sse: bool = True,
@@ -1437,6 +1444,7 @@ def make_minibatch_fit_fn(mesh: Mesh, *, batch_per_shard: int,
     return jax.jit(mapped)
 
 
+@_obs_trace.traced_builder
 def make_predict_fn(mesh: Mesh, *, chunk_size: int,
                     mode: str = "matmul",
                     donate_points: bool = False) -> Callable:
@@ -1532,6 +1540,7 @@ def make_predict_fn(mesh: Mesh, *, chunk_size: int,
     return jax.jit(mapped, donate_argnums=(0,) if donate_points else ())
 
 
+@_obs_trace.traced_builder
 def make_assign_margin_fn(mesh: Mesh, *, chunk_size: int,
                           mode: str = "matmul_bf16") -> Callable:
     """Guarded-assignment primitive for the serving bf16 fast path
@@ -1587,6 +1596,7 @@ def make_assign_margin_fn(mesh: Mesh, *, chunk_size: int,
     return jax.jit(mapped)
 
 
+@_obs_trace.traced_builder
 def make_score_rows_fn(mesh: Mesh, *, chunk_size: int,
                        mode: str = "matmul") -> Callable:
     """Per-row squared distance to the nearest centroid:
@@ -1634,6 +1644,7 @@ def make_score_rows_fn(mesh: Mesh, *, chunk_size: int,
     return jax.jit(mapped)
 
 
+@_obs_trace.traced_builder
 def make_multi_predict_fn(mesh: Mesh, *, chunk_size: int,
                           mode: str = "matmul",
                           n_models: int) -> Callable:
@@ -1688,6 +1699,7 @@ def make_multi_predict_fn(mesh: Mesh, *, chunk_size: int,
     return jax.jit(mapped)
 
 
+@_obs_trace.traced_builder
 def make_transform_fn(mesh: Mesh, *, chunk_size: int,
                       mode: str = "matmul") -> Callable:
     """Build the jitted SPMD distance pass for ``KMeans.transform``:

@@ -85,6 +85,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kmeans_tpu.obs import trace as _obs_trace
 from kmeans_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS, mesh_shape,
                                       shard_map)
 
@@ -282,6 +283,7 @@ def _embed_psum(st: EStats, k_pad: int, k_local: int, model_shards: int):
     return EStats(resp, xsum, x2sum, ll)
 
 
+@_obs_trace.traced_builder
 def make_gmm_step_fn(mesh: Mesh, *, chunk_size: int, pipeline: int = 1,
                      exp_dtype=None) -> Callable:
     """Build the jitted SPMD E-step:
@@ -314,6 +316,7 @@ def make_gmm_step_fn(mesh: Mesh, *, chunk_size: int, pipeline: int = 1,
     return jax.jit(mapped)
 
 
+@_obs_trace.traced_builder
 def make_gmm_predict_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
     """Jitted sharded posterior pass:
     (points, shift, means, inv_var, log_det, log_weights) ->
@@ -503,6 +506,7 @@ def _prec_chol_dev(cov, tiny):
     return p_chol, ldh
 
 
+@_obs_trace.traced_builder
 def make_gmm_step_full_fn(mesh: Mesh, *, chunk_size: int,
                           pipeline: int = 1, exp_dtype=None) -> Callable:
     """Full-covariance SPMD E-step: (points, weights, shift, means_c,
@@ -573,6 +577,7 @@ def _scan_estats_tied(points, weights, means_t, prec_chol, log_det_half,
                           consume_fn=consume, init=init, acc=acc)
 
 
+@_obs_trace.traced_builder
 def make_gmm_step_tied_fn(mesh: Mesh, *, chunk_size: int,
                           pipeline: int = 1, exp_dtype=None) -> Callable:
     """Tied-covariance SPMD E-step: (points, weights, shift, means_t
@@ -604,6 +609,7 @@ def make_gmm_step_tied_fn(mesh: Mesh, *, chunk_size: int,
     return jax.jit(mapped)
 
 
+@_obs_trace.traced_builder
 def make_total_scatter_fn(mesh: Mesh) -> Callable:
     """(points, weights, shift) -> (D, D) total weighted scatter
     ``sum_i w_i (x_i - shift)(x_i - shift)^T``, replicated — the
@@ -655,6 +661,7 @@ def _predict_from_logp(logp_fn, points, chunk_size, k_local, d,
             lse.reshape(-1))
 
 
+@_obs_trace.traced_builder
 def make_gmm_multi_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
                           max_iter: int, tol: float, reg_covar: float,
                           cov_type: str = "diag", pipeline: int = 1,
@@ -797,6 +804,7 @@ def make_gmm_multi_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
     return jax.jit(mapped)
 
 
+@_obs_trace.traced_builder
 def make_gmm_fit_full_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
                          max_iter: int, tol: float, reg_covar: float,
                          pipeline: int = 1):
@@ -899,6 +907,7 @@ def make_gmm_fit_full_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
     return jax.jit(mapped)
 
 
+@_obs_trace.traced_builder
 def make_gmm_fit_tied_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
                          max_iter: int, tol: float, reg_covar: float,
                          pipeline: int = 1):
@@ -995,6 +1004,7 @@ def make_gmm_fit_tied_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
     return jax.jit(mapped)
 
 
+@_obs_trace.traced_builder
 def make_gmm_predict_full_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
     """Full-covariance posterior pass (same contract as
     ``make_gmm_predict_fn``)."""
@@ -1019,6 +1029,7 @@ def make_gmm_predict_full_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
     return jax.jit(mapped)
 
 
+@_obs_trace.traced_builder
 def make_gmm_predict_tied_fn(mesh: Mesh, *, chunk_size: int) -> Callable:
     """Tied-covariance posterior pass (same contract as
     ``make_gmm_predict_fn``; ``means_t`` pre-transformed)."""
@@ -1086,6 +1097,7 @@ def _diag_m_step(st, *, w_total, reg_covar, tiny, pi_floor, real,
     return mu, new_var, new_log_w
 
 
+@_obs_trace.traced_builder
 def make_gmm_fit_fn(mesh: Mesh, *, chunk_size: int, k_real: int,
                     max_iter: int, tol: float, reg_covar: float,
                     cov_type: str = "diag", pipeline: int = 1):

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from kmeans_tpu.obs import trace as _obs_trace
 from kmeans_tpu.parallel.mesh import DATA_AXIS, mesh_shape
 
 
@@ -244,14 +245,20 @@ def shard_points(x: np.ndarray, mesh: Optional[Mesh], chunk_size: int,
     """
     data_shards, _ = mesh_shape(mesh)
     x = np.asarray(x)
-    x_pad, w_pad = pad_points(x, data_shards * chunk_size)
-    if sample_weight is not None:
-        w_pad[: x.shape[0]] *= sample_weight.astype(w_pad.dtype)
-    if mesh is None:
-        return jnp.asarray(x_pad), jnp.asarray(w_pad)
-    xsh = NamedSharding(mesh, P(DATA_AXIS, None))
-    wsh = NamedSharding(mesh, P(DATA_AXIS))
-    return (jax.device_put(x_pad, xsh), jax.device_put(w_pad, wsh))
+    # 'stage' span (ISSUE 11): one host->device staging of a block —
+    # under a prefetched stream these come from the producer thread's
+    # own tid, so the chrome timeline shows transfer overlapping the
+    # consumer's dispatches.
+    with _obs_trace.span("stage", rows=int(x.shape[0]),
+                         bytes=int(x.nbytes)):
+        x_pad, w_pad = pad_points(x, data_shards * chunk_size)
+        if sample_weight is not None:
+            w_pad[: x.shape[0]] *= sample_weight.astype(w_pad.dtype)
+        if mesh is None:
+            return jnp.asarray(x_pad), jnp.asarray(w_pad)
+        xsh = NamedSharding(mesh, P(DATA_AXIS, None))
+        wsh = NamedSharding(mesh, P(DATA_AXIS))
+        return (jax.device_put(x_pad, xsh), jax.device_put(w_pad, wsh))
 
 
 
@@ -475,7 +482,13 @@ def to_device(X, mesh: Optional[Mesh], chunk: int, dtype,
     sw = None
     if sample_weight is not None:
         sw = _validate_sample_weight(sample_weight, X.shape[0], X.dtype)
-    points, weights = shard_points(X, mesh, chunk, sample_weight=sw)
+    # 'place' span (ISSUE 11): the one-time dataset upload onto the
+    # mesh — the transfer share of time-to-first-iteration (contains
+    # the 'stage' span; the TTFI report attributes self time, so the
+    # nesting never double-counts).
+    with _obs_trace.span("place", rows=int(X.shape[0]),
+                         bytes=int(X.nbytes)):
+        points, weights = shard_points(X, mesh, chunk, sample_weight=sw)
     return ShardedDataset(points, weights, X.shape[0], chunk, mesh, host=X,
                           host_weights=sw, explicit_chunk=explicit)
 

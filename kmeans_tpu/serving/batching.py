@@ -55,6 +55,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from kmeans_tpu.obs import trace as _obs_trace
+
 __all__ = ["MicroBatchQueue", "ServingFuture", "ServingClosedError",
            "DEFAULT_BUCKETS"]
 
@@ -295,7 +297,13 @@ class MicroBatchQueue:
             self.coalesce_hist[len(batch)] = \
                 self.coalesce_hist.get(len(batch), 0) + 1
         try:
-            out = self._dispatch(model_id, op, rows)
+            # 'serve.flush' span (ISSUE 11): one queue flush — the
+            # coalesced dispatch it runs emits its own nested
+            # 'serve.request' span from the engine.
+            with _obs_trace.span("serve.flush", model=str(model_id),
+                                 op=op, coalesced=len(batch),
+                                 rows=int(rows.shape[0])):
+                out = self._dispatch(model_id, op, rows)
         except Exception as batch_err:      # noqa: BLE001 — isolated below
             if len(batch) == 1:
                 batch[0].future._set_error(batch_err)

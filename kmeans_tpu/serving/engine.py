@@ -60,6 +60,8 @@ from kmeans_tpu.parallel.sharding import choose_chunk_size, shard_points
 from kmeans_tpu.serving.batching import (DEFAULT_BUCKETS, MicroBatchQueue,
                                          ServingFuture, bucket_for,
                                          check_buckets)
+from kmeans_tpu.obs import metrics_registry as obs_metrics
+from kmeans_tpu.obs import trace as obs_trace
 from kmeans_tpu.serving.registry import ModelRegistry
 from kmeans_tpu.utils.profiling import note_dispatch
 
@@ -266,6 +268,12 @@ class ServingEngine:
             fill = self._fill.setdefault(bucket, [0, 0])
             fill[0] += 1
             fill[1] += m
+        # Write-through (ISSUE 11): the engine counters stay the
+        # per-engine surface; the registry keeps the process view.
+        reg = obs_metrics.REGISTRY
+        reg.counter("serve.dispatches").inc()
+        reg.counter("serve.requests").inc(n_requests)
+        reg.counter("serve.rows").inc(m)
 
     def _kmeans_modes(self, rm: ResidentModel, B: int) -> Tuple[str, str]:
         """(assign mode, transform mode) for a bucket-B dispatch —
@@ -332,37 +340,44 @@ class ServingEngine:
         mode, tmode = self._kmeans_modes(rm, B)
         chunk = self._serve_chunk(rm, B)
         data_shards, model_shards = mesh_shape(self.mesh)
-        cents_dev = model._cents_dev(self.mesh, model_shards)
-        pts, _ = shard_points(buf, self.mesh, chunk)
-        if op == "predict":
-            if rm.quantize == "bf16":
-                out, corrected = self._assign_bf16_guarded(
-                    rm, buf, pts, cents_dev, chunk, m)
-                if corrected and not getattr(self._tls, "warming", False):
-                    with self._lock:
-                        rm.bf16_corrected_rows += corrected
-            else:
-                out = np.asarray(self._predict_fn(chunk, mode)(
-                    pts, cents_dev, np.int32(m)))[:m]
-        elif op == "transform":
-            tfn = kmeans_mod._STEP_CACHE.get_or_create(
-                (self.mesh, chunk, tmode, "transform"),
-                lambda: dist.make_transform_fn(
-                    self.mesh, chunk_size=chunk, mode=tmode))
-            out = np.asarray(tfn(pts, cents_dev))[:m, : rm.spec["k"]]
-        elif op == "score_rows":
-            # Key on the VALUE-surface mode: make_score_rows_fn maps the
-            # guarded rung to 'matmul' internally, so the raw mode would
-            # duplicate an identical compile next to the f32 entry.
-            from kmeans_tpu.ops.assign import value_mode
-            smode = value_mode(mode)
-            sfn = kmeans_mod._STEP_CACHE.get_or_create(
-                (self.mesh, chunk, smode, "score_rows"),
-                lambda: dist.make_score_rows_fn(
-                    self.mesh, chunk_size=chunk, mode=smode))
-            out = np.asarray(sfn(pts, cents_dev))[:m]
-        else:                               # unreachable past _validate
-            raise ValueError(f"unknown op {op!r}")
+        # 'serve.request' span (ISSUE 11): one coalesced serving
+        # dispatch — covers staging + the compiled call + the result
+        # transfer (np.asarray is the sync point).
+        with obs_trace.span("serve.request", model=rm.model_id, op=op,
+                            rows=m, bucket=B):
+            cents_dev = model._cents_dev(self.mesh, model_shards)
+            pts, _ = shard_points(buf, self.mesh, chunk)
+            if op == "predict":
+                if rm.quantize == "bf16":
+                    out, corrected = self._assign_bf16_guarded(
+                        rm, buf, pts, cents_dev, chunk, m)
+                    if corrected and not getattr(self._tls, "warming",
+                                                 False):
+                        with self._lock:
+                            rm.bf16_corrected_rows += corrected
+                else:
+                    out = np.asarray(self._predict_fn(chunk, mode)(
+                        pts, cents_dev, np.int32(m)))[:m]
+            elif op == "transform":
+                tfn = kmeans_mod._STEP_CACHE.get_or_create(
+                    (self.mesh, chunk, tmode, "transform"),
+                    lambda: dist.make_transform_fn(
+                        self.mesh, chunk_size=chunk, mode=tmode))
+                out = np.asarray(tfn(pts, cents_dev))[:m, : rm.spec["k"]]
+            elif op == "score_rows":
+                # Key on the VALUE-surface mode: make_score_rows_fn maps
+                # the guarded rung to 'matmul' internally, so the raw
+                # mode would duplicate an identical compile next to the
+                # f32 entry.
+                from kmeans_tpu.ops.assign import value_mode
+                smode = value_mode(mode)
+                sfn = kmeans_mod._STEP_CACHE.get_or_create(
+                    (self.mesh, chunk, smode, "score_rows"),
+                    lambda: dist.make_score_rows_fn(
+                        self.mesh, chunk_size=chunk, mode=smode))
+                out = np.asarray(sfn(pts, cents_dev))[:m]
+            else:                           # unreachable past _validate
+                raise ValueError(f"unknown op {op!r}")
         self._record(rm, B, m)
         return out
 
@@ -378,14 +393,15 @@ class ServingEngine:
         inside the guarded margin.  Returns (labels, corrected_count);
         the CALLER owns the audit counter (verify_quantized probes
         through here without touching the resident's state)."""
-        fn = kmeans_mod._STEP_CACHE.get_or_create(
-            (self.mesh, chunk, "assign-margin"),
-            lambda: dist.make_assign_margin_fn(
-                self.mesh, chunk_size=chunk, mode="matmul_bf16"))
-        labels, margin, scale = fn(pts, cents_dev)
-        labels = np.array(np.asarray(labels)[:m])
-        margin = np.asarray(margin)[:m]
-        scale = np.asarray(scale)[:m]
+        with obs_trace.span("dispatch", tag="serve/bf16-margin", rows=m):
+            fn = kmeans_mod._STEP_CACHE.get_or_create(
+                (self.mesh, chunk, "assign-margin"),
+                lambda: dist.make_assign_margin_fn(
+                    self.mesh, chunk_size=chunk, mode="matmul_bf16"))
+            labels, margin, scale = fn(pts, cents_dev)
+            labels = np.array(np.asarray(labels)[:m])
+            margin = np.asarray(margin)[:m]
+            scale = np.asarray(scale)[:m]
         near = np.flatnonzero(margin <= BF16_TIE_RTOL * scale)
         if near.size:
             # f32 correction ride-along: its own (small) bucket, the
@@ -393,18 +409,21 @@ class ServingEngine:
             # dispatch-count pins can tell guard traffic from serving
             # traffic (ISSUE 8 satellite).
             note_dispatch("bf16-guard-fix")
-            sub = np.ascontiguousarray(buf[near])
-            sub_buf, n_sub, B_sub = self._stage(rm, sub)
-            sub_chunk = self._serve_chunk(rm, B_sub)
-            sub_pts, _ = shard_points(sub_buf, self.mesh, sub_chunk)
-            # The model's OWN f32-class mode (not the bf16 map) — the
-            # corrected rows must match whatever `model.predict` runs.
-            f32_mode = rm.model._mode(B_sub, rm.spec["d"])
-            exact = np.asarray(self._predict_fn(sub_chunk, f32_mode)(
-                sub_pts, rm.model._cents_dev(
-                    self.mesh, mesh_shape(self.mesh)[1]),
-                np.int32(n_sub)))[:n_sub]
-            labels[near] = exact
+            with obs_trace.span("dispatch", tag="serve/bf16-guard-fix",
+                                rows=int(near.size)):
+                sub = np.ascontiguousarray(buf[near])
+                sub_buf, n_sub, B_sub = self._stage(rm, sub)
+                sub_chunk = self._serve_chunk(rm, B_sub)
+                sub_pts, _ = shard_points(sub_buf, self.mesh, sub_chunk)
+                # The model's OWN f32-class mode (not the bf16 map) —
+                # the corrected rows must match whatever
+                # ``model.predict`` runs.
+                f32_mode = rm.model._mode(B_sub, rm.spec["d"])
+                exact = np.asarray(self._predict_fn(sub_chunk, f32_mode)(
+                    sub_pts, rm.model._cents_dev(
+                        self.mesh, mesh_shape(self.mesh)[1]),
+                    np.int32(n_sub)))[:n_sub]
+                labels[near] = exact
         return labels, int(near.size)
 
     def _dispatch_gmm(self, rm: ResidentModel, op: str,
@@ -414,7 +433,9 @@ class ServingEngine:
         ISSUE-6 ``_params_dev`` cache makes it warm (tables placed
         once, compiled pass reused per bucket shape)."""
         buf, m, B = self._stage(rm, rows)
-        labels, logr, lse = rm.model._posterior(buf)
+        with obs_trace.span("serve.request", model=rm.model_id, op=op,
+                            rows=m, bucket=B):
+            labels, logr, lse = rm.model._posterior(buf)
         self._record(rm, B, m)
         if op == "predict":
             return labels[:m]
@@ -537,14 +558,16 @@ class ServingEngine:
         # bf16 rate until a guarded packed form is built and measured.
         mode = first.model._mode(B, d)
         chunk = self._serve_chunk(first, B)
-        fn = kmeans_mod._STEP_CACHE.get_or_create(
-            (self.mesh, chunk, mode, len(ids), "multipredict"),
-            lambda: dist.make_multi_predict_fn(
-                self.mesh, chunk_size=chunk, mode=mode,
-                n_models=len(ids)))
-        pts, _ = shard_points(buf, self.mesh, chunk)
-        stack = self._pack_stack(ids)
-        labels_all = np.asarray(fn(pts, stack))      # (M, B_padded)
+        with obs_trace.span("serve.request", op="predict_multi",
+                            models=len(ids), rows=m, bucket=B):
+            fn = kmeans_mod._STEP_CACHE.get_or_create(
+                (self.mesh, chunk, mode, len(ids), "multipredict"),
+                lambda: dist.make_multi_predict_fn(
+                    self.mesh, chunk_size=chunk, mode=mode,
+                    n_models=len(ids)))
+            pts, _ = shard_points(buf, self.mesh, chunk)
+            stack = self._pack_stack(ids)
+            labels_all = np.asarray(fn(pts, stack))  # (M, B_padded)
         # ONE physical dispatch: the global count and the bucket-fill
         # histogram record it once (with the batch's total real rows);
         # per-model counters record each member's share (a member's
@@ -603,9 +626,11 @@ class ServingEngine:
         # pins can tell verification from serving (dispatch-accounting
         # lint: every compiled call site routes through note_dispatch).
         note_dispatch("verify-quantized/f32-oracle")
-        lab_f = np.asarray(self._predict_fn(chunk, f32_mode)(
-            shard_points(buf, self.mesh, chunk)[0], cents_dev,
-            np.int32(m)))[:m]
+        with obs_trace.span("dispatch", tag="verify-quantized/f32-oracle",
+                            rows=m):
+            lab_f = np.asarray(self._predict_fn(chunk, f32_mode)(
+                shard_points(buf, self.mesh, chunk)[0], cents_dev,
+                np.int32(m)))[:m]
 
         def _distances(tmode):
             tfn = kmeans_mod._STEP_CACHE.get_or_create(

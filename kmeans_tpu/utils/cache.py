@@ -14,15 +14,33 @@ from __future__ import annotations
 
 from collections import OrderedDict
 
+from kmeans_tpu.obs import trace as _obs_trace
+
 
 class LRUCache:
     """Minimal ordered-dict LRU with the mapping surface the models use
-    (``in`` / ``[]`` / assignment / ``len``)."""
+    (``in`` / ``[]`` / assignment / ``len``).
 
-    def __init__(self, maxsize: int = 64):
+    ``name`` labels the cache in telemetry: every ``get_or_create``
+    MISS — the event where a program gets (re)built — is recorded as a
+    ``compile`` span naming the cache and key when a tracer is active
+    (ISSUE 11: the ``_STEP_CACHE``-class compile hook), so unexpected
+    recompiles appear on the timeline with their provenance, the same
+    classification the recompilation sentinel enforces at runtime.
+    ``compile_spans=False`` opts a cache out — for caches whose factory
+    is NOT a program build (the ``_AUTO_CACHE`` measurement cache runs
+    two full training steps; labeling that ``compile`` would inflate
+    the TTFI compile row on exactly the high-RTT platforms the
+    artifact targets).
+    """
+
+    def __init__(self, maxsize: int = 64, name: str = None,
+                 compile_spans: bool = True):
         if int(maxsize) < 1:
             raise ValueError(f"maxsize must be >= 1, got {maxsize}")
         self.maxsize = int(maxsize)
+        self.name = name
+        self.compile_spans = bool(compile_spans)
         self._d: OrderedDict = OrderedDict()
 
     def get_or_create(self, key, factory):
@@ -34,7 +52,13 @@ class LRUCache:
         try:
             value = self._d[key]           # single atomic read
         except KeyError:
-            value = factory()
+            if self.compile_spans and _obs_trace.active():
+                with _obs_trace.span("compile",
+                                     cache=self.name or "cache",
+                                     key=repr(key)[:160]):
+                    value = factory()
+            else:
+                value = factory()
             self[key] = value
             return value
         try:

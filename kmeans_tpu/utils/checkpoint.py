@@ -50,6 +50,8 @@ from typing import Any, Dict, Tuple
 
 import numpy as np
 
+from kmeans_tpu.obs import trace as _obs_trace
+
 FORMAT_VERSION = 1
 
 
@@ -92,12 +94,13 @@ def save_state(path, state: Dict[str, Any]) -> None:
     meta = {k: v for k, v in state.items() if k not in arrays}
     meta["__format_version__"] = FORMAT_VERSION
     tmp = path.with_name(f".{path.name}.{os.getpid()}.tmp")
-    try:
-        with open(tmp, "wb") as f:
-            np.savez(f, __meta__=json.dumps(meta), **arrays)
-        os.replace(tmp, path)
-    finally:
-        tmp.unlink(missing_ok=True)
+    with _obs_trace.span("checkpoint.save", path=str(path)):
+        try:
+            with open(tmp, "wb") as f:
+                np.savez(f, __meta__=json.dumps(meta), **arrays)
+            os.replace(tmp, path)
+        finally:
+            tmp.unlink(missing_ok=True)
 
 
 def save_state_rotating(path, state: Dict[str, Any]) -> None:
@@ -183,8 +186,9 @@ def _parse_npz(path: Path, materialize: bool):
 def _load_state_at(path: Path) -> Dict[str, Any]:
     """Load an EXACT path (no .npz normalization — also serves the
     ``.prev`` rotation slot)."""
-    state, arrays = _parse_npz(path, materialize=True)
-    state.update(arrays)
+    with _obs_trace.span("checkpoint.restore", path=str(path)):
+        state, arrays = _parse_npz(path, materialize=True)
+        state.update(arrays)
     return state
 
 

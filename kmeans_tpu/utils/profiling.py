@@ -17,6 +17,9 @@ from typing import Optional
 
 import jax
 
+from kmeans_tpu.obs import metrics_registry as _metrics
+from kmeans_tpu.obs import trace as _obs_trace
+
 
 class Timer:
     """Accumulating wall-clock timer with device sync."""
@@ -55,17 +58,29 @@ def trace(log_dir: Optional[str]):
 # the host consumes, or a device_get) note themselves here, so tests and
 # harnesses can assert structural properties like "the device k-means||
 # pipeline is O(1) dispatches in the round count" (ISSUE 2) without
-# depending on jax internals.  Zero overhead when no log is active.
+# depending on jax internals.
+#
+# Since ISSUE 11 the canonical store is the obs metrics registry: every
+# noted dispatch increments ``dispatch.<label>`` in
+# ``obs.metrics_registry.REGISTRY`` and (when a tracer is active) lands
+# as an instant ``dispatch.note`` event on the span timeline.  The
+# ``log_dispatches`` scope list is the COMPATIBILITY SHIM for the
+# existing structural pins (``log.count(label)``): a scoped view over
+# the same notes, unchanged surface.
 
 _DISPATCH_LOG: Optional[list] = None
 
 
 def note_dispatch(label: str) -> None:
-    """Record one host->device dispatch under the active ``log_dispatches``
-    scope (no-op outside one).  Instrumented call sites pass a stable
-    label (e.g. ``'kmeans||/round'``) so counts can be grouped."""
+    """Record one host->device dispatch: increments the registry's
+    ``dispatch.<label>`` counter, emits a span-timeline event when a
+    tracer is active, and appends to the active ``log_dispatches``
+    scope (the legacy list shim).  Instrumented call sites pass a
+    stable label (e.g. ``'kmeans||/round'``) so counts group."""
     if _DISPATCH_LOG is not None:
         _DISPATCH_LOG.append(label)
+    _metrics.REGISTRY.counter(f"dispatch.{label}").inc()
+    _obs_trace.event("dispatch.note", label=label)
 
 
 @contextlib.contextmanager
@@ -79,7 +94,10 @@ def log_dispatches():
         assert log.count("kmeans||/device-pipeline") == 1
 
     Nested scopes shadow (the inner scope collects; the outer resumes
-    afterwards), matching how the tests isolate measurements."""
+    afterwards), matching how the tests isolate measurements.  The
+    global accounting moved to ``obs.metrics_registry`` (``dispatch.*``
+    counters, process-lifetime); this scope remains the isolated-
+    measurement shim over the same ``note_dispatch`` stream."""
     global _DISPATCH_LOG
     prev, _DISPATCH_LOG = _DISPATCH_LOG, []
     try:
@@ -300,6 +318,17 @@ def recompilation_sentinel(allowed_new: int = 0):
             new[name] = added
             total += len(added)
     record["new"] = new
+    # Timeline twin of the growth check (ISSUE 11 satellite): every new
+    # key the sentinel observed becomes a zero-length ``compile`` span
+    # naming the cache, so a sentinel violation is visible on the
+    # chrome://tracing timeline at the moment the scope closed even
+    # when the miss itself ran before tracing was installed.
+    tr = _obs_trace.get_tracer()
+    if tr is not None:
+        for name, keys in sorted(new.items()):
+            for k in keys:
+                tr.instant_span("compile", cache=name,
+                                key=repr(k)[:160], via="sentinel")
     if total > allowed_new:
         lines = [f"  {name}: +{len(keys)} entries:" + "".join(
             f"\n    {repr(k)[:120]}" for k in keys)

@@ -323,6 +323,111 @@ def get_fn(mesh, chunk, build):
 
 
 # ---------------------------------------------------------------------------
+# obs-span (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+_OBS_SPAN_BAD = """
+from kmeans_tpu.utils.cache import LRUCache
+from kmeans_tpu.utils.profiling import note_dispatch
+
+_STEP_CACHE = LRUCache(8)
+
+
+def serve(pts, mesh, chunk, build):
+    fn = _STEP_CACHE.get_or_create((mesh, chunk), lambda: build(mesh))
+    note_dispatch("serve/predict")
+    return fn(pts)
+"""
+
+_OBS_SPAN_OK = """
+from kmeans_tpu.obs import trace as obs_trace
+from kmeans_tpu.utils.cache import LRUCache
+from kmeans_tpu.utils.profiling import note_dispatch
+
+_STEP_CACHE = LRUCache(8)
+
+
+def serve(pts, mesh, chunk, build):
+    fn = _STEP_CACHE.get_or_create((mesh, chunk), lambda: build(mesh))
+    note_dispatch("serve/predict")
+    with obs_trace.span("serve.request"):
+        return fn(pts)
+"""
+
+
+def test_obs_span_fires_on_unspanned_compiled_call(tmp_path):
+    """Dispatch-tagged but span-less: the dispatch rule passes, the
+    obs-span twin fires — the two rules close different halves of the
+    same invisibility class."""
+    findings = run_on(tmp_path, _OBS_SPAN_BAD, subdir="serving")
+    assert [f.rule for f in findings
+            if f.rule in ("dispatch", "obs-span")] == ["obs-span"]
+    fire = [f for f in findings if f.rule == "obs-span"][0]
+    assert "serve()" in fire.message and "span" in fire.message
+
+
+def test_obs_span_silent_with_enclosing_span(tmp_path):
+    findings = run_on(tmp_path, _OBS_SPAN_OK, subdir="serving")
+    assert [f for f in findings if f.rule == "obs-span"] == []
+
+
+def test_obs_span_builders_that_only_return_are_exempt(tmp_path):
+    src = """
+from kmeans_tpu.utils.cache import LRUCache
+
+_STEP_CACHE = LRUCache(8)
+
+
+def get_fn(mesh, chunk, build):
+    return _STEP_CACHE.get_or_create((mesh, chunk), lambda: build(mesh))
+"""
+    findings = run_on(tmp_path, src, subdir="serving")
+    assert [f for f in findings if f.rule == "obs-span"] == []
+
+
+def test_obs_span_scoped_to_serving_and_parallel(tmp_path):
+    """A models/-layer compiled call is out of the rule's scope (model
+    dispatch sites are spanned at their engine choke points, not
+    per-site)."""
+    findings = run_on(tmp_path, _OBS_SPAN_BAD, subdir="models")
+    assert [f for f in findings if f.rule == "obs-span"] == []
+
+
+def test_obs_span_nested_closure_covered_by_driver_span(tmp_path):
+    """A nested helper's compiled call counts against the DRIVER
+    function, whose span covers the whole subtree (the
+    verify_quantized/_distances shape)."""
+    src = """
+from kmeans_tpu.obs import trace as obs_trace
+from kmeans_tpu.utils.cache import LRUCache
+from kmeans_tpu.utils.profiling import note_dispatch
+
+_STEP_CACHE = LRUCache(8)
+
+
+def verify(pts, mesh, chunk, build):
+    def _inner(m):
+        fn = _STEP_CACHE.get_or_create((mesh, chunk, m),
+                                       lambda: build(mesh, m))
+        note_dispatch("verify/probe")
+        return fn(pts)
+    with obs_trace.span("dispatch", tag="verify"):
+        return _inner("a") - _inner("b")
+"""
+    findings = run_on(tmp_path, src, subdir="serving")
+    assert [f for f in findings if f.rule == "obs-span"] == []
+
+
+def test_obs_span_suppression_honored(tmp_path):
+    src = _OBS_SPAN_BAD.replace(
+        "    return fn(pts)",
+        "    # lint: ok(obs-span) — probe path, timeline coverage "
+        "at the caller\n    return fn(pts)")
+    findings = run_on(tmp_path, src, subdir="serving")
+    assert [f for f in findings if f.rule == "obs-span"] == []
+
+
+# ---------------------------------------------------------------------------
 # thread
 # ---------------------------------------------------------------------------
 

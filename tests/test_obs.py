@@ -1,0 +1,803 @@
+"""ISSUE 11: the unified telemetry layer.
+
+Coverage tiers:
+
+1. **Tracer/registry/heartbeat units** — span nesting, self-time
+   attribution (nested spans never double-count), JSONL round-trip,
+   Chrome export schema, malformed-input classification, typed metric
+   semantics, heartbeat emission + thread join-on-close.
+2. **obs-off parity** — the disabled path is the bit-exact oracle: a
+   fit run under tracing + metrics + heartbeat equals the plain fit
+   bit-for-bit for all five model families across 1/2/4/8-way meshes.
+3. **Span structure under the hard paths** — segmented fits, injected
+   OOM replay (attempt spans inside ONE segment span — never a second
+   segment), checkpoint restore, the note_dispatch migration shim, and
+   the recompilation sentinel's timeline twin.
+4. **Time-to-first-iteration report** — span-derived ladder through the
+   shared ``phase_ceiling_table`` formatter.
+5. **CLI** — ``python -m kmeans_tpu trace summarize`` (table/json/
+   chrome; exit 2 on unreadable/malformed).
+"""
+
+import json
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+
+from kmeans_tpu import KMeans, obs
+from kmeans_tpu.models import (BisectingKMeans, GaussianMixture,
+                               MiniBatchKMeans, SphericalKMeans)
+from kmeans_tpu.obs.heartbeat import (Heartbeat,
+                                      get_heartbeat)
+from kmeans_tpu.obs import metrics_registry as mr_mod
+from kmeans_tpu.obs import trace as trace_mod
+from kmeans_tpu.obs.report import ttfi_ladder, time_to_first_iteration
+from kmeans_tpu.parallel.mesh import make_mesh
+from kmeans_tpu.utils import faults, profiling
+
+WIDTHS = (1, 2, 4, 8)
+
+
+def _mesh(w, m=1):
+    if len(jax.devices()) < w * m:
+        pytest.skip(f"needs {w * m} devices")
+    return make_mesh(data=w, model=m, devices=jax.devices()[: w * m])
+
+
+def _blobs(n=800, d=4, centers=4, rs=7):
+    X, _ = make_blobs(n_samples=n, centers=centers, n_features=d,
+                      random_state=rs)
+    return X.astype(np.float32)
+
+
+def spans_named(records, name):
+    return [r for r in records if r.get("kind") == "span"
+            and r["name"] == name]
+
+
+# ---------------------------------------------------------------------------
+# Tracer unit semantics
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_parent_depth():
+    with obs.tracing() as tr:
+        with obs.span("segment", index=0):
+            with obs.span("dispatch", tag="x"):
+                pass
+            with obs.span("dispatch", tag="y"):
+                pass
+    recs = tr.records()
+    seg = spans_named(recs, "segment")[0]
+    disps = spans_named(recs, "dispatch")
+    assert len(disps) == 2
+    for d in disps:
+        assert d["parent"] == seg["id"]
+        assert d["depth"] == 1
+        assert seg["t0"] <= d["t0"] and d["t1"] <= seg["t1"]
+    assert seg["parent"] is None and seg["depth"] == 0
+
+
+def test_disabled_path_is_noop_and_allocation_free():
+    assert obs.get_tracer() is None
+    ctx1 = obs.span("dispatch", tag="x")
+    ctx2 = obs.span("stage")
+    assert ctx1 is ctx2           # the one shared null context manager
+    with ctx1:
+        pass
+    obs.event("dispatch.note", label="x")      # must not raise
+
+
+def test_span_records_error_type_and_propagates():
+    with obs.tracing() as tr:
+        with pytest.raises(ValueError):
+            with obs.span("dispatch"):
+                raise ValueError("boom")
+    rec = spans_named(tr.records(), "dispatch")[0]
+    assert rec["error"] == "ValueError"
+    assert rec["dur"] is not None
+
+
+def test_self_time_excludes_children_no_double_count():
+    with obs.tracing() as tr:
+        with obs.span("stage"):            # outer (prefetch-style)
+            with obs.span("stage"):        # inner (shard_points-style)
+                time.sleep(0.02)
+    recs = tr.records()
+    summ = obs.summarize(recs)
+    outer_total = max(r["dur"] for r in spans_named(recs, "stage"))
+    # Total SELF time ~= the one real sleep, NOT 2x (the nested same-
+    # name span must not double-count).
+    assert summ["stage"]["count"] == 2
+    assert summ["stage"]["total"] == pytest.approx(outer_total, rel=0.25)
+
+
+def test_jsonl_roundtrip_and_header():
+    with obs.tracing() as tr:
+        with obs.span("seed", strategy="forgy"):
+            pass
+        obs.event("dispatch.note", label="x")
+    return_path = None
+
+    def check(tmp):
+        tr.write_jsonl(tmp)
+        back = trace_mod.read_jsonl(tmp)
+        kinds = [r["kind"] for r in back]
+        assert kinds[0] == "header"
+        assert "span" in kinds and "event" in kinds
+        sp = spans_named(back, "seed")[0]
+        assert sp["attrs"]["strategy"] == "forgy"
+        return back
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as td:
+        check(os.path.join(td, "t.jsonl"))
+    return return_path
+
+
+def test_read_jsonl_malformed_raises(tmp_path):
+    p = tmp_path / "bad.jsonl"
+    p.write_text("not json\n")
+    with pytest.raises(trace_mod.TraceReadError):
+        trace_mod.read_jsonl(p)
+    p2 = tmp_path / "empty_records.jsonl"
+    p2.write_text(json.dumps({"kind": "header"}) + "\n")
+    with pytest.raises(trace_mod.TraceReadError):
+        trace_mod.read_jsonl(p2)
+    p3 = tmp_path / "missing_fields.jsonl"
+    p3.write_text(json.dumps({"kind": "span"}) + "\n")
+    with pytest.raises(trace_mod.TraceReadError):
+        trace_mod.read_jsonl(p3)
+    with pytest.raises(trace_mod.TraceReadError):
+        trace_mod.read_jsonl(tmp_path / "nonexistent.jsonl")
+
+
+def test_read_jsonl_span_missing_id_is_malformed(tmp_path):
+    """'id' is load-bearing (self_times keys on it): a record without
+    it must classify as TraceReadError at read time, never a KeyError
+    deep in summarize (the CLI's exit-2 contract)."""
+    p = tmp_path / "noid.jsonl"
+    p.write_text(json.dumps({"kind": "header"}) + "\n" + json.dumps(
+        {"kind": "span", "name": "dispatch", "t0": 0.1,
+         "dur": 0.5}) + "\n")
+    with pytest.raises(trace_mod.TraceReadError):
+        trace_mod.read_jsonl(p)
+    from kmeans_tpu.cli import trace_main
+    assert trace_main(["summarize", str(p)]) == 2
+
+
+def test_measurement_cache_opts_out_of_compile_spans():
+    """A cache constructed with compile_spans=False (the _AUTO_CACHE
+    measurement cache) emits no 'compile' span on a miss — its factory
+    is a measurement, not a program build."""
+    from kmeans_tpu.utils.cache import LRUCache
+    quiet = LRUCache(4, name="test._QUIET", compile_spans=False)
+    loud = LRUCache(4, name="test._LOUD")
+    with obs.tracing() as tr:
+        quiet.get_or_create("k", lambda: 1)
+        loud.get_or_create("k", lambda: 2)
+    compiles = spans_named(tr.records(), "compile")
+    assert [c["attrs"]["cache"] for c in compiles] == ["test._LOUD"]
+
+
+def test_chrome_export_schema(tmp_path):
+    with obs.tracing() as tr:
+        with obs.span("dispatch", tag="x"):
+            time.sleep(0.002)
+        obs.event("dispatch.note", label="y")
+    out = tmp_path / "chrome.json"
+    tr.write_chrome(out)
+    doc = json.loads(out.read_text())
+    evs = doc["traceEvents"]
+    assert evs, "no trace events exported"
+    phases = {e["ph"] for e in evs}
+    assert "X" in phases and "i" in phases
+    for e in evs:
+        assert {"name", "ph", "ts", "pid", "tid"} <= set(e)
+        assert e["ts"] >= 0
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+    # ts sorted ascending (the chrome loader expects it)
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+
+
+def test_nested_tracing_scopes_shadow():
+    with obs.tracing() as outer:
+        with obs.span("seed"):
+            pass
+        with obs.tracing() as inner:
+            with obs.span("dispatch"):
+                pass
+        with obs.span("io.block"):
+            pass
+    assert [r["name"] for r in outer.records()] == ["seed", "io.block"]
+    assert [r["name"] for r in inner.records()] == ["dispatch"]
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry
+# ---------------------------------------------------------------------------
+
+def test_registry_typed_metrics_and_snapshot():
+    reg = mr_mod.MetricsRegistry()
+    reg.counter("a.hits").inc()
+    reg.counter("a.hits").inc(2)
+    reg.gauge("a.level").set(7)
+    h = reg.histogram("a.lat")
+    for v in range(100):
+        h.observe(v)
+    snap = reg.snapshot()
+    assert snap["a.hits"] == {"kind": "counter", "value": 3}
+    assert snap["a.level"]["value"] == 7
+    lat = snap["a.lat"]["value"]
+    assert lat["count"] == 100 and lat["min"] == 0 and lat["max"] == 99
+    assert lat["p50"] == pytest.approx(50, abs=3)
+    json.loads(reg.to_json())          # JSON-exportable by contract
+
+
+def test_registry_name_type_conflict_raises():
+    reg = mr_mod.MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+
+
+def test_histogram_reservoir_thins_deterministically():
+    h = mr_mod.Histogram("h", reservoir=64)
+    for v in range(10_000):
+        h.observe(v)
+    assert h.count == 10_000
+    assert len(h._reservoir) <= 64
+    assert h.percentile(0.5) == pytest.approx(5000, rel=0.1)
+
+
+def test_note_dispatch_writes_through_registry_and_shim():
+    mr_mod.REGISTRY.reset()
+    with profiling.log_dispatches() as log:
+        with obs.tracing() as tr:
+            profiling.note_dispatch("test/label")
+            profiling.note_dispatch("test/label")
+    # shim list (the existing structural-pin surface)
+    assert log.count("test/label") == 2
+    # registry counter (the migrated canonical store)
+    snap = mr_mod.REGISTRY.snapshot()
+    assert snap["dispatch.test/label"]["value"] == 2
+    # tracer events
+    evs = [r for r in tr.records() if r.get("kind") == "event"]
+    assert len(evs) == 2
+    assert all(e["attrs"]["label"] == "test/label" for e in evs)
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_callback_and_file(tmp_path):
+    p = tmp_path / "hb.jsonl"
+    got = []
+    with obs.heartbeat(str(p), callback=got.append) as hb:
+        obs.note_progress(None, phase="iteration", iteration=3)
+        obs.note_progress(None, phase="checkpoint", iteration=6)
+    assert hb.emitted == 2 and len(got) == 2
+    lines = [json.loads(line) for line in p.read_text().splitlines()]
+    assert [r["phase"] for r in lines] == ["iteration", "checkpoint"]
+    assert all("ts" in r for r in lines)
+
+
+def test_heartbeat_thread_joins_on_close_no_leak():
+    before = set(threading.enumerate())
+    hb = Heartbeat(callback=lambda r: None, interval_s=0.02)
+    assert hb._thread is not None and hb._thread.is_alive()
+    hb.beat({"phase": "iteration"})
+    time.sleep(0.06)
+    hb.close()
+    assert hb._thread is None
+    leaked = [t for t in set(threading.enumerate()) - before
+              if t.name == "kmeans_tpu-heartbeat"]
+    assert not leaked
+    hb.close()                         # idempotent
+
+
+def test_heartbeat_timer_reemits_latest_with_tick():
+    got = []
+    with obs.heartbeat(callback=got.append, interval_s=0.03):
+        obs.note_progress(None, phase="iteration", iteration=1)
+        time.sleep(0.1)
+    ticks = [r for r in got if r.get("tick")]
+    assert ticks, "timer thread emitted no liveness ticks"
+    assert all(r["iteration"] == 1 for r in ticks)
+
+
+def test_heartbeat_throttle_flushes_latest_on_close():
+    got = []
+    with obs.heartbeat(callback=got.append, min_period_s=60.0):
+        for i in range(5):
+            obs.note_progress(None, phase="iteration", iteration=i)
+    # First beat emitted immediately; throttled tail flushed at close.
+    assert [r["iteration"] for r in got] == [0, 4]
+
+
+def test_heartbeat_callback_errors_isolated():
+    def bad(rec):
+        raise RuntimeError("observer broke")
+    with obs.heartbeat(callback=bad) as hb:
+        obs.note_progress(None, phase="iteration")
+    assert hb.callback_errors == 1 and hb.emitted == 1
+
+
+def test_phase_totals_incremental_matches_summarize():
+    """The O(names) incremental accumulators agree with the exact
+    post-hoc summarize() once spans are closed."""
+    with obs.tracing() as tr:
+        with obs.span("segment"):
+            with obs.span("dispatch"):
+                time.sleep(0.005)
+        with obs.span("stage"):
+            with obs.span("stage"):
+                time.sleep(0.002)
+    exact = {name: row["total"]
+             for name, row in obs.summarize(tr.records()).items()}
+    fast = tr.phase_totals()
+    assert set(fast) == set(exact)
+    for name in exact:
+        assert fast[name] == pytest.approx(exact[name], abs=1e-9)
+
+
+def test_heartbeat_reentrant_callback_does_not_deadlock():
+    """A callback that re-enters note_progress recurses through the
+    reentrant emit lock instead of deadlocking (review finding)."""
+    got = []
+
+    def reentrant(rec):
+        got.append(rec)
+        if not rec.get("nested"):
+            obs.note_progress(None, phase="iteration", nested=True)
+
+    with obs.heartbeat(callback=reentrant):
+        obs.note_progress(None, phase="iteration")
+    assert len(got) == 2
+    assert got[1]["nested"] is True
+
+
+def test_heartbeat_file_sink_failure_isolated(tmp_path):
+    """A dead file sink (unwritable path) is counted and disabled; the
+    fit-side beats and the callback keep working (the 'broken observer
+    never kills a healthy fit' contract covers BOTH sinks)."""
+    got = []
+    bad = tmp_path / "no_such_dir" / "hb.jsonl"
+    with obs.heartbeat(str(bad), callback=got.append) as hb:
+        obs.note_progress(None, phase="iteration", iteration=1)
+        obs.note_progress(None, phase="iteration", iteration=2)
+    assert hb.sink_errors == 1          # disabled after first failure
+    assert len(got) == 2                # callback unaffected
+
+
+def test_heartbeat_unserializable_field_does_not_raise(tmp_path):
+    p = tmp_path / "hb.jsonl"
+    with obs.heartbeat(str(p)):
+        obs.note_progress(None, phase="iteration",
+                          weird=np.float32(1.5), path=p)
+    rec = json.loads(p.read_text().splitlines()[0])
+    assert rec["phase"] == "iteration"  # default=str serialized it
+
+
+def test_note_progress_is_noop_without_heartbeat():
+    assert get_heartbeat() is None
+    obs.note_progress(None, phase="iteration")       # must not raise
+
+
+def test_heartbeat_validates_interval():
+    with pytest.raises(ValueError):
+        Heartbeat(interval_s=0)
+
+
+def test_heartbeat_scope_rejects_kwargs_with_instance():
+    """Kwargs alongside a pre-built Heartbeat would be silently
+    dropped (no timer thread despite interval_s) — loud error
+    instead."""
+    hb = Heartbeat()
+    try:
+        with pytest.raises(ValueError, match="interval_s"):
+            with obs.heartbeat(hb, interval_s=5.0):
+                pass
+    finally:
+        hb.close()
+
+
+# ---------------------------------------------------------------------------
+# obs-off parity: all five families, telemetry fully on vs off
+# ---------------------------------------------------------------------------
+
+def _fit_pair(build, X, tmp_path, tag):
+    """(plain_model, telemetry_model): identical construction, second
+    fit runs under tracing + heartbeat (JSONL sinks exercised too)."""
+    plain = build().fit(X)
+    with obs.tracing(str(tmp_path / f"{tag}.jsonl")), \
+            obs.heartbeat(str(tmp_path / f"{tag}.hb.jsonl")):
+        traced = build().fit(X)
+    return plain, traced
+
+
+@pytest.mark.parametrize("width", WIDTHS)
+def test_obs_off_parity_kmeans(width, tmp_path):
+    mesh = _mesh(width)
+    X = _blobs()
+
+    def build():
+        return KMeans(k=5, max_iter=8, tolerance=1e-12, seed=0,
+                      compute_sse=True, mesh=mesh, verbose=False)
+    a, b = _fit_pair(build, X, tmp_path, f"km{width}")
+    assert a.iterations_run == b.iterations_run
+    assert np.array_equal(a.centroids, b.centroids)
+    assert a.sse_history == b.sse_history
+    assert np.array_equal(a.labels_, b.labels_)
+
+
+@pytest.mark.parametrize("width", WIDTHS)
+def test_obs_off_parity_minibatch(width, tmp_path):
+    mesh = _mesh(width)
+    X = _blobs()
+
+    def build():
+        return MiniBatchKMeans(k=5, max_iter=8, batch_size=128, seed=0,
+                               mesh=mesh, verbose=False)
+    a, b = _fit_pair(build, X, tmp_path, f"mb{width}")
+    assert a.iterations_run == b.iterations_run
+    assert np.array_equal(a.centroids, b.centroids)
+
+
+@pytest.mark.parametrize("width", WIDTHS)
+def test_obs_off_parity_bisecting(width, tmp_path):
+    mesh = _mesh(width)
+    X = _blobs()
+
+    def build():
+        return BisectingKMeans(k=4, max_iter=6, seed=0, mesh=mesh,
+                               compute_sse=True, verbose=False)
+    a, b = _fit_pair(build, X, tmp_path, f"bk{width}")
+    assert a.iterations_run == b.iterations_run
+    assert np.array_equal(a.centroids, b.centroids)
+    assert np.array_equal(a.labels_, b.labels_)
+
+
+@pytest.mark.parametrize("width", WIDTHS)
+def test_obs_off_parity_spherical(width, tmp_path):
+    mesh = _mesh(width)
+    X = _blobs()
+
+    def build():
+        return SphericalKMeans(k=4, max_iter=8, seed=0, mesh=mesh,
+                               verbose=False)
+    a, b = _fit_pair(build, X, tmp_path, f"sk{width}")
+    assert a.iterations_run == b.iterations_run
+    assert np.array_equal(a.centroids, b.centroids)
+
+
+@pytest.mark.parametrize("width", WIDTHS)
+def test_obs_off_parity_gmm(width, tmp_path):
+    mesh = _mesh(width)
+    X = _blobs()
+
+    def build():
+        return GaussianMixture(n_components=4, max_iter=6,
+                               init_params="random", seed=0, mesh=mesh,
+                               verbose=False)
+    a, b = _fit_pair(build, X, tmp_path, f"gm{width}")
+    assert a.n_iter_ == b.n_iter_
+    assert np.array_equal(a.means_, b.means_)
+    assert np.array_equal(a.covariances_, b.covariances_)
+    assert a.lower_bound_ == b.lower_bound_
+
+
+def test_obs_off_parity_device_loop_and_stream(tmp_path):
+    """The one-dispatch device loop and the streamed fit under full
+    telemetry — same bit-exact contract."""
+    mesh = _mesh(min(4, len(jax.devices())))
+    X = _blobs()
+
+    def build_dev():
+        return KMeans(k=5, max_iter=8, tolerance=1e-12, seed=0,
+                      compute_sse=True, mesh=mesh, host_loop=False,
+                      empty_cluster="keep", verbose=False)
+    a, b = _fit_pair(build_dev, X, tmp_path, "kmdev")
+    assert np.array_equal(a.centroids, b.centroids)
+    assert a.sse_history == b.sse_history
+
+    def blocks():
+        for i in range(0, X.shape[0], 256):
+            yield X[i: i + 256]
+    km_plain = KMeans(k=5, max_iter=4, tolerance=1e-12, seed=0,
+                      compute_sse=True, mesh=mesh, verbose=False)
+    km_plain.fit_stream(lambda: blocks(), prefetch=2)
+    with obs.tracing(str(tmp_path / "stream.jsonl")):
+        km_tr = KMeans(k=5, max_iter=4, tolerance=1e-12, seed=0,
+                       compute_sse=True, mesh=mesh, verbose=False)
+        km_tr.fit_stream(lambda: blocks(), prefetch=2)
+    assert np.array_equal(km_plain.centroids, km_tr.centroids)
+    assert km_plain.sse_history == km_tr.sse_history
+
+
+# ---------------------------------------------------------------------------
+# Span structure: lifecycle, segments, OOM replay, resume
+# ---------------------------------------------------------------------------
+
+def test_traced_fit_emits_lifecycle_spans():
+    X = _blobs()
+    with obs.tracing() as tr:
+        KMeans(k=5, max_iter=5, seed=0, chunk_size=117,  # odd chunk ->
+               verbose=False).fit(X)                     # fresh cache key
+    recs = tr.records()
+    for name in ("place", "stage", "seed", "dispatch"):
+        assert spans_named(recs, name), f"no {name!r} span"
+    compiles = spans_named(recs, "compile")
+    assert compiles, "cache miss emitted no compile span"
+    assert any(c["attrs"]["cache"] == "kmeans._STEP_CACHE"
+               for c in compiles)
+    # builder construction nested inside the compile span
+    traces = spans_named(recs, "trace")
+    assert traces and all(t["attrs"]["builder"].startswith("make_")
+                          for t in traces)
+
+
+def test_segmented_fit_span_counts(tmp_path):
+    mesh = _mesh(min(2, len(jax.devices())))
+    X = _blobs()
+    p = tmp_path / "seg.npz"
+    with obs.tracing() as tr:
+        km = KMeans(k=5, max_iter=6, tolerance=1e-12, seed=0, mesh=mesh,
+                    host_loop=False, empty_cluster="keep", verbose=False)
+        km.fit(X, checkpoint_every=2, checkpoint_path=str(p))
+    recs = tr.records()
+    segs = spans_named(recs, "segment")
+    assert len(segs) == km.checkpoint_segments_
+    assert len(spans_named(recs, "checkpoint.save")) \
+        == km.checkpoint_segments_
+    # one dispatch attempt per healthy segment
+    fit_disp = [d for d in spans_named(recs, "dispatch")
+                if d.get("attrs", {}).get("tag") == "fit/segment"]
+    assert len(fit_disp) == len(segs)
+
+
+def test_oom_replay_attempts_nest_in_one_segment(tmp_path):
+    """The no-double-counting pin: an injected OOM replays the segment
+    as a SECOND dispatch-attempt span inside the SAME segment span —
+    segment count equals the clean run's."""
+    mesh = _mesh(min(2, len(jax.devices())))
+    X = _blobs()
+    # float64: the chunk backoff (256 -> 128) regroups the scan folds,
+    # and only f64 over f32-width data is regrouping-invariant (the r10
+    # parity-class table) — the pin here is about SPAN structure, with
+    # the trajectory pinned in its bit-exact class.
+    kw = dict(k=5, max_iter=6, tolerance=1e-12, seed=0, mesh=mesh,
+              chunk_size=256, host_loop=False, empty_cluster="keep",
+              verbose=False, dtype=np.float64)
+    p = tmp_path / "oom.npz"
+    clean = KMeans(**kw).fit(X)
+    import warnings
+    with obs.tracing() as tr, \
+            faults.inject_oom_on_segment(1) as rec, \
+            warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        km = KMeans(**kw)
+        km.fit(X, checkpoint_every=2, checkpoint_path=str(p))
+    assert rec["fired"] == 1 and km.oom_backoffs_ == 1
+    assert np.array_equal(km.centroids, clean.centroids)
+    recs = tr.records()
+    segs = spans_named(recs, "segment")
+    assert len(segs) == km.checkpoint_segments_   # replay added NO segment
+    fit_disp = [d for d in spans_named(recs, "dispatch")
+                if d.get("attrs", {}).get("tag") == "fit/segment"]
+    # one extra attempt for the replayed segment, inside its span
+    assert len(fit_disp) == len(segs) + 1
+    replayed = [d for d in fit_disp if d["attrs"]["attempt"] == 1]
+    assert len(replayed) == 1
+    seg_of = {s["id"]: s for s in segs}
+    assert replayed[0]["parent"] in seg_of
+    # registry write-through
+    assert mr_mod.REGISTRY.snapshot().get(
+        "fit.oom_backoffs", {}).get("value", 0) >= 1
+
+
+def test_resume_emits_restore_span(tmp_path):
+    mesh = _mesh(1)
+    X = _blobs()
+    p = tmp_path / "res.npz"
+    kw = dict(k=5, max_iter=6, tolerance=1e-12, seed=0, mesh=mesh,
+              host_loop=False, empty_cluster="keep", verbose=False)
+    with faults.inject_kill_after_iteration(2):
+        try:
+            KMeans(**kw).fit(X, checkpoint_every=2,
+                             checkpoint_path=str(p))
+        except faults.SimulatedPreemption:
+            pass
+    with obs.tracing() as tr:
+        km = KMeans(**kw)
+        km.fit(X, resume=str(p), checkpoint_every=2,
+               checkpoint_path=str(p))
+    recs = tr.records()
+    assert spans_named(recs, "checkpoint.restore")
+    assert spans_named(recs, "segment")
+
+
+def test_sentinel_emits_compile_span_per_new_key():
+    X = _blobs(n=400)
+    km = KMeans(k=3, max_iter=3, seed=0, chunk_size=97, verbose=False)
+    with obs.tracing() as tr:
+        with pytest.raises(profiling.RecompilationError):
+            with profiling.recompilation_sentinel():
+                km.fit(X)          # fresh odd chunk -> new cache keys
+    sentinel_spans = [s for s in spans_named(tr.records(), "compile")
+                     if s.get("attrs", {}).get("via") == "sentinel"]
+    assert sentinel_spans
+    assert all("STEP_CACHE" in s["attrs"]["cache"] or
+               "CACHE" in s["attrs"]["cache"] for s in sentinel_spans)
+
+
+def test_heartbeat_records_from_real_fits(tmp_path):
+    mesh = _mesh(1)
+    X = _blobs()
+    got = []
+    with obs.heartbeat(callback=got.append):
+        KMeans(k=5, max_iter=4, seed=0, mesh=mesh, compute_sse=True,
+               verbose=False).fit(X)
+    iters = [r for r in got if r["phase"] == "iteration"]
+    assert iters and iters[0]["model_class"] == "KMeans"
+    assert iters[-1]["iteration"] >= 1
+    assert "inertia" in iters[-1] and "shift" in iters[-1]
+
+    got_gm = []
+    with obs.heartbeat(callback=got_gm.append):
+        GaussianMixture(n_components=3, max_iter=4,
+                        init_params="random", seed=0, mesh=mesh,
+                        verbose=False).fit(X)
+    assert any(r["phase"] == "iteration" and
+               r["model_class"] == "GaussianMixture" for r in got_gm)
+
+    got_bk = []
+    with obs.heartbeat(callback=got_bk.append):
+        BisectingKMeans(k=4, max_iter=5, seed=0, mesh=mesh,
+                        verbose=False).fit(X)
+    assert any(r["phase"] == "split" for r in got_bk)
+
+    got_mb = []
+    with obs.heartbeat(callback=got_mb.append):
+        MiniBatchKMeans(k=4, max_iter=5, batch_size=128, seed=0,
+                        mesh=mesh, verbose=False).fit(X)
+    assert any(r["phase"] == "iteration" and
+               r["model_class"] == "MiniBatchKMeans" for r in got_mb)
+
+    p = tmp_path / "ckpt.npz"
+    got_ck = []
+    with obs.heartbeat(callback=got_ck.append):
+        KMeans(k=5, max_iter=6, tolerance=1e-12, seed=0, mesh=mesh,
+               host_loop=False, empty_cluster="keep",
+               verbose=False).fit(X, checkpoint_every=2,
+                                  checkpoint_path=str(p))
+    assert any(r["phase"] == "checkpoint" for r in got_ck)
+
+
+def test_serving_spans(tmp_path):
+    from kmeans_tpu.serving import ServingEngine
+    mesh = _mesh(1)
+    X = _blobs()
+    km = KMeans(k=4, max_iter=5, seed=0, mesh=mesh,
+                verbose=False).fit(X)
+    with ServingEngine(mesh=mesh, start=False) as eng:
+        eng.add_model("m", km)
+        with obs.tracing() as tr:
+            eng.predict("m", X[:8])
+            fut = eng.submit("m", X[:4])
+            eng.queue.service(now=float("inf"))
+            fut.result()
+    recs = tr.records()
+    reqs = spans_named(recs, "serve.request")
+    assert len(reqs) == 2
+    assert reqs[0]["attrs"]["model"] == "m"
+    flushes = spans_named(recs, "serve.flush")
+    assert len(flushes) == 1
+    # the flush's dispatch is nested under it
+    assert any(r["parent"] == flushes[0]["id"] for r in reqs)
+
+
+# ---------------------------------------------------------------------------
+# Time-to-first-iteration report
+# ---------------------------------------------------------------------------
+
+def test_ttfi_ladder_and_table_from_real_fit():
+    X = _blobs()
+    with obs.tracing() as tr:
+        KMeans(k=5, max_iter=4, seed=0, chunk_size=119,
+               host_loop=False, empty_cluster="keep",
+               verbose=False).fit(X)
+    recs = tr.records()
+    ladder = ttfi_ladder(recs)
+    assert [r["phase"] for r in ladder] == [
+        "place", "stage", "trace", "compile", "seed", "first_dispatch"]
+    cums = [r["cumulative"] for r in ladder]
+    assert cums == sorted(cums)
+    assert ladder[-1]["seconds"] > 0
+    rows = time_to_first_iteration(recs)
+    assert len(rows) == 6
+    total_share = sum(r["share"] for r in rows)
+    assert total_share == pytest.approx(1.0, abs=1e-6)
+    assert all(r["implied_ceiling_speedup"] >= 1.0 for r in rows)
+    table = obs.format_phase_table(rows)
+    assert "first_dispatch" in table and "TOTAL" in table
+
+
+def test_ttfi_requires_a_dispatch_span():
+    with obs.tracing() as tr:
+        with obs.span("seed"):
+            pass
+    with pytest.raises(ValueError):
+        ttfi_ladder(tr.records())
+
+
+# ---------------------------------------------------------------------------
+# CLI: python -m kmeans_tpu trace summarize
+# ---------------------------------------------------------------------------
+
+def _write_trace(tmp_path):
+    X = _blobs(n=400)
+    p = tmp_path / "fit.jsonl"
+    with obs.tracing(str(p)):
+        KMeans(k=4, max_iter=3, seed=0, host_loop=False,
+               empty_cluster="keep", verbose=False).fit(X)
+    return p
+
+
+def test_cli_trace_summarize_table(tmp_path, capsys):
+    from kmeans_tpu.cli import trace_main
+    p = _write_trace(tmp_path)
+    assert trace_main(["summarize", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "time-to-first-iteration" in out
+    assert "dispatch" in out and "p99" in out
+
+
+def test_cli_trace_summarize_json_and_chrome(tmp_path, capsys):
+    from kmeans_tpu.cli import trace_main
+    p = _write_trace(tmp_path)
+    chrome = tmp_path / "chrome.json"
+    assert trace_main(["summarize", str(p), "--json",
+                       "--chrome", str(chrome)]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert "phases" in doc and "time_to_first_iteration" in doc
+    assert doc["time_to_first_iteration"][-1]["phase"] == "first_dispatch"
+    cdoc = json.loads(chrome.read_text())
+    assert cdoc["traceEvents"]
+
+
+def test_cli_trace_exit_2_on_malformed(tmp_path, capsys):
+    from kmeans_tpu.cli import trace_main
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("{broken\n")
+    assert trace_main(["summarize", str(bad)]) == 2
+    assert "error:" in capsys.readouterr().err
+    assert trace_main(["summarize",
+                       str(tmp_path / "missing.jsonl")]) == 2
+
+
+def test_cli_trace_via_main(tmp_path, capsys, monkeypatch):
+    import kmeans_tpu.__main__ as main_mod
+    p = _write_trace(tmp_path)
+    monkeypatch.setattr("sys.argv",
+                        ["kmeans_tpu", "trace", "summarize", str(p)])
+    assert main_mod.main() == 0
+    assert "time-to-first-iteration" in capsys.readouterr().out
+
+
+def test_cli_trace_no_dispatch_summary_only(tmp_path, capsys):
+    """A trace without dispatch spans still summarizes (no TTFI
+    section, no crash)."""
+    from kmeans_tpu.cli import trace_main
+    p = tmp_path / "nodisp.jsonl"
+    with obs.tracing(str(p)):
+        with obs.span("seed"):
+            pass
+    assert trace_main(["summarize", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "seed" in out and "time-to-first-iteration" not in out
