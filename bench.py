@@ -89,6 +89,16 @@ BENCH_OBS_TRACE, default artifacts/trace_ttfi.jsonl).  Committed rule:
 <= 1% median overhead on the 200k x 32 k=64 proxy or per-iteration
 spans demote to segment-level.  Env: BENCH_N/_D/_K/_ITERS.
 
+BENCH_COST=1 switches to the DEVICE-COST OBSERVABILITY rows (ISSUE 12):
+analytic-vs-XLA-reported FLOPs and predicted-vs-observed peak-memory
+comparisons for the kmeans and gmm-diag step programs, captured
+through the real step-cache path (``kmeans_tpu.benchmarks.bench_cost``)
+— one JSON line per family for BASELINE.md/json.  Committed rule:
+analytic flops within +-10% of XLA at the 10M x 128 k=1024 headline
+shape keeps the hand-formula MFU numerator; a breach publishes as a
+finding and MFU switches to the XLA numerator.  Env: BENCH_N/_D/_K
+(kmeans), BENCH_GMM_N/_D/_K (gmm-diag; defaults scale with platform).
+
 BENCH_PHASES=1 switches to the MEASURED PER-PHASE CEILING TABLE
 (ISSUE 8c): the r8 cumulative-prefix phase ladder (distance ->
 +argmin -> +scatter/psum) with implied-ceiling-if-free columns and the
@@ -261,6 +271,25 @@ def main() -> None:
             log(f"bench: BF16-GUARD mode backend={backend} N={ln} "
                 f"D={ld} k={lk} iters_gap={li}")
             bench_bf16_guard(ln, ld, lk, li)
+        return
+
+    if os.environ.get("BENCH_COST"):
+        # Device-cost observability rows (ISSUE 12): analytic-vs-XLA
+        # flops + predicted-vs-observed peak memory for the kmeans and
+        # gmm-diag step programs, committed 10% agreement rule at the
+        # hardware headline shape.
+        from kmeans_tpu.benchmarks import bench_cost
+        kn = int(os.environ.get("BENCH_N",
+                                10_000_000 if on_accel else 200_000))
+        kd = int(os.environ.get("BENCH_D", 128))
+        kk = int(os.environ.get("BENCH_K", 1024 if on_accel else 64))
+        gn = int(os.environ.get("BENCH_GMM_N",
+                                2_097_152 if on_accel else 100_000))
+        gd = int(os.environ.get("BENCH_GMM_D", 128 if on_accel else 64))
+        gk = int(os.environ.get("BENCH_GMM_K", 256 if on_accel else 32))
+        log(f"bench: COST mode backend={backend} kmeans {kn}x{kd} "
+            f"k={kk}; gmm-diag {gn}x{gd} k={gk}")
+        bench_cost(kn, kd, kk, gmm_n=gn, gmm_d=gd, gmm_k=gk)
         return
 
     if os.environ.get("BENCH_PHASES"):

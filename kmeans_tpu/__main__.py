@@ -39,8 +39,11 @@ def main() -> int:
     if cmd == "trace":
         from kmeans_tpu.cli import trace_main
         return trace_main(rest)
+    if cmd == "cost-report":
+        from kmeans_tpu.cli import cost_report_main
+        return cost_report_main(rest)
     print(f"unknown command {cmd!r}; available: suite, bench, fit, "
-          f"sweep, ckpt-info, serve, report, lint, trace",
+          f"sweep, ckpt-info, serve, report, lint, trace, cost-report",
           file=sys.stderr)
     return 2
 

@@ -694,6 +694,52 @@ class DeadPrivateRule(Rule):
                     f"in the linted tree — every call site bypasses it")
 
 
+# --------------------------------------------------------- cache-name
+
+class CacheNameRule(Rule):
+    """Every module-level :class:`~kmeans_tpu.utils.cache.LRUCache`
+    construction must pass ``name=``: an unnamed cache is invisible to
+    the compile spans (its misses trace as the anonymous ``'cache'``)
+    AND to the ISSUE 12 cost capture, whose CostRecords key on the
+    cache name — so a new cache without one silently falls off both
+    the timeline and the device-cost report.  Function-local caches
+    (test fixtures, ad-hoc scopes) are exempt: only module-scope caches
+    live long enough to be an observability surface."""
+
+    id = "cache-name"
+    incident = ("ISSUE 12: unnamed caches are invisible to compile "
+                "spans and to device-cost capture")
+
+    def run(self, pkg: Package) -> Iterator[Finding]:
+        for mod in pkg:
+            parents = mod.parents()
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call)
+                        and (dotted(node.func) or "").split(".")[-1]
+                        == "LRUCache"):
+                    continue
+                if any(kw.arg == "name" for kw in node.keywords):
+                    continue
+                if self._enclosing_scope_is_module(parents, node):
+                    yield self.finding(
+                        mod, node.lineno,
+                        "module-level LRUCache(...) without name= — "
+                        "unnamed caches are invisible to compile spans "
+                        "and cost capture; pass name='<module>.<ATTR>'")
+
+    @staticmethod
+    def _enclosing_scope_is_module(parents: dict, node: ast.AST) -> bool:
+        p = parents.get(node)
+        while p is not None:
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                return False
+            if isinstance(p, ast.Module):
+                return True
+            p = parents.get(p)
+        return False
+
+
 # -------------------------------------------------------- suppression
 
 class SuppressionFormatRule(Rule):
@@ -725,5 +771,5 @@ class SuppressionFormatRule(Rule):
 RULES: Dict[str, Rule] = {rule.id: rule for rule in (
     TraceHazardRule(), CacheKeyRule(), DispatchAccountingRule(),
     ObsSpanRule(), ThreadHygieneRule(), CounterResetRule(),
-    DeadPrivateRule(), SuppressionFormatRule(),
+    DeadPrivateRule(), CacheNameRule(), SuppressionFormatRule(),
 )}

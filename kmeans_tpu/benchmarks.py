@@ -166,8 +166,13 @@ MODEL_SPECS = {
 #: bf16 peak TFLOP/s per backend — the MFU denominator (the rate "f32"
 #: dots execute at on the MXU; exp_glove_mfu.py precedent).  Backends
 #: without an entry publish ``step_mfu = None`` but always record
-#: ``flops_per_iter``, so the MFU is derivable the moment a peak is
-#: pinned for that platform.
+#: ``flops_per_iter``.  Since ISSUE 12 the hand FLOP formulas below are
+#: CROSS-CHECKED against XLA's own per-program cost analysis
+#: (``obs.cost``): an MFU row is publishable only while the analytic
+#: and XLA-reported flops agree within the committed 10% band
+#: (``obs.cost.FLOPS_AGREEMENT_RTOL``; ``BENCH_COST=1`` /
+#: ``cost-report`` emit the comparison) — a mismatch is a reported
+#: finding, never a silently trusted numerator.
 PEAK_TFLOPS = {"tpu": 197.0}
 
 
@@ -859,8 +864,22 @@ def bench_phases(n: int, d: int, k: int, *, gap: int = 20, reps: int = 5,
         [(ph, marginal(ph)) for ph in dist.ESTEP_PHASES], reps=reps)
     flops = kmeans_flops_per_iter(n, d, k)
     peak = PEAK_TFLOPS.get(backend)
+    # Device-cost join (ISSUE 12): AOT-analyze the measured full-stats
+    # program so every ceiling row carries analytic_flops/ai/
+    # mfu_analytic, and the XLA-vs-analytic agreement publishes next to
+    # the measured table (per-chunk on both sides — XLA counts loop
+    # bodies once).
+    from kmeans_tpu.obs import cost as obs_cost
+    cost_rec = obs_cost.analyze_jitted(
+        fns[dist.ESTEP_PHASES[-1]][2 + gap], pts, w, cents,
+        cache="bench.phases", key=f"N{n}_D{d}_k{k}_chunk{committed}")
+    agreement = obs_cost.crosscheck(
+        obs_cost.analytic_step_flops("kmeans", n=n, d=d, k=k,
+                                     chunk=committed,
+                                     n_devices=data_shards),
+        cost_rec)
     table = phase_ceiling_table(ladder, flops_per_iter=flops,
-                                peak_tflops=peak)
+                                peak_tflops=peak, cost_record=cost_rec)
     full = ladder[-1]["cumulative"]
     for row in table:
         _log(f"[phases] {row['phase']:9s} {row['ms']:8.3f} ms "
@@ -931,6 +950,8 @@ def bench_phases(n: int, d: int, k: int, *, gap: int = 20, reps: int = 5,
         "chunk": committed,
         "ladder": ladder,
         "ceiling_table": table,
+        "cost": cost_rec.to_dict(),
+        "flops_agreement": agreement,
         "chunk_sweep": sweep_rows,
         "decision_rules": {
             "phase_actionable_share": 0.15,
@@ -1072,6 +1093,68 @@ def bench_obs(n: int, d: int, k: int, iters: int = 20,
     }
     print(json.dumps(sanitize_json(result)), flush=True)
     return result
+
+
+def bench_cost(n: int, d: int, k: int, *, gmm_n: int = None,
+               gmm_d: int = None, gmm_k: int = None) -> List[Dict]:
+    """Device-cost observability benchmark (ISSUE 12: ``BENCH_COST=1
+    python bench.py``): analytic-vs-XLA FLOPs and predicted-vs-observed
+    peak-memory rows for the kmeans and gmm-diag step programs, one
+    JSON line each — the BASELINE.md/json artifact rows.
+
+    Each family's fit runs under the real step-cache capture path
+    (``obs.report.device_cost_report``), so the analyzed program is
+    exactly what ``fit`` dispatches.  COMMITTED DECISION RULE
+    (pre-registered): at the hardware headline shape 10M x 128 k=1024
+    the analytic and XLA-reported FLOPs must agree within the 10% band
+    (``obs.cost.FLOPS_AGREEMENT_RTOL``) for the MFU rows to keep their
+    hand-formula numerator; a breach is published as a finding and the
+    MFU rows switch to the XLA-reported numerator.  CPU rows publish
+    the same comparison now at the scaled proxy shapes.  The
+    predicted-vs-observed peak ratio has no pass/fail bar — the planner
+    is advisory — but ships on every row so drift is visible."""
+    import jax
+
+    from kmeans_tpu.obs.report import device_cost_report
+
+    specs = {"kmeans": dict(n=n, d=d, k=k),
+             "gmm": dict(n=gmm_n or n, d=gmm_d or d,
+                         k=gmm_k or max(2, k // 2))}
+    rep = device_cost_report(("kmeans", "gmm"), specs=specs)
+    rows = []
+    for row, plan in zip(rep["rows"], rep["plans"]):
+        observed = row.get("peak_bytes")
+        predicted = plan["predicted_peak_bytes"]
+        out = {
+            "metric": f"device_cost_{row['family']}_N{row['n']}"
+                      f"_D{row['d']}_k{row['k']}",
+            "value": row.get("ratio"),
+            "unit": "x (XLA-reported flops / analytic flops, one "
+                    "chunk of the step program)",
+            "family": row["family"],
+            "n": row["n"], "d": row["d"], "k": row["k"],
+            "chunk": row["chunk"],
+            "available": row["available"],
+            "reported_flops": row.get("flops"),
+            "analytic_flops": row.get("analytic_flops"),
+            "flops_agree_10pct": row.get("agree"),
+            "ai": row.get("ai"),
+            "bytes_accessed": row.get("bytes_accessed"),
+            "observed_peak_bytes": observed,
+            "predicted_peak_bytes": predicted,
+            "predicted_vs_observed": (round(predicted / observed, 3)
+                                      if observed else None),
+            "decision_rule": "analytic flops within 10% of XLA at "
+                             "10M x 128 k=1024 keeps the hand-formula "
+                             "MFU numerator; a breach is published and "
+                             "MFU switches to the XLA numerator",
+            "error": row.get("error"),
+            "platform": jax.default_backend(),
+            "n_devices": len(jax.devices()),
+        }
+        print(json.dumps(out), flush=True)
+        rows.append(out)
+    return rows
 
 
 def bench_stream(n: int, d: int, k: int, block_rows: int, epochs: int,

@@ -390,10 +390,19 @@ def trace_main(argv=None) -> int:
                         help="machine-readable output on stdout")
     parser.add_argument("--chrome", metavar="OUT.JSON", default=None,
                         help="also write a Chrome trace_event file")
+    parser.add_argument("--cost", action="store_true",
+                        help="merge captured CostRecords (ISSUE 12: "
+                             "cost.record events from obs.cost capture) "
+                             "into the per-phase table — flops/bytes/"
+                             "arithmetic-intensity columns, blank when "
+                             "the trace holds no cost records (no "
+                             "trace-derived MFU: captured flops count "
+                             "one loop-body pass, a span may cover "
+                             "many)")
     args = parser.parse_args(argv)
 
     from kmeans_tpu.obs import trace as obs_trace
-    from kmeans_tpu.obs.report import (format_phase_table,
+    from kmeans_tpu.obs.report import (format_phase_table, merge_cost,
                                        time_to_first_iteration)
     try:
         records = obs_trace.read_jsonl(args.file)
@@ -406,6 +415,7 @@ def trace_main(argv=None) -> int:
         ttfi = time_to_first_iteration(records)
     except ValueError:
         ttfi = None                  # no dispatch span — summary only
+    cost = merge_cost(records) if args.cost else None
 
     if args.chrome:
         with open(args.chrome, "w") as f:
@@ -414,29 +424,110 @@ def trace_main(argv=None) -> int:
 
     if args.json:
         from kmeans_tpu.utils.profiling import sanitize_json
-        print(json.dumps(sanitize_json(
-            {"file": args.file, "phases": summary,
-             "time_to_first_iteration": ttfi,
-             "chrome": args.chrome}), indent=2))
+        out = {"file": args.file, "phases": summary,
+               "time_to_first_iteration": ttfi,
+               "chrome": args.chrome}
+        if args.cost:
+            out["cost"] = cost
+        print(json.dumps(sanitize_json(out), indent=2))
         return 0
 
     n_spans = sum(1 for r in records if r.get("kind") == "span")
     n_events = sum(1 for r in records if r.get("kind") == "event")
     print(f"trace: {args.file} — {n_spans} spans, {n_events} events")
-    print(f"  {'phase':<20} {'count':>6} {'total ms':>10} "
-          f"{'p50 ms':>9} {'p99 ms':>9} {'events':>7}")
+    header = (f"  {'phase':<20} {'count':>6} {'total ms':>10} "
+              f"{'p50 ms':>9} {'p99 ms':>9} {'events':>7}")
+    if args.cost:
+        # flops/bytes/AI of the captured programs.  Deliberately NO
+        # wall-time MFU here: captured flops count ONE loop-body pass
+        # (the obs.cost convention) while a span may cover many
+        # iterations/chunks, so any trace-derived MFU would understate
+        # by that multiplicity.  AI is per-pass on both sides and
+        # therefore sound; analytic MFU lives where a measured
+        # per-iteration marginal exists (phase_ceiling_table / the
+        # BENCH_COST rows).
+        header += f" {'flops':>10} {'bytes':>10} {'ai':>7}"
+    print(header)
     for name in sorted(summary,
                        key=lambda n: -summary[n]["total"]):
         row = summary[name]
-        print(f"  {name:<20} {row['count']:>6} "
-              f"{row['total'] * 1e3:>10.2f} {row['p50'] * 1e3:>9.3f} "
-              f"{row['p99'] * 1e3:>9.3f} {row['events']:>7}")
+        line = (f"  {name:<20} {row['count']:>6} "
+                f"{row['total'] * 1e3:>10.2f} {row['p50'] * 1e3:>9.3f} "
+                f"{row['p99'] * 1e3:>9.3f} {row['events']:>7}")
+        if args.cost:
+            c = (cost or {}).get(name)
+            if c and c["programs"]:
+                ai = c.get("ai")
+                line += (f" {c['flops']:>10.3g} "
+                         f"{c['bytes_accessed']:>10.3g} "
+                         + (f"{ai:>7.2f}" if ai is not None
+                            else f"{'-':>7}"))
+            else:
+                line += f" {'-':>10} {'-':>10} {'-':>7}"
+        print(line)
     if ttfi is not None:
         print()
         print(format_phase_table(ttfi))
     if args.chrome:
         print(f"\nchrome trace written to {args.chrome} "
               f"(load in chrome://tracing or ui.perfetto.dev)")
+    return 0
+
+
+def cost_report_main(argv=None) -> int:
+    """``python -m kmeans_tpu cost-report`` — device-cost observability
+    report (ISSUE 12): run each model family's small fit under XLA
+    cost/memory capture and print the per-program table — XLA-reported
+    flops vs the analytic roofline formulas (ratio + the committed 10%
+    agreement band), arithmetic intensity, XLA per-program peak bytes
+    vs the HBM footprint planner's prediction — plus the per-device
+    plan table and the device's free-memory snapshot (unreported on
+    CPU).  ``--json`` emits the machine-readable payload; a backend
+    that cannot report yields ``available=False`` rows, never a
+    failure.  Exit 0 always when the fits themselves succeed."""
+    parser = argparse.ArgumentParser(
+        prog="python -m kmeans_tpu cost-report",
+        description="XLA cost/memory analysis per compiled step "
+                    "program: analytic-FLOPs cross-check, roofline, "
+                    "and the HBM footprint plan")
+    parser.add_argument("--families", default=None,
+                        help="comma list (default: kmeans,spherical,"
+                             "bisecting,minibatch,gmm)")
+    parser.add_argument("--n", type=int, default=None,
+                        help="rows override for every family")
+    parser.add_argument("--d", type=int, default=None)
+    parser.add_argument("--k", type=int, default=None)
+    parser.add_argument("--chunk", type=int, default=None,
+                        help="explicit scan chunk (default: one whole "
+                             "shard, the analytic-agreement regime)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable JSON on stdout")
+    args = parser.parse_args(argv)
+
+    from kmeans_tpu.obs.memory import FAMILIES, format_plan_table
+    from kmeans_tpu.obs.report import (device_cost_report,
+                                       format_cost_table)
+    families = [f.strip() for f in args.families.split(",")] \
+        if args.families else None
+    for fam in families or ():
+        if fam not in FAMILIES:
+            print(f"error: unknown family {fam!r}; families: "
+                  f"{','.join(FAMILIES)}", file=sys.stderr)
+            return 2
+    override = {k: v for k, v in
+                (("n", args.n), ("d", args.d), ("k", args.k))
+                if v is not None}
+    specs = {fam: dict(override)
+             for fam in (families or FAMILIES)} if override else None
+    rep = device_cost_report(families, specs=specs, chunk=args.chunk)
+    if args.json:
+        from kmeans_tpu.utils.profiling import sanitize_json
+        print(json.dumps(sanitize_json(rep), default=str))
+        return 0
+    print(format_cost_table(rep["rows"],
+                            title=f"device cost ({rep['backend']})"))
+    print()
+    print(format_plan_table(rep["plans"]))
     return 0
 
 

@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import os
 
+from kmeans_tpu.obs import memory as obs_memory
 from kmeans_tpu.obs import metrics_registry as obs_metrics
 from kmeans_tpu.obs import trace as obs_trace
 from kmeans_tpu.obs.heartbeat import note_progress as obs_note_progress
@@ -192,11 +193,22 @@ class AutoCheckpointMixin:
         with its chunk and attempt index — so a replayed segment adds
         attempt spans inside the SAME segment span, never a second
         segment (the no-double-counting contract
-        tests/test_obs.py pins)."""
+        tests/test_obs.py pins).  With a tracer active the segment also
+        opens with an ADVISORY memory check
+        (``obs.memory.advise_dispatch``, ISSUE 12): predicted tile
+        bytes vs device-free logged as a ``mem.plan`` event and the
+        ``fit.mem_planned_chunk`` gauge — informational, the chunk is
+        never steered by it."""
         import warnings
         import jax
         attempt = 0
         with obs_trace.span("segment", index=segment):
+            # Advisory pre-dispatch memory check (ISSUE 12): with a
+            # tracer active, log predicted tile footprint vs device-free
+            # bytes and record the ``fit.mem_planned_chunk`` gauge.
+            # Advisory ONLY — never raises, never changes the chunk;
+            # the reactive backoff below stays the enforcement path.
+            obs_memory.advise_dispatch(self, chunk, segment=segment)
             while True:
                 try:
                     with obs_trace.span("dispatch", tag="fit/segment",

@@ -1,6 +1,7 @@
-"""Unified telemetry layer (ISSUE 11): spans, metrics, heartbeats.
+"""Unified telemetry layer (ISSUE 11 + 12): spans, metrics, heartbeats,
+device cost.
 
-Three parts, one discipline:
+Four parts, one discipline:
 
 * :mod:`kmeans_tpu.obs.trace` — process-wide span tracing of the
   lifecycle phases an operator waits on (place/stage/compile/seed/
@@ -13,6 +14,9 @@ Three parts, one discipline:
   callback or JSONL file, driven from boundaries the fit already pays
   (zero extra dispatches) — the health channel ROADMAP item 1's
   orchestration loop consumes.
+* :mod:`kmeans_tpu.obs.cost` / :mod:`kmeans_tpu.obs.memory` — device-
+  cost capture (XLA cost/memory analysis per compiled step-cache
+  program, ISSUE 12) and the HBM footprint planner built on it.
 
 Telemetry is OFF by default and the disabled path is a true no-op
 (one None check); ``obs=0`` is the bit-exact parity oracle, pinned for
@@ -20,29 +24,42 @@ all five model families by tests/test_obs.py.  Quick start::
 
     from kmeans_tpu import obs
 
-    with obs.tracing("fit.jsonl") as tr:
+    with obs.tracing("fit.jsonl") as tr, obs.cost.collecting() as col:
         model.fit(X)
     print(obs.format_phase_table(obs.time_to_first_iteration(
         tr.records())))
+    for rec in col.records():
+        print(rec.cache, rec.flops, rec.peak_bytes)
 
-The trace/metrics/heartbeat modules are pure stdlib (no jax/numpy), so
-every layer — including ``utils.cache``, which emits the compile spans
-— can import them without cost or cycles; the report helpers (which
-pull ``utils.profiling``) load lazily.
+The trace/metrics/heartbeat/cost/memory modules are pure stdlib at
+import (no jax/numpy), so every layer — including ``utils.cache``,
+which emits the compile spans and the cost-capture hook — can import
+them without cost or cycles; the report helpers (which pull
+``utils.profiling``) load lazily.
+
+NAMESPACE GOTCHA, resolved deliberately: re-exporting the
+``heartbeat`` SCOPE FUNCTION shadows the ``kmeans_tpu.obs.heartbeat``
+submodule as a package attribute — ``obs.heartbeat`` IS the callable
+(the documented scope-manager surface), while the module stays
+importable as ``from kmeans_tpu.obs.heartbeat import note_progress``
+(resolved via sys.modules, immune to the shadowing).  The submodule's
+public names — ``note_progress``, ``Heartbeat``, ``get_heartbeat`` —
+are therefore ALSO re-exported at package level below, so no consumer
+needs to reach through the shadowed attribute;
+tests/test_obs.py pins both routes.
 """
 
+from kmeans_tpu.obs import cost, memory
 from kmeans_tpu.obs.trace import (SPAN_NAMES, TraceReadError, Tracer,
                                   chrome_events, event, get_tracer,
                                   read_jsonl, span, summarize, tracing)
 from kmeans_tpu.obs.metrics_registry import (REGISTRY, Counter, Gauge,
                                              Histogram, MetricsRegistry,
                                              registry)
-# NOTE: re-exporting the `heartbeat` SCOPE function shadows the
-# `kmeans_tpu.obs.heartbeat` submodule as a package attribute —
-# `from kmeans_tpu.obs import heartbeat` yields the function.  In-
-# package consumers therefore import names straight from the
-# submodule (`from kmeans_tpu.obs.heartbeat import note_progress`),
-# which resolves via sys.modules and is immune to the shadowing.
+# This import block MUST stay last: binding the `heartbeat` callable is
+# what shadows the submodule attribute (see the docstring), and the
+# package-level re-exports of Heartbeat/get_heartbeat/note_progress are
+# the supported spelling for everything else the submodule exports.
 from kmeans_tpu.obs.heartbeat import (Heartbeat, get_heartbeat, heartbeat,
                                       note_progress)
 
@@ -51,13 +68,15 @@ __all__ = [
     "get_tracer", "read_jsonl", "span", "summarize", "tracing",
     "REGISTRY", "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "registry", "Heartbeat", "get_heartbeat", "heartbeat",
-    "note_progress",
+    "note_progress", "cost", "memory",
     # lazy (pull utils.profiling, which imports jax):
     "ttfi_ladder", "time_to_first_iteration", "format_phase_table",
+    "merge_cost", "format_cost_table",
 ]
 
 _LAZY_REPORT = ("ttfi_ladder", "time_to_first_iteration",
-                "format_phase_table", "TTFI_PHASES")
+                "format_phase_table", "TTFI_PHASES", "merge_cost",
+                "format_cost_table", "device_cost_report")
 
 
 def __getattr__(name):

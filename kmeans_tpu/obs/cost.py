@@ -1,0 +1,460 @@
+"""Device-cost capture: XLA cost/memory analysis per compiled program.
+
+ISSUE 12 tentpole.  r15's telemetry sees host-side wall time at sync
+points; nothing in the system could say what a compiled program *costs
+on the device* — FLOPs, bytes moved, peak HBM — so MFU rows rested on
+hand-derived FLOP formulas and OOM was discovered by catching
+``RESOURCE_EXHAUSTED``.  This module captures XLA's own per-program
+analyses (``Compiled.cost_analysis()`` / ``memory_analysis()``) into
+typed :class:`CostRecord`\\ s at the step-cache miss the r15 compile
+span already instruments (``utils.cache.LRUCache.get_or_create``
+calls :func:`instrument` on every MISS), and layers the analytic
+roofline on top (:func:`analytic_step_flops`, :func:`crosscheck`,
+:func:`roofline_fields`).
+
+Capture contract (mirrors the tracer's):
+
+* OFF by default; :func:`instrument` with no collector installed is one
+  ``None`` check returning the value untouched — the ``obs=0`` parity
+  oracle holds trivially and the warm path never changes.
+* When a :func:`collecting` scope is active, a cache MISS wraps the
+  built program(s) in a one-shot capturing proxy.  On the program's
+  FIRST call the proxy AOT-lowers it against the real call's arguments
+  (``fn.lower(*args).compile()`` — shape/dtype/sharding only, the
+  buffers are never read, so donated inputs are safe) and records the
+  analyses; the real call then proceeds through the jit path unchanged.
+  Capture adds ZERO dispatches (the AOT executable is analyzed, never
+  executed) and changes no numerics; it costs one extra XLA compile per
+  captured program, deduplicated by the persistent compilation cache
+  when one is enabled.
+* A backend that cannot report (or reports partially) yields a record
+  with ``available=False`` and never fails the fit, the compile, or the
+  recompilation sentinel — degraded observability is still
+  observability.
+
+Semantics worth knowing (documented, load-bearing):
+
+* **Analyses are per-device.**  XLA runs them on the post-SPMD-
+  partitioning module — the program ONE device executes — so reported
+  flops/bytes are already "after mesh division".  ``n_devices`` (from
+  the argument sharding) is recorded so totals are derivable.
+* **Loop bodies are counted once.**  HLO cost analysis does not
+  multiply by trip counts: a ``while_loop`` fit program reports ONE
+  iteration's cost, and a ``scan``-chunked pass reports ONE CHUNK's.
+  :func:`analytic_step_flops` applies the same convention to the hand
+  formulas so the cross-check compares like with like.
+* **Peak is per-program, not allocator-global.**  ``peak_bytes`` is
+  the executable's arg+output+temp footprint (minus aliased buffers);
+  other resident buffers (datasets, other models' tables) share the
+  allocator, so the footprint planner (:mod:`kmeans_tpu.obs.memory`)
+  treats it as a component, not the device total.
+
+Pure stdlib at import (jax loads lazily at capture time) — importable
+from every layer including ``utils.cache``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+from dataclasses import asdict, dataclass
+from typing import Dict, List, Optional
+
+from kmeans_tpu.obs import trace as _trace
+from kmeans_tpu.obs.metrics_registry import REGISTRY
+
+__all__ = ["CostRecord", "CostCollector", "collecting", "get_collector",
+           "instrument", "analyze_jitted", "normalize_compiled",
+           "analytic_step_flops", "crosscheck", "roofline_fields",
+           "FLOPS_AGREEMENT_RTOL"]
+
+#: The committed analytic-vs-XLA FLOPs agreement band (pre-registered,
+#: the repo's decision-rule discipline): |reported/analytic - 1| <= 10%
+#: on the kmeans and gmm-diag step programs.  A larger mismatch is a
+#: REPORTED finding (``crosscheck()['agree'] = False`` in the bench/CLI
+#: artifacts), never silently trusted in an MFU row.
+FLOPS_AGREEMENT_RTOL = 0.10
+
+
+@dataclass
+class CostRecord:
+    """One compiled program's device-cost analysis, normalized.
+
+    All byte/flop figures are PER-DEVICE (see the module docstring);
+    ``None`` means the backend did not report that figure.  ``key`` is
+    the (truncated) repr of the compile-cache key, so a record joins
+    back to the compile span that built the program.
+    """
+
+    cache: str
+    key: str
+    role: Optional[int] = None        # index inside a tuple cache entry
+    backend: str = "?"
+    n_devices: int = 1
+    available: bool = False
+    error: Optional[str] = None
+    flops: Optional[float] = None
+    transcendentals: Optional[float] = None
+    bytes_accessed: Optional[float] = None
+    arg_bytes: Optional[int] = None
+    out_bytes: Optional[int] = None
+    temp_bytes: Optional[int] = None
+    alias_bytes: Optional[int] = None
+    code_bytes: Optional[int] = None
+    peak_bytes: Optional[int] = None  # arg + out + temp - alias
+
+    def arithmetic_intensity(self) -> Optional[float]:
+        """flops / bytes-accessed — the roofline x-axis; None when
+        either figure is unreported or bytes are zero."""
+        if self.flops is None or not self.bytes_accessed:
+            return None
+        return self.flops / self.bytes_accessed
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d["ai"] = self.arithmetic_intensity()
+        return d
+
+
+# ------------------------------------------------------------ collector
+
+#: Process-wide active collector (None = capture off, the default).
+_COLLECTOR: Optional["CostCollector"] = None
+
+
+class CostCollector:
+    """Sink for captured :class:`CostRecord`\\ s.
+
+    Thread-safe (serving captures from queue workers); one record per
+    (cache, key, role) — a program is analyzed once, on its first call.
+    Each accepted record also writes through the shared surfaces:
+    ``cost.captured`` / ``cost.unavailable`` registry counters, the
+    ``cost.peak_bytes`` gauge (max seen), and — when a tracer is active
+    — an instant ``cost.record`` event on the span timeline, so trace
+    JSONL carries the records for ``trace summarize --cost``.
+    """
+
+    def __init__(self):
+        self.closed = False
+        self._lock = threading.Lock()
+        self._records: List[CostRecord] = []
+        self._seen: set = set()
+
+    def add(self, rec: CostRecord) -> bool:
+        ident = (rec.cache, rec.key, rec.role)
+        with self._lock:
+            if self.closed or ident in self._seen:
+                return False
+            self._seen.add(ident)
+            self._records.append(rec)
+        REGISTRY.counter("cost.captured" if rec.available
+                         else "cost.unavailable").inc()
+        if rec.available and rec.peak_bytes is not None:
+            g = REGISTRY.gauge("cost.peak_bytes")
+            if g.value is None or rec.peak_bytes > g.value:
+                g.set(rec.peak_bytes)
+        _trace.event("cost.record", **{
+            k: v for k, v in rec.to_dict().items() if v is not None})
+        return True
+
+    def records(self) -> List[CostRecord]:
+        with self._lock:
+            return list(self._records)
+
+    def by_cache(self) -> Dict[str, List[CostRecord]]:
+        out: Dict[str, List[CostRecord]] = {}
+        for rec in self.records():
+            out.setdefault(rec.cache, []).append(rec)
+        return out
+
+    def max_metrics(self) -> dict:
+        """Max available per-device peak bytes / flops across captured
+        programs — the step program dominates both, so these are the
+        heartbeat's ``mem_peak_bytes``/``program_flops`` fields."""
+        peaks = [r.peak_bytes for r in self.records()
+                 if r.available and r.peak_bytes is not None]
+        flops = [r.flops for r in self.records()
+                 if r.available and r.flops is not None]
+        return {"mem_peak_bytes": max(peaks) if peaks else None,
+                "program_flops": max(flops) if flops else None}
+
+    def write_jsonl(self, path) -> None:
+        with open(path, "w") as f:
+            for rec in self.records():
+                f.write(json.dumps(rec.to_dict(), default=str) + "\n")
+
+
+def get_collector() -> Optional[CostCollector]:
+    """The active collector, or None (capture off — the default)."""
+    return _COLLECTOR
+
+
+@contextlib.contextmanager
+def collecting(path=None, collector: Optional[CostCollector] = None):
+    """Install a cost collector for the ``with`` body (nested scopes
+    shadow, the ``tracing``/``heartbeat`` discipline); on exit restore
+    the previous one, mark the scope's collector closed (a cached proxy
+    whose first call lands later must not capture into a dead scope),
+    and write the records as JSONL when ``path`` is given.
+
+    Usage::
+
+        with obs.cost.collecting() as col:
+            model.fit(X)          # step-cache MISSES are captured
+        for rec in col.records():
+            print(rec.cache, rec.flops, rec.peak_bytes)
+    """
+    global _COLLECTOR
+    col = collector if collector is not None else CostCollector()
+    prev, _COLLECTOR = _COLLECTOR, col
+    try:
+        yield col
+    finally:
+        _COLLECTOR = prev
+        col.closed = True
+        if path is not None:
+            col.write_jsonl(path)
+
+
+# -------------------------------------------------------- normalization
+
+def _cost_dict(compiled) -> Optional[dict]:
+    """``cost_analysis()`` result as one flat dict (jax returns a
+    one-element list on some versions, a dict on others), or None."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    return ca if isinstance(ca, dict) else None
+
+
+def normalize_compiled(compiled, *, cache: str = "adhoc", key: str = "",
+                       role: Optional[int] = None, backend: str = "?",
+                       n_devices: int = 1) -> CostRecord:
+    """One :class:`CostRecord` from a jax ``Compiled`` (or anything
+    shaped like one).  Never raises: an analysis that throws or reports
+    partially yields ``available=False`` with the failure named in
+    ``error`` and every figure that WAS reported kept — the degraded-
+    backend contract tests/test_cost.py pins."""
+    rec = CostRecord(cache=cache, key=key, role=role, backend=backend,
+                     n_devices=int(n_devices))
+    errors = []
+    try:
+        ca = _cost_dict(compiled)
+        if ca is None:
+            errors.append("cost_analysis: unreported")
+        else:
+            flops = ca.get("flops")
+            rec.flops = float(flops) if flops is not None else None
+            ba = ca.get("bytes accessed")
+            rec.bytes_accessed = float(ba) if ba is not None else None
+            tr = ca.get("transcendentals")
+            rec.transcendentals = float(tr) if tr is not None else None
+            if rec.flops is None:
+                errors.append("cost_analysis: no flops key")
+    except Exception as e:  # noqa: BLE001 — backend-specific failures
+        errors.append(f"cost_analysis: {type(e).__name__}: {e}")
+    try:
+        ma = compiled.memory_analysis()
+        if ma is None:
+            errors.append("memory_analysis: unreported")
+        else:
+            rec.arg_bytes = _int_attr(ma, "argument_size_in_bytes")
+            rec.out_bytes = _int_attr(ma, "output_size_in_bytes")
+            rec.temp_bytes = _int_attr(ma, "temp_size_in_bytes")
+            rec.alias_bytes = _int_attr(ma, "alias_size_in_bytes")
+            rec.code_bytes = _int_attr(ma, "generated_code_size_in_bytes")
+            parts = (rec.arg_bytes, rec.out_bytes, rec.temp_bytes)
+            if any(p is None for p in parts):
+                errors.append("memory_analysis: partial sizes")
+            else:
+                rec.peak_bytes = (rec.arg_bytes + rec.out_bytes
+                                  + rec.temp_bytes
+                                  - (rec.alias_bytes or 0))
+    except Exception as e:  # noqa: BLE001 — backend-specific failures
+        errors.append(f"memory_analysis: {type(e).__name__}: {e}")
+    rec.available = rec.flops is not None and rec.peak_bytes is not None
+    rec.error = "; ".join(errors) if errors else None
+    return rec
+
+
+def _int_attr(obj, name: str) -> Optional[int]:
+    v = getattr(obj, name, None)
+    try:
+        return int(v) if v is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
+def _args_n_devices(args, kwargs) -> int:
+    """Devices participating in the call, read off the first sharded
+    argument (the analyses are per-device; this makes totals
+    derivable).  1 when nothing is sharded or jax is unavailable."""
+    try:
+        import jax
+        for leaf in jax.tree_util.tree_leaves((args, kwargs)):
+            sharding = getattr(leaf, "sharding", None)
+            if sharding is not None:
+                return max(1, len(sharding.device_set))
+    except Exception:  # noqa: BLE001 — observability only
+        pass
+    return 1
+
+
+def analyze_jitted(fn, *args, cache: str = "adhoc", key: str = "",
+                   role: Optional[int] = None, **kwargs) -> CostRecord:
+    """AOT-analyze a jitted function against concrete call arguments:
+    ``fn.lower(*args, **kwargs).compile()`` (avals only — buffers are
+    never read, donation-safe) normalized into a :class:`CostRecord`.
+    Never raises and never dispatches; a function without ``lower`` (or
+    a backend that cannot compile AOT) yields ``available=False``."""
+    backend = "?"
+    try:
+        import jax
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — observability only
+        pass
+    n_dev = _args_n_devices(args, kwargs)
+    try:
+        lower = getattr(fn, "lower", None)
+        if lower is None:
+            raise TypeError(f"{type(fn).__name__} has no .lower — not "
+                            f"an AOT-analyzable program")
+        compiled = lower(*args, **kwargs).compile()
+    except Exception as e:  # noqa: BLE001 — capture must never fail a fit
+        return CostRecord(cache=cache, key=key, role=role, backend=backend,
+                          n_devices=n_dev, available=False,
+                          error=f"lower/compile: {type(e).__name__}: {e}")
+    return normalize_compiled(compiled, cache=cache, key=key, role=role,
+                              backend=backend, n_devices=n_dev)
+
+
+# ------------------------------------------------------- capture proxy
+
+class _CapturedProgram:
+    """One-shot capturing proxy around a cached compiled-function: the
+    first call AOT-analyzes the program against the call's own
+    arguments, every call delegates to the wrapped function unchanged
+    (same jit path, same numerics, zero extra dispatches).  Attribute
+    access falls through, so ``.lower``/jit introspection keep working.
+    """
+
+    __slots__ = ("_fn", "_cache", "_key", "_role", "_collector", "_done")
+
+    def __init__(self, fn, cache: str, key: str, role: Optional[int],
+                 collector: CostCollector):
+        self._fn = fn
+        self._cache = cache
+        self._key = key
+        self._role = role
+        self._collector = collector
+        self._done = False
+
+    def __call__(self, *args, **kwargs):
+        if not self._done:
+            # Benign race: two threads may both analyze; the collector
+            # dedupes by (cache, key, role), so at worst one redundant
+            # AOT compile — never a wrong record.
+            self._done = True
+            if not self._collector.closed:
+                try:
+                    rec = analyze_jitted(
+                        self._fn, *args, cache=self._cache,
+                        key=self._key, role=self._role, **kwargs)
+                except Exception as e:  # noqa: BLE001 — never fail a fit
+                    # analyze_jitted is non-raising by design; this
+                    # guard covers a patched/broken analyzer too —
+                    # degraded capture must never take the fit down.
+                    rec = CostRecord(
+                        cache=self._cache, key=self._key,
+                        role=self._role, available=False,
+                        error=f"capture: {type(e).__name__}: {e}")
+                try:
+                    self._collector.add(rec)
+                except Exception:  # noqa: BLE001 — broken collector
+                    pass
+        return self._fn(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
+def instrument(cache_name: str, key, value):
+    """The ``LRUCache.get_or_create`` MISS hook: wrap the freshly built
+    program(s) for capture when a collector is active; return ``value``
+    untouched otherwise (one ``None`` check — the disabled-path
+    contract).  Tuple-valued entries (kmeans' ``(step_fn, predict_fn)``
+    pair) keep their structure, each callable member wrapped with its
+    index as ``role``; non-callable values pass through."""
+    col = _COLLECTOR
+    if col is None:
+        return value
+    key_repr = repr(key)[:160]
+    if isinstance(value, tuple):
+        return tuple(
+            _CapturedProgram(v, cache_name, key_repr, i, col)
+            if callable(v) else v
+            for i, v in enumerate(value))
+    if callable(value):
+        return _CapturedProgram(value, cache_name, key_repr, None, col)
+    return value
+
+
+# ------------------------------------------------------------- roofline
+
+def analytic_step_flops(family: str, n: int, d: int, k: int, *,
+                        chunk: Optional[int] = None, n_devices: int = 1,
+                        cov_type: str = "diag") -> float:
+    """The hand-derived FLOPs of ONE compiled step-program pass, under
+    the same conventions XLA's cost analysis uses (per-device rows;
+    loop bodies counted once, so a ``scan``-chunked program counts one
+    chunk) — the roofline cross-check's analytic side.  Families:
+    ``kmeans``/``spherical``/``bisecting``/``minibatch`` (the Lloyd
+    4·rows·D·k pass; minibatch rows = its batch) and ``gmm`` (per
+    ``cov_type``, ``benchmarks.gmm_flops_per_iter``)."""
+    from kmeans_tpu.benchmarks import (gmm_flops_per_iter,
+                                       kmeans_flops_per_iter)
+    rows = -(-int(n) // max(1, int(n_devices)))
+    if chunk:
+        rows = min(rows, int(chunk))
+    if family == "gmm":
+        return gmm_flops_per_iter(rows, d, k, cov_type)
+    if family in ("kmeans", "spherical", "bisecting", "minibatch"):
+        return kmeans_flops_per_iter(rows, d, k)
+    raise ValueError(f"unknown family {family!r}")
+
+
+def crosscheck(analytic_flops: float, record: CostRecord,
+               rtol: float = FLOPS_AGREEMENT_RTOL) -> dict:
+    """Analytic-vs-XLA FLOPs agreement for one program: ``ratio`` =
+    reported/analytic, ``agree`` = within ``rtol`` (the committed 10%
+    band).  XLA counts every elementwise/reduction op while the hand
+    formulas count only the real matmul work (padding and bookkeeping
+    get no credit — the repo's MFU definition), so the ratio runs
+    slightly ABOVE 1 and shrinks as D·k grows; a mismatch beyond the
+    band is a reported finding, not a silently trusted number."""
+    ratio = (record.flops / analytic_flops
+             if record.flops is not None and analytic_flops > 0 else None)
+    return {"analytic_flops": analytic_flops,
+            "reported_flops": record.flops,
+            "ratio": ratio,
+            "agree": bool(ratio is not None
+                          and abs(ratio - 1.0) <= rtol),
+            "rtol": rtol}
+
+
+def roofline_fields(analytic_flops: float, seconds: Optional[float],
+                    record: Optional[CostRecord] = None,
+                    peak_tflops: Optional[float] = None) -> dict:
+    """The three roofline columns a BASELINE row carries:
+    ``analytic_flops`` (the hand formula), ``ai`` (XLA flops/bytes when
+    a record is available, else None), and ``mfu_analytic`` (analytic
+    flops over measured seconds against the pinned peak; None without a
+    peak — the CPU container publishes the flops so the MFU is
+    derivable the moment a peak is pinned)."""
+    ai = record.arithmetic_intensity() if record is not None else None
+    mfu = None
+    if peak_tflops and seconds and seconds > 0:
+        mfu = analytic_flops / seconds / (peak_tflops * 1e12)
+    return {"analytic_flops": analytic_flops, "ai": ai,
+            "mfu_analytic": mfu}

@@ -28,6 +28,8 @@ Record schema (one JSON object per emission)::
      "inertia": 8.1e4, "effective_chunk": 65536, "oom_backoffs": 0,
      "dispatch_counts": {...},        # registry dispatch.* counters
      "phase_elapsed": {...},          # tracer per-phase self seconds
+     "mem_peak_bytes": 420304,        # max captured program peak (ISSUE
+     "program_flops": 1.97e7,         #   12; only when cost capture on)
      "tick": true                     # only on timer re-emissions
     }
 
@@ -43,6 +45,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from kmeans_tpu.obs import cost as _cost
 from kmeans_tpu.obs import trace as _trace
 from kmeans_tpu.obs.metrics_registry import registry as _registry
 
@@ -171,6 +174,16 @@ class Heartbeat:
         tr = _trace.get_tracer()
         if tr is not None:
             rec.setdefault("phase_elapsed", tr.phase_totals())
+        col = _cost.get_collector()
+        if col is not None:
+            # Device-cost fields (ISSUE 12): the max available per-
+            # program peak/flops across captured programs — the step
+            # program dominates both.  Host-side dict reads only.
+            mx = col.max_metrics()
+            if mx["mem_peak_bytes"] is not None:
+                rec.setdefault("mem_peak_bytes", mx["mem_peak_bytes"])
+            if mx["program_flops"] is not None:
+                rec.setdefault("program_flops", mx["program_flops"])
         counts = {name: m["value"]
                   for name, m in _registry().snapshot().items()
                   if name.startswith("dispatch.")}

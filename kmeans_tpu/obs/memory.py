@@ -1,0 +1,272 @@
+"""HBM footprint planner: predict per-device peak bytes before dispatch.
+
+ISSUE 12 (b).  The OOM story before this module was reactive: dispatch,
+catch ``RESOURCE_EXHAUSTED``, halve the chunk, replay
+(``models.fault_tolerance._dispatch_oom_safe``).  This module is the
+predictive half: :func:`plan_fit` models a family's per-device working
+set from the shapes alone (the same padding/sharding arithmetic the fit
+actually performs), optionally joined with captured
+:class:`~kmeans_tpu.obs.cost.CostRecord`\\ s for the XLA-observed
+per-program peak, and :func:`advise_dispatch` runs the comparison
+against the device's free memory as an ADVISORY pre-dispatch check —
+logged and recorded, never steering: ``chunk`` semantics and every
+parity oracle stay bit-exact, and the reactive backoff remains the
+enforcement path.
+
+Planner caveats (documented, load-bearing):
+
+* **XLA-reported peak is per-program, not allocator-global.**  A step
+  program's arg+output+temp footprint shares the allocator with the
+  resident dataset, other models' tables, and the staging buffers —
+  the plan therefore models the RESIDENT set (points/weights/tables)
+  and the per-dispatch temporaries separately and sums them; the
+  observed per-program peak cross-checks the temporaries term only.
+* **The model is an upper-bound sketch, not an allocator simulation.**
+  XLA fuses, rematerializes, and reuses buffers; the plan's job is the
+  operator question "will this chunk fit, roughly, before I pay the
+  dispatch" — the committed predicted-vs-observed comparison
+  (``BENCH_COST=1``) keeps it honest.
+
+Pure stdlib at import; jax loads lazily inside
+:func:`device_memory_info`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from kmeans_tpu.obs import trace as _trace
+from kmeans_tpu.obs.metrics_registry import REGISTRY
+
+__all__ = ["plan_fit", "device_memory_info", "advise_dispatch",
+           "format_plan_table", "FAMILIES"]
+
+#: Families the planner models (the five shipped fit engines; the three
+#: non-diag mixture covariance shapes ride on the ``cov_type`` knob).
+FAMILIES = ("kmeans", "spherical", "bisecting", "minibatch", "gmm")
+
+_DTYPE_BYTES = {"float32": 4, "float64": 8, "bfloat16": 2, "float16": 2}
+
+
+def _itemsize(dtype) -> int:
+    name = getattr(dtype, "name", None) or str(dtype)
+    return _DTYPE_BYTES.get(name.replace("np.", "").replace("jnp.", ""), 4)
+
+
+def plan_fit(family: str, n: int, d: int, k: int, *,
+             data_shards: int = 1, model_shards: int = 1,
+             dtype="float32", chunk: Optional[int] = None,
+             cov_type: str = "diag", batch: Optional[int] = None,
+             pipeline: int = 0, records=None) -> dict:
+    """Predict one device's working set for a family's fit at a shape.
+
+    Mirrors the real placement arithmetic: rows pad up to
+    ``data_shards * chunk`` multiples (``parallel.sharding``), the
+    centroid/parameter tables row-shard over ``model_shards``, and the
+    per-dispatch temporary is the (chunk, k) distance/responsibility
+    tile (doubled under the pipelined schedule, which carries two
+    tiles in flight) plus the (k, d) stats accumulators.
+
+    Returns a dict of per-device byte components plus
+    ``predicted_resident_bytes`` (dataset + tables: survives the
+    dispatch), ``predicted_temp_bytes`` (per-dispatch transient), and
+    ``predicted_peak_bytes`` (their sum).  When ``records`` (an
+    iterable of :class:`~kmeans_tpu.obs.cost.CostRecord`) holds an
+    available record for the family's step cache, the XLA-observed
+    per-program ``observed_peak_bytes`` joins the plan for the
+    predicted-vs-observed comparison.
+    """
+    if family not in FAMILIES:
+        raise ValueError(f"unknown family {family!r}; families: "
+                         f"{FAMILIES}")
+    item = _itemsize(dtype)
+    data_shards = max(1, int(data_shards))
+    model_shards = max(1, int(model_shards))
+    rows_local = -(-int(n) // data_shards)
+    if chunk:
+        chunk_eff = int(chunk)
+        rows_local = -(-rows_local // chunk_eff) * chunk_eff
+    else:
+        chunk_eff = rows_local
+    k_pad = -(-int(k) // model_shards) * model_shards
+    k_local = k_pad // model_shards
+
+    rows_for_data = int(batch) if (family == "minibatch" and batch) \
+        else rows_local
+    comp: Dict[str, int] = {
+        "points_bytes": rows_local * d * item,
+        "weights_bytes": rows_local * item,
+    }
+    # Tables are f32/f64 model state at the fit dtype; the distance/
+    # responsibility tile accumulates in f32 regardless of a bf16 rung.
+    tile_rows = min(chunk_eff, rows_for_data)
+    if family == "gmm":
+        cov_elems = {"diag": k_local * d, "spherical": k_local,
+                     "tied": d * d, "full": k_local * d * d}
+        if cov_type not in cov_elems:
+            raise ValueError(f"unknown covariance type {cov_type!r}")
+        comp["table_bytes"] = (2 * k_local * d + k_local
+                               + cov_elems[cov_type]) * item
+        # E-step holds the (chunk, k) log-density AND responsibility
+        # tiles plus two (chunk, d) moment buffers (weighted points /
+        # squares feeding the scatter) — matches the XLA-observed
+        # per-program temp within ~10% on the CPU capture.
+        comp["tile_bytes"] = (2 * tile_rows * k_local
+                              + 2 * tile_rows * d) * 4
+        comp["stats_bytes"] = (2 * k_local * d + k_local
+                               + cov_elems[cov_type]) * 4
+    else:
+        # Distance tile + the one-hot/select tile the scatter matmul
+        # consumes — two (chunk, k) f32 buffers live at the peak.
+        comp["table_bytes"] = k_local * d * item
+        comp["tile_bytes"] = 2 * tile_rows * k_local * 4
+        comp["stats_bytes"] = (k_local * d + k_local) * 4
+    if pipeline:
+        comp["tile_bytes"] *= 2            # two chunk tiles in flight
+    if family == "minibatch" and batch:
+        comp["batch_bytes"] = int(batch) * d * item
+
+    resident = comp["points_bytes"] + comp["weights_bytes"] \
+        + comp["table_bytes"]
+    temp = comp["tile_bytes"] + comp["stats_bytes"] \
+        + comp.get("batch_bytes", 0)
+    plan = {
+        "family": family, "n": int(n), "d": int(d), "k": int(k),
+        "cov_type": cov_type if family == "gmm" else None,
+        "data_shards": data_shards, "model_shards": model_shards,
+        "dtype": str(getattr(dtype, "name", dtype)),
+        "chunk": chunk_eff, "pipeline": int(bool(pipeline)),
+        "components": comp,
+        "predicted_resident_bytes": resident,
+        "predicted_temp_bytes": temp,
+        "predicted_peak_bytes": resident + temp,
+        "observed_peak_bytes": None,
+    }
+    observed = _observed_peak(family, records)
+    if observed is not None:
+        plan["observed_peak_bytes"] = observed
+    return plan
+
+
+#: family -> the compile-cache whose step program carries that family's
+#: footprint (the join key between a plan and captured CostRecords).
+_FAMILY_CACHES = {
+    "kmeans": "kmeans._STEP_CACHE",
+    "spherical": "kmeans._STEP_CACHE",
+    "bisecting": "kmeans._STEP_CACHE",
+    "minibatch": "kmeans._STEP_CACHE",
+    "gmm": "gmm._STEP_CACHE",
+}
+
+
+def _observed_peak(family: str, records) -> Optional[int]:
+    """Largest available per-program peak among records from the
+    family's step cache (the step program dominates)."""
+    if not records:
+        return None
+    cache = _FAMILY_CACHES.get(family)
+    peaks = [r.peak_bytes for r in records
+             if r.available and r.peak_bytes is not None
+             and (cache is None or r.cache == cache)]
+    return max(peaks) if peaks else None
+
+
+def device_memory_info() -> dict:
+    """Best-effort allocator stats of the first local device:
+    ``{"available": bool, "bytes_limit", "bytes_in_use",
+    "bytes_free"}``.  CPU (and any backend without ``memory_stats``)
+    reports ``available=False`` — the planner then prints the plan
+    without a headroom verdict instead of failing."""
+    try:
+        import jax
+        dev = jax.local_devices()[0]
+        stats = dev.memory_stats()
+        if not stats or "bytes_limit" not in stats:
+            return {"available": False, "bytes_limit": None,
+                    "bytes_in_use": None, "bytes_free": None}
+        limit = int(stats["bytes_limit"])
+        in_use = int(stats.get("bytes_in_use", 0))
+        return {"available": True, "bytes_limit": limit,
+                "bytes_in_use": in_use, "bytes_free": limit - in_use}
+    except Exception as e:  # noqa: BLE001 — observability only
+        return {"available": False, "bytes_limit": None,
+                "bytes_in_use": None, "bytes_free": None,
+                "error": f"{type(e).__name__}: {e}"}
+
+
+def advise_dispatch(model, chunk: int, segment: int = 0) -> Optional[dict]:
+    """Advisory pre-dispatch memory check for ``_dispatch_oom_safe``:
+    with a tracer active, predict the (chunk, k) tile footprint from
+    the model's host-side attrs, compare against the device's free
+    bytes, emit a ``mem.plan`` event and set the
+    ``fit.mem_planned_chunk`` gauge.  Returns the advisory dict, or
+    None when tracing is off (the default true-no-op path — one check).
+    Advisory ONLY: never raises, never changes the chunk, and a model
+    the attrs cannot describe simply yields fewer fields."""
+    if not _trace.active():
+        return None
+    try:
+        k = getattr(model, "k", None) or getattr(model, "n_components",
+                                                 None)
+        cents = getattr(model, "centroids", None)
+        if cents is None:
+            cents = getattr(model, "means_", None)
+        d = int(cents.shape[1]) if cents is not None \
+            and getattr(cents, "ndim", 0) == 2 else None
+        tile = int(chunk) * int(k) * 4 if k else None
+        table = int(k) * d * 4 if (k and d) else None
+        free = device_memory_info()
+        advisory = {
+            "segment": int(segment), "chunk": int(chunk),
+            "k": int(k) if k else None, "d": d,
+            "predicted_tile_bytes": tile,
+            "predicted_table_bytes": table,
+            "device_bytes_free": free.get("bytes_free"),
+            "fits": (bool(tile <= free["bytes_free"])
+                     if tile is not None and free.get("bytes_free")
+                     is not None else None),
+        }
+        REGISTRY.gauge("fit.mem_planned_chunk").set(int(chunk))
+        _trace.event("mem.plan", **{k_: v for k_, v in advisory.items()
+                                    if v is not None})
+        return advisory
+    except Exception:  # noqa: BLE001 — advisory must never fail a fit
+        return None
+
+
+def _fmt_bytes(b: Optional[float]) -> str:
+    if b is None:
+        return "-"
+    b = float(b)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(b) < 1024.0 or unit == "TB":
+            return f"{b:.0f}{unit}" if unit == "B" else f"{b:.2f}{unit}"
+        b /= 1024.0
+    return f"{b:.2f}TB"
+
+
+def format_plan_table(plans: List[dict],
+                      title: str = "hbm footprint plan") -> str:
+    """Fixed-width rendering of :func:`plan_fit` rows (the
+    ``cost-report`` / ``dryrun_multichip`` artifact)."""
+    lines = [f"{title} (per device):",
+             f"  {'family':<10} {'shape':<22} {'chunk':>8} "
+             f"{'resident':>10} {'temp':>10} {'predicted':>10} "
+             f"{'observed':>10}"]
+    for p in plans:
+        shape = f"{p['n']}x{p['d']} k={p['k']}"
+        if p.get("cov_type"):
+            shape += f" {p['cov_type']}"
+        lines.append(
+            f"  {p['family']:<10} {shape:<22} {p['chunk']:>8} "
+            f"{_fmt_bytes(p['predicted_resident_bytes']):>10} "
+            f"{_fmt_bytes(p['predicted_temp_bytes']):>10} "
+            f"{_fmt_bytes(p['predicted_peak_bytes']):>10} "
+            f"{_fmt_bytes(p.get('observed_peak_bytes')):>10}")
+    free = device_memory_info()
+    if free.get("available"):
+        lines.append(f"  device free: {_fmt_bytes(free['bytes_free'])} "
+                     f"of {_fmt_bytes(free['bytes_limit'])}")
+    else:
+        lines.append("  device free: unreported on this backend")
+    return "\n".join(lines)

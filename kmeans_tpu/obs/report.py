@@ -33,7 +33,8 @@ from typing import Dict, List, Optional
 from kmeans_tpu.obs import trace as _trace
 
 __all__ = ["ttfi_ladder", "time_to_first_iteration",
-           "format_phase_table", "TTFI_PHASES"]
+           "format_phase_table", "TTFI_PHASES", "merge_cost",
+           "format_cost_table", "device_cost_report"]
 
 #: Lifecycle order of the pre-first-iteration phase rows.
 TTFI_PHASES = ("place", "stage", "trace", "compile", "seed")
@@ -86,8 +87,57 @@ def time_to_first_iteration(records: List[dict],
     from kmeans_tpu.utils import profiling
     share = profiling.PHASE_DECISION_SHARE if decision_share is None \
         else decision_share
-    return profiling.phase_ceiling_table(ttfi_ladder(records),
+    rows = profiling.phase_ceiling_table(ttfi_ladder(records),
                                          decision_share=share)
+    # Device-cost join (ISSUE 12): when the trace carries cost.record
+    # events (capture ran alongside tracing), each phase row gains the
+    # captured flops/bytes/arithmetic-intensity of the programs whose
+    # first call landed under that phase's spans; first_dispatch joins
+    # the ``dispatch`` phase (that is where step programs fire).
+    cost = merge_cost(records)
+    if cost:
+        for row in rows:
+            phase = "dispatch" if row["phase"] == "first_dispatch" \
+                else row["phase"]
+            c = cost.get(phase)
+            if c and c["programs"]:
+                row["flops"] = c["flops"]
+                row["bytes_accessed"] = c["bytes_accessed"]
+                row["ai"] = c["ai"]
+    return rows
+
+
+def merge_cost(records: List[dict]) -> Dict[str, dict]:
+    """Roll ``cost.record`` events (ISSUE 12: one per captured program,
+    emitted by the cost collector when tracing is active) up by the
+    span phase their first call ran under: ``{phase: {programs, flops,
+    bytes_accessed, peak_bytes, ai, unavailable}}``.  Empty dict when
+    the trace holds no cost records — the ``--cost`` CLI columns then
+    stay blank."""
+    spans = {r["id"]: r for r in records if r.get("kind") == "span"}
+    out: Dict[str, dict] = {}
+    for r in records:
+        if r.get("kind") != "event" or r.get("name") != "cost.record":
+            continue
+        attrs = r.get("attrs", {}) or {}
+        parent = spans.get(r.get("parent"))
+        phase = parent["name"] if parent else "-"
+        agg = out.setdefault(phase, {
+            "programs": 0, "flops": 0.0, "bytes_accessed": 0.0,
+            "peak_bytes": 0, "unavailable": 0, "ai": None})
+        if attrs.get("available"):
+            agg["programs"] += 1
+            agg["flops"] += float(attrs.get("flops") or 0.0)
+            agg["bytes_accessed"] += float(attrs.get("bytes_accessed")
+                                           or 0.0)
+            agg["peak_bytes"] = max(agg["peak_bytes"],
+                                    int(attrs.get("peak_bytes") or 0))
+        else:
+            agg["unavailable"] += 1
+    for agg in out.values():
+        if agg["bytes_accessed"]:
+            agg["ai"] = agg["flops"] / agg["bytes_accessed"]
+    return out
 
 
 def format_phase_table(rows: List[dict], title: str =
@@ -106,3 +156,163 @@ def format_phase_table(rows: List[dict], title: str =
     total_ms = sum(r["ms"] for r in rows)
     lines.append(f"  {'TOTAL':<16} {total_ms:>10.2f}")
     return "\n".join(lines)
+
+
+# ------------------------------------------------------ device cost
+
+def _fmt_num(v, unit: str = "") -> str:
+    if v is None:
+        return "-"
+    v = float(v)
+    for scale, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"),
+                          (1e3, "k")):
+        if abs(v) >= scale:
+            return f"{v / scale:.2f}{suffix}{unit}"
+    return f"{v:.2f}{unit}"
+
+
+def format_cost_table(rows: List[dict],
+                      title: str = "device cost") -> str:
+    """Fixed-width rendering of :func:`device_cost_report` rows (the
+    ``cost-report`` CLI / ``dryrun_multichip`` artifact)."""
+    lines = [f"{title}:",
+             f"  {'family':<10} {'program':<26} {'flops':>9} "
+             f"{'analytic':>9} {'ratio':>6} {'agree':>5} {'ai':>7} "
+             f"{'peak':>9} {'planned':>9}"]
+    for r in rows:
+        ratio = r.get("ratio")
+        ratio_s = f"{ratio:.3f}" if ratio is not None else "-"
+        agree_s = "-" if ratio is None else \
+            ("yes" if r.get("agree") else "NO")
+        ai = r.get("ai")
+        ai_s = f"{ai:.2f}" if ai is not None else "-"
+        lines.append(
+            f"  {r['family']:<10} {r['program'][:26]:<26} "
+            f"{_fmt_num(r.get('flops')):>9} "
+            f"{_fmt_num(r.get('analytic_flops')):>9} "
+            f"{ratio_s:>6} {agree_s:>5} {ai_s:>7} "
+            f"{_fmt_num(r.get('peak_bytes'), 'B'):>9} "
+            f"{_fmt_num(r.get('planned_peak_bytes'), 'B'):>9}")
+    return "\n".join(lines)
+
+
+#: The small shapes the report fits each family at on the CPU proxy —
+#: single-chunk (whole shard), D large enough that the elementwise
+#: share XLA counts (and the hand formulas exclude) sits inside the
+#: committed 10% band for the kmeans/gmm-diag cross-check.
+REPORT_SPECS = {
+    "kmeans": dict(n=8192, d=128, k=64),
+    "spherical": dict(n=8192, d=64, k=32),
+    "bisecting": dict(n=4096, d=64, k=4),
+    "minibatch": dict(n=8192, d=64, k=32, batch=2048),
+    "gmm": dict(n=8192, d=64, k=32),
+}
+
+
+def device_cost_report(families=None, *, specs=None,
+                       chunk: Optional[int] = None) -> dict:
+    """Run each family's small fit under cost capture and report the
+    captured step-program analyses against the analytic roofline and
+    the HBM footprint plan — the ``python -m kmeans_tpu cost-report``
+    payload.  Returns ``{"rows": [...], "plans": [...],
+    "device_memory": {...}, "backend": ...}``.
+
+    Each family fits at its ``REPORT_SPECS`` shape (override per family
+    via ``specs``) with the library's own chunk rule made EXPLICIT
+    (``choose_chunk_size``; override via ``chunk``): the step-cache key
+    is fresh in a warm process, the small shapes run single-chunk so
+    XLA's loop-body-once counting lines up with the per-iteration hand
+    formulas, and large (hardware) shapes scan at the committed chunk —
+    the analytic side then counts one chunk too
+    (``analytic_step_flops``).  A backend that cannot report yields
+    ``available=False`` rows — the report never fails with the fit
+    working."""
+    import numpy as np
+
+    import jax
+
+    from kmeans_tpu.obs import cost as cost_mod
+    from kmeans_tpu.obs import memory as memory_mod
+    from kmeans_tpu.parallel.mesh import make_mesh, mesh_shape
+    from kmeans_tpu.parallel.sharding import choose_chunk_size
+
+    families = list(families or REPORT_SPECS)
+    merged = dict(REPORT_SPECS)
+    if specs:
+        for fam, s in specs.items():
+            merged[fam] = dict(merged.get(fam, {}), **s)
+    backend = jax.default_backend()
+    data_shards, model_shards = mesh_shape(make_mesh())
+    rows: List[dict] = []
+    plans: List[dict] = []
+    rng = np.random.default_rng(42)
+    for family in families:
+        spec = merged[family]
+        n, d, k = spec["n"], spec["d"], spec["k"]
+        X = (rng.standard_normal((n, d))
+             + 3.0 * rng.integers(0, 3, size=(n, 1))).astype(np.float32)
+        eff_chunk = int(chunk) if chunk \
+            else choose_chunk_size(-(-n // data_shards), k, d)
+        with cost_mod.collecting() as col:
+            _report_fit(family, X, k, eff_chunk, spec)
+        recs = col.records()
+        step = max((r for r in recs if r.available and r.flops),
+                   key=lambda r: r.flops, default=None)
+        analytic = cost_mod.analytic_step_flops(
+            family, n=spec.get("batch", n) if family == "minibatch"
+            else n, d=d, k=k, chunk=eff_chunk, n_devices=data_shards)
+        plan = memory_mod.plan_fit(
+            family, n, d, k, chunk=eff_chunk, data_shards=data_shards,
+            model_shards=model_shards, batch=spec.get("batch"),
+            records=recs)
+        plans.append(plan)
+        row = {"family": family, "backend": backend,
+               "n": n, "d": d, "k": k, "chunk": eff_chunk,
+               "captured": len(recs),
+               "available": bool(step is not None),
+               "program": step.cache if step else "-",
+               "planned_peak_bytes": plan["predicted_peak_bytes"]}
+        if step is not None:
+            row.update(step.to_dict())
+            row.update(cost_mod.crosscheck(analytic, step))
+        else:
+            row.update({"analytic_flops": analytic, "ratio": None,
+                        "agree": False,
+                        "error": "; ".join(sorted(
+                            {r.error for r in recs if r.error}))
+                        or "no program captured"})
+        rows.append(row)
+    return {"rows": rows, "plans": plans,
+            "device_memory": memory_mod.device_memory_info(),
+            "backend": backend}
+
+
+def _report_fit(family: str, X, k: int, chunk: int, spec: dict) -> None:
+    """One small fit driving the family's real step-cache capture path
+    (host_loop=False: the one-dispatch device program IS the step
+    program the headline rows measure)."""
+    from kmeans_tpu.models import (BisectingKMeans, GaussianMixture,
+                                   KMeans, MiniBatchKMeans,
+                                   SphericalKMeans)
+    common = dict(max_iter=3, seed=0, verbose=False)
+    if family == "gmm":
+        GaussianMixture(n_components=k, covariance_type="diag", tol=0.0,
+                        init_params="random", host_loop=False,
+                        chunk_size=chunk, **common).fit(X)
+    elif family == "minibatch":
+        MiniBatchKMeans(k=k, batch_size=spec.get("batch", 2048),
+                        tolerance=1e-30, host_loop=False,
+                        compute_labels=False, chunk_size=chunk,
+                        **common).fit(X)
+    elif family == "bisecting":
+        BisectingKMeans(k=k, tolerance=1e-30, host_loop=False,
+                        compute_labels=False, chunk_size=chunk,
+                        **common).fit(X)
+    elif family == "spherical":
+        SphericalKMeans(k=k, tolerance=1e-30, host_loop=False,
+                        empty_cluster="keep", compute_labels=False,
+                        chunk_size=chunk, **common).fit(X)
+    else:
+        KMeans(k=k, tolerance=1e-30, host_loop=False,
+               empty_cluster="keep", compute_labels=False,
+               chunk_size=chunk, **common).fit(X)

@@ -90,6 +90,25 @@ _BF16_MODES = {"matmul": "matmul_bf16", "pallas": "pallas_bf16",
 from kmeans_tpu.ops.assign import BF16_GUARD_RTOL as BF16_TIE_RTOL
 
 
+#: Fitted-table attributes summed into a resident model's footprint
+#: (whatever the family exposes; missing attrs contribute nothing).
+_TABLE_ATTRS = ("centroids", "means_", "covariances_", "weights_",
+                "precisions_cholesky_")
+
+
+def _model_table_bytes(model) -> int:
+    """Host-side bytes of a fitted model's parameter tables — the
+    per-device residency cost of serving it (tables replicate across
+    the data axis; a TP-sharded table costs 1/model_shards of this)."""
+    total = 0
+    for attr in _TABLE_ATTRS:
+        arr = getattr(model, attr, None)
+        nbytes = getattr(arr, "nbytes", None)
+        if nbytes is not None:
+            total += int(nbytes)
+    return total
+
+
 class ResidentModel:
     """One resident model: the fitted estimator + its serving spec +
     per-model counters.  Device tables live on the MODEL's own caches
@@ -107,6 +126,12 @@ class ResidentModel:
         # Rows the bf16 near-tie guard re-labeled at f32 (audit trail
         # of the exactness guarantee; 0 on separated traffic).
         self.bf16_corrected_rows = 0
+        # Resident table footprint (ISSUE 12): the bytes this model's
+        # parameter tables hold on EACH device it is placed on (tables
+        # are replicated across the data axis) — summed host-side from
+        # the fitted arrays, so stats() answers "what does residency
+        # cost" without touching the device.
+        self.table_bytes = _model_table_bytes(model)
 
     def preprocess(self, rows: np.ndarray) -> np.ndarray:
         """Per-request input canonicalization: exactly what the model's
@@ -709,17 +734,47 @@ class ServingEngine:
                       "quantize": rm.quantize,
                       "requests": rm.requests, "rows": rm.rows,
                       "dispatches": rm.dispatches,
+                      "table_bytes": rm.table_bytes,
                       "bf16_corrected_rows": rm.bf16_corrected_rows}
                 for mid, rm in sorted(self._residents.items())}
             return {
                 "models_resident": len(models),
                 "models": models,
+                "resident_table_bytes": sum(
+                    m["table_bytes"] for m in models.values()),
+                "program_memory": self._program_memory(),
                 "dispatches": self.dispatches,
                 "packed_dispatches": self.packed_dispatches,
                 "queue": self.queue.stats(),
                 "batch_fill": fill,
                 "buckets": list(self.buckets),
             }
+
+    #: Step caches serving dispatches compile through — the K-Means
+    #: family's assignment/transform programs AND the mixture family's
+    #: posterior/score programs (``_dispatch_gmm`` -> ``model
+    #: ._posterior`` -> ``gmm._STEP_CACHE``).
+    _SERVING_CACHES = ("kmeans._STEP_CACHE", "gmm._STEP_CACHE")
+
+    @classmethod
+    def _program_memory(cls) -> List[dict]:
+        """Per-program compiled memory of the serving step caches
+        (ISSUE 12 serving residency report): one compact row per
+        :class:`~kmeans_tpu.obs.cost.CostRecord` captured from a
+        ``_SERVING_CACHES`` cache while a cost collector is active —
+        run ``warmup()`` (the per-bucket compiles) inside
+        ``obs.cost.collecting()`` to populate it.  Empty when capture
+        is off: residency bytes above stay available either way."""
+        from kmeans_tpu.obs import cost as obs_cost
+        col = obs_cost.get_collector()
+        if col is None:
+            return []
+        return [{"cache": r.cache, "key": r.key, "role": r.role,
+                 "peak_bytes": r.peak_bytes, "arg_bytes": r.arg_bytes,
+                 "temp_bytes": r.temp_bytes, "code_bytes": r.code_bytes,
+                 "available": r.available}
+                for r in col.records()
+                if r.cache in cls._SERVING_CACHES]
 
     # -------------------------------------------------------- lifecycle
 

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from collections import OrderedDict
 
+from kmeans_tpu.obs import cost as _obs_cost
 from kmeans_tpu.obs import trace as _obs_trace
 
 
@@ -59,6 +60,15 @@ class LRUCache:
                     value = factory()
             else:
                 value = factory()
+            if self.compile_spans:
+                # Device-cost capture (ISSUE 12): with a cost collector
+                # active, the freshly built program(s) are wrapped for
+                # one-shot AOT analysis on their first call; with none
+                # installed this is a single None check returning the
+                # value untouched.  Measurement caches
+                # (compile_spans=False) opt out alongside the span.
+                value = _obs_cost.instrument(self.name or "cache", key,
+                                             value)
             self[key] = value
             return value
         try:

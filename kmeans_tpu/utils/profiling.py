@@ -175,7 +175,7 @@ PHASE_DECISION_SHARE = 0.15
 
 
 def phase_ceiling_table(ladder, *, flops_per_iter=None,
-                        peak_tflops=None,
+                        peak_tflops=None, cost_record=None,
                         decision_share: float = PHASE_DECISION_SHARE):
     """Turn a ``measure_phase_ladder`` result into the publishable
     MEASURED-CEILING table (ISSUE 8c): one row per phase with
@@ -195,10 +195,24 @@ def phase_ceiling_table(ladder, *, flops_per_iter=None,
     The full pass is the LAST rung's cumulative median (the complete
     statistics body); rows carry the ladder's ``spread`` through so a
     noisy phase can never silently pass the decision rule unflagged.
+
+    Roofline join (ISSUE 12): with ``cost_record`` (a captured
+    :class:`~kmeans_tpu.obs.cost.CostRecord` of the measured program)
+    each row additionally carries ``analytic_flops`` (the hand
+    formula, when ``flops_per_iter`` is given), ``ai`` (XLA
+    flops/bytes-accessed), and ``mfu_analytic`` (analytic flops over
+    the full measured pass vs the pinned peak; None off-accelerator) —
+    so every BASELINE row that embeds this table is roofline-attributed
+    without a second measurement.
     """
     import numpy as np  # noqa: F811 — mirror measure_phase_ladder
 
     full = float(ladder[-1]["cumulative"])
+    roofline = None
+    if cost_record is not None and flops_per_iter:
+        from kmeans_tpu.obs.cost import roofline_fields
+        roofline = roofline_fields(flops_per_iter, full, cost_record,
+                                   peak_tflops)
     rows = []
     for r in ladder:
         sec = float(r["seconds"])
@@ -208,7 +222,7 @@ def phase_ceiling_table(ladder, *, flops_per_iter=None,
         mfu = None
         if flops_per_iter and peak_tflops and full > 0:
             mfu = (flops_per_iter / remaining) / (peak_tflops * 1e12)
-        rows.append({
+        row = {
             "phase": r["phase"],
             "ms": sec * 1e3,
             "share": share,
@@ -216,7 +230,10 @@ def phase_ceiling_table(ladder, *, flops_per_iter=None,
             "implied_ceiling_speedup": speedup,
             "implied_ceiling_mfu": mfu,
             "actionable": bool(share >= decision_share),
-        })
+        }
+        if roofline is not None:
+            row.update(roofline)
+        rows.append(row)
     return rows
 
 

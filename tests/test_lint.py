@@ -54,7 +54,7 @@ def test_registry_has_the_required_rules():
     """The six incident-class rules (plus the suppression-format
     meta-rule) are registered — the >= 6 acceptance bar."""
     assert {"trace-hazard", "cache-key", "dispatch", "thread",
-            "counter-reset", "dead-private"} <= set(RULES)
+            "counter-reset", "dead-private", "cache-name"} <= set(RULES)
     assert len(RULES) >= 6
     for rule in RULES.values():
         assert rule.id and rule.incident, rule
@@ -425,6 +425,67 @@ def test_obs_span_suppression_honored(tmp_path):
         "at the caller\n    return fn(pts)")
     findings = run_on(tmp_path, src, subdir="serving")
     assert [f for f in findings if f.rule == "obs-span"] == []
+
+
+# ---------------------------------------------------------------------------
+# cache-name (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+_CACHE_NAME_BAD = """
+from kmeans_tpu.utils.cache import LRUCache
+
+_STEP_CACHE = LRUCache(64)
+"""
+
+_CACHE_NAME_OK = """
+from kmeans_tpu.utils.cache import LRUCache
+
+_STEP_CACHE = LRUCache(64, name="mod._STEP_CACHE")
+"""
+
+
+def test_cache_name_fires_on_unnamed_module_cache(tmp_path):
+    findings = run_on(tmp_path, _CACHE_NAME_BAD, subdir="models")
+    fired = [f for f in findings if f.rule == "cache-name"]
+    assert len(fired) == 1
+    assert "name=" in fired[0].message
+    assert "cost capture" in fired[0].message
+
+
+def test_cache_name_silent_when_named(tmp_path):
+    findings = run_on(tmp_path, _CACHE_NAME_OK, subdir="models")
+    assert [f for f in findings if f.rule == "cache-name"] == []
+
+
+def test_cache_name_exempts_function_local_caches(tmp_path):
+    src = """
+from kmeans_tpu.utils.cache import LRUCache
+
+
+def make_scratch():
+    local = LRUCache(4)          # test-fixture/ad-hoc scope: exempt
+    return local
+"""
+    findings = run_on(tmp_path, src, subdir="models")
+    assert [f for f in findings if f.rule == "cache-name"] == []
+
+
+def test_cache_name_fires_anywhere_in_package(tmp_path):
+    """Unlike the serving/parallel-scoped rules, an unnamed cache is a
+    finding in ANY module — every module-level cache is a compile-span
+    and cost-capture surface."""
+    findings = run_on(tmp_path, _CACHE_NAME_BAD, subdir="utils")
+    assert [f.rule for f in findings if f.rule == "cache-name"] \
+        == ["cache-name"]
+
+
+def test_cache_name_suppression_honored(tmp_path):
+    src = _CACHE_NAME_BAD.replace(
+        "_STEP_CACHE = LRUCache(64)",
+        "_STEP_CACHE = LRUCache(64)  # lint: ok(cache-name) — "
+        "measurement cache, opted out of telemetry")
+    findings = run_on(tmp_path, src, subdir="models")
+    assert [f for f in findings if f.rule == "cache-name"] == []
 
 
 # ---------------------------------------------------------------------------
