@@ -42,9 +42,12 @@ def main() -> int:
     if cmd == "cost-report":
         from kmeans_tpu.cli import cost_report_main
         return cost_report_main(rest)
+    if cmd == "fleet-status":
+        from kmeans_tpu.cli import fleet_status_main
+        return fleet_status_main(rest)
     print(f"unknown command {cmd!r}; available: suite, bench, fit, "
-          f"sweep, ckpt-info, serve, report, lint, trace, cost-report",
-          file=sys.stderr)
+          f"sweep, ckpt-info, serve, report, lint, trace, cost-report, "
+          f"fleet-status", file=sys.stderr)
     return 2
 
 

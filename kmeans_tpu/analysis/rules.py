@@ -401,23 +401,30 @@ class DispatchAccountingRule(Rule):
                     sites.append(node)
         return sites
 
-    def _check_function(self, mod: Module, fn) -> Iterator[Finding]:
-        call_sites = self._compiled_call_sites(fn)
-        accounted = False
+    @staticmethod
+    def _has_accounting(fn) -> bool:
+        """Does ``fn`` tag a dispatch anywhere — ``note_dispatch(...)``,
+        ``._record(...)``, or a ``dispatch``-named counter update?  The
+        ONE accounting predicate shared by the dispatch, obs-span and
+        collective-span rules."""
         for node in ast.walk(fn):
             if isinstance(node, ast.Call):
                 path = dotted(node.func) or ""
                 if path.split(".")[-1] in ("note_dispatch", "_record"):
-                    accounted = True
+                    return True
             if isinstance(node, (ast.AugAssign, ast.Assign)):
                 target = node.target if isinstance(node, ast.AugAssign) \
                     else (node.targets[0] if node.targets else None)
                 if target is not None and "dispatch" in (
                         dotted(target) or "").lower():
-                    accounted = True
+                    return True
+        return False
+
+    def _check_function(self, mod: Module, fn) -> Iterator[Finding]:
+        call_sites = self._compiled_call_sites(fn)
         # Functions that only BUILD and return the compiled fn (no
         # invocation) are accounted at their call sites instead.
-        if call_sites and not accounted:
+        if call_sites and not self._has_accounting(fn):
             yield self.finding(
                 mod, call_sites[0].lineno,
                 f"{fn.name}() invokes a compiled function but never "
@@ -486,6 +493,61 @@ class ObsSpanRule(DispatchAccountingRule):
                     if leaf in ("span", "tracing"):
                         return True
         return False
+
+
+# ----------------------------------------------------- collective-span
+
+class CollectiveSpanRule(ObsSpanRule):
+    """ISSUE 13 extension of the r15 ``obs-span`` detection: in
+    ``parallel/``, a driver-level function that performs a HOST-SIDE
+    cross-process collective (``process_allgather`` /
+    ``sync_global_devices`` / ``broadcast_one_to_all`` — the calls that
+    block every process in the fleet, invisible to the compiled-fn
+    rules) must run it under a telemetry span or carry a dispatch tag.
+    Without this, fleet-blocking waits silently fall off the merged
+    timeline — the one place an operator could have attributed a
+    stalled fleet to the host that never arrived."""
+
+    id = "collective-span"
+    incident = ("ISSUE 13: a host-side collective invisible to the "
+                "fleet timeline — the cross-process twin of the "
+                "obs-span class")
+
+    _COLLECTIVES = {"process_allgather", "sync_global_devices",
+                    "broadcast_one_to_all"}
+
+    def run(self, pkg: Package) -> Iterator[Finding]:
+        for mod in pkg:
+            p = mod.rel.replace("\\", "/")
+            if "/parallel/" not in p:
+                continue
+            parents = mod.parents()
+            for fn in ast.walk(mod.tree):
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                # Driver-level only — nested closures are checked
+                # through the enclosing driver's subtree walk (the
+                # obs-span convention).
+                if not isinstance(parents.get(fn),
+                                  (ast.Module, ast.ClassDef)):
+                    continue
+                sites = [node for node in ast.walk(fn)
+                         if isinstance(node, ast.Call)
+                         and (dotted(node.func) or "").split(".")[-1]
+                         in self._COLLECTIVES]
+                if not sites:
+                    continue
+                if self._has_span(fn) or self._has_accounting(fn):
+                    continue
+                yield self.finding(
+                    mod, sites[0].lineno,
+                    f"{fn.name}() runs a host-side cross-process "
+                    f"collective with no enclosing telemetry span or "
+                    f"dispatch tag — wrap it in `with "
+                    f"obs_trace.span('collective', ...)` (a no-op when "
+                    f"tracing is off) so the fleet-blocking wait lands "
+                    f"on the merged timeline")
 
 
 # ------------------------------------------------------------ threads
@@ -770,6 +832,7 @@ class SuppressionFormatRule(Rule):
 
 RULES: Dict[str, Rule] = {rule.id: rule for rule in (
     TraceHazardRule(), CacheKeyRule(), DispatchAccountingRule(),
-    ObsSpanRule(), ThreadHygieneRule(), CounterResetRule(),
-    DeadPrivateRule(), CacheNameRule(), SuppressionFormatRule(),
+    ObsSpanRule(), CollectiveSpanRule(), ThreadHygieneRule(),
+    CounterResetRule(), DeadPrivateRule(), CacheNameRule(),
+    SuppressionFormatRule(),
 )}

@@ -366,26 +366,40 @@ def serve_main(argv=None) -> int:
 
 
 def trace_main(argv=None) -> int:
-    """``python -m kmeans_tpu trace summarize <file.jsonl>`` — analyze
-    a telemetry trace written by ``obs.tracing(path=...)`` (ISSUE 11).
+    """``python -m kmeans_tpu trace summarize <file.jsonl> [...]`` —
+    analyze telemetry traces written by ``obs.tracing(path=...)``
+    (ISSUE 11; fleet merge ISSUE 13).
 
-    Prints the per-phase rollup (count / total / p50 / p99 over SELF
+    One file: per-phase rollup (count / total / p50 / p99 over SELF
     time — nested child time is excluded, so totals never double-count)
     and, when the trace holds a ``dispatch`` span, the
     time-to-first-iteration table (the same ``phase_ceiling_table``
     schema as the r13 per-iteration ceiling table, with the committed
-    >= 15% "actionable" rule).  ``--json`` emits both machine-readable;
-    ``--chrome out.json`` additionally converts the trace to Chrome
-    ``trace_event`` format for chrome://tracing / Perfetto.  Exit 2 on
-    an unreadable or malformed trace file."""
+    >= 15% "actionable" rule).
+
+    Several files (or a directory / glob — the per-host
+    ``trace.p{idx}.jsonl`` family ``obs.tracing`` writes under
+    ``process_count > 1``): the streams are clock-aligned and MERGED
+    first (``obs.fleet.merge_traces`` — barrier-anchored when synced
+    fleet barriers exist, wall-anchored otherwise), the host roster +
+    measured skew bound print above the rollup, and the TTFI table is
+    per-reference-host territory so it is omitted.  ``--json`` emits
+    everything machine-readable; ``--chrome out.json`` converts to
+    Chrome ``trace_event`` (merged: one track group per host).  Exit 2
+    on unreadable, malformed, or clock-unalignable inputs
+    (``TraceReadError`` classification, the single-file contract
+    extended)."""
     parser = argparse.ArgumentParser(
         prog="python -m kmeans_tpu trace",
-        description="Summarize a kmeans_tpu telemetry trace (JSONL "
-                    "from obs.tracing): per-phase totals/percentiles + "
-                    "the time-to-first-iteration table")
+        description="Summarize kmeans_tpu telemetry traces (JSONL from "
+                    "obs.tracing): per-phase totals/percentiles + the "
+                    "time-to-first-iteration table; several files / a "
+                    "directory merge into one fleet timeline")
     parser.add_argument("action", choices=("summarize",),
                         help="analysis to run (summarize)")
-    parser.add_argument("file", help="trace JSONL path")
+    parser.add_argument("file", nargs="+",
+                        help="trace JSONL path(s), a directory, or a "
+                             "glob (per-host trace.p{idx}.jsonl files)")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable output on stdout")
     parser.add_argument("--chrome", metavar="OUT.JSON", default=None,
@@ -401,20 +415,43 @@ def trace_main(argv=None) -> int:
                              "many)")
     args = parser.parse_args(argv)
 
+    from kmeans_tpu.obs import fleet as obs_fleet
     from kmeans_tpu.obs import trace as obs_trace
     from kmeans_tpu.obs.report import (format_phase_table, merge_cost,
                                        time_to_first_iteration)
+    merged = None
     try:
-        records = obs_trace.read_jsonl(args.file)
+        paths = obs_fleet.expand_fleet_paths(args.file)
+        if len(paths) > 1:
+            # Directory/glob/multi-file inputs naturally co-locate
+            # heartbeat sinks next to the trace sinks — skip the
+            # heartbeat streams instead of failing the merge on them
+            # (one explicitly-named file stays strict: reading it as a
+            # trace is what the user asked for).
+            trace_paths = [p for p in paths
+                           if obs_fleet.sniff_stream(p)
+                           != "heartbeat"]
+            if not trace_paths:
+                raise obs_trace.TraceReadError(
+                    f"no trace streams among {paths} (heartbeat files "
+                    f"are read by 'fleet-status')")
+            paths = trace_paths
+        if len(paths) == 1:
+            records = obs_trace.read_jsonl(paths[0])
+        else:
+            merged = obs_fleet.merge_traces(paths)
+            records = merged["records"]
     except obs_trace.TraceReadError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
 
     summary = obs_trace.summarize(records)
-    try:
-        ttfi = time_to_first_iteration(records)
-    except ValueError:
-        ttfi = None                  # no dispatch span — summary only
+    ttfi = None
+    if merged is None:
+        try:
+            ttfi = time_to_first_iteration(records)
+        except ValueError:
+            ttfi = None              # no dispatch span — summary only
     cost = merge_cost(records) if args.cost else None
 
     if args.chrome:
@@ -424,9 +461,13 @@ def trace_main(argv=None) -> int:
 
     if args.json:
         from kmeans_tpu.utils.profiling import sanitize_json
-        out = {"file": args.file, "phases": summary,
+        out = {"files": paths, "phases": summary,
                "time_to_first_iteration": ttfi,
                "chrome": args.chrome}
+        if merged is not None:
+            out["fleet"] = {k: merged[k] for k in
+                            ("hosts", "align", "barriers",
+                             "skew_bound_s", "ntp_delta_s")}
         if args.cost:
             out["cost"] = cost
         print(json.dumps(sanitize_json(out), indent=2))
@@ -434,7 +475,11 @@ def trace_main(argv=None) -> int:
 
     n_spans = sum(1 for r in records if r.get("kind") == "span")
     n_events = sum(1 for r in records if r.get("kind") == "event")
-    print(f"trace: {args.file} — {n_spans} spans, {n_events} events")
+    if merged is not None:
+        print(obs_fleet.format_fleet_summary(merged))
+        print()
+    print(f"trace: {', '.join(paths)} — {n_spans} spans, "
+          f"{n_events} events")
     header = (f"  {'phase':<20} {'count':>6} {'total ms':>10} "
               f"{'p50 ms':>9} {'p99 ms':>9} {'events':>7}")
     if args.cost:
@@ -472,6 +517,76 @@ def trace_main(argv=None) -> int:
         print(f"\nchrome trace written to {args.chrome} "
               f"(load in chrome://tracing or ui.perfetto.dev)")
     return 0
+
+
+def fleet_status_main(argv=None) -> int:
+    """``python -m kmeans_tpu fleet-status <dir-or-files> [--json]`` —
+    per-host progress/liveness/lag from merged heartbeat streams
+    (ISSUE 13): the table ROADMAP item 1's elastic orchestration loop
+    consumes.
+
+    Inputs: heartbeat JSONL files (the per-process ``hb.p{idx}.jsonl``
+    family ``obs.heartbeat`` writes), a directory, or a glob; trace
+    files found alongside are ignored here (``trace summarize`` reads
+    those).  The report applies the committed straggler rules
+    (``obs.fleet``: rows/s below ``rate_factor`` x the fleet median ->
+    ``slow``; trailing the leader by >= ``behind_iters`` iterations ->
+    ``behind``; behind AND silent past the stall window ->
+    ``stalled``).  ``--now`` anchors liveness at the current wall
+    clock (live monitoring) instead of the newest record (post-hoc).
+
+    Exit 0: healthy fleet.  Exit 1: stragglers flagged (the
+    orchestrator's signal).  Exit 2: unreadable/malformed inputs or no
+    heartbeat records."""
+    parser = argparse.ArgumentParser(
+        prog="python -m kmeans_tpu fleet-status",
+        description="Per-host progress/liveness/lag table from merged "
+                    "fleet heartbeat files")
+    parser.add_argument("paths", nargs="+",
+                        help="heartbeat JSONL file(s), directory, or "
+                             "glob")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable report on stdout")
+    parser.add_argument("--now", action="store_true",
+                        help="anchor liveness at the current wall "
+                             "clock (live monitoring) instead of the "
+                             "newest record (post-hoc)")
+    parser.add_argument("--rate-factor", type=float, default=None,
+                        help="override the committed slow-host rows/s "
+                             "factor")
+    parser.add_argument("--behind-iters", type=int, default=None,
+                        help="override the committed behind-leader "
+                             "iteration threshold")
+    args = parser.parse_args(argv)
+
+    from kmeans_tpu.obs import fleet as obs_fleet
+    from kmeans_tpu.obs.trace import TraceReadError
+    try:
+        files = obs_fleet.expand_fleet_paths(args.paths)
+        hb_files = [p for p in files
+                    if obs_fleet.sniff_stream(p) != "trace"]
+        if not hb_files:
+            raise TraceReadError(
+                f"no heartbeat files among {files} (trace streams are "
+                f"summarized by 'trace summarize')")
+        records = obs_fleet.merge_heartbeats(hb_files)
+        kwargs = {}
+        if args.now:
+            kwargs["now"] = time.time()
+        if args.rate_factor is not None:
+            kwargs["rate_factor"] = args.rate_factor
+        if args.behind_iters is not None:
+            kwargs["behind_iters"] = args.behind_iters
+        report = obs_fleet.straggler_report(records, **kwargs)
+    except TraceReadError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        from kmeans_tpu.utils.profiling import sanitize_json
+        print(json.dumps(sanitize_json({"files": hb_files, **report})))
+    else:
+        print(obs_fleet.format_fleet_status(report))
+    return 0 if report["healthy"] else 1
 
 
 def cost_report_main(argv=None) -> int:

@@ -30,6 +30,7 @@ import jax
 import numpy as np
 
 from kmeans_tpu.models.kmeans import KMeans, _get_step_fns
+from kmeans_tpu.parallel.multihost import fleet_barrier
 from kmeans_tpu.obs.heartbeat import note_progress as obs_note_progress
 from kmeans_tpu.utils.logging import IterationLogger
 
@@ -107,6 +108,10 @@ class BisectingKMeans(KMeans):
         log = IterationLogger(verbose)
         X = self._apply_sample_weight(X, sample_weight)
         ds, mesh, model_shards, step_fn, predict_fn = self._prepare(X)
+        # Fleet prelude (ISSUE 13): rows for heartbeat rows_per_sec +
+        # the merged-timeline clock anchor (no-op when obs=0).
+        self._progress_rows = ds.local_rows if ds.local_rows else ds.n
+        fleet_barrier("fit-start")
 
         n = ds.n
         # Validate the data ONCE up front (same message as the reference's

@@ -78,6 +78,7 @@ from kmeans_tpu.parallel.mesh import MODEL_AXIS, make_mesh, mesh_shape
 from kmeans_tpu.parallel.sharding import (ShardedDataset, choose_chunk_size,
                                           to_device)
 from kmeans_tpu.models.fault_tolerance import AutoCheckpointMixin
+from kmeans_tpu.parallel.multihost import fleet_barrier
 from kmeans_tpu.obs import trace as obs_trace
 from kmeans_tpu.obs.heartbeat import note_progress as obs_note_progress
 from kmeans_tpu.utils.validation import check_finite_array
@@ -266,6 +267,8 @@ class GaussianMixture(AutoCheckpointMixin):
         self.io_retries_used_: int = 0
         self.blocks_skipped_: int = 0
         self.checkpoint_segments_: Optional[int] = None
+        # Heartbeat rows_per_sec input (ISSUE 13), mirroring KMeans'.
+        self._progress_rows: Optional[int] = None
         # Elastic recovery observability (ISSUE 5): OOM chunk-backoff
         # count / the device loop's effective chunk (None when no
         # device loop ran; equals the committed chunk on healthy fits —
@@ -777,6 +780,11 @@ class GaussianMixture(AutoCheckpointMixin):
         self.io_retries_used_ = getattr(
             getattr(ds, "io_stats", None), "retries_used", 0)
         mesh = self._resolve_mesh()
+        # Fleet prelude (ISSUE 13): rows for heartbeat rows_per_sec +
+        # the merged-timeline clock anchor (no-op when obs=0).
+        self._progress_rows = ds.local_rows if getattr(
+            ds, "local_rows", None) else ds.n
+        fleet_barrier("fit-start")
         chunk = self._eff_chunk(ds)
         pipeline = self._note_estep_path()
         step_fn, _ = _get_fns(mesh, chunk, self.covariance_type, pipeline)
@@ -960,6 +968,12 @@ class GaussianMixture(AutoCheckpointMixin):
             d = peek.shape[1]
             del peek, item
         mesh = self._resolve_mesh()
+        # Fleet prelude (ISSUE 13): clock anchor; streamed EM has no
+        # fixed per-iteration row count until an epoch has run, so
+        # rows_per_sec stays absent (documented) — the anchor is what
+        # the merged timeline needs.
+        self._progress_rows = None
+        fleet_barrier("fit-stream-start")
         ct = self.covariance_type
         k = self.n_components
         pipeline = self._note_estep_path()

@@ -50,6 +50,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from kmeans_tpu.ops.assign import StepStats
 from kmeans_tpu.parallel import distributed as dist
 from kmeans_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh, mesh_shape
+from kmeans_tpu.parallel.multihost import fleet_barrier
 from kmeans_tpu.parallel.sharding import (ShardedDataset, choose_chunk_size,
                                           to_device)
 from kmeans_tpu.models.init import resolve_init
@@ -378,6 +379,10 @@ class KMeans(AutoCheckpointMixin):
         self.restart_inertias_: Optional[np.ndarray] = None
         self._fit_ds = None                           # retained for labels_
         self._labels_cache: Optional[np.ndarray] = None
+        # Rows THIS host processes per iteration (heartbeat rows_per_sec,
+        # ISSUE 13); set by each fit prelude, cleared here so a reused
+        # estimator never reports a previous fit's row count.
+        self._progress_rows: Optional[int] = None
         validate_params(k, max_iter, tolerance)       # kmeans_spark.py:46
         self.iterations_run = 0                       # kmeans_spark.py:47
         # Internal: skip init-time full-array finite scans when the caller
@@ -828,6 +833,11 @@ class KMeans(AutoCheckpointMixin):
         X = self._apply_sample_weight(X, sample_weight)
         ds, mesh, model_shards, step_fn, _ = self._prepare(X)
         self._set_fit_data(ds)                        # feeds lazy labels_
+        # Fleet prelude (ISSUE 13): per-host row count for the heartbeat
+        # rows_per_sec derivation, and the fit-start clock anchor the
+        # merged-timeline alignment keys on (a true no-op when obs=0).
+        self._progress_rows = ds.local_rows if ds.local_rows else ds.n
+        fleet_barrier("fit-start")
         self.io_retries_used_ = getattr(
             getattr(ds, "io_stats", None), "retries_used", 0)
         log.startup(self.k, self.max_iter, self.tolerance, self.compute_sse)
@@ -1060,6 +1070,10 @@ class KMeans(AutoCheckpointMixin):
 
         mesh = self._resolve_mesh()
         _, model_shards = mesh_shape(mesh)
+        # Fleet prelude (ISSUE 13): the clock anchor; the per-epoch row
+        # count lands once the first epoch has measured the stream.
+        self._progress_rows = None
+        fleet_barrier("fit-stream-start")
 
         class _StreamMeta:
             """_handle_empty's dataset view of a stream: replacement rows
@@ -1191,6 +1205,7 @@ class KMeans(AutoCheckpointMixin):
                          for st_r in active]
             sums, counts, sse, far, n_seen = epoch(active, cents_dev,
                                                    iteration)
+            self._progress_rows = n_seen      # rows/iteration, measured
             if iteration == start_iter and n_seen < self.k:
                 raise ValueError(f"Not enough data points ({n_seen}) to "
                                  f"initialize {self.k} clusters")

@@ -53,6 +53,7 @@ from typing import Optional
 import numpy as np
 
 from kmeans_tpu.models.kmeans import KMeans, _STEP_CACHE
+from kmeans_tpu.parallel.multihost import fleet_barrier
 from kmeans_tpu.models.init import resolve_init
 from kmeans_tpu.obs import trace as obs_trace
 from kmeans_tpu.obs.heartbeat import note_progress as obs_note_progress
@@ -295,6 +296,10 @@ class MiniBatchKMeans(KMeans):
         # Rounded up: every shard contributes the same (>= 8-row sublane-
         # aligned) count, so the effective batch is bs_local * data_shards.
         bs_local = max(8, -(-bs // data_shards))
+        # Fleet prelude (ISSUE 13): minibatch rows/iteration = the
+        # effective global batch (sampled, not the dataset size).
+        self._progress_rows = bs_local * data_shards
+        fleet_barrier("fit-start")
         log = IterationLogger(self.verbose and jax.process_index() == 0)
 
         self._set_fit_data(ds)                 # feeds lazy labels_
@@ -546,6 +551,8 @@ class MiniBatchKMeans(KMeans):
             hw = _validate_sample_weight(sample_weight, n, self.dtype)
         bs = min(self.batch_size, n)
         total_w = float(hw.sum()) if hw is not None else float(n)
+        self._progress_rows = bs          # fleet prelude (ISSUE 13)
+        fleet_barrier("fit-start")
         self._set_fit_data(X)                         # feeds lazy labels_
         import jax
         log = IterationLogger(self.verbose and jax.process_index() == 0)

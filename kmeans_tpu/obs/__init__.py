@@ -17,6 +17,12 @@ Four parts, one discipline:
 * :mod:`kmeans_tpu.obs.cost` / :mod:`kmeans_tpu.obs.memory` — device-
   cost capture (XLA cost/memory analysis per compiled step-cache
   program, ISSUE 12) and the HBM footprint planner built on it.
+* :mod:`kmeans_tpu.obs.fleet` / :mod:`kmeans_tpu.obs.identity` — the
+  fleet layer (ISSUE 13): per-process telemetry identity and sink
+  paths, clock-aligned merged timelines over N hosts' streams,
+  analytic collective-comms accounting cross-checked against the
+  compiled HLO, and the straggler report behind
+  ``python -m kmeans_tpu fleet-status``.
 
 Telemetry is OFF by default and the disabled path is a true no-op
 (one None check); ``obs=0`` is the bit-exact parity oracle, pinned for
@@ -49,7 +55,7 @@ needs to reach through the shadowed attribute;
 tests/test_obs.py pins both routes.
 """
 
-from kmeans_tpu.obs import cost, memory
+from kmeans_tpu.obs import cost, fleet, identity, memory
 from kmeans_tpu.obs.trace import (SPAN_NAMES, TraceReadError, Tracer,
                                   chrome_events, event, get_tracer,
                                   read_jsonl, span, summarize, tracing)
@@ -68,7 +74,7 @@ __all__ = [
     "get_tracer", "read_jsonl", "span", "summarize", "tracing",
     "REGISTRY", "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "registry", "Heartbeat", "get_heartbeat", "heartbeat",
-    "note_progress", "cost", "memory",
+    "note_progress", "cost", "memory", "fleet", "identity",
     # lazy (pull utils.profiling, which imports jax):
     "ttfi_ladder", "time_to_first_iteration", "format_phase_table",
     "merge_cost", "format_cost_table",

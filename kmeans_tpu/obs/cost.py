@@ -57,6 +57,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import re
 import threading
 from dataclasses import asdict, dataclass
 from typing import Dict, List, Optional
@@ -67,7 +68,7 @@ from kmeans_tpu.obs.metrics_registry import REGISTRY
 __all__ = ["CostRecord", "CostCollector", "collecting", "get_collector",
            "instrument", "analyze_jitted", "normalize_compiled",
            "analytic_step_flops", "crosscheck", "roofline_fields",
-           "FLOPS_AGREEMENT_RTOL"]
+           "hlo_collective_bytes", "FLOPS_AGREEMENT_RTOL"]
 
 #: The committed analytic-vs-XLA FLOPs agreement band (pre-registered,
 #: the repo's decision-rule discipline): |reported/analytic - 1| <= 10%
@@ -103,6 +104,14 @@ class CostRecord:
     alias_bytes: Optional[int] = None
     code_bytes: Optional[int] = None
     peak_bytes: Optional[int] = None  # arg + out + temp - alias
+    # Collective-comms accounting (ISSUE 13): result-shape bytes and
+    # instruction count of the all-reduce/all-gather/reduce-scatter/
+    # all-to-all/collective-permute ops in the compiled (post-SPMD)
+    # module, one loop-body pass — the MEASURED side the fleet layer's
+    # analytic byte model (obs.fleet.comm_bytes_model) cross-checks
+    # against.  None when the backend exposes no HLO text.
+    collective_bytes: Optional[float] = None
+    collectives: Optional[int] = None
 
     def arithmetic_intensity(self) -> Optional[float]:
         """flops / bytes-accessed — the roofline x-axis; None when
@@ -219,6 +228,67 @@ def collecting(path=None, collector: Optional[CostCollector] = None):
 
 # -------------------------------------------------------- normalization
 
+#: HLO dtype -> element bytes, for the collective-shape parser.
+_HLO_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2,
+    "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+    "f64": 8, "c64": 8, "c128": 16,
+}
+
+_COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+                   "all-to-all", "collective-permute")
+
+#: One collective instruction: ``%name = <result shapes> <op>(...)``.
+#: The result segment may be a tuple — every dtype[shape] token in it
+#: is summed.  ``-start`` variants (async collectives) are counted at
+#: the start instruction only (the ``-done`` re-states the same shape).
+_COLLECTIVE_RE = re.compile(
+    r"= (?P<result>[^=]*?) (?P<op>" + "|".join(_COLLECTIVE_OPS)
+    + r")(?:-start)?\(")
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+def hlo_collective_bytes(hlo_text: str) -> dict:
+    """Sum the RESULT-shape bytes of every collective instruction in an
+    HLO module dump: ``{"bytes", "count", "by_op": {op: bytes}}``.
+
+    Conventions (matching XLA's own cost analysis, so these compose
+    with :class:`CostRecord`): per-device (the post-SPMD module is one
+    device's program), one loop-body pass (a collective inside a
+    ``scan``/``while`` body appears — and is counted — once), and
+    RESULT bytes (an all-reduce's result equals its payload; an
+    all-gather's result is ``shards x local``, the bytes the device
+    actually materializes).  Wire traffic per device on a ring is
+    ``2 (S-1)/S`` of the all-reduce payload — a topology statement the
+    fleet layer derives separately; this function reports what the
+    compiled program SAYS it moves."""
+    total = 0.0
+    count = 0
+    by_op: Dict[str, float] = {}
+    for m in _COLLECTIVE_RE.finditer(hlo_text):
+        tokens = _SHAPE_RE.findall(m.group("result"))
+        if m.group(0).endswith("-start("):
+            # Async form: the -start result tuple re-states the operand
+            # alongside the true result — keep the result half only.
+            tokens = tokens[(len(tokens) + 1) // 2:]
+        nbytes = 0.0
+        for dtype, dims in tokens:
+            if dtype not in _HLO_DTYPE_BYTES:
+                continue
+            elems = 1
+            for d in dims.split(","):
+                if d:
+                    elems *= int(d)
+            nbytes += elems * _HLO_DTYPE_BYTES[dtype]
+        if nbytes == 0.0:
+            continue                      # token-shaped / degenerate
+        total += nbytes
+        count += 1
+        op = m.group("op")
+        by_op[op] = by_op.get(op, 0.0) + nbytes
+    return {"bytes": total, "count": count, "by_op": by_op}
+
+
 def _cost_dict(compiled) -> Optional[dict]:
     """``cost_analysis()`` result as one flat dict (jax returns a
     one-element list on some versions, a dict on others), or None."""
@@ -273,6 +343,19 @@ def normalize_compiled(compiled, *, cache: str = "adhoc", key: str = "",
                                   - (rec.alias_bytes or 0))
     except Exception as e:  # noqa: BLE001 — backend-specific failures
         errors.append(f"memory_analysis: {type(e).__name__}: {e}")
+    try:
+        # Collective accounting (ISSUE 13): best-effort AND silent — a
+        # backend without an HLO text dump leaves the fields None
+        # without polluting `error` or `available` (flops/peak are the
+        # record's contract; comm_crosscheck reports agree=None for
+        # the missing-measurement case).
+        txt = compiled.as_text()
+        if isinstance(txt, str) and txt:
+            coll = hlo_collective_bytes(txt)
+            rec.collective_bytes = coll["bytes"]
+            rec.collectives = coll["count"]
+    except Exception:  # noqa: BLE001 — auxiliary capture, degrade silently
+        pass
     rec.available = rec.flops is not None and rec.peak_bytes is not None
     rec.error = "; ".join(errors) if errors else None
     return rec

@@ -46,6 +46,7 @@ import time
 from typing import Callable, Optional
 
 from kmeans_tpu.obs import cost as _cost
+from kmeans_tpu.obs import identity as _identity
 from kmeans_tpu.obs import trace as _trace
 from kmeans_tpu.obs.metrics_registry import registry as _registry
 
@@ -90,6 +91,15 @@ def _model_record(model) -> dict:
         rec["inertia"] = float(hist[-1])
         if len(hist) >= 2 and "shift" not in rec:
             rec["sse_delta"] = float(hist[-1] - hist[-2])
+    # Rows this host processes per iteration (ISSUE 13): set by the fit
+    # preludes (``_progress_rows`` — process-local rows for multi-host
+    # process-local datasets, the batch for minibatch engines).  The
+    # heartbeat derives ``rows_per_sec`` from consecutive beats, so the
+    # weak-scaling curve of ROADMAP item 1 is a ``fleet-status``
+    # read-off, not a bespoke script.
+    rows = getattr(model, "_progress_rows", None)
+    if rows:
+        rec["rows"] = int(rows)
     return rec
 
 
@@ -128,15 +138,34 @@ class Heartbeat:
         this many seconds (0 = every boundary); the latest record
         always wins, and ``close()`` flushes it so the final state is
         never lost to the throttle.
+    per_process : multi-host sink policy (ISSUE 13), resolved at the
+        FIRST emission (identity is cached then).  ``'auto'`` (default):
+        under ``process_count > 1`` the file path gains the per-process
+        suffix (``hb.jsonl`` -> ``hb.p3.jsonl``) so N hosts never tear
+        one file; single-process keeps the verbatim path.  ``False``:
+        primary-only — non-zero processes drop the FILE sink (callbacks
+        still fire on every host).  ``True``: always suffix.
+
+    Every record additionally stamps the producing process's
+    ``process_index``/``process_count``/``host`` (the fleet identity
+    the straggler report and ``fleet-status`` key on), and — when the
+    fit prelude recorded a per-iteration row count — ``rows_per_sec``,
+    derived from consecutive boundary beats' iteration/monotonic
+    deltas (ticks re-emit the last derived value; no recomputation).
     """
 
     def __init__(self, path=None, callback: Optional[Callable] = None,
                  *, interval_s: Optional[float] = None,
-                 min_period_s: float = 0.0):
+                 min_period_s: float = 0.0, per_process: object = "auto"):
         if interval_s is not None and interval_s <= 0:
             raise ValueError(f"interval_s must be positive or None, got "
                              f"{interval_s!r}")
+        if per_process not in ("auto", True, False):
+            raise ValueError(f"per_process must be 'auto', True or "
+                             f"False, got {per_process!r}")
         self.path = path
+        self.per_process = per_process
+        self.resolved_path = None       # set at first file open
         self.callback = callback
         self.interval_s = interval_s
         self.min_period_s = float(min_period_s)
@@ -151,6 +180,10 @@ class Heartbeat:
         # state update or deadlock against itself (review finding).
         self._lock = threading.Lock()
         self._emit_lock = threading.RLock()
+        self._ident: Optional[dict] = None
+        # (iteration, mono) of the last rate-bearing beat per model
+        # class — the rows_per_sec derivation state.
+        self._rate: dict = {}
         self._latest: Optional[dict] = None
         self._latest_unflushed = False
         self._last_emit = 0.0
@@ -171,6 +204,22 @@ class Heartbeat:
         rec = dict(record)
         rec.setdefault("ts", time.time())
         rec.setdefault("mono", now)
+        if self._ident is None:
+            self._ident = _identity.identity()
+        for k, v in self._ident.items():
+            rec.setdefault(k, v)
+        # rows_per_sec (ISSUE 13): Δiteration × rows / Δmono between
+        # consecutive boundary beats of the same model class — the
+        # per-host throughput the weak-scaling curve reads off.
+        mc = rec.get("model_class")
+        if "iteration" in rec and "rows" in rec:
+            prev = self._rate.get(mc)
+            if prev is not None and rec["iteration"] > prev[0] \
+                    and now > prev[1]:
+                rec.setdefault("rows_per_sec",
+                               (rec["iteration"] - prev[0]) * rec["rows"]
+                               / (now - prev[1]))
+            self._rate[mc] = (rec["iteration"], now)
         tr = _trace.get_tracer()
         if tr is not None:
             rec.setdefault("phase_elapsed", tr.phase_totals())
@@ -218,13 +267,22 @@ class Heartbeat:
             # _closed, so the tail still lands).
             if self.path is not None and not self._file_failed \
                     and not self._closed:
+                if self._file is None and self.resolved_path is None:
+                    self.resolved_path = self._resolve_path()
+                    if self.resolved_path is None:
+                        # primary-only policy on a non-zero process:
+                        # the file sink is deliberately off (not an
+                        # error — sink_errors stays 0).
+                        self._file_failed = True
                 try:
-                    if self._file is None:
-                        self._file = open(self.path, "a")
-                    # default=str: user fields (numpy scalars, paths)
-                    # serialize best-effort rather than raising.
-                    self._file.write(json.dumps(rec, default=str) + "\n")
-                    self._file.flush()
+                    if not self._file_failed:
+                        if self._file is None:
+                            self._file = open(self.resolved_path, "a")
+                        # default=str: user fields (numpy scalars,
+                        # paths) serialize best-effort, never raising.
+                        self._file.write(
+                            json.dumps(rec, default=str) + "\n")
+                        self._file.flush()
                 except Exception:   # noqa: BLE001 — observer isolation
                     self.sink_errors += 1
                     self._file_failed = True
@@ -233,6 +291,22 @@ class Heartbeat:
                     self.callback(rec)
                 except Exception:   # noqa: BLE001 — observer isolation
                     self.callback_errors += 1
+
+    def _resolve_path(self) -> Optional[str]:
+        """The actual file path per the ``per_process`` policy (see the
+        class docstring); None = this process's file sink is off."""
+        ident = self._ident if self._ident is not None \
+            else _identity.identity()
+        self._ident = ident
+        if self.per_process is True or (
+                self.per_process == "auto"
+                and ident["process_count"] > 1):
+            return _identity.per_process_path(self.path,
+                                              ident["process_index"])
+        if self.per_process is False and ident["process_count"] > 1 \
+                and ident["process_index"] != 0:
+            return None
+        return str(self.path)
 
     def _tick_loop(self) -> None:
         while not self._stop.wait(self.interval_s):

@@ -1,0 +1,104 @@
+"""Telemetry identity: which process of which fleet produced a record.
+
+ISSUE 13 (fleet observability) makes every telemetry surface — trace
+spans, heartbeat records, metrics exports — carry the producing
+process's coordinates, so N hosts' streams can be merged into one
+timeline and attributed without guessing from file names:
+
+* ``process_index`` / ``process_count`` — the jax.distributed
+  coordinates when the process is part of an initialized multi-process
+  job; ``0`` / ``1`` otherwise (a single-process fit IS a one-host
+  fleet).
+* ``host`` — the machine name (``socket.gethostname()``), the
+  operator-facing label on merged-timeline tracks and straggler tables.
+
+Resolution order (first hit wins):
+
+1. ``KMEANS_TPU_PROCESS_INDEX`` / ``KMEANS_TPU_PROCESS_COUNT`` /
+   ``KMEANS_TPU_HOST`` environment overrides — for harnesses that run a
+   simulated fleet of plain processes (no jax.distributed), and for
+   launchers that know the topology before jax does.
+2. jax's ``process_index()``/``process_count()`` — read ONLY when jax
+   is already imported AND ``jax.distributed`` reports initialized:
+   probing jax from a telemetry call must never itself initialize the
+   backends (that would pin single-process mode under a caller that
+   planned to call ``jax.distributed.initialize`` later — the exact
+   hazard ``parallel.multihost.initialize`` documents).
+3. ``{process_index: 0, process_count: 1}`` — the single-process
+   default.
+
+The lookup is cheap but not free (env reads + a getattr chain), so the
+tracer and heartbeat cache it per instance; a process's identity is
+fixed for the lifetime of a telemetry scope by construction (scopes are
+installed after ``jax.distributed.initialize`` in any multi-host
+program — the mesh needs it first).
+
+Pure stdlib — importable from every layer, like the rest of ``obs``.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+from typing import Optional
+
+__all__ = ["identity", "per_process_path"]
+
+
+def _jax_coords() -> Optional[tuple]:
+    """(index, count) from an ALREADY-initialized jax.distributed, else
+    None.  Never imports jax and never initializes backends."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        probe = getattr(jax.distributed, "is_initialized", None)
+        if probe is not None:
+            initialized = bool(probe())
+        else:                           # pre-0.6 jax: global_state probe
+            from jax._src import distributed as _dist
+            initialized = getattr(_dist.global_state, "client",
+                                  None) is not None
+        if not initialized:
+            return None
+        return int(jax.process_index()), int(jax.process_count())
+    except Exception:  # noqa: BLE001 — telemetry must never raise here
+        return None
+
+
+def identity() -> dict:
+    """``{"process_index", "process_count", "host"}`` for this process
+    (see the module docstring for the resolution order)."""
+    host = os.environ.get("KMEANS_TPU_HOST")
+    if host is None:
+        try:
+            host = socket.gethostname()
+        except Exception:  # noqa: BLE001 — containers without a hostname
+            host = "?"
+    env_idx = os.environ.get("KMEANS_TPU_PROCESS_INDEX")
+    env_cnt = os.environ.get("KMEANS_TPU_PROCESS_COUNT")
+    if env_idx is not None or env_cnt is not None:
+        try:
+            return {"process_index": int(env_idx or 0),
+                    "process_count": int(env_cnt or 1), "host": host}
+        except ValueError:
+            pass                        # malformed override: fall through
+    coords = _jax_coords()
+    if coords is not None:
+        return {"process_index": coords[0], "process_count": coords[1],
+                "host": host}
+    return {"process_index": 0, "process_count": 1, "host": host}
+
+
+def per_process_path(path, process_index: int) -> str:
+    """The per-process sink path: ``trace.jsonl`` -> ``trace.p3.jsonl``
+    (suffix inserted before the final extension; appended when the path
+    has none).  This is THE naming convention the fleet tools glob for
+    (``obs.fleet.expand_fleet_paths``), fixing the r15 multi-host sink
+    collision where every host opened the same file."""
+    s = str(path)
+    base, dot, ext = s.rpartition(".")
+    if not dot or os.sep in ext or (os.altsep and os.altsep in ext):
+        return f"{s}.p{process_index}"
+    return f"{base}.p{process_index}.{ext}"

@@ -175,8 +175,21 @@ class MetricsRegistry:
         return {m.name: {"kind": m.kind, "value": m.snapshot()}
                 for m in metrics}
 
+    def identity(self) -> dict:
+        """The producing process's fleet coordinates (ISSUE 13) —
+        ``process_index``/``process_count``/``host`` — so exported
+        snapshots from N hosts stay attributable.  Uncached: the
+        registry is process-global and outlives telemetry scopes."""
+        from kmeans_tpu.obs.identity import identity
+        return identity()
+
     def to_json(self, indent: Optional[int] = None) -> str:
-        return json.dumps(self.snapshot(), indent=indent, sort_keys=True)
+        """Snapshot as JSON, stamped with the producer's fleet identity
+        under ``__identity__`` (a reserved name no metric can take:
+        metric names are dotted lowercase paths by convention)."""
+        out = dict(self.snapshot())
+        out["__identity__"] = self.identity()
+        return json.dumps(out, indent=indent, sort_keys=True)
 
     def reset(self) -> None:
         """Drop every metric (bench/test isolation).  Live references

@@ -75,7 +75,8 @@ def ttfi_ladder(records: List[dict]) -> List[dict]:
 
 
 def time_to_first_iteration(records: List[dict],
-                            decision_share: Optional[float] = None
+                            decision_share: Optional[float] = None,
+                            comm_model: Optional[dict] = None
                             ) -> List[dict]:
     """The publishable per-phase time-to-first-iteration table: one row
     per phase with ``ms`` / ``share`` / ``implied_ceiling_speedup`` /
@@ -83,11 +84,15 @@ def time_to_first_iteration(records: List[dict],
     the span-derived ladder, so the TTFI artifact and the r13 per-
     iteration ceiling table share one schema and one committed decision
     rule (>= ``PHASE_DECISION_SHARE`` of the total marks the phase as
-    the next attack surface for ROADMAP item 5)."""
+    the next attack surface for ROADMAP item 5).  ``comm_model`` (an
+    ``obs.fleet.comm_bytes_model`` dict, ISSUE 13) attaches the
+    analytic collective-bytes columns to the ``first_dispatch`` row —
+    the dispatch is where the fit pays them."""
     from kmeans_tpu.utils import profiling
     share = profiling.PHASE_DECISION_SHARE if decision_share is None \
         else decision_share
     rows = profiling.phase_ceiling_table(ttfi_ladder(records),
+                                         comm_model=comm_model,
                                          decision_share=share)
     # Device-cost join (ISSUE 12): when the trace carries cost.record
     # events (capture ran alongside tracing), each phase row gains the
@@ -155,6 +160,14 @@ def format_phase_table(rows: List[dict], title: str =
             f"{'YES' if r.get('actionable') else 'no'}")
     total_ms = sum(r["ms"] for r in rows)
     lines.append(f"  {'TOTAL':<16} {total_ms:>10.2f}")
+    for r in rows:
+        if "comm_bytes_per_iter" in r:
+            lines.append(
+                f"  comm ({r['phase']}): "
+                f"{r['comm_bytes_per_iter']:.0f} B/iter analytic "
+                f"collectives, "
+                f"{r['comm_wire_bytes_per_device']:.0f} B/iter wire "
+                f"per device (ring)")
     return "\n".join(lines)
 
 

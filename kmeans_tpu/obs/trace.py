@@ -58,17 +58,21 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from kmeans_tpu.obs import identity as _identity
 from kmeans_tpu.obs.metrics_registry import nearest_rank
 
 __all__ = ["Tracer", "span", "event", "tracing", "get_tracer",
            "read_jsonl", "summarize", "SPAN_NAMES", "TraceReadError"]
 
 #: The span taxonomy (documentation + the CLI's table ordering; call
-#: sites may add dotted sub-names like ``checkpoint.save``).
+#: sites may add dotted sub-names like ``checkpoint.save``).  The
+#: ``collective`` span (ISSUE 13) wraps host-side cross-process
+#: collectives (``process_allgather``, the fleet barrier) — the
+#: ``collective-span`` lint rule enforces coverage in ``parallel/``.
 SPAN_NAMES = (
     "place", "stage", "compile", "trace", "seed", "dispatch", "segment",
     "checkpoint.save", "checkpoint.restore", "io.block",
-    "serve.request", "serve.flush",
+    "serve.request", "serve.flush", "collective",
 )
 
 
@@ -117,6 +121,7 @@ class Tracer:
         self._records: List[dict] = []
         self._tls = threading.local()
         self._next_id = 0
+        self._ident: Optional[dict] = None
         # Incremental per-name SELF-time accumulators: +dur on close,
         # -dur from the enclosing span's name — so phase_totals() is
         # O(names), not a re-walk of every record (the heartbeat reads
@@ -127,6 +132,16 @@ class Tracer:
     # ------------------------------------------------------------ time
     def _now(self) -> float:
         return time.perf_counter() - self._t0
+
+    def identity(self) -> dict:
+        """This tracer's fleet identity (process_index/count, host) —
+        resolved lazily on first use (by which time a multi-host
+        program has initialized jax.distributed: the mesh needs it
+        before any fit runs) and cached for the tracer's lifetime, so
+        per-record stamping costs three dict inserts, not a lookup."""
+        if self._ident is None:
+            self._ident = _identity.identity()
+        return self._ident
 
     def _stack(self) -> list:
         st = getattr(self._tls, "stack", None)
@@ -145,10 +160,15 @@ class Tracer:
             sid = self._next_id
             self._next_id += 1
         parent = stack[-1] if stack else None
+        # Fleet identity (ISSUE 13): every record carries its producer's
+        # coordinates so merged multi-host streams stay attributable
+        # record-by-record (the file header alone would be lost on
+        # re-slicing).  Cached — three dict inserts per span.
         rec = {"kind": "span", "name": name, "id": sid,
                "parent": parent["id"] if parent else None,
                "depth": len(stack),
                "tid": threading.get_ident(),
+               **self.identity(),
                "t0": self._now(), "t1": None, "dur": None}
         if attrs:
             rec["attrs"] = _jsonable(attrs)
@@ -183,6 +203,7 @@ class Tracer:
                 "kind": "event", "name": name, "id": sid,
                 "parent": stack[-1]["id"] if stack else None,
                 "depth": len(stack), "tid": threading.get_ident(),
+                **self.identity(),
                 "t0": self._now(), "t1": None, "dur": 0.0,
                 **({"attrs": _jsonable(attrs)} if attrs else {})})
 
@@ -228,7 +249,7 @@ class Tracer:
 
     def _dump_jsonl(self, f) -> None:
         f.write(json.dumps({"kind": "header", "wall0": self.wall0,
-                            "pid": os.getpid(),
+                            "pid": os.getpid(), **self.identity(),
                             "format": "kmeans_tpu.trace.v1"}) + "\n")
         for rec in self.records():
             f.write(json.dumps(rec) + "\n")
@@ -254,12 +275,28 @@ def _jsonable(attrs: dict) -> dict:
 def chrome_events(records: List[dict]) -> List[dict]:
     """Chrome ``trace_event`` array from trace records: complete events
     (``ph='X'``) for spans, instant events (``ph='i'``) for events —
-    the schema chrome://tracing and Perfetto load directly."""
-    pid = os.getpid()
+    the schema chrome://tracing and Perfetto load directly.
+
+    Fleet rendering (ISSUE 13): records from a multi-process fit carry
+    ``process_index``/``host``; each host then becomes its OWN Chrome
+    process (``pid`` = process_index, a ``process_name`` metadata event
+    labels it with the host name), so a merged timeline shows one track
+    group per host.  Single-process records keep ``pid`` = the OS pid —
+    the r15 schema, unchanged."""
+    os_pid = os.getpid()
     out = []
+    hosts = {}                      # pid -> host label (fleet records)
     for rec in records:
         if rec.get("kind") == "header":
             continue
+        if rec.get("process_count", 1) > 1:
+            pid = int(rec.get("process_index", 0))
+            hosts.setdefault(
+                pid, f"{rec.get('host', '?')} (p{pid})")
+        else:
+            pid = rec.get("process_index") if "process_index" in rec \
+                and _is_merged(rec) else os_pid
+            pid = os_pid if pid is None else pid
         base = {"name": rec["name"], "pid": pid, "tid": rec["tid"],
                 "ts": round(rec["t0"] * 1e6, 3),
                 "args": rec.get("attrs", {})}
@@ -269,7 +306,17 @@ def chrome_events(records: List[dict]) -> List[dict]:
         else:
             out.append({**base, "ph": "i", "s": "t"})
     out.sort(key=lambda e: e["ts"])
-    return out
+    meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": label}}
+            for pid, label in sorted(hosts.items())]
+    return meta + out
+
+
+def _is_merged(rec: dict) -> bool:
+    """True for records a fleet merge re-stamped (they carry the
+    merged-stream marker) — their process_index is a track id even when
+    the source fit was single-process-per-host."""
+    return bool(rec.get("fleet_merged"))
 
 
 # --------------------------------------------------- module fast paths
@@ -320,10 +367,23 @@ def traced_builder(fn):
 
 
 @contextlib.contextmanager
-def tracing(path=None, chrome=None, tracer: Optional[Tracer] = None):
+def tracing(path=None, chrome=None, tracer: Optional[Tracer] = None,
+            per_process: object = "auto"):
     """Install a tracer for the ``with`` body (nested scopes shadow,
     like ``log_dispatches``); on exit restore the previous one and
     write the JSONL/Chrome exports when paths were given.
+
+    Multi-host sinks (ISSUE 13): under ``process_count > 1`` every host
+    runs this scope, and N hosts appending to ONE path would tear the
+    file — so by default (``per_process='auto'``) each process writes
+    to the suffixed ``identity.per_process_path`` (``trace.jsonl`` ->
+    ``trace.p3.jsonl``; ``obs.fleet``/``trace summarize`` glob these
+    back together).  ``per_process=False`` is the primary-only
+    alternative: ONLY process 0 writes, at the verbatim path — a
+    one-host sample of the fleet, for operators who want a single file
+    and accept losing the other hosts' spans.  ``per_process=True``
+    forces the suffix even single-process (harness use).  Single
+    process + 'auto' keeps the verbatim path — the r15 contract.
 
     Usage::
 
@@ -333,16 +393,30 @@ def tracing(path=None, chrome=None, tracer: Optional[Tracer] = None):
         table = obs.time_to_first_iteration(tr.records())
     """
     global _TRACER
+    if per_process not in ("auto", True, False):
+        # Validate up front (the Heartbeat rule): silently degrading a
+        # typo'd policy to every-host-writes-the-verbatim-path would
+        # reintroduce the torn-shared-file collision this knob fixes.
+        raise ValueError(f"per_process must be 'auto', True or False, "
+                         f"got {per_process!r}")
     t = tracer if tracer is not None else Tracer()
     prev, _TRACER = _TRACER, t
     try:
         yield t
     finally:
         _TRACER = prev
-        if path is not None:
-            t.write_jsonl(path)
-        if chrome is not None:
-            t.write_chrome(chrome)
+        ident = t.identity()
+        suffix = per_process is True or (
+            per_process == "auto" and ident["process_count"] > 1)
+        primary_only = per_process is False \
+            and ident["process_count"] > 1
+        writer = not (primary_only and ident["process_index"] != 0)
+        if path is not None and writer:
+            t.write_jsonl(_identity.per_process_path(
+                path, ident["process_index"]) if suffix else path)
+        if chrome is not None and writer:
+            t.write_chrome(_identity.per_process_path(
+                chrome, ident["process_index"]) if suffix else chrome)
 
 
 # ----------------------------------------------------------- analysis

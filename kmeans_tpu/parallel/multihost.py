@@ -28,6 +28,8 @@ from typing import Optional
 
 import jax
 
+from kmeans_tpu.obs import trace as _obs_trace
+
 
 _CLUSTER_ENV_VARS = (
     "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
@@ -83,3 +85,41 @@ def initialize(coordinator_address: Optional[str] = None,
 def is_primary() -> bool:
     """True on the process that should own logging/artifact writes."""
     return jax.process_index() == 0
+
+
+def fleet_barrier(tag: str = "fit-start") -> None:
+    """Telemetry clock anchor (ISSUE 13): a cross-host barrier + a
+    ``fleet.barrier`` trace event, emitted by the fit preludes.
+
+    The fleet merge (``obs.fleet.merge_traces``) aligns per-host
+    monotonic clocks on these events: all hosts exit the barrier at the
+    same true instant up to the release skew, so the k-th barrier on
+    host A pairs with the k-th on host B.  Contract:
+
+    * **obs=0 true no-op** — with no tracer installed this returns
+      after one ``None`` check: no barrier, no collective, no record.
+      Corollary: telemetry scopes must be installed FLEET-WIDE (every
+      host or none) — a barrier some hosts skip would deadlock the
+      rest (documented in docs/OBSERVABILITY.md "Fleet").
+    * Multi-process: the barrier is one tiny ``process_allgather`` (the
+      same primitive ``from_process_local`` already pays per dataset),
+      safe to repeat; the event stamps ``synced=True`` and the merge
+      trusts it as a clock anchor.
+    * Single-process (or a simulated fleet of plain processes): no
+      collective exists to sync on — the event is still emitted with
+      ``synced=False``, a sequence marker only; the merge then falls
+      back to wall-clock alignment.
+    """
+    if _obs_trace.get_tracer() is None:
+        return
+    synced = False
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        import numpy as np
+        with _obs_trace.span("collective", op="process_allgather",
+                             site=f"fleet_barrier:{tag}"):
+            multihost_utils.process_allgather(
+                np.asarray([jax.process_index()], dtype=np.int32))
+        synced = True
+    _obs_trace.event("fleet.barrier", tag=tag, synced=synced)

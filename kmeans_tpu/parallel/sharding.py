@@ -570,8 +570,13 @@ def from_process_local(X_local, mesh: Mesh, *,
         raise ValueError(
             f"data axis ({data_shards}) must be divisible by the process "
             f"count ({nproc}) for process-local loading")
-    counts = np.asarray(multihost_utils.process_allgather(
-        np.asarray([n_local], dtype=np.int64))).reshape(-1)
+    # 'collective' span (ISSUE 13): the one host-side cross-process
+    # collective of the data path — covered so it lands on the fleet
+    # timeline (the `collective-span` lint rule enforces this class).
+    with _obs_trace.span("collective", op="process_allgather",
+                         site="from_process_local:counts"):
+        counts = np.asarray(multihost_utils.process_allgather(
+            np.asarray([n_local], dtype=np.int64))).reshape(-1)
     n_global = int(counts.sum())
     # Chunk from the allgathered MAX count — every process must compute the
     # identical chunk (and therefore identical global shape and identical

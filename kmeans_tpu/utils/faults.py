@@ -50,6 +50,7 @@ __all__ = [
     "TransientIOError", "SimulatedPreemption", "SimulatedOOM",
     "on_checkpoint", "on_segment_dispatch",
     "inject_kill_after_iteration", "inject_oom_on_segment",
+    "inject_checkpoint_delay",
     "fail_first_attempts", "flaky_blocks", "poison_blocks",
 ]
 
@@ -119,6 +120,37 @@ def inject_kill_after_iteration(j: int):
             raise SimulatedPreemption(
                 f"injected preemption after iteration {iteration} "
                 f"(armed at {j}); last checkpoint: {path}")
+
+    with _HOOK_LOCK:
+        _CHECKPOINT_HOOKS.append(hook)
+    try:
+        yield record
+    finally:
+        with _HOOK_LOCK:
+            if hook in _CHECKPOINT_HOOKS:
+                _CHECKPOINT_HOOKS.remove(hook)
+
+
+@contextlib.contextmanager
+def inject_checkpoint_delay(seconds: float, *, after_iteration: int = 0):
+    """Arm a deterministic SLOW-HOST injection (ISSUE 13): every
+    checkpoint boundary whose completed-iteration count is
+    >= ``after_iteration`` sleeps ``seconds`` before returning to the
+    fit loop — the stand-in for a host whose per-iteration work is
+    slower than the fleet's (page-cache misses, a noisy neighbor, a
+    failing NIC).  Run a fit with ``checkpoint_every=1`` and the delay
+    stretches every iteration on THIS process only, so merged
+    heartbeats show the lagging boundary cadence and rows/s skew the
+    straggler report must flag.  Yields a record dict with ``fired``
+    (boundary count delayed)."""
+    import time
+
+    record = {"fired": 0}
+
+    def hook(iteration: int, path) -> None:
+        if iteration >= after_iteration:
+            record["fired"] += 1
+            time.sleep(seconds)
 
     with _HOOK_LOCK:
         _CHECKPOINT_HOOKS.append(hook)

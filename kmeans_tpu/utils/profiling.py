@@ -176,6 +176,7 @@ PHASE_DECISION_SHARE = 0.15
 
 def phase_ceiling_table(ladder, *, flops_per_iter=None,
                         peak_tflops=None, cost_record=None,
+                        comm_model=None,
                         decision_share: float = PHASE_DECISION_SHARE):
     """Turn a ``measure_phase_ladder`` result into the publishable
     MEASURED-CEILING table (ISSUE 8c): one row per phase with
@@ -204,6 +205,15 @@ def phase_ceiling_table(ladder, *, flops_per_iter=None,
     the full measured pass vs the pinned peak; None off-accelerator) —
     so every BASELINE row that embeds this table is roofline-attributed
     without a second measurement.
+
+    Comm join (ISSUE 13): with ``comm_model`` (an
+    ``obs.fleet.comm_bytes_model`` dict) the LAST row — the full
+    measured pass, the one that pays the collectives — additionally
+    carries ``comm_bytes_per_iter`` (analytic per-device collective
+    result bytes per iteration) and ``comm_wire_bytes_per_device``
+    (ring-algorithm interconnect estimate), so the table answers "how
+    much of this phase is the fleet talking" without a second model
+    run; ``format_phase_table`` renders them as a trailing comm line.
     """
     import numpy as np  # noqa: F811 — mirror measure_phase_ladder
 
@@ -234,6 +244,11 @@ def phase_ceiling_table(ladder, *, flops_per_iter=None,
         if roofline is not None:
             row.update(roofline)
         rows.append(row)
+    if comm_model is not None and rows:
+        rows[-1]["comm_bytes_per_iter"] = \
+            comm_model["per_iteration_bytes"]
+        rows[-1]["comm_wire_bytes_per_device"] = \
+            comm_model["wire_bytes_per_device_per_iteration"]
     return rows
 
 

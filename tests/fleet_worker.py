@@ -1,0 +1,80 @@
+"""Worker for the SIMULATED-fleet observability tests (not a test
+module — spawned by tests/test_fleet.py).
+
+Each invocation is one "host" of a simulated fleet: a plain process
+(no jax.distributed — the ungated twin of the real multi-process
+harness in tests/mh_worker.py) whose fleet identity comes from the
+``KMEANS_TPU_PROCESS_INDEX``/``_COUNT`` environment overrides, running
+a fully-instrumented host-loop fit whose telemetry lands in the shared
+output directory:
+
+* ``trace.p{idx}.jsonl``  — per-process trace (auto-suffixed sink)
+* ``hb.p{idx}.jsonl``     — per-process heartbeat stream
+
+``--slow <seconds>`` arms ``faults.inject_checkpoint_delay`` so THIS
+host's iterations stretch (fit runs ``checkpoint_every=1``) — the
+deterministic straggler the report must flag.  All hosts share one
+machine, hence one wall clock: the merge aligns on the wall anchors
+(``align='wall'``), exactly the fallback path the simulated fleet is
+meant to exercise (the real barrier path is covered by mh_worker.py).
+"""
+
+import argparse
+import os
+import sys
+from pathlib import Path
+
+parser = argparse.ArgumentParser()
+parser.add_argument("index", type=int)
+parser.add_argument("count", type=int)
+parser.add_argument("out_dir")
+parser.add_argument("--slow", type=float, default=0.0)
+args = parser.parse_args()
+
+os.environ["KMEANS_TPU_PROCESS_INDEX"] = str(args.index)
+os.environ["KMEANS_TPU_PROCESS_COUNT"] = str(args.count)
+os.environ["KMEANS_TPU_HOST"] = f"simhost{args.index}"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=1")
+
+import contextlib  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+from kmeans_tpu import KMeans, obs  # noqa: E402
+from kmeans_tpu.utils import faults  # noqa: E402
+
+out = Path(args.out_dir)
+rng = np.random.default_rng(0)
+# Structureless data: Lloyd keeps moving for many iterations, so the
+# tolerance never fires and every host runs the full max_iter budget
+# (the straggler comparison needs equal iteration counts).
+X = rng.normal(size=(2000, 8)).astype(np.float32)
+init = X[rng.choice(2000, size=4, replace=False)]
+
+slow = (faults.inject_checkpoint_delay(args.slow) if args.slow
+        else contextlib.nullcontext({"fired": 0}))
+# A sub-epsilon tolerance runs every iteration; checkpoint_every=1
+# gives the delay hook an every-iteration boundary; host_loop=True
+# emits one heartbeat per iteration (the fleet-status cadence).
+with obs.tracing(out / "trace.jsonl") as tr, \
+        obs.heartbeat(out / "hb.jsonl") as hb, slow as rec:
+    km = KMeans(k=4, seed=0, init=init, max_iter=8, tolerance=1e-30,
+                empty_cluster="keep", compute_sse=True, host_loop=True,
+                verbose=False)
+    km.fit(X, checkpoint_every=1,
+           checkpoint_path=out / f"ckpt_{args.index}.npz")
+
+assert km.iterations_run == 8, km.iterations_run
+if args.slow:
+    assert rec["fired"] >= 8, rec
+assert hb.resolved_path == str(out / f"hb.p{args.index}.jsonl"), \
+    hb.resolved_path
+ident = tr.identity()
+assert ident["process_index"] == args.index, ident
+assert ident["process_count"] == args.count, ident
+np.save(out / f"centroids_{args.index}.npy", km.centroids)
+print(f"fleet worker {args.index}/{args.count}: OK "
+      f"iters={km.iterations_run}", flush=True)
+sys.exit(0)

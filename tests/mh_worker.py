@@ -199,6 +199,58 @@ np.save(out_dir / f"gmm_means_{proc_id}.npy", gm.means_)
 np.save(out_dir / f"gmm_ll_{proc_id}.npy",
         np.asarray([gm.lower_bound_]))
 
+# --- ISSUE 13: fleet observability under REAL multi-process SPMD.
+# (a) obs=0 parity under multiprocess: the fully-instrumented fit must
+# be BIT-identical to the plain one on every host; (b) per-process
+# sinks: tracing/heartbeat paths auto-suffix (no torn shared file);
+# (c) TWO instrumented fits emit two synced fit-start barriers, so the
+# parent's merge measures a real cross-barrier skew bound.
+import contextlib  # noqa: E402
+
+from kmeans_tpu import obs  # noqa: E402
+from kmeans_tpu.utils import faults  # noqa: E402
+
+obs_kw = dict(k=4, seed=0, init=init, empty_cluster="keep",
+              compute_sse=True, max_iter=6, tolerance=1e-30,
+              verbose=False)
+km_plain = KMeans(**obs_kw).fit(ds)
+with obs.tracing(out_dir / "fleet_trace.jsonl") as fleet_tr, \
+        obs.heartbeat(out_dir / "fleet_hb.jsonl") as fleet_hb:
+    km_obs = KMeans(**obs_kw).fit(ds)
+    km_obs2 = KMeans(**obs_kw).fit(ds)      # second fit-start barrier
+assert km_obs.iterations_run == km_plain.iterations_run
+np.testing.assert_array_equal(km_obs.centroids, km_plain.centroids)
+assert km_obs.sse_history == km_plain.sse_history
+np.testing.assert_array_equal(km_obs2.centroids, km_obs.centroids)
+ident = fleet_tr.identity()
+assert ident["process_index"] == proc_id, ident
+assert ident["process_count"] == nproc, ident
+assert (out_dir / f"fleet_trace.p{proc_id}.jsonl").exists()
+assert fleet_hb.resolved_path == str(
+    out_dir / f"fleet_hb.p{proc_id}.jsonl"), fleet_hb.resolved_path
+barrier_evs = [r for r in fleet_tr.records()
+               if r.get("kind") == "event"
+               and r["name"] == "fleet.barrier"]
+assert len(barrier_evs) == 2, barrier_evs
+assert all(e["attrs"]["synced"] for e in barrier_evs), barrier_evs
+
+# (d) straggler fleet: per-host INDEPENDENT local fits (the elastic-
+# loop regime — each host trains on its own slice, coordinating only
+# through checkpoints/heartbeats), process 1 slowed by the
+# deterministic faults hook; the parent's straggler report must flag
+# exactly it.  Local 1-device mesh: no cross-process collectives.
+local_mesh = make_mesh(data=1, model=1,
+                       devices=[jax.local_devices()[0]])
+delay = (faults.inject_checkpoint_delay(0.1) if proc_id == 1
+         else contextlib.nullcontext())
+with obs.heartbeat(out_dir / "straggler_hb.jsonl"), delay:
+    KMeans(k=4, seed=0, init=init, empty_cluster="keep",
+           compute_sse=True, max_iter=6, tolerance=1e-30,
+           host_loop=True, mesh=local_mesh, verbose=False).fit(
+        X_local, checkpoint_every=1,
+        checkpoint_path=out_dir / f"straggler_ckpt_{proc_id}.npz")
+assert (out_dir / f"straggler_hb.p{proc_id}.jsonl").exists()
+
 np.save(out_dir / f"centroids_{proc_id}.npy", km.centroids)
 np.save(out_dir / f"sse_{proc_id}.npy", np.asarray(km.sse_history))
 tp_note = f" tp_iters={km_tp.iterations_run}" if nproc == 2 else ""

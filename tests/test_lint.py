@@ -428,6 +428,75 @@ def test_obs_span_suppression_honored(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# collective-span (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+_COLLECTIVE_BAD = """
+import numpy as np
+from jax.experimental import multihost_utils
+
+
+def gather_counts(n_local):
+    return multihost_utils.process_allgather(
+        np.asarray([n_local], dtype=np.int64))
+"""
+
+
+def test_collective_span_fires_on_uncovered_allgather(tmp_path):
+    findings = run_on(tmp_path, _COLLECTIVE_BAD, subdir="parallel")
+    fires = [f for f in findings if f.rule == "collective-span"]
+    assert len(fires) == 1
+    assert "gather_counts()" in fires[0].message
+    assert "merged timeline" in fires[0].message
+
+
+def test_collective_span_silent_with_span_or_tag(tmp_path):
+    spanned = """
+import numpy as np
+from jax.experimental import multihost_utils
+
+from kmeans_tpu.obs import trace as obs_trace
+
+
+def gather_counts(n_local):
+    with obs_trace.span("collective", op="process_allgather"):
+        return multihost_utils.process_allgather(
+            np.asarray([n_local], dtype=np.int64))
+"""
+    findings = run_on(tmp_path, spanned, subdir="parallel")
+    assert [f for f in findings if f.rule == "collective-span"] == []
+    tagged = """
+import numpy as np
+from jax.experimental import multihost_utils
+
+from kmeans_tpu.utils.profiling import note_dispatch
+
+
+def sync(tag):
+    note_dispatch("fleet/barrier")
+    multihost_utils.sync_global_devices(tag)
+"""
+    findings = run_on(tmp_path, tagged, subdir="parallel")
+    assert [f for f in findings if f.rule == "collective-span"] == []
+
+
+def test_collective_span_scoped_to_parallel(tmp_path):
+    """The same uncovered collective outside parallel/ (e.g. the
+    checkpoint barrier in utils/) is out of this rule's scope."""
+    findings = run_on(tmp_path, _COLLECTIVE_BAD, subdir="utils")
+    assert [f for f in findings if f.rule == "collective-span"] == []
+
+
+def test_collective_span_suppression_honored(tmp_path):
+    src = _COLLECTIVE_BAD.replace(
+        "    return multihost_utils.process_allgather(",
+        "    # lint: ok(collective-span) — covered by the caller's "
+        "span\n    return multihost_utils.process_allgather(")
+    findings = run_on(tmp_path, src, subdir="parallel")
+    assert [f for f in findings if f.rule == "collective-span"] == []
+
+
+# ---------------------------------------------------------------------------
 # cache-name (ISSUE 12)
 # ---------------------------------------------------------------------------
 

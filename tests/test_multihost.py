@@ -187,6 +187,41 @@ def test_two_process_fit_matches_single_process(tmp_path):
         gm_ref.lower_bound_, rtol=1e-4)
 
     _assert_r5_matrix(tmp_path, 2, X, init)
+    _assert_fleet_obs(tmp_path, 2)
+
+
+def _assert_fleet_obs(tmp_path, nproc: int) -> None:
+    """ISSUE 13 coverage shared by the 2- and 4-process runs: the
+    workers' per-process telemetry merges into one barrier-aligned
+    timeline with every host present and the measured skew bound under
+    the committed threshold; the healthy SPMD fleet's heartbeats stay
+    straggler-silent; the injected-slow-host independent fleet flags
+    exactly process 1.  (The workers already asserted obs=0 parity
+    bit-exact and per-process sink paths internally.)"""
+    from kmeans_tpu.obs import fleet
+
+    traces = sorted(tmp_path.glob("fleet_trace.p*.jsonl"))
+    assert len(traces) == nproc, traces
+    merged = fleet.merge_traces(traces)
+    assert [h["process_index"] for h in merged["hosts"]] \
+        == list(range(nproc))
+    assert merged["align"] == "barrier"
+    assert merged["barriers"] == 2          # two instrumented fits
+    assert merged["skew_bound_s"] is not None
+    assert merged["skew_bound_s"] <= fleet.FLEET_SKEW_BOUND_S, merged
+    # Every host's spans landed on the merged timeline.
+    present = {r["process_index"] for r in merged["records"]}
+    assert present == set(range(nproc)), present
+
+    hb = fleet.merge_heartbeats(sorted(tmp_path.glob(
+        "fleet_hb.p*.jsonl")))
+    healthy = fleet.straggler_report(hb)
+    assert healthy["healthy"], healthy
+
+    slow = fleet.straggler_report(fleet.merge_heartbeats(sorted(
+        tmp_path.glob("straggler_hb.p*.jsonl"))))
+    assert 1 in slow["flagged"], slow
+    assert 0 not in slow["flagged"], slow
 
 
 def _assert_r5_matrix(tmp_path, nproc: int, X, init) -> None:
@@ -263,6 +298,7 @@ def test_four_process_fit_matches_single_process(tmp_path):
     np.testing.assert_allclose(loaded.centroids, cents[0])
 
     _assert_r5_matrix(tmp_path, 4, X, init)
+    _assert_fleet_obs(tmp_path, 4)
 
 
 # (r1's up-front 'resample' rejection for process-local datasets is gone:
