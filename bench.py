@@ -89,6 +89,18 @@ BENCH_OBS_TRACE, default artifacts/trace_ttfi.jsonl).  Committed rule:
 <= 1% median overhead on the 200k x 32 k=64 proxy or per-iteration
 spans demote to segment-level.  Env: BENCH_N/_D/_K/_ITERS.
 
+BENCH_QUALITY=1 switches to the SERVING-QUALITY MONITORING overhead
+benchmark (ISSUE 14): monitoring-on vs monitoring-off serving
+throughput against a resident warm K-Means model, interleaved per-rep
+on/off ratio pairs with labels asserted bit-equal in-bench (the obs=0
+parity contract applied to serving).  Committed rule: <= 1.01 median
+overhead keeps ``quality='auto'`` resolving ON for the measured
+platform; a breach resolves 'auto' to off there — published either
+way (measured outcome: CPU breaches at ~1.1-1.2x against sub-ms local
+dispatches -> 'auto' = off on CPU, on on accelerators; hardware row
+pinned).  Env: BENCH_N/_D/_K, BENCH_QUALITY_BATCH (rows per dispatch,
+default 512).
+
 BENCH_COST=1 switches to the DEVICE-COST OBSERVABILITY rows (ISSUE 12):
 analytic-vs-XLA-reported FLOPs and predicted-vs-observed peak-memory
 comparisons for the kmeans and gmm-diag step programs, captured
@@ -271,6 +283,21 @@ def main() -> None:
             log(f"bench: BF16-GUARD mode backend={backend} N={ln} "
                 f"D={ld} k={lk} iters_gap={li}")
             bench_bf16_guard(ln, ld, lk, li)
+        return
+
+    if os.environ.get("BENCH_QUALITY"):
+        # Serving-quality monitoring overhead (ISSUE 14): drift
+        # monitor fed per dispatch vs the blind engine, interleaved
+        # per-rep ratios, committed <=1.01 rule.
+        from kmeans_tpu.benchmarks import bench_quality
+        qn = int(os.environ.get("BENCH_N",
+                                2_000_000 if on_accel else 200_000))
+        qd = int(os.environ.get("BENCH_D", 128 if on_accel else 32))
+        qk = int(os.environ.get("BENCH_K", 1024 if on_accel else 64))
+        qb = int(os.environ.get("BENCH_QUALITY_BATCH", 512))
+        log(f"bench: QUALITY mode backend={backend} N={qn} D={qd} "
+            f"k={qk} batch={qb}")
+        bench_quality(qn, qd, qk, batch=qb)
         return
 
     if os.environ.get("BENCH_COST"):

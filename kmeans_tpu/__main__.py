@@ -45,9 +45,15 @@ def main() -> int:
     if cmd == "fleet-status":
         from kmeans_tpu.cli import fleet_status_main
         return fleet_status_main(rest)
+    if cmd == "serve-status":
+        from kmeans_tpu.cli import serve_status_main
+        return serve_status_main(rest)
+    if cmd == "bench-diff":
+        from kmeans_tpu.cli import bench_diff_main
+        return bench_diff_main(rest)
     print(f"unknown command {cmd!r}; available: suite, bench, fit, "
           f"sweep, ckpt-info, serve, report, lint, trace, cost-report, "
-          f"fleet-status", file=sys.stderr)
+          f"fleet-status, serve-status, bench-diff", file=sys.stderr)
     return 2
 
 

@@ -550,6 +550,81 @@ class CollectiveSpanRule(ObsSpanRule):
                     f"on the merged timeline")
 
 
+# ------------------------------------------------------ quality-counter
+
+class QualityCounterRule(ObsSpanRule):
+    """ISSUE 14 member of the obs-span lint family: in ``serving/``, a
+    driver-level function that RECORDS serving traffic (calls the
+    engine's ``._record`` stats recorder, or bumps the
+    ``packed_dispatches`` counter — the packed path's accounting) must
+    also feed the quality monitor (``._observe_quality``/
+    ``.observe``).  A dispatch path that counts its traffic but skips
+    the monitor silently starves the drift detectors of exactly that
+    path's labels — the monitoring twin of the r14 dispatch-counter
+    incident class, and how a future fifth dispatch path would
+    otherwise go blind."""
+
+    id = "quality-counter"
+    incident = ("ISSUE 14: a serving dispatch path recorded in the "
+                "stats but invisible to the drift monitor — the "
+                "quality twin of the dispatch-counter class")
+
+    _FEEDS = {"_observe_quality", "observe"}
+
+    def run(self, pkg: Package) -> Iterator[Finding]:
+        for mod in pkg:
+            p = mod.rel.replace("\\", "/")
+            if "/serving/" not in p:
+                continue
+            parents = mod.parents()
+            for fn in ast.walk(mod.tree):
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                # Driver-level only (the obs-span convention): nested
+                # closures are checked through the enclosing driver.
+                if not isinstance(parents.get(fn),
+                                  (ast.Module, ast.ClassDef)):
+                    continue
+                sites = self._traffic_sites(fn)
+                if not sites:
+                    continue
+                if self._feeds_monitor(fn):
+                    continue
+                yield self.finding(
+                    mod, sites[0],
+                    f"{fn.name}() records serving traffic but never "
+                    f"feeds the quality monitor — call "
+                    f"_observe_quality(...) with the labels/scores "
+                    f"this dispatch already computed (a no-op when "
+                    f"monitoring is off)")
+
+    @staticmethod
+    def _traffic_sites(fn) -> List[int]:
+        """Lines where ``fn`` records serving traffic: ``._record(...)``
+        calls and ``packed_dispatches`` counter INCREMENTS (AugAssign
+        only — the ``= 0`` declarations in __init__ are bookkeeping
+        setup, not traffic)."""
+        lines: List[int] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                if (dotted(node.func) or "").split(".")[-1] == "_record":
+                    lines.append(node.lineno)
+            elif isinstance(node, ast.AugAssign):
+                if "packed_dispatches" in (dotted(node.target) or ""):
+                    lines.append(node.lineno)
+        return lines
+
+    @classmethod
+    def _feeds_monitor(cls, fn) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                if (dotted(node.func) or "").split(".")[-1] \
+                        in cls._FEEDS:
+                    return True
+        return False
+
+
 # ------------------------------------------------------------ threads
 
 class ThreadHygieneRule(Rule):
@@ -832,7 +907,7 @@ class SuppressionFormatRule(Rule):
 
 RULES: Dict[str, Rule] = {rule.id: rule for rule in (
     TraceHazardRule(), CacheKeyRule(), DispatchAccountingRule(),
-    ObsSpanRule(), CollectiveSpanRule(), ThreadHygieneRule(),
-    CounterResetRule(), DeadPrivateRule(), CacheNameRule(),
-    SuppressionFormatRule(),
+    ObsSpanRule(), CollectiveSpanRule(), QualityCounterRule(),
+    ThreadHygieneRule(), CounterResetRule(), DeadPrivateRule(),
+    CacheNameRule(), SuppressionFormatRule(),
 )}

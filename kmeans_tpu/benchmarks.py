@@ -999,7 +999,7 @@ def bench_obs(n: int, d: int, k: int, iters: int = 20,
     import jax
 
     from kmeans_tpu.models.kmeans import KMeans
-    from kmeans_tpu.obs.heartbeat import heartbeat as heartbeat_scope
+    from kmeans_tpu.obs import heartbeat as heartbeat_scope
     from kmeans_tpu.obs import trace as trace_mod
     from kmeans_tpu.obs.report import (format_phase_table,
                                        time_to_first_iteration)
@@ -1608,6 +1608,106 @@ def bench_serving(n: int, d: int, k: int,
          f"{st['batch_fill']}")
     engine.close()
     return results
+
+
+def bench_quality(n: int, d: int, k: int, *, reps: int = 5,
+                  batch: int = 512, waves: int = 8) -> Dict:
+    """Serving-quality monitoring overhead (ISSUE 14): monitoring-ON
+    vs monitoring-OFF serving throughput, interleaved per-rep — the
+    r15 telemetry-overhead discipline applied to the drift monitor.
+
+    One K-Means model is fitted at (n, d, k) and held resident in TWO
+    engines on ONE shared mesh (so the identity-keyed ``_cents_dev``
+    placement cache never thrashes between them): ``quality=True``
+    (the monitor fed per dispatch, windows closing mid-run) and
+    ``quality=False`` (the blind r11 engine).  Per rep one interleaved
+    pair runs ``waves`` direct ``call`` dispatches of ``batch`` rows
+    through each engine; the published overhead is the median of
+    per-rep on/off ratios.  Committed rule: <= 1.01 median overhead
+    keeps monitoring on for that platform's ``quality='auto'``
+    resolution; a breach resolves 'auto' to OFF there (the r8/r13
+    'auto' discipline — the rejection is published, the knob stays).
+    Outcome on the 2-core CPU proxy: BREACH (~1.1-1.2x — a 512-row
+    local dispatch costs under 1 ms, so the ~0.1 ms cold-cache numpy
+    feed is visible), hence 'auto' = off on CPU; accelerators keep ON
+    (a tunneled dispatch pays 70-100 ms RTT — the same feed is
+    < 0.2%), hardware row pinned.  Labels bit-equality on/off is
+    asserted IN-BENCH every run (the obs=0 parity contract)."""
+    import jax
+
+    from kmeans_tpu.models.kmeans import KMeans
+    from kmeans_tpu.parallel.mesh import make_mesh
+    from kmeans_tpu.serving import ServingEngine
+
+    rng = np.random.default_rng(42)
+    X = rng.uniform(-1.0, 1.0, size=(n, d)).astype(np.float32)
+    init = X[np.sort(rng.choice(n, size=k, replace=False))].copy()
+    km = KMeans(k=k, max_iter=5, seed=0, init=init,
+                empty_cluster="keep", verbose=False,
+                compute_sse=True).fit(X)
+    pool = rng.uniform(-1.0, 1.0, size=(max(batch, 4096), d)) \
+        .astype(np.float32)
+
+    mesh = make_mesh()
+    # ONE fitted model shared by both engines (neither mutates it; the
+    # per-engine state lives on the ResidentModel wrappers): a deepcopy
+    # twin would duplicate the retained training dataset — ~1 GB at
+    # the accelerator default shape — purely for registration.
+    eng_on = ServingEngine(mesh=mesh, quality=True, start=False)
+    eng_off = ServingEngine(mesh=mesh, quality=False, start=False)
+    eng_on.add_model("q", km)
+    eng_off.add_model("q", km)
+    eng_on.warmup()
+    eng_off.warmup()
+    _log(f"[quality] resident k={k} d={d}, batch={batch}, "
+         f"waves={waves}, window={eng_on._quality_window}, "
+         f"backend={jax.default_backend()}")
+
+    block = pool[:batch]
+    np.testing.assert_array_equal(eng_on.call("q", block),
+                                  eng_off.call("q", block))
+
+    n_blocks = max(1, pool.shape[0] // batch)
+
+    def wave(engine) -> float:
+        t0 = time.perf_counter()
+        for i in range(waves):
+            j = (i % n_blocks) * batch
+            engine.call("q", pool[j: j + batch])
+        return time.perf_counter() - t0
+
+    wave(eng_on)                            # burn-in pair
+    wave(eng_off)
+    ratios = []
+    for rep in range(reps):
+        t_on = wave(eng_on)
+        t_off = wave(eng_off)
+        ratios.append(t_on / t_off)
+        _log(f"[quality] rep {rep + 1}/{reps}: on {t_on * 1e3:.2f} ms, "
+             f"off {t_off * 1e3:.2f} ms ({ratios[-1]:.4f}x)")
+    overhead = float(np.median(ratios))
+    spread = (max(ratios) - min(ratios)) / overhead
+    status = eng_on.quality_status()["q"]
+    row = {
+        "metric": f"serving_quality_overhead_N{n}_D{d}_k{k}",
+        "overhead_ratio": round(overhead, 4),
+        "overhead_spread": round(spread, 3),
+        "indicative_only": bool(spread > 0.05),
+        "within_1pct_rule": bool(overhead <= 1.01),
+        "rule": "<=1.01 median on/off keeps quality='auto' ON for "
+                "this platform; breach resolves 'auto' to off there "
+                "(published either way)",
+        "batch": batch, "waves": waves, "reps": reps,
+        "windows_closed": status["windows"],
+        "drift_events": status["events"],
+        "labels_bitequal": True,            # asserted above
+        "platform": jax.default_backend(),
+        "n_devices": len(jax.devices()),
+    }
+    print(json.dumps(row), flush=True)
+    eng_on.close()
+    eng_off.close()
+    return row
 
 
 def bench_sweep(n: int, d: int, k_values, n_init: int,

@@ -261,6 +261,10 @@ def serve_main(argv=None) -> int:
       resident model ``"model"`` may be omitted.
     * ``{"stats": true}`` — reply with the engine stats snapshot
       (models resident, dispatches, batch-fill histogram).
+    * ``{"quality": true}`` — reply with the per-model drift-monitor
+      snapshot (ISSUE 14: detector readings, debounced state, event
+      counts; ``--quality-dir`` additionally streams the per-model
+      JSONL sinks ``serve-status`` reads).
 
     A malformed/poisoned request errors ITS line
     (``{"error": ...}``) and the loop keeps serving.  On EOF the
@@ -289,6 +293,18 @@ def serve_main(argv=None) -> int:
                         help="request-batch bucket ladder")
     parser.add_argument("--no-warmup", action="store_true",
                         help="skip pre-compiling the bucket shapes")
+    parser.add_argument("--quality-dir", default=None, metavar="DIR",
+                        help="write per-model drift JSONL sinks "
+                             "(quality.<id>.jsonl) here — the "
+                             "serve-status input; implies monitoring "
+                             "on")
+    parser.add_argument("--quality", action="store_true",
+                        help="force drift monitoring on (default "
+                             "'auto': on on accelerators, off on CPU "
+                             "— the measured BENCH_QUALITY rule)")
+    parser.add_argument("--no-quality", action="store_true",
+                        help="disable drift monitoring (the blind "
+                             "r11 engine)")
     parser.add_argument("--json", action="store_true",
                         help="print the final stats snapshot as JSON "
                              "on stdout")
@@ -301,8 +317,17 @@ def serve_main(argv=None) -> int:
               file=sys.stderr)
         return 2
     buckets = tuple(int(b) for b in args.buckets.split(","))
+    if args.quality and args.no_quality:
+        print("error: --quality and --no-quality are mutually "
+              "exclusive", file=sys.stderr)
+        return 2
     engine = ServingEngine(buckets=buckets,
-                           max_wait_ms=args.max_wait_ms)
+                           max_wait_ms=args.max_wait_ms,
+                           quality=(False if args.no_quality
+                                    else True if args.quality
+                                    else "auto"),
+                           quality_dir=(None if args.no_quality
+                                        else args.quality_dir))
     try:
         for i, path in enumerate(args.models):
             mid = ids[i] if i < len(ids) else None
@@ -331,6 +356,10 @@ def serve_main(argv=None) -> int:
                 req = json.loads(line)
                 if req.get("stats"):
                     print(json.dumps(engine.stats()), flush=True)
+                    continue
+                if req.get("quality"):
+                    print(json.dumps(engine.quality_status()),
+                          flush=True)
                     continue
                 model_id = req.get("model", default_model)
                 if model_id is None:
@@ -644,6 +673,233 @@ def cost_report_main(argv=None) -> int:
     print()
     print(format_plan_table(rep["plans"]))
     return 0
+
+
+def serve_status_main(argv=None) -> int:
+    """``python -m kmeans_tpu serve-status <dir-or-files> [--json]`` —
+    per-model serving-quality/drift table from the quality JSONL sinks
+    a monitored :class:`~kmeans_tpu.serving.ServingEngine` writes
+    (``quality.<model_id>.jsonl`` under ``quality_dir`` / the serve
+    CLI's ``--quality-dir``): the mirror of ``fleet-status`` for the
+    serving half (ISSUE 14), and the trigger signal ROADMAP item 4's
+    serve-and-learn loop consumes.
+
+    The report applies the monitor's COMMITTED thresholds + debounce
+    as recorded in the streams (``obs.drift``): a model is
+    ``DRIFTING`` when its newest record's debounced state says so —
+    PSI/JS assignment shift, rolling score-per-row ratio, or bf16
+    near-tie fraction held over threshold for the debounce window.
+    Trace/heartbeat files found alongside are skipped (``trace
+    summarize`` / ``fleet-status`` read those).
+
+    Exit 0: every monitored model healthy.  Exit 1: at least one model
+    drifting.  Exit 2: unreadable/malformed inputs or no quality
+    records."""
+    parser = argparse.ArgumentParser(
+        prog="python -m kmeans_tpu serve-status",
+        description="Per-model serving-quality & drift table from a "
+                    "monitored engine's quality JSONL sinks")
+    parser.add_argument("paths", nargs="+",
+                        help="quality JSONL file(s), directory, or "
+                             "glob")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable report on stdout")
+    args = parser.parse_args(argv)
+
+    from kmeans_tpu.obs import drift as obs_drift
+    from kmeans_tpu.obs.trace import TraceReadError
+    try:
+        report = obs_drift.quality_report(args.paths)
+    except TraceReadError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(obs_drift.format_quality_status(report))
+    return 0 if report["healthy"] else 1
+
+
+#: bench-diff metric directions: which numeric row fields are
+#: comparable, and which way is worse.  A field absent from both rows
+#: is skipped; spread-style/meta fields are never compared.
+_BENCH_LOWER_BETTER = ("ms_per_iter", "p50_ms", "p99_ms",
+                       "overhead_x", "overhead_ratio",
+                       "cpu_init_device_s", "batched_s", "resume_ms",
+                       "save_ms")
+_BENCH_HIGHER_BETTER = ("value", "pts_dims_per_s_chip", "qps",
+                        "speedup_vs_sequential", "overlap_speedup",
+                        "step_mfu")
+#: Regression allowance floor when a row recorded no spread (the
+#: repo's publication bar: rows are published at <= 5% spread).
+_BENCH_DEFAULT_SPREAD = 0.05
+
+
+#: Fields that tell apart rows sharing one config/model key (e.g. the
+#: per-batch-size serving rows) — tried in order before falling back
+#: to the occurrence index (append-only artifacts keep occurrence
+#: order stable, so old/new keys still align).
+_BENCH_DISCRIMINATORS = ("batch_requests", "batch", "clients")
+
+
+def _bench_rows(doc) -> dict:
+    """Comparable rows out of any bench artifact shape: BASELINE.json
+    (``published.rows`` + the northstar), a BENCH_r*.json wrapper
+    (``parsed``), a raw bench payload, or a LIST of rows (JSONL
+    artifacts parse to one).  Key = the row's ``metric`` else
+    ``config``+``model``; same-key groups disambiguate instead of
+    silently collapsing (review finding: 3 of the 4 serving rows were
+    invisible to the guard)."""
+    rows = []
+    if isinstance(doc, dict) and "published" in doc:
+        pub = doc["published"]
+        rows.extend(r for r in pub.get("rows", [])
+                    if isinstance(r, dict))
+        if isinstance(pub.get("northstar"), dict):
+            rows.append(pub["northstar"])
+    elif isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        rows.append(doc["parsed"])
+    elif isinstance(doc, list):
+        rows.extend(r for r in doc if isinstance(r, dict))
+    elif isinstance(doc, dict):
+        rows.append(doc)
+    groups: dict = {}
+    for r in rows:
+        key = r.get("metric") or r.get("config")
+        if key is None:
+            continue
+        if r.get("model"):
+            key = f"{key} [{r['model']}]"
+        groups.setdefault(str(key), []).append(r)
+    out = {}
+    for key, grp in groups.items():
+        if len(grp) == 1:
+            out[key] = grp[0]
+            continue
+        for i, r in enumerate(grp):
+            disc = next((f"{f}={r[f]}" for f in _BENCH_DISCRIMINATORS
+                         if f in r), f"#{i + 1}")
+            sub = f"{key} ({disc})"
+            if sub in out:
+                # Colliding discriminator values (e.g. an appended
+                # re-measure of one batch size) still keep every row
+                # comparable via the occurrence index.
+                sub = f"{key} ({disc} #{i + 1})"
+            out[sub] = r
+    return out
+
+
+def _row_spread(row: dict) -> float:
+    """The largest noise figure a row RECORDED, whatever it called it:
+    rows across rounds spell it ``spread``, ``overhead_spread``,
+    ``speedup_spread``, ... — reading only ``spread`` would apply the
+    5% floor to e.g. the BENCH_QUALITY row whose measured noise is
+    19.6% under ``overhead_spread`` (review finding)."""
+    vals = [v for k, v in row.items()
+            if (k == "spread" or k.endswith("_spread"))
+            and isinstance(v, (int, float)) and not isinstance(v, bool)]
+    return max(vals, default=0.0)
+
+
+def _bench_compare(old: dict, new: dict) -> dict:
+    """One row pair -> list of per-field comparisons with the
+    regression rule applied: the change in the WORSE direction must
+    exceed the pair's recorded spread (max of both sides, floored at
+    the 5% publication bar) to flag — the repo's own noise model, so a
+    re-measure inside its error bars never pages anyone."""
+    allow = max(_row_spread(old), _row_spread(new),
+                _BENCH_DEFAULT_SPREAD)
+    comps = []
+    for field, lower_better in (
+            [(f, True) for f in _BENCH_LOWER_BETTER]
+            + [(f, False) for f in _BENCH_HIGHER_BETTER]):
+        a, b = old.get(field), new.get(field)
+        if not isinstance(a, (int, float)) \
+                or not isinstance(b, (int, float)) \
+                or isinstance(a, bool) or isinstance(b, bool) or a == 0:
+            continue
+        ratio = b / a
+        worse = (ratio - 1.0) if lower_better else (1.0 - ratio)
+        comps.append({"field": field, "old": a, "new": b,
+                      "ratio": round(ratio, 4),
+                      "allowed": round(allow, 4),
+                      "regressed": bool(worse > allow)})
+    return {"allow": allow, "fields": comps,
+            "regressed": [c["field"] for c in comps if c["regressed"]]}
+
+
+def bench_diff_main(argv=None) -> int:
+    """``python -m kmeans_tpu bench-diff <old.json> <new.json>`` —
+    compare two bench artifacts (BASELINE.json, BENCH_r*.json, or raw
+    bench JSON lines) row by row and flag ratio regressions beyond
+    each row's RECORDED spread (floored at the 5% publication bar) —
+    the CI-runnable guard the bench trajectory lacked (ISSUE 14
+    satellite).
+
+    Rows are matched by ``metric``/``config`` key; rows present on
+    only one side are reported informationally, never flagged.  Exit
+    0: no regression.  Exit 1: at least one row regressed.  Exit 2:
+    unreadable inputs or no common rows."""
+    parser = argparse.ArgumentParser(
+        prog="python -m kmeans_tpu bench-diff",
+        description="Flag bench-row regressions beyond each row's "
+                    "recorded spread between two bench JSON artifacts")
+    parser.add_argument("old", help="baseline artifact (e.g. "
+                                    "BASELINE.json, BENCH_r04.json)")
+    parser.add_argument("new", help="candidate artifact")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable diff on stdout")
+    args = parser.parse_args(argv)
+
+    docs = []
+    for path in (args.old, args.new):
+        try:
+            with open(path) as f:
+                text = f.read()
+            try:
+                docs.append(json.loads(text))
+            except ValueError:
+                # JSONL fallback (review finding): the serving/obs
+                # bench modes emit one JSON row PER LINE — parse to a
+                # row list; a line that still fails is a real error.
+                docs.append([json.loads(line)
+                             for line in text.splitlines()
+                             if line.strip()])
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read bench artifact {path}: {e}",
+                  file=sys.stderr)
+            return 2
+    rows_old, rows_new = _bench_rows(docs[0]), _bench_rows(docs[1])
+    common = sorted(set(rows_old) & set(rows_new))
+    if not common:
+        print(f"error: no common bench rows between {args.old} "
+              f"({len(rows_old)} rows) and {args.new} "
+              f"({len(rows_new)} rows)", file=sys.stderr)
+        return 2
+    diff = {key: _bench_compare(rows_old[key], rows_new[key])
+            for key in common}
+    regressed = sorted(k for k, d in diff.items() if d["regressed"])
+    result = {"old": args.old, "new": args.new,
+              "rows_compared": len(common),
+              "only_old": sorted(set(rows_old) - set(rows_new)),
+              "only_new": sorted(set(rows_new) - set(rows_old)),
+              "rows": diff, "regressed": regressed,
+              "ok": not regressed}
+    if args.json:
+        print(json.dumps(result, indent=2))
+    else:
+        print(f"bench-diff: {len(common)} rows compared "
+              f"({len(result['only_old'])} only-old, "
+              f"{len(result['only_new'])} only-new) — "
+              f"{'OK' if not regressed else 'REGRESSED: ' + str(regressed)}")
+        for key in common:
+            for c in diff[key]["fields"]:
+                flag = " <-- REGRESSION" if c["regressed"] else ""
+                print(f"  {key[:44]:<44} {c['field']:<22} "
+                      f"{c['old']:>12.4g} -> {c['new']:>12.4g} "
+                      f"(x{c['ratio']:.3f}, allowed "
+                      f"±{c['allowed']:.0%}){flag}")
+    return 1 if regressed else 0
 
 
 def lint_main(argv=None) -> int:

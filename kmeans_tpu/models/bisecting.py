@@ -31,7 +31,7 @@ import numpy as np
 
 from kmeans_tpu.models.kmeans import KMeans, _get_step_fns
 from kmeans_tpu.parallel.multihost import fleet_barrier
-from kmeans_tpu.obs.heartbeat import note_progress as obs_note_progress
+from kmeans_tpu.obs import note_progress as obs_note_progress
 from kmeans_tpu.utils.logging import IterationLogger
 
 _STRATEGIES = ("biggest_sse", "largest_cluster")

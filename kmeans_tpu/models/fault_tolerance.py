@@ -51,7 +51,7 @@ import os
 from kmeans_tpu.obs import memory as obs_memory
 from kmeans_tpu.obs import metrics_registry as obs_metrics
 from kmeans_tpu.obs import trace as obs_trace
-from kmeans_tpu.obs.heartbeat import note_progress as obs_note_progress
+from kmeans_tpu.obs import note_progress as obs_note_progress
 from kmeans_tpu.utils import checkpoint as ckpt
 from kmeans_tpu.utils import faults
 

@@ -80,7 +80,7 @@ from kmeans_tpu.parallel.sharding import (ShardedDataset, choose_chunk_size,
 from kmeans_tpu.models.fault_tolerance import AutoCheckpointMixin
 from kmeans_tpu.parallel.multihost import fleet_barrier
 from kmeans_tpu.obs import trace as obs_trace
-from kmeans_tpu.obs.heartbeat import note_progress as obs_note_progress
+from kmeans_tpu.obs import note_progress as obs_note_progress
 from kmeans_tpu.utils.validation import check_finite_array
 
 from kmeans_tpu.utils.cache import LRUCache
@@ -263,6 +263,10 @@ class GaussianMixture(AutoCheckpointMixin):
         self.shift_: Optional[np.ndarray] = None
         self.best_restart_: int = 0
         self.restart_lower_bounds_: Optional[np.ndarray] = None
+        # Serving-quality reference profile restored from a checkpoint
+        # (ISSUE 14); ``quality_profile()`` prefers fresh fitted attrs
+        # (weights_/lower_bound_) when they exist.
+        self._quality_profile: Optional[dict] = None
         # Fault-tolerance observability (ISSUE 4), mirroring KMeans'.
         self.io_retries_used_: int = 0
         self.blocks_skipped_: int = 0
@@ -1921,6 +1925,39 @@ class GaussianMixture(AutoCheckpointMixin):
             "ops": ("predict", "predict_proba", "score_samples"),
         }
 
+    def quality_profile(self, X=None) -> Optional[dict]:
+        """Fit-time serving-quality reference profile (ISSUE 14), the
+        mixture-family analogue of ``KMeans.quality_profile``: the
+        assignment histogram is the fitted mixing weights (the
+        responsibility mass each component holds over the training
+        data — what a hard-label serving histogram approximates), and
+        the score reference is the per-row NEGATIVE log-likelihood
+        (``-lower_bound_``; the ratio detector deactivates itself when
+        the reference is non-positive, i.e. when the density exceeds 1
+        nat — documented in ``obs.drift``).  With ``X``, both are
+        recomputed against that data (one posterior pass)."""
+        from kmeans_tpu.obs import drift as obs_drift
+        if X is not None:
+            self._check_fitted()
+            labels, _, lse = self._posterior(X)
+            return obs_drift.build_profile(
+                family="gmm", model_class=type(self).__name__,
+                k=self.n_components,
+                counts=np.bincount(np.asarray(labels),
+                                   minlength=self.n_components),
+                score_kind="neg_log_lik",
+                score_per_row=float(-np.mean(lse)),
+                n_rows=float(np.asarray(labels).size))
+        if self.weights_ is not None:
+            return obs_drift.build_profile(
+                family="gmm", model_class=type(self).__name__,
+                k=self.n_components, counts=self.weights_,
+                score_kind="neg_log_lik",
+                score_per_row=(float(-self.lower_bound_)
+                               if np.isfinite(self.lower_bound_)
+                               else None))
+        return self._quality_profile
+
     def fit_predict(self, X, y=None, *, sample_weight=None) -> np.ndarray:
         """Fit and return component labels for X (sklearn convention:
         ``y`` is ignored).  X is placed on device ONCE and shared by the
@@ -2097,6 +2134,8 @@ class GaussianMixture(AutoCheckpointMixin):
         # Topology metadata block (ISSUE 5): informational — the state
         # below is canonical/unsharded, so resume works on any mesh.
         state.update(self._ckpt_meta())
+        # Serving-quality reference profile (ISSUE 14, JSON meta block).
+        state["quality_profile"] = self.quality_profile()
         # Explicit init arrays are CONFIG, not fitted state: a loaded
         # model that is re-fit must seed exactly like the original.
         for name in ("weights_init", "means_init", "precisions_init"):
@@ -2155,6 +2194,8 @@ class GaussianMixture(AutoCheckpointMixin):
             self.restart_lower_bounds_ = (
                 np.asarray(rlb, np.float64)
                 if rlb is not None and rlb.size else None)
+        # Pre-r18 checkpoints carry no profile -> None.
+        self._quality_profile = state.get("quality_profile")
         # Clear-then-restore: a stale in-memory carry from an earlier
         # fit must never shadow the checkpoint.
         self._dev_tables = None

@@ -56,7 +56,7 @@ from kmeans_tpu.parallel.sharding import (ShardedDataset, choose_chunk_size,
 from kmeans_tpu.models.init import resolve_init
 from kmeans_tpu.models.fault_tolerance import AutoCheckpointMixin
 from kmeans_tpu.obs import trace as obs_trace
-from kmeans_tpu.obs.heartbeat import note_progress as obs_note_progress
+from kmeans_tpu.obs import note_progress as obs_note_progress
 from kmeans_tpu.utils.logging import IterationLogger
 from kmeans_tpu.utils.validation import check_finite_array, validate_params
 from kmeans_tpu.utils import checkpoint as ckpt
@@ -370,6 +370,12 @@ class KMeans(AutoCheckpointMixin):
         self._cents_cache = None
         self.sse_history: List[float] = []            # kmeans_spark.py:45
         self.cluster_sizes_: Optional[np.ndarray] = None
+        # Serving-quality reference profile restored from a checkpoint
+        # (ISSUE 14); ``quality_profile()`` prefers the FRESH fitted
+        # attrs when they exist (a loaded checkpoint has no
+        # cluster_sizes_, which is exactly when this fallback carries
+        # the fit-time reference window into the serving registry).
+        self._quality_profile: Optional[dict] = None
         self.iter_times_: List[float] = []            # wall secs/iteration
         # Restart-sweep observability: winning restart index and the
         # per-restart final inertias — declared here (the counter-reset
@@ -567,6 +573,82 @@ class KMeans(AutoCheckpointMixin):
             "normalize_inputs": False,
             "ops": ("predict", "transform", "score_rows"),
         }
+
+    def _profile_counts(self) -> Optional[np.ndarray]:
+        """Training assignment mass per cluster for the quality
+        profile's HISTOGRAM — the weighted cluster sizes the fit
+        already materialized (MiniBatch overrides with its lifetime
+        per-center counts)."""
+        return self.cluster_sizes_
+
+    def _profile_rows(self) -> Optional[float]:
+        """Weighted row count behind ``inertia_`` — the score-per-row
+        denominator and the profile's ``n_rows``.  Deliberately NOT
+        ``sum(_profile_counts())``: MiniBatch's histogram mass is its
+        lifetime ``_seen`` counts, whose total is rows PROCESSED
+        (passes x batch) — dividing the full-dataset-scaled inertia
+        estimate by that would deflate the drift reference by the
+        number of passes (review finding: a healthy multi-pass
+        MiniBatch model would read as permanently drifting)."""
+        if self.cluster_sizes_ is None:
+            return None
+        total = float(np.asarray(self.cluster_sizes_, np.float64).sum())
+        return total if total > 0 else None
+
+    def _quality_rows(self, X) -> np.ndarray:
+        """Rows in the geometry ``quality_profile(X=...)`` scores
+        distances in (SphericalKMeans overrides with its row
+        normalization, so the chordal-distance convention matches
+        serving ``score_rows``)."""
+        return np.asarray(X, np.float64)
+
+    def quality_profile(self, X=None) -> Optional[dict]:
+        """Fit-time serving-quality reference profile (ISSUE 14): the
+        training assignment histogram, the training score-per-row
+        (inertia/row — what the drift monitor's rolling serving SSE is
+        compared against), and per-cluster SSE stats where the fit
+        computed them (BisectingKMeans' ``cluster_sse_``).
+
+        Sources, in order: an explicit ``X`` computes the profile
+        against that data host-side (one ``predict`` pass + numpy
+        distances — the reference-window override for a model whose
+        training stats were lost); the fitted attrs (fresh after every
+        ``fit``); the profile restored from checkpoint metadata (a
+        loaded model carries its own reference window — the r10 meta
+        block).  Returns None when none is available (e.g. a mid-fit
+        segment checkpoint before sizes exist) — serving then runs the
+        reference-free detector subset."""
+        from kmeans_tpu.obs import drift as obs_drift
+        if X is not None:
+            if self.centroids is None:
+                raise ValueError("Model must be fitted before building "
+                                 "a quality profile from data")
+            rows = self._quality_rows(X)
+            labels = np.asarray(self.predict(X))
+            cents = np.asarray(self.centroids, np.float64)
+            d2 = np.sum((rows - cents[labels]) ** 2, axis=1)
+            per_cluster = np.zeros(self.k, np.float64)
+            np.add.at(per_cluster, labels, d2)
+            return obs_drift.build_profile(
+                family="kmeans", model_class=type(self).__name__,
+                k=self.k,
+                counts=np.bincount(labels, minlength=self.k),
+                score_kind="sse", score_per_row=float(d2.mean()),
+                per_cluster_sse=per_cluster,
+                n_rows=float(labels.size))
+        counts = self._profile_counts()
+        if self.centroids is not None and counts is not None:
+            inertia = self.inertia_
+            rows = self._profile_rows()
+            return obs_drift.build_profile(
+                family="kmeans", model_class=type(self).__name__,
+                k=self.k, counts=counts, score_kind="sse",
+                score_per_row=(inertia / rows
+                               if inertia is not None and rows
+                               else None),
+                per_cluster_sse=getattr(self, "cluster_sse_", None),
+                n_rows=rows)
+        return self._quality_profile
 
     # ------------------------------------------------------------------- fit
 
@@ -2353,6 +2435,12 @@ class KMeans(AutoCheckpointMixin):
         # informational (state itself is canonical/unsharded; resume
         # re-shards it for whatever topology the resuming model has).
         state.update(self._ckpt_meta())
+        # Serving-quality reference profile (ISSUE 14): rides the JSON
+        # meta block, so a model loaded into the serving registry
+        # carries its own reference window (None on mid-fit segment
+        # checkpoints that have no sizes yet — re-stamped complete at
+        # the final save).
+        state["quality_profile"] = self.quality_profile()
         if isinstance(self.init, str):
             state["init"] = self.init
         elif not callable(self.init):
@@ -2364,6 +2452,9 @@ class KMeans(AutoCheckpointMixin):
         self.centroids = cents if cents.size else None
         self.sse_history = list(state["sse_history"])
         self.iterations_run = int(state["iterations_run"])
+        # Pre-r18 checkpoints carry no profile -> None (reference-free
+        # monitoring); npz meta JSON round-trips the dict as-is.
+        self._quality_profile = state.get("quality_profile")
 
     def save(self, path) -> None:
         """Checkpoint fitted state (beyond-reference; SURVEY.md §5).

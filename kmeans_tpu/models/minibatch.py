@@ -56,7 +56,7 @@ from kmeans_tpu.models.kmeans import KMeans, _STEP_CACHE
 from kmeans_tpu.parallel.multihost import fleet_barrier
 from kmeans_tpu.models.init import resolve_init
 from kmeans_tpu.obs import trace as obs_trace
-from kmeans_tpu.obs.heartbeat import note_progress as obs_note_progress
+from kmeans_tpu.obs import note_progress as obs_note_progress
 from kmeans_tpu.utils.logging import IterationLogger
 
 _SAMPLING = ("device", "host")
@@ -90,6 +90,13 @@ class MiniBatchKMeans(KMeans):
         # partial_fit-trained models must not raise on these reads.
         self.init_inertias_ = None
         self.best_init_ = 0
+        # Total dataset weight of the last fit() (ISSUE 14): the
+        # quality-profile score-per-row denominator — ``inertia_`` is
+        # the TOTAL-WEIGHT-scaled SSE estimate while ``cluster_sizes_``
+        # holds only the last batch's counts, so neither fitted attr
+        # can stand in for it.  None under partial_fit (batch-scale
+        # inertia there divides by the batch counts).
+        self._profile_total_w = None
 
     def _auto_n_init(self) -> int:
         """sklearn resolves MiniBatchKMeans ``n_init='auto'`` to 3 (not
@@ -356,6 +363,7 @@ class MiniBatchKMeans(KMeans):
         # Scale factor target: total dataset weight (== n when unweighted).
         total_w = float(np.asarray(
             jax.jit(lambda w: w.sum())(ds.weights)))
+        self._profile_total_w = total_w       # quality-profile denominator
 
         for iteration in range(start_iter, self.max_iter):
             t0 = time.perf_counter()
@@ -551,6 +559,7 @@ class MiniBatchKMeans(KMeans):
             hw = _validate_sample_weight(sample_weight, n, self.dtype)
         bs = min(self.batch_size, n)
         total_w = float(hw.sum()) if hw is not None else float(n)
+        self._profile_total_w = total_w   # quality-profile denominator
         self._progress_rows = bs          # fleet prelude (ISSUE 13)
         fleet_barrier("fit-start")
         self._set_fit_data(X)                         # feeds lazy labels_
@@ -714,6 +723,10 @@ class MiniBatchKMeans(KMeans):
         # r10).
         self._active_ckpt_path = None
         self._ckpt_written_this_fit = False
+        # partial_fit's SSE estimate is BATCH-scale (sse_scale=1), so
+        # the quality-profile denominator falls back to the batch
+        # counts — a stale full-fit total would inflate the reference.
+        self._profile_total_w = None
         X = np.ascontiguousarray(np.asarray(X, dtype=self.dtype))
         if X.ndim != 2:
             raise ValueError(f"X must be 2-D (n, D), got shape {X.shape}")
@@ -751,8 +764,36 @@ class MiniBatchKMeans(KMeans):
             "through partial_fit, or use KMeans.fit_stream for an exact "
             "out-of-core fit")
 
+    def _profile_counts(self):
+        """Quality-profile assignment mass (ISSUE 14): the LIFETIME
+        per-center counts (``_seen``) rather than the last batch's
+        ``cluster_sizes_`` — a 4096-row batch histogram is too noisy
+        to be the drift reference, while the Sculley lifetime counts
+        are exactly the training mass the centers converged under."""
+        seen = getattr(self, "_seen", None)
+        if seen is not None and float(np.sum(seen)) > 0:
+            return np.asarray(seen, np.float64)
+        return self.cluster_sizes_
+
+    def _profile_rows(self):
+        """Score-per-row denominator (ISSUE 14, review finding):
+        ``inertia_`` here is the TOTAL-WEIGHT-scaled SSE estimate, so
+        the denominator is the dataset weight recorded at fit time —
+        NOT the lifetime ``_seen`` total (rows processed = passes x
+        batch; dividing by it deflates the reference by the pass
+        count) and NOT ``cluster_sizes_`` (one batch).  partial_fit
+        leaves it None: its estimate is batch-scale, and the base rule
+        (the last batch's counts) is then the matching denominator."""
+        if self._profile_total_w:
+            return float(self._profile_total_w)
+        return super()._profile_rows()
+
     def _state_dict(self) -> dict:
         state = super()._state_dict()
+        # The denominator must round-trip (ISSUE 14): ``_seen`` does,
+        # so a LOADED model re-derives the same profile from attrs —
+        # without this its score reference would silently vanish.
+        state["profile_total_w"] = self._profile_total_w
         state["batch_size"] = self.batch_size
         state["sampling"] = self.sampling
         state["reassignment_ratio"] = self.reassignment_ratio
@@ -768,6 +809,8 @@ class MiniBatchKMeans(KMeans):
 
     def _restore_state(self, state: dict) -> None:
         super()._restore_state(state)
+        ptw = state.get("profile_total_w")
+        self._profile_total_w = float(ptw) if ptw is not None else None
         self._seen = np.asarray(state["seen_counts"])
         carried = state.get("centroids_f64")
         # Explicitly clear on pre-carry checkpoints: a stale in-memory

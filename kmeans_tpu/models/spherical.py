@@ -121,6 +121,13 @@ class SphericalKMeans(KMeans):
     # routed back to the host loop (kmeans._resolve_host_loop).
     _postprocess_centroids._device_equivalent = "sphere"
 
+    def _quality_rows(self, X) -> "np.ndarray":
+        """Quality-profile geometry (ISSUE 14): rows L2-normalize in
+        float64 before distancing, so ``quality_profile(X=...)`` scores
+        the same chordal ``2 - 2*cos`` distances serving ``score_rows``
+        computes (centroids are unit vectors)."""
+        return _normalize_rows(np.asarray(X, np.float64))
+
     def fitted_state(self) -> dict:
         """Serving handle (ISSUE 6): same table shape/stacking as the
         base class, but requests must be row-normalized before

@@ -23,6 +23,14 @@ Four parts, one discipline:
   analytic collective-comms accounting cross-checked against the
   compiled HLO, and the straggler report behind
   ``python -m kmeans_tpu fleet-status``.
+* :mod:`kmeans_tpu.obs.drift` — serving-quality & drift observability
+  (ISSUE 14): PSI/JS assignment-distribution detectors, rolling
+  score-per-row ratio vs the fit-time reference profile, bf16-guard
+  margin shift — committed thresholds + debounce, per-model JSONL
+  sinks, and the report behind ``python -m kmeans_tpu serve-status``.
+  The one obs module that imports numpy (array detectors), so it
+  loads LAZILY — ``obs.drift`` / ``from kmeans_tpu.obs import drift``
+  both work, and the package itself stays stdlib at import.
 
 Telemetry is OFF by default and the disabled path is a true no-op
 (one None check); ``obs=0`` is the bit-exact parity oracle, pinned for
@@ -43,16 +51,21 @@ which emits the compile spans and the cost-capture hook — can import
 them without cost or cycles; the report helpers (which pull
 ``utils.profiling``) load lazily.
 
-NAMESPACE GOTCHA, resolved deliberately: re-exporting the
-``heartbeat`` SCOPE FUNCTION shadows the ``kmeans_tpu.obs.heartbeat``
-submodule as a package attribute — ``obs.heartbeat`` IS the callable
-(the documented scope-manager surface), while the module stays
-importable as ``from kmeans_tpu.obs.heartbeat import note_progress``
-(resolved via sys.modules, immune to the shadowing).  The submodule's
-public names — ``note_progress``, ``Heartbeat``, ``get_heartbeat`` —
-are therefore ALSO re-exported at package level below, so no consumer
-needs to reach through the shadowed attribute;
-tests/test_obs.py pins both routes.
+NAMESPACE GOTCHA, resolved deliberately (r15 wart, closed r18):
+re-exporting the ``heartbeat`` SCOPE FUNCTION shadows the
+``kmeans_tpu.obs.heartbeat`` submodule as a package attribute —
+``obs.heartbeat`` IS the callable (the documented scope-manager
+surface), while the module stays importable as ``from
+kmeans_tpu.obs.heartbeat import note_progress`` (resolved via
+sys.modules, immune to the shadowing).  The submodule's public names
+— ``note_progress``, ``Heartbeat``, ``get_heartbeat`` — are therefore
+ALSO re-exported at package level below, and since r18 that is the
+SUPPORTED consumer spelling: every in-repo consumer imports them from
+``kmeans_tpu.obs`` (the models' fit boundaries included), so nothing
+reaches through the shadowed attribute anymore.  Back-compat for both
+routes — the package-level names, the submodule path, and the
+callable-shadows-module behavior — is pinned by
+tests/test_quality.py::test_obs_heartbeat_namespace_backcompat.
 """
 
 from kmeans_tpu.obs import cost, fleet, identity, memory
@@ -74,7 +87,7 @@ __all__ = [
     "get_tracer", "read_jsonl", "span", "summarize", "tracing",
     "REGISTRY", "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "registry", "Heartbeat", "get_heartbeat", "heartbeat",
-    "note_progress", "cost", "memory", "fleet", "identity",
+    "note_progress", "cost", "memory", "fleet", "identity", "drift",
     # lazy (pull utils.profiling, which imports jax):
     "ttfi_ladder", "time_to_first_iteration", "format_phase_table",
     "merge_cost", "format_cost_table",
@@ -89,4 +102,12 @@ def __getattr__(name):
     if name in _LAZY_REPORT:
         from kmeans_tpu.obs import report
         return getattr(report, name)
+    if name == "drift":
+        # Lazy: drift is the one obs module that imports numpy (see
+        # the docstring); loading it here instead of eagerly keeps the
+        # package stdlib at import for utils.cache and the linter.
+        # importlib (not `from ... import`): the from-form re-enters
+        # this __getattr__ before the submodule import runs.
+        import importlib
+        return importlib.import_module("kmeans_tpu.obs.drift")
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -47,13 +47,16 @@ pins it across 1/2/4/8-way virtual meshes.
 
 from __future__ import annotations
 
+import os
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
 from kmeans_tpu.models import kmeans as kmeans_mod
+from kmeans_tpu.obs import drift as obs_drift
 from kmeans_tpu.parallel import distributed as dist
 from kmeans_tpu.parallel.mesh import make_mesh, mesh_shape
 from kmeans_tpu.parallel.sharding import choose_chunk_size, shard_points
@@ -120,6 +123,14 @@ class ResidentModel:
         self.model = model
         self.spec = spec
         self.quantize = quantize
+        # Per-model drift monitor (ISSUE 14); None when the engine runs
+        # with quality monitoring off.  Fed exclusively with outputs
+        # the dispatch already computed (engine._observe_quality).
+        self.monitor: Optional[obs_drift.QualityMonitor] = None
+        # bucket -> registry Histogram for request latency; resolved
+        # once per (model, bucket) so the per-dispatch feed skips the
+        # name build + registry lock (hot-path cost, BENCH_QUALITY).
+        self._lat_hists: Dict[int, object] = {}
         self.requests = 0
         self.rows = 0
         self.dispatches = 0
@@ -167,11 +178,31 @@ class ServingEngine:
         Donate the per-dispatch staging buffer to the assignment
         program.  'auto' = on accelerators only (CPU ignores donation
         and would warn).
+    quality : 'auto' | bool
+        Per-model drift monitoring (ISSUE 14): every dispatch path
+        feeds its ALREADY-COMPUTED labels/distances into a
+        :class:`~kmeans_tpu.obs.drift.QualityMonitor` — zero extra
+        dispatches, labels bit-exact with monitoring off (the obs=0
+        parity contract, pinned by tests/test_quality.py) — and
+        per-(model, bucket) latency histograms land in the metrics
+        registry.  'auto' (default) resolves ON on accelerators —
+        where a dispatch pays the ~70-100 ms tunneled RTT and the
+        host-side feed is < 0.2% — and OFF on CPU, where the
+        BENCH_QUALITY row MEASURED the per-dispatch feed breaching
+        the committed <= 1.01 overhead rule against sub-ms local
+        dispatches (the r8/r13 'auto'-resolution discipline: the
+        measured rejection is published, the knob stays).
+    quality_dir : directory for per-model drift JSONL sinks
+        (``quality.<model_id>.jsonl`` — the ``serve-status`` input);
+        None (default) keeps monitoring in-memory only.
+    quality_window : rows per drift-evaluation window
+        (:data:`~kmeans_tpu.obs.drift.DRIFT_WINDOW_ROWS` default).
     """
 
     def __init__(self, *, mesh=None, buckets=DEFAULT_BUCKETS,
                  max_wait_ms: float = 2.0, clock=None, start: bool = True,
-                 donate="auto"):
+                 donate="auto", quality="auto", quality_dir=None,
+                 quality_window: Optional[int] = None):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.buckets = check_buckets(buckets)
         self.registry = ModelRegistry()
@@ -191,6 +222,20 @@ class ServingEngine:
         self._tls = threading.local()
         # Bucket-fill histogram: bucket -> [dispatches, real rows].
         self._fill: Dict[int, List[int]] = {}
+        if quality not in ("auto", True, False):
+            raise ValueError(f"quality must be 'auto', True or False, "
+                             f"got {quality!r}")
+        if quality == "auto":
+            # Writing quality sinks is asking for monitoring: a
+            # --quality-dir serve on CPU must not silently produce
+            # empty files because 'auto' resolved off.
+            quality = quality_dir is not None \
+                or jax.default_backend() not in ("cpu",)
+        self._quality = bool(quality)
+        self._quality_dir = str(quality_dir) if quality_dir is not None \
+            else None
+        self._quality_window = int(quality_window) \
+            if quality_window is not None else obs_drift.DRIFT_WINDOW_ROWS
         self.dispatches = 0
         self.packed_dispatches = 0
         self.queue = MicroBatchQueue(
@@ -201,10 +246,17 @@ class ServingEngine:
     # -------------------------------------------------------- residency
 
     def add_model(self, model_id: str, model, *,
-                  quantize: Optional[str] = None) -> ResidentModel:
+                  quantize: Optional[str] = None,
+                  profile: Optional[dict] = None) -> ResidentModel:
         """Make a FITTED model resident.  ``quantize='bf16'`` serves
         its assignment through the bf16 cross-term fast path (labels
-        pinned against the f32 path by ``verify_quantized``)."""
+        pinned against the f32 path by ``verify_quantized``).
+
+        ``profile`` overrides the drift-monitor reference window
+        (ISSUE 14); by default the model's own ``quality_profile()`` —
+        fresh fitted stats or the checkpoint-restored block — is used.
+        A model with neither serves with the reference-free detector
+        subset (bf16 margin shift + latency histograms only)."""
         if quantize not in (None, "bf16"):
             raise ValueError(f"quantize must be None or 'bf16', got "
                              f"{quantize!r}")
@@ -220,13 +272,25 @@ class ServingEngine:
         if spec["family"] == "gmm":
             quantize = None       # bf16 assign is a K-Means-family path
         rm = ResidentModel(model_id, model, spec, quantize)
+        if self._quality:
+            if profile is None:
+                qp = getattr(model, "quality_profile", None)
+                profile = qp() if callable(qp) else None
+            sink = os.path.join(self._quality_dir,
+                                f"quality.{model_id}.jsonl") \
+                if self._quality_dir is not None else None
+            rm.monitor = obs_drift.QualityMonitor(
+                model_id, spec["k"], profile=profile,
+                window_rows=self._quality_window, sink_path=sink)
         self._residents[model_id] = rm
         return rm
 
     def load(self, path, model_id: Optional[str] = None, *,
              quantize: Optional[str] = None) -> str:
         """Load a topology-portable checkpoint (any family, any mesh it
-        was written on — r10) and make it resident."""
+        was written on — r10) and make it resident.  The checkpoint's
+        quality-profile metadata block (ISSUE 14) becomes the drift
+        monitor's reference window."""
         mid, model = self.registry.load(path, model_id)
         # registry.load registered it; wrap without re-registering.
         self.registry.remove(mid)
@@ -235,7 +299,9 @@ class ServingEngine:
 
     def remove(self, model_id: str) -> None:
         self.registry.remove(model_id)
-        del self._residents[model_id]
+        rm = self._residents.pop(model_id)
+        if rm.monitor is not None:
+            rm.monitor.close()
         with self._lock:
             self._pack_cache = {ids: v for ids, v in
                                 self._pack_cache.items()
@@ -299,6 +365,29 @@ class ServingEngine:
         reg.counter("serve.dispatches").inc()
         reg.counter("serve.requests").inc(n_requests)
         reg.counter("serve.rows").inc(m)
+
+    def _observe_quality(self, rm: ResidentModel, bucket: int,
+                         dt_s: Optional[float], *, rows: int = 0,
+                         labels=None, score=None, near_ties: int = 0,
+                         guarded_rows: int = 0) -> None:
+        """Feed one dispatch's ALREADY-COMPUTED outputs into the
+        model's drift monitor + the per-(model, bucket) latency
+        histogram (ISSUE 14).  Host-side reads only — never an extra
+        dispatch, never a write into the result arrays, skipped for
+        warmup probes — so monitoring on/off is label-bit-exact and
+        dispatch-count-identical by construction."""
+        if rm.monitor is None or getattr(self._tls, "warming", False):
+            return
+        if dt_s is not None:
+            hist = rm._lat_hists.get(bucket)
+            if hist is None:
+                hist = obs_metrics.REGISTRY.histogram(
+                    f"serve.latency_ms.{rm.model_id}.b{bucket}")
+                rm._lat_hists[bucket] = hist
+            hist.observe(dt_s * 1e3)
+        rm.monitor.observe(rows, labels=labels, score=score,
+                           near_ties=near_ties,
+                           guarded_rows=guarded_rows)
 
     def _kmeans_modes(self, rm: ResidentModel, B: int) -> Tuple[str, str]:
         """(assign mode, transform mode) for a bucket-B dispatch —
@@ -365,6 +454,9 @@ class ServingEngine:
         mode, tmode = self._kmeans_modes(rm, B)
         chunk = self._serve_chunk(rm, B)
         data_shards, model_shards = mesh_shape(self.mesh)
+        corrected = 0
+        guarded = 0
+        t0 = time.perf_counter()
         # 'serve.request' span (ISSUE 11): one coalesced serving
         # dispatch — covers staging + the compiled call + the result
         # transfer (np.asarray is the sync point).
@@ -376,6 +468,7 @@ class ServingEngine:
                 if rm.quantize == "bf16":
                     out, corrected = self._assign_bf16_guarded(
                         rm, buf, pts, cents_dev, chunk, m)
+                    guarded = m
                     if corrected and not getattr(self._tls, "warming",
                                                  False):
                         with self._lock:
@@ -404,6 +497,16 @@ class ServingEngine:
             else:                           # unreachable past _validate
                 raise ValueError(f"unknown op {op!r}")
         self._record(rm, B, m)
+        # Quality feed (ISSUE 14): exactly what THIS dispatch already
+        # computed — labels for predict (plus the bf16 guard's
+        # correction count), per-row nearest squared distance for
+        # score_rows; transform feeds rows only (deriving a min over k
+        # columns would be new host work the overhead rule forbids).
+        self._observe_quality(
+            rm, B, time.perf_counter() - t0, rows=m,
+            labels=out if op == "predict" else None,
+            score=out if op == "score_rows" else None,
+            near_ties=corrected, guarded_rows=guarded)
         return out
 
     def _assign_bf16_guarded(self, rm: ResidentModel, buf: np.ndarray,
@@ -458,10 +561,17 @@ class ServingEngine:
         ISSUE-6 ``_params_dev`` cache makes it warm (tables placed
         once, compiled pass reused per bucket shape)."""
         buf, m, B = self._stage(rm, rows)
+        t0 = time.perf_counter()
         with obs_trace.span("serve.request", model=rm.model_id, op=op,
                             rows=m, bucket=B):
             labels, logr, lse = rm.model._posterior(buf)
         self._record(rm, B, m)
+        # Quality feed (ISSUE 14): the posterior pass computes labels
+        # AND per-row log-likelihood for EVERY mixture op, so both
+        # detectors feed on every dispatch — score in the profile's
+        # neg_log_lik convention (-log p(x) per row).
+        self._observe_quality(rm, B, time.perf_counter() - t0, rows=m,
+                              labels=labels[:m], score=-lse[:m])
         if op == "predict":
             return labels[:m]
         if op == "predict_proba":
@@ -583,6 +693,7 @@ class ServingEngine:
         # bf16 rate until a guarded packed form is built and measured.
         mode = first.model._mode(B, d)
         chunk = self._serve_chunk(first, B)
+        t0 = time.perf_counter()
         with obs_trace.span("serve.request", op="predict_multi",
                             models=len(ids), rows=m, bucket=B):
             fn = kmeans_mod._STEP_CACHE.get_or_create(
@@ -609,12 +720,21 @@ class ServingEngine:
             for mid, block in items:
                 rms[mid].requests += 1
                 rms[mid].rows += block.shape[0]
+        dt = time.perf_counter() - t0
         results = []
         off = 0
         for mid, block in items:
             mb = block.shape[0]
             results.append(labels_all[slot[mid], off: off + mb].copy())
             off += mb
+        # Quality feed (ISSUE 14): each packed member's monitor sees
+        # ITS OWN requests' labels under its own model's slot — the
+        # packed dispatch labeled every row under every model, but
+        # foreign rows are foreign traffic, not this model's serving
+        # distribution.
+        for (mid, block), lab in zip(items, results):
+            self._observe_quality(rms[mid], B, dt, rows=block.shape[0],
+                                  labels=lab)
         return results
 
     # ----------------------------------------------- bf16 verification
@@ -737,7 +857,7 @@ class ServingEngine:
                       "table_bytes": rm.table_bytes,
                       "bf16_corrected_rows": rm.bf16_corrected_rows}
                 for mid, rm in sorted(self._residents.items())}
-            return {
+            stats = {
                 "models_resident": len(models),
                 "models": models,
                 "resident_table_bytes": sum(
@@ -749,6 +869,19 @@ class ServingEngine:
                 "batch_fill": fill,
                 "buckets": list(self.buckets),
             }
+        # Quality block (ISSUE 14) assembled OUTSIDE the engine lock:
+        # each monitor takes its own lock, and nesting them under the
+        # engine's would order-couple dispatch and stats paths.
+        stats["quality"] = self.quality_status()
+        return stats
+
+    def quality_status(self) -> dict:
+        """Per-model drift-monitor snapshot (the ``stats()`` quality
+        block and the serve CLI's ``{"quality": true}`` payload);
+        ``{model_id: None}`` entries mean monitoring is off."""
+        return {mid: (rm.monitor.status() if rm.monitor is not None
+                      else None)
+                for mid, rm in sorted(self._residents.items())}
 
     #: Step caches serving dispatches compile through — the K-Means
     #: family's assignment/transform programs AND the mixture family's
@@ -779,8 +912,12 @@ class ServingEngine:
     # -------------------------------------------------------- lifecycle
 
     def close(self) -> None:
-        """Drain the queue and join its worker (idempotent)."""
+        """Drain the queue, join its worker, close the drift-monitor
+        sinks (idempotent)."""
         self.queue.close()
+        for rm in self._residents.values():
+            if rm.monitor is not None:
+                rm.monitor.close()
 
     def __enter__(self):
         return self

@@ -497,6 +497,81 @@ def test_collective_span_suppression_honored(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# quality-counter (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+_QUALITY_BAD = """
+class Engine:
+    def _record(self, rm, bucket, m):
+        self.dispatches += 1
+
+    def _dispatch(self, rm, rows):
+        out = rows
+        self._record(rm, 8, len(rows))
+        return out
+"""
+
+_QUALITY_OK = """
+class Engine:
+    def _record(self, rm, bucket, m):
+        self.dispatches += 1
+
+    def _dispatch(self, rm, rows):
+        out = rows
+        self._record(rm, 8, len(rows))
+        self._observe_quality(rm, 8, None, rows=len(rows), labels=out)
+        return out
+"""
+
+
+def test_quality_counter_fires_on_unfed_record_path(tmp_path):
+    findings = run_on(tmp_path, _QUALITY_BAD, subdir="serving")
+    fires = [f for f in findings if f.rule == "quality-counter"]
+    assert len(fires) == 1
+    assert "_dispatch()" in fires[0].message
+    assert "quality monitor" in fires[0].message
+
+
+def test_quality_counter_silent_when_monitor_fed(tmp_path):
+    findings = run_on(tmp_path, _QUALITY_OK, subdir="serving")
+    assert [f for f in findings if f.rule == "quality-counter"] == []
+
+
+def test_quality_counter_fires_on_packed_counter_increment(tmp_path):
+    src = """
+class Engine:
+    def _dispatch_packed(self, items):
+        self.packed_dispatches += 1
+        return items
+"""
+    findings = run_on(tmp_path, src, subdir="serving")
+    assert [f.rule for f in findings
+            if f.rule == "quality-counter"] == ["quality-counter"]
+    # The = 0 declarations in __init__ are setup, not traffic.
+    init_only = """
+class Engine:
+    def __init__(self):
+        self.packed_dispatches = 0
+"""
+    findings = run_on(tmp_path, init_only, subdir="serving")
+    assert [f for f in findings if f.rule == "quality-counter"] == []
+
+
+def test_quality_counter_scoped_to_serving(tmp_path):
+    findings = run_on(tmp_path, _QUALITY_BAD, subdir="parallel")
+    assert [f for f in findings if f.rule == "quality-counter"] == []
+
+
+def test_quality_counter_suppression_honored(tmp_path):
+    src = _QUALITY_BAD.replace(
+        "        self._record(rm, 8, len(rows))",
+        "        # lint: ok(quality-counter) — probe path, monitor fed "
+        "by the caller\n        self._record(rm, 8, len(rows))")
+    findings = run_on(tmp_path, src, subdir="serving")
+    assert [f for f in findings if f.rule == "quality-counter"] == []
+
+
+# ---------------------------------------------------------------------------
 # cache-name (ISSUE 12)
 # ---------------------------------------------------------------------------
 

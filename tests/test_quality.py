@@ -1,0 +1,765 @@
+"""ISSUE 14: serving-quality & drift observability.
+
+Five tiers of coverage:
+
+1. **Detector unit fixtures** — hand-computed PSI/JS values, the
+   empty-bin smoothing contract, and the sentinel-row label mask.
+2. **Monitor semantics** — row-counted windows, the committed
+   debounce (fire at exactly DEBOUNCE consecutive breaching windows,
+   recover after DEBOUNCE clean ones), the JSONL sink stream.
+3. **Reference profiles** — ``quality_profile()`` on all five
+   families, persisted through the r10 checkpoint metadata block and
+   carried into the serving registry by ``engine.load``.
+4. **Engine acceptance** — monitoring-on vs monitoring-off serve
+   labels BIT-EQUAL with dispatch counts unchanged across all four
+   dispatch paths (direct / queued / packed / bf16-guarded), and the
+   injected-drift end-to-end: a traffic generator shifts the blob
+   mixture mid-serve — stationary traffic stays silent, shifted
+   traffic fires within the committed debounce window.
+5. **CLIs** — ``serve-status`` exit codes (0 healthy / 1 drifting /
+   2 unreadable) and the ``bench-diff`` regression guard, plus the
+   r15 ``obs.heartbeat`` namespace back-compat pin (satellite).
+"""
+
+import json
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+
+from kmeans_tpu import (GaussianMixture, KMeans, MiniBatchKMeans,
+                        SphericalKMeans)
+from kmeans_tpu.models import BisectingKMeans
+from kmeans_tpu.obs import drift
+from kmeans_tpu.obs.trace import TraceReadError
+from kmeans_tpu.parallel.mesh import make_mesh
+from kmeans_tpu.serving import ServingEngine
+
+
+def _mesh(w):
+    if len(jax.devices()) < w:
+        pytest.skip(f"needs {w} devices")
+    return make_mesh(data=w, devices=jax.devices()[:w])
+
+
+@pytest.fixture(scope="module")
+def data():
+    X, _ = make_blobs(n_samples=4000, centers=4, n_features=8,
+                      cluster_std=0.6, random_state=7)
+    return X.astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# 1. Detector unit fixtures
+# ---------------------------------------------------------------------------
+
+def test_psi_hand_computed():
+    """ref [50, 50] vs cur [90, 10]:
+    PSI = (0.9-0.5)ln(0.9/0.5) + (0.1-0.5)ln(0.1/0.5) = 0.87889...
+    (smoothing is 1e-6/bin — invisible at 1e-4 tolerance)."""
+    assert drift.psi([50, 50], [90, 10]) == pytest.approx(0.878890,
+                                                          abs=1e-4)
+    assert drift.psi([50, 50], [50, 50]) == pytest.approx(0.0, abs=1e-9)
+
+
+def test_js_hand_computed():
+    """Same pair: m = [0.7, 0.3],
+    JS = 0.5 KL2(r||m) + 0.5 KL2(c||m) = 0.146780... bits; bounded by
+    1 and symmetric."""
+    assert drift.js_divergence([50, 50], [90, 10]) == pytest.approx(
+        0.146780, abs=1e-4)
+    assert drift.js_divergence([90, 10], [50, 50]) == pytest.approx(
+        drift.js_divergence([50, 50], [90, 10]), abs=1e-12)
+    # Disjoint distributions: JS -> 1 bit (its upper bound).
+    assert drift.js_divergence([1, 0], [0, 1]) == pytest.approx(
+        1.0, abs=1e-3)
+
+
+def test_empty_bin_smoothing_keeps_detectors_finite():
+    """A cluster with zero serving traffic (or zero training mass)
+    must contribute a finite term, never an infinity — the smoothing
+    contract."""
+    for ref, cur in (([100, 0], [0, 100]), ([1, 0, 0], [0, 0, 1])):
+        assert np.isfinite(drift.psi(ref, cur))
+        assert np.isfinite(drift.js_divergence(ref, cur))
+    # PSI on disjoint mass is huge but finite — the alert still fires.
+    assert drift.psi([100, 0], [0, 100]) > drift.PSI_ALERT
+
+
+def test_assignment_counts_masks_sentinel_labels():
+    """The k-sweep / TP padding discipline pads centroid tables with
+    inert sentinel rows; a sentinel label leaking through must be
+    DROPPED (not clipped into a real bin)."""
+    counts = drift.assignment_counts(np.array([0, 1, 1, 5, 7]), k=2)
+    np.testing.assert_array_equal(counts, [1.0, 2.0])
+    # Negative labels (hand-built fixtures) take the masked slow path.
+    counts = drift.assignment_counts(np.array([-1, 0, 1]), k=2)
+    np.testing.assert_array_equal(counts, [1.0, 1.0])
+
+
+def test_inline_close_matches_public_detectors():
+    """The monitor's optimized in-close arithmetic (cached smoothed
+    reference + shared logs) must equal the public psi()/js() to
+    float64 — one formula, two spellings."""
+    rng = np.random.default_rng(0)
+    ref = rng.integers(1, 100, size=16)
+    prof = drift.build_profile(family="kmeans", model_class="KMeans",
+                               k=16, counts=ref)
+    mon = drift.QualityMonitor("m", 16, profile=prof, window_rows=64)
+    labels = rng.integers(0, 16, size=64)
+    mon.observe(64, labels=labels)
+    last = mon.history()[-1]["detectors"]
+    cur = drift.assignment_counts(labels, 16)
+    # The monitor's reference is the profile's NORMALIZED histogram
+    # (that is what the checkpoint persists) — compare against the
+    # public detectors on the same inputs.
+    ref_hist = prof["assignment_hist"]
+    assert last["psi"] == pytest.approx(drift.psi(ref_hist, cur),
+                                        rel=1e-12)
+    assert last["js"] == pytest.approx(
+        drift.js_divergence(ref_hist, cur), rel=1e-12)
+
+
+def test_committed_thresholds_pinned():
+    """The decision table is COMMITTED (the fleet-status discipline):
+    these numbers moving is an API change, not a tweak."""
+    assert drift.COMMITTED_THRESHOLDS == {
+        "psi": 0.25, "js": 0.10, "score_ratio": 2.0,
+        "near_tie_frac": 0.05}
+    assert drift.DRIFT_WINDOW_ROWS == 512
+    assert drift.DRIFT_DEBOUNCE_WINDOWS == 2
+
+
+def test_build_profile_validates_and_coerces():
+    prof = drift.build_profile(
+        family="kmeans", model_class="KMeans", k=3,
+        counts=np.array([2, 1, 1], np.int64), score_kind="sse",
+        score_per_row=np.float64(1.5), n_rows=np.float64(4))
+    assert prof["assignment_hist"] == [0.5, 0.25, 0.25]
+    # JSON-clean: every value must be a plain Python type.
+    json.dumps(prof)
+    with pytest.raises(ValueError, match="bins"):
+        drift.build_profile(family="kmeans", model_class="KMeans",
+                            k=3, counts=[1, 2])
+    with pytest.raises(ValueError, match="score_kind"):
+        drift.build_profile(family="kmeans", model_class="KMeans",
+                            k=2, score_kind="rmse")
+
+
+# ---------------------------------------------------------------------------
+# 2. Monitor semantics: windows, debounce, sink
+# ---------------------------------------------------------------------------
+
+def _monitor(tmp_path=None, **kw):
+    prof = drift.build_profile(family="kmeans", model_class="KMeans",
+                               k=4, counts=[25, 25, 25, 25],
+                               score_kind="sse", score_per_row=1.0,
+                               n_rows=100)
+    sink = str(tmp_path / "quality.m.jsonl") if tmp_path else None
+    kw.setdefault("window_rows", 32)
+    return drift.QualityMonitor("m", 4, profile=prof, sink_path=sink,
+                                **kw)
+
+
+def test_debounce_fires_at_exactly_n_consecutive_windows():
+    mon = _monitor()
+    shifted = np.zeros(32, np.int32)          # all mass on cluster 0
+    mon.observe(32, labels=shifted)           # window 1: breach
+    assert not mon.drifting and mon.events == 0
+    mon.observe(32, labels=shifted)           # window 2: debounce met
+    assert mon.drifting and mon.events == 1
+    mon.observe(32, labels=shifted)           # still drifting, 1 event
+    assert mon.events == 1
+    balanced = np.arange(32, dtype=np.int32) % 4
+    mon.observe(32, labels=balanced)          # clean window 1
+    assert mon.drifting                       # debounce on recovery too
+    mon.observe(32, labels=balanced)          # clean window 2
+    assert not mon.drifting
+    assert mon.events == 1
+
+
+def test_info_free_windows_are_not_evidence():
+    """Review regression: a window where no detector could evaluate
+    (transform-only traffic — rows, no labels) must neither reset a
+    breach streak nor count toward recovery."""
+    mon = _monitor()
+    shifted = np.zeros(32, np.int32)
+    mon.observe(32, labels=shifted)           # breach 1
+    mon.observe(32)                           # info-free: no reset
+    assert mon.history()[-1]["informative"] is False
+    mon.observe(32, labels=shifted)           # breach 2 -> fires
+    assert mon.drifting and mon.events == 1
+    mon.observe(32)                           # info-free windows must
+    mon.observe(32)                           # not "recover" either
+    assert mon.drifting
+
+
+def test_minibatch_profile_score_uses_dataset_rows_not_lifetime_seen():
+    """Review regression: MiniBatch's histogram mass is its lifetime
+    _seen counts (passes x batch), but inertia_ is the full-dataset
+    SSE estimate — the score-per-row denominator must be the dataset
+    weight or a healthy multi-pass model reads as drifting forever."""
+    rng = np.random.default_rng(0)
+    X = (rng.standard_normal((20000, 8)) * 0.5
+         + rng.integers(0, 4, 20000)[:, None] * 6).astype(np.float32)
+    mb = MiniBatchKMeans(k=4, seed=0, verbose=False, batch_size=1024,
+                         max_iter=60, compute_sse=True).fit(X)
+    prof = mb.quality_profile()
+    assert prof["n_rows"] == pytest.approx(len(X))
+    # The reference must agree with the directly recomputed SSE/row —
+    # serving the model its own training data must sit near ratio 1.
+    true_spr = mb.quality_profile(X)["score_per_row"]
+    assert prof["score_per_row"] == pytest.approx(true_spr, rel=0.25)
+    assert prof["score_per_row"] / true_spr < drift.SCORE_RATIO_ALERT
+
+
+def test_sink_never_opens_after_close(tmp_path):
+    """Review regression: a monitor whose sink was never lazily opened
+    must not create the file from an in-flight dispatch after
+    close()."""
+    sink = tmp_path / "late.jsonl"
+    mon = drift.QualityMonitor("m", 4, sink_path=str(sink),
+                               window_rows=8)   # no profile: lazy open
+    mon.close()
+    mon.observe(8, labels=np.zeros(8, np.int32))   # closes a window
+    assert not sink.exists()
+
+
+def test_one_bad_window_between_clean_ones_never_fires():
+    mon = _monitor()
+    shifted = np.zeros(32, np.int32)
+    balanced = np.arange(32, dtype=np.int32) % 4
+    for _ in range(4):
+        mon.observe(32, labels=shifted)
+        mon.observe(32, labels=balanced)
+    assert mon.events == 0 and not mon.drifting
+
+
+def test_score_ratio_and_near_tie_detectors():
+    mon = _monitor()
+    balanced = np.arange(32, dtype=np.int32) % 4
+    # score 3x the training score_per_row=1.0 -> ratio breach; the
+    # near-tie fraction 8/32 = 25% breaches its 5% threshold too.
+    for _ in range(drift.DRIFT_DEBOUNCE_WINDOWS):
+        mon.observe(32, labels=balanced,
+                    score=np.full(32, 3.0), near_ties=8,
+                    guarded_rows=32)
+    assert mon.drifting
+    last = mon.history()[-1]
+    assert last["detectors"]["score_ratio"] == pytest.approx(3.0)
+    assert last["detectors"]["near_tie_frac"] == pytest.approx(0.25)
+    assert {"score_ratio", "near_tie_frac"} <= set(last["breaching"])
+    assert last["detectors"]["psi"] < drift.PSI_ALERT  # hist stayed ok
+
+
+def test_non_positive_score_reference_deactivates_ratio():
+    prof = drift.build_profile(family="gmm", model_class="G", k=2,
+                               counts=[1, 1], score_kind="neg_log_lik",
+                               score_per_row=-0.5)
+    mon = drift.QualityMonitor("m", 2, profile=prof, window_rows=8)
+    mon.observe(8, labels=np.zeros(8, np.int32), score=np.full(8, 9.0))
+    assert mon.history()[-1]["detectors"]["score_ratio"] is None
+
+
+def test_monitor_rejects_mismatched_reference_k():
+    prof = drift.build_profile(family="kmeans", model_class="K", k=3,
+                               counts=[1, 1, 1])
+    with pytest.raises(ValueError, match="k="):
+        drift.QualityMonitor("m", 5, profile=prof)
+
+
+def test_sink_stream_and_reader(tmp_path):
+    mon = _monitor(tmp_path)
+    shifted = np.zeros(32, np.int32)
+    for _ in range(3):
+        mon.observe(32, labels=shifted)
+    mon.close()
+    records = drift.read_quality_log(tmp_path / "quality.m.jsonl")
+    kinds = [r["kind"] for r in records]
+    assert kinds[0] == "profile"
+    assert kinds.count("window") == 3
+    assert kinds.count("drift") == 1          # fired once, debounced
+    assert all(r["model"] == "m" for r in records)
+    # Torn live tail is tolerated; a garbage body line is not.
+    p = tmp_path / "quality.m.jsonl"
+    with open(p, "a") as f:
+        f.write('{"kind": "window", "model":')       # torn tail
+    assert len(drift.read_quality_log(p)) == len(records)
+    (tmp_path / "garbage.jsonl").write_text("not json\nstill not\n")
+    with pytest.raises(TraceReadError):
+        drift.read_quality_log(tmp_path / "garbage.jsonl")
+
+
+def test_quality_report_aggregates_and_classifies(tmp_path):
+    mon = _monitor(tmp_path)
+    for _ in range(2):
+        mon.observe(32, labels=np.zeros(32, np.int32))
+    mon.close()
+    # A co-located heartbeat sink must be skipped on a DIRECTORY scan.
+    (tmp_path / "hb.jsonl").write_text(
+        json.dumps({"ts": 1.0, "iteration": 1}) + "\n")
+    report = drift.quality_report(str(tmp_path))
+    assert list(report["models"]) == ["m"]
+    assert report["models"]["m"]["windows"] == 2
+    assert report["models"]["m"]["drifting"] is True
+    assert report["drifting"] == ["m"] and not report["healthy"]
+    assert drift.format_quality_status(report).startswith(
+        "serving quality: 1 model")
+    # A directory with no quality stream classifies as unreadable.
+    with pytest.raises(TraceReadError):
+        drift.quality_report(str(tmp_path / "hb.jsonl") + "x")
+
+
+# ---------------------------------------------------------------------------
+# 3. Reference profiles across the five families
+# ---------------------------------------------------------------------------
+
+FAMILIES = {
+    "kmeans": lambda: KMeans(k=4, seed=0, verbose=False, max_iter=20,
+                             compute_sse=True),
+    "minibatch": lambda: MiniBatchKMeans(k=4, seed=0, verbose=False,
+                                         batch_size=256, max_iter=25),
+    "bisecting": lambda: BisectingKMeans(k=4, seed=0, verbose=False,
+                                         compute_sse=True),
+    "spherical": lambda: SphericalKMeans(k=4, seed=0, verbose=False,
+                                         max_iter=20),
+    "gmm": lambda: GaussianMixture(n_components=4, seed=0),
+}
+
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_profile_roundtrips_through_checkpoint(family, data, tmp_path):
+    model = FAMILIES[family]().fit(data)
+    prof = model.quality_profile()
+    assert prof is not None and prof["k"] == 4
+    assert prof["assignment_hist"] is not None
+    assert sum(prof["assignment_hist"]) == pytest.approx(1.0)
+    path = tmp_path / f"{family}.npz"
+    model.save(path)
+    loaded = type(model).load(path)
+    # The loaded model has no training stats, yet carries the SAME
+    # reference window via the r10 metadata block.
+    assert loaded.quality_profile() == prof
+
+
+def test_bisecting_profile_carries_per_cluster_sse(data):
+    model = FAMILIES["bisecting"]().fit(data)
+    prof = model.quality_profile()
+    assert prof["per_cluster_sse"] is not None
+    assert len(prof["per_cluster_sse"]) == 4
+
+
+def test_profile_from_explicit_data(data):
+    km = FAMILIES["kmeans"]().fit(data)
+    prof = km.quality_profile(data)
+    assert prof["score_kind"] == "sse"
+    assert prof["n_rows"] == float(len(data))
+    # Inertia/row from the fused fit pass == the recomputed one.
+    attrs = km.quality_profile()
+    assert prof["score_per_row"] == pytest.approx(
+        attrs["score_per_row"], rel=1e-2)
+    assert prof["per_cluster_sse"] is not None
+    assert sum(prof["per_cluster_sse"]) == pytest.approx(
+        prof["score_per_row"] * prof["n_rows"], rel=1e-6)
+
+
+def test_unfitted_profile_is_none():
+    assert KMeans(k=3, verbose=False).quality_profile() is None
+    assert GaussianMixture(n_components=2).quality_profile() is None
+
+
+# ---------------------------------------------------------------------------
+# 4. Engine acceptance: parity, zero extra dispatches, injected drift
+# ---------------------------------------------------------------------------
+
+def _paired_engines(mesh, models, tmp_path=None, **on_kw):
+    """(quality-on, quality-off) engines holding deepcopies of the
+    same fitted models."""
+    import copy
+    on_kw.setdefault("quality", True)
+    if tmp_path is not None:
+        on_kw.setdefault("quality_dir", str(tmp_path))
+    eng_on = ServingEngine(mesh=mesh, max_wait_ms=1.0, **on_kw)
+    eng_off = ServingEngine(mesh=mesh, max_wait_ms=1.0, quality=False)
+    for mid, model, kw in models:
+        twin = copy.deepcopy(model)
+        twin.mesh = None
+        eng_on.add_model(mid, model, **kw)
+        eng_off.add_model(mid, twin, **kw)
+    return eng_on, eng_off
+
+
+def test_monitoring_parity_all_dispatch_paths(data, tmp_path):
+    """THE acceptance pin: monitoring-on vs monitoring-off labels are
+    bit-equal and dispatch counts identical across direct, queued,
+    packed, and bf16-guarded dispatch paths — the quality feed only
+    READS what the dispatch computed."""
+    mesh = _mesh(1)
+    a = KMeans(k=4, seed=0, verbose=False, max_iter=20).fit(data)
+    b = KMeans(k=4, seed=9, verbose=False, max_iter=20).fit(data)
+    q = KMeans(k=4, seed=5, verbose=False, max_iter=20).fit(data)
+    gm = GaussianMixture(n_components=4, seed=0).fit(data)
+    for m in (a, b, q, gm):
+        m.mesh = None
+    eng_on, eng_off = _paired_engines(
+        mesh, [("a", a, {}), ("b", b, {}),
+               ("q", q, {"quantize": "bf16"}), ("gm", gm, {})],
+        tmp_path)
+    with eng_on, eng_off:
+        for rows in (1, 7, 300):
+            probe = data[:rows]
+            for mid in ("a", "gm", "q"):
+                np.testing.assert_array_equal(
+                    eng_on.predict(mid, probe),          # direct
+                    eng_off.predict(mid, probe))
+                np.testing.assert_array_equal(
+                    eng_on.submit(mid, probe).result(30.0),   # queued
+                    eng_off.submit(mid, probe).result(30.0))
+            for on, off in zip(                          # packed
+                    eng_on.predict_multi([("a", probe), ("b", probe)]),
+                    eng_off.predict_multi([("a", probe),
+                                           ("b", probe)])):
+                np.testing.assert_array_equal(on, off)
+            np.testing.assert_array_equal(               # score path
+                eng_on.call("a", probe, op="score_rows"),
+                eng_off.call("a", probe, op="score_rows"))
+        # Zero extra dispatches: identical traffic, identical counts.
+        assert eng_on.dispatches == eng_off.dispatches
+        assert eng_on.packed_dispatches == eng_off.packed_dispatches
+        st = eng_on.stats()
+        for mid in ("a", "b", "q", "gm"):
+            assert st["models"][mid]["dispatches"] == \
+                eng_off.stats()["models"][mid]["dispatches"]
+        # The quality block exists and saw the traffic (incl. the
+        # guarded path's near-tie accounting on the quantized model).
+        assert st["quality"]["a"]["rows"] > 0
+        assert st["quality"]["q"]["rows"] > 0
+        assert eng_off.stats()["quality"]["a"] is None
+
+
+def drift_traffic(data, labels_true, weights_a, weights_b,
+                  shift_after, batch, seed=0):
+    """Faults-style deterministic traffic generator: draws request
+    batches from the blob mixture with per-cluster weights
+    ``weights_a``, switching to ``weights_b`` after ``shift_after``
+    batches — the injected-drift harness."""
+    rng = np.random.default_rng(seed)
+    by_cluster = [np.flatnonzero(labels_true == c)
+                  for c in range(len(weights_a))]
+    i = 0
+    while True:
+        w = np.asarray(weights_a if i < shift_after else weights_b,
+                       np.float64)
+        w = w / w.sum()
+        comps = rng.choice(len(w), size=batch, p=w)
+        rows = np.stack([data[rng.choice(by_cluster[c])]
+                         for c in comps])
+        yield rows
+        i += 1
+
+
+def test_injected_drift_fires_shifted_stays_silent_stationary(
+        data, tmp_path):
+    """End-to-end: a model fitted on the balanced blob mixture serves
+    (a) stationary traffic — same mixture, fresh draws — which must
+    stay SILENT, then (b) mixture-shifted traffic (90% of mass on one
+    blob) which must fire within the committed debounce window."""
+    X, y = make_blobs(n_samples=4000, centers=4, n_features=8,
+                      cluster_std=0.6, random_state=7)
+    X = X.astype(np.float32)
+    km = KMeans(k=4, seed=0, verbose=False, max_iter=25,
+                compute_sse=True).fit(X)
+    km.mesh = None
+    window = 256
+    eng = ServingEngine(mesh=_mesh(1), quality=True,
+                        quality_dir=str(tmp_path),
+                        quality_window=window)
+    with eng:
+        eng.add_model("m", km)
+        batch = 128
+        balanced = [1, 1, 1, 1]
+        shifted = [0.9, 0.04, 0.03, 0.03]
+        # Phase (a): 8 stationary windows.
+        gen = drift_traffic(X, y, balanced, balanced, 10 ** 9, batch)
+        for _ in range(8 * (window // batch)):
+            eng.call("m", next(gen))
+        status = eng.quality_status()["m"]
+        assert status["windows"] >= 8
+        assert status["events"] == 0 and not status["drifting"]
+        # Phase (b): shifted traffic must fire after exactly the
+        # debounce window count (2 windows = 4 batches here).
+        gen = drift_traffic(X, y, shifted, shifted, 0, batch, seed=1)
+        for _ in range(drift.DRIFT_DEBOUNCE_WINDOWS
+                       * (window // batch)):
+            eng.call("m", next(gen))
+        status = eng.quality_status()["m"]
+        assert status["drifting"] and status["events"] == 1
+        assert "psi" in status["breaching"]
+        assert status["detectors"]["psi"] > drift.PSI_ALERT
+    # The sink recorded it for serve-status.
+    report = drift.quality_report(str(tmp_path))
+    assert report["drifting"] == ["m"]
+
+
+def test_engine_load_carries_checkpoint_profile(data, tmp_path):
+    km = KMeans(k=4, seed=0, verbose=False, max_iter=20,
+                compute_sse=True).fit(data)
+    km.save(tmp_path / "km.npz")
+    eng = ServingEngine(mesh=_mesh(1), quality=True)
+    with eng:
+        mid = eng.load(tmp_path / "km.npz")
+        status = eng.quality_status()[mid]
+        assert status["reference"] is True
+        assert status["score_kind"] == "sse"
+
+
+def test_quality_auto_resolution_and_validation(data):
+    """'auto' resolves OFF on CPU (the measured BENCH_QUALITY rule) —
+    unless a quality_dir asks for sinks, which implies monitoring."""
+    km = KMeans(k=4, seed=0, verbose=False, max_iter=10).fit(data)
+    km.mesh = None
+    eng = ServingEngine(mesh=_mesh(1))
+    if jax.default_backend() == "cpu":
+        assert eng._quality is False
+    eng.close()
+    eng = ServingEngine(mesh=_mesh(1), quality_dir="/tmp/unused-qdir")
+    assert eng._quality is True
+    eng.close()
+    with pytest.raises(ValueError, match="quality"):
+        ServingEngine(mesh=_mesh(1), quality="yes")
+
+
+def test_warmup_and_verify_probes_stay_out_of_monitor(data):
+    km = KMeans(k=4, seed=0, verbose=False, max_iter=20).fit(data)
+    km.mesh = None
+    eng = ServingEngine(mesh=_mesh(1), quality=True)
+    with eng:
+        eng.add_model("m", km, quantize="bf16")
+        eng.warmup()
+        eng.verify_quantized("m", data[:100])
+        assert eng.quality_status()["m"]["rows"] == 0
+        eng.predict("m", data[:50])
+        assert eng.quality_status()["m"]["rows"] == 50
+
+
+def test_latency_histograms_per_model_and_bucket(data):
+    from kmeans_tpu.obs.metrics_registry import REGISTRY
+    km = KMeans(k=4, seed=0, verbose=False, max_iter=10).fit(data)
+    km.mesh = None
+    eng = ServingEngine(mesh=_mesh(1), quality=True)
+    with eng:
+        eng.add_model("lat", km)
+        eng.predict("lat", data[:3])          # bucket 8
+        eng.predict("lat", data[:100])        # bucket 512
+    snap = REGISTRY.snapshot()
+    assert snap["serve.latency_ms.lat.b8"]["value"]["count"] >= 1
+    assert snap["serve.latency_ms.lat.b512"]["value"]["count"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# 5. CLIs + namespace back-compat satellite
+# ---------------------------------------------------------------------------
+
+def test_serve_status_cli_exit_codes(data, tmp_path, capsys):
+    from kmeans_tpu.cli import serve_status_main
+    km = KMeans(k=4, seed=0, verbose=False, max_iter=20).fit(data)
+    km.mesh = None
+    qdir = tmp_path / "q"
+    eng = ServingEngine(mesh=_mesh(1), quality=True,
+                        quality_dir=str(qdir), quality_window=64)
+    with eng:
+        eng.add_model("m", km)
+        for _ in range(3):
+            eng.call("m", data[:64])          # stationary -> healthy
+    assert serve_status_main([str(qdir)]) == 0
+    out = capsys.readouterr().out
+    assert "HEALTHY" in out
+    assert serve_status_main([str(qdir), "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["healthy"] and report["models"]["m"]["windows"] == 3
+    # Drifting stream -> exit 1 (append a drift record the way the
+    # monitor writes one).
+    sink = qdir / "quality.m.jsonl"
+    with open(sink, "a") as f:
+        f.write(json.dumps({"kind": "drift", "model": "m", "ts": 9e9,
+                            "drifting": True, "window": 4,
+                            "detectors": {}, "breaching": ["psi"]})
+                + "\n")
+    assert serve_status_main([str(qdir)]) == 1
+    assert "DRIFTING" in capsys.readouterr().out
+    # Unreadable -> exit 2.
+    assert serve_status_main([str(tmp_path / "nope")]) == 2
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("garbage\n" * 3)
+    assert serve_status_main([str(bad)]) == 2
+
+
+def test_serve_cli_quality_op(data, tmp_path, capsys, monkeypatch):
+    import io
+
+    from kmeans_tpu.cli import serve_main
+    km = KMeans(k=4, seed=0, verbose=False, max_iter=15).fit(data)
+    km.save(tmp_path / "km.npz")
+    req = json.dumps({"model": "km", "x": data[:4].tolist()})
+    monkeypatch.setattr("sys.stdin",
+                        io.StringIO(req + "\n"
+                                    + json.dumps({"quality": True})
+                                    + "\n"))
+    rc = serve_main(["--model", str(tmp_path / "km.npz"), "--quality",
+                     "--no-warmup"])
+    assert rc == 0
+    lines = [json.loads(line) for line in
+             capsys.readouterr().out.strip().splitlines()]
+    assert lines[0]["result"] == km.predict(data[:4]).tolist()
+    assert lines[1]["km"]["reference"] is True
+    assert lines[1]["km"]["rows"] == 4
+
+
+def _bench_doc(path, ms, spread=0.01, metric="kmeans_iter_x"):
+    path.write_text(json.dumps(
+        {"parsed": {"metric": metric, "ms_per_iter": ms,
+                    "value": 1e9 * 38.0 / ms, "spread": spread}}))
+    return path
+
+
+def test_bench_diff_ok_regression_and_unreadable(tmp_path, capsys):
+    from kmeans_tpu.cli import bench_diff_main
+    old = _bench_doc(tmp_path / "old.json", 38.0)
+    # Inside the recorded spread (5% floor): not a regression.
+    same = _bench_doc(tmp_path / "same.json", 39.0)
+    assert bench_diff_main([str(old), str(same)]) == 0
+    capsys.readouterr()
+    # 20% slower: regression on ms_per_iter AND on throughput.
+    slow = _bench_doc(tmp_path / "slow.json", 45.6)
+    assert bench_diff_main([str(old), str(slow)]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out
+    # An IMPROVEMENT is never flagged.
+    fast = _bench_doc(tmp_path / "fast.json", 20.0)
+    assert bench_diff_main([str(old), str(fast)]) == 0
+    capsys.readouterr()
+    # --json is machine-readable and names the regressed rows.
+    assert bench_diff_main([str(old), str(slow), "--json"]) == 1
+    diff = json.loads(capsys.readouterr().out)
+    assert diff["regressed"] == [f"{'kmeans_iter_x'}"]
+    # Unreadable / disjoint -> exit 2.
+    assert bench_diff_main([str(old), str(tmp_path / "nope.json")]) == 2
+    other = _bench_doc(tmp_path / "other.json", 10.0, metric="other")
+    assert bench_diff_main([str(old), str(other)]) == 2
+
+
+def test_bench_diff_honors_any_recorded_spread_field(tmp_path, capsys):
+    """Review regression: rows across rounds record noise under
+    different names (overhead_spread, speedup_spread, ...); a change
+    inside THAT recorded spread must never flag."""
+    from kmeans_tpu.cli import bench_diff_main
+    old = tmp_path / "o.json"
+    new = tmp_path / "n.json"
+    old.write_text(json.dumps({"parsed": {
+        "metric": "quality_overhead", "overhead_ratio": 1.1413,
+        "overhead_spread": 0.196}}))
+    new.write_text(json.dumps({"parsed": {
+        "metric": "quality_overhead", "overhead_ratio": 1.25,
+        "overhead_spread": 0.15}}))
+    assert bench_diff_main([str(old), str(new)]) == 0   # inside 19.6%
+    capsys.readouterr()
+    worse = tmp_path / "w.json"
+    worse.write_text(json.dumps({"parsed": {
+        "metric": "quality_overhead", "overhead_ratio": 1.40,
+        "overhead_spread": 0.02}}))
+    assert bench_diff_main([str(old), str(worse)]) == 1  # beyond it
+
+
+def test_sink_concurrent_window_closes_never_tear(tmp_path):
+    """Review regression: concurrent dispatch threads closing windows
+    must serialize their sink writes — every line in the stream parses
+    (read_quality_log is strict about non-final lines)."""
+    import threading
+    sink = tmp_path / "quality.c.jsonl"
+    mon = drift.QualityMonitor("c", 4, sink_path=str(sink),
+                               window_rows=8)
+    labels = np.arange(8, dtype=np.int32) % 4
+
+    def hammer():
+        for _ in range(200):
+            mon.observe(8, labels=labels)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    mon.close()
+    assert mon.sink_errors == 0
+    records = drift.read_quality_log(sink)
+    assert len(records) == 800           # one window per 8-row batch
+    assert all(r["kind"] == "window" for r in records)
+
+
+def test_bench_diff_reads_baseline_format(tmp_path, capsys):
+    from kmeans_tpu.cli import bench_diff_main
+    base = Path(__file__).resolve().parents[1] / "BASELINE.json"
+    assert bench_diff_main([str(base), str(base), "--json"]) == 0
+    diff = json.loads(capsys.readouterr().out)
+    # Review regression: the 4 per-batch-size serving rows share one
+    # config/model key — they must disambiguate, never collapse.
+    serving = [k for k in diff["rows"]
+               if k.startswith("online serving") and "batch_requests="
+               in k]
+    assert len(serving) == 4
+
+
+def test_bench_diff_duplicate_keys_and_jsonl(tmp_path, capsys):
+    """Review regressions: same-key rows disambiguate (a regression in
+    ANY of them flags), and multi-line JSONL bench artifacts parse."""
+    from kmeans_tpu.cli import bench_diff_main
+
+    def rows(q64):
+        return "\n".join(json.dumps(
+            {"config": "serve", "model": "kmeans", "batch_requests": b,
+             "qps": q, "spread": 0.01})
+            for b, q in ((8, 1000.0), (64, q64))) + "\n"
+
+    old = tmp_path / "old.jsonl"
+    new = tmp_path / "new.jsonl"
+    old.write_text(rows(10000.0))
+    new.write_text(rows(5000.0))              # B=64 qps halved
+    assert bench_diff_main([str(old), str(new), "--json"]) == 1
+    diff = json.loads(capsys.readouterr().out)
+    assert diff["rows_compared"] == 2
+    assert diff["regressed"] == ["serve [kmeans] (batch_requests=64)"]
+
+
+def test_obs_heartbeat_namespace_backcompat():
+    """The r15 namespace wart, pinned closed (ISSUE 14 satellite):
+    package-level re-exports are the supported spelling, the scope
+    callable still shadows the submodule attribute, and the submodule
+    import path keeps working."""
+    import importlib
+
+    import kmeans_tpu.obs as obs
+    # Package-level re-exports (what consumers use now).
+    from kmeans_tpu.obs import Heartbeat, get_heartbeat, note_progress
+    hb_mod = importlib.import_module("kmeans_tpu.obs.heartbeat")
+    assert obs.heartbeat is hb_mod.heartbeat       # callable, shadows
+    assert callable(obs.heartbeat)
+    assert Heartbeat is hb_mod.Heartbeat
+    assert note_progress is hb_mod.note_progress
+    assert get_heartbeat is hb_mod.get_heartbeat
+    # The submodule route (pre-r18 consumers) keeps working.
+    from kmeans_tpu.obs.heartbeat import note_progress as np2
+    assert np2 is note_progress
+    # The models now import from package level — no consumer reaches
+    # through the shadowed attribute anymore.
+    import kmeans_tpu.models.kmeans as km_mod
+    assert km_mod.obs_note_progress is note_progress
+
+
+def test_drift_module_is_lazy_on_obs_package():
+    """obs stays stdlib at import; obs.drift resolves lazily and is
+    the same module object as the direct import."""
+    import kmeans_tpu.obs as obs
+    from kmeans_tpu.obs import drift as direct
+    assert obs.drift is direct
