@@ -89,6 +89,15 @@ BENCH_OBS_TRACE, default artifacts/trace_ttfi.jsonl).  Committed rule:
 <= 1% median overhead on the 200k x 32 k=64 proxy or per-iteration
 spans demote to segment-level.  Env: BENCH_N/_D/_K/_ITERS.
 
+BENCH_TTFI=1 switches to the TIME-TO-FIRST-ITERATION attack rows
+(ISSUE 15): cold / same-process-warm / AOT-warm(second process) /
+compile-ingest-overlap TTFI tables measured across fresh subprocesses
+sharing one AOT executable store, with the committed rules (AOT-warm
+compile row <= 10% of cold; overlapped prelude window < serial
+stage+compile sum).  Cold/AOT-warm traces land in artifacts/ for the
+bench-diff TTFI guard.  Env: BENCH_N/_D/_K, BENCH_ITERS,
+BENCH_AOT_DIR.
+
 BENCH_QUALITY=1 switches to the SERVING-QUALITY MONITORING overhead
 benchmark (ISSUE 14): monitoring-on vs monitoring-off serving
 throughput against a resident warm K-Means model, interleaved per-rep
@@ -335,6 +344,25 @@ def main() -> None:
         bench_phases(pn, pd, pk, gap=pg, chunks=chunks,
                      skip_sweep=bool(os.environ.get(
                          "BENCH_PHASES_NO_SWEEP")))
+        return
+
+    if os.environ.get("BENCH_TTFI"):
+        # Time-to-first-iteration attack rows (ISSUE 15): cold / warm /
+        # AOT-warm / overlap TTFI, measured across fresh processes
+        # against one shared AOT executable store, with the committed
+        # rules (AOT-warm compile <= 10% of cold; overlapped prelude
+        # window < serial stage+compile sum).  Env: BENCH_N/_D/_K,
+        # BENCH_ITERS (device-loop iterations), BENCH_AOT_DIR.
+        from kmeans_tpu.benchmarks import bench_ttfi
+        tn = int(os.environ.get("BENCH_N",
+                                2_000_000 if on_accel else 400_000))
+        td = int(os.environ.get("BENCH_D", 128 if on_accel else 64))
+        tk = int(os.environ.get("BENCH_K", 1024 if on_accel else 64))
+        ti = int(os.environ.get("BENCH_ITERS", 4))
+        log(f"bench: TTFI mode backend={backend} N={tn} D={td} k={tk} "
+            f"iters={ti}")
+        bench_ttfi(tn, td, tk, max_iter=ti,
+                   aot_dir=os.environ.get("BENCH_AOT_DIR"))
         return
 
     if os.environ.get("BENCH_OBS"):

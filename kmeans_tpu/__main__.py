@@ -27,6 +27,9 @@ def main() -> int:
     if cmd == "ckpt-info":
         from kmeans_tpu.cli import ckpt_info_main
         return ckpt_info_main(rest)
+    if cmd == "warm":
+        from kmeans_tpu.cli import warm_main
+        return warm_main(rest)
     if cmd == "serve":
         from kmeans_tpu.cli import serve_main
         return serve_main(rest)
@@ -52,8 +55,9 @@ def main() -> int:
         from kmeans_tpu.cli import bench_diff_main
         return bench_diff_main(rest)
     print(f"unknown command {cmd!r}; available: suite, bench, fit, "
-          f"sweep, ckpt-info, serve, report, lint, trace, cost-report, "
-          f"fleet-status, serve-status, bench-diff", file=sys.stderr)
+          f"sweep, ckpt-info, warm, serve, report, lint, trace, "
+          f"cost-report, fleet-status, serve-status, bench-diff",
+          file=sys.stderr)
     return 2
 
 

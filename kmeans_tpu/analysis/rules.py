@@ -877,6 +877,77 @@ class CacheNameRule(Rule):
         return False
 
 
+# ------------------------------------------------------------ aot-key
+
+class AotKeyRule(Rule):
+    """ISSUE 15 member of the r14 cache-key rule family: every AOT
+    artifact write (a ``.put(...)`` on a ``*store*``-named object — the
+    :class:`~kmeans_tpu.utils.aot.AOTStore` surface) must derive its
+    key through ``artifact_key(...)``, the one constructor that starts
+    from the SAME in-memory ``_STEP_CACHE`` key the compiled entry
+    lives under and appends the jax/jaxlib-version + backend-
+    fingerprint fields.  A hand-rolled key dict misses components the
+    way 4 r14 findings missed knobs — except across processes and
+    builds, where the served artifact is a stale or foreign executable
+    rather than a same-process wrong program."""
+
+    id = "aot-key"
+    incident = ("r14 cache-key class, cross-process: an AOT artifact "
+                "keyed without a version/backend/in-memory-key field "
+                "serves a stale executable to a later build")
+
+    def run(self, pkg: Package) -> Iterator[Finding]:
+        for mod in pkg:
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "put"):
+                    continue
+                base = dotted(node.func.value) or ""
+                leaf = base.split(".")[-1].lower()
+                if "store" not in leaf:
+                    continue
+                key_arg = node.args[0] if node.args else next(
+                    (kw.value for kw in node.keywords
+                     if kw.arg == "fields"), None)
+                if key_arg is None or not self._is_blessed(mod, node,
+                                                           key_arg):
+                    yield self.finding(
+                        mod, node.lineno,
+                        "AOT store write with a hand-rolled key — "
+                        "derive it with artifact_key(...) (the audited "
+                        "constructor spanning the in-memory cache key "
+                        "plus jax/jaxlib version and backend "
+                        "fingerprint fields)")
+
+    @staticmethod
+    def _is_key_call(node: ast.AST) -> bool:
+        return isinstance(node, ast.Call) and \
+            (dotted(node.func) or "").split(".")[-1] == "artifact_key"
+
+    def _is_blessed(self, mod: Module, call: ast.Call,
+                    key_arg: ast.AST) -> bool:
+        """Direct ``artifact_key(...)`` argument, or a Name chased to
+        its nearest preceding same-function assignment from one (the
+        CacheKeyRule._resolve_key discipline)."""
+        if self._is_key_call(key_arg):
+            return True
+        if not isinstance(key_arg, ast.Name):
+            return False
+        fn = mod.enclosing(call, (ast.FunctionDef, ast.AsyncFunctionDef))
+        if fn is None:
+            return False
+        best = None
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == key_arg.id
+                    and node.lineno <= call.lineno):
+                if best is None or node.lineno > best.lineno:
+                    best = node
+        return best is not None and self._is_key_call(best.value)
+
+
 # -------------------------------------------------------- suppression
 
 class SuppressionFormatRule(Rule):
@@ -909,5 +980,5 @@ RULES: Dict[str, Rule] = {rule.id: rule for rule in (
     TraceHazardRule(), CacheKeyRule(), DispatchAccountingRule(),
     ObsSpanRule(), CollectiveSpanRule(), QualityCounterRule(),
     ThreadHygieneRule(), CounterResetRule(), DeadPrivateRule(),
-    CacheNameRule(), SuppressionFormatRule(),
+    CacheNameRule(), AotKeyRule(), SuppressionFormatRule(),
 )}

@@ -38,24 +38,17 @@ import numpy as np
 
 
 def enable_compilation_cache() -> None:
-    """Persistent XLA/Mosaic compilation cache (r2 VERDICT #6): the
-    marginal method compiles TWO while_loop programs per config, and on
-    the tunneled platform each remote compile can cost tens of seconds
-    on a slow compile-service day (breakdown in docs/PERFORMANCE.md;
-    experiments/exp_compile_time.py reproduces it).  The cache removes
-    recompiles across processes/runs entirely.  Opt out with
-    JAX_COMPILATION_CACHE_DIR="" (cold-compile measurement).  Lives in
-    the package (not the repo-root bench.py script) so installed users
-    get it too."""
-    import os
+    """Persistent XLA/Mosaic compilation cache (r2 VERDICT #6).
 
-    import jax
-    cache = os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                           "/tmp/kmeans_tpu_jax_cache")
+    ISSUE 15 satellite: the implementation moved to
+    ``utils.aot.enable_compilation_cache`` — library-level, with the
+    ``KMEANS_TPU_COMPILE_CACHE`` env knob, called by the CLI fits too —
+    so the first rung of the warm-start ladder stopped being
+    bench-only.  This delegator keeps the bench surface (and its log
+    line)."""
+    from kmeans_tpu.utils.aot import enable_compilation_cache as enable
+    cache = enable()
     if cache:
-        jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                          1.0)
         _log(f"bench: compilation cache at {cache}")
 
 
@@ -1814,6 +1807,241 @@ def bench_sweep(n: int, d: int, k_values, n_init: int,
     }
     print(json.dumps(row), flush=True)
     return row
+
+
+# --------------------------------------------------------------- TTFI
+
+def _ttfi_payload(records, wall_s: float) -> Dict:
+    """One traced fit -> its TTFI table + the prelude-window overlap
+    figures: ``window_s`` is the measured wall of the pre-first-
+    dispatch work (place/stage/compile span envelope), ``serial_s`` the
+    sum of those phases' SELF times — ``window_s < serial_s`` is the
+    measured proof that ingest and compile ran concurrently (ISSUE
+    15c's committed overlap rule)."""
+    from kmeans_tpu.obs.report import time_to_first_iteration
+    table = time_to_first_iteration(records)
+    spans = [r for r in records if r.get("kind") == "span"]
+    fd = min((s for s in spans if s["name"] == "dispatch"),
+             key=lambda s: s["t0"], default=None)
+    window = serial = None
+    if fd is not None:
+        # Up to the first dispatch's END (the revised ttfi_ladder
+        # rule): a serial fit's explicit aot-build compile span nests
+        # INSIDE the first dispatch, and the window must cover it or
+        # the serial stage-then-compile wall under-measures.
+        fd_end = fd["t1"] if fd.get("t1") is not None else fd["t0"]
+        pre = [s for s in spans
+               if s["name"] in ("place", "stage", "compile")
+               and s["t0"] <= fd_end and s.get("t1") is not None]
+        if pre:
+            window = max(s["t1"] for s in pre) - min(s["t0"] for s in pre)
+            serial = sum(r["ms"] for r in table
+                         if r["phase"] in ("place", "stage",
+                                           "compile")) / 1e3
+    phases = {r["phase"]: r["ms"] for r in table}
+    return {"table": table, "wall_s": wall_s,
+            "ttfi_s": sum(r["ms"] for r in table) / 1e3,
+            "compile_ms": phases.get("compile"),
+            "first_dispatch_ms": phases.get("first_dispatch"),
+            "stage_ms": (phases.get("stage", 0.0)
+                         + phases.get("place", 0.0)),
+            "window_s": window, "serial_s": serial}
+
+
+def ttfi_child() -> None:
+    """Subprocess body of ``bench_ttfi`` (a FRESH process is the only
+    honest cold/AOT-warm boundary): two traced fits at the configured
+    shape — the first is this process's cold (or AOT-warm, when the
+    shared store is populated) row, the second the same-process warm
+    row — printed as one ``TTFI_JSON`` line."""
+    import os
+
+    from kmeans_tpu.obs import trace as obs_trace
+    from kmeans_tpu.models.kmeans import KMeans
+    from kmeans_tpu.utils import aot
+    from kmeans_tpu.utils.profiling import sanitize_json
+    cfg = json.loads(os.environ["KMEANS_TPU_TTFI_CFG"])
+    if cfg.get("compile_cache"):
+        enable_compilation_cache()
+    store = aot.configure(cfg["aot_dir"]) if cfg.get("aot_dir") else None
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(cfg["n"], cfg["d"])).astype(np.float32)
+
+    def run_fit(trace_path=None):
+        model = KMeans(k=cfg["k"], max_iter=cfg["max_iter"],
+                       tolerance=1e-12, seed=0, verbose=False,
+                       host_loop=False, empty_cluster="keep",
+                       bucket="auto", overlap=cfg["overlap"])
+        t0 = time.perf_counter()
+        with obs_trace.tracing(trace_path) as tr:
+            model.fit(X)
+        return (time.perf_counter() - t0, tr.records(),
+                float(np.float64(model.centroids).sum()))
+
+    # Only the FIRST fit writes the trace artifact — it is the
+    # cold/AOT-warm row the bench-diff TTFI guard reads; the second
+    # fit is the same-process-warm row, reported but not persisted.
+    wall1, recs1, sum1 = run_fit(cfg.get("trace_path"))
+    wall2, recs2, sum2 = run_fit()
+    out = {"first": _ttfi_payload(recs1, wall1),
+           "second": _ttfi_payload(recs2, wall2),
+           "centroid_sum": sum1, "centroid_sum_warm": sum2,
+           "aot": store.stats() if store else None}
+    print("TTFI_JSON " + json.dumps(sanitize_json(out)), flush=True)
+
+
+#: Committed decision rules (pre-registered, the repo's publication
+#: discipline): an AOT-warm second process's TTFI compile row must cost
+#: <= this fraction of the cold process's; the overlapped prelude's
+#: measured window must be < its serial phase sum.
+TTFI_AOT_COMPILE_MAX_RATIO = 0.10
+
+
+def _ttfi_spawn(cfg: Dict) -> Dict:
+    """Run one ``ttfi_child`` subprocess and parse its payload."""
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env["KMEANS_TPU_TTFI_CFG"] = json.dumps(cfg)
+    env.pop("KMEANS_TPU_AOT_CACHE", None)   # cfg decides, not ambient env
+    if not cfg.get("compile_cache"):
+        # The COLD row must be genuinely cold: jax reads
+        # JAX_COMPILATION_CACHE_DIR natively, so an ambient value (set
+        # by docs/bench habits) would turn the cold compile into a
+        # persistent-cache disk hit and corrupt the committed
+        # AOT<=10%-of-cold baseline (review finding).
+        env["JAX_COMPILATION_CACHE_DIR"] = ""
+        env.pop("KMEANS_TPU_COMPILE_CACHE", None)
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "from kmeans_tpu.benchmarks import ttfi_child; ttfi_child()"],
+        env=env, capture_output=True, text=True, timeout=1800)
+    for line in proc.stdout.splitlines():
+        if line.startswith("TTFI_JSON "):
+            return json.loads(line[len("TTFI_JSON "):])
+    raise RuntimeError(
+        f"TTFI child produced no payload (exit {proc.returncode}):\n"
+        f"{proc.stderr[-2000:]}")
+
+
+def bench_ttfi(n: int, d: int, k: int, *, max_iter: int = 4,
+               aot_dir: str = None, artifact_dir: str = "artifacts",
+               overlap_reps: int = 3) -> List[Dict]:
+    """BENCH_TTFI=1: measured cold / warm / AOT-warm / overlap
+    time-to-first-iteration rows (ISSUE 15 acceptance).
+
+    Four fresh-process runs against one shared AOT store:
+
+    * **cold** — empty store; the TTFI compile row carries the real
+      XLA build (``compile(via='aot-build')`` spans).
+    * **warm** — the SAME process's second fit (in-memory caches):
+      zero compile time, the standing-fleet bound.
+    * **aot-warm** — a SECOND process against the populated store:
+      compile row = ``via='aot-load'`` deserialize time; committed
+      rule ``<= TTFI_AOT_COMPILE_MAX_RATIO`` x cold.
+    * **overlap** — a third process, fresh store, ``overlap=1``:
+      staged ingest runs in the producer thread while this thread
+      builds; committed rule measured window < serial phase sum.
+
+    Rows print as bench JSON lines (bench-diff-comparable); the cold
+    and AOT-warm traces land in ``artifact_dir`` for the bench-diff
+    TTFI guard."""
+    import os
+    import tempfile
+
+    os.makedirs(artifact_dir, exist_ok=True)
+    aot_dir = aot_dir or tempfile.mkdtemp(prefix="kmeans_tpu_aot_")
+    base = {"n": n, "d": d, "k": k, "max_iter": max_iter,
+            "compile_cache": False, "overlap": 0, "aot_dir": aot_dir}
+    shape = f"N{n}_D{d}_k{k}"
+
+    _log(f"bench: TTFI cold process (store {aot_dir})...")
+    cold = _ttfi_spawn({**base, "trace_path":
+                        os.path.join(artifact_dir, "trace_ttfi_cold.jsonl")})
+    _log(f"bench: TTFI AOT-warm process...")
+    warm2 = _ttfi_spawn({**base, "trace_path":
+                         os.path.join(artifact_dir,
+                                      "trace_ttfi_aotwarm.jsonl")})
+    # The overlap row compares MEASURED walls, not self-time sums: an
+    # interleaved (serial, overlapped) pair of fresh-store processes
+    # per rep — the serial child's place/stage/compile span envelope IS
+    # the stage-then-compile serial wall (overlap=0 runs them
+    # sequentially), the overlapped child's envelope is the concurrent
+    # wall — reduced to medians (the repo's interleaved-pairs method;
+    # thread contention moves single runs ~10% on a shared CPU).
+    ov_runs, ov_windows, ser_windows = [], [], []
+    for i in range(overlap_reps):
+        _log(f"bench: TTFI overlap pair {i + 1}/{overlap_reps} "
+             f"(fresh stores)...")
+        ser = _ttfi_spawn({**base, "overlap": 0,
+                           "aot_dir": tempfile.mkdtemp(
+                               prefix="kmeans_tpu_aot_ser_")})
+        ovl = _ttfi_spawn({**base, "overlap": 1,
+                           "aot_dir": tempfile.mkdtemp(
+                               prefix="kmeans_tpu_aot_ov_")})
+        ov_runs.append(ovl)
+        ser_windows.append(ser["first"]["window_s"])
+        ov_windows.append(ovl["first"]["window_s"])
+    ov_sorted, ser_sorted = sorted(ov_windows), sorted(ser_windows)
+    overlap = ov_runs[ov_windows.index(
+        ov_sorted[len(ov_sorted) // 2])]
+    ov_window = ov_sorted[len(ov_sorted) // 2]
+    ov_serial = ser_sorted[len(ser_sorted) // 2]
+
+    parity = cold["centroid_sum"] == warm2["centroid_sum"] \
+        == overlap["centroid_sum"]
+    c_cold = cold["first"]["compile_ms"] or 0.0
+    c_aot = warm2["first"]["compile_ms"] or 0.0
+    ratio = c_aot / c_cold if c_cold > 0 else None
+    rows = [
+        {"metric": f"ttfi_cold_{shape}", **_row_of(cold["first"]),
+         "aot_built": cold["aot"]["built"]},
+        {"metric": f"ttfi_warm_sameproc_{shape}",
+         **_row_of(cold["second"])},
+        {"metric": f"ttfi_aot_warm_{shape}", **_row_of(warm2["first"]),
+         "aot_loaded": warm2["aot"]["loaded"],
+         "compile_vs_cold": round(ratio, 4) if ratio is not None
+         else None,
+         "rule": f"compile <= {TTFI_AOT_COMPILE_MAX_RATIO} x cold",
+         "rule_pass": bool(ratio is not None
+                           and ratio <= TTFI_AOT_COMPILE_MAX_RATIO)},
+        {"metric": f"ttfi_overlap_{shape}",
+         **_row_of(overlap["first"]),
+         "overlap_window_s": round(ov_window, 4),
+         "serial_wall_s": round(ov_serial, 4),
+         "overlap_window_reps": [round(w, 4) for w in ov_sorted],
+         "serial_wall_reps": [round(s, 4) for s in ser_sorted],
+         "overlap_speedup": (round(ov_serial / ov_window, 3)
+                             if ov_window else None),
+         "spread": (round((ov_sorted[-1] - ov_sorted[0])
+                          / ov_window, 3) if ov_window else None),
+         "rule": "median overlapped window < median serial "
+                 "stage-then-compile wall",
+         "rule_pass": bool(ov_window < ov_serial)},
+    ]
+    for r in rows:
+        r["bit_parity_across_processes"] = parity
+        print(json.dumps(r), flush=True)
+    _log("\n| row | ttfi s | compile ms | first_dispatch ms | rule |")
+    _log("|---|---|---|---|---|")
+    for r in rows:
+        _log(f"| {r['metric']} | {r['ttfi_s']:.3f} | "
+             f"{r['compile_ms'] if r['compile_ms'] is not None else '-'}"
+             f" | {r['first_dispatch_ms']:.1f} | "
+             f"{r.get('rule', '-')}"
+             f"{' PASS' if r.get('rule_pass') else ''} |")
+    return rows
+
+
+def _row_of(payload: Dict) -> Dict:
+    return {"ttfi_s": round(payload["ttfi_s"], 4),
+            "wall_s": round(payload["wall_s"], 3),
+            "compile_ms": (round(payload["compile_ms"], 2)
+                           if payload["compile_ms"] is not None
+                           else None),
+            "stage_ms": round(payload["stage_ms"], 2),
+            "first_dispatch_ms": round(payload["first_dispatch_ms"], 2)}
 
 
 def main(argv=None) -> int:

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -35,12 +36,21 @@ def _load_matrix(path: str, npz_key: str) -> np.ndarray:
     return np.load(p)
 
 
+def _parse_bucket(raw):
+    """The ``--bucket`` grammar shared by ``fit`` and ``warm``:
+    'auto' | an int >= 0 (0 = exact shape) — validated by the same
+    ``check_bucket`` the model constructors use."""
+    from kmeans_tpu.parallel.sharding import check_bucket
+    return check_bucket(raw if raw == "auto" else int(raw))
+
+
 def _build_model(args):
     from kmeans_tpu import (BisectingKMeans, KMeans, MiniBatchKMeans,
                             SphericalKMeans)
     common = dict(k=args.k, max_iter=args.max_iter, tolerance=args.tolerance,
                   seed=args.seed, compute_sse=args.sse, init=args.init,
-                  n_init=args.n_init, verbose=not args.quiet)
+                  n_init=args.n_init, verbose=not args.quiet,
+                  bucket=_parse_bucket(getattr(args, "bucket", 0)))
     if args.model == "minibatch":
         # n_init > 1 selects the best-scoring candidate init
         # (sklearn-style), then runs one training session.
@@ -71,6 +81,16 @@ def main(argv=None) -> int:
                         help="minibatch model only")
     parser.add_argument("--sse", action="store_true",
                         help="track SSE per iteration")
+    parser.add_argument("--bucket", default="0",
+                        help="fit-shape bucket: 0 (exact, default) | "
+                             "auto (committed ladder) | int step "
+                             "(ISSUE 15: warm fleets reuse one "
+                             "compiled program across nearby sizes)")
+    parser.add_argument("--aot-cache", default=None, metavar="DIR",
+                        help="AOT executable cache directory (also via "
+                             "KMEANS_TPU_AOT_CACHE): serialized "
+                             "compiled programs load here instead of "
+                             "trace+compile on later runs")
     parser.add_argument("--out-dir", default=".",
                         help="where centroids.npy/labels.npy/summary.json go")
     parser.add_argument("--no-labels", action="store_true",
@@ -86,6 +106,20 @@ def main(argv=None) -> int:
     if X.ndim != 2:
         print(f"error: expected (n, D) matrix, got shape {X.shape}",
               file=sys.stderr)
+        return 2
+    # First rung of the warm-start ladder (ISSUE 15 satellite): the
+    # persistent compilation cache is library-level now — every CLI fit
+    # gets it, not just bench runs (KMEANS_TPU_COMPILE_CACHE="" opts
+    # out).
+    from kmeans_tpu.utils import aot
+    aot.enable_compilation_cache()
+    if args.aot_cache:
+        aot.configure(args.aot_cache)
+    try:
+        args.bucket = _parse_bucket(args.bucket)
+    except ValueError:
+        print(f"error: --bucket must be 'auto' or an int, got "
+              f"{args.bucket!r}", file=sys.stderr)
         return 2
     model = _build_model(args)
 
@@ -726,7 +760,13 @@ def serve_status_main(argv=None) -> int:
 _BENCH_LOWER_BETTER = ("ms_per_iter", "p50_ms", "p99_ms",
                        "overhead_x", "overhead_ratio",
                        "cpu_init_device_s", "batched_s", "resume_ms",
-                       "save_ms")
+                       "save_ms",
+                       # TTFI rows (ISSUE 15): span-table phase costs
+                       # and the BENCH_TTFI cold/warm/AOT-warm rows —
+                       # cold->warm regressions in time-to-first-
+                       # iteration guard like ms/iter rows.
+                       "ms", "ttfi_s", "compile_ms", "first_dispatch_ms",
+                       "overlap_window_s")
 _BENCH_HIGHER_BETTER = ("value", "pts_dims_per_s_chip", "qps",
                         "speedup_vs_sequential", "overlap_speedup",
                         "step_mfu")
@@ -742,14 +782,34 @@ _BENCH_DEFAULT_SPREAD = 0.05
 _BENCH_DISCRIMINATORS = ("batch_requests", "batch", "clients")
 
 
+def _ttfi_trace_rows(records) -> list:
+    """A trace JSONL artifact (``artifacts/trace_ttfi.jsonl``-class:
+    span records from ``obs.tracing``) rendered as bench-diff rows —
+    one ``ttfi <phase>`` row per phase with its ``ms`` (ISSUE 15
+    satellite: cold->warm TTFI regressions guard the same way ms/iter
+    rows do).  Returns [] when the trace holds no dispatch span."""
+    from kmeans_tpu.obs.report import time_to_first_iteration
+    try:
+        table = time_to_first_iteration(records)
+    except ValueError:
+        return []
+    return [{"metric": f"ttfi {r['phase']}", "ms": r["ms"]}
+            for r in table]
+
+
 def _bench_rows(doc) -> dict:
     """Comparable rows out of any bench artifact shape: BASELINE.json
     (``published.rows`` + the northstar), a BENCH_r*.json wrapper
-    (``parsed``), a raw bench payload, or a LIST of rows (JSONL
-    artifacts parse to one).  Key = the row's ``metric`` else
-    ``config``+``model``; same-key groups disambiguate instead of
-    silently collapsing (review finding: 3 of the 4 serving rows were
-    invisible to the guard)."""
+    (``parsed``), a raw bench payload, a LIST of rows (JSONL
+    artifacts parse to one), or a TTFI trace JSONL (span records —
+    converted to per-phase ``ttfi <phase>`` rows).  Key = the row's
+    ``metric`` else ``config``+``model``; same-key groups disambiguate
+    instead of silently collapsing (review finding: 3 of the 4 serving
+    rows were invisible to the guard)."""
+    if isinstance(doc, list) and any(
+            isinstance(r, dict) and r.get("kind") == "span"
+            for r in doc):
+        doc = _ttfi_trace_rows(doc)
     rows = []
     if isinstance(doc, dict) and "published" in doc:
         pub = doc["published"]
@@ -914,6 +974,164 @@ def lint_main(argv=None) -> int:
     return main(argv)
 
 
+#: warm-command family table: model_class name -> import path the
+#: loader resolves (every family's ``.load`` accepts any-family
+#: checkpoints being rejected with a pointed error).
+_WARM_FAMILIES = ("kmeans", "minibatch", "bisecting", "spherical", "gmm")
+
+
+def _warm_class(name: str):
+    import kmeans_tpu as kt
+    table = {"kmeans": kt.KMeans, "minibatch": kt.MiniBatchKMeans,
+             "bisecting": kt.BisectingKMeans,
+             "spherical": kt.SphericalKMeans,
+             "gmm": kt.GaussianMixture,
+             # model_class names from checkpoint metadata
+             "KMeans": kt.KMeans, "MiniBatchKMeans": kt.MiniBatchKMeans,
+             "BisectingKMeans": kt.BisectingKMeans,
+             "SphericalKMeans": kt.SphericalKMeans,
+             "GaussianMixture": kt.GaussianMixture}
+    return table.get(name)
+
+
+def warm_main(argv=None) -> int:
+    """``python -m kmeans_tpu warm <ckpt | --family F --shape NxD --k K>``
+    — pre-populate the AOT executable cache for a (family, bucket,
+    mesh, dtype) set (ISSUE 15 satellite): one synthetic fit at the
+    bucketed shape compiles (or loads) the real step/fit/predict
+    programs with the AOT store active, so the NEXT process — a fresh
+    host resuming a shipped checkpoint, a standing fleet accepting a
+    new fit — starts with ``compile(via='aot-load')`` rows instead of
+    trace+compile.
+
+    With a checkpoint argument the model's own hyperparameters drive
+    the programs and the artifacts are ALSO mirrored into the sibling
+    ``<ckpt>.aot`` directory (what ships with the checkpoint).  Prints
+    what was compiled vs loaded; ``--json`` emits the machine-readable
+    stats.  Exit 2 when the backend cannot serialize executables
+    (``available=False``) or the arguments don't resolve."""
+    parser = argparse.ArgumentParser(
+        prog="python -m kmeans_tpu warm",
+        description="Pre-populate the AOT executable cache for a "
+                    "(family, bucket, mesh, dtype) set")
+    parser.add_argument("ckpt", nargs="?", default=None,
+                        help="checkpoint whose model (and sibling "
+                             ".aot dir) to warm")
+    parser.add_argument("--family", choices=_WARM_FAMILIES,
+                        default="kmeans",
+                        help="model family (no-checkpoint form)")
+    parser.add_argument("--shape", default=None, metavar="NxD",
+                        help="data shape to warm, e.g. 8192x32 "
+                             "(default: 8192 rows x the checkpoint's "
+                             "feature count)")
+    parser.add_argument("--k", type=int, default=8,
+                        help="clusters/components (no-checkpoint form)")
+    parser.add_argument("--dtype", default="float32")
+    parser.add_argument("--bucket", default="auto",
+                        help="fit-shape bucket the programs commit to "
+                             "(default auto)")
+    parser.add_argument("--model-shards", type=int, default=1,
+                        help="TP centroid-sharding axis size")
+    parser.add_argument("--max-iter", type=int, default=None,
+                        help="device-loop segment length to warm "
+                             "(default: the model's max_iter)")
+    parser.add_argument("--aot-dir", default=None, metavar="DIR",
+                        help="store directory (default: "
+                             "KMEANS_TPU_AOT_CACHE or "
+                             "/tmp/kmeans_tpu_aot)")
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args(argv)
+
+    from kmeans_tpu.utils import aot
+    aot.enable_compilation_cache()
+    ok, reason = aot.aot_supported()
+    if not ok:
+        print(f"error: this backend cannot serialize compiled "
+              f"executables ({reason}); the AOT cache is unavailable "
+              f"(available=False)", file=sys.stderr)
+        return 2
+    try:
+        bucket = _parse_bucket(args.bucket)
+    except ValueError:
+        print(f"error: --bucket must be 'auto' or an int, got "
+              f"{args.bucket!r}", file=sys.stderr)
+        return 2
+    root = args.aot_dir or os.environ.get("KMEANS_TPU_AOT_CACHE") \
+        or "/tmp/kmeans_tpu_aot"
+    mirror = aot.aot_dir_for(args.ckpt) if args.ckpt else None
+    store = aot.configure(root, mirror=mirror)
+
+    d = None
+    if args.ckpt:
+        from kmeans_tpu.utils.checkpoint import describe_checkpoint
+        info = describe_checkpoint(args.ckpt)
+        cls = _warm_class(info.get("model_class") or "")
+        if cls is None:
+            print(f"error: {args.ckpt}: no loadable model "
+                  f"(model_class={info.get('model_class')!r}, "
+                  f"primary_error={info.get('primary_error')!r})",
+                  file=sys.stderr)
+            return 2
+        model = cls.load(args.ckpt)
+        table = getattr(model, "centroids", None)
+        if table is None:
+            table = getattr(model, "means_", None)
+        d = int(np.asarray(table).shape[1]) if table is not None else None
+    else:
+        cls = _warm_class(args.family)
+        kwargs = dict(seed=0, verbose=False)
+        model = cls(**({"n_components": args.k} if args.family == "gmm"
+                       else {"k": args.k}), dtype=args.dtype, **kwargs)
+    if args.shape:
+        try:
+            n, d = (int(v) for v in args.shape.lower().split("x"))
+        except ValueError:
+            print(f"error: --shape must be NxD (e.g. 8192x32), got "
+                  f"{args.shape!r}", file=sys.stderr)
+            return 2
+    else:
+        n = 8192
+        if d is None:
+            print("error: --shape NxD is required without a fitted "
+                  "checkpoint (the feature count cannot be inferred)",
+                  file=sys.stderr)
+            return 2
+    if args.max_iter is not None:
+        model.max_iter = args.max_iter
+    model.bucket = bucket
+    if hasattr(model, "model_shards"):
+        model.model_shards = args.model_shards
+        model.mesh = None                       # re-resolve for the TP axis
+    # The warm fit: synthetic rows at the bucketed shape through the
+    # REAL fit engine (device loop where the family has one), so the
+    # programs warmed are the programs a real fit keys.
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, d)).astype(model.dtype)
+    model.verbose = False
+    if hasattr(model, "host_loop"):
+        model.host_loop = False
+    fit_k = getattr(model, "k", getattr(model, "n_components", 2))
+    if n < fit_k:
+        print(f"error: shape rows ({n}) must be >= k ({fit_k})",
+              file=sys.stderr)
+        return 2
+    model.fit(X)
+    stats = store.stats()
+    out = {"family": type(model).__name__, "n": n, "d": d,
+           "k": int(fit_k), "bucket": bucket,
+           "dtype": str(np.dtype(model.dtype)),
+           "ckpt": args.ckpt, **stats}
+    if args.json:
+        print(json.dumps(out))
+    else:
+        print(f"warm: {out['family']} k={out['k']} {n}x{d} "
+              f"bucket={bucket} -> compiled {stats['built']}, "
+              f"loaded {stats['loaded']} (store {stats['root']}"
+              + (f", shipped to {stats['mirror']}" if stats["mirror"]
+                 else "") + ")")
+    return 0
+
+
 def ckpt_info_main(argv=None) -> int:
     """``python -m kmeans_tpu ckpt-info <path>`` — print a checkpoint's
     metadata block (model class, k, completed iteration, the mesh shape
@@ -932,6 +1150,10 @@ def ckpt_info_main(argv=None) -> int:
 
     from kmeans_tpu.utils.checkpoint import describe_checkpoint
     info = describe_checkpoint(args.path)
+    # AOT block (ISSUE 15 satellite): the executables shipped next to
+    # this checkpoint (<path>.aot), described without device init.
+    from kmeans_tpu.utils import aot
+    info["aot"] = aot.describe_dir(aot.aot_dir_for(args.path))
     if args.json:
         print(json.dumps(info, indent=2))
         return 0 if info.get("source") else 2
@@ -959,6 +1181,19 @@ def ckpt_info_main(argv=None) -> int:
         + (f", loads={info['prev_loads']}" if info["prev_exists"]
            else ""),
     ]
+    a = info["aot"]
+    if a["exists"]:
+        progs = ", ".join(f"{p['cache']}@{p['platform']}"
+                          for p in a["programs"]) or "-"
+        lines.append(
+            f"aot executables : {a['artifacts']} artifacts "
+            f"({a['bytes']:,} B) in {a['path']} [{progs}]"
+            + (f", {a['unreadable']} unreadable" if a["unreadable"]
+               else ""))
+    else:
+        lines.append(
+            "aot executables : none shipped (run `python -m kmeans_tpu "
+            "warm <ckpt>` to pre-populate)")
     if info.get("primary_error"):
         lines.append(f"primary error   : {info['primary_error']}")
     print("\n".join(lines))

@@ -152,6 +152,15 @@ class AutoCheckpointMixin:
                 "n_init == 1: a restart sweep re-initializes, so a "
                 "partially-swept fit has no well-defined resume point")
         self._active_ckpt_path = checkpoint_path if n > 0 else None
+        if n > 0:
+            # AOT artifact shipping (ISSUE 15a): with an executable
+            # store active, everything this fit compiles is mirrored
+            # into the checkpoint's sibling ``<path>.aot`` directory —
+            # so state + executables travel together and an elastic
+            # restart on a fresh host skips the compile column.  A
+            # no-op without a store.
+            from kmeans_tpu.utils import aot as _aot
+            _aot.on_checkpoint_path(checkpoint_path)
         # Rollback is only legal once THIS fit has a stake in the path:
         # a checkpoint it wrote, or the state it resumed from.  Without
         # this, a diverging fit that reuses a path from an earlier,
@@ -321,6 +330,13 @@ class AutoCheckpointMixin:
             self._resumed_from = None
             return bool(resume)
         self._resumed_from = os.fspath(resume)
+        # AOT read path (ISSUE 15a): executables shipped next to the
+        # checkpoint (``<path>.aot``) join the store's lookup dirs, so
+        # a resume — including onto a new mesh on a fresh host — loads
+        # instead of compiling whatever programs match this topology.
+        # A no-op without an active store.
+        from kmeans_tpu.utils import aot as _aot
+        _aot.on_resume_path(resume)
         state, used_prev = ckpt.load_state_with_fallback(resume)
         if used_prev:
             import warnings

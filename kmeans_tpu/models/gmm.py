@@ -181,7 +181,7 @@ class GaussianMixture(AutoCheckpointMixin):
                     "max_iter", "n_init", "init_params", "weights_init",
                     "means_init", "precisions_init", "seed", "dtype",
                     "mesh", "model_shards", "chunk_size", "host_loop",
-                    "pipeline", "verbose")
+                    "pipeline", "bucket", "verbose")
 
     _ckpt_k_attr = "n_components"    # AutoCheckpointMixin resume check
 
@@ -193,6 +193,7 @@ class GaussianMixture(AutoCheckpointMixin):
                  seed: int = 42, dtype=None, mesh: Optional[Mesh] = None,
                  model_shards: int = 1, chunk_size: Optional[int] = None,
                  host_loop: bool = True, pipeline="auto",
+                 bucket=0,
                  verbose: bool = False):
         if covariance_type not in ("diag", "spherical", "tied", "full"):
             raise ValueError(
@@ -243,6 +244,13 @@ class GaussianMixture(AutoCheckpointMixin):
             raise ValueError(f"pipeline must be 'auto', 0, or 1; got "
                              f"{pipeline!r}")
         self.pipeline = pipeline if pipeline == "auto" else int(pipeline)
+        # Fit-shape bucket (ISSUE 15b; the KMeans knob grammar): 0 is
+        # the exact-shape bit-parity oracle, 'auto' pads the staged
+        # shard to the committed ladder boundary so nearby dataset
+        # sizes share one compiled EM program.  Grammar/policy shared
+        # with KMeans via parallel.sharding (one definition).
+        from kmeans_tpu.parallel.sharding import check_bucket
+        self.bucket = check_bucket(bucket)
         self.verbose = verbose
 
         # Which E-step schedule the last fit IN THIS PROCESS ran
@@ -356,12 +364,24 @@ class GaussianMixture(AutoCheckpointMixin):
         # measured for.
         eff_k = (self.n_components * X.shape[1]
                  if self.covariance_type == "full" else self.n_components)
+        # Shape bucket (ISSUE 15b): the chunk derives from the BUCKETED
+        # row count and the shard pads up to it, so same-bucket fits
+        # share one compiled EM program; bucket=0 (default) is the
+        # exact-shape parity oracle.
+        n_eff = self._bucket_target(X.shape[0])
         chunk = self.chunk_size or choose_chunk_size(
-            -(-X.shape[0] // data_shards), eff_k, X.shape[1],
+            -(-n_eff // data_shards), eff_k, X.shape[1],
             budget_elems=EM_CHUNK_BUDGET)
         return to_device(X, mesh, chunk, self.dtype,
                          sample_weight=sample_weight,
-                         explicit=self.chunk_size is not None)
+                         explicit=self.chunk_size is not None,
+                         min_rows=n_eff)
+
+    def _bucket_target(self, n: int) -> int:
+        """Padded-row target of the fit-shape bucket — the one
+        committed policy in ``parallel.sharding.bucket_target``."""
+        from kmeans_tpu.parallel.sharding import bucket_target
+        return bucket_target(self.bucket, n)
 
     @property
     def _k_pad(self) -> int:
@@ -2110,7 +2130,7 @@ class GaussianMixture(AutoCheckpointMixin):
             "init_params": self.init_params, "seed": self.seed,
             "model_shards": self.model_shards,
             "chunk_size": self.chunk_size, "host_loop": self.host_loop,
-            "pipeline": self.pipeline,
+            "pipeline": self.pipeline, "bucket": self.bucket,
             "verbose": self.verbose, "dtype": str(self.dtype),
             "weights_": np.asarray(self.weights_)
             if self.weights_ is not None else np.zeros((0,)),
@@ -2241,6 +2261,9 @@ class GaussianMixture(AutoCheckpointMixin):
                                 None),
                     host_loop=bool(state.get("host_loop", True)),
                     pipeline=pipeline,
+                    # Pre-r19 checkpoints carry no bucket -> exact shape.
+                    bucket=(lambda b: b if isinstance(b, str)
+                            else int(b))(state.get("bucket", 0)),
                     verbose=bool(state["verbose"]),
                     dtype=np.dtype(str(state["dtype"])), **inits)
         model._restore_state(state)

@@ -203,6 +203,32 @@ class KMeans(AutoCheckpointMixin):
         only; `empty_cluster='farthest'` rejected (both pointed errors);
         `bf16_guard_corrected_rows_` audits the per-fit correction count
         on device-loop fits).
+    bucket : 0 (default) | 'auto' | int — the fit-shape bucket (ISSUE
+        15b, serving's batch-bucket discipline applied to training row
+        counts).  0 pads the staged shard exactly to the shard/chunk
+        multiple — the bit-parity oracle, identical to every fit before
+        this knob existed.  'auto' pads up to the next committed bucket
+        boundary (``parallel.sharding.bucket_rows``: {1, 1.25, 1.5,
+        1.75} x 2^e rows, <= 25% padding worst-case) with the existing
+        inert zero-weight sentinel rows, and derives the scan chunk
+        from the BUCKETED count — so nearby dataset sizes commit to one
+        padded shape and one compiled program, and a standing fleet
+        (or a second-process AOT-cache hit, ``utils.aot``) accepts a
+        new fit with zero compiles (``recompilation_sentinel`` pins a
+        second same-bucket fit at zero new cache entries).  An int is
+        an explicit boundary step: rows pad to the next multiple of it.
+        Same-data results differ from ``bucket=0`` only in fp summation
+        fold (the extra all-zero chunks), never semantics.
+    overlap : 'auto' (default) | 0 | 1 — compile/ingest overlap (ISSUE
+        15c): with 1, a fit on a host array stages the upload through
+        the prefetch producer thread while THIS thread resolves the
+        step programs — AOT-load (or trace+compile) concurrently with
+        the transfer, so the two TTFI terms stop being serial.  The
+        work and its arithmetic are identical (bit-exact parity with
+        0 — only WHERE the prelude runs moves); 'auto' resolves 0 on
+        CPU (both terms are small; keeps the serial trace shape) and 1
+        on accelerators, where the transfer is the dominant TTFI term
+        (docs/PERFORMANCE.md).
     host_loop : True (reference per-iteration driver semantics: host-side
         f64 division, per-iteration logging, host empty-cluster policy) |
         False (the WHOLE fit as one device-side ``lax.while_loop``
@@ -260,6 +286,8 @@ class KMeans(AutoCheckpointMixin):
                  distance_mode: str = "auto",
                  host_loop: Union[bool, str] = "auto",
                  pipeline: Union[str, int] = "auto",
+                 bucket: Union[str, int] = 0,
+                 overlap: Union[str, int] = "auto",
                  verbose: bool = True):
         self.k = k
         self.max_iter = max_iter
@@ -327,6 +355,16 @@ class KMeans(AutoCheckpointMixin):
             raise ValueError(f"pipeline must be 'auto', 0, or 1; got "
                              f"{pipeline!r}")
         self.pipeline = pipeline if pipeline == "auto" else int(pipeline)
+        # Fit-shape bucket + compile/ingest overlap (ISSUE 15; the
+        # pipeline knob grammar: 0 is the bit-parity oracle).  Grammar
+        # and target policy live in parallel.sharding — one definition
+        # for both families and the CLI.
+        from kmeans_tpu.parallel.sharding import check_bucket
+        self.bucket = check_bucket(bucket)
+        if overlap not in ("auto", 0, 1, True, False):
+            raise ValueError(f"overlap must be 'auto', 0, or 1; got "
+                             f"{overlap!r}")
+        self.overlap = overlap if overlap == "auto" else int(overlap)
         if isinstance(host_loop, str):
             if host_loop != "auto":
                 raise ValueError(f"host_loop must be True, False, or "
@@ -461,8 +499,18 @@ class KMeans(AutoCheckpointMixin):
         budget/clamp must be computed against (r5 review)."""
         return self.k * d if self._mode(n, d) == "direct" else self.k
 
+    def _bucket_target(self, n: int) -> int:
+        """Padded-row target of the fit-shape bucket (ISSUE 15b): the
+        one committed policy in ``parallel.sharding.bucket_target``."""
+        from kmeans_tpu.parallel.sharding import bucket_target
+        return bucket_target(self.bucket, n)
+
     def _chunk_for(self, n: int, d: int) -> int:
         data_shards, model_shards = mesh_shape(self._resolve_mesh())
+        # Chunk derives from the BUCKETED count, so every size in a
+        # bucket commits to one (padded shape, chunk) and therefore one
+        # compiled program (ISSUE 15b); bucket=0 leaves n untouched.
+        n = self._bucket_target(n)
         return self.chunk_size or choose_chunk_size(
             -(-n // data_shards), max(self._tile_k(n, d), model_shards), d)
 
@@ -493,7 +541,8 @@ class KMeans(AutoCheckpointMixin):
         return to_device(X, self._resolve_mesh(),
                          self._chunk_for(*X.shape), self.dtype,
                          sample_weight=sample_weight,
-                         explicit=self.chunk_size is not None)
+                         explicit=self.chunk_size is not None,
+                         min_rows=self._bucket_target(X.shape[0]))
 
     def _dataset(self, X) -> ShardedDataset:
         """Accept an (n, D) array-like or an already-cached ShardedDataset."""
@@ -510,14 +559,41 @@ class KMeans(AutoCheckpointMixin):
             return X
         return self.cache(X)
 
-    def _prepare(self, X):
+    def _resolve_overlap(self) -> int:
+        """Resolve the ``overlap`` knob (ISSUE 15c): serial on CPU
+        (both TTFI terms are small there — keeps the default trace
+        shape), overlapped on accelerators, where the staged transfer
+        is the dominant term the compile should hide behind."""
+        if self.overlap == "auto":
+            return 0 if jax.default_backend() == "cpu" else 1
+        return int(self.overlap)
+
+    def _prepare(self, X, checkpoint_every: Optional[int] = None,
+                 start_iter: int = 0):
         """Place the data; build (or fetch cached) step functions.
 
         Step functions are built for the dataset's OWN chunk size (its
         padding commits to it), which may differ from what ``_chunk_for``
         would pick for this model's k — clamped to a safe divisor when
         the load-time k_hint undershot this model's k
-        (ShardedDataset.effective_chunk)."""
+        (ShardedDataset.effective_chunk).
+
+        Compile/ingest overlap (ISSUE 15c): with ``overlap`` resolved
+        on and a HOST-array input (its shapes — and therefore the chunk
+        and every program key — are known before any data moves), the
+        upload runs in the prefetch producer thread while this thread
+        resolves (and, with an AOT store active, loads-or-compiles) the
+        programs.  ``checkpoint_every`` is the fit path's hint for
+        which device-loop program to pre-warm (None: inference caller,
+        step/predict only); ``start_iter`` is the resume offset, so a
+        resumed fit warms the segment length it will actually dispatch
+        (review finding)."""
+        if self._resolve_overlap() and not isinstance(X, ShardedDataset) \
+                and jax.process_count() == 1:
+            prep = self._prepare_overlapped(X, checkpoint_every,
+                                            start_iter)
+            if prep is not None:
+                return prep
         ds = self._dataset(X)
         mesh = self._resolve_mesh()
         _, model_shards = mesh_shape(mesh)
@@ -525,6 +601,97 @@ class KMeans(AutoCheckpointMixin):
         step_fn, predict_fn = _get_step_fns(mesh, self._eff_chunk(ds), mode,
                                             self._resolve_pipeline(mode))
         return ds, mesh, model_shards, step_fn, predict_fn
+
+    def _prepare_overlapped(self, X, checkpoint_every: Optional[int],
+                            start_iter: int = 0):
+        """The overlapped fit prelude: one-item prefetch producer stages
+        the upload (``cache``; its 'place'/'stage' spans land on the
+        producer tid) while the consumer thread resolves the step
+        programs and pre-warms the AOT executables for the exact padded
+        shapes the fit will dispatch.  Returns None when the input
+        isn't a plain (n, D) host array — the serial path then applies
+        its own validation — and falls back to the serial key
+        derivation if the staged dataset ended up on a different chunk
+        (cannot happen for self-cached data; defensive)."""
+        from kmeans_tpu.data.prefetch import close_source, prefetch_iter
+        X = np.asarray(X, dtype=self.dtype)
+        if X.ndim != 2:
+            return None
+        n, d = X.shape
+        mesh = self._resolve_mesh()
+        _, model_shards = mesh_shape(mesh)
+        mode = self._mode(n, d)
+        chunk = self._chunk_for(n, d)
+        pipeline = self._resolve_pipeline(mode)
+        it = prefetch_iter([X], 1, stage=self.cache)
+        try:
+            step_fn, predict_fn = _get_step_fns(mesh, chunk, mode,
+                                                pipeline)
+            self._warm_aot(mesh, model_shards, n, d, chunk, mode,
+                           pipeline, checkpoint_every, start_iter,
+                           step_fn, predict_fn)
+            ds = next(it)
+        finally:
+            close_source(it)
+        if self._eff_chunk(ds) != chunk:  # pragma: no cover — defensive
+            step_fn, predict_fn = _get_step_fns(
+                mesh, self._eff_chunk(ds), mode, pipeline)
+        return ds, mesh, model_shards, step_fn, predict_fn
+
+    def _warm_aot(self, mesh, model_shards: int, n: int, d: int,
+                  chunk: int, mode: str, pipeline: int,
+                  checkpoint_every: Optional[int], start_iter: int,
+                  step_fn, predict_fn) -> None:
+        """Pre-resolve AOT executables for the shapes this fit will
+        dispatch (ISSUE 15c), overlapping the load-or-compile with the
+        staged ingest.  A no-op without an active AOT store (the cache
+        entries are then plain jitted functions with no ``warm``).
+        Signatures are built from sharding-carrying
+        ``ShapeDtypeStruct``s that normalize identically to the real
+        arrays (``utils.aot._shard_sig``)."""
+        if not (hasattr(step_fn, "warm") or hasattr(predict_fn, "warm")):
+            return
+        from jax.sharding import NamedSharding
+        data_shards, _ = mesh_shape(mesh)
+        n_pad = -(-max(self._bucket_target(n), n)
+                  // (data_shards * chunk)) * (data_shards * chunk)
+        k_pad = -(-self.k // model_shards) * model_shards
+        pts = jax.ShapeDtypeStruct(
+            (n_pad, d), self.dtype,
+            sharding=NamedSharding(mesh, P(DATA_AXIS, None)))
+        wts = jax.ShapeDtypeStruct(
+            (n_pad,), self.dtype,
+            sharding=NamedSharding(mesh, P(DATA_AXIS)))
+        cents = jax.ShapeDtypeStruct((k_pad, d), self.dtype,
+                                     sharding=dist.centroid_sharding(mesh))
+        # Warm only the programs THIS fit will dispatch: the per-
+        # iteration step program is host-loop-only (a device-loop fit
+        # never calls it), and the assignment program only runs when
+        # the fit materializes labels_ — warming an unused program
+        # would spend real compile seconds inside the TTFI window.
+        if hasattr(step_fn, "warm") and self.host_loop is not False:
+            step_fn.warm(pts, wts, cents)
+        if hasattr(predict_fn, "warm") and self.compute_labels \
+                and self._eager_labels:
+            predict_fn.warm(pts, cents,
+                            jax.ShapeDtypeStruct((), np.int32))
+        # The one-dispatch training program, when this fit will
+        # certainly take it (explicit host_loop=False, single restart):
+        # the same key/builder AND the same first-segment length the
+        # dispatch computes (_fit_on_device: seg is measured from the
+        # RESUME offset — warming seg=max_iter for a resumed fit would
+        # build a program the fit never dispatches, review finding).
+        if self.host_loop is False and self.n_init == 1 \
+                and checkpoint_every is not None:
+            remaining = self.max_iter - start_iter
+            seg = (min(checkpoint_every, remaining) if checkpoint_every
+                   else remaining)
+            if seg <= 0:
+                return
+            fit_fn = self._get_fit_fn(mesh, chunk, mode, seg, pipeline)
+            if hasattr(fit_fn, "warm"):
+                fit_fn.warm(pts, wts, cents,
+                            jax.ShapeDtypeStruct((seg,), np.uint32))
 
     def _put_centroids(self, centroids: np.ndarray, mesh: Mesh,
                        model_shards: int) -> jax.Array:
@@ -913,7 +1080,10 @@ class KMeans(AutoCheckpointMixin):
                                             checkpoint_path)
         log = IterationLogger(self.verbose and jax.process_index() == 0)
         X = self._apply_sample_weight(X, sample_weight)
-        ds, mesh, model_shards, step_fn, _ = self._prepare(X)
+        ds, mesh, model_shards, step_fn, _ = self._prepare(
+            X, checkpoint_every=checkpoint_every,
+            start_iter=(self.iterations_run
+                        if resume and self.centroids is not None else 0))
         self._set_fit_data(ds)                        # feeds lazy labels_
         # Fleet prelude (ISSUE 13): per-host row count for the heartbeat
         # rows_per_sec derivation, and the fit-start clock anchor the
@@ -1517,19 +1687,7 @@ class KMeans(AutoCheckpointMixin):
             # step fn at a smaller tile and replay the segment from
             # this boundary (== the last checkpoint, ISSUE 5).
             def dispatch(c, _seg=seg, _it0=it0):
-                key = (mesh, c, mode, self.k, _seg,
-                       float(self.tolerance), self.empty_cluster,
-                       self.compute_sse, self._device_project, pipeline,
-                       "fit")
-                fit_fn = _STEP_CACHE.get_or_create(
-                    key, lambda: dist.make_fit_fn(
-                        mesh, chunk_size=c, mode=mode,
-                        k_real=self.k, max_iter=_seg,
-                        tolerance=float(self.tolerance),
-                        empty_policy=self.empty_cluster,
-                        history_sse=self.compute_sse,
-                        project=self._device_project,
-                        pipeline=pipeline))
+                fit_fn = self._get_fit_fn(mesh, c, mode, _seg, pipeline)
                 return fit_fn(ds.points, ds.weights, cents_dev,
                               dist._empty_seed_array(seed, _it0, _seg))
 
@@ -1577,6 +1735,28 @@ class KMeans(AutoCheckpointMixin):
             np.concatenate(shift_parts) if shift_parts else np.zeros(0),
             counts, time.perf_counter() - fit_start, log)
         return self
+
+    def _get_fit_fn(self, mesh, chunk: int, mode: str, seg: int,
+                    pipeline: int):
+        """The cached one-dispatch training program for one segment
+        length — ONE key derivation shared by the dispatch closure
+        (``_fit_on_device``) and the prelude AOT warm-up
+        (``_warm_aot``), so the two can never drift apart and warm a
+        different program than the fit runs (the r14 cache-key
+        incident class)."""
+        key = (mesh, chunk, mode, self.k, seg,
+               float(self.tolerance), self.empty_cluster,
+               self.compute_sse, self._device_project, pipeline,
+               "fit")
+        return _STEP_CACHE.get_or_create(
+            key, lambda: dist.make_fit_fn(
+                mesh, chunk_size=chunk, mode=mode,
+                k_real=self.k, max_iter=seg,
+                tolerance=float(self.tolerance),
+                empty_policy=self.empty_cluster,
+                history_sse=self.compute_sse,
+                project=self._device_project,
+                pipeline=pipeline))
 
     def _finish_device_fit(self, cents, n_iters: int, start_iter: int,
                            sse_hist, shift_hist, counts, elapsed: float,
@@ -2300,7 +2480,8 @@ class KMeans(AutoCheckpointMixin):
     _PARAM_NAMES = ("k", "max_iter", "tolerance", "seed", "compute_sse",
                     "init", "n_init", "compute_labels", "empty_cluster",
                     "dtype", "mesh", "model_shards", "chunk_size",
-                    "distance_mode", "host_loop", "pipeline", "verbose")
+                    "distance_mode", "host_loop", "pipeline", "bucket",
+                    "overlap", "verbose")
 
     def get_params(self, deep: bool = True) -> dict:
         """Constructor parameters as a dict (sklearn estimator protocol —
@@ -2425,6 +2606,8 @@ class KMeans(AutoCheckpointMixin):
             "chunk_size": self.chunk_size,
             "host_loop": self.host_loop,
             "pipeline": self.pipeline,
+            "bucket": self.bucket,
+            "overlap": self.overlap,
             "verbose": self.verbose,
             "sse_history": list(map(float, self.sse_history)),
             "iterations_run": self.iterations_run,
@@ -2484,6 +2667,12 @@ class KMeans(AutoCheckpointMixin):
                     # fitted state).  npz round-trips ints as 0-d arrays.
                     pipeline=(lambda p: p if isinstance(p, str)
                               else int(p))(state.get("pipeline", "auto")),
+                    # Pre-r19 checkpoints have neither knob -> the
+                    # exact-shape / platform-resolved defaults.
+                    bucket=(lambda b: b if isinstance(b, str)
+                            else int(b))(state.get("bucket", 0)),
+                    overlap=(lambda o: o if isinstance(o, str)
+                             else int(o))(state.get("overlap", "auto")),
                     verbose=state["verbose"],
                     dtype=np.dtype(state["dtype"]),
                     **cls._load_kwargs(state))

@@ -47,7 +47,19 @@ def ttfi_ladder(records: List[dict]) -> List[dict]:
     is one observed run, not a repeated measurement — re-trace to
     estimate variance.  Raises ``ValueError`` when the trace holds no
     ``dispatch`` span (nothing ran; there is no first iteration to
-    report)."""
+    report).
+
+    Attribution rule (revised for ISSUE 15): phase rows sum SELF time
+    of their spans up to the END of the first dispatch — not just its
+    start — and ``first_dispatch`` is that span's SELF time.  Under
+    lazy jit the XLA executable build hides inside the first dispatch
+    with no span of its own (it lands in the ``first_dispatch`` row, as
+    before); with an AOT store active the build/load is an explicit
+    ``compile(via='aot-build'/'aot-load')`` span NESTED in that first
+    dispatch — the revised rule attributes it to the ``compile`` row,
+    which is what makes the cold-vs-AOT-warm compile comparison an
+    honest measured before/after (self-time accounting keeps the total
+    double-count-free either way)."""
     spans = [r for r in records if r.get("kind") == "span"]
     dispatches = sorted((s for s in spans if s["name"] == "dispatch"),
                         key=lambda s: s["t0"])
@@ -56,10 +68,11 @@ def ttfi_ladder(records: List[dict]) -> List[dict]:
             "trace holds no 'dispatch' span — nothing was dispatched, "
             "so there is no first iteration to decompose")
     fd = dispatches[0]
+    fd_end = fd["t1"] if fd.get("t1") is not None else fd["t0"]
     selfs = _trace.self_times(records)
     totals: Dict[str, float] = {name: 0.0 for name in TTFI_PHASES}
     for s in spans:
-        if s["name"] in totals and s["t0"] <= fd["t0"]:
+        if s["name"] in totals and s["t0"] <= fd_end:
             totals[s["name"]] += selfs[s["id"]]
     ladder = []
     cum = 0.0
@@ -67,9 +80,10 @@ def ttfi_ladder(records: List[dict]) -> List[dict]:
         cum += totals[name]
         ladder.append({"phase": name, "seconds": totals[name],
                        "cumulative": cum, "spread": 0.0})
-    cum += fd.get("dur") or 0.0
+    fd_self = selfs.get(fd["id"], fd.get("dur") or 0.0)
+    cum += fd_self
     ladder.append({"phase": "first_dispatch",
-                   "seconds": fd.get("dur") or 0.0,
+                   "seconds": fd_self,
                    "cumulative": cum, "spread": 0.0})
     return ladder
 

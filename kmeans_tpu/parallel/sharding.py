@@ -223,10 +223,75 @@ def backoff_chunk(chunk: int, floor: int = MIN_CHUNK) -> Optional[int]:
     return best_grid if best_grid is not None else best_any
 
 
-def pad_points(x: np.ndarray, multiple: int) -> Tuple[np.ndarray, np.ndarray]:
-    """Pad rows of (n, D) to a multiple; return (padded, 0/1 weights)."""
+#: The committed fit-shape bucket ladder (ISSUE 15b): row-count
+#: boundaries at {1, 1.25, 1.5, 1.75} x 2^e (floored at BUCKET_FLOOR
+#: rows).  Serving's batch-bucket discipline applied to training: a
+#: fit with ``bucket='auto'`` pads its staged shard (with the existing
+#: inert zero-weight sentinel rows) up to the next boundary, so nearby
+#: dataset sizes commit to ONE padded shape + chunk and therefore ONE
+#: compiled program — a standing fleet accepts a new fit like the
+#: serving engine accepts a request.  Quarter-power-of-two rungs bound
+#: the padding waste at 25% worst-case (~11% expected under a
+#: log-uniform size distribution).
+BUCKET_RUNGS = (1.0, 1.25, 1.5, 1.75)
+BUCKET_FLOOR = 256
+
+
+def bucket_rows(n: int) -> int:
+    """The smallest committed bucket boundary >= ``n`` (ISSUE 15b)."""
+    n = int(n)
+    if n <= BUCKET_FLOOR:
+        return BUCKET_FLOOR
+    e = int(np.floor(np.log2(n / BUCKET_FLOOR)))
+    # Float log may land one exponent high/low at exact boundaries;
+    # scan the neighborhood — correctness over cleverness.
+    for ee in (e - 1, e, e + 1):
+        for r in BUCKET_RUNGS:
+            b = int(round(BUCKET_FLOOR * r * (2 ** ee)))
+            if b >= n:
+                return b
+    return int(round(BUCKET_FLOOR * (2 ** (e + 2))))  # pragma: no cover
+
+
+def check_bucket(bucket):
+    """Validate (and normalize) the ``bucket`` knob grammar shared by
+    every family and the CLI: ``'auto'`` | an int >= 0 (0 = exact
+    shape, the bit-parity oracle).  ONE definition, so the families
+    can never diverge on the grammar (review finding)."""
+    if isinstance(bucket, str):
+        if bucket != "auto":
+            raise ValueError(f"bucket must be 'auto' or an int >= 0, "
+                             f"got {bucket!r}")
+        return bucket
+    if int(bucket) < 0 or int(bucket) != bucket:
+        raise ValueError(f"bucket must be 'auto' or an int >= 0, "
+                         f"got {bucket!r}")
+    return int(bucket)
+
+
+def bucket_target(bucket, n: int) -> int:
+    """Padded-row target for a validated ``bucket`` knob: the real row
+    count at 0, the committed ladder boundary at ``'auto'``, the next
+    multiple of an explicit int step — the ONE policy both model
+    families' ``_bucket_target`` delegates to."""
+    if bucket == "auto":
+        return bucket_rows(n)
+    if bucket:
+        return -(-int(n) // bucket) * bucket
+    return int(n)
+
+
+def pad_points(x: np.ndarray, multiple: int,
+               min_rows: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad rows of (n, D) to a multiple; return (padded, 0/1 weights).
+
+    ``min_rows`` (ISSUE 15b) raises the padding target first — the
+    shape-bucket mechanism: rows pad to the bucket boundary, THEN to
+    the shard/chunk multiple; the extra rows carry weight 0 exactly
+    like ordinary shard padding (inert in every statistic)."""
     n = x.shape[0]
-    pad = (-n) % multiple
+    target = max(n, int(min_rows))
+    pad = target - n + ((-target) % multiple)
     w = np.ones(n + pad, dtype=x.dtype)
     if pad:
         x = np.concatenate([x, np.zeros((pad, x.shape[1]), dtype=x.dtype)])
@@ -235,13 +300,15 @@ def pad_points(x: np.ndarray, multiple: int) -> Tuple[np.ndarray, np.ndarray]:
 
 
 def shard_points(x: np.ndarray, mesh: Optional[Mesh], chunk_size: int,
-                 sample_weight: Optional[np.ndarray] = None
-                 ) -> Tuple[jax.Array, jax.Array]:
+                 sample_weight: Optional[np.ndarray] = None,
+                 min_rows: int = 0) -> Tuple[jax.Array, jax.Array]:
     """Pad and place (points, weights) sharded along the mesh's data axis.
 
     ``sample_weight`` (n,) is folded into the padding mask (padding rows stay
     0).  With ``mesh=None`` the arrays are committed to the default device —
-    the single-chip path, same downstream code.
+    the single-chip path, same downstream code.  ``min_rows`` raises the
+    padding target to a shape-bucket boundary (ISSUE 15b; extra rows are
+    inert zero-weight sentinels like all shard padding).
     """
     data_shards, _ = mesh_shape(mesh)
     x = np.asarray(x)
@@ -251,7 +318,8 @@ def shard_points(x: np.ndarray, mesh: Optional[Mesh], chunk_size: int,
     # consumer's dispatches.
     with _obs_trace.span("stage", rows=int(x.shape[0]),
                          bytes=int(x.nbytes)):
-        x_pad, w_pad = pad_points(x, data_shards * chunk_size)
+        x_pad, w_pad = pad_points(x, data_shards * chunk_size,
+                                  min_rows=min_rows)
         if sample_weight is not None:
             w_pad[: x.shape[0]] *= sample_weight.astype(w_pad.dtype)
         if mesh is None:
@@ -458,13 +526,16 @@ class ShardedDataset:
 
 
 def to_device(X, mesh: Optional[Mesh], chunk: int, dtype,
-              sample_weight=None, explicit: bool = False) -> ShardedDataset:
+              sample_weight=None, explicit: bool = False,
+              min_rows: int = 0) -> ShardedDataset:
     """Upload (n, D) host data once; pass-through if already a ShardedDataset
     on a compatible (mesh, chunk).
 
     ``sample_weight`` (n,) folds per-point weights into the padding mask —
     weighted counts/sums/SSE come for free from the same fused step (a
-    capability the reference lacks; sklearn-style).
+    capability the reference lacks; sklearn-style).  ``min_rows`` is the
+    shape-bucket padding target (ISSUE 15b; 0 = exact-shape padding, the
+    bit-parity oracle).
     """
     if isinstance(X, ShardedDataset):
         if mesh is not None and X.mesh is not mesh:
@@ -488,7 +559,8 @@ def to_device(X, mesh: Optional[Mesh], chunk: int, dtype,
     # nesting never double-counts).
     with _obs_trace.span("place", rows=int(X.shape[0]),
                          bytes=int(X.nbytes)):
-        points, weights = shard_points(X, mesh, chunk, sample_weight=sw)
+        points, weights = shard_points(X, mesh, chunk, sample_weight=sw,
+                                       min_rows=min_rows)
     return ShardedDataset(points, weights, X.shape[0], chunk, mesh, host=X,
                           host_weights=sw, explicit_chunk=explicit)
 

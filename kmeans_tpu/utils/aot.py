@@ -1,0 +1,573 @@
+"""Portable AOT executable cache + the compilation-cache ladder (ISSUE 15).
+
+The measured time-to-first-iteration window (docs/PERFORMANCE.md "Time
+to first iteration") decomposes into a transfer term and a ~3.5 s/program
+compile term — and the compile term is paid again by every fresh process:
+an elastic resize (ROADMAP item 1), a serving restart, a second bench
+run.  This module is the warm-start layer that removes it:
+
+* :func:`enable_compilation_cache` — the FIRST rung: jax's persistent
+  compilation cache (promoted out of ``benchmarks.py`` where it was
+  bench-only since r2), now library-level with the
+  ``KMEANS_TPU_COMPILE_CACHE`` env knob and called by bench and CLI
+  alike.  Same-machine recompiles become disk hits.
+* :class:`AOTStore` + :func:`wrap` — the SECOND rung: on the first call
+  of any ``*_STEP_CACHE``-class program (the moment the arguments — and
+  therefore the exact avals/shardings — exist), the program is lowered,
+  compiled and SERIALIZED (``jax.experimental.serialize_executable``,
+  the ``jax.export``-era AOT surface) to an on-disk artifact keyed by
+  (cache name, in-memory cache key, argument signature, jax/jaxlib
+  version, backend fingerprint).  A later process — including a resumed
+  fit on a fresh host — deserializes and LOADS the executable instead of
+  trace+compile: the TTFI compile row collapses to artifact-read
+  milliseconds, visible on the span timeline as
+  ``compile(via='aot-load')``.
+
+Degrade contract (the ``obs/cost.py`` discipline): a backend whose PJRT
+client cannot serialize executables yields ``available=False`` in
+:meth:`AOTStore.stats` with ONE warning — fits run exactly as before,
+never fail, never silently pretend the cache worked.  A corrupted or
+version-skewed artifact is a counted fallback (``aot.fallback`` metric +
+warning) that re-enters trace+compile — NEVER a wrong program: artifacts
+are looked up by content hash of the full key AND the stored key fields
+are re-verified against the expectation on load.
+
+Key discipline (the ``aot-key`` lint rule): every artifact write derives
+its key through :func:`artifact_key` — the one constructor that starts
+from the SAME in-memory ``_STEP_CACHE`` key the compiled entry lives
+under and appends the version/backend fields.  A hand-rolled key missing
+a component is the r14 cache-key incident class, across processes.
+
+Trust note: artifacts embed a pickled treedef pair (the executable's
+in/out trees).  The store directory is therefore in the same trust
+domain as checkpoints — load artifacts only from directories you would
+load a checkpoint from.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import threading
+import warnings
+import zipfile
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from kmeans_tpu.obs import metrics_registry as _metrics
+from kmeans_tpu.obs import trace as _obs_trace
+
+__all__ = ["enable_compilation_cache", "aot_supported", "AOTStore",
+           "artifact_key", "configure", "deactivate", "active_store",
+           "wrap", "aot_dir_for", "describe_dir", "FORMAT"]
+
+FORMAT = "kmeans_tpu.aot.v1"
+
+#: Artifact file extension (one serialized executable per file).
+_EXT = ".aotx"
+
+
+# ------------------------------------------------- compilation cache
+
+_COMPILE_CACHE_SET = False
+
+
+def enable_compilation_cache() -> Optional[str]:
+    """Persistent XLA/Mosaic compilation cache (r2 VERDICT #6), the
+    first rung of the warm-start ladder — promoted from the bench-only
+    ``benchmarks.py`` setup (ISSUE 15 satellite) so EVERY fit entry
+    point (bench, ``fit``/``warm``/``serve`` CLIs, library users calling
+    this) shares it.
+
+    Directory resolution: ``KMEANS_TPU_COMPILE_CACHE`` (the library
+    knob) > ``JAX_COMPILATION_CACHE_DIR`` (jax's own) > the
+    ``/tmp/kmeans_tpu_jax_cache`` default.  An EMPTY value for either
+    env knob opts out (cold-compile measurement).  Idempotent; returns
+    the directory in effect (None when opted out)."""
+    global _COMPILE_CACHE_SET
+    import jax
+    cache = os.environ.get("KMEANS_TPU_COMPILE_CACHE")
+    if cache is None:
+        cache = os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                               "/tmp/kmeans_tpu_jax_cache")
+    if not cache:
+        return None
+    if not _COMPILE_CACHE_SET:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
+        _COMPILE_CACHE_SET = True
+    return cache
+
+
+# ------------------------------------------------- backend capability
+
+_SUPPORTED: Optional[Tuple[bool, str]] = None
+_SUPPORT_LOCK = threading.Lock()
+
+
+def aot_supported() -> Tuple[bool, str]:
+    """(supported, reason): can this backend serialize AND reload a
+    compiled executable?  Probed ONCE per process with a trivial
+    program; cached.  ``reason`` names the failing step on degraded
+    backends — the ``available=False`` surface the store and the
+    ``warm`` CLI (exit 2) report."""
+    global _SUPPORTED
+    with _SUPPORT_LOCK:
+        if _SUPPORTED is not None:
+            return _SUPPORTED
+        import jax
+        try:
+            from jax.experimental import serialize_executable as se
+            fn = jax.jit(lambda x: x + 1)
+            comp = fn.lower(jax.numpy.zeros((2,))).compile()
+            payload, in_tree, out_tree = se.serialize(comp)
+            pickle.dumps((in_tree, out_tree))
+            se.deserialize_and_load(payload, in_tree, out_tree)
+            _SUPPORTED = (True, "ok")
+        except Exception as e:  # noqa: BLE001 — capability probe
+            _SUPPORTED = (False, f"{type(e).__name__}: {e}")
+        return _SUPPORTED
+
+
+def _backend_fingerprint() -> Dict[str, object]:
+    """The backend fields of every artifact key: an executable compiled
+    for one platform/topology must never load on another."""
+    import jax
+    dev = jax.devices()[0]
+    return {
+        "platform": jax.default_backend(),
+        "device_kind": str(getattr(dev, "device_kind", "?")),
+        "device_count": int(jax.device_count()),
+        "process_count": int(jax.process_count()),
+    }
+
+
+# --------------------------------------------------------- key fields
+
+def _norm_key(key) -> object:
+    """A JSON-stable normalization of an in-memory ``_STEP_CACHE`` key:
+    tuples recurse; a ``jax.sharding.Mesh`` becomes its (axis, size)
+    shape plus device kind (two processes with the same topology must
+    produce the SAME normalized key — ``repr(mesh)`` embeds device ids
+    and would defeat cross-process reuse); everything else reprs."""
+    if isinstance(key, tuple):
+        return [_norm_key(k) for k in key]
+    from jax.sharding import Mesh
+    if isinstance(key, Mesh):
+        dev = next(iter(key.devices.flat))
+        return ["mesh", [[str(n), int(s)] for n, s in key.shape.items()],
+                str(getattr(dev, "device_kind", "?"))]
+    return repr(key)
+
+
+def _shard_sig(sh) -> object:
+    """Sharding component of an argument signature.  NamedShardings
+    reduce to (axis sizes, spec) — deliberately WITHOUT device ids or
+    memory kind, so a ``jax.ShapeDtypeStruct`` warm-up signature
+    (prelude overlap, ISSUE 15c) matches the real arrays' and two
+    processes on the same topology agree."""
+    if sh is None:
+        return "host"
+    from jax.sharding import NamedSharding
+    if isinstance(sh, NamedSharding):
+        return ("named",
+                tuple((str(n), int(s))
+                      for n, s in sh.mesh.shape.items()),
+                str(sh.spec))
+    return type(sh).__name__
+
+
+def _sig_of(args) -> tuple:
+    """Aval signature of a concrete argument tuple (shapes, dtypes,
+    shardings) — what, together with the cache key, pins ONE compiled
+    executable.  Works on real arrays and on ``ShapeDtypeStruct``s."""
+    import jax
+    sig = []
+    for a in jax.tree_util.tree_leaves(args):
+        if hasattr(a, "shape") and hasattr(a, "dtype"):
+            sig.append((tuple(int(s) for s in a.shape), str(a.dtype),
+                        _shard_sig(getattr(a, "sharding", None))))
+        else:
+            sig.append(("pyleaf", type(a).__name__))
+    return tuple(sig)
+
+
+def artifact_key(cache_name: str, key, sig) -> Dict[str, object]:
+    """The CANONICAL AOT artifact key (the ``aot-key`` lint rule's
+    blessed constructor — every ``store.put`` call site must build its
+    key here): the in-memory cache identity (cache name + full
+    ``_STEP_CACHE`` key, normalized) + the argument signature + jax /
+    jaxlib versions + the backend fingerprint.  Dropping any component
+    is the r14 cache-key incident class across processes: a stale or
+    foreign executable served as this program."""
+    import jax
+    import jaxlib
+    return {
+        "format": FORMAT,
+        "cache": str(cache_name),
+        "key": _norm_key(key),
+        "sig": _norm_key(tuple(sig)),
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        **_backend_fingerprint(),
+    }
+
+
+def _digest(fields: Dict[str, object]) -> str:
+    return hashlib.sha256(
+        json.dumps(fields, sort_keys=True).encode()).hexdigest()[:40]
+
+
+# -------------------------------------------------------------- store
+
+class AOTStore:
+    """Directory-backed store of serialized executables.
+
+    ``root`` is the write (and first read) directory; ``read_dirs`` are
+    additional lookup-only directories (e.g. the ``<ckpt>.aot``
+    directory shipped next to a checkpoint being resumed); ``mirror``
+    (when set) receives a copy of every write — the ship-next-to-
+    checkpoints mechanism, so an elastic restart on a fresh host finds
+    the executables beside the state it restores.
+
+    Artifacts are single ``.aotx`` files (a zip of ``meta.json`` +
+    ``trees.pkl`` + ``exe.bin``) written atomically (temp +
+    ``os.replace``, the checkpoint discipline).  Loads re-verify the
+    stored key fields against the expectation — a content-hash
+    collision or a hand-renamed file can never serve a wrong program.
+    """
+
+    def __init__(self, root, read_dirs=(), mirror=None):
+        self.root = Path(root)
+        self.read_dirs: List[Path] = [Path(d) for d in read_dirs]
+        self.mirror: Optional[Path] = Path(mirror) if mirror else None
+        self._lock = threading.Lock()
+        self.counts = {"loaded": 0, "built": 0, "saved": 0,
+                       "fallbacks": 0, "call_fallbacks": 0}
+
+    # ------------------------------------------------------- bookkeeping
+    def _count(self, what: str) -> None:
+        with self._lock:
+            self.counts[what] += 1
+        _metrics.REGISTRY.counter(f"aot.{what}").inc()
+
+    def stats(self) -> dict:
+        ok, reason = aot_supported()
+        with self._lock:
+            counts = dict(self.counts)
+        return {"root": str(self.root),
+                "read_dirs": [str(d) for d in self.read_dirs],
+                "mirror": str(self.mirror) if self.mirror else None,
+                "available": ok, "reason": reason, **counts}
+
+    def add_read_dir(self, path) -> None:
+        p = Path(path)
+        if p not in self.read_dirs:
+            self.read_dirs.append(p)
+
+    def set_mirror(self, path) -> None:
+        self.mirror = Path(path) if path else None
+
+    # ------------------------------------------------------------ paths
+    def _candidates(self, digest: str) -> List[Path]:
+        dirs = [self.root] + self.read_dirs
+        if self.mirror is not None:
+            dirs.append(self.mirror)
+        return [d / (digest + _EXT) for d in dirs]
+
+    # ------------------------------------------------------------- put
+    def put(self, fields: Dict[str, object], compiled) -> bool:
+        """Serialize ``compiled`` under ``fields``
+        (:func:`artifact_key` output — the lint-enforced constructor).
+        Returns False (counted, warned once) on an unserializable
+        backend; raises nothing into the fit path."""
+        ok, reason = aot_supported()
+        if not ok:
+            _warn_once(f"AOT executable cache unavailable on this "
+                       f"backend ({reason}); fits run with in-process "
+                       f"compiles only (available=False)")
+            return False
+        from jax.experimental import serialize_executable as se
+        try:
+            payload, in_tree, out_tree = se.serialize(compiled)
+            blob = pickle.dumps((in_tree, out_tree))
+        except Exception as e:  # noqa: BLE001 — degrade, never fail a fit
+            self._count("fallbacks")
+            _warn_once(f"AOT serialize failed ({type(e).__name__}: {e}); "
+                       f"continuing without a cached executable")
+            return False
+        digest = _digest(fields)
+        meta = json.dumps(fields, sort_keys=True)
+        for target in ([self.root] + ([self.mirror] if self.mirror
+                                      else [])):
+            try:
+                target.mkdir(parents=True, exist_ok=True)
+                path = target / (digest + _EXT)
+                tmp = target / f".{digest}.{os.getpid()}.tmp"
+                try:
+                    with zipfile.ZipFile(tmp, "w") as z:
+                        z.writestr("meta.json", meta)
+                        z.writestr("trees.pkl", blob)
+                        z.writestr("exe.bin", payload)
+                    os.replace(tmp, path)
+                finally:
+                    tmp.unlink(missing_ok=True)
+            except OSError as e:
+                self._count("fallbacks")
+                warnings.warn(f"AOT artifact write to {target} failed "
+                              f"({e}); executable stays in-process only",
+                              UserWarning, stacklevel=2)
+                return False
+        self._count("saved")
+        return True
+
+    # ------------------------------------------------------------- get
+    def get(self, fields: Dict[str, object]):
+        """Deserialize-and-load the executable stored under ``fields``,
+        or None (a miss, or a counted fallback for corrupt/skewed
+        artifacts — the caller then trace+compiles, never a wrong
+        program)."""
+        ok, _ = aot_supported()
+        if not ok:
+            return None
+        digest = _digest(fields)
+        expect = json.loads(json.dumps(fields, sort_keys=True))
+        for path in self._candidates(digest):
+            if not path.exists():
+                continue
+            try:
+                with zipfile.ZipFile(path) as z:
+                    meta = json.loads(z.read("meta.json"))
+                    if meta != expect:
+                        raise ValueError(
+                            f"key fields mismatch (stored "
+                            f"jax={meta.get('jax')} "
+                            f"platform={meta.get('platform')}, expected "
+                            f"jax={expect.get('jax')} "
+                            f"platform={expect.get('platform')})")
+                    in_tree, out_tree = pickle.loads(z.read("trees.pkl"))
+                    payload = z.read("exe.bin")
+                from jax.experimental import serialize_executable as se
+                loaded = se.deserialize_and_load(payload, in_tree,
+                                                 out_tree)
+                self._count("loaded")
+                return loaded
+            except Exception as e:  # noqa: BLE001 — fall back to compile
+                self._count("fallbacks")
+                warnings.warn(
+                    f"AOT artifact {path} unusable "
+                    f"({type(e).__name__}: {e}); falling back to "
+                    f"trace+compile", UserWarning, stacklevel=2)
+                return None
+        return None
+
+
+def _warn_once(msg: str, _seen: set = set()) -> None:  # noqa: B006
+    """One warning per distinct degrade message per process — visible,
+    never spammy (a fit dispatches hundreds of programs)."""
+    if msg not in _seen:
+        _seen.add(msg)
+        warnings.warn(msg, UserWarning, stacklevel=3)
+
+
+# ----------------------------------------------------- active store
+
+_STORE: Optional[AOTStore] = None
+_ENV_CHECKED = False
+
+
+def configure(root, read_dirs=(), mirror=None) -> Optional[AOTStore]:
+    """Install the process-wide AOT store (``root=None`` uninstalls).
+    The env twin is ``KMEANS_TPU_AOT_CACHE=<dir>`` — picked up lazily on
+    the first compile-cache miss, so library users get the cache without
+    code changes."""
+    global _STORE, _ENV_CHECKED
+    _ENV_CHECKED = True
+    _STORE = AOTStore(root, read_dirs=read_dirs, mirror=mirror) \
+        if root else None
+    return _STORE
+
+
+def deactivate() -> None:
+    configure(None)
+
+
+def active_store() -> Optional[AOTStore]:
+    """The installed store; initializes from ``KMEANS_TPU_AOT_CACHE``
+    exactly once when nothing was configured programmatically."""
+    global _ENV_CHECKED
+    if _STORE is None and not _ENV_CHECKED:
+        env = os.environ.get("KMEANS_TPU_AOT_CACHE")
+        if env:
+            return configure(env)
+        _ENV_CHECKED = True
+    return _STORE
+
+
+def aot_dir_for(ckpt_path) -> Path:
+    """The artifact directory shipped NEXT TO a checkpoint
+    (``model.npz`` -> ``model.npz.aot/``): what an elastic restart on a
+    fresh host ships together with the state, so resume skips the
+    compile column entirely."""
+    from kmeans_tpu.utils.checkpoint import _normalize
+    p = _normalize(ckpt_path)
+    return p.with_name(p.name + ".aot")
+
+
+def on_checkpoint_path(ckpt_path) -> None:
+    """Fit-prelude hook (``AutoCheckpointMixin._check_ckpt``): with a
+    store active, mirror every artifact written during this fit into the
+    checkpoint's sibling ``.aot`` directory."""
+    store = active_store()
+    if store is not None and ckpt_path is not None:
+        store.set_mirror(aot_dir_for(ckpt_path))
+
+
+def on_resume_path(ckpt_path) -> None:
+    """Resume hook (``AutoCheckpointMixin._resolve_resume``): with a
+    store active, the checkpoint's sibling ``.aot`` directory joins the
+    read path — a fresh host resuming a shipped checkpoint loads the
+    shipped executables instead of compiling."""
+    store = active_store()
+    if store is not None and ckpt_path is not None:
+        store.add_read_dir(aot_dir_for(ckpt_path))
+
+
+def describe_dir(path) -> dict:
+    """Operator-facing summary of an artifact directory (the
+    ``ckpt-info`` ``aot`` block): artifact count/bytes and the distinct
+    (cache, platform, jax) triples present — readable without jax
+    device init (pure zip/json)."""
+    p = Path(path)
+    out = {"path": str(p), "exists": p.is_dir(), "artifacts": 0,
+           "bytes": 0, "programs": [], "unreadable": 0}
+    if not out["exists"]:
+        return out
+    seen = set()
+    for f in sorted(p.glob(f"*{_EXT}")):
+        out["artifacts"] += 1
+        out["bytes"] += f.stat().st_size
+        try:
+            with zipfile.ZipFile(f) as z:
+                meta = json.loads(z.read("meta.json"))
+            seen.add((meta.get("cache", "?"), meta.get("platform", "?"),
+                      meta.get("jax", "?")))
+        except Exception:  # noqa: BLE001 — a torn artifact still counts
+            out["unreadable"] += 1
+    out["programs"] = [{"cache": c, "platform": pl, "jax": j}
+                      for c, pl, j in sorted(seen)]
+    return out
+
+
+# ----------------------------------------------------------- wrapper
+
+class _AOTProgram:
+    """Per-signature AOT front of one compiled-cache entry.
+
+    On the first call for each argument signature: try the store
+    (``compile(via='aot-load')`` span), else lower+compile explicitly
+    (``compile(via='aot-build')`` span) and serialize the result.  The
+    explicit build moves the XLA executable build OUT of the first
+    ``dispatch`` span and into the ``compile`` phase — which is what
+    makes the TTFI compile row an honest before/after instrument for
+    this attack.  Every failure path falls back to the wrapped jitted
+    function (counted), so behavior is bit-identical to the unwrapped
+    entry by construction — the AOT-off parity oracle."""
+
+    def __init__(self, fn, cache_name: str, key, store: AOTStore):
+        self._fn = fn
+        self._cache = cache_name
+        self._key = key
+        self._store = store
+        self._exes: dict = {}
+        self._elock = threading.Lock()
+
+    # Delegation keeps the jit surface (.lower, .__name__, ...) visible
+    # to the cost-capture wrapper stacked outside this one.
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+    def _ensure(self, args):
+        sig = _sig_of(args)
+        with self._elock:
+            hit = self._exes.get(sig)
+        if hit is not None:
+            return hit
+        exe = None
+        fields = artifact_key(self._cache, self._key, sig)
+        loaded = self._store.get(fields)
+        if loaded is not None:
+            with _obs_trace.span("compile", cache=self._cache,
+                                 key=repr(self._key)[:160],
+                                 via="aot-load"):
+                exe = loaded
+        else:
+            try:
+                with _obs_trace.span("compile", cache=self._cache,
+                                     key=repr(self._key)[:160],
+                                     via="aot-build"):
+                    compiled = self._fn.lower(*args).compile()
+                self._store._count("built")
+                self._store.put(fields, compiled)
+                exe = compiled
+            except Exception as e:  # noqa: BLE001 — jit path still works
+                self._store._count("call_fallbacks")
+                _warn_once(f"AOT explicit compile failed for "
+                           f"{self._cache} ({type(e).__name__}: {e}); "
+                           f"using the in-process jit path")
+                exe = self._fn
+        with self._elock:
+            self._exes[sig] = exe
+        return exe
+
+    def warm(self, *arg_structs) -> None:
+        """Pre-resolve the executable for an argument signature given as
+        ``jax.ShapeDtypeStruct``s (sharding-carrying) — the prelude-
+        overlap entry point: load-or-compile runs NOW, concurrently with
+        the staged ingest, and the later real call is a dict hit.
+        Never raises into the fit prelude."""
+        try:
+            self._ensure(arg_structs)
+        except Exception as e:  # noqa: BLE001 — warming is best-effort
+            self._store._count("call_fallbacks")
+            _warn_once(f"AOT warm-up failed for {self._cache} "
+                       f"({type(e).__name__}: {e})")
+
+    def __call__(self, *args, **kwargs):
+        if kwargs:
+            return self._fn(*args, **kwargs)
+        exe = self._ensure(args)
+        if exe is self._fn:
+            return self._fn(*args)
+        try:
+            return exe(*args)
+        except (TypeError, ValueError) as e:
+            # Argument/sharding layout the compiled executable cannot
+            # accept (e.g. differently-committed arrays): permanent,
+            # counted fallback for this signature — correctness first.
+            self._store._count("call_fallbacks")
+            _warn_once(f"AOT executable call fell back to jit for "
+                       f"{self._cache} ({type(e).__name__}: {e})")
+            with self._elock:
+                self._exes[_sig_of(args)] = self._fn
+            return self._fn(*args)
+
+
+def wrap(cache_name: str, key, value):
+    """The ``LRUCache.get_or_create`` MISS hook (the cost-capture
+    pattern): with a store active, wrap each callable member of the
+    fresh entry in an :class:`_AOTProgram`; with none, return ``value``
+    untouched — the disabled path is one None check, and tier-1 runs
+    with it disabled (the AOT-off parity oracle)."""
+    store = active_store()
+    if store is None:
+        return value
+    if isinstance(value, tuple):
+        return tuple(_AOTProgram(v, cache_name, key, store)
+                     if callable(v) else v for v in value)
+    if callable(value):
+        return _AOTProgram(value, cache_name, key, store)
+    return value

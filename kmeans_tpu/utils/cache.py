@@ -12,10 +12,28 @@ reference to their function, so eviction mid-fit is harmless.
 
 from __future__ import annotations
 
+import os
+import sys
 from collections import OrderedDict
 
 from kmeans_tpu.obs import cost as _obs_cost
 from kmeans_tpu.obs import trace as _obs_trace
+
+
+def _aot_wrap(name, key, value):
+    """ISSUE 15: hand a fresh compile-cache entry to the AOT executable
+    layer (``utils.aot.wrap``) — lazily, so this module keeps its
+    light import surface: ``utils.aot`` (which imports jax) is touched
+    only when it was already configured programmatically (module
+    imported) or the ``KMEANS_TPU_AOT_CACHE`` env knob is set.  With
+    neither, a cache miss costs one sys.modules lookup + one env get —
+    the AOT-off parity-oracle path."""
+    mod = sys.modules.get("kmeans_tpu.utils.aot")
+    if mod is None:
+        if not os.environ.get("KMEANS_TPU_AOT_CACHE"):
+            return value
+        from kmeans_tpu.utils import aot as mod
+    return mod.wrap(name, key, value)
 
 
 class LRUCache:
@@ -61,6 +79,14 @@ class LRUCache:
             else:
                 value = factory()
             if self.compile_spans:
+                # AOT executable cache (ISSUE 15): with a store active,
+                # each callable member is fronted by a per-signature
+                # load-or-compile-and-serialize wrapper — applied FIRST
+                # so the cost wrapper below stays outermost and its
+                # one-shot analysis still observes every call.
+                # Measurement caches (compile_spans=False) opt out of
+                # all three hooks together.
+                value = _aot_wrap(self.name or "cache", key, value)
                 # Device-cost capture (ISSUE 12): with a cost collector
                 # active, the freshly built program(s) are wrapped for
                 # one-shot AOT analysis on their first call; with none

@@ -1,0 +1,478 @@
+"""ISSUE 15: portable AOT executable cache, shape-bucketed fits, and
+compile/ingest overlap.
+
+Pinned contracts:
+
+* ``bucket=0`` and AOT-off are BIT-exact parity oracles (the
+  ``prefetch=0`` discipline): the knobs move where padding/compiles
+  happen, never arithmetic.
+* A second same-bucket fit adds ZERO new compile-cache entries
+  (``recompilation_sentinel``) — serving's warm-kernel residency
+  discipline applied to training shapes.
+* Cross-process AOT round trip: compile+serialize in subprocess A,
+  deserialize-and-fit in subprocess B, bit-exact vs an in-process fit,
+  for the f64 device-loop class across {1, 2, 4, 8}-way meshes
+  including TP.
+* A corrupted or version-skewed artifact falls back to trace+compile
+  with a counted warning — never a wrong program.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import warnings
+import zipfile
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+
+from kmeans_tpu import (BisectingKMeans, GaussianMixture, KMeans,
+                        MiniBatchKMeans, SphericalKMeans)
+from kmeans_tpu.obs import trace as obs_trace
+from kmeans_tpu.parallel.sharding import (BUCKET_FLOOR, bucket_rows,
+                                          to_device)
+from kmeans_tpu.utils import aot
+from kmeans_tpu.utils.profiling import recompilation_sentinel
+import kmeans_tpu.models.kmeans as km_mod
+import kmeans_tpu.models.gmm as gmm_mod
+
+
+@pytest.fixture(autouse=True)
+def _aot_isolation():
+    """Every test starts and ends with no active store and cold step
+    caches touched by AOT wrappers cleared — wrappers must never leak
+    into unrelated tests' cache entries."""
+    aot.deactivate()
+    yield
+    if aot.active_store() is not None:
+        km_mod._STEP_CACHE.clear()
+        gmm_mod._STEP_CACHE.clear()
+    aot.deactivate()
+
+
+def _blobs(n=600, d=6, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    cents = rng.normal(size=(4, d)) * 6
+    return (cents[rng.integers(0, 4, n)]
+            + rng.normal(size=(n, d))).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Bucket ladder
+# ---------------------------------------------------------------------------
+
+def test_bucket_rows_ladder():
+    assert bucket_rows(1) == BUCKET_FLOOR
+    assert bucket_rows(BUCKET_FLOOR) == BUCKET_FLOOR
+    assert bucket_rows(BUCKET_FLOOR + 1) == int(BUCKET_FLOOR * 1.25)
+    # Boundaries are fixed points; values just past a boundary land on
+    # the next rung; waste is bounded by the 1.25x rung ratio.
+    for n in (257, 900, 1020, 1024, 1025, 123457, 10**6):
+        b = bucket_rows(n)
+        assert b >= n
+        assert b / n <= 1.25 + 1e-9
+        assert bucket_rows(b) == b
+    # Monotone.
+    vals = [bucket_rows(n) for n in range(1, 5000, 7)]
+    assert vals == sorted(vals)
+
+
+def test_bucket_param_validation():
+    with pytest.raises(ValueError, match="bucket"):
+        KMeans(k=2, bucket="sometimes")
+    with pytest.raises(ValueError, match="bucket"):
+        KMeans(k=2, bucket=-1)
+    with pytest.raises(ValueError, match="bucket"):
+        GaussianMixture(n_components=2, bucket="sometimes")
+    with pytest.raises(ValueError, match="overlap"):
+        KMeans(k=2, overlap=2)
+
+
+def test_bucket_pads_with_inert_rows():
+    X = _blobs(n=600)
+    km = KMeans(k=4, bucket="auto", verbose=False)
+    ds = km.cache(X)
+    assert ds.n == 600                       # real rows untouched
+    assert ds.points.shape[0] >= bucket_rows(600)
+    w = np.asarray(ds.weights)
+    assert w[:600].sum() == 600 and w[600:].sum() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# bucket=0 parity oracle — all five families
+# ---------------------------------------------------------------------------
+
+FAMILIES = [
+    ("kmeans", lambda **kw: KMeans(k=4, max_iter=8, seed=5,
+                                   verbose=False, **kw)),
+    ("minibatch", lambda **kw: MiniBatchKMeans(k=4, max_iter=6, seed=5,
+                                               batch_size=128,
+                                               verbose=False, **kw)),
+    ("bisecting", lambda **kw: BisectingKMeans(k=4, max_iter=6, seed=5,
+                                               verbose=False, **kw)),
+    ("spherical", lambda **kw: SphericalKMeans(k=4, max_iter=8, seed=5,
+                                               verbose=False, **kw)),
+    ("gmm", lambda **kw: GaussianMixture(n_components=3, max_iter=6,
+                                         seed=5, verbose=False, **kw)),
+]
+
+
+def _table(model):
+    return np.asarray(model.centroids if hasattr(model, "centroids")
+                      and model.centroids is not None else model.means_)
+
+
+@pytest.mark.parametrize("name,build", FAMILIES,
+                         ids=[f[0] for f in FAMILIES])
+def test_bucket0_is_bit_exact_oracle(name, build):
+    X = _blobs(n=700, d=5)
+    base = build().fit(X)
+    oracle = build(bucket=0).fit(X)
+    assert np.array_equal(_table(base), _table(oracle))
+
+
+@pytest.mark.parametrize("name,build", FAMILIES,
+                         ids=[f[0] for f in FAMILIES])
+def test_bucket_auto_same_semantics(name, build):
+    """'auto' changes only the fp summation fold (extra all-zero
+    chunks), never semantics: same trajectory to numerical tolerance,
+    attributes at real shapes."""
+    X = _blobs(n=700, d=5)
+    base = build().fit(X)
+    auto = build(bucket="auto").fit(X)
+    assert _table(auto).shape == _table(base).shape
+    assert np.allclose(_table(base), _table(auto), atol=1e-4)
+    if hasattr(auto, "labels_") and auto.labels_ is not None:
+        assert np.asarray(auto.labels_).shape[0] == 700
+
+
+def test_same_bucket_repeat_fit_zero_new_entries():
+    """The warm-fleet pin: two different row counts in one bucket run
+    the SAME compiled programs — zero cache growth, zero compile
+    spans."""
+    build = lambda: KMeans(k=4, max_iter=5, seed=5, verbose=False,
+                           bucket="auto", host_loop=False,
+                           empty_cluster="keep")
+    assert bucket_rows(900) == bucket_rows(1000)
+    build().fit(_blobs(n=900))
+    with obs_trace.tracing() as tr, recompilation_sentinel():
+        build().fit(_blobs(n=1000, seed=9))
+    spans = [r for r in tr.records()
+             if r.get("kind") == "span" and r["name"] == "compile"]
+    assert spans == []
+
+
+def test_explicit_int_bucket_rounds_up():
+    km = KMeans(k=4, bucket=500, verbose=False)
+    assert km._bucket_target(601) == 1000
+    assert km._bucket_target(1000) == 1000
+
+
+def test_bucket_roundtrips_through_params_and_checkpoint(tmp_path):
+    km = KMeans(k=4, max_iter=4, seed=0, bucket="auto", overlap=0,
+                verbose=False).fit(_blobs())
+    assert km.get_params()["bucket"] == "auto"
+    km.save(tmp_path / "m.npz")
+    loaded = KMeans.load(tmp_path / "m.npz")
+    assert loaded.bucket == "auto" and loaded.overlap == 0
+    g = GaussianMixture(n_components=2, max_iter=3, seed=0,
+                        bucket=512, verbose=False).fit(_blobs())
+    g.save(tmp_path / "g.npz")
+    assert GaussianMixture.load(tmp_path / "g.npz").bucket == 512
+
+
+# ---------------------------------------------------------------------------
+# Compile/ingest overlap
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("host_loop", [True, False])
+def test_overlap_bit_exact_parity(host_loop):
+    X = _blobs(n=800, d=6)
+    kw = dict(k=4, max_iter=8, seed=2, verbose=False,
+              host_loop=host_loop, empty_cluster="keep")
+    serial = KMeans(overlap=0, **kw).fit(X)
+    lapped = KMeans(overlap=1, **kw).fit(X)
+    assert np.array_equal(serial.centroids, lapped.centroids)
+    assert np.array_equal(serial.labels_, lapped.labels_)
+    assert serial.iterations_run == lapped.iterations_run
+
+
+def test_overlap_stages_on_producer_thread():
+    """The overlapped prelude's 'stage' spans come from the prefetch
+    producer's tid — the compile/ingest concurrency is visible on the
+    timeline."""
+    import threading
+    X = _blobs(n=800)
+    with obs_trace.tracing() as tr:
+        KMeans(k=4, max_iter=3, seed=2, verbose=False, overlap=1,
+               host_loop=False, empty_cluster="keep").fit(X)
+    main_tid = threading.get_ident()
+    stage = [r for r in tr.records() if r.get("kind") == "span"
+             and r["name"] == "stage"]
+    assert stage and any(s["tid"] != main_tid for s in stage)
+
+
+def test_overlap_skips_sharded_dataset_input():
+    km = KMeans(k=4, max_iter=4, seed=2, verbose=False, overlap=1)
+    ds = km.cache(_blobs())
+    ref = KMeans(k=4, max_iter=4, seed=2, verbose=False,
+                 overlap=0).fit(_blobs())
+    assert np.array_equal(km.fit(ds).centroids, ref.centroids)
+
+
+# ---------------------------------------------------------------------------
+# AOT executable store — in-process
+# ---------------------------------------------------------------------------
+
+def test_aot_supported_on_cpu():
+    ok, reason = aot.aot_supported()
+    assert ok, reason
+
+
+def test_artifact_key_spans_versions_and_backend():
+    fields = aot.artifact_key("kmeans._STEP_CACHE", ("k", 4), ((4,),))
+    import jaxlib
+    assert fields["jax"] == jax.__version__
+    assert fields["jaxlib"] == jaxlib.__version__
+    assert fields["platform"] == jax.default_backend()
+    assert fields["format"] == aot.FORMAT
+    for f in ("cache", "key", "sig", "device_kind", "device_count",
+              "process_count"):
+        assert f in fields
+    json.dumps(fields)        # must be JSON-stable (digest input)
+
+
+def test_aot_roundtrip_in_process(tmp_path):
+    """Cold fit builds+serializes; after an in-memory cache wipe (a
+    simulated fresh process) the same fit LOADS — compile spans flip
+    from via='aot-build' to via='aot-load' — and the trajectory is
+    bit-exact, also vs AOT-off."""
+    X = _blobs(n=900, d=8, dtype=np.float64)
+    kw = dict(k=4, max_iter=8, seed=7, verbose=False, host_loop=False,
+              empty_cluster="keep", dtype=np.float64)
+    store = aot.configure(tmp_path / "store")
+    km_mod._STEP_CACHE.clear()
+    with obs_trace.tracing() as tr1:
+        cold = KMeans(**kw).fit(X)
+    vias1 = [r["attrs"]["via"] for r in tr1.records()
+             if r.get("kind") == "span" and r["name"] == "compile"
+             and r.get("attrs", {}).get("via")]
+    assert "aot-build" in vias1 and store.stats()["saved"] > 0
+
+    km_mod._STEP_CACHE.clear()
+    with obs_trace.tracing() as tr2:
+        warm = KMeans(**kw).fit(X)
+    vias2 = [r["attrs"]["via"] for r in tr2.records()
+             if r.get("kind") == "span" and r["name"] == "compile"
+             and r.get("attrs", {}).get("via")]
+    assert vias2 and set(vias2) == {"aot-load"}
+    assert store.stats()["loaded"] >= len(vias2)
+    assert np.array_equal(cold.centroids, warm.centroids)
+
+    aot.deactivate()
+    km_mod._STEP_CACHE.clear()
+    off = KMeans(**kw).fit(X)
+    assert np.array_equal(cold.centroids, off.centroids)
+
+
+def test_aot_corrupted_artifact_counted_fallback(tmp_path):
+    X = _blobs(n=700, d=6)
+    kw = dict(k=4, max_iter=6, seed=3, verbose=False, host_loop=False,
+              empty_cluster="keep")
+    store = aot.configure(tmp_path / "store")
+    km_mod._STEP_CACHE.clear()
+    ref = KMeans(**kw).fit(X)
+    for f in Path(store.root).glob("*.aotx"):
+        f.write_bytes(b"not a zip")
+    km_mod._STEP_CACHE.clear()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        again = KMeans(**kw).fit(X)
+    assert np.array_equal(ref.centroids, again.centroids)
+    assert store.stats()["fallbacks"] >= 1
+    assert any("unusable" in str(x.message) for x in w)
+
+
+def test_aot_version_skewed_artifact_counted_fallback(tmp_path):
+    """An artifact whose stored meta names another jax build must load
+    as a MISMATCH (counted fallback), never as this build's program —
+    tampered in place so the content-hash lookup still finds it."""
+    X = _blobs(n=700, d=6)
+    kw = dict(k=4, max_iter=6, seed=3, verbose=False, host_loop=False,
+              empty_cluster="keep")
+    store = aot.configure(tmp_path / "store")
+    km_mod._STEP_CACHE.clear()
+    ref = KMeans(**kw).fit(X)
+    for f in Path(store.root).glob("*.aotx"):
+        with zipfile.ZipFile(f) as z:
+            meta = json.loads(z.read("meta.json"))
+            trees, exe = z.read("trees.pkl"), z.read("exe.bin")
+        meta["jax"] = "999.0.0"
+        with zipfile.ZipFile(f, "w") as z:
+            z.writestr("meta.json", json.dumps(meta, sort_keys=True))
+            z.writestr("trees.pkl", trees)
+            z.writestr("exe.bin", exe)
+    km_mod._STEP_CACHE.clear()
+    before = store.stats()["fallbacks"]
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        again = KMeans(**kw).fit(X)
+    assert np.array_equal(ref.centroids, again.centroids)
+    assert store.stats()["fallbacks"] > before
+    assert any("mismatch" in str(x.message) for x in w)
+
+
+def test_aot_off_by_default_zero_hook_cost():
+    """Without a store or env knob, cache entries are plain jitted
+    functions — no wrapper, no store, nothing on disk (the tier-1
+    default)."""
+    assert aot.active_store() is None
+    km_mod._STEP_CACHE.clear()
+    KMeans(k=3, max_iter=3, seed=0, verbose=False).fit(_blobs())
+    for key in km_mod._STEP_CACHE.keys():
+        entry = km_mod._STEP_CACHE[key]
+        for member in (entry if isinstance(entry, tuple) else (entry,)):
+            assert not isinstance(member, aot._AOTProgram)
+
+
+def test_describe_dir_and_ship_with_checkpoint(tmp_path):
+    """checkpoint_every + an active store mirrors artifacts into
+    <ckpt>.aot; resume from that checkpoint registers the dir as a
+    read path; describe_dir summarizes it."""
+    X = _blobs(n=700, d=6)
+    store = aot.configure(tmp_path / "store")
+    km_mod._STEP_CACHE.clear()
+    ckpt = tmp_path / "model.npz"
+    KMeans(k=4, max_iter=6, seed=3, verbose=False, host_loop=False,
+           empty_cluster="keep").fit(X, checkpoint_every=3,
+                                     checkpoint_path=ckpt)
+    shipped = aot.aot_dir_for(ckpt)
+    assert shipped.is_dir() and list(shipped.glob("*.aotx"))
+    desc = aot.describe_dir(shipped)
+    assert desc["exists"] and desc["artifacts"] >= 1
+    assert desc["bytes"] > 0 and desc["unreadable"] == 0
+    assert any(p["cache"] == "kmeans._STEP_CACHE"
+               for p in desc["programs"])
+    # Fresh store elsewhere + resume: the shipped dir joins the read
+    # path and the resumed fit LOADS instead of building.
+    store2 = aot.configure(tmp_path / "other")
+    km_mod._STEP_CACHE.clear()
+    km2 = KMeans(k=4, max_iter=10, seed=3, verbose=False,
+                 host_loop=False, empty_cluster="keep")
+    km2.fit(X, resume=ckpt)
+    assert str(shipped) in [str(d) for d in store2.read_dirs]
+    assert store2.stats()["loaded"] >= 1
+
+
+def test_env_knob_activates_store(tmp_path, monkeypatch):
+    monkeypatch.setenv("KMEANS_TPU_AOT_CACHE", str(tmp_path / "env"))
+    # Reset the lazy env check (configure()/deactivate() marks it
+    # checked; tests must re-arm it).
+    aot._ENV_CHECKED = False
+    aot._STORE = None
+    try:
+        store = aot.active_store()
+        assert store is not None
+        assert str(store.root) == str(tmp_path / "env")
+    finally:
+        aot.deactivate()
+
+
+def test_enable_compilation_cache_env_knob(tmp_path, monkeypatch):
+    monkeypatch.setenv("KMEANS_TPU_COMPILE_CACHE", str(tmp_path / "cc"))
+    aot._COMPILE_CACHE_SET = False
+    assert aot.enable_compilation_cache() == str(tmp_path / "cc")
+    monkeypatch.setenv("KMEANS_TPU_COMPILE_CACHE", "")
+    aot._COMPILE_CACHE_SET = False
+    assert aot.enable_compilation_cache() is None
+
+
+# ---------------------------------------------------------------------------
+# Cross-process round trip — the portable-artifact pin
+# ---------------------------------------------------------------------------
+
+_CHILD = textwrap.dedent("""
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import numpy as np
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    from kmeans_tpu import KMeans
+    from kmeans_tpu.parallel.mesh import make_mesh
+    from kmeans_tpu.utils import aot
+
+    cfg = json.loads(os.environ["KMEANS_TPU_AOT_TEST_CFG"])
+    store = aot.configure(cfg["store"])
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1024, 8)).astype(np.float64)
+    out = {}
+    for data, model in cfg["meshes"]:
+        mesh = make_mesh(data=data, model=model,
+                         devices=jax.devices()[: data * model])
+        km = KMeans(k=4, max_iter=6, seed=11, verbose=False,
+                    host_loop=False, empty_cluster="keep",
+                    dtype=np.float64, mesh=mesh)
+        km.fit(X)
+        out[f"{data}x{model}"] = np.asarray(
+            km.centroids, np.float64).tobytes().hex()
+    stats = store.stats()
+    print("AOT_TEST " + json.dumps(
+        {"tables": out, "built": stats["built"],
+         "loaded": stats["loaded"], "saved": stats["saved"],
+         "fallbacks": stats["fallbacks"]}))
+""")
+
+#: {1, 2, 4, 8}-way meshes including a TP (model-axis) layout.
+_MESHES = [[1, 1], [2, 1], [4, 1], [4, 2]]
+
+
+def _spawn_child(store_dir):
+    env = dict(os.environ)
+    env["KMEANS_TPU_AOT_TEST_CFG"] = json.dumps(
+        {"store": str(store_dir), "meshes": _MESHES})
+    env.pop("KMEANS_TPU_AOT_CACHE", None)
+    proc = subprocess.run([sys.executable, "-c", _CHILD], env=env,
+                          capture_output=True, text=True, timeout=600)
+    for line in proc.stdout.splitlines():
+        if line.startswith("AOT_TEST "):
+            return json.loads(line[len("AOT_TEST "):])
+    raise AssertionError(
+        f"child produced no payload (exit {proc.returncode}):\n"
+        f"{proc.stderr[-3000:]}")
+
+
+def test_cross_process_aot_roundtrip_bit_exact(tmp_path):
+    """Process A compiles + serializes for every mesh layout; process B
+    deserializes-and-fits from the shared store (zero builds) and
+    reproduces A's f64 device-loop trajectories bit-exactly; the parent
+    process's in-process fit is the oracle both must match."""
+    store_dir = tmp_path / "shared_store"
+    a = _spawn_child(store_dir)
+    assert a["built"] > 0 and a["saved"] == a["built"]
+    assert a["fallbacks"] == 0
+
+    b = _spawn_child(store_dir)
+    assert b["built"] == 0, "process B recompiled despite the store"
+    assert b["loaded"] >= len(_MESHES)
+    assert b["tables"] == a["tables"], \
+        "cross-process AOT fit diverged from the compiling process"
+
+    # In-process oracle (AOT off) at the 4x2 TP layout.
+    from kmeans_tpu.parallel.mesh import make_mesh
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1024, 8)).astype(np.float64)
+    km = KMeans(k=4, max_iter=6, seed=11, verbose=False,
+                host_loop=False, empty_cluster="keep",
+                dtype=np.float64, mesh=make_mesh(data=4, model=2))
+    km.fit(X)
+    oracle = np.asarray(km.centroids, np.float64).tobytes().hex()
+    assert a["tables"]["4x2"] == oracle

@@ -170,3 +170,122 @@ def test_sweep_cli_sequential_oracle(data_file, tmp_path, capsys):
         [batched["scores"][k] for k in map(str, batched["k_range"])],
         [seq["scores"][k] for k in map(str, seq["k_range"])],
         rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# warm / ckpt-info aot block / bench-diff TTFI artifacts (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def _aot_clean():
+    """Warm-command tests simulate a fresh process: the in-memory step
+    caches must start cold (earlier suite tests populate entries at
+    these small shapes, which would make `warm` a no-op builder) and
+    the store must not leak out."""
+    from kmeans_tpu.utils import aot
+    import kmeans_tpu.models.kmeans as km_mod
+    km_mod._STEP_CACHE.clear()
+    yield
+    km_mod._STEP_CACHE.clear()
+    aot.deactivate()
+
+
+def test_warm_cli_shape_form_json(tmp_path, capsys, _aot_clean):
+    from kmeans_tpu.cli import warm_main
+    rc = warm_main(["--family", "kmeans", "--shape", "1024x8",
+                    "--k", "4", "--aot-dir", str(tmp_path / "aot"),
+                    "--json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["available"] is True
+    assert out["built"] >= 1 and out["saved"] == out["built"]
+    assert list(Path(tmp_path / "aot").glob("*.aotx"))
+
+
+def test_warm_cli_from_checkpoint_ships_and_loads(tmp_path, capsys,
+                                                  _aot_clean):
+    from kmeans_tpu import KMeans
+    from kmeans_tpu.cli import ckpt_info_main, warm_main
+    from kmeans_tpu.utils import aot
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(600, 5)).astype(np.float32)
+    KMeans(k=3, max_iter=4, seed=0, verbose=False).fit(X).save(
+        tmp_path / "m.npz")
+    # Cache wipe = the fresh-process boundary: the fit above populated
+    # in-memory entries that a real warm-command process starts
+    # without.
+    import kmeans_tpu.models.kmeans as km_mod
+    km_mod._STEP_CACHE.clear()
+    rc = warm_main([str(tmp_path / "m.npz"), "--shape", "1024x5",
+                    "--aot-dir", str(tmp_path / "aot"), "--json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["k"] == 3 and out["built"] >= 1
+    shipped = aot.aot_dir_for(tmp_path / "m.npz")
+    assert shipped.is_dir() and list(shipped.glob("*.aotx"))
+    # ckpt-info reports the shipped aot block.
+    rc = ckpt_info_main([str(tmp_path / "m.npz"), "--json"])
+    assert rc == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info["aot"]["exists"] is True
+    assert info["aot"]["artifacts"] >= 1
+    # A second warm against the same store loads instead of building
+    # (in-memory caches cleared = the fresh-process boundary).
+    km_mod._STEP_CACHE.clear()
+    rc = warm_main([str(tmp_path / "m.npz"), "--shape", "1024x5",
+                    "--aot-dir", str(tmp_path / "aot"), "--json"])
+    assert rc == 0
+    out2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out2["loaded"] >= 1 and out2["built"] == 0
+
+
+def test_warm_cli_requires_shape_without_ckpt(capsys, _aot_clean):
+    from kmeans_tpu.cli import warm_main
+    rc = warm_main(["--family", "kmeans", "--k", "4"])
+    assert rc == 2
+    assert "--shape" in capsys.readouterr().err
+
+
+def test_ckpt_info_reports_missing_aot(tmp_path, capsys):
+    from kmeans_tpu import KMeans
+    from kmeans_tpu.cli import ckpt_info_main
+    rng = np.random.default_rng(0)
+    KMeans(k=3, max_iter=3, seed=0, verbose=False).fit(
+        rng.normal(size=(400, 4)).astype(np.float32)).save(
+        tmp_path / "m.npz")
+    rc = ckpt_info_main([str(tmp_path / "m.npz")])
+    assert rc == 0
+    assert "none shipped" in capsys.readouterr().out
+
+
+def _write_ttfi_trace(path, compile_ms):
+    """A minimal trace JSONL with one compile span + one dispatch."""
+    recs = [
+        {"kind": "header", "wall0": 0.0, "pid": 1,
+         "format": "kmeans_tpu.trace.v1"},
+        {"kind": "span", "name": "place", "id": 0, "parent": None,
+         "depth": 0, "tid": 1, "t0": 0.0, "t1": 0.01, "dur": 0.01},
+        {"kind": "span", "name": "compile", "id": 1, "parent": None,
+         "depth": 0, "tid": 1, "t0": 0.02,
+         "t1": 0.02 + compile_ms / 1e3, "dur": compile_ms / 1e3},
+        {"kind": "span", "name": "dispatch", "id": 2, "parent": None,
+         "depth": 0, "tid": 1, "t0": 0.5, "t1": 0.6, "dur": 0.1},
+    ]
+    path.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+
+
+def test_bench_diff_reads_ttfi_trace_artifacts(tmp_path, capsys):
+    """The TTFI guard (ISSUE 15 satellite): trace JSONL artifacts
+    compare per-phase, and a cold->warm compile regression beyond the
+    spread floor exits 1 like any ms/iter row."""
+    from kmeans_tpu.cli import bench_diff_main
+    old, new = tmp_path / "old.jsonl", tmp_path / "new.jsonl"
+    _write_ttfi_trace(old, compile_ms=10.0)
+    _write_ttfi_trace(new, compile_ms=9.0)
+    assert bench_diff_main([str(old), str(new)]) == 0
+    capsys.readouterr()
+    _write_ttfi_trace(new, compile_ms=200.0)
+    assert bench_diff_main([str(old), str(new), "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert any(k.startswith("ttfi compile") for k in doc["rows"])
+    assert any("ttfi compile" in r for r in doc["regressed"])

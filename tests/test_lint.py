@@ -54,7 +54,8 @@ def test_registry_has_the_required_rules():
     """The six incident-class rules (plus the suppression-format
     meta-rule) are registered — the >= 6 acceptance bar."""
     assert {"trace-hazard", "cache-key", "dispatch", "thread",
-            "counter-reset", "dead-private", "cache-name"} <= set(RULES)
+            "counter-reset", "dead-private", "cache-name",
+            "aot-key"} <= set(RULES)
     assert len(RULES) >= 6
     for rule in RULES.values():
         assert rule.id and rule.incident, rule
@@ -630,6 +631,66 @@ def test_cache_name_suppression_honored(tmp_path):
         "measurement cache, opted out of telemetry")
     findings = run_on(tmp_path, src, subdir="models")
     assert [f for f in findings if f.rule == "cache-name"] == []
+
+
+# ---------------------------------------------------------------------------
+# aot-key (ISSUE 15: the cache-key rule family, across processes)
+# ---------------------------------------------------------------------------
+
+_AOT_KEY_BAD = """
+def persist(store, compiled, mesh, chunk):
+    fields = {"mesh": repr(mesh), "chunk": chunk}   # hand-rolled key
+    store.put(fields, compiled)
+"""
+
+_AOT_KEY_OK = """
+from kmeans_tpu.utils.aot import artifact_key
+
+def persist(store, compiled, cache_name, key, sig):
+    store.put(artifact_key(cache_name, key, sig), compiled)
+"""
+
+_AOT_KEY_OK_CHASED = """
+from kmeans_tpu.utils.aot import artifact_key
+
+def persist(store, compiled, cache_name, key, sig):
+    fields = artifact_key(cache_name, key, sig)
+    store.put(fields, compiled)
+"""
+
+
+def test_aot_key_fires_on_hand_rolled_key(tmp_path):
+    findings = [f for f in run_on(tmp_path, _AOT_KEY_BAD,
+                                  subdir="utils")
+                if f.rule == "aot-key"]
+    assert len(findings) == 1
+    assert "artifact_key" in findings[0].message
+
+
+def test_aot_key_silent_on_blessed_constructor(tmp_path):
+    for src in (_AOT_KEY_OK, _AOT_KEY_OK_CHASED):
+        findings = run_on(tmp_path, src, subdir="utils")
+        assert [f for f in findings if f.rule == "aot-key"] == []
+
+
+def test_aot_key_ignores_non_store_puts(tmp_path):
+    src = """
+import queue
+
+def enqueue(q: queue.Queue, item):
+    q.put({"raw": item})
+"""
+    findings = run_on(tmp_path, src, subdir="utils")
+    assert [f for f in findings if f.rule == "aot-key"] == []
+
+
+def test_aot_key_suppression_honored(tmp_path):
+    src = _AOT_KEY_BAD.replace(
+        "store.put(fields, compiled)",
+        "store.put(fields, compiled)  # lint: ok(aot-key) — test "
+        "fixture exercising the corrupt-artifact path")
+    findings = run_on(tmp_path, src, subdir="utils")
+    assert [f for f in findings if f.rule == "aot-key"] == []
 
 
 # ---------------------------------------------------------------------------
