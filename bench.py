@@ -80,6 +80,20 @@ every run, the corrected-rows audit published with the rate
 (``kmeans_tpu.benchmarks.bench_bf16_guard``; committed adopt rule:
 >= 5% at the headline shape).  Env: BENCH_N/_D/_K/_ITERS.
 
+BENCH_LARGEK=1 switches to the MASSIVE-k SCALING CURVE (ISSUE 16
+tentpole): ms/iter vs k at fixed N x D for the dense Lloyd oracle vs
+the routed large-k tier — k_shard=model_shards (TP-sharded centroid
+table, pair all-reduce assignment) on a model-sharded mesh,
+assign='two_level' (coarse-cell candidate routing) on a data-parallel
+one — interleaved per-rep marginal ratio pairs, the in-bench parity
+oracle (k-shard: bit parity asserted; two-level: SSE gap published),
+and the planner's predicted-vs-observed HBM bytes per row
+(``kmeans_tpu.benchmarks.bench_large_k``).  Accelerator default is
+2M x 128 over k in {1024, 4096, 16384, 65536}; the CPU proxy scales
+to 50k x 32 over k in {256, 512, 1024, 2048}.  Env: BENCH_N/_D,
+BENCH_LARGEK_KS (comma list), BENCH_ITERS, BENCH_MODEL_SHARDS
+(builds a TP mesh and benches the k-sharded route instead).
+
 BENCH_OBS=1 switches to the TELEMETRY-OVERHEAD benchmark (ISSUE 11):
 obs-on (span tracing + heartbeat) vs obs-off fits, interleaved per-rep
 ratios on BOTH the one-dispatch device loop and the telemetry-dense
@@ -292,6 +306,27 @@ def main() -> None:
             log(f"bench: BF16-GUARD mode backend={backend} N={ln} "
                 f"D={ld} k={lk} iters_gap={li}")
             bench_bf16_guard(ln, ld, lk, li)
+        return
+
+    if os.environ.get("BENCH_LARGEK"):
+        # Massive-k scaling curve (ISSUE 16): dense oracle vs the
+        # routed large-k tier across a k sweep at fixed N x D,
+        # interleaved per-rep ratios + parity oracles + planner
+        # predicted-vs-observed HBM rows.
+        from kmeans_tpu.benchmarks import bench_large_k
+        xn = int(os.environ.get("BENCH_N",
+                                2_000_000 if on_accel else 50_000))
+        xd = int(os.environ.get("BENCH_D", 128 if on_accel else 32))
+        xks = tuple(int(v) for v in os.environ.get(
+            "BENCH_LARGEK_KS",
+            "1024,4096,16384,65536" if on_accel
+            else "256,512,1024,2048").split(","))
+        xi = int(os.environ.get("BENCH_ITERS", 8))
+        xm = int(os.environ.get("BENCH_MODEL_SHARDS", 0))
+        log(f"bench: LARGE-K mode backend={backend} N={xn} D={xd} "
+            f"ks={xks} iters_gap={xi}"
+            + (f" model_shards={xm}" if xm else ""))
+        bench_large_k(xn, xd, xks, iters=xi, model_shards=xm)
         return
 
     if os.environ.get("BENCH_QUALITY"):

@@ -21,6 +21,7 @@ from kmeans_tpu.models.bisecting import BisectingKMeans
 from kmeans_tpu.models.spherical import SphericalKMeans
 from kmeans_tpu.models.gmm import GaussianMixture
 from kmeans_tpu.models.fault_tolerance import NumericalDivergenceError
+from kmeans_tpu.models.pq import ProductQuantizer
 from kmeans_tpu.parallel.mesh import make_mesh
 from kmeans_tpu.parallel.sharding import ShardedDataset
 from kmeans_tpu.sweep import SweepResult
@@ -29,5 +30,5 @@ __version__ = "0.1.0"
 
 __all__ = ["KMeans", "MiniBatchKMeans", "BisectingKMeans",
            "SphericalKMeans", "GaussianMixture", "DispatchLatencyHint",
-           "NumericalDivergenceError", "ShardedDataset", "SweepResult",
-           "make_mesh", "__version__"]
+           "NumericalDivergenceError", "ProductQuantizer", "ShardedDataset",
+           "SweepResult", "make_mesh", "__version__"]

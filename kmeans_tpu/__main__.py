@@ -54,9 +54,12 @@ def main() -> int:
     if cmd == "bench-diff":
         from kmeans_tpu.cli import bench_diff_main
         return bench_diff_main(rest)
+    if cmd == "plan":
+        from kmeans_tpu.cli import plan_main
+        return plan_main(rest)
     print(f"unknown command {cmd!r}; available: suite, bench, fit, "
           f"sweep, ckpt-info, warm, serve, report, lint, trace, "
-          f"cost-report, fleet-status, serve-status, bench-diff",
+          f"cost-report, fleet-status, serve-status, bench-diff, plan",
           file=sys.stderr)
     return 2
 

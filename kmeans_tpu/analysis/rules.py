@@ -948,6 +948,83 @@ class AotKeyRule(Rule):
         return best is not None and self._is_key_call(best.value)
 
 
+# ------------------------------------------------------------- large-k
+
+#: Program builders whose E-pass materializes a dense (chunk, k)
+#: distance tile per device (parallel.distributed's dispatch surface).
+_DENSE_TILE_BUILDERS = {
+    "make_step_fn", "make_fit_fn", "make_multi_fit_fn",
+    "make_predict_fn", "make_transform_fn", "make_score_rows_fn",
+    "make_assign_margin_fn",
+}
+#: Atoms whose presence marks the class as large-k-aware: a planner
+#: fit-check (``plan_fit`` / the KMeans resolution helpers) or a
+#: ``k_shard``/``assign`` dispatch branch (names, attributes, spec-dict
+#: string keys and the 'two_level' route constant all count).
+_LARGE_K_GUARDS = {
+    "plan_fit", "_resolve_large_k", "_route_large_k",
+    "k_shard", "assign", "two_level",
+}
+
+
+class LargeKRule(Rule):
+    """ISSUE 16: any CLASS that builds dense-tile programs (a
+    ``make_*_fn`` from the dispatch surface — each one materializes a
+    (chunk, k) distance tile per device) must be large-k-aware: it must
+    consult the r16 planner (``plan_fit``, or the KMeans
+    ``_resolve_large_k``/``_route_large_k`` helpers that wrap it) or
+    carry a ``k_shard``/``assign`` dispatch branch routing past the
+    memory wall.  A class that unconditionally instantiates the dense
+    tile re-opens the exact failure the massive-k tier closed: at
+    k=64k x chunk=8192 the tile alone is 2 GiB/device, an OOM no knob
+    can route around after the fact.  Class granularity is the honest
+    scope — module-level builder calls (benchmarks, the builder layer
+    itself) size their shapes deliberately."""
+
+    id = "large-k"
+    incident = ("ISSUE 16: an unguarded dense (chunk, k) tile "
+                "materialization OOMs at massive k with no dispatch "
+                "route around it")
+
+    def run(self, pkg: Package) -> Iterator[Finding]:
+        for mod in pkg:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                calls = [
+                    c for c in ast.walk(node)
+                    if isinstance(c, ast.Call)
+                    and (dotted(c.func) or "").split(".")[-1]
+                    in _DENSE_TILE_BUILDERS]
+                if not calls or self._atoms(node) & _LARGE_K_GUARDS:
+                    continue
+                yield self.finding(
+                    mod, calls[0].lineno,
+                    f"class {node.name} builds dense-tile programs "
+                    f"({(dotted(calls[0].func) or '').split('.')[-1]}) "
+                    f"with no plan_fit fit-check and no k_shard/assign "
+                    f"dispatch branch — unguarded (chunk, k) tiles OOM "
+                    f"at massive k (ISSUE 16)")
+
+    @staticmethod
+    def _atoms(node: ast.AST) -> Set[str]:
+        """Every symbol-ish atom in the class body: Name ids, Attribute
+        components, keyword-argument names, and string constants (spec
+        keys like 'assign' and route constants like 'two_level' live as
+        strings)."""
+        atoms: Set[str] = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name):
+                atoms.add(n.id)
+            elif isinstance(n, ast.Attribute):
+                atoms.add(n.attr)
+            elif isinstance(n, ast.keyword) and n.arg:
+                atoms.add(n.arg)
+            elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+                atoms.add(n.value)
+        return atoms
+
+
 # -------------------------------------------------------- suppression
 
 class SuppressionFormatRule(Rule):
@@ -980,5 +1057,6 @@ RULES: Dict[str, Rule] = {rule.id: rule for rule in (
     TraceHazardRule(), CacheKeyRule(), DispatchAccountingRule(),
     ObsSpanRule(), CollectiveSpanRule(), QualityCounterRule(),
     ThreadHygieneRule(), CounterResetRule(), DeadPrivateRule(),
-    CacheNameRule(), AotKeyRule(), SuppressionFormatRule(),
+    CacheNameRule(), AotKeyRule(), LargeKRule(),
+    SuppressionFormatRule(),
 )}

@@ -602,7 +602,8 @@ def bench_gmm_pipeline(n: int, d: int, k: int, iters: int = 20,
     return result
 
 
-def _lloyd_bench_setup(n: int, d: int, k: int, seed: int = 42):
+def _lloyd_bench_setup(n: int, d: int, k: int, seed: int = 42,
+                       mesh=None):
     """Shared staging of the Lloyd schedule/rung benches: a sharded
     uniform dataset + a fixed explicit init (identical across variants,
     so the marginal compares SCHEDULES, never init luck)."""
@@ -611,22 +612,28 @@ def _lloyd_bench_setup(n: int, d: int, k: int, seed: int = 42):
     rng = np.random.default_rng(seed)
     X = rng.uniform(-1, 1, size=(n, d)).astype(np.float32)
     init = X[np.sort(rng.choice(n, size=k, replace=False))].copy()
-    staging = KMeans(k=k, verbose=False)
+    staging = KMeans(k=k, verbose=False, mesh=mesh)
     ds = staging.cache(X)
     return ds, init
 
 
 def _timed_lloyd_fit(ds, init, k: int, mi: int, *, mode: str,
-                     pipeline: int) -> float:
+                     pipeline: int, **extra) -> float:
     """Wall seconds of one whole-fit dispatch (estimator level, so the
     measured program is exactly what `KMeans(distance_mode=, pipeline=)`
-    ships; the fixed-iteration tolerance keeps both sides honest)."""
+    ships; the fixed-iteration tolerance keeps both sides honest).
+    ``extra`` overrides estimator knobs — the large-k bench routes
+    through here with ``k_shard``/``assign``/``host_loop`` (the routed
+    steps are per-iteration host-loop programs, so the comparison pins
+    ``host_loop=True`` on BOTH sides)."""
     from kmeans_tpu.models.kmeans import KMeans
 
-    m = KMeans(k=k, max_iter=mi, tolerance=1e-30, seed=0, init=init,
-               compute_sse=False, compute_labels=False,
-               empty_cluster="keep", host_loop=False, verbose=False,
-               distance_mode=mode, pipeline=pipeline)
+    kw = dict(k=k, max_iter=mi, tolerance=1e-30, seed=0, init=init,
+              compute_sse=False, compute_labels=False,
+              empty_cluster="keep", host_loop=False, verbose=False,
+              distance_mode=mode, pipeline=pipeline)
+    kw.update(extra)
+    m = KMeans(**kw)
     m._eager_labels = False
     t0 = time.perf_counter()
     m.fit(ds)
@@ -792,6 +799,173 @@ def bench_bf16_guard(n: int, d: int, k: int, iters: int = 20,
     }
     print(json.dumps(result), flush=True)
     return result
+
+
+def _large_k_capture_fit(ds, init, k: int, extra: dict, mesh=None):
+    """One short (3-iteration) fit under the cost collector: returns
+    ``(model, records)`` — the records join ``plan_fit`` for the
+    predicted-vs-observed HBM row, the model carries the parity
+    inputs (``inertia_``, ``centroids``) and the resolved route."""
+    from kmeans_tpu.models.kmeans import KMeans
+    from kmeans_tpu.obs import cost as cost_mod
+
+    m = KMeans(k=k, max_iter=3, tolerance=1e-30, seed=0, init=init,
+               compute_sse=True, compute_labels=False,
+               empty_cluster="keep", host_loop=True, verbose=False,
+               distance_mode="matmul", pipeline=0, mesh=mesh, **extra)
+    m._eager_labels = False
+    with cost_mod.collecting() as col:
+        m.fit(ds)
+    return m, col.records()
+
+
+def bench_large_k(n: int, d: int, ks, iters: int = 8,
+                  reps: int = 3, model_shards: int = 0) -> Dict:
+    """Massive-k scaling curve (ISSUE 16 tentpole artifact:
+    ``BENCH_LARGEK=1 python bench.py``): ms/iter vs k at FIXED N x D
+    for the dense Lloyd oracle vs the routed large-k tier, one row per
+    k.  The route is what the mesh affords — ``k_shard=model_shards``
+    (TP-sharded centroid table, pair all-reduce assignment) on a
+    model-sharded mesh, ``assign='two_level'`` (coarse-cell candidate
+    routing) on a data-parallel one — and each row records what the
+    planner's 'auto' rule would have resolved at that shape, so the
+    published curve and the shipping default are comparable.
+
+    Method: per-rep INTERLEAVED (2, 2+iters) marginal pairs (the r6
+    drift rule, median-of-ratios, <= 5% spread bar published per row).
+    Both sides run the per-iteration host loop — the routed steps are
+    host-loop programs by construction (member tables / stats gathers
+    rebuild between iterations), so a device-loop dense side would
+    conflate dispatch amortization with the tier's actual per-iteration
+    cost.  Each row also carries the parity oracle from a short
+    same-init fit pair (k-shard: centroid maxdiff, bit-exact expected;
+    two-level: SSE relative gap — labels may differ inside the
+    candidate-set contract, docs/ANALYSIS.md) and the planner's
+    predicted table/peak bytes with XLA-observed peak joined when the
+    backend reports it."""
+    import jax
+
+    from kmeans_tpu.obs import memory as memory_mod
+    from kmeans_tpu.parallel.mesh import make_mesh, mesh_shape
+
+    # model_shards > 0 builds a TP mesh explicitly (BENCH_MODEL_SHARDS)
+    # — that is what flips the route to the k-sharded table on hosts
+    # whose default mesh is data-only.
+    mesh = make_mesh(model=model_shards) if model_shards else make_mesh()
+    data_shards, model_shards = mesh_shape(mesh)
+    if model_shards > 1:
+        route = "k_shard"
+        routed_kw = dict(k_shard=model_shards, assign="dense")
+    else:
+        route = "two_level"
+        routed_kw = dict(k_shard=0, assign="two_level")
+    dense_kw = dict(k_shard=0, assign="dense")
+    rows = []
+    for k in ks:
+        ds, init = _lloyd_bench_setup(n, d, k, mesh=mesh)
+        # Parity + plan capture first (cheap; a broken route makes the
+        # timing meaningless).  Same init on both sides.
+        m_dense, recs_dense = _large_k_capture_fit(ds, init, k,
+                                                   dense_kw, mesh=mesh)
+        m_routed, recs_routed = _large_k_capture_fit(ds, init, k,
+                                                     routed_kw, mesh=mesh)
+        maxdiff = float(np.max(np.abs(
+            np.asarray(m_dense.centroids, np.float64)
+            - np.asarray(m_routed.centroids, np.float64))))
+        sse_gap = float(m_routed.inertia_ / m_dense.inertia_ - 1.0)
+        if route == "k_shard" and maxdiff != 0.0:
+            raise AssertionError(
+                f"k-sharded step broke bit parity with the dense TP "
+                f"oracle at k={k} (centroid maxdiff {maxdiff:.3e}) — "
+                f"do not publish a rate for a wrong answer")
+        plan_dense = memory_mod.plan_fit(
+            "kmeans", n, d, k, data_shards=data_shards,
+            model_shards=model_shards, chunk=ds.chunk, k_shard=0,
+            records=recs_dense)
+        plan_routed = memory_mod.plan_fit(
+            "kmeans", n, d, k, data_shards=data_shards,
+            model_shards=model_shards, chunk=ds.chunk,
+            k_shard=model_shards if route == "k_shard" else 0,
+            records=recs_routed)
+        # What the shipping 'auto' rule resolves to at this shape (the
+        # planner consults live allocator stats; unreported backends
+        # resolve dense — recorded so the curve says which rows the
+        # default would actually route).
+        from kmeans_tpu.models.kmeans import KMeans
+        probe = KMeans(k=k, seed=0, verbose=False, mesh=mesh)
+        auto_ks, auto_asg = probe._resolve_large_k(
+            ds, data_shards, model_shards, ds.chunk)
+        p0, p1, ratios = _interleaved_lloyd_pair(
+            ds, init, k, iters, reps,
+            dict(mode="matmul", pipeline=0, host_loop=True, mesh=mesh,
+                 **dense_kw),
+            dict(mode="matmul", pipeline=0, host_loop=True, mesh=mesh,
+                 **routed_kw),
+            "dense", route, f"large-k:{k}")
+        speedup = float(np.median(ratios))
+        spread = (max(ratios) - min(ratios)) / speedup
+        row = {
+            "metric": f"large_k_N{n}_D{d}_k{k}",
+            "value": round(p1 * 1e3, 4),
+            "unit": f"ms/iter (routed large-k tier: {route})",
+            "k": k, "n": n, "d": d, "chunk": ds.chunk,
+            "route": route,
+            "dense_ms_per_iter": round(p0 * 1e3, 4),
+            "routed_ms_per_iter": round(p1 * 1e3, 4),
+            "dense_over_routed": round(speedup, 4),
+            "ratio_spread": round(spread, 3),
+            "indicative_only": bool(spread > 0.05),
+            "iters_gap": iters,
+            "centroid_maxdiff": maxdiff,
+            "sse_rel_gap": round(sse_gap, 8),
+            "auto_resolution": {"k_shard": auto_ks, "assign": auto_asg},
+            "predicted_table_bytes_dense":
+                plan_dense["components"]["table_bytes"],
+            "predicted_table_bytes_routed":
+                plan_routed["components"]["table_bytes"],
+            "predicted_peak_bytes_dense":
+                plan_dense["predicted_peak_bytes"],
+            "predicted_peak_bytes_routed":
+                plan_routed["predicted_peak_bytes"],
+            "observed_peak_bytes_dense":
+                plan_dense["observed_peak_bytes"],
+            "observed_peak_bytes_routed":
+                plan_routed["observed_peak_bytes"],
+            "platform": jax.default_backend(),
+            "n_devices": len(jax.devices()),
+        }
+        if route == "two_level":
+            row["coarse_cells"], row["nprobe"] = \
+                m_routed._two_level_params()
+            tl = m_routed._two_level_route_
+            row["candidate_width"] = int(tl[1].shape[1]) if tl else None
+        _log(f"[large-k] k={k}: dense {p0 * 1e3:.2f} ms/iter, {route} "
+             f"{p1 * 1e3:.2f} ms/iter, dense/routed {speedup:.3f}x "
+             f"(spread {spread * 100:.0f}%), sse_gap {sse_gap:+.2e}, "
+             f"auto -> k_shard={auto_ks} assign={auto_asg!r}")
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+    _log("\n| k | dense ms/iter | routed ms/iter | dense/routed | "
+         "spread | predicted peak B/dev (dense -> routed) |")
+    _log("|---|---|---|---|---|---|")
+    for r in rows:
+        _log(f"| {r['k']:,} | {r['dense_ms_per_iter']} | "
+             f"{r['routed_ms_per_iter']} | {r['dense_over_routed']}x | "
+             f"{r['ratio_spread'] * 100:.0f}% | "
+             f"{r['predicted_peak_bytes_dense']:,} -> "
+             f"{r['predicted_peak_bytes_routed']:,} |")
+    summary = {
+        "metric": f"large_k_curve_N{n}_D{d}",
+        "value": rows[-1]["routed_ms_per_iter"] if rows else None,
+        "unit": "ms/iter (routed large-k tier at the largest k)",
+        "route": route,
+        "ks": list(ks),
+        "rows": rows,
+        "platform": jax.default_backend(),
+        "n_devices": len(jax.devices()),
+    }
+    print(json.dumps(summary), flush=True)
+    return summary
 
 
 #: Chunk-geometry re-sweep candidates of the BENCH_PHASES mode: the

@@ -709,6 +709,109 @@ def cost_report_main(argv=None) -> int:
     return 0
 
 
+def plan_main(argv=None) -> int:
+    """``python -m kmeans_tpu plan --n N --d D --k K [...]`` — the r16
+    HBM planner + the massive-k resolution (ISSUE 16), standalone: the
+    dense per-device footprint at (N, D, k, mesh, chunk), the k-sharded
+    footprint when the mesh has a TP axis, and the ``k_shard``/
+    ``assign`` values the ``'auto'`` rule would pick on THIS backend —
+    the same 80%-of-free-bytes rule ``KMeans._resolve_large_k``
+    applies at fit time (kept in lockstep; the resolution text names
+    which branch decided).  Pure arithmetic plus one allocator-stats
+    read: no arrays are placed, so planning a 64k-centroid fit costs
+    milliseconds."""
+    parser = argparse.ArgumentParser(
+        prog="python -m kmeans_tpu plan",
+        description="Per-device HBM footprint plan + massive-k "
+                    "k_shard/assign resolution for a fit shape")
+    parser.add_argument("--n", type=int, required=True, help="rows")
+    parser.add_argument("--d", type=int, required=True, help="features")
+    parser.add_argument("--k", type=int, required=True, help="clusters")
+    parser.add_argument("--data-shards", type=int, default=None,
+                        help="default: local device count")
+    parser.add_argument("--model-shards", type=int, default=1)
+    parser.add_argument("--dtype", default="float32")
+    parser.add_argument("--chunk", type=int, default=None,
+                        help="scan chunk (default: the auto VMEM rule)")
+    parser.add_argument("--k-shard", default="auto",
+                        help="auto | 0 | <model_shards> (the KMeans "
+                             "knob grammar)")
+    parser.add_argument("--assign", default="auto",
+                        choices=("auto", "dense", "two_level"))
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args(argv)
+
+    from kmeans_tpu.obs import memory as _mem
+    from kmeans_tpu.parallel.sharding import choose_chunk_size
+    import jax
+    S = args.data_shards if args.data_shards is not None \
+        else jax.local_device_count()
+    M = int(args.model_shards)
+    if args.k_shard != "auto":
+        try:
+            ks_req = int(args.k_shard)
+        except ValueError:
+            print(f"error: --k-shard must be 'auto' or an int, got "
+                  f"{args.k_shard!r}", file=sys.stderr)
+            return 2
+        if ks_req not in (0, M) or (ks_req and M <= 1):
+            print(f"error: --k-shard={ks_req} must be 0 or match "
+                  f"--model-shards={M} (the table shards on the "
+                  f"existing TP axis)", file=sys.stderr)
+            return 2
+    chunk = args.chunk or choose_chunk_size(
+        -(-args.n // S), max(args.k, M), args.d)
+    plans = [_mem.plan_fit("kmeans", args.n, args.d, args.k,
+                           data_shards=S, model_shards=M,
+                           dtype=args.dtype, chunk=chunk, k_shard=0)]
+    if M > 1:
+        plans.append(_mem.plan_fit("kmeans", args.n, args.d, args.k,
+                                   data_shards=S, model_shards=M,
+                                   dtype=args.dtype, chunk=chunk,
+                                   k_shard=M))
+    # The fit-time auto rule, mirrored (KMeans._resolve_large_k): the
+    # DENSE plan against 80% of the device's free bytes; no allocator
+    # stats (CPU) -> the bit-exact dense oracles.
+    info = _mem.device_memory_info()
+    fits = True
+    if info.get("available"):
+        fits = plans[0]["predicted_peak_bytes"] <= 0.8 * info["bytes_free"]
+    ks = (0 if (fits or M <= 1) else M) if args.k_shard == "auto" \
+        else int(args.k_shard)
+    asg = ("dense" if (fits or M > 1) else "two_level") \
+        if args.assign == "auto" else args.assign
+    if asg == "two_level" and M != 1:
+        print("error: assign='two_level' composes with data "
+              "parallelism only (model_shards == 1); on a TP mesh use "
+              "k_shard instead", file=sys.stderr)
+        return 2
+    why = ("allocator stats unavailable on this backend — dense "
+           "oracles" if not info.get("available")
+           else "dense plan fits in 80% of free HBM" if fits
+           else "dense plan exceeds 80% of free HBM")
+    resolution = {"k_shard": ks, "assign": asg,
+                  "auto_rule": why,
+                  "dense_predicted_peak_bytes":
+                      plans[0]["predicted_peak_bytes"],
+                  "device_memory": info}
+    if args.json:
+        from kmeans_tpu.utils.profiling import sanitize_json
+        print(json.dumps(sanitize_json(
+            {"plans": plans, "resolution": resolution}), default=str))
+        return 0
+    print(_mem.format_plan_table(
+        plans, title=f"hbm footprint plan ({S}x{M} mesh)"))
+    print()
+    print(f"resolution     : k_shard={ks}, assign={asg!r}  [{why}]")
+    if M > 1:
+        dense, shard = plans[0], plans[1]
+        saved = dense["predicted_peak_bytes"] \
+            - shard["predicted_peak_bytes"]
+        print(f"k-shard saves  : {saved:,} B/device of predicted peak "
+              f"(replicated full-k stats accumulators -> local shard)")
+    return 0
+
+
 def serve_status_main(argv=None) -> int:
     """``python -m kmeans_tpu serve-status <dir-or-files> [--json]`` —
     per-model serving-quality/drift table from the quality JSONL sinks
@@ -779,7 +882,9 @@ _BENCH_DEFAULT_SPREAD = 0.05
 #: per-batch-size serving rows) — tried in order before falling back
 #: to the occurrence index (append-only artifacts keep occurrence
 #: order stable, so old/new keys still align).
-_BENCH_DISCRIMINATORS = ("batch_requests", "batch", "clients")
+#: "k" discriminates the BENCH_LARGEK k-sweep rows (ISSUE 16: one row
+#: per table size under a shared method label).
+_BENCH_DISCRIMINATORS = ("batch_requests", "batch", "clients", "k")
 
 
 def _ttfi_trace_rows(records) -> list:
@@ -1132,6 +1237,58 @@ def warm_main(argv=None) -> int:
     return 0
 
 
+def _ckpt_plan(path, info: dict, plan_n: int) -> dict:
+    """The ckpt-info planner block (ISSUE 16): r16 ``plan_fit`` rows
+    for this checkpoint's table on its written-on mesh at ``plan_n``
+    rows, plus the ``k_shard``/``assign`` resolution — the state's own
+    explicit knobs when it carries them, the fit-time auto rule
+    otherwise."""
+    import json as _json
+    from kmeans_tpu.obs import memory as _mem
+    from kmeans_tpu.parallel.sharding import choose_chunk_size
+    from kmeans_tpu.utils.checkpoint import prev_path
+    src = path if info["source"] == "primary" else str(prev_path(path))
+    with np.load(src, allow_pickle=False) as z:
+        meta = _json.loads(str(z["__meta__"]))
+        name = "centroids" if "centroids" in z.files else next(
+            f for f in z.files
+            if f != "__meta__" and z[f].ndim == 2)
+        d = int(z[name].shape[1])
+    mesh = info.get("written_on_mesh") or {}
+    S = int(mesh.get("data_shards") or 1)
+    M = int(mesh.get("model_shards") or 1)
+    k = int(info["k"])
+    dtype = str(info.get("dtype") or "float32")
+    chunk = choose_chunk_size(-(-plan_n // S), max(k, M), d)
+    plans = [_mem.plan_fit("kmeans", plan_n, d, k, data_shards=S,
+                           model_shards=M, dtype=dtype, chunk=chunk,
+                           k_shard=0)]
+    if M > 1:
+        plans.append(_mem.plan_fit("kmeans", plan_n, d, k,
+                                   data_shards=S, model_shards=M,
+                                   dtype=dtype, chunk=chunk, k_shard=M))
+    dev = _mem.device_memory_info()
+    fits = True
+    if dev.get("available"):
+        fits = plans[0]["predicted_peak_bytes"] <= 0.8 * dev["bytes_free"]
+    ks, asg = meta.get("k_shard", "auto"), meta.get("assign", "auto")
+    # Any explicit knob in the state wins over the auto rule; only a
+    # fully-'auto' state reports a purely rule-driven resolution.
+    src_note = "auto rule" if (ks == "auto" and asg == "auto") \
+        else "checkpoint knobs"
+    if ks == "auto":
+        ks = 0 if (fits or M <= 1) else M
+    if asg == "auto":
+        asg = "dense" if (fits or M > 1) else "two_level"
+    return {"n_assumed": int(plan_n), "d": d, "k": k,
+            "data_shards": S, "model_shards": M, "chunk": chunk,
+            "plans": plans,
+            "k_shard": int(ks), "assign": asg,
+            "resolved_by": src_note,
+            "table_bytes_per_device":
+                plans[-1]["components"]["table_bytes"]}
+
+
 def ckpt_info_main(argv=None) -> int:
     """``python -m kmeans_tpu ckpt-info <path>`` — print a checkpoint's
     metadata block (model class, k, completed iteration, the mesh shape
@@ -1146,6 +1303,11 @@ def ckpt_info_main(argv=None) -> int:
     parser.add_argument("path", help="checkpoint path (.npz)")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable JSON only")
+    parser.add_argument("--plan-n", type=int, default=1_000_000,
+                        metavar="N",
+                        help="rows assumed for the HBM planner block "
+                             "(ISSUE 16; default 1e6 — the table-side "
+                             "terms dominate at massive k)")
     args = parser.parse_args(argv)
 
     from kmeans_tpu.utils.checkpoint import describe_checkpoint
@@ -1154,6 +1316,18 @@ def ckpt_info_main(argv=None) -> int:
     # this checkpoint (<path>.aot), described without device init.
     from kmeans_tpu.utils import aot
     info["aot"] = aot.describe_dir(aot.aot_dir_for(args.path))
+    # Large-k planner block (ISSUE 16): the per-device table footprint
+    # and the k_shard/assign resolution this state would get at
+    # --plan-n rows on its written-on mesh, in the r16 planner's
+    # format.  Needs the table's D: read ONE member's shape from the
+    # loadable source (lazy per-member np.load — the payload arrays
+    # stay compressed); any failure skips the block, never the report.
+    info["plan"] = None
+    if info.get("source") and info.get("k"):
+        try:
+            info["plan"] = _ckpt_plan(args.path, info, args.plan_n)
+        except Exception:       # noqa: BLE001 — the block is optional
+            info["plan"] = None
     if args.json:
         print(json.dumps(info, indent=2))
         return 0 if info.get("source") else 2
@@ -1194,6 +1368,20 @@ def ckpt_info_main(argv=None) -> int:
         lines.append(
             "aot executables : none shipped (run `python -m kmeans_tpu "
             "warm <ckpt>` to pre-populate)")
+    p = info.get("plan")
+    if p:
+        from kmeans_tpu.obs.memory import _fmt_bytes
+        lines.append(
+            f"table footprint : "
+            f"{_fmt_bytes(p['table_bytes_per_device'])}/device "
+            f"(k={p['k']}, d={p['d']}, {p['data_shards']}x"
+            f"{p['model_shards']} mesh)")
+        lines.append(
+            f"large-k route   : k_shard={p['k_shard']}, "
+            f"assign={p['assign']!r}  [{p['resolved_by']}; planned at "
+            f"n={p['n_assumed']:,}, predicted peak "
+            f"{_fmt_bytes(p['plans'][-1]['predicted_peak_bytes'])}"
+            f"/device]")
     if info.get("primary_error"):
         lines.append(f"primary error   : {info['primary_error']}")
     print("\n".join(lines))

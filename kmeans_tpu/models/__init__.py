@@ -13,7 +13,9 @@ from kmeans_tpu.models.spherical import SphericalKMeans
 from kmeans_tpu.models.gmm import GaussianMixture
 from kmeans_tpu.models.fault_tolerance import NumericalDivergenceError
 from kmeans_tpu.models.init import forgy_init, kmeanspp_init
+from kmeans_tpu.models.pq import ProductQuantizer
 
 __all__ = ["KMeans", "MiniBatchKMeans", "BisectingKMeans",
            "SphericalKMeans", "GaussianMixture", "DispatchLatencyHint",
-           "NumericalDivergenceError", "forgy_init", "kmeanspp_init"]
+           "NumericalDivergenceError", "forgy_init", "kmeanspp_init",
+           "ProductQuantizer"]

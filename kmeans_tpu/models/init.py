@@ -315,6 +315,7 @@ def _fold_candidates(points, mind2, cands, valid):
 
 def _kmeans_parallel_host(src, k: int, seed: int, *, rounds: int = 5,
                           oversampling: Optional[float] = None,
+                          cap: Optional[int] = None,
                           return_candidates: bool = False) -> np.ndarray:
     """LEGACY kmeans|| engine (the ``device=False`` path): per-round device
     dispatches with host-side candidate bookkeeping and a host-side final
@@ -339,7 +340,11 @@ def _kmeans_parallel_host(src, k: int, seed: int, *, rounds: int = 5,
 
     ell = float(oversampling if oversampling is not None else 2 * k)
     # cap may not exceed the (padded) point count — lax.top_k requires it.
-    cap = int(min(max(2 * k, 256), 2048, points.shape[0]))
+    # Default clamp(2k, 256, 2048) unchanged since r5 (the pinned
+    # oracle trajectory); an explicit cap (ISSUE 16 — KMeans(init_cap=))
+    # overrides the capacity, bounded the same way.
+    cap = int(min(max(2 * k, 256), 2048, points.shape[0])) if cap is None \
+        else int(min(max(int(cap), 1), points.shape[0]))
     rounds = max(rounds, -(-int(1.5 * k) // cap))  # ensure >= 1.5k samples
     key = jax.random.PRNGKey(seed)
     rng = np.random.default_rng(seed)
@@ -694,9 +699,13 @@ def kmeans_parallel_init(X, k: int, seed: int, *, rounds: int = 5,
     (``_kmeans_parallel_host``) as the parity/trajectory oracle.
 
     ``cap`` overrides the per-round candidate capacity (default
-    ``clamp(2k, 256, 2048)``, bounded by the per-shard row count);
-    ``refine`` sets the on-device weighted Lloyd polish steps (device
-    path only).  ``return_candidates=True`` additionally returns the
+    ``clamp(2k, 256, 2048)``, bounded by the per-shard row count) —
+    promoted from an r5 internal constant to a real keyword, threaded
+    from the estimator as ``KMeans(init_cap=...)`` (ISSUE 16: the
+    two-level assignment tier reuses this candidate-buffer discipline
+    and needs it sizeable per workload; both the device pipeline and
+    the ``device=False`` host oracle honor it).  ``refine`` sets the
+    on-device weighted Lloyd polish steps (device path only).  ``return_candidates=True`` additionally returns the
     (valid) candidate rows and their cell masses — the hook the candidate-
     set parity tests use."""
     from kmeans_tpu.utils import profiling
@@ -718,7 +727,7 @@ def kmeans_parallel_init(X, k: int, seed: int, *, rounds: int = 5,
     if not device:
         return _kmeans_parallel_host(
             src, k, seed, rounds=rounds, oversampling=oversampling,
-            return_candidates=return_candidates)
+            cap=cap, return_candidates=return_candidates)
 
     points = getattr(src, "points", None)
     weights = getattr(src, "weights", None)
@@ -1072,7 +1081,8 @@ INITIALIZERS = {"forgy": forgy_init, "random": forgy_init,
 
 
 def resolve_init(init, X, k: int, seed: int, *,
-                 validate: bool = True) -> np.ndarray:
+                 validate: bool = True,
+                 cap: Optional[int] = None) -> np.ndarray:
     """Dispatch: strategy name, callable, or an explicit (k, D) array.
 
     ``validate=False`` skips redundant full-array finite scans in the named
@@ -1080,10 +1090,21 @@ def resolve_init(init, X, k: int, seed: int, *,
     manage their own validation.  A named or callable strategy runs
     under a ``seed`` span (ISSUE 11: the seeding share of
     time-to-first-iteration; explicit arrays cost nothing and are not
-    spanned)."""
+    spanned).  ``cap`` (ISSUE 16 — ``KMeans(init_cap=...)``) sets the
+    k-means|| per-round candidate capacity; it is a property of that
+    buffer discipline specifically, so a non-|| strategy rejects it
+    rather than silently ignoring the knob."""
     from kmeans_tpu.obs import trace as _obs_trace
     src = as_source(X)
     dtype = np.dtype(str(src.dtype))
+    if cap is not None and not (
+            isinstance(init, str)
+            and INITIALIZERS.get(init) is kmeans_parallel_init):
+        raise ValueError(
+            "init_cap sizes the k-means|| candidate buffer and only "
+            "applies to init='k-means||'; got init="
+            + (repr(init) if isinstance(init, str) else "a non-strategy "
+               "init (array/callable)"))
     if callable(init):
         host = getattr(src, "host", None)
         with _obs_trace.span("seed", strategy="callable", k=k):
@@ -1096,8 +1117,9 @@ def resolve_init(init, X, k: int, seed: int, *,
         except KeyError:
             raise ValueError(f"unknown init strategy: {init!r}; "
                              f"options: {sorted(INITIALIZERS)}") from None
+        kw = {"cap": cap} if cap is not None else {}
         with _obs_trace.span("seed", strategy=init, k=k):
-            return np.asarray(fn(src, k, seed, validate=validate),
+            return np.asarray(fn(src, k, seed, validate=validate, **kw),
                               dtype=dtype)
     arr = np.asarray(init, dtype=dtype)
     if arr.shape != (k, src.d):

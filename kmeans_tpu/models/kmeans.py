@@ -288,6 +288,11 @@ class KMeans(AutoCheckpointMixin):
                  pipeline: Union[str, int] = "auto",
                  bucket: Union[str, int] = 0,
                  overlap: Union[str, int] = "auto",
+                 k_shard: Union[str, int] = "auto",
+                 assign: str = "auto",
+                 coarse_cells: Optional[int] = None,
+                 nprobe: Optional[int] = None,
+                 init_cap: Optional[int] = None,
                  verbose: bool = True):
         self.k = k
         self.max_iter = max_iter
@@ -365,6 +370,40 @@ class KMeans(AutoCheckpointMixin):
             raise ValueError(f"overlap must be 'auto', 0, or 1; got "
                              f"{overlap!r}")
         self.overlap = overlap if overlap == "auto" else int(overlap)
+        # Massive-k tier (ISSUE 16).  Knob grammar follows the pipeline/
+        # bucket convention: ``k_shard=0`` and ``assign='dense'`` are
+        # the bit-exact dense parity oracles; 'auto' resolves per fit
+        # against the r16 HBM planner (``_resolve_large_k``) and stays
+        # dense whenever the backend reports no allocator stats (CPU),
+        # so every committed oracle shape keeps the dense trajectory.
+        if isinstance(k_shard, str):
+            if k_shard != "auto":
+                raise ValueError(f"k_shard must be 'auto' or an int >= 0, "
+                                 f"got {k_shard!r}")
+            self.k_shard = k_shard
+        else:
+            if int(k_shard) < 0:
+                raise ValueError(f"k_shard must be >= 0, got {k_shard}")
+            self.k_shard = int(k_shard)
+        if assign not in ("auto", "dense", "two_level"):
+            raise ValueError(f"assign must be 'auto', 'dense', or "
+                             f"'two_level', got {assign!r}")
+        self.assign = assign
+        if coarse_cells is not None and int(coarse_cells) < 1:
+            raise ValueError(f"coarse_cells must be >= 1 or None, "
+                             f"got {coarse_cells}")
+        self.coarse_cells = (None if coarse_cells is None
+                             else int(coarse_cells))
+        if nprobe is not None and int(nprobe) < 1:
+            raise ValueError(f"nprobe must be >= 1 or None, got {nprobe}")
+        self.nprobe = None if nprobe is None else int(nprobe)
+        # k-means|| candidate-buffer capacity, threaded to the seeding
+        # engine (models.init.kmeans_parallel_init) — None keeps the
+        # committed clamp(2k, 256, 2048) default.
+        if init_cap is not None and int(init_cap) < 1:
+            raise ValueError(f"init_cap must be >= 1 or None, "
+                             f"got {init_cap}")
+        self.init_cap = None if init_cap is None else int(init_cap)
         if isinstance(host_loop, str):
             if host_loop != "auto":
                 raise ValueError(f"host_loop must be True, False, or "
@@ -380,6 +419,18 @@ class KMeans(AutoCheckpointMixin):
         self.centroids: Optional[np.ndarray] = None   # kmeans_spark.py:44
         self.loop_path_: Optional[str] = None         # 'host'|'device'|...
         self.auto_rtt_: Optional[float] = None        # measured by 'auto'
+        # Massive-k resolution of the last fit (ISSUE 16): what the
+        # k_shard/assign knobs resolved TO at the fit's shape (None
+        # before any fit — the dry-run/ckpt-info artifact).
+        self.k_shard_resolved_: Optional[int] = None
+        self.assign_resolved_: Optional[str] = None
+        # Set by _route_large_k when a large-k step is swapped in: both
+        # large-k steps are per-iteration host-loop programs.
+        self._force_host_loop = False
+        # (coarse, members) routing tables of the last two-level fit —
+        # reused by predict so serving shares the fit's coarse cells.
+        self._two_level_route_ = None
+        self._route_cache = None
         # Which chunk schedule the last fit IN THIS PROCESS ran
         # ('pipelined' | 'serial'; the GMM estep_path_ convention) and
         # the guarded bf16 rung's per-fit corrected-row audit (summed
@@ -736,8 +787,13 @@ class KMeans(AutoCheckpointMixin):
             "k": int(self.k),
             "d": int(np.asarray(self.centroids).shape[1]),
             "dtype": np.dtype(self.dtype).str,
-            "stackable": True,
+            # A two-level model routes through its own coarse/member
+            # tables — it cannot ride the packed multi-model dense
+            # dispatch (ISSUE 16).
+            "stackable": self.assign != "two_level",
             "normalize_inputs": False,
+            "assign": ("two_level" if self.assign == "two_level"
+                       else "dense"),
             "ops": ("predict", "transform", "score_rows"),
         }
 
@@ -935,7 +991,8 @@ class KMeans(AutoCheckpointMixin):
         # standalone fit at that k makes, so member inits match their
         # standalone oracles exactly.
         centroids = resolve_init(self.init, ds, self.k if k is None else k,
-                                 seed, validate=self._validate_init)
+                                 seed, validate=self._validate_init,
+                                 cap=self.init_cap)
         return self._postprocess_centroids(
             np.asarray(centroids, dtype=np.float64)).astype(self.dtype)
 
@@ -974,6 +1031,12 @@ class KMeans(AutoCheckpointMixin):
         — its batch step is sub-ms by construction).  A change to the
         policy here almost certainly belongs there too.
         """
+        if getattr(self, "_force_host_loop", False):
+            # A large-k step is swapped in (ISSUE 16): both the
+            # k-sharded and two-level steps exist only as per-iteration
+            # host-loop programs (explicit host_loop=False was already
+            # rejected in _route_large_k, with the reason).
+            return True
         if self.host_loop is True or self.host_loop is False:
             return self.host_loop
         if jax.process_count() > 1:
@@ -1072,6 +1135,221 @@ class KMeans(AutoCheckpointMixin):
                 f"(lets 'auto' switch itself) to reclaim it")
         return True
 
+    # ------------------------------------------------------------ massive-k
+
+    def _resolve_large_k(self, ds, data_shards, model_shards, chunk):
+        """Resolve the ``k_shard``/``assign`` knobs for this fit's shape
+        (ISSUE 16).  Returns ``(k_shard, assign)`` as concrete values.
+
+        'auto' consults the r16 HBM planner: the DENSE plan at this
+        (n, d, k, mesh, chunk) is compared against the device's free
+        bytes (80% headroom — staging buffers and allocator
+        fragmentation share the arena).  A backend that reports no
+        allocator stats (CPU) resolves both knobs to their bit-exact
+        dense oracles, so every committed parity shape keeps the dense
+        trajectory.  Sharding the table is the first resort past the
+        wall (exact assignment, no routing error surface); two-level
+        only engages when the mesh has no TP axis to shard over.
+        Explicit values force the path and are validated here, before
+        any data-dependent work."""
+        ks, asg = self.k_shard, self.assign
+        if ks == "auto" or asg == "auto":
+            from kmeans_tpu.obs import memory as _mem
+            info = _mem.device_memory_info()
+            fits = True
+            if info.get("available"):
+                plan = _mem.plan_fit(
+                    "kmeans", ds.n, ds.d, self.k,
+                    data_shards=data_shards, model_shards=model_shards,
+                    dtype=str(self.dtype), chunk=chunk,
+                    pipeline=self._resolve_pipeline(
+                        self._mode(ds.n, ds.d)), k_shard=0)
+                fits = (plan["predicted_peak_bytes"]
+                        <= 0.8 * info["bytes_free"])
+            if ks == "auto":
+                ks = 0 if (fits or model_shards <= 1) else model_shards
+            if asg == "auto":
+                asg = "dense" if (fits or model_shards > 1) \
+                    else "two_level"
+        ks = int(ks)
+        if ks:
+            if model_shards <= 1:
+                raise ValueError(
+                    f"k_shard={ks} requires a model-sharded mesh "
+                    f"(model_shards > 1); this mesh has no TP axis — "
+                    f"use k_shard=0, or build the mesh with model= "
+                    f"shards")
+            if ks != model_shards:
+                raise ValueError(
+                    f"k_shard={ks} does not match the mesh's "
+                    f"model_shards={model_shards}: the table shards on "
+                    f"the EXISTING TP axis, so the only supported "
+                    f"values are 0 (the dense oracle) and "
+                    f"{model_shards}")
+        if asg == "two_level" and model_shards != 1:
+            raise ValueError(
+                "assign='two_level' composes with data parallelism "
+                "only (model_shards == 1); on a TP mesh use k_shard "
+                "instead — the two tiers address the same memory wall "
+                "and do not stack")
+        return ks, asg
+
+    def _route_large_k(self, ds, mesh, model_shards, step_fn):
+        """Swap the dense step for the k-sharded or two-level one per
+        the resolved knobs; returns the step function the fit loops on
+        (the dense ``step_fn`` untouched on the oracle path).
+
+        Both large-k steps are per-iteration host-loop programs (the
+        two-level member tables rebuild host-side each iteration; the
+        sharded step's stats gather transparently into the host
+        M-step's ``np.asarray``), so the swap pins the host loop —
+        explicit ``host_loop=False`` on a large-k path is rejected
+        with the reason rather than silently overridden."""
+        self._force_host_loop = False
+        self._two_level_route_ = None
+        data_shards, _ = mesh_shape(mesh)
+        chunk = self._eff_chunk(ds)
+        ks, asg = self._resolve_large_k(ds, data_shards, model_shards,
+                                        chunk)
+        self.k_shard_resolved_, self.assign_resolved_ = ks, asg
+        if not ks and asg == "dense":
+            return step_fn
+        if self.host_loop is False:
+            raise ValueError(
+                f"host_loop=False cannot run the large-k paths "
+                f"(resolved k_shard={ks}, assign={asg!r}): they are "
+                f"per-iteration host-loop programs; drop "
+                f"host_loop=False, or force the dense oracle "
+                f"(k_shard=0, assign='dense')")
+        self._force_host_loop = True
+        mode = self._mode(ds.n, ds.d)
+        if ks:
+            pipeline = self._resolve_pipeline(mode)
+            return _STEP_CACHE.get_or_create(
+                (mesh, chunk, mode, pipeline, "kshard"),
+                lambda: dist.make_kshard_step_fn(
+                    mesh, chunk_size=chunk, mode=mode,
+                    pipeline=pipeline))
+        return self._two_level_step(ds, mesh, chunk, mode)
+
+    def _two_level_params(self):
+        """(coarse cell count C, probes-per-row nprobe) for this k —
+        √k-ish cells by default (the tentpole's sizing), an eighth of
+        the cells probed.  ``nprobe >= C`` probes every cell: exact
+        dense coverage, the parity-oracle configuration."""
+        C = self.coarse_cells or max(2, int(round(np.sqrt(self.k))))
+        C = min(int(C), self.k)
+        npb = self.nprobe or max(1, -(-C // 8))
+        return C, min(int(npb), C)
+
+    def _train_coarse(self, cents: np.ndarray, C: int) -> np.ndarray:
+        """Coarse quantizer: dense k-means over the FINE TABLE (k rows)
+        — the existing dense path at √k scale, exactly as the tentpole
+        specifies.  IVF discipline: trained once per fit from the
+        initial fine table, then FIXED; only the member lists refresh
+        per iteration (``_build_members``)."""
+        km = KMeans(k=C, max_iter=25, tolerance=1e-4, seed=self.seed,
+                    compute_sse=False, init="k-means++",
+                    compute_labels=False, empty_cluster="keep",
+                    dtype=self.dtype, mesh=self.mesh, host_loop=True,
+                    assign="dense", k_shard=0, verbose=False)
+        km._eager_labels = False
+        km._validate_init = False
+        km.fit(np.asarray(cents, np.float64).astype(self.dtype))
+        return np.asarray(km.centroids, np.float64)
+
+    def _build_members(self, cents: np.ndarray,
+                       coarse: np.ndarray) -> np.ndarray:
+        """(C, L) member lists: fine centroid j files under its nearest
+        coarse cell.  L is the LARGEST cell size bucketed on the
+        candidate ladder (``parallel.sharding.bucket_candidates`` — the
+        r19 rung geometry at a 32-row floor), so cell-size drift across
+        iterations lands on a handful of compiled widths instead of
+        one per iteration; ``k`` (the sentinel row index) pads the
+        tails.  Member lists are sorted ascending, which makes the
+        device kernel's lexicographic (distance, index) tie-break
+        reproduce dense argmin's first-lowest-index rule.  An empty
+        cell carries its nearest fine centroid in slot 0, so a probe
+        routed there still returns a valid candidate."""
+        from kmeans_tpu.parallel.sharding import bucket_candidates
+        k, C = cents.shape[0], coarse.shape[0]
+        d2 = (np.sum(cents ** 2, axis=1)[:, None]
+              - 2.0 * cents @ coarse.T
+              + np.sum(coarse ** 2, axis=1)[None, :])
+        owner = np.argmin(d2, axis=1)
+        lists = [np.flatnonzero(owner == c) for c in range(C)]
+        for c in range(C):
+            if lists[c].size == 0:
+                lists[c] = np.array([int(np.argmin(d2[:, c]))])
+        L = bucket_candidates(max(lst.size for lst in lists))
+        members = np.full((C, L), k, np.int32)
+        for c, lst in enumerate(lists):
+            members[c, : lst.size] = np.sort(lst).astype(np.int32)
+        return members
+
+    def _two_level_step(self, ds, mesh, chunk, mode):
+        """Host wrapper with the dense step's calling convention
+        (``step(points, weights, cents_dev) -> StepStats``): trains the
+        coarse quantizer on first call, rebuilds the member lists from
+        the CURRENT fine table each iteration, and dispatches the
+        compiled two-level step for the bucketed member width.  SSE
+        stays exact by construction — the fine search recomputes exact
+        distances over the candidate set (parallel.distributed.
+        make_two_level_step_fn)."""
+        C, npb = self._two_level_params()
+        state = {"coarse": None}
+
+        def step(points, weights, cents_dev):
+            cents = np.asarray(cents_dev, np.float64)[: self.k]
+            if state["coarse"] is None:
+                state["coarse"] = self._train_coarse(cents, C)
+            coarse = state["coarse"]
+            members = self._build_members(cents, coarse)
+            self._two_level_route_ = (coarse, members)
+            fn = _STEP_CACHE.get_or_create(
+                (mesh, chunk, mode, C, members.shape[1], npb,
+                 "twolevel"),
+                lambda: dist.make_two_level_step_fn(
+                    mesh, chunk_size=chunk, nprobe=npb, mode=mode))
+            return fn(points, weights, cents_dev,
+                      coarse.astype(self.dtype), members)
+
+        return step
+
+    def _two_level_tables(self):
+        """(coarse, members) for the CURRENT fitted table, cached by
+        centroid-array identity (the ``_cents_dev`` discipline).
+        Reuses the fit's coarse cells when this process trained them; a
+        model that never ran a two-level fit here (loaded checkpoint,
+        knob flipped post fit) trains the coarse quantizer once, now."""
+        cache = self._route_cache
+        if cache is not None and cache[0] is self.centroids:
+            return cache[1], cache[2]
+        C, _ = self._two_level_params()
+        cents = np.asarray(self.centroids, np.float64)
+        route = self._two_level_route_
+        coarse = (route[0] if route is not None
+                  and route[0].shape[0] == C
+                  else self._train_coarse(cents, C))
+        members = self._build_members(cents, coarse)
+        self._route_cache = (self.centroids, coarse, members)
+        return coarse, members
+
+    def _predict_two_level_labels(self, ds, mesh, cents_dev):
+        """Two-level assignment pass (explicit ``assign='two_level'``
+        predict route): same coarse->candidates->exact-recompute kernel
+        as the fit step, labels only."""
+        coarse, members = self._two_level_tables()
+        C, npb = self._two_level_params()
+        chunk, mode = self._eff_chunk(ds), self._mode(ds.n, ds.d)
+        fn = _STEP_CACHE.get_or_create(
+            (mesh, chunk, mode, C, members.shape[1], npb,
+             "twolevel-predict"),
+            lambda: dist.make_two_level_predict_fn(
+                mesh, chunk_size=chunk, nprobe=npb, mode=mode))
+        return fn(ds.points, cents_dev, coarse.astype(self.dtype),
+                  members)
+
     def _fit(self, X, *, sample_weight, resume, checkpoint_every: int = 0,
              checkpoint_path=None) -> "KMeans":
         # Multi-host: only process 0 narrates (every host computes the same
@@ -1097,6 +1375,11 @@ class KMeans(AutoCheckpointMixin):
         self.restart_inertias_ = None
         self._note_estep_path(self._mode(ds.n, ds.d))
         self.bf16_guard_corrected_rows_ = None
+        # Massive-k routing (ISSUE 16): on the resolved large-k paths
+        # the dense step is swapped for the k-sharded or two-level one
+        # (host-loop programs with the same calling convention); the
+        # dense oracle path returns step_fn untouched.
+        step_fn = self._route_large_k(ds, mesh, model_shards, step_fn)
 
         if resume and self.centroids is not None:
             centroids = np.asarray(self.centroids, dtype=self.dtype)
@@ -1246,6 +1529,12 @@ class KMeans(AutoCheckpointMixin):
         from kmeans_tpu.models.init import (STREAM_INITIALIZERS,
                                             _split_block,
                                             streamed_init_sample)
+        if self.k_shard not in ("auto", 0) or self.assign == "two_level":
+            raise ValueError(
+                "fit_stream runs the dense assignment path only (its "
+                "per-block statistics already bound device memory by "
+                "the block size); drop the explicit k_shard/assign "
+                "large-k knobs, or use fit on an in-memory dataset")
         prefetch = check_prefetch(prefetch)
         checkpoint_every = self._check_ckpt(checkpoint_every,
                                             checkpoint_path)
@@ -1912,6 +2201,12 @@ class KMeans(AutoCheckpointMixin):
             raise ValueError(
                 "sweep() needs a string or callable init (an explicit "
                 "(k, D) init array pins k); got an array init")
+        if self.k_shard not in ("auto", 0) or self.assign == "two_level":
+            raise ValueError(
+                "sweep() runs its members on the dense multi-fit path; "
+                "the large-k k_shard/assign routes do not compose with "
+                "the padded member axis — sweep with the dense oracle "
+                "and fit the winner's k with the large-k knobs")
         ks = sweep_mod.parse_k_range(k_range)
         sweep_mod.check_criterion(criterion, sweep_mod.KMEANS_CRITERIA)
         if criterion != "inertia" and ks[0] < 2:
@@ -2241,7 +2536,15 @@ class KMeans(AutoCheckpointMixin):
             return self._predict_process_local(X)
         ds, mesh, model_shards, _, predict_fn = self._prepare(X)
         cents_dev = self._cents_dev(mesh, model_shards)
-        labels = predict_fn(ds.points, cents_dev, np.int32(ds.n))
+        # Explicit assign='two_level' routes inference through the
+        # coarse->candidates->exact-recompute pass (ISSUE 16); 'auto'
+        # and 'dense' keep the dense assignment (exact everywhere), and
+        # a TP mesh falls back to the dense TP kernel — the two tiers
+        # do not stack (see _resolve_large_k).
+        if self.assign == "two_level" and model_shards == 1:
+            labels = self._predict_two_level_labels(ds, mesh, cents_dev)
+        else:
+            labels = predict_fn(ds.points, cents_dev, np.int32(ds.n))
         return np.asarray(labels)[: ds.n]
 
     def _predict_process_local(self, ds: ShardedDataset) -> np.ndarray:
@@ -2481,7 +2784,8 @@ class KMeans(AutoCheckpointMixin):
                     "init", "n_init", "compute_labels", "empty_cluster",
                     "dtype", "mesh", "model_shards", "chunk_size",
                     "distance_mode", "host_loop", "pipeline", "bucket",
-                    "overlap", "verbose")
+                    "overlap", "k_shard", "assign", "coarse_cells",
+                    "nprobe", "init_cap", "verbose")
 
     def get_params(self, deep: bool = True) -> dict:
         """Constructor parameters as a dict (sklearn estimator protocol —
@@ -2608,6 +2912,11 @@ class KMeans(AutoCheckpointMixin):
             "pipeline": self.pipeline,
             "bucket": self.bucket,
             "overlap": self.overlap,
+            "k_shard": self.k_shard,
+            "assign": self.assign,
+            "coarse_cells": self.coarse_cells,
+            "nprobe": self.nprobe,
+            "init_cap": self.init_cap,
             "verbose": self.verbose,
             "sse_history": list(map(float, self.sse_history)),
             "iterations_run": self.iterations_run,
@@ -2624,6 +2933,14 @@ class KMeans(AutoCheckpointMixin):
         # checkpoints that have no sizes yet — re-stamped complete at
         # the final save).
         state["quality_profile"] = self.quality_profile()
+        # Two-level routing is FITTED state (ISSUE 16): the coarse
+        # quantizer is trained once per fit and then fixed, so the
+        # checkpoint must carry it — retraining from the FINAL table
+        # at load time would re-route predict onto different candidate
+        # sets than the fit (and its drift profile) assigned with.
+        route = self._two_level_route_
+        if route is not None:
+            state["two_level_coarse"] = np.asarray(route[0], np.float64)
         if isinstance(self.init, str):
             state["init"] = self.init
         elif not callable(self.init):
@@ -2638,6 +2955,16 @@ class KMeans(AutoCheckpointMixin):
         # Pre-r18 checkpoints carry no profile -> None (reference-free
         # monitoring); npz meta JSON round-trips the dict as-is.
         self._quality_profile = state.get("quality_profile")
+        # Restore the two-level route from the saved coarse table
+        # (member lists rebuild deterministically from table + coarse).
+        # Pre-r20 / dense-fit checkpoints carry no key -> the lazy
+        # retrain-from-final-table fallback in _two_level_tables.
+        coarse = state.get("two_level_coarse")
+        if (coarse is not None and getattr(coarse, "size", 0)
+                and self.centroids is not None):
+            coarse = np.asarray(coarse, np.float64)
+            self._two_level_route_ = (coarse, self._build_members(
+                np.asarray(self.centroids, np.float64), coarse))
 
     def save(self, path) -> None:
         """Checkpoint fitted state (beyond-reference; SURVEY.md §5).
@@ -2673,6 +3000,17 @@ class KMeans(AutoCheckpointMixin):
                             else int(b))(state.get("bucket", 0)),
                     overlap=(lambda o: o if isinstance(o, str)
                              else int(o))(state.get("overlap", "auto")),
+                    # Pre-r20 checkpoints have no massive-k knobs ->
+                    # the planner-resolved ('auto') defaults.
+                    k_shard=(lambda v: v if isinstance(v, str)
+                             else int(v))(state.get("k_shard", "auto")),
+                    assign=str(state.get("assign", "auto")),
+                    coarse_cells=(lambda v: None if v is None
+                                  else int(v))(state.get("coarse_cells")),
+                    nprobe=(lambda v: None if v is None
+                            else int(v))(state.get("nprobe")),
+                    init_cap=(lambda v: None if v is None
+                              else int(v))(state.get("init_cap")),
                     verbose=state["verbose"],
                     dtype=np.dtype(state["dtype"]),
                     **cls._load_kwargs(state))

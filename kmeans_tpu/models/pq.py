@@ -1,0 +1,312 @@
+"""Batched product-quantization codebook trainer (ISSUE 16).
+
+Product quantization (Jégou et al., PAMI 2011) splits the feature space
+into ``m`` contiguous subspaces and learns an independent k-means
+codebook per subspace; a vector is stored as its ``m`` per-subspace
+codeword indices (``m`` bytes at the classic k=256), and distances to
+compressed vectors are answered by per-subspace lookup-table sums (ADC
+— asymmetric distance computation).
+
+The trainer is the r12 model axis doing new work: the ``m`` independent
+subspace k-means problems stack on the multi-fit member axis with
+PER-MEMBER ROWS (``parallel.distributed.make_multi_fit_fn(
+member_points=True)`` — each member trains against its own column
+slice), so ONE device dispatch trains every codebook.  Each member's
+trajectory is bit-identical to a standalone fit of that subspace (the
+member axis is a batch dimension of every kernel; pinned by
+tests/test_large_k.py).
+
+The serving side (``adc_assign``) answers nearest-centroid queries
+against a PQ-compressed table with the r13 bf16 error-model discipline
+(``ops.assign.BF16_GUARD_RTOL``): the f32-rate ADC sum decides every
+query whose argmin margin clears the guard rtol of its distance scale,
+and flagged near-ties re-resolve against the exactly-decoded table —
+labels bit-equal to the exact decoded-table argmin BY CONSTRUCTION,
+with the quantization residual (ADC distance == exact distance to the
+DECODED row) as the one documented approximation.  The serving engine
+routes ``quantize='pq'`` residents through it.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kmeans_tpu.ops.assign import BF16_GUARD_RTOL
+from kmeans_tpu.parallel import distributed as dist
+from kmeans_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh, \
+    mesh_shape
+from kmeans_tpu.parallel.sharding import choose_chunk_size
+from kmeans_tpu.models.init import resolve_init
+from kmeans_tpu.utils.cache import LRUCache
+from kmeans_tpu.utils.validation import check_finite_array
+
+__all__ = ["ProductQuantizer", "default_subspaces"]
+
+# The batched codebook-trainer programs, keyed like kmeans._STEP_CACHE
+# entries (mesh + every static that forces a rebuild).
+_PQ_CACHE = LRUCache(16, name="pq._PQ_CACHE")
+
+
+def default_subspaces(d: int) -> int:
+    """Largest m <= 8 dividing d (PQ needs equal contiguous slices);
+    1 when d is prime to 2..8 — PQ degenerates to plain VQ there."""
+    for m in range(min(8, d), 0, -1):
+        if d % m == 0:
+            return m
+    return 1  # pragma: no cover — m=1 always divides
+
+
+class ProductQuantizer:
+    """m independent per-subspace k-means codebooks, trained in ONE
+    batched dispatch on the multi-fit member axis.
+
+    Parameters: ``m`` subspaces ('auto': largest divisor of d up to 8),
+    ``k`` codewords per subspace (<= 256 keeps codes at one byte each),
+    and the familiar fit knobs.  ``empty_cluster`` is pinned to 'keep'
+    (the ``member_points`` contract: a subspace codeword with no mass
+    keeps its old value — the sklearn-encoder behavior).
+
+    Fitted attributes: ``codebooks_`` (m, k, d_sub), ``n_iters_`` (m,),
+    ``subspace_inertias_`` (m,) — each member's true final inertia on
+    its own subspace — and ``counts_`` (m, k).
+    """
+
+    def __init__(self, m="auto", k: int = 256, max_iter: int = 25,
+                 tolerance: float = 1e-4, seed: int = 42, *,
+                 init="k-means++", dtype=None,
+                 mesh=None, chunk_size: Optional[int] = None,
+                 verbose: bool = False):
+        if m != "auto" and int(m) < 1:
+            raise ValueError(f"m must be 'auto' or an int >= 1, got {m}")
+        self.m = m if m == "auto" else int(m)
+        if int(k) < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.k = int(k)
+        self.max_iter = int(max_iter)
+        self.tolerance = float(tolerance)
+        self.seed = int(seed)
+        self.init = init
+        requested = np.dtype(dtype) if dtype is not None \
+            else np.dtype(np.float32)
+        self.dtype = np.dtype(jax.dtypes.canonicalize_dtype(requested))
+        self.mesh = mesh
+        self.chunk_size = chunk_size
+        self.verbose = verbose
+        self.codebooks_: Optional[np.ndarray] = None
+        self.n_iters_: Optional[np.ndarray] = None
+        self.subspace_inertias_: Optional[np.ndarray] = None
+        self.counts_: Optional[np.ndarray] = None
+        self.plan_: Optional[dict] = None
+        self.m_: Optional[int] = None
+        self.d_: Optional[int] = None
+        self.d_sub_: Optional[int] = None
+
+    # ------------------------------------------------------------- fit
+
+    def _resolve_mesh(self):
+        if self.mesh is None:
+            self.mesh = make_mesh()
+        return self.mesh
+
+    def _member_seeds(self, m: int) -> List[int]:
+        """One derived init/refill seed per subspace — the restart-seed
+        discipline (distinct streams, deterministic in ``seed``)."""
+        return [int(s) for s in
+                np.random.SeedSequence(self.seed).generate_state(m)]
+
+    def fit(self, X) -> "ProductQuantizer":
+        X = np.asarray(X, dtype=self.dtype)
+        if X.ndim != 2:
+            raise ValueError(f"X must be 2-D (n, D), got shape {X.shape}")
+        check_finite_array(X, "Data contains NaN or Inf values")
+        n, d = X.shape
+        m = default_subspaces(d) if self.m == "auto" else self.m
+        if d % m:
+            raise ValueError(
+                f"m={m} must divide d={d} into equal contiguous "
+                f"subspaces (PQ's split; pad the features or pick a "
+                f"divisor)")
+        if n < self.k:
+            raise ValueError(f"Not enough data points ({n}) to train "
+                             f"{self.k} codewords per subspace")
+        d_sub = d // m
+        mesh = self._resolve_mesh()
+        data_shards, model_shards = mesh_shape(mesh)
+        chunk = self.chunk_size or choose_chunk_size(
+            -(-n // data_shards), max(self.k, model_shards), d_sub)
+        # Pre-dispatch HBM fit-check (the r16 planner; also the
+        # large-k lint rule's guard): each member's E-step materializes
+        # a (chunk, k) tile, m of them concurrently under vmap.
+        from kmeans_tpu.obs.memory import plan_fit
+        self.plan_ = plan_fit(
+            "kmeans", n, d_sub, self.k, data_shards=data_shards,
+            model_shards=model_shards, dtype=str(self.dtype),
+            chunk=chunk)
+
+        sub = np.ascontiguousarray(
+            X.reshape(n, m, d_sub).transpose(1, 0, 2))   # (m, n, d_sub)
+        mult = data_shards * chunk
+        n_pad = -(-n // mult) * mult
+        pts = np.zeros((m, n_pad, d_sub), self.dtype)
+        pts[:, :n] = sub
+        wts = np.zeros(n_pad, self.dtype)
+        wts[:n] = 1
+        pts_dev = jax.device_put(
+            pts, NamedSharding(mesh, P(None, DATA_AXIS, None)))
+        wts_dev = jax.device_put(wts, NamedSharding(mesh, P(DATA_AXIS)))
+        seeds = self._member_seeds(m)
+        inits = np.stack([
+            dist.pad_centroids(
+                np.asarray(resolve_init(self.init, sub[j], self.k,
+                                        seeds[j], validate=False),
+                           np.float64).astype(self.dtype),
+                model_shards)
+            for j in range(m)])
+        cents_dev = jax.device_put(
+            inits, NamedSharding(mesh, P(None, MODEL_AXIS, None)))
+        fit_fn = _PQ_CACHE.get_or_create(
+            (mesh, chunk, self.k, m, self.max_iter,
+             float(self.tolerance), "pqfit"),
+            lambda: dist.make_multi_fit_fn(
+                mesh, chunk_size=chunk, mode="matmul", k_real=self.k,
+                max_iter=self.max_iter, tolerance=float(self.tolerance),
+                empty_policy="keep", n_init=m, history_sse=True,
+                return_all=True, member_points=True))
+        out = jax.block_until_ready(fit_fn(
+            pts_dev, wts_dev, cents_dev,
+            np.stack([dist._empty_seed_array(s, 0, self.max_iter)
+                      for s in seeds])))
+        cents, n_iters, _sse, _shift, counts, finals = out
+        self.codebooks_ = np.asarray(cents, np.float64).astype(self.dtype)
+        self.n_iters_ = np.asarray(n_iters, np.int64)
+        self.subspace_inertias_ = np.asarray(finals, np.float64)
+        self.counts_ = np.asarray(counts, np.float64)
+        self.m_, self.d_, self.d_sub_ = m, d, d_sub
+        return self
+
+    # ---------------------------------------------------- encode/decode
+
+    def _check_fitted(self):
+        if self.codebooks_ is None:
+            raise ValueError("ProductQuantizer must be fitted first")
+
+    def _code_dtype(self):
+        return np.uint8 if self.k <= 256 else (
+            np.uint16 if self.k <= 65536 else np.uint32)
+
+    def encode(self, X) -> np.ndarray:
+        """(n, d) rows -> (n, m) per-subspace codeword indices (exact
+        f64 per-subspace argmin; ties to the lowest index, the dense
+        argmin rule)."""
+        self._check_fitted()
+        X = np.asarray(X, np.float64)
+        if X.ndim != 2 or X.shape[1] != self.d_:
+            raise ValueError(f"X must be (n, {self.d_}), got {X.shape}")
+        n = X.shape[0]
+        codes = np.empty((n, self.m_), self._code_dtype())
+        for j in range(self.m_):
+            xj = X[:, j * self.d_sub_:(j + 1) * self.d_sub_]
+            cb = np.asarray(self.codebooks_[j], np.float64)
+            d2 = (np.sum(xj ** 2, axis=1)[:, None]
+                  - 2.0 * xj @ cb.T + np.sum(cb ** 2, axis=1)[None, :])
+            codes[:, j] = np.argmin(d2, axis=1)
+        return codes
+
+    def decode(self, codes) -> np.ndarray:
+        """(n, m) codes -> (n, d) reconstruction (per-subspace codeword
+        concatenation)."""
+        self._check_fitted()
+        codes = np.asarray(codes)
+        return np.concatenate(
+            [np.asarray(self.codebooks_[j], np.float64)[codes[:, j]]
+             for j in range(self.m_)], axis=1)
+
+    def compression_ratio(self) -> float:
+        """Stored bytes per row, original vs coded."""
+        self._check_fitted()
+        return (self.d_ * self.dtype.itemsize) \
+            / (self.m_ * np.dtype(self._code_dtype()).itemsize)
+
+    # ------------------------------------------------------ ADC serving
+
+    def adc_assign(self, queries, codes, *,
+                   tie_rtol: float = BF16_GUARD_RTOL):
+        """Nearest compressed-table row per query: ``(labels,
+        n_corrected)``.
+
+        The f32-rate ADC pass (per-subspace LUT + gathered sum — the
+        fast path) decides every query whose argmin margin clears
+        ``tie_rtol`` of its distance scale ``|q|^2 + max_i |row_i|^2``
+        — the r13 bf16 error model, verbatim.  Flagged near-ties
+        re-resolve by one exact f64 pass against the DECODED table, so
+        labels equal the exact decoded-table argmin by construction;
+        the quantization residual (decoded vs original rows) is the one
+        approximation, and it is a property of the stored codes, not of
+        this query path."""
+        self._check_fitted()
+        Q = np.asarray(queries, np.float64)
+        if Q.ndim != 2 or Q.shape[1] != self.d_:
+            raise ValueError(f"queries must be (n, {self.d_}), "
+                             f"got {Q.shape}")
+        codes = np.asarray(codes)
+        decoded = self.decode(codes)                    # (t, d) exact f64
+        # f32 fast path: LUTs and the gathered sum at serving rate.
+        approx = np.zeros((Q.shape[0], codes.shape[0]), np.float32)
+        for j in range(self.m_):
+            qj = Q[:, j * self.d_sub_:(j + 1) * self.d_sub_] \
+                .astype(np.float32)
+            cb = np.asarray(self.codebooks_[j], np.float32)
+            lut = (np.sum(qj ** 2, axis=1)[:, None]
+                   - 2.0 * qj @ cb.T + np.sum(cb ** 2, axis=1)[None, :])
+            approx += lut[:, codes[:, j]]
+        order = np.argsort(approx, axis=1)[:, :2]
+        best = order[:, 0].astype(np.int32)
+        margin = (np.take_along_axis(approx, order[:, 1:2], axis=1)
+                  - np.take_along_axis(approx, order[:, 0:1], axis=1)
+                  )[:, 0]
+        scale = np.sum(Q.astype(np.float32) ** 2, axis=1) \
+            + np.float32(np.max(np.sum(decoded ** 2, axis=1)))
+        near = np.flatnonzero(
+            (margin <= tie_rtol * scale) | (codes.shape[0] < 2))
+        if near.size:
+            sub = Q[near]
+            d2 = (np.sum(sub ** 2, axis=1)[:, None]
+                  - 2.0 * sub @ decoded.T
+                  + np.sum(decoded ** 2, axis=1)[None, :])
+            best[near] = np.argmin(d2, axis=1).astype(np.int32)
+        return best, int(near.size)
+
+    # ---------------------------------------------------------- serving
+
+    def fitted_state(self) -> dict:
+        """Serving handle (the ISSUE 6 registry contract)."""
+        self._check_fitted()
+        return {
+            "family": "pq",
+            "model_class": type(self).__name__,
+            "k": int(self.k),
+            "d": int(self.d_),
+            "dtype": self.dtype.str,
+            "stackable": False,
+            "normalize_inputs": False,
+            "m": int(self.m_),
+            "ops": ("encode",),
+        }
+
+    @classmethod
+    def for_table(cls, table, *, m="auto", k: Optional[int] = None,
+                  seed: int = 0, mesh=None, max_iter: int = 25):
+        """Compress a fitted (k_table, d) centroid table: train the
+        codebooks ON the table rows and encode them.  Returns
+        ``(pq, codes)`` — the serving engine's ``quantize='pq'``
+        ingredients."""
+        table = np.asarray(table)
+        kt, d = table.shape
+        k_pq = int(k) if k is not None else min(256, max(2, kt // 4))
+        pq = cls(m=m, k=min(k_pq, kt), seed=seed, mesh=mesh,
+                 max_iter=max_iter, dtype=table.dtype).fit(table)
+        return pq, pq.encode(table)

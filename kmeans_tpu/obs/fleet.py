@@ -520,7 +520,8 @@ def comm_bytes_model(family: str = "kmeans", *, k: int, d: int,
                      empty_cluster: str = "keep", cov_type: str = "diag",
                      n_members: int = 1, n_chunks: int = 1,
                      seeding_rounds: int = 0, seeding_cap: int = 0,
-                     processes: int = 1) -> dict:
+                     processes: int = 1, k_shard: int = 0,
+                     chunk_rows: int = 0) -> dict:
     """The analytic collective-traffic bill of one fit (module
     docstring).  Site rows carry ``result_bytes`` (per-device, the
     XLA/HLO convention the cross-check uses), ``count`` (times the
@@ -533,7 +534,21 @@ def comm_bytes_model(family: str = "kmeans", *, k: int, d: int,
     :func:`comm_crosscheck` reference); ``per_iteration_bytes`` /
     ``per_fit_bytes`` — the running bill.  ``empty_cluster='resample'``
     is modeled as 'keep' (its conditional Gumbel refill collectives are
-    outside the committed model — documented, not pretended)."""
+    outside the committed model — documented, not pretended).
+
+    ``k_shard`` (ISSUE 16, with ``model_shards > 1``) switches the
+    kmeans-family bill to the K-SHARDED tier: the statistics psums stay
+    sharded on the model axis (one (k/M, D) block over the DATA axis
+    only — the term that made dense TP traffic scale with full k), the
+    per-dispatch centroid-table gather disappears (the step consumes
+    its sharded block directly), and the headline per-iteration
+    collective becomes the scan-bodied (distance, index) pair
+    all-reduce — two ``pmin`` legs of ``chunk_rows`` f32 + i32 over the
+    model axis, ``n_chunks`` times per iteration.  Pass ``chunk_rows``
+    (the scan chunk size) to size it; unlike the dense TP path — whose
+    per-chunk minima gathers ride a program documented as
+    modeled-to-the-table — the pair all-reduce IS the committed wire
+    cost of the k-sharded tier, so it is in the model."""
     S, M = int(data_shards), int(model_shards)
     group = S * M
     R = int(n_members)
@@ -550,11 +565,27 @@ def comm_bytes_model(family: str = "kmeans", *, k: int, d: int,
             "wire_bytes_per_device": _ring_wire(result_bytes, grp,
                                                 collective)})
 
+    kshard = bool(k_shard) and M > 1
     if family in ("kmeans", "spherical", "bisecting", "minibatch"):
-        site("estep.psum_sums", "all-reduce", R * k_pad * d * acc_bytes,
-             scope="iteration")
-        site("estep.psum_counts", "all-reduce", R * k_pad * acc_bytes,
-             scope="iteration")
+        if kshard:
+            # K-sharded tier (ISSUE 16): each model shard psums ONLY
+            # its own (k/M, D) statistics block over the data axis —
+            # the model axis is the output sharding, not a reduction.
+            site("estep.psum_sums", "all-reduce", R * kl * d * acc_bytes,
+                 scope="iteration", grp=S)
+            site("estep.psum_counts", "all-reduce", R * kl * acc_bytes,
+                 scope="iteration", grp=S)
+            # The pair select replacing the dense minima gather: two
+            # pmin legs (f32 global-min distance + i32 masked global
+            # index) per scan-bodied chunk over the model axis.
+            site("estep.pmin_assign_pair", "all-reduce",
+                 R * chunk_rows * (acc_bytes + 4), scope="iteration",
+                 count=n_chunks, grp=M)
+        else:
+            site("estep.psum_sums", "all-reduce",
+                 R * k_pad * d * acc_bytes, scope="iteration")
+            site("estep.psum_counts", "all-reduce", R * k_pad * acc_bytes,
+                 scope="iteration")
         if compute_sse:
             site("estep.psum_sse", "all-reduce", R * acc_bytes,
                  scope="iteration")
@@ -601,7 +632,7 @@ def comm_bytes_model(family: str = "kmeans", *, k: int, d: int,
         raise ValueError(f"unknown family {family!r}")
 
     if family in ("kmeans", "spherical", "bisecting", "minibatch") \
-            and M > 1:
+            and M > 1 and not kshard:
         # TP composition: the per-dispatch (k_pad, D) centroid-table
         # gather over the model axis.  (The per-chunk minima gathers of
         # the TP assignment path are chunk-shaped and scan-bodied; they
@@ -635,7 +666,8 @@ def comm_bytes_model(family: str = "kmeans", *, k: int, d: int,
                     for s in sites if s["scope"] == "iteration")
     return {"family": family, "k": k, "k_pad": k_pad, "d": d,
             "data_shards": S, "model_shards": M, "acc_bytes": acc_bytes,
-            "n_members": R, "sites": sites,
+            "n_members": R, "k_shard": int(k_shard) if kshard else 0,
+            "sites": sites,
             "per_iteration_bytes": per_iter,
             "per_fit_bytes": per_fit,
             "hlo_program_bytes": program,

@@ -57,7 +57,7 @@ def plan_fit(family: str, n: int, d: int, k: int, *,
              data_shards: int = 1, model_shards: int = 1,
              dtype="float32", chunk: Optional[int] = None,
              cov_type: str = "diag", batch: Optional[int] = None,
-             pipeline: int = 0, records=None) -> dict:
+             pipeline: int = 0, k_shard: int = 0, records=None) -> dict:
     """Predict one device's working set for a family's fit at a shape.
 
     Mirrors the real placement arithmetic: rows pad up to
@@ -75,6 +75,14 @@ def plan_fit(family: str, n: int, d: int, k: int, *,
     available record for the family's step cache, the XLA-observed
     per-program ``observed_peak_bytes`` joins the plan for the
     predicted-vs-observed comparison.
+
+    ``k_shard`` (ISSUE 16) distinguishes the two TP placements of the
+    k-means stats accumulators: the dense TP path (``k_shard=0``)
+    psums FULL ``(k_pad, d)`` sums / ``(k_pad,)`` counts replicated on
+    every device, while the k-sharded step keeps only the local
+    ``(k_local, d)`` shard resident — the term sharding removes.  The
+    distance tile is ``(chunk, k_local)`` under either placement, and
+    at ``model_shards=1`` the knob is a no-op (``k_pad == k_local``).
     """
     if family not in FAMILIES:
         raise ValueError(f"unknown family {family!r}; families: "
@@ -120,7 +128,10 @@ def plan_fit(family: str, n: int, d: int, k: int, *,
         # consumes — two (chunk, k) f32 buffers live at the peak.
         comp["table_bytes"] = k_local * d * item
         comp["tile_bytes"] = 2 * tile_rows * k_local * 4
-        comp["stats_bytes"] = (k_local * d + k_local) * 4
+        # Dense TP replicates the full-k psum'd accumulators on every
+        # device; the k-sharded step keeps only its local shard.
+        k_stats = k_local if (k_shard and model_shards > 1) else k_pad
+        comp["stats_bytes"] = (k_stats * d + k_stats) * 4
     if pipeline:
         comp["tile_bytes"] *= 2            # two chunk tiles in flight
     if family == "minibatch" and batch:
@@ -136,7 +147,7 @@ def plan_fit(family: str, n: int, d: int, k: int, *,
         "data_shards": data_shards, "model_shards": model_shards,
         "dtype": str(getattr(dtype, "name", dtype)),
         "chunk": chunk_eff, "pipeline": int(bool(pipeline)),
-        "components": comp,
+        "k_shard": int(k_shard), "components": comp,
         "predicted_resident_bytes": resident,
         "predicted_temp_bytes": temp,
         "predicted_peak_bytes": resident + temp,

@@ -76,6 +76,37 @@ def _model_axis_select(model_shards: int):
     return select
 
 
+def _model_axis_pair_select(model_shards: int, k_local: int):
+    """select_fn for the K-SHARDED statistics pass (ISSUE 16): reconstruct
+    the global argmin with a logical (distance, index) pair all-reduce
+    instead of the dense ``all_gather`` of per-shard minima — O(chunk)
+    payload per collective instead of O(model_shards * chunk), and no
+    (m, chunk) gathered tile resident.
+
+    Two ``pmin`` legs realize the pair: the distance leg computes the
+    global min; the index leg carries each shard's GLOBAL candidate index
+    masked to INT32_MAX wherever that shard did not achieve the min, so
+    its pmin is the lowest global index among the achieving shards.
+    Tie-breaking is therefore "global lowest index" — bit-identical to
+    ``_model_axis_select`` (argmin over gathered minima picks the lowest
+    shard, blocks are ordered) and to the dense single-table argmin.
+    Ownership is exclusive: a shard owns a row iff the winning index lies
+    in its own block, and the winner's index lies in exactly one block."""
+    if model_shards <= 1:
+        return None
+    m_idx = lax.axis_index(MODEL_AXIS)
+
+    def select(best_local, mind2_local):
+        gmin = lax.pmin(mind2_local, MODEL_AXIS)
+        gidx = (m_idx * k_local + best_local).astype(jnp.int32)
+        cand = jnp.where(mind2_local == gmin, gidx,
+                         jnp.int32(np.iinfo(np.int32).max))
+        win = lax.pmin(cand, MODEL_AXIS)
+        return win == gidx, gmin
+
+    return select
+
+
 PALLAS_MODES = ("pallas", "pallas_bf16")
 
 
@@ -212,7 +243,7 @@ def _local_stats(points, weights, centroids_block, *, chunk_size, mode,
                  model_shards: int, need_sse: bool = True,
                  need_farthest: bool = True, need_sse_pc: bool = True,
                  x2w=None, w_col=None, pipeline: int = 0,
-                 real_mask=None):
+                 real_mask=None, kshard: bool = False):
     """Per-(data,model)-shard pass: scan chunks via the shared
     stage-A/stage-B body (``ops.assign.distance_stage``/``consume_chunk``;
     one fused Pallas kernel for the 'pallas' modes).  Returns
@@ -254,7 +285,12 @@ def _local_stats(points, weights, centroids_block, *, chunk_size, mode,
     n_chunks = points.shape[0] // chunk_size
     xs = (points.reshape(n_chunks, chunk_size, d),
           weights.astype(acc).reshape(n_chunks, chunk_size))
-    select = _model_axis_select(model_shards)
+    # kshard swaps the dense (m, chunk) minima gather for the pair
+    # all-reduce (ISSUE 16); both selects return the identical global min
+    # and the identical "global lowest index" owner, so every downstream
+    # statistic is bit-equal — only the collective pattern differs.
+    select = (_model_axis_pair_select(model_shards, k_local) if kshard
+              else _model_axis_select(model_shards))
     kw = dict(mode=mode, select_fn=select, need_sse=need_sse,
               need_farthest=need_farthest, need_sse_pc=need_sse_pc,
               real_mask=real_mask)
@@ -384,6 +420,262 @@ def make_step_fn(mesh: Mesh, *, chunk_size: int,
                             P(None)),
         check_vma=False)
     return jax.jit(mapped)
+
+
+@_obs_trace.traced_builder
+def make_kshard_step_fn(mesh: Mesh, *, chunk_size: int,
+                        mode: str = "matmul",
+                        pipeline: int = 0) -> Callable:
+    """K-SHARDED variant of ``make_step_fn`` for the massive-k tier
+    (ISSUE 16): per-cluster ``sums``/``counts``/``sse_per_cluster`` stay
+    SHARDED on the model axis (``P(MODEL_AXIS, ...)`` out_specs) instead
+    of being embedded into a replicated full table, and the assignment
+    pass reconstructs the global argmin with the (distance, index) pair
+    all-reduce (``_model_axis_pair_select``) instead of the dense
+    ``all_gather`` of per-shard minima.
+
+    What that buys at large k: the dense TP step materializes a
+    replicated (k, D) psum accumulator (plus counts and the gathered
+    (m, chunk) minima tile) on EVERY device — the exact term the r16
+    planner's ``k_shard`` branch removes; here no device ever holds more
+    than its (k/M, D) block of the statistics.  The host M-step is
+    unchanged: ``np.asarray`` on the sharded stats gathers them on the
+    host, where the float64 division already lives.
+
+    Parity: both selects return the identical global min distance and
+    the identical "global lowest index" owner, and the replicated
+    ``sse``/farthest reductions reuse the dense expressions verbatim, so
+    the k-sharded step is a BIT-EXACT partner of the dense TP step
+    (``k_shard=0`` is the oracle; pinned in tests/test_large_k.py).
+
+    Matmul-class modes only: the fused Pallas kernels own their TP form
+    (assignment-only + gathered minima), and the guarded bf16 rung is
+    already rejected under TP (``_check_guarded``).
+    """
+    data_shards, model_shards = mesh_shape(mesh)
+    if model_shards <= 1:
+        raise ValueError(
+            "make_kshard_step_fn requires a TP (centroid-sharded) mesh "
+            f"(model_shards > 1, got {model_shards}); on a data-parallel "
+            "mesh the dense step already holds only one centroid block — "
+            "use make_step_fn (k_shard=0)")
+    if mode in PALLAS_MODES or mode == GUARDED_MODE:
+        raise ValueError(
+            f"make_kshard_step_fn supports the matmul-class modes only, "
+            f"got {mode!r}: the Pallas kernels carry their own TP "
+            "assignment form, and the guarded bf16 rung has no TP form "
+            "(_check_guarded)")
+
+    def step(points, weights, centroids_block):
+        st, _ = _local_stats(points, weights, centroids_block,
+                             chunk_size=chunk_size, mode=mode,
+                             model_shards=model_shards,
+                             pipeline=pipeline, kshard=True)
+        # Per-block stats: psum over the DATA axis only — the model axis
+        # is the OUTPUT sharding (out_specs below stitch the blocks into
+        # the global (k, D) view the host M-step gathers lazily).
+        sums = lax.psum(st.sums, DATA_AXIS)
+        counts = lax.psum(st.counts, DATA_AXIS)
+        sse_pc = lax.psum(st.sse_per_cluster, DATA_AXIS)
+        # sse/farthest reuse the dense-step expressions verbatim (the
+        # pair select's gmin is global, so st.sse is identical on every
+        # model shard — the same replication the dense step divides out).
+        sse = lax.psum(st.sse, (DATA_AXIS, MODEL_AXIS)) / model_shards
+        far_ds = lax.all_gather(st.farthest_dist, (DATA_AXIS, MODEL_AXIS))
+        far_ps = lax.all_gather(st.farthest_point, (DATA_AXIS, MODEL_AXIS))
+        j = jnp.argmax(far_ds)
+        return StepStats(sums, counts, sse, far_ds[j], far_ps[j], sse_pc)
+
+    mapped = shard_map(
+        step, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(MODEL_AXIS, None)),
+        out_specs=StepStats(P(MODEL_AXIS, None), P(MODEL_AXIS), P(), P(),
+                            P(None), P(MODEL_AXIS)),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+def _two_level_best(xc, coarse, cents_ext, members, *, nprobe: int,
+                    mode: str, k: int):
+    """Per-chunk two-level candidate search (ISSUE 16): route each row
+    through the coarse quantizer, then recompute EXACT distances over the
+    activated cells' member lists.  Returns ``(best_d, best_i)`` — the
+    exact squared distance to, and the GLOBAL index of, the nearest
+    candidate centroid.
+
+    Routing: each row activates its ``nprobe`` nearest coarse cells (the
+    per-row ``nprobe``-th-smallest threshold; coarse-distance ties
+    activate a SUPERSET of cells, which only widens the candidate set).
+    A ``fori_loop`` over cells visits only cells some row in the chunk
+    activated (``lax.cond`` — inactive cells pay nothing), gathers the
+    cell's (L, d) member table from the full table, and computes the
+    (chunk, L) distance tile with the SAME ``pairwise_sq_dists`` mode
+    ladder as the dense path — distances over the candidate set are
+    exact, which is the SSE contract (docs/ANALYSIS.md).
+
+    Tie-breaking matches the dense argmin's "global lowest index": the
+    cross-cell merge is lexicographic on (distance, global index), and
+    member lists arrive SORTED ascending (the host builder's contract),
+    so the within-cell argmin already picks the lowest global index.
+    ``members`` entries equal to ``k`` are empty slots (they gather the
+    sentinel row of ``cents_ext`` and are masked to +inf); every cell
+    must carry >= 1 real member (the host builder seeds empty cells with
+    their nearest fine centroid), so ``best_i < k`` for every row."""
+    chunk = xc.shape[0]
+    C, L = members.shape
+    if not 1 <= nprobe <= C:
+        raise ValueError(f"nprobe must be in [1, {C}], got {nprobe}")
+    dc = pairwise_sq_dists(xc, coarse, mode=mode)           # (chunk, C)
+    thresh = -lax.top_k(-dc, nprobe)[0][:, -1]
+    active = dc <= thresh[:, None]                          # (chunk, C)
+    cell_any = jnp.any(active, axis=0)                      # (C,)
+    carry0 = (jnp.full((chunk,), jnp.inf, dc.dtype),
+              jnp.full((chunk,), k, jnp.int32))
+
+    def cell(c, carry):
+        def visit(carry):
+            bd, bi = carry
+            mem = members[c]                                # (L,)
+            ctab = cents_ext[mem]                           # (L, d)
+            d2 = pairwise_sq_dists(xc, ctab, mode=mode)     # (chunk, L)
+            valid = (mem < k)[None, :] & active[:, c][:, None]
+            d2 = jnp.where(valid, d2, jnp.inf)
+            j = jnp.argmin(d2, axis=1)
+            dm = jnp.min(d2, axis=1)
+            gi = mem[j].astype(jnp.int32)
+            better = (dm < bd) | ((dm == bd) & (gi < bi))
+            return (jnp.where(better, dm, bd),
+                    jnp.where(better, gi, bi))
+
+        return lax.cond(cell_any[c], visit, lambda s: s, carry)
+
+    return lax.fori_loop(0, C, cell, carry0)
+
+
+def _check_two_level(mode: str, model_shards: int) -> None:
+    """Builder-level support matrix of the two-level tier (ISSUE 16)."""
+    if model_shards != 1:
+        raise ValueError(
+            "two-level assignment requires a data-parallel mesh "
+            f"(model_shards == 1, got {model_shards}): the candidate "
+            "gather indexes the FULL centroid table; at table sizes "
+            "that need TP sharding, use k_shard instead (the two tiers "
+            "compose with the planner, not with each other)")
+    if mode in PALLAS_MODES or mode == GUARDED_MODE:
+        raise ValueError(
+            f"two-level assignment supports the matmul-class modes only, "
+            f"got {mode!r}: the fused Pallas kernels and the guarded "
+            "bf16 rung are dense-tile programs — the candidate-set "
+            "gather has no fused form")
+
+
+@_obs_trace.traced_builder
+def make_two_level_step_fn(mesh: Mesh, *, chunk_size: int, nprobe: int,
+                           mode: str = "matmul") -> Callable:
+    """TWO-LEVEL variant of ``make_step_fn`` for the massive-k tier
+    (ISSUE 16): ``(points, weights, centroids (k, D), coarse (C, D),
+    members (C, L)) -> StepStats``.  The coarse quantizer routes each
+    chunk to a bounded candidate set (``_two_level_best``) and the
+    per-cluster statistics accumulate by SCATTER-ADD over the winning
+    labels — the step never materializes a (chunk, k) dense tile, which
+    is the memory wall the r16 planner predicts (docs/PERFORMANCE.md).
+
+    SSE stays EXACT for the produced labeling: distances over the
+    candidate set come from the same ``pairwise_sq_dists`` ladder as the
+    dense path, and the per-chunk SSE fold is the dense expression
+    verbatim.  With ``nprobe == C`` the candidate set covers every
+    centroid and the step is a parity partner of the dense step
+    (``assign='dense'`` is the oracle; the scatter-add fold order is the
+    only difference — the r10 f64 parity class, pinned in
+    tests/test_large_k.py).  Matmul-class modes, data-parallel meshes
+    only (``_check_two_level``)."""
+    data_shards, model_shards = mesh_shape(mesh)
+    _check_two_level(mode, model_shards)
+
+    def step(points, weights, centroids, coarse, members):
+        k, d = centroids.shape
+        acc = _accum_dtype(points.dtype)
+        n_chunks = points.shape[0] // chunk_size
+        xs = (points.reshape(n_chunks, chunk_size, d),
+              weights.astype(acc).reshape(n_chunks, chunk_size))
+        cents_ext = jnp.concatenate(
+            [centroids, jnp.full((1, d), PAD_CENTROID_VALUE,
+                                 centroids.dtype)], axis=0)
+
+        def body(st, chunk):
+            xc, wc = chunk
+            bd, bi = _two_level_best(xc, coarse, cents_ext, members,
+                                     nprobe=nprobe, mode=mode, k=k)
+            sums = st.sums.at[bi].add(xc.astype(acc) * wc[:, None])
+            counts = st.counts.at[bi].add(wc)
+            sse = st.sse + jnp.sum(bd * wc).astype(acc)
+            sse_pc = st.sse_per_cluster.at[bi].add((bd * wc).astype(acc))
+            masked = jnp.where(wc > 0, bd, -jnp.inf)
+            i = jnp.argmax(masked)
+            better = masked[i] > st.farthest_dist
+            far_d = jnp.where(better, masked[i],
+                              st.farthest_dist).astype(acc)
+            far_p = jnp.where(better, xc[i].astype(acc),
+                              st.farthest_point)
+            return StepStats(sums, counts, sse, far_d, far_p, sse_pc), None
+
+        st, _ = lax.scan(body, init_stats(k, d, acc), xs)
+        sums = lax.psum(st.sums, DATA_AXIS)
+        counts = lax.psum(st.counts, DATA_AXIS)
+        sse_pc = lax.psum(st.sse_per_cluster, DATA_AXIS)
+        sse = lax.psum(st.sse, DATA_AXIS)
+        far_ds = lax.all_gather(st.farthest_dist, DATA_AXIS)
+        far_ps = lax.all_gather(st.farthest_point, DATA_AXIS)
+        j = jnp.argmax(far_ds)
+        return StepStats(sums, counts, sse, far_ds[j], far_ps[j], sse_pc)
+
+    mapped = shard_map(
+        step, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None, None),
+                  P(None, None), P(None, None)),
+        out_specs=StepStats(P(None, None), P(None), P(), P(), P(None),
+                            P(None)),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+@_obs_trace.traced_builder
+def make_two_level_predict_fn(mesh: Mesh, *, chunk_size: int, nprobe: int,
+                              mode: str = "matmul",
+                              donate_points: bool = False) -> Callable:
+    """Two-level label assignment (ISSUE 16): ``(points, centroids,
+    coarse, members) -> labels`` with labels data-sharded — the serving
+    twin of ``make_two_level_step_fn``'s assignment pass, same candidate
+    search, same tie-breaking, no (chunk, k) dense tile.
+    ``donate_points`` mirrors ``make_predict_fn`` (the serving engine's
+    single-use staging buffer)."""
+    data_shards, model_shards = mesh_shape(mesh)
+    _check_two_level(value_mode(mode), model_shards)
+    mode = value_mode(mode)
+
+    def predict(points, centroids, coarse, members):
+        k, d = centroids.shape
+        n_chunks = points.shape[0] // chunk_size
+        xs = points.reshape(n_chunks, chunk_size, d)
+        cents_ext = jnp.concatenate(
+            [centroids, jnp.full((1, d), PAD_CENTROID_VALUE,
+                                 centroids.dtype)], axis=0)
+
+        def body(_, xc):
+            _, bi = _two_level_best(xc, coarse, cents_ext, members,
+                                    nprobe=nprobe, mode=mode, k=k)
+            return None, bi
+
+        _, labels = lax.scan(body, None, xs)
+        return labels.reshape(-1)
+
+    mapped = shard_map(
+        predict, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(None, None), P(None, None),
+                  P(None, None)),
+        out_specs=P(DATA_AXIS),
+        check_vma=False)
+    return jax.jit(mapped, donate_argnums=(0,) if donate_points else ())
 
 
 #: Ordered phase labels of the assignment pass's cumulative-prefix
@@ -830,7 +1122,7 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                       history_sse: bool = True,
                       project: Optional[str] = None,
                       k_reals=None, return_all: bool = False,
-                      pipeline: int = 0):
+                      pipeline: int = 0, member_points: bool = False):
     """Build a BATCHED on-device training loop: ``n_init`` independent
     restarts run in ONE dispatch, vmapped over the restart axis.
 
@@ -884,6 +1176,19 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
     the host: ``(centroids[R,k_real,D], n_iters[R], sse_hist[R,max_iter],
     shift_hist[R,max_iter], counts[R,k_real], final_inertias[R])``.
 
+    ``member_points=True`` generalizes the member axis once more (ISSUE
+    16, the batched PQ codebook trainer): ``points`` arrives with a
+    LEADING member axis — (R, n_local, d) sharded on the data axis at
+    axis 1 — and member r trains against ITS OWN rows (the r-th
+    subspace's column slice) instead of a shared dataset.  Everything
+    else about the member axis is unchanged, so one dispatch trains all
+    R subspace codebooks.  Restricted to the matmul-class modes and
+    ``empty_policy='keep'``: the Gumbel refill engine draws rows from
+    the SHARED dataset by global index, which has no per-member-rows
+    form (a PQ subspace with an empty code keeps its old codeword — the
+    sklearn-encoder behavior), and the Pallas prep hoists are
+    shared-points programs.
+
     ``pipeline`` selects the chunk schedule (``_local_stats``).  Under
     the guarded bf16 rung the member passes run under ``lax.map``
     instead of ``vmap`` (a vmapped ``lax.cond`` lowers to a select that
@@ -900,6 +1205,18 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
             f"'resample', got {empty_policy!r}")
     _check_guarded(mode, mesh_shape(mesh)[1], empty_policy)
     guarded = (mode == GUARDED_MODE)
+    if member_points:
+        if mode in PALLAS_MODES or guarded:
+            raise ValueError(
+                f"member_points supports the matmul-class modes only, "
+                f"got {mode!r} (the Pallas prep hoists and the guarded "
+                "rung are shared-points programs)")
+        if empty_policy != "keep":
+            raise ValueError(
+                f"member_points requires empty_cluster='keep', got "
+                f"{empty_policy!r}: the Gumbel refill engine draws rows "
+                "from the shared dataset by global index, which has no "
+                "per-member-rows form")
     if k_reals is not None:
         k_reals = np.asarray(k_reals, np.int32)
         if k_reals.shape != (n_init,):
@@ -912,13 +1229,15 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
 
     def fit(points, weights, cents0_blocks, empty_seeds):
         # cents0_blocks: (R, k_local, d), k axis sharded on MODEL.
+        # points: (n_local, d) shared, or (R, n_local, d) per-member.
         acc = _accum_dtype(points.dtype)
         R, k_local, d = cents0_blocks.shape
         if empty_seeds.shape != (R, max_iter):
             raise ValueError(f"empty_seeds must have shape ({R}, "
                              f"{max_iter}) (one row per restart), got "
                              f"{empty_seeds.shape}")
-        n_orig, w_draw = points.shape[0], weights   # pre-prep row space
+        n_orig = points.shape[1] if member_points else points.shape[0]
+        w_draw = weights                            # pre-prep row space
         x2w = w_col = None
         if mode in PALLAS_MODES:
             # Hoist the kernel's x-side prep out of the loop (see
@@ -947,7 +1266,7 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
             restart's centroid block from its full table, then psum the
             embedded accumulators over both mesh axes.  Optional
             statistics are elided per the need flags."""
-            def local(c_full, r_mask):
+            def local(c_full, r_mask, pts):
                 blk = lax.dynamic_slice(
                     c_full, (jnp.asarray(m_idx * k_local, jnp.int32),
                              jnp.int32(0)), (k_local, d))
@@ -955,8 +1274,8 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                 # inert sentinel rows (k-sweep padding, 1e12 norms) out
                 # of the guard's distance scale (model_shards == 1 under
                 # the rung, so the block IS the full k_pad table).
-                return _local_stats(points, weights,
-                                    blk.astype(points.dtype),
+                return _local_stats(pts, weights,
+                                    blk.astype(pts.dtype),
                                     chunk_size=chunk_size, mode=mode,
                                     model_shards=model_shards,
                                     need_sse=need_sse,
@@ -964,7 +1283,12 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                                     need_sse_pc=False, x2w=x2w,
                                     w_col=w_col, pipeline=pipeline,
                                     real_mask=r_mask if guarded else None)
-            if mode in PALLAS_MODES or guarded:
+            if member_points:
+                # Per-member rows batch alongside the member's centroid
+                # table (ISSUE 16: one dispatch trains all subspace
+                # codebooks).
+                st, corrs = jax.vmap(local)(cents, real, points)
+            elif mode in PALLAS_MODES or guarded:
                 # vmapping a pallas_call over the restart axis
                 # MATERIALIZES the unbatched points operand R times
                 # (r5, found by the 10M x R=4 time-to-solution run:
@@ -976,9 +1300,11 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                 # The guarded rung rides the same path: vmap would turn
                 # its per-chunk correction cond into a both-branches
                 # select (see the builder docstring).
-                st, corrs = lax.map(lambda a: local(*a), (cents, real))
+                st, corrs = lax.map(lambda a: local(*a, points),
+                                    (cents, real))
             else:
-                st, corrs = jax.vmap(local)(cents, real)
+                st, corrs = jax.vmap(local, in_axes=(0, 0, None))(
+                    cents, real, points)
             off = jnp.asarray(m_idx * k_local, jnp.int32)
             sums = lax.psum(jax.vmap(lambda s: lax.dynamic_update_slice(
                 jnp.zeros((k_pad, d), acc), s.astype(acc),
@@ -1085,9 +1411,11 @@ def make_multi_fit_fn(mesh: Mesh, *, chunk_size: int, mode: str = "matmul",
                        P(None)))
     if guarded:
         out_specs = out_specs + (P(),)
+    points_spec = (P(None, DATA_AXIS, None) if member_points
+                   else P(DATA_AXIS, None))
     mapped = shard_map(
         fit, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS),
+        in_specs=(points_spec, P(DATA_AXIS),
                   P(None, MODEL_AXIS, None), P(None, None)),
         out_specs=out_specs,
         check_vma=False)

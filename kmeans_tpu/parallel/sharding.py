@@ -253,6 +253,31 @@ def bucket_rows(n: int) -> int:
     return int(round(BUCKET_FLOOR * (2 ** (e + 2))))  # pragma: no cover
 
 
+#: Candidate-set bucket floor (ISSUE 16): the two-level tier's member
+#: lists are (C, L) tables whose width L is the largest per-cell member
+#: count — bucketing L on the same quarter-power-of-two rungs as the
+#: row ladder (with a lane-width floor, not the row floor: candidate
+#: lists are k/C-ish, far below 256 at moderate k) means member-list
+#: rebuilds across iterations and across cells commit to a handful of
+#: compiled programs instead of one per distinct L.
+CANDIDATE_FLOOR = 32
+
+
+def bucket_candidates(n: int) -> int:
+    """The smallest candidate-width bucket boundary >= ``n`` (ISSUE 16):
+    ``bucket_rows`` rungs with the ``CANDIDATE_FLOOR`` floor."""
+    n = int(n)
+    if n <= CANDIDATE_FLOOR:
+        return CANDIDATE_FLOOR
+    e = int(np.floor(np.log2(n / CANDIDATE_FLOOR)))
+    for ee in (e - 1, e, e + 1):
+        for r in BUCKET_RUNGS:
+            b = int(round(CANDIDATE_FLOOR * r * (2 ** ee)))
+            if b >= n:
+                return b
+    return int(round(CANDIDATE_FLOOR * (2 ** (e + 2))))  # pragma: no cover
+
+
 def check_bucket(bucket):
     """Validate (and normalize) the ``bucket`` knob grammar shared by
     every family and the CLI: ``'auto'`` | an int >= 0 (0 = exact

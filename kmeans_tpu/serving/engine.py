@@ -137,6 +137,13 @@ class ResidentModel:
         # Rows the bf16 near-tie guard re-labeled at f32 (audit trail
         # of the exactness guarantee; 0 on separated traffic).
         self.bf16_corrected_rows = 0
+        # quantize='pq' residency (ISSUE 16): the table's product
+        # quantizer + the compressed row codes, built once at add time;
+        # pq_corrected_rows counts ADC near-ties re-resolved against
+        # the decoded table (the r13 guard discipline applied to PQ).
+        self.pq = None
+        self.pq_codes: Optional[np.ndarray] = None
+        self.pq_corrected_rows = 0
         # Resident table footprint (ISSUE 12): the bytes this model's
         # parameter tables hold on EACH device it is placed on (tables
         # are replicated across the data axis) — summed host-side from
@@ -256,22 +263,53 @@ class ServingEngine:
         (ISSUE 14); by default the model's own ``quality_profile()`` —
         fresh fitted stats or the checkpoint-restored block — is used.
         A model with neither serves with the reference-free detector
-        subset (bf16 margin shift + latency histograms only)."""
-        if quantize not in (None, "bf16"):
-            raise ValueError(f"quantize must be None or 'bf16', got "
-                             f"{quantize!r}")
-        if quantize == "bf16" and mesh_shape(self.mesh)[1] != 1:
+        subset (bf16 margin shift + latency histograms only).
+
+        ``quantize='pq'`` (ISSUE 16) compresses the centroid table with
+        a product quantizer trained at add time and serves ``predict``
+        through the ADC route (``ProductQuantizer.adc_assign``): labels
+        are the exact argmin over the DECODED table — near-ties under
+        the r13 margin guard re-resolve exactly — with the quantization
+        residual of the stored codes as the one documented
+        approximation.  ``transform``/``score_rows`` keep the exact
+        table."""
+        if quantize not in (None, "bf16", "pq"):
+            raise ValueError(f"quantize must be None, 'bf16' or 'pq', "
+                             f"got {quantize!r}")
+        if quantize is not None and mesh_shape(self.mesh)[1] != 1:
             raise ValueError(
-                "quantize='bf16' requires a data-parallel mesh (the "
-                "guarded assignment has no TP centroid-sharding form); "
-                "serve this model unquantized or use model_shards=1")
+                f"quantize={quantize!r} requires a data-parallel mesh "
+                "(neither the guarded bf16 assignment nor the PQ-ADC "
+                "route has a TP centroid-sharding form); serve this "
+                "model unquantized or use model_shards=1")
         spec = self.registry.register(model_id, model)
+        if spec.get("assign") == "two_level":
+            if mesh_shape(self.mesh)[1] != 1:
+                self.registry.remove(model_id)
+                raise ValueError(
+                    "a two-level (assign='two_level') model requires a "
+                    "data-parallel serving mesh (model_shards == 1): "
+                    "the coarse->candidates route addresses the same "
+                    "memory wall as TP centroid sharding and the two "
+                    "tiers do not stack")
+            if quantize is not None:
+                self.registry.remove(model_id)
+                raise ValueError(
+                    "quantize does not compose with assign='two_level' "
+                    "— the quantized fast paths score the DENSE table, "
+                    "the two-level route a candidate subset; serve one "
+                    "approximation at a time")
         # One mesh for everything resident: direct model calls and
         # serving dispatches must hit the same compiled programs.
         model.mesh = self.mesh
         if spec["family"] == "gmm":
-            quantize = None       # bf16 assign is a K-Means-family path
+            quantize = None       # quantized assign is K-Means-family
         rm = ResidentModel(model_id, model, spec, quantize)
+        if quantize == "pq":
+            from kmeans_tpu.models.pq import ProductQuantizer
+            rm.pq, rm.pq_codes = ProductQuantizer.for_table(
+                np.asarray(model.centroids), mesh=self.mesh,
+                seed=int(getattr(model, "seed", 0)))
         if self._quality:
             if profile is None:
                 qp = getattr(model, "quality_profile", None)
@@ -473,6 +511,16 @@ class ServingEngine:
                                                  False):
                         with self._lock:
                             rm.bf16_corrected_rows += corrected
+                elif rm.quantize == "pq":
+                    out, corrected = self._assign_pq(rm, buf, m)
+                    guarded = m
+                    if corrected and not getattr(self._tls, "warming",
+                                                 False):
+                        with self._lock:
+                            rm.pq_corrected_rows += corrected
+                elif rm.spec.get("assign") == "two_level":
+                    out = self._assign_two_level(
+                        rm, pts, cents_dev, chunk, tmode, m)
                 else:
                     out = np.asarray(self._predict_fn(chunk, mode)(
                         pts, cents_dev, np.int32(m)))[:m]
@@ -553,6 +601,46 @@ class ServingEngine:
                     np.int32(n_sub)))[:n_sub]
                 labels[near] = exact
         return labels, int(near.size)
+
+    def _assign_pq(self, rm: ResidentModel, buf: np.ndarray, m: int
+                   ) -> Tuple[np.ndarray, int]:
+        """The ``quantize='pq'`` predict route (ISSUE 16): ADC lookup
+        sums against the compressed table, with the r13 margin guard
+        re-resolving near-ties exactly against the DECODED table
+        (``ProductQuantizer.adc_assign`` — labels equal the exact
+        decoded-table argmin by construction).  Host-side: the whole
+        route is O(m * (k_table + k_pq * d)) numpy on tiny tables —
+        no compiled program, hence no note_dispatch."""
+        with obs_trace.span("dispatch", tag="serve/pq-adc", rows=m):
+            labels, corrected = rm.pq.adc_assign(buf[:m], rm.pq_codes)
+        return labels, int(corrected)
+
+    def _assign_two_level(self, rm: ResidentModel, pts, cents_dev,
+                          chunk: int, tmode: str, m: int) -> np.ndarray:
+        """The two-level predict route for a resident
+        ``assign='two_level'`` model (ISSUE 16): the model's own
+        coarse/member tables (cached by centroid identity) through the
+        coarse->candidates->exact-recompute program, at the SERVING
+        bucket's chunk shape.  Same cache key family as
+        ``KMeans._predict_two_level_labels``, so a model served and
+        called directly shares compiled programs whenever the shapes
+        agree."""
+        model = rm.model
+        coarse, members = model._two_level_tables()
+        C, npb = model._two_level_params()
+        fn = kmeans_mod._STEP_CACHE.get_or_create(
+            (self.mesh, chunk, tmode, C, members.shape[1], npb,
+             "twolevel-predict"),
+            lambda: dist.make_two_level_predict_fn(
+                self.mesh, chunk_size=chunk, nprobe=npb, mode=tmode))
+        # Tagged distinctly from dense serving traffic so dispatch-
+        # count pins can tell the routes apart (the bf16-guard-fix
+        # discipline).
+        note_dispatch("serve/two-level")
+        with obs_trace.span("dispatch", tag="serve/two-level", rows=m):
+            return np.asarray(fn(pts, cents_dev,
+                                 coarse.astype(model.dtype),
+                                 members))[:m]
 
     def _dispatch_gmm(self, rm: ResidentModel, op: str,
                       rows: np.ndarray) -> np.ndarray:
@@ -764,6 +852,8 @@ class ServingEngine:
         model_shards = mesh_shape(self.mesh)[1]
         cents_dev = rm.model._cents_dev(self.mesh, model_shards)
         pts, _ = shard_points(buf, self.mesh, chunk)
+        if rm.quantize == "pq":
+            return self._verify_pq(rm, buf, chunk, m, B, cents_dev)
         lab_q, corrected = self._assign_bf16_guarded(
             rm, buf, pts, cents_dev, chunk, m)
         f32_mode = rm.model._mode(B, rm.spec["d"])
@@ -806,6 +896,41 @@ class ServingEngine:
                 # probe — the price of exactness (0 on separated data).
                 "corrected_rows": corrected,
                 "dist_max_rel": float(np.max(rel))}
+
+    def _verify_pq(self, rm: ResidentModel, buf: np.ndarray, chunk: int,
+                   m: int, B: int, cents_dev) -> dict:
+        """``verify_quantized`` for a ``quantize='pq'`` resident: the
+        ADC route vs the f32 TRUE-table oracle.  Unlike bf16 (exact by
+        construction), PQ's labels may legitimately differ — the
+        decoded table is an approximation of the true one — so
+        ``label_mismatches`` here MEASURES the quantization error on
+        the probe rather than pinning zero, and ``dist_max_rel`` is the
+        decoded-vs-true distance residual under the same row-scale
+        normalization as the bf16 probe."""
+        lab_q, corrected = self._assign_pq(rm, buf, m)
+        f32_mode = rm.model._mode(B, rm.spec["d"])
+        note_dispatch("verify-quantized/f32-oracle")
+        with obs_trace.span("dispatch", tag="verify-quantized/f32-oracle",
+                            rows=m):
+            lab_f = np.asarray(self._predict_fn(chunk, f32_mode)(
+                shard_points(buf, self.mesh, chunk)[0], cents_dev,
+                np.int32(m)))[:m]
+        Q = np.asarray(buf[:m], np.float64)
+        table = np.asarray(rm.model.centroids, np.float64)
+        decoded = rm.pq.decode(rm.pq_codes)
+
+        def _d2(tab):
+            return (np.sum(Q ** 2, axis=1)[:, None] - 2.0 * Q @ tab.T
+                    + np.sum(tab ** 2, axis=1)[None, :])
+
+        df, dq = _d2(table), _d2(decoded)
+        scale = np.maximum(np.max(np.abs(df), axis=1, keepdims=True),
+                           np.finfo(np.float64).tiny)
+        mism = int(np.sum(lab_q != lab_f))
+        return {"labels_equal": mism == 0,
+                "label_mismatches": mism,
+                "corrected_rows": int(corrected),
+                "dist_max_rel": float(np.max(np.abs(dq - df) / scale))}
 
     # ------------------------------------------------------------ stats
 
@@ -855,7 +980,8 @@ class ServingEngine:
                       "requests": rm.requests, "rows": rm.rows,
                       "dispatches": rm.dispatches,
                       "table_bytes": rm.table_bytes,
-                      "bf16_corrected_rows": rm.bf16_corrected_rows}
+                      "bf16_corrected_rows": rm.bf16_corrected_rows,
+                      "pq_corrected_rows": rm.pq_corrected_rows}
                 for mid, rm in sorted(self._residents.items())}
             stats = {
                 "models_resident": len(models),

@@ -1,0 +1,486 @@
+"""Massive-k tier tests (ISSUE 16): k-sharded centroid tables,
+two-level assignment, the batched PQ codebook trainer, and the
+planner/CLI surfaces that route between them.
+
+The parity discipline mirrors the repo's other route tests: every new
+execution path is pinned against the dense oracle it replaces —
+bit-exact where the construction guarantees it (k-shard; two-level at
+``nprobe >= C``), by explicit error contract where it does not
+(two-level candidate sets, PQ/ADC quantization).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from kmeans_tpu import KMeans, ProductQuantizer
+from kmeans_tpu.models.pq import default_subspaces
+
+
+@pytest.fixture(scope="module")
+def clusters():
+    """Well-separated blobs: 600 x 16, three lattice offsets."""
+    rng = np.random.default_rng(5)
+    return (rng.normal(size=(600, 16))
+            + 8.0 * rng.integers(0, 3, size=(600, 1)))
+
+
+def _fit_kw(**over):
+    kw = dict(k=12, max_iter=15, seed=0, dtype=np.float64,
+              tolerance=1e-6, compute_sse=True, verbose=False)
+    kw.update(over)
+    return kw
+
+
+def _dense_argmin(Q, table):
+    Q = np.asarray(Q, np.float64)
+    T = np.asarray(table, np.float64)
+    d2 = (np.sum(Q * Q, 1)[:, None] - 2.0 * Q @ T.T
+          + np.sum(T * T, 1)[None, :])
+    return np.argmin(d2, axis=1).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# k-sharded centroid tables (TP mesh)
+# ---------------------------------------------------------------------------
+
+class TestKShard:
+    def test_bit_parity_vs_dense_tp_oracle(self, mesh4x2, clusters):
+        """k_shard=model_shards is the dense TP fit's bit-exact twin:
+        same trajectory, same iteration count, same final table."""
+        dense = KMeans(mesh=mesh4x2, k_shard=0, assign="dense",
+                       host_loop=True, **_fit_kw()).fit(clusters)
+        shard = KMeans(mesh=mesh4x2, k_shard=2, **_fit_kw()).fit(clusters)
+        assert np.array_equal(np.asarray(dense.centroids),
+                              np.asarray(shard.centroids))
+        assert dense.n_iter_ == shard.n_iter_
+        assert np.array_equal(np.asarray(dense.predict(clusters)),
+                              np.asarray(shard.predict(clusters)))
+        assert shard.k_shard_resolved_ == 2
+        assert shard.assign_resolved_ == "dense"
+
+    def test_explicit_kshard_requires_tp_mesh(self, mesh8, clusters):
+        with pytest.raises(ValueError, match="model-sharded mesh"):
+            KMeans(mesh=mesh8, k_shard=2, **_fit_kw()).fit(clusters)
+
+    def test_explicit_kshard_must_match_mesh(self, mesh4x2, clusters):
+        with pytest.raises(ValueError, match="does not match"):
+            KMeans(mesh=mesh4x2, k_shard=4, **_fit_kw()).fit(clusters)
+
+    def test_kshard_rejects_device_loop(self, mesh4x2, clusters):
+        with pytest.raises(ValueError, match="host_loop=False"):
+            KMeans(mesh=mesh4x2, k_shard=2, host_loop=False,
+                   **_fit_kw()).fit(clusters)
+
+    def test_knob_grammar(self):
+        with pytest.raises(ValueError, match="k_shard"):
+            KMeans(k=4, k_shard="bogus")
+        with pytest.raises(ValueError, match="k_shard"):
+            KMeans(k=4, k_shard=-1)
+        with pytest.raises(ValueError, match="assign"):
+            KMeans(k=4, assign="bogus")
+        with pytest.raises(ValueError, match="coarse_cells"):
+            KMeans(k=4, coarse_cells=0)
+        with pytest.raises(ValueError, match="nprobe"):
+            KMeans(k=4, nprobe=0)
+        with pytest.raises(ValueError, match="init_cap"):
+            KMeans(k=4, init_cap=0)
+
+
+# ---------------------------------------------------------------------------
+# Two-level (coarse-quantizer) assignment
+# ---------------------------------------------------------------------------
+
+class TestTwoLevel:
+    def test_exact_probe_is_dense_bit_parity(self, mesh8, clusters):
+        """nprobe >= C probes every cell — the candidate set is the
+        whole table, sorted member lists reproduce dense argmin's
+        tie-break, so the fit trajectory is bit-exact."""
+        dense = KMeans(mesh=mesh8, k_shard=0, assign="dense",
+                       host_loop=True, **_fit_kw()).fit(clusters)
+        two = KMeans(mesh=mesh8, assign="two_level", coarse_cells=4,
+                     nprobe=4, **_fit_kw()).fit(clusters)
+        assert np.array_equal(np.asarray(dense.centroids),
+                              np.asarray(two.centroids))
+        assert dense.n_iter_ == two.n_iter_
+        assert two.assign_resolved_ == "two_level"
+        assert two.k_shard_resolved_ == 0
+
+    def test_predict_matches_dense_argmin(self, mesh8, clusters):
+        rng = np.random.default_rng(9)
+        rows = (rng.normal(size=(80, 16))
+                + 8.0 * rng.integers(0, 3, size=(80, 1)))
+        two = KMeans(mesh=mesh8, assign="two_level", coarse_cells=4,
+                     nprobe=4, **_fit_kw()).fit(clusters)
+        assert np.array_equal(np.asarray(two.predict(rows)),
+                              _dense_argmin(rows, two.centroids))
+
+    def test_default_probe_quality_contract(self, mesh8, clusters):
+        """Default nprobe (an eighth of the cells) is NOT exact — the
+        contract is exact SSE over the candidate assignment, with the
+        routed fit landing within a few percent of the dense one on
+        separated data (docs/ANALYSIS.md)."""
+        two = KMeans(mesh=mesh8, assign="two_level",
+                     **_fit_kw(k=24, max_iter=20)).fit(clusters)
+        dense = KMeans(mesh=mesh8, host_loop=True,
+                       **_fit_kw(k=24, max_iter=20)).fit(clusters)
+        ratio = two.inertia_ / dense.inertia_
+        assert 0.5 < ratio < 1.1
+        C, npb = two._two_level_params()
+        assert npb < C  # the default really exercises the routed path
+
+    def test_two_level_requires_dp_mesh(self, mesh4x2, clusters):
+        with pytest.raises(ValueError, match="two_level"):
+            KMeans(mesh=mesh4x2, assign="two_level",
+                   **_fit_kw()).fit(clusters)
+
+    def test_auto_resolves_dense_on_unreported_backend(self, mesh8,
+                                                       clusters):
+        """CPU reports no allocator stats, so 'auto' must resolve to
+        the dense oracle — massive-k routing is opt-in there."""
+        import jax
+        if jax.default_backend() != "cpu":
+            pytest.skip("auto-resolution fallback is the CPU contract")
+        km = KMeans(mesh=mesh8, **_fit_kw()).fit(clusters)
+        assert km.k_shard_resolved_ == 0
+        assert km.assign_resolved_ == "dense"
+
+
+# ---------------------------------------------------------------------------
+# Batched PQ codebook trainer
+# ---------------------------------------------------------------------------
+
+class TestProductQuantizer:
+    @pytest.fixture(scope="class")
+    def fitted(self, mesh8):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(1024, 16)).astype(np.float64)
+        pq = ProductQuantizer(m=4, k=8, max_iter=20, tolerance=1e-6,
+                              seed=7, dtype=np.float64, mesh=mesh8)
+        pq.fit(X)
+        return pq, X
+
+    def test_subspace_equivalence_vs_independent_fits(self, fitted,
+                                                      mesh8):
+        """The one-dispatch batched trainer is M independent per-
+        subspace k-means fits, bit-for-bit (the r12 member-axis
+        contract applied to subspaces)."""
+        pq, X = fitted
+        seeds = pq._member_seeds(4)
+        for j in range(4):
+            sub = X[:, j * 4:(j + 1) * 4]
+            km = KMeans(k=8, max_iter=20, tolerance=1e-6, seed=seeds[j],
+                        init="k-means++", empty_cluster="keep",
+                        dtype=np.float64, mesh=mesh8, host_loop=False,
+                        verbose=False).fit(sub)
+            assert np.max(np.abs(np.asarray(km.centroids, np.float64)
+                                 - pq.codebooks_[j])) == 0.0
+            d2 = ((sub[:, None, :]
+                   - pq.codebooks_[j][None, :, :]) ** 2).sum(-1)
+            sse = float(np.sum(np.min(d2, axis=1)))
+            assert pq.subspace_inertias_[j] == pytest.approx(
+                sse, rel=1e-9)
+
+    def test_encode_is_exact_argmin(self, fitted):
+        pq, X = fitted
+        codes = pq.encode(X)
+        assert codes.shape == (1024, 4) and codes.dtype == np.uint8
+        for j in (0, 2):
+            sub = X[:, j * 4:(j + 1) * 4]
+            d2 = ((sub[:, None, :]
+                   - pq.codebooks_[j][None, :, :]) ** 2).sum(-1)
+            assert np.array_equal(codes[:, j], np.argmin(d2, axis=1))
+        dec = pq.decode(codes)
+        assert dec.shape == X.shape
+        assert pq.compression_ratio() > 1.0
+
+    def test_adc_assign_matches_exact_decoded_argmin(self, mesh8):
+        """The guarded ADC contract: f32 LUT sums with near-tie rows
+        recomputed exactly — labels equal the exact f64 argmin over
+        the DECODED table (the bf16-guard discipline applied to PQ)."""
+        rng = np.random.default_rng(3)
+        table = rng.normal(size=(64, 16))
+        pq, codes = ProductQuantizer.for_table(table, m=4, k=16,
+                                               seed=3, mesh=mesh8)
+        queries = rng.normal(size=(200, 16))
+        labels, corrected = pq.adc_assign(queries, codes)
+        oracle = _dense_argmin(queries, pq.decode(codes))
+        assert np.array_equal(labels, oracle)
+        assert 0 <= corrected <= len(queries)
+
+    def test_plan_recorded(self, fitted):
+        pq, _ = fitted
+        assert pq.plan_ is not None
+        assert "predicted_peak_bytes" in pq.plan_
+
+    def test_auto_subspaces_and_validation(self, mesh8):
+        assert default_subspaces(16) == 8
+        assert default_subspaces(7) == 7
+        assert default_subspaces(13) == 1
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(64, 16))
+        with pytest.raises(ValueError, match="divide"):
+            ProductQuantizer(m=5, mesh=mesh8).fit(X)
+        with pytest.raises(ValueError, match="Not enough data points"):
+            ProductQuantizer(m=4, k=8, mesh=mesh8).fit(X[:4])
+
+    def test_fitted_state(self, fitted):
+        pq, _ = fitted
+        fs = pq.fitted_state()
+        assert fs["family"] == "pq"
+        assert fs["stackable"] is False
+        assert fs["m"] == 4
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint roundtrip of the large-k knobs
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_roundtrip_preserves_knobs(tmp_path, mesh8, clusters):
+    km = KMeans(mesh=mesh8, assign="two_level", coarse_cells=4,
+                nprobe=4, init_cap=4096, init="k-means||",
+                **_fit_kw()).fit(clusters)
+    path = tmp_path / "largek.npz"
+    km.save(path)
+    back = KMeans.load(path)
+    assert back.assign == "two_level"
+    assert back.coarse_cells == 4
+    assert back.nprobe == 4
+    assert back.init_cap == 4096
+    assert back.k_shard == "auto"
+    assert np.array_equal(np.asarray(back.centroids),
+                          np.asarray(km.centroids))
+    assert np.array_equal(np.asarray(back.predict(clusters)),
+                          np.asarray(km.predict(clusters)))
+
+
+def test_checkpoint_carries_coarse_table(tmp_path, mesh8, clusters):
+    """The coarse quantizer is FITTED state: with nprobe < coarse_cells
+    (non-collapse regime, where candidate sets actually depend on the
+    coarse table) a loaded model must predict IDENTICALLY to the model
+    that was saved — retraining coarse from the final table at load
+    time would re-route rows.  Regression pin for the r20 verify
+    finding (the collapse-regime roundtrip above cannot catch it)."""
+    km = KMeans(mesh=mesh8, assign="two_level", coarse_cells=6,
+                nprobe=1, **_fit_kw()).fit(clusters)
+    path = tmp_path / "largek_probe1.npz"
+    km.save(path)
+    back = KMeans.load(path)
+    saved_coarse = km._two_level_route_[0]
+    assert back._two_level_route_ is not None
+    assert np.array_equal(back._two_level_route_[0], saved_coarse)
+    assert np.array_equal(np.asarray(back.predict(clusters)),
+                          np.asarray(km.predict(clusters)))
+
+
+# ---------------------------------------------------------------------------
+# Serving routes (engine dispatch)
+# ---------------------------------------------------------------------------
+
+class TestServingRoutes:
+    @pytest.fixture(scope="class")
+    def engine(self, mesh8):
+        from kmeans_tpu.serving.engine import ServingEngine
+        return ServingEngine(mesh=mesh8, buckets=(64, 256),
+                             quality=False)
+
+    @pytest.fixture(scope="class")
+    def rows(self):
+        rng = np.random.default_rng(9)
+        return (rng.normal(size=(50, 16))
+                + 8.0 * rng.integers(0, 3, size=(50, 1)))
+
+    def test_pq_serving_matches_decoded_oracle(self, engine, mesh8,
+                                               clusters, rows):
+        km = KMeans(mesh=mesh8, **_fit_kw()).fit(clusters)
+        engine.add_model("pq-m", km, quantize="pq")
+        try:
+            labels = engine.call("pq-m", rows)
+            rm = engine._rm("pq-m")
+            oracle = _dense_argmin(rows, rm.pq.decode(rm.pq_codes))
+            assert np.array_equal(labels, oracle)
+            v = engine.verify_quantized("pq-m", rows)
+            assert "dist_max_rel" in v and v["label_mismatches"] >= 0
+            st = engine.stats()["models"]["pq-m"]
+            assert st["quantize"] == "pq"
+            assert "pq_corrected_rows" in st
+        finally:
+            engine.remove("pq-m")
+
+    def test_two_level_serving_matches_model_predict(self, engine,
+                                                     mesh8, clusters,
+                                                     rows):
+        km = KMeans(mesh=mesh8, assign="two_level", coarse_cells=4,
+                    nprobe=4, **_fit_kw()).fit(clusters)
+        engine.add_model("tl-m", km)
+        try:
+            labels = engine.call("tl-m", rows)
+            assert np.array_equal(labels, np.asarray(km.predict(rows)))
+            assert np.array_equal(labels,
+                                  _dense_argmin(rows, km.centroids))
+        finally:
+            engine.remove("tl-m")
+
+    def test_rejections(self, engine, mesh8, mesh4x2, clusters):
+        from kmeans_tpu.serving.engine import ServingEngine
+        km_tl = KMeans(mesh=mesh8, assign="two_level", coarse_cells=4,
+                       nprobe=4, **_fit_kw()).fit(clusters)
+        with pytest.raises(ValueError):
+            engine.add_model("bad", km_tl, quantize="bf16")
+        assert "bad" not in engine.models()
+        km = KMeans(mesh=mesh8, **_fit_kw()).fit(clusters)
+        with pytest.raises(ValueError, match="'pq'"):
+            engine.add_model("bad", km, quantize="int8")
+        eng_tp = ServingEngine(mesh=mesh4x2, buckets=(64,),
+                               quality=False)
+        km_tp = KMeans(mesh=mesh4x2, **_fit_kw(max_iter=5)).fit(clusters)
+        with pytest.raises(ValueError):
+            eng_tp.add_model("m", km_tp, quantize="pq")
+        with pytest.raises(ValueError):
+            eng_tp.add_model("m", km_tl)
+        assert eng_tp.models() == []
+
+
+# ---------------------------------------------------------------------------
+# Comm accounting + HBM planner
+# ---------------------------------------------------------------------------
+
+class TestCommAndPlanner:
+    def test_kshard_comm_sites(self):
+        from kmeans_tpu.obs.fleet import comm_bytes_model
+        dense = comm_bytes_model("kmeans", k=64, d=8, data_shards=4,
+                                 model_shards=2, n_chunks=4,
+                                 chunk_rows=128)
+        ksh = comm_bytes_model("kmeans", k=64, d=8, data_shards=4,
+                               model_shards=2, n_chunks=4,
+                               chunk_rows=128, k_shard=2)
+        dn = {s["site"] for s in dense["sites"]}
+        kn = {s["site"] for s in ksh["sites"]}
+        assert "tp.gather_centroid_table" in dn
+        assert "tp.gather_centroid_table" not in kn
+        assert "estep.pmin_assign_pair" in kn
+        assert "estep.pmin_assign_pair" not in dn
+        sums_d = next(s for s in dense["sites"]
+                      if s["site"] == "estep.psum_sums")
+        sums_k = next(s for s in ksh["sites"]
+                      if s["site"] == "estep.psum_sums")
+        # k-local accumulator rows: half the bytes over the DATA group
+        # only, instead of full k_pad over the whole mesh.
+        assert sums_k["result_bytes"] == sums_d["result_bytes"] / 2
+        assert sums_k["group"] == 4 and sums_d["group"] == 8
+        pair = next(s for s in ksh["sites"]
+                    if s["site"] == "estep.pmin_assign_pair")
+        assert pair["result_bytes"] == 128 * 8  # (f32 dist + i32 idx)/row
+        assert pair["count"] == 4 and pair["group"] == 2
+        assert ksh["k_shard"] == 2 and dense["k_shard"] == 0
+
+    def test_dp_comm_model_unchanged(self):
+        from kmeans_tpu.obs.fleet import comm_bytes_model
+        dp = comm_bytes_model("kmeans", k=64, d=8, data_shards=8)
+        assert {s["site"] for s in dp["sites"]} == {
+            "estep.psum_sums", "estep.psum_counts", "estep.psum_sse"}
+        assert dp["k_shard"] == 0
+
+    def test_plan_fit_kshard_shrinks_stats(self):
+        from kmeans_tpu.obs.memory import plan_fit
+        dense = plan_fit("kmeans", 1_000_000, 64, 16384, data_shards=4,
+                         model_shards=2, chunk=4096, k_shard=0)
+        ksh = plan_fit("kmeans", 1_000_000, 64, 16384, data_shards=4,
+                       model_shards=2, chunk=4096, k_shard=2)
+        assert ksh["components"]["stats_bytes"] \
+            < dense["components"]["stats_bytes"]
+        assert ksh["predicted_peak_bytes"] < dense["predicted_peak_bytes"]
+        assert ksh["k_shard"] == 2 and dense["k_shard"] == 0
+
+    def test_bucket_candidates_ladder(self):
+        from kmeans_tpu.parallel.sharding import bucket_candidates
+        assert bucket_candidates(1) == 32
+        assert bucket_candidates(32) == 32
+        widths = [bucket_candidates(n) for n in range(1, 4097)]
+        assert all(w >= n for n, w in enumerate(widths, start=1))
+        assert all(b >= a for a, b in zip(widths, widths[1:]))
+        assert len(set(widths)) < 32  # a bounded ladder, not one per n
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces
+# ---------------------------------------------------------------------------
+
+class TestCli:
+    def test_plan_json(self, capsys):
+        from kmeans_tpu.cli import plan_main
+        rc = plan_main(["--n", "1000000", "--d", "64", "--k", "16384",
+                        "--data-shards", "4", "--model-shards", "2",
+                        "--json"])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert len(doc["plans"]) == 2
+        res = doc["resolution"]
+        assert res["k_shard"] in (0, 2)
+        assert res["assign"] in ("dense", "two_level")
+
+    def test_plan_human_table(self, capsys):
+        from kmeans_tpu.cli import plan_main
+        rc = plan_main(["--n", "1000000", "--d", "64", "--k", "16384",
+                        "--data-shards", "4", "--model-shards", "2"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "hbm footprint plan" in out
+        assert "resolution" in out
+        assert "k-shard saves" in out
+
+    def test_plan_rejects_two_level_on_tp(self, capsys):
+        from kmeans_tpu.cli import plan_main
+        rc = plan_main(["--n", "1000", "--d", "8", "--k", "64",
+                        "--model-shards", "2", "--assign", "two_level"])
+        assert rc == 2
+
+    def test_plan_rejects_bad_kshard(self, capsys):
+        from kmeans_tpu.cli import plan_main
+        rc = plan_main(["--n", "1000", "--d", "8", "--k", "64",
+                        "--model-shards", "2", "--k-shard", "3"])
+        assert rc == 2
+
+    def test_ckpt_info_plan_block(self, tmp_path, mesh8, clusters,
+                                  capsys):
+        from kmeans_tpu.cli import ckpt_info_main
+        km = KMeans(mesh=mesh8, assign="two_level", coarse_cells=4,
+                    nprobe=4, **_fit_kw()).fit(clusters)
+        path = tmp_path / "ck.npz"
+        km.save(path)
+        rc = ckpt_info_main([str(path), "--json", "--plan-n", "50000"])
+        assert rc == 0
+        info = json.loads(capsys.readouterr().out)
+        plan = info["plan"]
+        assert plan is not None
+        assert plan["n_assumed"] == 50000
+        assert plan["k"] == 12 and plan["d"] == 16
+        # The checkpoint's own explicit knobs win over the auto rule.
+        assert plan["assign"] == "two_level"
+        assert plan["resolved_by"] == "checkpoint knobs"
+        assert len(plan["plans"]) >= 1
+
+    def test_bench_diff_discriminates_k(self):
+        """BENCH_LARGEK rows at different k must never be compared as
+        a regression pair — 'k' is a discriminator key."""
+        from kmeans_tpu.cli import _BENCH_DISCRIMINATORS
+        assert "k" in _BENCH_DISCRIMINATORS
+
+
+# ---------------------------------------------------------------------------
+# Bench harness (tiny shape; the published curve runs via
+# BENCH_LARGEK=1 python bench.py)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_bench_large_k_rows(monkeypatch):
+    from kmeans_tpu.benchmarks import bench_large_k
+    out = bench_large_k(2000, 8, (16,), iters=2, reps=1)
+    assert out["ks"] == [16]
+    row = out["rows"][0]
+    assert row["dense_ms_per_iter"] > 0
+    assert row["routed_ms_per_iter"] > 0
+    assert row["sse_rel_gap"] is not None
+    assert row["predicted_peak_bytes_dense"] > 0
+    assert "auto_resolution" in row

@@ -55,7 +55,7 @@ def test_registry_has_the_required_rules():
     meta-rule) are registered — the >= 6 acceptance bar."""
     assert {"trace-hazard", "cache-key", "dispatch", "thread",
             "counter-reset", "dead-private", "cache-name",
-            "aot-key"} <= set(RULES)
+            "aot-key", "large-k"} <= set(RULES)
     assert len(RULES) >= 6
     for rule in RULES.values():
         assert rule.id and rule.incident, rule
@@ -691,6 +691,85 @@ def test_aot_key_suppression_honored(tmp_path):
         "fixture exercising the corrupt-artifact path")
     findings = run_on(tmp_path, src, subdir="utils")
     assert [f for f in findings if f.rule == "aot-key"] == []
+
+
+# ---------------------------------------------------------------------------
+# large-k
+# ---------------------------------------------------------------------------
+
+_LARGE_K_BAD = """
+from kmeans_tpu.parallel import distributed as dist
+
+
+class Estimator:
+    def fit(self, pts, mesh, chunk):
+        step = dist.make_step_fn(mesh, chunk_size=chunk, mode="matmul")
+        return step(pts)
+"""
+
+_LARGE_K_OK_PLAN = """
+from kmeans_tpu.obs.memory import plan_fit
+from kmeans_tpu.parallel import distributed as dist
+
+
+class Estimator:
+    def fit(self, pts, mesh, chunk):
+        self.plan_ = plan_fit("kmeans", 10, 4, 8, chunk=chunk)
+        step = dist.make_step_fn(mesh, chunk_size=chunk, mode="matmul")
+        return step(pts)
+"""
+
+_LARGE_K_OK_DISPATCH = """
+from kmeans_tpu.parallel import distributed as dist
+
+
+class Server:
+    def predict(self, rm, pts, mesh, chunk):
+        if rm.spec.get("assign") == "two_level":
+            return self._route(rm, pts)
+        fn = dist.make_predict_fn(mesh, chunk_size=chunk)
+        return fn(pts)
+"""
+
+_LARGE_K_MODULE_LEVEL = """
+from kmeans_tpu.parallel import distributed as dist
+
+
+def bench_fit(mesh, chunk):
+    return dist.make_fit_fn(mesh, chunk_size=chunk, mode="matmul")
+"""
+
+
+def test_large_k_fires_on_unguarded_class(tmp_path):
+    findings = [f for f in run_on(tmp_path, _LARGE_K_BAD,
+                                  subdir="models")
+                if f.rule == "large-k"]
+    assert len(findings) == 1
+    assert "plan_fit" in findings[0].message
+    assert "Estimator" in findings[0].message
+
+
+def test_large_k_silent_on_planner_or_dispatch_guard(tmp_path):
+    for src in (_LARGE_K_OK_PLAN, _LARGE_K_OK_DISPATCH):
+        findings = run_on(tmp_path, src, subdir="models")
+        assert [f for f in findings if f.rule == "large-k"] == []
+
+
+def test_large_k_exempts_module_level_builders(tmp_path):
+    """Class granularity: module-level builder calls (benchmarks, the
+    builder layer) size their shapes deliberately."""
+    findings = run_on(tmp_path, _LARGE_K_MODULE_LEVEL, subdir="models")
+    assert [f for f in findings if f.rule == "large-k"] == []
+
+
+def test_large_k_suppression_honored(tmp_path):
+    src = _LARGE_K_BAD.replace(
+        "step = dist.make_step_fn(mesh, chunk_size=chunk, "
+        "mode=\"matmul\")",
+        "step = dist.make_step_fn(mesh, chunk_size=chunk, "
+        "mode=\"matmul\")  # lint: ok(large-k) — test fixture")
+    findings = run_on(tmp_path, src, subdir="models")
+    assert [f for f in findings if f.rule == "large-k"] == []
 
 
 # ---------------------------------------------------------------------------
