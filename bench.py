@@ -124,6 +124,18 @@ dispatches -> 'auto' = off on CPU, on on accelerators; hardware row
 pinned).  Env: BENCH_N/_D/_K, BENCH_QUALITY_BATCH (rows per dispatch,
 default 512).
 
+BENCH_FLEET=1 switches to the SERVING-FLEET rows (ISSUE 17): router
+overhead at R=1 (committed <= 1.05 routed/direct rule), the open-loop
+(coordinated-omission-free) 1->N replica QPS/p99 scaling curve at a
+committed offered rate with failed==0 asserted every rep, shed rate
+at the committed admission bound (served + shed == offered asserted —
+zero silent drops), and add_replica prewarm cost vs the initial
+warmup (``kmeans_tpu.benchmarks.bench_fleet``).  On this CPU
+container in-process replicas share one backend so QPS(R) is flat by
+construction — the published property is replication-adds-no-loss;
+real scaling is a hardware row.  Env: BENCH_N/_D/_K,
+BENCH_FLEET_REPLICAS (comma list, default "1,2").
+
 BENCH_COST=1 switches to the DEVICE-COST OBSERVABILITY rows (ISSUE 12):
 analytic-vs-XLA-reported FLOPs and predicted-vs-observed peak-memory
 comparisons for the kmeans and gmm-diag step programs, captured
@@ -342,6 +354,22 @@ def main() -> None:
         log(f"bench: QUALITY mode backend={backend} N={qn} D={qd} "
             f"k={qk} batch={qb}")
         bench_quality(qn, qd, qk, batch=qb)
+        return
+
+    if os.environ.get("BENCH_FLEET"):
+        # Serving-fleet rows (ISSUE 17): router overhead at R=1, the
+        # open-loop 1->N replica QPS/p99 scaling curve, shed rate at
+        # the committed admission bound, and replica prewarm cost.
+        from kmeans_tpu.benchmarks import bench_fleet
+        fn_ = int(os.environ.get("BENCH_N",
+                                 2_000_000 if on_accel else 200_000))
+        fd = int(os.environ.get("BENCH_D", 128 if on_accel else 32))
+        fk = int(os.environ.get("BENCH_K", 1024 if on_accel else 64))
+        fr = tuple(int(v) for v in os.environ.get(
+            "BENCH_FLEET_REPLICAS", "1,2").split(","))
+        log(f"bench: FLEET mode backend={backend} N={fn_} D={fd} "
+            f"k={fk} replicas={fr}")
+        bench_fleet(fn_, fd, fk, replicas=fr)
         return
 
     if os.environ.get("BENCH_COST"):

@@ -65,14 +65,38 @@ DECISION RULES (committed now, measured per platform):
   rejection: the queue cannot absorb its own calibration traffic and
   its defaults must be re-tuned for that platform.
 
+FLEET (``SERVE_MODE=fleet``, ISSUE 17): the open-loop harness pointed
+at a :class:`ServingFleet` — N replica engines behind the SLO-aware
+router — producing the published 1->N replica QPS/p99 scaling curve.
+Per replica count R in ``SERVE_REPLICAS`` a FRESH fleet (same fitted
+model object, shared mesh) runs the same coordinated-omission-free
+open-loop level at a committed offered rate (0.5x a single-engine
+closed-loop calibration at 64 clients), and the verdict applies the
+PRE-COMMITTED rule: every R must sustain the committed rate (failed ==
+0, p99 from scheduled arrival <= the r11 bound, drain <= bound), and
+QPS(R) >= 0.8 x QPS(1) — replication through the router must not cost
+more than 20% of single-replica throughput.  On this CPU container the
+in-process replicas share one backend so the curve is FLAT by
+construction (the property measured is "replication adds no loss");
+near-linear QPS(R) needs one device set per replica — hardware row
+pinned (docs/PERFORMANCE.md).  ``SERVE_CHAOS=1`` appends the
+kill-a-replica run: an R=2 fleet serving the committed rate has one
+replica killed mid-run (``utils.faults.inject_replica_kill`` — the
+dispatch guard refuses the in-flight queued batch, the queue's
+per-member isolation fails each member, the router re-dispatches on
+the survivor), asserting ZERO failed requests and a bounded p99
+excursion (chaos p99 <= 5x the no-chaos p99 at the same rate and R).
+
 Run:  python experiments/exp_serving_load.py
 Env:  SERVE_N / SERVE_D / SERVE_K (model shape), SERVE_CLIENTS
       (comma list, default 1,8,64,512), SERVE_REQS (per client,
       default 64), SERVE_WAIT_MS (default 2.0),
-      SERVE_MODE (closed|open, default closed), SERVE_RATES (comma
-      list of offered QPS; default auto-calibrated), SERVE_OPEN_REQS
-      (requests per rate, default 512), SERVE_SWEEP (1 = pick k via
-      KMeans.sweep over SERVE_SWEEP_KRANGE, default '4:65:4').
+      SERVE_MODE (closed|open|fleet, default closed), SERVE_RATES
+      (comma list of offered QPS; default auto-calibrated),
+      SERVE_OPEN_REQS (requests per rate, default 512), SERVE_SWEEP
+      (1 = pick k via KMeans.sweep over SERVE_SWEEP_KRANGE, default
+      '4:65:4'), SERVE_REPLICAS (comma list, default 1,2),
+      SERVE_CHAOS (1 = append the kill-a-replica run).
 """
 
 import json
@@ -303,6 +327,136 @@ def open_loop_sweep(engine, pool, wait_ms: float):
     return rows
 
 
+def fleet_scaling(model, pool, wait_ms: float, replicas_list, *,
+                  chaos: bool):
+    """The 1->N replica scaling curve + the pre-committed verdict, and
+    optionally the kill-a-replica chaos run (module docstring)."""
+    from kmeans_tpu.obs import metrics_registry as obs_metrics
+    from kmeans_tpu.parallel.mesh import make_mesh
+    from kmeans_tpu.serving import ServingFleet
+    from kmeans_tpu.utils.faults import inject_replica_kill
+
+    n_open = int(os.environ.get("SERVE_OPEN_REQS", 512))
+    reqs = int(os.environ.get("SERVE_REQS", 64))
+    mesh = make_mesh()
+
+    # Committed offered rate: 0.5x a single-ENGINE closed-loop
+    # calibration at 64 clients (the r12 rule's operating point), so
+    # every fleet size is judged against the same absolute traffic.
+    cal_engine = ServingEngine(mesh=mesh, max_wait_ms=wait_ms,
+                               quality=False)
+    cal_engine.add_model("serve", model)
+    cal_engine.warmup()
+    for _ in range(8):
+        cal_engine.predict("serve", pool[:1])
+    t0 = time.perf_counter()
+    n_direct = 64
+    for i in range(n_direct):
+        cal_engine.predict("serve", pool[i % pool.shape[0]][None, :])
+    direct_s = (time.perf_counter() - t0) / n_direct
+    p99_bound_ms = wait_ms + 10 * direct_s * 1e3
+    cal = run_level(cal_engine, pool, clients=64, reqs=reqs)
+    cal_engine.close()
+    rate = round(0.5 * cal["qps"], 1)
+    print(json.dumps({"mode": "fleet-calibration", "rate_qps": rate,
+                      "p99_bound_ms": round(p99_bound_ms, 3), **cal}),
+          flush=True)
+
+    rows = []
+    for R in replicas_list:
+        # Fresh routing state per level: the fleet's latency
+        # histograms live in the process-wide registry under
+        # replica-name keys, so a previous level's estimates would
+        # otherwise pre-warm this one's router.
+        obs_metrics.REGISTRY.reset()
+        fleet = ServingFleet(R, mesh=mesh, max_wait_ms=wait_ms,
+                             quality=False)
+        fleet.add_model("serve", model)
+        fleet.warmup()
+        n_warm = min(128, n_open, max(8, int(2.0 * rate)))
+        run_open_loop(fleet, pool, rate, n_warm)   # thread warm-up
+        row = run_open_loop(fleet, pool, rate, n_open)
+        st = fleet.stats()
+        row.update({
+            "mode": "fleet", "replicas": R,
+            "routes": st["routes"], "sheds": st["sheds"],
+            "redispatches": st["redispatches"],
+            "sustained": bool(row["failed"] == 0
+                              and row["p99_ms"] is not None
+                              and row["p99_ms"] <= p99_bound_ms
+                              and row["drain_ms"] <= p99_bound_ms),
+        })
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+        fleet.close()
+
+    base = rows[0]
+    scaling_ok = all(r["achieved_qps"] >= 0.8 * base["achieved_qps"]
+                     for r in rows)
+    all_sustained = all(r["sustained"] for r in rows)
+    verdict = {
+        "mode": "fleet", "rate_qps": rate,
+        "p99_bound_ms": round(p99_bound_ms, 3),
+        "replicas": list(replicas_list),
+        "qps_curve": [r["achieved_qps"] for r in rows],
+        "p99_curve": [r["p99_ms"] for r in rows],
+        "passed": bool(all_sustained and scaling_ok),
+        "decision": (
+            "fleet sustains the committed rate at every replica count "
+            "and replication costs < 20% throughput"
+            if all_sustained and scaling_ok else
+            "REJECTION: " +
+            ("a replica count failed to sustain the committed rate"
+             if not all_sustained else
+             "replication through the router costs >= 20% throughput")),
+        "note": "in-process replicas share one backend on CPU — flat "
+                "QPS(R) is the expected curve here; near-linear "
+                "scaling needs one device set per replica (hardware "
+                "row pinned)",
+    }
+    print(json.dumps(verdict), flush=True)
+
+    if not chaos:
+        return rows
+
+    # Kill-a-replica chaos run (the ISSUE 17 acceptance pin): R=2 at
+    # the committed rate, one replica killed after a quarter of the
+    # traffic has dispatched; the router must finish the level with
+    # ZERO failed requests and a bounded p99 excursion.
+    no_chaos_p99 = rows[-1]["p99_ms"] if rows else None
+    obs_metrics.REGISTRY.reset()
+    fleet = ServingFleet(2, mesh=mesh, max_wait_ms=wait_ms,
+                         quality=False)
+    fleet.add_model("serve", model)
+    fleet.warmup()
+    # Threshold in engine-dispatch (coalesced batch) units, fleet-wide.
+    # At the committed rate the queue coalesces deeply (measured 12-55
+    # rows/dispatch here), so a whole level is only ~n/12 dispatches;
+    # arm after 4 so the kill always lands with queued work in flight.
+    with inject_replica_kill(fleet, after_dispatches=4) as rec:
+        row = run_open_loop(fleet, pool, rate, n_open)
+    st = fleet.stats()
+    excursion_ok = (no_chaos_p99 is None or row["p99_ms"] is None
+                    or row["p99_ms"] <= 5 * no_chaos_p99)
+    chaos_row = {
+        "mode": "fleet-chaos", "replicas": 2,
+        "killed_replica": rec["replica"], "kill_fired": rec["killed"],
+        "failed": row["failed"], "p99_ms": row["p99_ms"],
+        "no_chaos_p99_ms": no_chaos_p99,
+        "redispatches": st["redispatches"],
+        "n_serving_after": st["n_serving"],
+        "zero_failed": bool(row["failed"] == 0),
+        "p99_excursion_bounded": bool(excursion_ok),
+        "passed": bool(row["failed"] == 0 and rec["killed"]
+                       and excursion_ok),
+    }
+    print(json.dumps(chaos_row), flush=True)
+    fleet.close()
+    assert chaos_row["passed"], \
+        f"chaos run failed the committed rule: {chaos_row}"
+    return rows
+
+
 def main():
     backend = jax.default_backend()
     on_accel = backend not in ("cpu",)
@@ -341,6 +495,14 @@ def main():
           f"{len(jax.devices())} model k={k} d={d} (fit on {n:,} rows), "
           f"{reqs} reqs/client, max_wait_ms={wait_ms}, mode={mode}",
           file=sys.stderr)
+
+    if mode == "fleet":
+        replicas_list = [int(r) for r in os.environ.get(
+            "SERVE_REPLICAS", "1,2").split(",")]
+        fleet_scaling(model, pool, wait_ms, replicas_list,
+                      chaos=os.environ.get("SERVE_CHAOS", "") == "1")
+        return
+
     engine = ServingEngine(max_wait_ms=wait_ms)
     engine.add_model("serve", model)
     engine.warmup()

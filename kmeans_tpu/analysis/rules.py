@@ -625,6 +625,80 @@ class QualityCounterRule(ObsSpanRule):
         return False
 
 
+# -------------------------------------------------------- fleet-record
+
+class FleetRecordRule(QualityCounterRule):
+    """ISSUE 17 member of the quality-counter lint family: in
+    ``serving/``, a driver-level function that FORWARDS a request to a
+    replica engine (``<...>.engine.call/submit/score/predict/
+    predict_multi(...)``) or SHEDS one (``raise FleetOverloadError``)
+    must record the decision in the metrics registry — call the
+    fleet's ``_record_route``/``_record_shed`` write-throughs.  The
+    router's routing and admission decisions ARE the SLO signal
+    (``fleet.route``/``fleet.shed`` counters, the scaling-curve
+    denominators); a future routing path that forwards or sheds
+    without recording silently starves that signal exactly the way
+    unrecorded dispatch paths used to starve the r14 counters."""
+
+    id = "fleet-record"
+    incident = ("ISSUE 17: a fleet routing path that forwards or sheds "
+                "without recording — the router twin of the "
+                "dispatch-counter class")
+
+    _FEEDS = {"_record_route", "_record_shed"}
+    _FORWARD_LEAVES = {"call", "submit", "score", "predict",
+                       "predict_multi"}
+
+    def run(self, pkg: Package) -> Iterator[Finding]:
+        for mod in pkg:
+            p = mod.rel.replace("\\", "/")
+            if "/serving/" not in p:
+                continue
+            parents = mod.parents()
+            for fn in ast.walk(mod.tree):
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                # Driver-level only (the obs-span convention): nested
+                # closures are checked through the enclosing driver.
+                if not isinstance(parents.get(fn),
+                                  (ast.Module, ast.ClassDef)):
+                    continue
+                sites = self._routing_sites(fn)
+                if not sites:
+                    continue
+                if self._feeds_monitor(fn):
+                    continue
+                yield self.finding(
+                    mod, sites[0],
+                    f"{fn.name}() forwards or sheds fleet traffic but "
+                    f"never records it — call _record_route(...) / "
+                    f"_record_shed(...) so the routing decision lands "
+                    f"in the fleet.route/fleet.shed counters (the SLO "
+                    f"signal)")
+
+    @classmethod
+    def _routing_sites(cls, fn) -> List[int]:
+        """Lines where ``fn`` makes a routing decision: forwards a
+        request into a replica's engine (a call through an ``engine``
+        attribute with a dispatch-surface leaf) or sheds one (raises
+        ``FleetOverloadError``)."""
+        lines: List[int] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                path = (dotted(node.func) or "").split(".")
+                if len(path) >= 2 and path[-2] == "engine" \
+                        and path[-1] in cls._FORWARD_LEAVES:
+                    lines.append(node.lineno)
+            elif isinstance(node, ast.Raise) and node.exc is not None:
+                exc = node.exc
+                name = exc.func if isinstance(exc, ast.Call) else exc
+                if (dotted(name) or "").split(".")[-1] \
+                        == "FleetOverloadError":
+                    lines.append(node.lineno)
+        return lines
+
+
 # ------------------------------------------------------------ threads
 
 class ThreadHygieneRule(Rule):
@@ -1056,7 +1130,8 @@ class SuppressionFormatRule(Rule):
 RULES: Dict[str, Rule] = {rule.id: rule for rule in (
     TraceHazardRule(), CacheKeyRule(), DispatchAccountingRule(),
     ObsSpanRule(), CollectiveSpanRule(), QualityCounterRule(),
-    ThreadHygieneRule(), CounterResetRule(), DeadPrivateRule(),
+    FleetRecordRule(), ThreadHygieneRule(), CounterResetRule(),
+    DeadPrivateRule(),
     CacheNameRule(), AotKeyRule(), LargeKRule(),
     SuppressionFormatRule(),
 )}

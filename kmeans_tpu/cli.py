@@ -299,6 +299,15 @@ def serve_main(argv=None) -> int:
       snapshot (ISSUE 14: detector readings, debounced state, event
       counts; ``--quality-dir`` additionally streams the per-model
       JSONL sinks ``serve-status`` reads).
+    * ``{"fleet_stats": true}`` — with ``--replicas N`` (ISSUE 17:
+      in-process :class:`ServingFleet` — N replica engines behind the
+      SLO-aware router), reply with the fleet snapshot (per-replica
+      liveness/load, placement, route/shed counters); an error line
+      under a single engine.  ``--quality-dir`` doubles as the fleet
+      directory (per-replica quality + heartbeat sinks — the
+      ``serve-status``/``fleet-status`` input), and ``--slo-p99-ms``
+      commits the admission bound (shed requests error THEIR line,
+      explicitly).
 
     A malformed/poisoned request errors ITS line
     (``{"error": ...}``) and the loop keeps serving.  On EOF the
@@ -327,6 +336,16 @@ def serve_main(argv=None) -> int:
                         help="request-batch bucket ladder")
     parser.add_argument("--no-warmup", action="store_true",
                         help="skip pre-compiling the bucket shapes")
+    parser.add_argument("--replicas", type=int, default=1, metavar="N",
+                        help="serve through an in-process fleet of N "
+                             "replica engines behind the SLO-aware "
+                             "router (default 1: a single engine)")
+    parser.add_argument("--slo-p99-ms", type=float, default=None,
+                        metavar="MS",
+                        help="committed p99 latency bound for fleet "
+                             "admission control (requests shed at the "
+                             "bound error their line explicitly; "
+                             "requires --replicas >= 1 fleet mode)")
     parser.add_argument("--quality-dir", default=None, metavar="DIR",
                         help="write per-model drift JSONL sinks "
                              "(quality.<id>.jsonl) here — the "
@@ -344,24 +363,39 @@ def serve_main(argv=None) -> int:
                              "on stdout")
     args = parser.parse_args(argv)
 
-    from kmeans_tpu.serving import ServingEngine
+    from kmeans_tpu.serving import ServingEngine, ServingFleet
     ids = args.ids or []
     if len(ids) > len(args.models):
         print("error: more --id flags than --model flags",
               file=sys.stderr)
+        return 2
+    if args.replicas < 1:
+        print("error: --replicas must be >= 1", file=sys.stderr)
         return 2
     buckets = tuple(int(b) for b in args.buckets.split(","))
     if args.quality and args.no_quality:
         print("error: --quality and --no-quality are mutually "
               "exclusive", file=sys.stderr)
         return 2
-    engine = ServingEngine(buckets=buckets,
-                           max_wait_ms=args.max_wait_ms,
-                           quality=(False if args.no_quality
-                                    else True if args.quality
-                                    else "auto"),
-                           quality_dir=(None if args.no_quality
-                                        else args.quality_dir))
+    quality = (False if args.no_quality else True if args.quality
+               else "auto")
+    fleet_mode = args.replicas > 1 or args.slo_p99_ms is not None
+    if fleet_mode:
+        engine = ServingFleet(
+            args.replicas, buckets=buckets,
+            max_wait_ms=args.max_wait_ms, quality=quality,
+            fleet_dir=(None if args.no_quality else args.quality_dir),
+            slo_p99_ms=args.slo_p99_ms)
+        print(f"serve: fleet of {args.replicas} replicas"
+              + (f", SLO p99 <= {args.slo_p99_ms} ms"
+                 if args.slo_p99_ms is not None else ""),
+              file=sys.stderr)
+    else:
+        engine = ServingEngine(buckets=buckets,
+                               max_wait_ms=args.max_wait_ms,
+                               quality=quality,
+                               quality_dir=(None if args.no_quality
+                                            else args.quality_dir))
     try:
         for i, path in enumerate(args.models):
             mid = ids[i] if i < len(ids) else None
@@ -379,6 +413,10 @@ def serve_main(argv=None) -> int:
         if not args.no_warmup:
             n = engine.warmup()
             print(f"serve: warmed {n} bucket shapes", file=sys.stderr)
+        elif fleet_mode:
+            # Replicas take traffic only in state 'serving': open the
+            # fleet without pre-compiling (the --no-warmup contract).
+            engine.warmup(prewarm=False)
         default_model = engine.models()[0] \
             if len(engine.models()) == 1 else None
 
@@ -394,6 +432,13 @@ def serve_main(argv=None) -> int:
                 if req.get("quality"):
                     print(json.dumps(engine.quality_status()),
                           flush=True)
+                    continue
+                if req.get("fleet_stats"):
+                    if not fleet_mode:
+                        raise ValueError(
+                            "fleet_stats requires --replicas N fleet "
+                            "mode (a single engine has no fleet)")
+                    print(json.dumps(engine.stats()), flush=True)
                     continue
                 model_id = req.get("model", default_model)
                 if model_id is None:
@@ -422,9 +467,13 @@ def serve_main(argv=None) -> int:
     else:
         st = engine.stats()
         n_req = sum(m["requests"] for m in st["models"].values())
-        print(f"serve: done — {st['models_resident']} models, "
+        n_models = st.get("models_resident", len(st["models"]))
+        print(f"serve: done — {n_models} models, "
               f"{n_req} requests, "
-              f"{st['dispatches']} dispatches", file=sys.stderr)
+              f"{st['dispatches']} dispatches"
+              + (f" across {st['n_replicas']} replicas "
+                 f"({st['routes']} routed, {st['sheds']} shed)"
+                 if fleet_mode else ""), file=sys.stderr)
     return 0
 
 
@@ -883,8 +932,10 @@ _BENCH_DEFAULT_SPREAD = 0.05
 #: to the occurrence index (append-only artifacts keep occurrence
 #: order stable, so old/new keys still align).
 #: "k" discriminates the BENCH_LARGEK k-sweep rows (ISSUE 16: one row
-#: per table size under a shared method label).
-_BENCH_DISCRIMINATORS = ("batch_requests", "batch", "clients", "k")
+#: per table size under a shared method label); "replicas" the
+#: BENCH_FLEET 1->N scaling rows (ISSUE 17).
+_BENCH_DISCRIMINATORS = ("batch_requests", "batch", "clients", "k",
+                         "replicas")
 
 
 def _ttfi_trace_rows(records) -> list:

@@ -12,19 +12,26 @@ Entry points:
   (``serving.batching``).
 * :class:`ModelRegistry` — multi-model residency + checkpoint loading
   + same-shape pack groups (``serving.registry``).
+* :class:`ServingFleet` — N replicated engines behind an SLO-aware
+  router with admission control, load shedding, and pack-group-aware
+  placement (``serving.fleet``, ISSUE 17).
 
 CLI: ``python -m kmeans_tpu serve --model <ckpt> [--model <ckpt> ...]``
-(stdin/JSONL request loop, no network dependency).  Benchmarks:
-``BENCH_SERVE=1 python bench.py`` and
-``experiments/exp_serving_load.py``.
+(stdin/JSONL request loop, no network dependency; ``--replicas N``
+serves through an in-process fleet).  Benchmarks:
+``BENCH_SERVE=1 python bench.py``, ``BENCH_FLEET=1 python bench.py``
+and ``experiments/exp_serving_load.py``.
 """
 
 from kmeans_tpu.serving.batching import (MicroBatchQueue,
                                          ServingClosedError,
                                          ServingFuture)
 from kmeans_tpu.serving.engine import ResidentModel, ServingEngine
+from kmeans_tpu.serving.fleet import (FleetFuture, FleetOverloadError,
+                                      ReplicaDeadError, ServingFleet)
 from kmeans_tpu.serving.registry import ModelRegistry, load_fitted
 
 __all__ = ["ServingEngine", "ResidentModel", "MicroBatchQueue",
            "ServingFuture", "ServingClosedError", "ModelRegistry",
-           "load_fitted"]
+           "load_fitted", "ServingFleet", "FleetFuture",
+           "FleetOverloadError", "ReplicaDeadError"]

@@ -204,12 +204,18 @@ class ServingEngine:
         None (default) keeps monitoring in-memory only.
     quality_window : rows per drift-evaluation window
         (:data:`~kmeans_tpu.obs.drift.DRIFT_WINDOW_ROWS` default).
+    quality_tag : suffix for the per-model quality sink filenames
+        (``quality.<model_id>.<tag>.jsonl``) so N fleet replicas
+        (ISSUE 17) sharing one ``quality_dir`` keep distinct sinks —
+        the ``serve-status`` multi-file reader merges them per model.
+        None (default) keeps the documented single-engine name.
     """
 
     def __init__(self, *, mesh=None, buckets=DEFAULT_BUCKETS,
                  max_wait_ms: float = 2.0, clock=None, start: bool = True,
                  donate="auto", quality="auto", quality_dir=None,
-                 quality_window: Optional[int] = None):
+                 quality_window: Optional[int] = None,
+                 quality_tag: Optional[str] = None):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.buckets = check_buckets(buckets)
         self.registry = ModelRegistry()
@@ -243,6 +249,15 @@ class ServingEngine:
             else None
         self._quality_window = int(quality_window) \
             if quality_window is not None else obs_drift.DRIFT_WINDOW_ROWS
+        self._quality_tag = str(quality_tag) if quality_tag is not None \
+            else None
+        # Fleet glue (ISSUE 17): an optional pre-dispatch hook, called
+        # with (model_id, op) before EVERY dispatch — direct, queued,
+        # and packed.  The fleet's replica wrapper raises
+        # ReplicaDeadError here when the replica is killed, so queued
+        # batches fail through the queue's existing per-member
+        # isolation and the router can re-dispatch each request.
+        self.dispatch_guard = None
         self.dispatches = 0
         self.packed_dispatches = 0
         self.queue = MicroBatchQueue(
@@ -314,8 +329,10 @@ class ServingEngine:
             if profile is None:
                 qp = getattr(model, "quality_profile", None)
                 profile = qp() if callable(qp) else None
-            sink = os.path.join(self._quality_dir,
-                                f"quality.{model_id}.jsonl") \
+            sink_name = f"quality.{model_id}.jsonl" \
+                if self._quality_tag is None \
+                else f"quality.{model_id}.{self._quality_tag}.jsonl"
+            sink = os.path.join(self._quality_dir, sink_name) \
                 if self._quality_dir is not None else None
             rm.monitor = obs_drift.QualityMonitor(
                 model_id, spec["k"], profile=profile,
@@ -480,6 +497,9 @@ class ServingEngine:
     def _dispatch(self, model_id, op: str, rows: np.ndarray) -> np.ndarray:
         """One coalesced batch -> per-row result array (axis 0 aligned
         with ``rows``; the queue slices per request)."""
+        guard = self.dispatch_guard
+        if guard is not None:
+            guard(model_id, op)
         rm = self._rm(model_id)
         if rm.spec["family"] == "gmm":
             return self._dispatch_gmm(rm, op, rows)
@@ -767,6 +787,9 @@ class ServingEngine:
                          ) -> List[np.ndarray]:
         """One batched-model dispatch over every item's rows; returns
         per-item label arrays (item order preserved)."""
+        guard = self.dispatch_guard
+        if guard is not None:
+            guard(tuple(ids), "predict_multi")
         ids = tuple(ids)
         slot = {mid: j for j, mid in enumerate(ids)}
         rms = {mid: self._rm(mid) for mid in ids}

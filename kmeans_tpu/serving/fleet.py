@@ -1,0 +1,866 @@
+"""Multi-tenant serving fleet: replicated engines behind an SLO-aware
+router (ISSUE 17 tentpole).
+
+One :class:`~kmeans_tpu.serving.engine.ServingEngine` on one mesh was
+the serving ceiling; production traffic wants N replicas behind a
+router (ROADMAP item 5: "traffic scale, not just dispatch speed").
+:class:`ServingFleet` composes the existing parts into that tier:
+
+* **Replicated engines.**  N engine replicas over one process's mesh
+  (the CPU/CI form the tests pin; a multi-host deployment runs one
+  fleet worker per host and aggregates through the per-replica sinks
+  below).  Replicas share the fitted model OBJECTS, so the ``_cents_dev``
+  placement caches and the ``_STEP_CACHE`` compiled programs are shared
+  — replication costs bookkeeping, not recompiles, and fleet labels are
+  bit-equal to a single engine's by construction
+  (tests/test_fleet_serving.py pins every dispatch path).
+* **SLO-aware routing.**  The router keeps per-(replica, model, bucket)
+  latency histograms in the r18 metrics registry
+  (``fleet.latency_ms.<replica>.<model>.b<bucket>``) fed with every
+  routed request's measured latency, and routes each request to the
+  replica with the LEAST EXPECTED LATENCY — ``(inflight + 1) * p50`` —
+  once every candidate's histogram is warm (``MIN_ROUTE_SAMPLES``
+  observations).  While any candidate is cold it falls back to a
+  deterministic power-of-two-choices rule: two candidates from a
+  rotating counter, fewer in-flight requests wins (ties -> lower
+  replica index) — deterministic so the shed/routing tests need no RNG
+  seeds.
+* **Admission control + load shedding.**  With a committed p99 bound
+  (``slo_p99_ms``) the router sheds a request when every candidate's
+  expected completion ``(inflight + 1) * p99`` would breach the bound
+  (cold candidates admit — shedding is never justified without data),
+  and with ``max_inflight`` when every candidate is at the depth limit.
+  A shed is EXPLICIT: :class:`FleetOverloadError` to the caller,
+  ``fleet.shed`` / ``fleet.shed.<model>`` counters in the registry —
+  never a silent drop (the ``fleet-record`` lint rule statically
+  requires every forward/shed site to record).
+* **Pack-group-aware placement.**  With partial replication
+  (``replication < n_replicas``) a model lands on the least-loaded
+  replicas, EXCEPT that members of an existing pack group
+  (same-(k, D, dtype), r11) co-reside with their group so
+  ``predict_multi`` stays one packed dispatch fleet-wide.
+* **Replica lifecycle.**  A replica takes traffic only in state
+  ``'serving'`` — reached through ``warmup()``, which pre-compiles the
+  bucket shapes (under an active r19 AOT store this loads executables
+  from the shared ``<ckpt>.aot`` mirror instead of compiling, so
+  ``add_replica`` on a warm cache is near-free — the BENCH_FLEET
+  prewarm row).  Each replica appends heartbeat records
+  (``hb.<replica>.jsonl``, r17 schema) to the fleet directory;
+  ``fleet-status`` renders them per replica, and :meth:`reap` declares
+  a replica dead when it holds in-flight work but has not completed a
+  dispatch within the stall window (``DEAD_AFTER_FACTOR`` heartbeat
+  intervals, min ``DEAD_MIN_S``).  A dead (or chaos-killed) replica's
+  queued requests fail through the engine ``dispatch_guard`` ->
+  micro-batch queue per-member isolation, and the router re-dispatches
+  each one on a surviving replica (``fleet.redispatch`` counter) — the
+  kill-a-replica chaos run pins zero failed requests.
+
+Sinks: ``fleet_dir`` holds per-replica quality sinks
+(``quality.<model>.<replica>.jsonl`` — the engine's ``quality_tag``
+glue) and heartbeats; ``serve-status <dir>`` merges drift state per
+model across replicas, ``fleet-status <dir>`` shows per-replica
+liveness — both existing multi-file readers, unchanged exit codes.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kmeans_tpu.obs import metrics_registry as obs_metrics
+from kmeans_tpu.parallel.mesh import make_mesh
+from kmeans_tpu.serving.batching import (DEFAULT_BUCKETS, ServingFuture,
+                                         bucket_for, check_buckets)
+from kmeans_tpu.serving.engine import ServingEngine
+from kmeans_tpu.serving.registry import ModelRegistry, load_fitted
+
+__all__ = ["ServingFleet", "FleetFuture", "FleetOverloadError",
+           "ReplicaDeadError", "MIN_ROUTE_SAMPLES", "DEAD_AFTER_FACTOR",
+           "DEAD_MIN_S"]
+
+#: Histogram observations before a (replica, model, bucket) latency
+#: estimate is trusted for least-expected-latency routing; below this
+#: the router uses the deterministic power-of-two-choices fallback.
+MIN_ROUTE_SAMPLES = 8
+
+#: Routed requests between percentile refreshes per (replica, model,
+#: bucket).  ``Histogram.percentile`` sorts its reservoir (<= 512
+#: samples) on every call; recomputing p50/p99 per routed request made
+#: the router's hot path O(reservoir log reservoir) and dominated the
+#: measured BENCH_FLEET overhead on sub-ms CPU dispatches.  Routing on
+#: estimates up to 32 observations stale is harmless — the queue-depth
+#: term ``(inflight + 1)`` tracks the fast signal; percentiles are the
+#: slow one.
+ROUTE_REFRESH = 32
+
+#: A replica holding in-flight work with no completed dispatch for
+#: ``DEAD_AFTER_FACTOR`` heartbeat intervals (but at least
+#: ``DEAD_MIN_S`` seconds) is declared dead by :meth:`ServingFleet.reap`
+#: — the straggler-stall rule (obs.fleet) applied to serving liveness.
+DEAD_AFTER_FACTOR = 3.0
+DEAD_MIN_S = 1.0
+
+
+class FleetOverloadError(RuntimeError):
+    """The explicit shed response (ISSUE 17 admission control): the
+    committed p99 bound (or the in-flight depth limit) would be
+    breached on every candidate replica, so the request is REFUSED
+    up front rather than queued into a bound violation.  Counted in
+    the registry (``fleet.shed`` / ``fleet.shed.<model>``) — never a
+    silent drop."""
+
+
+class ReplicaDeadError(RuntimeError):
+    """A dispatch was refused because its target replica is dead
+    (killed by chaos injection or reaped on heartbeat stall).  Raised
+    by the engine's ``dispatch_guard``; the router catches it and
+    re-dispatches the request on a surviving replica."""
+
+
+class _Replica:
+    """One engine replica: the engine + router-side state (liveness,
+    in-flight count, heartbeat sink)."""
+
+    def __init__(self, name: str, index: int, engine: ServingEngine,
+                 hb_path: Optional[str], hb_interval_s: float):
+        self.name = name
+        self.index = index
+        self.engine = engine
+        self.state = "warming"            # 'warming' | 'serving' | 'dead'
+        self.killed = False
+        self.inflight = 0
+        self.models: set = set()
+        self.prewarm_s: Optional[float] = None
+        # Chaos injection point (utils.faults.inject_replica_kill):
+        # called with (replica, model_id, op) before the killed check.
+        self.fault_hook = None
+        # Router-clock time of the last COMPLETED dispatch (the reap
+        # signal); wall-clock bookkeeping for the heartbeat sink.
+        self.last_beat: Optional[float] = None
+        self._hb_path = hb_path
+        self._hb_interval = float(hb_interval_s)
+        self._hb_wall_last: Optional[float] = None
+        self._hb_rows = 0
+        engine.dispatch_guard = self._guard
+
+    def _guard(self, model_id, op: str) -> None:
+        """Engine pre-dispatch hook: chaos first, then liveness — a
+        killed replica refuses EVERY dispatch (direct, queued batch,
+        packed), so queued requests fail through the micro-batch
+        queue's per-member isolation and the router re-dispatches
+        them."""
+        hook = self.fault_hook
+        if hook is not None:
+            hook(self, model_id, op)
+        if self.killed:
+            raise ReplicaDeadError(
+                f"replica {self.name!r} is dead (dispatch refused)")
+
+    def beat(self, *, rows: int = 0, force: bool = False) -> None:
+        """Append one heartbeat record (r17 schema: ``ts`` + identity +
+        progress) to this replica's sink, rate-limited to the fleet's
+        heartbeat interval.  ``iteration`` carries the engine dispatch
+        count and ``rows_per_sec`` the recent serving throughput, so
+        ``fleet-status`` renders progress and liveness per replica."""
+        self._hb_rows += rows
+        if self._hb_path is None:
+            return
+        now = time.time()
+        if not force and self._hb_wall_last is not None \
+                and now - self._hb_wall_last < self._hb_interval:
+            return
+        rate = None
+        if self._hb_wall_last is not None and now > self._hb_wall_last:
+            rate = self._hb_rows / (now - self._hb_wall_last)
+        rec = {"ts": now, "phase": "serving",
+               "iteration": int(self.engine.dispatches),
+               "rows_per_sec": rate, "process_index": self.index,
+               "host": self.name, "replica": self.name,
+               "state": self.state, "inflight": int(self.inflight)}
+        import json
+        try:
+            with open(self._hb_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError:
+            # Telemetry must never fail serving; the sink simply goes
+            # stale and fleet-status reports the age.
+            pass
+        self._hb_wall_last = now
+        self._hb_rows = 0
+
+
+class FleetFuture:
+    """Completion handle for one fleet-routed queued request.
+
+    ``result()`` returns the request's own rows' slice (the
+    :class:`ServingFuture` contract).  If the target replica died with
+    the request in flight, the failure surfaces here as
+    :class:`ReplicaDeadError` from the queue's isolation machinery and
+    the future transparently re-dispatches on a surviving replica —
+    the caller sees a result, never the dead replica."""
+
+    def __init__(self, fleet: "ServingFleet", rep: _Replica,
+                 inner: ServingFuture, model_id, rows, op: str,
+                 t0: float):
+        self._fleet = fleet
+        self._rep = rep
+        self._inner = inner
+        self._model_id = model_id
+        self._rows = rows
+        self._op = op
+        self._t0 = t0
+        self._settled = False
+
+    def done(self) -> bool:
+        return self._inner.done()
+
+    def result(self, timeout: Optional[float] = None):
+        while True:
+            try:
+                out = self._inner.result(timeout)
+            except ReplicaDeadError:
+                self._fleet._fail_over(self._rep)
+                rep, inner = self._fleet._resubmit(
+                    self._model_id, self._rows, self._op)
+                self._rep, self._inner = rep, inner
+                continue
+            except Exception:
+                self._settle(error=True)
+                raise
+            self._settle()
+            return out
+
+    def exception(self, timeout: Optional[float] = None):
+        try:
+            self.result(timeout)
+            return None
+        except TimeoutError:
+            raise
+        except Exception as e:              # noqa: BLE001 — mirror
+            return e                        # ServingFuture.exception
+
+    def _settle(self, error: bool = False) -> None:
+        """Release the in-flight slot and (on success) feed the routing
+        histogram — once, however many times result() is called."""
+        if self._settled:
+            return
+        self._settled = True
+        self._fleet._complete(self._rep, self._model_id,
+                              self._rows, self._t0, error=error)
+
+
+class ServingFleet:
+    """N :class:`ServingEngine` replicas behind an SLO-aware router.
+
+    Parameters
+    ----------
+    n_replicas : initial replica count (``add_replica``/``kill_replica``
+        /``remove_replica`` grow and shrink it later).
+    mesh, buckets, max_wait_ms, clock, start, quality, quality_window :
+        forwarded to every replica engine (one shared mesh: in-process
+        replicas serve the same devices, so compiled programs and
+        placements are shared and parity with a single engine is by
+        construction).  ``clock`` also drives the router's latency
+        observations and the :meth:`reap` liveness rule — injectable
+        for deterministic shed tests.
+    fleet_dir : directory for per-replica sinks — quality JSONL
+        (``quality.<model>.<replica>.jsonl``) and heartbeats
+        (``hb.<replica>.jsonl``); the ``serve-status`` /
+        ``fleet-status`` input.  None = in-memory only.
+    slo_p99_ms : committed p99 latency bound (ms).  None disables
+        admission control (route-only fleet).
+    max_inflight : per-replica in-flight depth limit (admission sheds
+        when EVERY candidate is at the limit).  None = unbounded.
+    replication : copies of each model across the fleet (placement is
+        least-loaded, pack-group co-resident).  None = full
+        replication on every replica.
+    heartbeat_interval_s : min seconds between heartbeat records (and
+        the base of the :meth:`reap` stall window).
+    """
+
+    def __init__(self, n_replicas: int = 2, *, mesh=None,
+                 buckets=DEFAULT_BUCKETS, max_wait_ms: float = 2.0,
+                 clock=None, start: bool = True, quality="auto",
+                 quality_window: Optional[int] = None,
+                 fleet_dir=None, slo_p99_ms: Optional[float] = None,
+                 max_inflight: Optional[int] = None,
+                 replication: Optional[int] = None,
+                 heartbeat_interval_s: float = 0.5):
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        if replication is not None and replication < 1:
+            raise ValueError(f"replication must be >= 1, "
+                             f"got {replication}")
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.buckets = check_buckets(buckets)
+        self._max_wait_ms = float(max_wait_ms)
+        self._clock = clock if clock is not None else time.monotonic
+        self._user_clock = clock
+        self._start = bool(start)
+        self._quality = quality
+        self._quality_window = quality_window
+        self._fleet_dir = str(fleet_dir) if fleet_dir is not None else None
+        if self._fleet_dir is not None:
+            os.makedirs(self._fleet_dir, exist_ok=True)
+        self.slo_p99_ms = float(slo_p99_ms) if slo_p99_ms is not None \
+            else None
+        self.max_inflight = int(max_inflight) if max_inflight is not None \
+            else None
+        self._replication = int(replication) if replication is not None \
+            else None
+        self._hb_interval = float(heartbeat_interval_s)
+        self.registry = ModelRegistry()     # fleet-level placement view
+        self._quantize: Dict[str, Optional[str]] = {}
+        self._profiles: Dict[str, Optional[dict]] = {}
+        self._placement: Dict[str, List[int]] = {}
+        self._group_homes: Dict[tuple, List[int]] = {}
+        self._replicas: List[_Replica] = []
+        self._hists: Dict[tuple, object] = {}
+        self._est: Dict[tuple, tuple] = {}
+        self._lock = threading.Lock()
+        self._rr = 0                        # power-of-two rotation
+        self._next_index = 0
+        self.routes = 0
+        self.sheds = 0
+        self.redispatches = 0
+        self._closed = False
+        for _ in range(int(n_replicas)):
+            self._spawn()
+
+    # -------------------------------------------------------- replicas
+
+    def _spawn(self) -> _Replica:
+        i = self._next_index
+        self._next_index += 1
+        name = f"r{i}"
+        eng = ServingEngine(
+            mesh=self.mesh, buckets=self.buckets,
+            max_wait_ms=self._max_wait_ms, clock=self._user_clock,
+            start=self._start, quality=self._quality,
+            quality_dir=self._fleet_dir,
+            quality_window=self._quality_window, quality_tag=name)
+        hb = os.path.join(self._fleet_dir, f"hb.{name}.jsonl") \
+            if self._fleet_dir is not None else None
+        rep = _Replica(name, i, eng, hb, self._hb_interval)
+        self._replicas.append(rep)
+        return rep
+
+    def _replica(self, name) -> _Replica:
+        for rep in self._replicas:
+            if rep.name == name:
+                return rep
+        raise KeyError(f"no replica {name!r}; fleet: "
+                       f"{[r.name for r in self._replicas]}")
+
+    def replicas(self) -> List[str]:
+        return [r.name for r in self._replicas]
+
+    def add_replica(self, *, prewarm: bool = True) -> str:
+        """Grow the fleet by one replica.  Fully-replicated models are
+        placed on it immediately; with partial replication it joins the
+        placement pool for future models.  With ``prewarm`` the replica
+        compiles (or AOT-loads, r19) every bucket shape BEFORE entering
+        ``'serving'`` — it never takes traffic cold; ``prewarm_s``
+        (stats) is the measured cost, the BENCH_FLEET prewarm row."""
+        rep = self._spawn()
+        if self._replication is None:
+            for mid in self.registry.ids():
+                rep.engine.add_model(mid, self.registry.get(mid),
+                                     quantize=self._quantize[mid],
+                                     profile=self._profiles[mid])
+                rep.models.add(mid)
+                self._placement[mid].append(rep.index)
+        t0 = time.perf_counter()
+        self._warm_replica(rep, prewarm=prewarm)
+        rep.prewarm_s = time.perf_counter() - t0
+        return rep.name
+
+    def kill_replica(self, name) -> None:
+        """Chaos kill (``utils.faults`` discipline): the replica
+        refuses every further dispatch via the engine guard, so its
+        queued in-flight requests fail through the queue's per-member
+        isolation and re-dispatch on survivors.  Routing skips it
+        immediately."""
+        rep = self._replica(name)
+        rep.killed = True
+        rep.state = "dead"
+
+    def remove_replica(self, name) -> None:
+        """Graceful shrink: stop routing to the replica, drain its
+        queue (pending requests still complete — it is not killed),
+        and release its models from the placement map."""
+        rep = self._replica(name)
+        rep.state = "dead"
+        rep.engine.close()
+        for mid in list(rep.models):
+            idxs = self._placement.get(mid, [])
+            if rep.index in idxs:
+                idxs.remove(rep.index)
+        for key, homes in list(self._group_homes.items()):
+            if rep.index in homes:
+                homes.remove(rep.index)
+
+    def _fail_over(self, rep: _Replica) -> None:
+        """Mark a replica dead after a ReplicaDeadError surfaced from
+        its dispatch path, and count the re-dispatch that follows."""
+        rep.killed = True
+        rep.state = "dead"
+        with self._lock:
+            self.redispatches += 1
+        obs_metrics.REGISTRY.counter("fleet.redispatch").inc()
+
+    def reap(self, now: Optional[float] = None) -> List[str]:
+        """Heartbeat-driven death detection: declare dead every serving
+        replica that HOLDS in-flight work but has not completed a
+        dispatch within the stall window (``DEAD_AFTER_FACTOR``
+        heartbeat intervals, min ``DEAD_MIN_S`` — the obs.fleet
+        straggler-stall rule applied to serving).  An idle replica
+        never reaps: no outstanding work means no evidence of death.
+        Returns the newly dead replica names; their queued requests
+        fail over on the next result() collection."""
+        now = self._clock() if now is None else now
+        window = max(DEAD_AFTER_FACTOR * self._hb_interval, DEAD_MIN_S)
+        newly: List[str] = []
+        for rep in self._replicas:
+            if rep.state != "serving" or rep.inflight <= 0:
+                continue
+            if rep.last_beat is not None \
+                    and now - rep.last_beat > window:
+                rep.killed = True
+                rep.state = "dead"
+                newly.append(rep.name)
+        return newly
+
+    # ------------------------------------------------------- residency
+
+    def add_model(self, model_id: str, model, *,
+                  quantize: Optional[str] = None,
+                  profile: Optional[dict] = None) -> List[str]:
+        """Make a fitted model resident across the fleet; returns the
+        replica names it was placed on (pack-group co-resident,
+        least-loaded — module docstring)."""
+        spec = self.registry.register(model_id, model)
+        idxs = self._place(spec)
+        placed: List[int] = []
+        try:
+            for i in idxs:
+                rep = self._replicas[i]
+                rep.engine.add_model(model_id, model, quantize=quantize,
+                                     profile=profile)
+                rep.models.add(model_id)
+                placed.append(i)
+        except Exception:
+            for i in placed:
+                self._replicas[i].engine.remove(model_id)
+                self._replicas[i].models.discard(model_id)
+            self.registry.remove(model_id)
+            raise
+        self._placement[model_id] = list(idxs)
+        self._quantize[model_id] = quantize
+        self._profiles[model_id] = profile
+        key = ModelRegistry.group_key(spec)
+        if key is not None and key not in self._group_homes:
+            self._group_homes[key] = list(idxs)
+        return [self._replicas[i].name for i in idxs]
+
+    def load(self, path, model_id: Optional[str] = None, *,
+             quantize: Optional[str] = None) -> str:
+        """Load a topology-portable checkpoint once and place it across
+        the fleet (every replica shares the one fitted model object —
+        one host copy, one device placement)."""
+        model = load_fitted(path)
+        if model_id is None:
+            from pathlib import Path
+            stem = Path(str(path)).stem
+            model_id, i = stem, 1
+            while model_id in self.registry:
+                i += 1
+                model_id = f"{stem}-{i}"
+        self.add_model(model_id, model, quantize=quantize)
+        return model_id
+
+    def models(self) -> List[str]:
+        return self.registry.ids()
+
+    def _place(self, spec: dict) -> List[int]:
+        """Home replica indices for a new model: the pack group's
+        existing homes when one exists (co-residency keeps packed
+        routing alive), else the ``replication`` least-loaded live
+        replicas (ties -> lower index)."""
+        live = [r for r in self._replicas if r.state != "dead"]
+        if not live:
+            raise RuntimeError("fleet has no live replicas")
+        key = ModelRegistry.group_key(spec)
+        if key is not None:
+            homes = [i for i in self._group_homes.get(key, [])
+                     if self._replicas[i].state != "dead"]
+            if homes:
+                return sorted(homes)
+        r = len(live) if self._replication is None \
+            else min(self._replication, len(live))
+        order = sorted(live, key=lambda rep: (len(rep.models), rep.index))
+        return sorted(rep.index for rep in order[:r])
+
+    # ---------------------------------------------------------- warmup
+
+    def warmup(self, *, prewarm: bool = True) -> int:
+        """Prewarm every replica's bucket shapes and open the fleet for
+        traffic (replicas move ``'warming'`` -> ``'serving'``; routing
+        only ever considers serving replicas, so no replica takes
+        traffic before its programs are warm).  ``prewarm=False``
+        opens without compiling (the ``serve --no-warmup`` path).
+        Returns the number of warm dispatches run."""
+        n = 0
+        for rep in self._replicas:
+            if rep.state == "warming":
+                n += self._warm_replica(rep, prewarm=prewarm)
+        return n
+
+    def _warm_replica(self, rep: _Replica, *, prewarm: bool = True) -> int:
+        n = rep.engine.warmup() if prewarm and rep.models else 0
+        rep.state = "serving"
+        rep.last_beat = self._clock()
+        rep.beat(force=True)                # fleet-status sees it live
+        return n
+
+    # ---------------------------------------------------------- routing
+
+    def _hist(self, rep: _Replica, model_id, bucket: int):
+        key = (rep.name, model_id, bucket)
+        h = self._hists.get(key)
+        if h is None:
+            h = obs_metrics.REGISTRY.histogram(
+                f"fleet.latency_ms.{rep.name}.{model_id}.b{bucket}")
+            self._hists[key] = h
+        return h
+
+    def _estimates(self, rep: _Replica, model_id, bucket: int
+                   ) -> Tuple[Optional[float], Optional[float]]:
+        """(p50, p99) latency estimate for routing — ``(None, None)``
+        while the histogram is cold.  Refreshed every
+        ``ROUTE_REFRESH`` observations (docstring at the constant:
+        per-request percentile() re-sorts dominated router overhead;
+        mildly stale percentiles route identically)."""
+        h = self._hist(rep, model_id, bucket)
+        n = h.count
+        if n < MIN_ROUTE_SAMPLES:
+            return None, None
+        key = (rep.name, model_id, bucket)
+        cached = self._est.get(key)
+        if cached is not None and n - cached[0] < ROUTE_REFRESH:
+            return cached[1], cached[2]
+        p50, p99 = h.percentile(0.50), h.percentile(0.99)
+        self._est[key] = (n, p50, p99)
+        return p50, p99
+
+    def _candidates(self, model_id) -> List[_Replica]:
+        idxs = self._placement.get(model_id)
+        if idxs is None:
+            raise KeyError(
+                f"no resident model {model_id!r}; resident: "
+                f"{self.models()}")
+        cands = [self._replicas[i] for i in idxs
+                 if self._replicas[i].state == "serving"]
+        if not cands:
+            states = {self._replicas[i].name: self._replicas[i].state
+                      for i in idxs}
+            raise ReplicaDeadError(
+                f"no serving replica hosts model {model_id!r} "
+                f"(placement: {states}; did you call warmup()?)")
+        return cands
+
+    def _route(self, model_id, m: int) -> _Replica:
+        """Pick the replica for an m-row request — least expected
+        latency on warm histograms, deterministic power-of-two-choices
+        while cold — applying admission control first (module
+        docstring).  Sheds raise :class:`FleetOverloadError`,
+        recorded."""
+        bucket = bucket_for(m, self.buckets)
+        cands = self._candidates(model_id)
+        ests = [(rep,) + self._estimates(rep, model_id, bucket)
+                for rep in cands]
+        if self.max_inflight is not None and all(
+                rep.inflight >= self.max_inflight for rep in cands):
+            self._record_shed(model_id)
+            raise FleetOverloadError(
+                f"all {len(cands)} replicas at max_inflight="
+                f"{self.max_inflight} for model {model_id!r} — request "
+                f"shed (explicit, counted in fleet.shed)")
+        if self.slo_p99_ms is not None:
+            known = [(rep, p99) for rep, _, p99 in ests
+                     if p99 is not None]
+            if known and len(known) == len(ests) and all(
+                    (rep.inflight + 1) * p99 > self.slo_p99_ms
+                    for rep, p99 in known):
+                self._record_shed(model_id)
+                raise FleetOverloadError(
+                    f"expected completion exceeds the committed p99 "
+                    f"bound {self.slo_p99_ms} ms on every replica for "
+                    f"model {model_id!r} — request shed (explicit, "
+                    f"counted in fleet.shed)")
+        if all(p99 is not None for _, _, p99 in ests):
+            # Least expected latency: typical service (p50) scaled by
+            # the queue this request would join.
+            best, best_exp = None, None
+            for rep, p50, _ in ests:
+                exp = (rep.inflight + 1) * (p50 or 0.0)
+                if best_exp is None or exp < best_exp:
+                    best, best_exp = rep, exp
+            return best
+        # Cold fallback: deterministic power-of-two-choices — two
+        # candidates off a rotating counter, fewer in-flight wins.
+        with self._lock:
+            c = self._rr
+            self._rr += 1
+        a = cands[c % len(cands)]
+        b = cands[(c + 1) % len(cands)]
+        if b.inflight < a.inflight:
+            return b
+        return a
+
+    def _record_route(self, replica_name: str, model_id,
+                      n: int = 1) -> None:
+        """Registry write-through for forwarded traffic — the
+        ``fleet-record`` lint rule requires every forward site to call
+        this (the SLO signal must never starve)."""
+        with self._lock:
+            self.routes += n
+        reg = obs_metrics.REGISTRY
+        reg.counter("fleet.route").inc(n)
+        reg.counter(f"fleet.route.{replica_name}").inc(n)
+
+    def _record_shed(self, model_id) -> None:
+        """Registry write-through for shed traffic (explicit, counted,
+        never silent — the admission-control contract)."""
+        with self._lock:
+            self.sheds += 1
+        reg = obs_metrics.REGISTRY
+        reg.counter("fleet.shed").inc()
+        reg.counter(f"fleet.shed.{model_id}").inc()
+
+    def _complete(self, rep: _Replica, model_id, rows, t0: float,
+                  error: bool = False) -> None:
+        """Release one in-flight slot; on success feed the routing
+        histogram and the replica heartbeat."""
+        dt_ms = (self._clock() - t0) * 1e3
+        with self._lock:
+            rep.inflight = max(0, rep.inflight - 1)
+        if error:
+            return
+        m = int(np.asarray(rows).shape[0]) if np.ndim(rows) > 1 else 1
+        self._hist(rep, model_id, bucket_for(m, self.buckets)) \
+            .observe(dt_ms)
+        rep.last_beat = self._clock()
+        rep.beat(rows=m)
+
+    # ----------------------------------------------------- public calls
+
+    def call(self, model_id, rows, *, op: str = "predict") -> np.ndarray:
+        """Routed immediate dispatch (the single-request latency floor);
+        fails over to a surviving replica if the target dies
+        mid-request."""
+        rows = np.asarray(rows)
+        m = int(rows.shape[0]) if rows.ndim > 1 else 1
+        while True:
+            rep = self._route(model_id, m)
+            try:
+                return self._forward(rep, model_id, rows, op)
+            except ReplicaDeadError:
+                self._fail_over(rep)
+
+    def predict(self, model_id, rows) -> np.ndarray:
+        return self.call(model_id, rows)
+
+    def score(self, model_id, rows) -> float:
+        rows = np.asarray(rows)
+        m = int(rows.shape[0]) if rows.ndim > 1 else 1
+        while True:
+            rep = self._route(model_id, m)
+            self._record_route(rep.name, model_id)
+            t0 = self._clock()
+            with self._lock:
+                rep.inflight += 1
+            try:
+                out = rep.engine.score(model_id, rows)
+            except ReplicaDeadError:
+                self._complete(rep, model_id, rows, t0, error=True)
+                self._fail_over(rep)
+                continue
+            except Exception:
+                self._complete(rep, model_id, rows, t0, error=True)
+                raise
+            self._complete(rep, model_id, rows, t0)
+            return out
+
+    def _forward(self, rep: _Replica, model_id, rows,
+                 op: str) -> np.ndarray:
+        """Forward one request synchronously to a replica engine,
+        keeping the in-flight count and latency histogram honest."""
+        self._record_route(rep.name, model_id)
+        t0 = self._clock()
+        with self._lock:
+            rep.inflight += 1
+        try:
+            out = rep.engine.call(model_id, rows, op=op)
+        except Exception:
+            self._complete(rep, model_id, rows, t0, error=True)
+            raise
+        self._complete(rep, model_id, rows, t0)
+        return out
+
+    def submit(self, model_id, rows, *, op: str = "predict"
+               ) -> FleetFuture:
+        """Route one request into a replica's micro-batch queue;
+        returns a :class:`FleetFuture` that transparently re-dispatches
+        on replica death (sheds still raise here, immediately — an
+        admission decision is made at submit time, not at collection)."""
+        rows = np.asarray(rows)
+        m = int(rows.shape[0]) if rows.ndim > 1 else 1
+        rep = self._route(model_id, m)
+        rep2, inner = self._submit_once(rep, model_id, rows, op)
+        return FleetFuture(self, rep2, inner, model_id, rows, op,
+                           self._clock())
+
+    def _submit_once(self, rep: _Replica, model_id, rows, op: str
+                     ) -> Tuple[_Replica, ServingFuture]:
+        self._record_route(rep.name, model_id)
+        with self._lock:
+            rep.inflight += 1
+        inner = rep.engine.submit(model_id, rows, op=op)
+        return rep, inner
+
+    def _resubmit(self, model_id, rows, op: str
+                  ) -> Tuple[_Replica, ServingFuture]:
+        """Re-dispatch a request whose replica died in flight (the
+        FleetFuture fail-over path)."""
+        m = int(np.asarray(rows).shape[0]) if np.ndim(rows) > 1 else 1
+        rep = self._route(model_id, m)
+        return self._submit_once(rep, model_id, rows, op)
+
+    def predict_multi(self, requests: Sequence[Tuple[str, np.ndarray]]
+                      ) -> List[np.ndarray]:
+        """Routed mixed-model batch: forwarded WHOLE to one replica
+        hosting every requested model (pack-group co-residency makes
+        that the common case, so r11 packed dispatch stays alive
+        fleet-wide); requests whose models share no replica fall back
+        to per-request routing (correct, unpacked)."""
+        if not requests:
+            return []
+        mids = {mid for mid, _ in requests}
+        for mid in mids:
+            if mid not in self._placement:
+                raise KeyError(
+                    f"no resident model {mid!r}; resident: "
+                    f"{self.models()}")
+        cands = [rep for rep in self._replicas
+                 if rep.state == "serving" and mids <= rep.models]
+        m = sum(int(np.asarray(rows).shape[0]) for _, rows in requests)
+        while cands:
+            # Same deterministic p2c on the co-resident candidates.
+            with self._lock:
+                c = self._rr
+                self._rr += 1
+            a = cands[c % len(cands)]
+            b = cands[(c + 1) % len(cands)]
+            rep = b if b.inflight < a.inflight else a
+            self._record_route(rep.name, next(iter(mids)),
+                               n=len(requests))
+            t0 = self._clock()
+            with self._lock:
+                rep.inflight += 1
+            try:
+                out = rep.engine.predict_multi(requests)
+            except ReplicaDeadError:
+                self._complete(rep, next(iter(mids)), m, t0, error=True)
+                self._fail_over(rep)
+                cands = [r for r in cands if r is not rep]
+                continue
+            except Exception:
+                self._complete(rep, next(iter(mids)), m, t0, error=True)
+                raise
+            self._complete(rep, next(iter(mids)), m, t0)
+            return out
+        # No single replica hosts them all: per-request routed calls.
+        return [self.call(mid, rows) for mid, rows in requests]
+
+    # ------------------------------------------------------------ stats
+
+    def stats(self) -> dict:
+        """Operator snapshot (the ``{"fleet_stats": true}`` payload):
+        router counters, per-replica liveness/load/engine stats,
+        placement and pack-group co-residency.  ``dispatches`` is the
+        fleet total, so harnesses written against the engine surface
+        (experiments/exp_serving_load.py) read it unchanged."""
+        with self._lock:
+            routes, sheds, redispatches = \
+                self.routes, self.sheds, self.redispatches
+        replicas = {}
+        for rep in self._replicas:
+            st = rep.engine.stats()
+            replicas[rep.name] = {
+                "state": rep.state, "inflight": int(rep.inflight),
+                "models": sorted(rep.models),
+                "dispatches": st["dispatches"],
+                "packed_dispatches": st["packed_dispatches"],
+                "queue": st["queue"],
+                "prewarm_s": rep.prewarm_s,
+            }
+        models: Dict[str, dict] = {}
+        for rep in self._replicas:
+            for mid, m in rep.engine.stats()["models"].items():
+                agg = models.setdefault(mid, {
+                    "requests": 0, "rows": 0, "dispatches": 0,
+                    "replicas": []})
+                agg["requests"] += m["requests"]
+                agg["rows"] += m["rows"]
+                agg["dispatches"] += m["dispatches"]
+                agg["replicas"].append(rep.name)
+        return {
+            "replicas": replicas,
+            "n_replicas": len(self._replicas),
+            "n_serving": sum(1 for r in self._replicas
+                             if r.state == "serving"),
+            "models": models,
+            "placement": {mid: [self._replicas[i].name for i in idxs]
+                          for mid, idxs in sorted(self._placement.items())},
+            "pack_groups": {
+                "/".join(map(str, key)): ids
+                for key, ids in self.registry.pack_groups().items()},
+            "routes": routes, "sheds": sheds,
+            "redispatches": redispatches,
+            "slo_p99_ms": self.slo_p99_ms,
+            "max_inflight": self.max_inflight,
+            "dispatches": sum(r["dispatches"] for r in replicas.values()),
+            "buckets": list(self.buckets),
+        }
+
+    def quality_status(self) -> dict:
+        """Per-model drift state per replica:
+        ``{model_id: {replica: status-or-None}}`` (the fleet twin of
+        ``ServingEngine.quality_status``; ``serve-status <fleet_dir>``
+        renders the merged cross-replica view from the sinks)."""
+        out: Dict[str, dict] = {}
+        for rep in self._replicas:
+            for mid, st in rep.engine.quality_status().items():
+                out.setdefault(mid, {})[rep.name] = st
+        return out
+
+    # -------------------------------------------------------- lifecycle
+
+    def close(self) -> None:
+        """Drain and close every replica engine (idempotent)."""
+        if self._closed:
+            return
+        self._closed = True
+        for rep in self._replicas:
+            rep.engine.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

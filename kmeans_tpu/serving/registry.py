@@ -144,3 +144,14 @@ class ModelRegistry:
             if key is not None:
                 groups.setdefault(key, []).append(model_id)
         return {k: v for k, v in groups.items() if len(v) >= 2}
+
+    def group_ids(self, key: Optional[tuple]) -> List[str]:
+        """Resident ids stacking under ``key`` (insertion order — the
+        packed-axis slot order); empty for ``key=None``.  Router glue
+        (ISSUE 17): fleet placement co-locates a new group member with
+        the group's existing home replicas so packed routing
+        (``predict_multi``) stays a single dispatch across the fleet."""
+        if key is None:
+            return []
+        return [mid for mid, spec in self._specs.items()
+                if self.group_key(spec) == key]

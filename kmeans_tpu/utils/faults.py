@@ -32,6 +32,12 @@ test.  This module is the one place those injections live:
   ``RESOURCE_EXHAUSTED``) the first ``times`` times segment ``j`` is
   attempted — proving the OOM chunk-backoff recovery (ISSUE 5) through
   the real dispatch loop, not a mock.
+* ``inject_replica_kill(fleet, replica)`` — arm a serving-fleet chaos
+  kill (ISSUE 17): the replica's pre-dispatch fault hook counts
+  dispatches and kills the replica after ``after_dispatches`` — the
+  in-flight request fails through the engine's dispatch guard and the
+  micro-batch queue's per-member isolation, and the fleet router must
+  re-dispatch it on a survivor with ZERO failed requests.
 
 All state is explicit (closures / context managers); nothing here is
 active unless a test arms it, and the hooks cost one empty-list check
@@ -50,7 +56,7 @@ __all__ = [
     "TransientIOError", "SimulatedPreemption", "SimulatedOOM",
     "on_checkpoint", "on_segment_dispatch",
     "inject_kill_after_iteration", "inject_oom_on_segment",
-    "inject_checkpoint_delay",
+    "inject_checkpoint_delay", "inject_replica_kill",
     "fail_first_attempts", "flaky_blocks", "poison_blocks",
 ]
 
@@ -203,6 +209,50 @@ def inject_oom_on_segment(j: int, times: int = 1):
         with _HOOK_LOCK:
             if hook in _SEGMENT_HOOKS:
                 _SEGMENT_HOOKS.remove(hook)
+
+
+@contextlib.contextmanager
+def inject_replica_kill(fleet, replica=None, *, after_dispatches: int = 0):
+    """Arm a deterministic serving-replica kill (ISSUE 17 chaos run):
+    the armed ``fault_hook`` — called by the engine's pre-dispatch
+    guard on EVERY dispatch path (direct, queued batch, packed) —
+    counts dispatch attempts, and once ``after_dispatches`` have been
+    allowed through it calls ``fleet.kill_replica`` on the replica
+    performing the NEXT one, so that dispatch (and every later one on
+    the victim) is refused with ``ReplicaDeadError``.  A queued batch
+    in flight at that moment fails through the micro-batch queue's
+    per-member isolation, and the fleet router re-dispatches each
+    member on a surviving replica — the chaos test pins zero failed
+    requests.  ``replica`` names a specific victim; the default arms
+    EVERY serving replica and kills whichever one crosses the
+    threshold first (robust to the router concentrating traffic — the
+    kill lands on a replica that actually holds work).  Yields a
+    record dict with ``dispatches`` (attempts seen fleet-wide),
+    ``killed`` (bool) and ``replica`` (the victim's name; the armed
+    target's when a specific one was named)."""
+    if replica is None:
+        targets = [r for r in fleet._replicas if r.state == "serving"] \
+            or list(fleet._replicas)
+    else:
+        targets = [fleet._replica(replica)]
+    record = {"dispatches": 0, "killed": False,
+              "replica": targets[0].name if len(targets) == 1 else None}
+
+    def hook(rep, model_id, op) -> None:
+        record["dispatches"] += 1
+        if not record["killed"] \
+                and record["dispatches"] > after_dispatches:
+            record["killed"] = True
+            record["replica"] = rep.name
+            fleet.kill_replica(rep.name)
+
+    for t in targets:
+        t.fault_hook = hook
+    try:
+        yield record
+    finally:
+        for t in targets:
+            t.fault_hook = None
 
 
 # ------------------------------------------------------------ callables

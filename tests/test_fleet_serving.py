@@ -1,0 +1,398 @@
+"""Serving-fleet acceptance (ISSUE 17): fleet labels BIT-EQUAL to a
+single engine's on every dispatch path (direct, queued, packed,
+bf16-guarded); deterministic admission control at the committed bound
+(explicit, counted — never a silent drop); the kill-a-replica chaos
+pin (zero failed requests, survivors absorb the re-dispatches);
+pack-group-aware placement under partial replication; no traffic
+before warmup; and the serve CLI / status-CLI fleet surfaces."""
+
+import io
+import json
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+
+from kmeans_tpu import KMeans
+from kmeans_tpu.obs import metrics_registry as obs_metrics
+from kmeans_tpu.parallel.mesh import make_mesh
+from kmeans_tpu.serving import (FleetOverloadError, ReplicaDeadError,
+                                ServingEngine, ServingFleet)
+from kmeans_tpu.serving.batching import bucket_for
+from kmeans_tpu.serving.fleet import MIN_ROUTE_SAMPLES
+from kmeans_tpu.utils.faults import inject_replica_kill
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    """Histograms/counters are PROCESS-GLOBAL and replica names repeat
+    (r0, r1, ...) across fleets, so a stale registry would pre-warm a
+    new fleet's router with a dead fleet's latency estimates."""
+    obs_metrics.REGISTRY.reset()
+    yield
+    obs_metrics.REGISTRY.reset()
+
+
+@pytest.fixture(scope="module")
+def data():
+    X, _ = make_blobs(n_samples=3000, centers=6, n_features=8,
+                      random_state=3)
+    return X.astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def km(data):
+    model = KMeans(k=5, seed=0, verbose=False, max_iter=25).fit(data)
+    model.mesh = None                   # engine re-points to its mesh
+    return model
+
+
+@pytest.fixture(scope="module")
+def km2(data):
+    model = KMeans(k=5, seed=11, verbose=False, max_iter=25).fit(data)
+    model.mesh = None
+    return model
+
+
+def _fleet(n=2, **kw):
+    kw.setdefault("mesh", make_mesh())
+    kw.setdefault("max_wait_ms", 1.0)
+    kw.setdefault("quality", False)
+    return ServingFleet(n, **kw)
+
+
+# ----------------------------------------------------------- parity
+
+
+def test_fleet_labels_bitequal_every_path(data, km, km2):
+    """Direct, queued, and packed fleet dispatches all return labels
+    bit-equal to the model's own predict (and hence to a single
+    engine's — ISSUE 6 parity composed through the router)."""
+    with _fleet(3) as fleet:
+        assert sorted(fleet.add_model("a", km)) == ["r0", "r1", "r2"]
+        fleet.add_model("b", km2)
+        fleet.warmup()
+        for m_rows in (1, 7, 64, 300):      # several buckets + padding
+            probe = data[:m_rows]
+            want = km.predict(probe)
+            np.testing.assert_array_equal(fleet.call("a", probe), want)
+            np.testing.assert_array_equal(
+                fleet.submit("a", probe).result(timeout=30.0), want)
+        outs = fleet.predict_multi([("a", data[:50]),
+                                    ("b", data[50:90])])
+        np.testing.assert_array_equal(outs[0], km.predict(data[:50]))
+        np.testing.assert_array_equal(outs[1], km2.predict(data[50:90]))
+        # Same-(k, D, dtype) models co-reside, so the mixed batch rode
+        # ONE packed dispatch on one replica (r11 stays alive).
+        assert sum(r.engine.packed_dispatches
+                   for r in fleet._replicas) == 1
+        st = fleet.stats()
+        assert st["routes"] >= 8 + 2 and st["sheds"] == 0
+        assert st["models"]["a"]["requests"] >= 8
+        assert obs_metrics.REGISTRY.counter("fleet.route").value \
+            == st["routes"]
+
+
+def test_fleet_bf16_guarded_path_matches_engine(data, km):
+    """The quantized assignment path (near-tie guard included) routes
+    through the fleet unchanged: labels bit-equal to a single bf16
+    engine's AND to exact predict (the guard's contract)."""
+    mesh = make_mesh()
+    probe = data[:200]
+    with ServingEngine(mesh=mesh, max_wait_ms=1.0, quality=False) as eng:
+        eng.add_model("m", km, quantize="bf16")
+        want = eng.predict("m", probe)
+    with _fleet(2, mesh=mesh) as fleet:
+        fleet.add_model("m", km, quantize="bf16")
+        fleet.warmup()
+        np.testing.assert_array_equal(fleet.call("m", probe), want)
+        np.testing.assert_array_equal(want, km.predict(probe))
+
+
+def test_score_routes_and_matches(data, km):
+    """Fleet score == a single engine's score BIT-EXACT (both run the
+    same padded-bucket program; the model's own unpadded score may
+    differ in f32 accumulation order)."""
+    mesh = make_mesh()
+    with ServingEngine(mesh=mesh, max_wait_ms=1.0, quality=False) as eng:
+        eng.add_model("m", km)
+        want = eng.score("m", data[:100])
+    with _fleet(2, mesh=mesh) as fleet:
+        fleet.add_model("m", km)
+        fleet.warmup()
+        assert fleet.score("m", data[:100]) == want
+        assert fleet.stats()["routes"] == 1
+
+
+# -------------------------------------------- admission & shedding
+
+
+def test_max_inflight_burst_sheds_deterministically(data, km):
+    """A burst beyond fleet capacity sheds EXACTLY offered - capacity
+    requests: in-flight slots release only at result() collection, so
+    with the queue timer never firing (start=False) the shed count is
+    a pure function of the burst size.  Sheds are explicit
+    (FleetOverloadError) and counted — zero silent drops."""
+    offered, per_rep = 9, 2
+    with _fleet(2, start=False, max_inflight=per_rep) as fleet:
+        fleet.add_model("m", km)
+        fleet.warmup(prewarm=False)
+        futs, shed = [], 0
+        for i in range(offered):
+            try:
+                futs.append(fleet.submit("m", data[i:i + 1]))
+            except FleetOverloadError:
+                shed += 1
+        assert len(futs) == 2 * per_rep     # capacity: 2 replicas x 2
+        assert shed == offered - 2 * per_rep
+        assert len(futs) + shed == offered  # nothing vanished
+        st = fleet.stats()
+        assert st["sheds"] == shed
+        assert obs_metrics.REGISTRY.counter("fleet.shed").value == shed
+        assert obs_metrics.REGISTRY.counter("fleet.shed.m").value == shed
+        # Drain: close() flushes the workerless queues; every ADMITTED
+        # request still completes bit-exact.
+        fleet.close()
+        for i, f in enumerate(futs):
+            np.testing.assert_array_equal(f.result(timeout=30.0),
+                                          km.predict(data[i:i + 1]))
+
+
+def test_slo_bound_sheds_when_every_replica_breaches(data, km):
+    """Committed-p99 admission: cold candidates admit (shedding needs
+    evidence); once every candidate's histogram is warm and expected
+    completion breaches the bound, the request sheds explicitly."""
+    with _fleet(2, slo_p99_ms=1.0) as fleet:
+        fleet.add_model("m", km)
+        fleet.warmup()
+        probe = data[:1]
+        # Cold histograms: admitted despite the tight bound.
+        np.testing.assert_array_equal(fleet.call("m", probe),
+                                      km.predict(probe))
+        b = bucket_for(1, fleet.buckets)
+        for rep in fleet._replicas:
+            h = fleet._hist(rep, "m", b)
+            for _ in range(MIN_ROUTE_SAMPLES):
+                h.observe(50.0)             # p99 = 50 ms >> 1 ms bound
+        with pytest.raises(FleetOverloadError, match="p99 bound"):
+            fleet.call("m", probe)
+        assert fleet.stats()["sheds"] == 1
+        assert obs_metrics.REGISTRY.counter("fleet.shed").value == 1
+
+
+# ------------------------------------------------- chaos / lifecycle
+
+
+def test_kill_a_replica_zero_failed_requests(data, km):
+    """The ISSUE 17 chaos pin: kill a replica with queued work in
+    flight — every request still completes bit-exact (failed == 0),
+    the dead replica's members re-dispatch on the survivor, and
+    routing never touches the corpse again."""
+    with _fleet(2) as fleet:
+        fleet.add_model("m", km)
+        fleet.warmup()
+        with inject_replica_kill(fleet, after_dispatches=0) as rec:
+            futs = [fleet.submit("m", data[i:i + 1]) for i in range(24)]
+            outs = [f.result(timeout=30.0) for f in futs]
+        assert rec["killed"] and rec["replica"] in ("r0", "r1")
+        for i, out in enumerate(outs):      # zero failed, all exact
+            np.testing.assert_array_equal(out,
+                                          km.predict(data[i:i + 1]))
+        st = fleet.stats()
+        assert st["n_serving"] == 1
+        assert st["replicas"][rec["replica"]]["state"] == "dead"
+        assert st["redispatches"] >= 1
+        assert obs_metrics.REGISTRY.counter("fleet.redispatch").value \
+            == st["redispatches"]
+        # Direct calls keep working on the survivor.
+        np.testing.assert_array_equal(fleet.call("m", data[:3]),
+                                      km.predict(data[:3]))
+
+
+def test_all_replicas_dead_is_loud(data, km):
+    with _fleet(1) as fleet:
+        fleet.add_model("m", km)
+        fleet.warmup()
+        fleet.kill_replica("r0")
+        with pytest.raises(ReplicaDeadError, match="no serving replica"):
+            fleet.call("m", data[:2])
+
+
+def test_no_traffic_before_warmup(data, km):
+    """A replica takes traffic only in state 'serving' — calls before
+    warmup() fail loudly, naming the fix."""
+    with _fleet(2) as fleet:
+        fleet.add_model("m", km)
+        with pytest.raises(ReplicaDeadError, match="warmup"):
+            fleet.call("m", data[:2])
+        fleet.warmup()
+        np.testing.assert_array_equal(fleet.call("m", data[:2]),
+                                      km.predict(data[:2]))
+
+
+def test_add_replica_prewarms_before_serving(data, km):
+    with _fleet(1) as fleet:
+        fleet.add_model("m", km)
+        fleet.warmup()
+        name = fleet.add_replica()
+        st = fleet.stats()
+        assert st["replicas"][name]["state"] == "serving"
+        assert st["replicas"][name]["prewarm_s"] is not None
+        assert st["placement"]["m"] == ["r0", name]
+        np.testing.assert_array_equal(fleet.call("m", data[:5]),
+                                      km.predict(data[:5]))
+
+
+def test_reap_stalled_replica_with_inflight_work(data, km):
+    """Heartbeat-driven death: in-flight work + no completed dispatch
+    past the stall window -> dead; an IDLE replica never reaps (no
+    outstanding work is no evidence of death)."""
+    with _fleet(2, heartbeat_interval_s=0.1) as fleet:
+        fleet.add_model("m", km)
+        fleet.warmup()
+        rep = fleet._replicas[0]
+        assert fleet.reap(now=fleet._clock() + 1e4) == []  # idle: never
+        rep.inflight = 1
+        rep.last_beat = fleet._clock()
+        assert fleet.reap(now=rep.last_beat + 0.5) == []   # in window
+        assert fleet.reap(now=rep.last_beat + 1e4) == ["r0"]
+        assert rep.state == "dead"
+        assert fleet.stats()["n_serving"] == 1
+
+
+# -------------------------------------------------------- placement
+
+
+def test_pack_group_coresidency_under_partial_replication(data, km,
+                                                          km2):
+    """replication=1 on a 3-replica fleet: same-(k, D, dtype) models
+    co-reside with their pack group (predict_multi stays ONE packed
+    dispatch), while an unrelated model lands on the least-loaded
+    replica."""
+    with _fleet(3, replication=1) as fleet:
+        fleet.add_model("a", km)
+        fleet.add_model("b", km2)           # same (k, D, dtype) as "a"
+        other = KMeans(k=3, seed=2, verbose=False, max_iter=5).fit(
+            data[:500])
+        other.mesh = None
+        fleet.add_model("c", other)         # different k: new home
+        st = fleet.stats()
+        assert st["placement"]["a"] == st["placement"]["b"]
+        assert len(st["placement"]["a"]) == 1
+        assert st["placement"]["c"] != st["placement"]["a"]
+        assert sorted(st["pack_groups"].get("5/8/<f4", [])) \
+            == ["a", "b"]
+        fleet.warmup()
+        outs = fleet.predict_multi([("a", data[:40]),
+                                    ("b", data[40:70])])
+        np.testing.assert_array_equal(outs[0], km.predict(data[:40]))
+        np.testing.assert_array_equal(outs[1], km2.predict(data[40:70]))
+        assert sum(r.engine.packed_dispatches
+                   for r in fleet._replicas) == 1
+
+
+def test_predict_multi_falls_back_when_no_coresident_replica(data, km):
+    """Models sharing no replica still answer (per-request routed
+    calls — correct, unpacked)."""
+    with _fleet(2, replication=1) as fleet:
+        fleet.add_model("a", km)
+        other = KMeans(k=3, seed=2, verbose=False, max_iter=5).fit(
+            data[:500])
+        other.mesh = None
+        fleet.add_model("c", other)
+        st = fleet.stats()
+        assert st["placement"]["a"] != st["placement"]["c"]
+        fleet.warmup()
+        outs = fleet.predict_multi([("a", data[:30]),
+                                    ("c", data[30:60])])
+        np.testing.assert_array_equal(outs[0], km.predict(data[:30]))
+        np.testing.assert_array_equal(outs[1],
+                                      other.predict(data[30:60]))
+        assert sum(r.engine.packed_dispatches
+                   for r in fleet._replicas) == 0
+
+
+# ------------------------------------------------------ CLI surface
+
+
+def test_serve_cli_fleet_mode(tmp_path, data, km, monkeypatch, capsys):
+    """serve --replicas N: requests route through the fleet (results
+    unchanged), {"fleet_stats": true} answers the fleet snapshot, and
+    the final summary names the replica count."""
+    from kmeans_tpu.cli import serve_main
+    km.save(tmp_path / "km.npz")
+    want = km.predict(data[:3]).tolist()
+    lines = [
+        json.dumps({"x": data[:3].tolist(), "id": "r1"}),
+        json.dumps({"fleet_stats": True}),
+    ]
+    monkeypatch.setattr("sys.stdin", io.StringIO("\n".join(lines) + "\n"))
+    rc = serve_main(["--model", str(tmp_path / "km.npz"), "--json",
+                     "--no-warmup", "--no-quality", "--replicas", "2",
+                     "--max-wait-ms", "1.0"])
+    assert rc == 0
+    out = [json.loads(ln) for ln in
+           capsys.readouterr().out.strip().splitlines()]
+    assert out[0]["result"] == want and out[0]["id"] == "r1"
+    fs = out[1]
+    assert fs["n_replicas"] == 2 and fs["n_serving"] == 2
+    assert fs["routes"] >= 1 and fs["sheds"] == 0
+    assert set(fs["replicas"]) == {"r0", "r1"}
+    final = out[-1]
+    assert final["n_replicas"] == 2
+    assert final["models"]["km"]["replicas"] == ["r0", "r1"]
+
+
+def test_serve_cli_fleet_stats_needs_fleet_mode(tmp_path, data, km,
+                                                monkeypatch, capsys):
+    from kmeans_tpu.cli import serve_main
+    km.save(tmp_path / "km.npz")
+    monkeypatch.setattr(
+        "sys.stdin",
+        io.StringIO(json.dumps({"fleet_stats": True}) + "\n"))
+    rc = serve_main(["--model", str(tmp_path / "km.npz"),
+                     "--no-warmup", "--no-quality"])
+    assert rc == 0                          # per-request error, loop on
+    out = [json.loads(ln) for ln in
+           capsys.readouterr().out.strip().splitlines()]
+    assert "error" in out[0] and "--replicas" in out[0]["error"]
+
+
+def test_serve_cli_rejects_bad_replicas(tmp_path, km, capsys):
+    from kmeans_tpu.cli import serve_main
+    km.save(tmp_path / "km.npz")
+    assert serve_main(["--model", str(tmp_path / "km.npz"),
+                       "--replicas", "0"]) == 2
+    assert "--replicas" in capsys.readouterr().err
+
+
+def test_status_clis_read_fleet_dir(tmp_path, data, km, capsys):
+    """One fleet_dir feeds BOTH status CLIs: serve-status merges the
+    per-replica quality sinks per model, fleet-status renders the
+    per-replica heartbeats — unchanged exit codes."""
+    from kmeans_tpu.cli import fleet_status_main, serve_status_main
+    fdir = tmp_path / "fleet"
+    with _fleet(2, quality=True, fleet_dir=str(fdir)) as fleet:
+        fleet.add_model("m", km)
+        fleet.warmup()
+        fleet.call("m", data[:64])
+    names = sorted(p.name for p in fdir.iterdir())
+    assert "hb.r0.jsonl" in names and "hb.r1.jsonl" in names
+    assert any(n.startswith("quality.m.r") for n in names)
+    assert serve_status_main([str(fdir)]) == 0
+    assert serve_status_main([str(fdir), "--json"]) == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "m" in report["models"] and report["healthy"]
+    assert len([f for f in report["files"]
+                if "quality.m.r" in f]) == 2
+    assert fleet_status_main([str(fdir)]) == 0
+    assert fleet_status_main([str(fdir), "--json"]) == 0
+    fs = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert {h["host"] for h in fs["hosts"]} == {"r0", "r1"}
+
+
+def test_fleet_ctor_validation():
+    with pytest.raises(ValueError, match="n_replicas"):
+        ServingFleet(0)
+    with pytest.raises(ValueError, match="replication"):
+        ServingFleet(2, replication=0)

@@ -55,7 +55,7 @@ def test_registry_has_the_required_rules():
     meta-rule) are registered — the >= 6 acceptance bar."""
     assert {"trace-hazard", "cache-key", "dispatch", "thread",
             "counter-reset", "dead-private", "cache-name",
-            "aot-key", "large-k"} <= set(RULES)
+            "aot-key", "large-k", "fleet-record"} <= set(RULES)
     assert len(RULES) >= 6
     for rule in RULES.values():
         assert rule.id and rule.incident, rule
@@ -570,6 +570,81 @@ def test_quality_counter_suppression_honored(tmp_path):
         "by the caller\n        self._record(rm, 8, len(rows))")
     findings = run_on(tmp_path, src, subdir="serving")
     assert [f for f in findings if f.rule == "quality-counter"] == []
+
+
+# ---------------------------------------------------------------------------
+# fleet-record (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+_FLEET_BAD = """
+class Fleet:
+    def forward(self, rep, model_id, rows):
+        return rep.engine.call(model_id, rows)
+
+    def admit(self, model_id):
+        raise FleetOverloadError(model_id)
+"""
+
+_FLEET_OK = """
+class Fleet:
+    def forward(self, rep, model_id, rows):
+        self._record_route(rep.name, model_id)
+        return rep.engine.call(model_id, rows)
+
+    def admit(self, model_id):
+        self._record_shed(model_id)
+        raise FleetOverloadError(model_id)
+"""
+
+
+def test_fleet_record_fires_on_unrecorded_forward_and_shed(tmp_path):
+    findings = run_on(tmp_path, _FLEET_BAD, subdir="serving")
+    fires = [f for f in findings if f.rule == "fleet-record"]
+    assert len(fires) == 2
+    assert "forward()" in fires[0].message
+    assert "admit()" in fires[1].message
+    assert "fleet.route/fleet.shed" in fires[0].message
+
+
+def test_fleet_record_silent_when_recorded(tmp_path):
+    findings = run_on(tmp_path, _FLEET_OK, subdir="serving")
+    assert [f for f in findings if f.rule == "fleet-record"] == []
+
+
+def test_fleet_record_ignores_non_dispatch_engine_calls(tmp_path):
+    # Engine lifecycle/bookkeeping calls are not traffic: only the
+    # dispatch surface (call/submit/score/predict/predict_multi)
+    # through an `engine` attribute counts as a forward.
+    src = """
+class Fleet:
+    def grow(self, rep, mid, model):
+        rep.engine.add_model(mid, model)
+        rep.engine.warmup()
+        return rep.engine.stats()
+
+    def helper(self, rows):
+        return self.call("m", rows)
+"""
+    findings = run_on(tmp_path, src, subdir="serving")
+    assert [f for f in findings if f.rule == "fleet-record"] == []
+
+
+def test_fleet_record_scoped_to_serving(tmp_path):
+    findings = run_on(tmp_path, _FLEET_BAD, subdir="parallel")
+    assert [f for f in findings if f.rule == "fleet-record"] == []
+
+
+def test_fleet_record_suppression_honored(tmp_path):
+    src = _FLEET_BAD.replace(
+        "        return rep.engine.call(model_id, rows)",
+        "        # lint: ok(fleet-record) — warm probe, excluded from "
+        "the SLO signal by design\n"
+        "        return rep.engine.call(model_id, rows)").replace(
+        "        raise FleetOverloadError(model_id)",
+        "        # lint: ok(fleet-record) — test-only admission stub\n"
+        "        raise FleetOverloadError(model_id)")
+    findings = run_on(tmp_path, src, subdir="serving")
+    assert [f for f in findings if f.rule == "fleet-record"] == []
 
 
 # ---------------------------------------------------------------------------
