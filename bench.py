@@ -112,6 +112,20 @@ stage+compile sum).  Cold/AOT-warm traces land in artifacts/ for the
 bench-diff TTFI guard.  Env: BENCH_N/_D/_K, BENCH_ITERS,
 BENCH_AOT_DIR.
 
+BENCH_INGEST=1 switches to the STAGED-INGEST decision rows (ISSUE 18):
+interleaved mono/slab placement walls of a >= 1 GB proxy in a fresh
+process (medians + the committed >= 1.2x adoption rule and the
+bit-parity column), fresh-process serial-vs-overlapped TTFI pairs with
+slabbed ingest (window < serial PASS row + re-measured place/stage
+share), load-whole-file vs streamed from_npy host high-water children
+(committed saved-copy rule: naive - stream maxrss >= 0.8x file bytes),
+and the 1e9-row weak-scaling config declared through
+plan_fit/plan_ingest.  Measured outcome (r22, BASELINE.md): the CPU
+proxy REJECTS slab-for-'auto' (median mono/slab 1.04x on the
+single-core box — nothing to overlap against) -> 'auto' = mono on
+CPU, slab on accelerators; saved-copy and 1e9-plan rows PASS.
+Env: BENCH_N/_D/_K, BENCH_ITERS, BENCH_REPS.
+
 BENCH_QUALITY=1 switches to the SERVING-QUALITY MONITORING overhead
 benchmark (ISSUE 14): monitoring-on vs monitoring-off serving
 throughput against a resident warm K-Means model, interleaved per-rep
@@ -339,6 +353,23 @@ def main() -> None:
             f"ks={xks} iters_gap={xi}"
             + (f" model_shards={xm}" if xm else ""))
         bench_large_k(xn, xd, xks, iters=xi, model_shards=xm)
+        return
+
+    if os.environ.get("BENCH_INGEST"):
+        # Staged-ingest decision rows (ISSUE 18): slab-vs-mono ratio on
+        # the >= 1 GB proxy with the committed 1.2x adoption rule,
+        # ingest/compile overlap PASS, streamed-vs-naive host
+        # high-water, and the declared 1e9-row config.
+        from kmeans_tpu.benchmarks import bench_ingest
+        gn = int(os.environ.get("BENCH_N",
+                                8_000_000 if on_accel else 4_200_000))
+        gd = int(os.environ.get("BENCH_D", 64))
+        gk = int(os.environ.get("BENCH_K", 64))
+        gi = int(os.environ.get("BENCH_ITERS", 4))
+        gr = int(os.environ.get("BENCH_REPS", 3))
+        log(f"bench: INGEST mode backend={backend} N={gn} D={gd} "
+            f"({gn * gd * 4 / 2**30:.2f} GiB proxy) reps={gr}")
+        bench_ingest(gn, gd, k=gk, max_iter=gi, reps=gr)
         return
 
     if os.environ.get("BENCH_QUALITY"):

@@ -550,6 +550,86 @@ class CollectiveSpanRule(ObsSpanRule):
                     f"on the merged timeline")
 
 
+# -------------------------------------------------------- ingest-span
+
+class IngestSpanRule(ObsSpanRule):
+    """ISSUE 18 member of the obs-span lint family: in ``data/`` and
+    ``parallel/sharding.py``, a driver-level function that PLACES host
+    bytes onto devices (``jax.device_put`` /
+    ``make_array_from_single_device_arrays`` /
+    ``make_array_from_callback`` /
+    ``make_array_from_process_local_data``) must run the placement
+    under a ``stage``/``place`` telemetry span.  The TTFI table's
+    ``stage`` row and the per-slab ingest breakdown are built from
+    those spans alone; a placement path without one silently
+    undercounts ingest in every TTFI artifact — the placement twin of
+    the obs-span incident class."""
+
+    id = "ingest-span"
+    incident = ("ISSUE 18: a host->device placement invisible to the "
+                "ingest timeline — the TTFI stage row silently "
+                "undercounts; the placement twin of the obs-span class")
+
+    _PLACERS = {"device_put", "make_array_from_single_device_arrays",
+                "make_array_from_callback",
+                "make_array_from_process_local_data"}
+
+    def run(self, pkg: Package) -> Iterator[Finding]:
+        for mod in pkg:
+            p = mod.rel.replace("\\", "/")
+            if "/data/" not in p and not p.endswith(
+                    "parallel/sharding.py"):
+                continue
+            parents = mod.parents()
+            for fn in ast.walk(mod.tree):
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                # Driver-level only (the obs-span convention): nested
+                # closures — including a prefetch producer's stage
+                # callback — are checked through the enclosing driver's
+                # subtree walk.
+                if not isinstance(parents.get(fn),
+                                  (ast.Module, ast.ClassDef)):
+                    continue
+                sites = [node.lineno for node in ast.walk(fn)
+                         if isinstance(node, ast.Call)
+                         and (dotted(node.func) or "").split(".")[-1]
+                         in self._PLACERS]
+                if not sites:
+                    continue
+                if self._has_stage_span(fn):
+                    continue
+                yield self.finding(
+                    mod, sites[0],
+                    f"{fn.name}() places host bytes on device with no "
+                    f"enclosing 'stage'/'place' span — wrap the "
+                    f"placement in `with obs_trace.span('stage', "
+                    f"rows=..., bytes=...)` (a no-op when tracing is "
+                    f"off) so it lands on the ingest timeline and the "
+                    f"TTFI stage row")
+
+    @staticmethod
+    def _has_stage_span(fn) -> bool:
+        """Stricter than the parent's ``_has_span``: the span must be
+        NAMED ``'stage'`` or ``'place'`` (a literal first argument) —
+        an ingest placement filed under some other phase name would
+        corrupt the TTFI decomposition rather than merely missing it."""
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                expr = item.context_expr
+                if not isinstance(expr, ast.Call):
+                    continue
+                if (dotted(expr.func) or "").split(".")[-1] != "span":
+                    continue
+                if expr.args and isinstance(expr.args[0], ast.Constant) \
+                        and expr.args[0].value in ("stage", "place"):
+                    return True
+        return False
+
+
 # ------------------------------------------------------ quality-counter
 
 class QualityCounterRule(ObsSpanRule):
@@ -1129,7 +1209,8 @@ class SuppressionFormatRule(Rule):
 
 RULES: Dict[str, Rule] = {rule.id: rule for rule in (
     TraceHazardRule(), CacheKeyRule(), DispatchAccountingRule(),
-    ObsSpanRule(), CollectiveSpanRule(), QualityCounterRule(),
+    ObsSpanRule(), CollectiveSpanRule(), IngestSpanRule(),
+    QualityCounterRule(),
     FleetRecordRule(), ThreadHygieneRule(), CounterResetRule(),
     DeadPrivateRule(),
     CacheNameRule(), AotKeyRule(), LargeKRule(),

@@ -2318,7 +2318,8 @@ def ttfi_child() -> None:
         model = KMeans(k=cfg["k"], max_iter=cfg["max_iter"],
                        tolerance=1e-12, seed=0, verbose=False,
                        host_loop=False, empty_cluster="keep",
-                       bucket="auto", overlap=cfg["overlap"])
+                       bucket="auto", overlap=cfg["overlap"],
+                       ingest=cfg.get("ingest", "auto"))
         t0 = time.perf_counter()
         with obs_trace.tracing(trace_path) as tr:
             model.fit(X)
@@ -2489,6 +2490,258 @@ def _row_of(payload: Dict) -> Dict:
                            else None),
             "stage_ms": round(payload["stage_ms"], 2),
             "first_dispatch_ms": round(payload["first_dispatch_ms"], 2)}
+
+
+# ------------------------------------------------------------- INGEST
+
+#: Committed adoption rule (ISSUE 18, the r8/r12 measured-adopt
+#: discipline): the slabbed placement joins ``ingest='auto'`` only where
+#: its measured mono/slab placement-wall ratio on the >= 1 GB proxy
+#: reaches this bar; below it 'auto' would keep the mono oracle.
+INGEST_ADOPT_RATIO = 1.2
+
+#: Committed memory rule (ISSUE 18d), saved-copy form: the streamed
+#: ``from_npy`` child must shave at least this fraction of the proxy
+#: file's bytes off the load-whole-file child's host high-water
+#: (``naive_maxrss - stream_maxrss >= fraction x file_bytes``) — the
+#: measured proof that streaming never materialises the full-file host
+#: copy, i.e. the host-side high-water is O(slab) in the *data* term.
+#: An absolute maxrss ratio is the wrong committed form on the CPU
+#: proxy, where the device buffers themselves live in host RAM and
+#: dominate both children identically; the r22 run measured the saved
+#: bytes at 0.98x the file size (1008 of 1025 MB), exactly the
+#: full-copy elimination this rule pins.
+INGEST_STREAM_SAVED_MIN_FRACTION = 0.8
+
+
+def ingest_child() -> None:
+    """Subprocess body of ``bench_ingest`` (fresh processes are the
+    honest allocator/RSS boundary).  Tasks, via KMEANS_TPU_INGEST_CFG:
+
+    * ``pairs`` — interleaved (mono, slab) placement walls of an
+      in-memory (n, d) float32 matrix on the full-device mesh,
+      per-array checksums for the bit-parity column.
+    * ``mem_naive`` — ``np.load`` the whole ``.npy`` file, then place:
+      the O(rows) host high-water baseline.
+    * ``mem_stream`` — ``from_npy`` streamed ingest of the same file:
+      the O(slab) high-water contender.
+
+    Each prints one ``INGEST_JSON`` line with its measurements plus the
+    process's ``ru_maxrss``."""
+    import os
+    import resource
+
+    from kmeans_tpu.parallel.mesh import make_mesh
+    from kmeans_tpu.parallel.sharding import to_device
+    cfg = json.loads(os.environ["KMEANS_TPU_INGEST_CFG"])
+    mesh = make_mesh()
+    chunk = cfg.get("chunk") or 65536
+
+    def checksum(ds):
+        return [float(np.float64(np.asarray(ds.points)).sum()),
+                float(np.float64(np.asarray(ds.weights)).sum())]
+
+    out: Dict = {"task": cfg["task"]}
+    if cfg["task"] == "pairs":
+        rng = np.random.default_rng(0)
+        X = rng.random((cfg["n"], cfg["d"]), dtype=np.float32)
+        walls = {"mono": [], "slab": []}
+        sums = {}
+        for _ in range(cfg.get("reps", 3)):
+            for mode in ("mono", "slab"):
+                t0 = time.perf_counter()
+                ds = to_device(X, mesh, chunk, np.float32, ingest=mode)
+                ds.points.block_until_ready()
+                ds.weights.block_until_ready()
+                walls[mode].append(time.perf_counter() - t0)
+                sums[mode] = checksum(ds)
+                del ds
+        out.update(mono_s=walls["mono"], slab_s=walls["slab"],
+                   parity=sums["mono"] == sums["slab"])
+    else:
+        from kmeans_tpu.data.io import from_npy
+        if cfg["task"] == "mem_naive":
+            X = np.load(cfg["path"])
+            ds = to_device(X, mesh, chunk, np.float32, ingest="slab")
+        else:                                          # mem_stream
+            ds = from_npy(cfg["path"], mesh, chunk_size=chunk,
+                          ingest="slab")
+        ds.points.block_until_ready()
+        out["checksum"] = checksum(ds)
+    out["maxrss_mb"] = resource.getrusage(
+        resource.RUSAGE_SELF).ru_maxrss / 1024.0
+    print("INGEST_JSON " + json.dumps(out), flush=True)
+
+
+def _ingest_spawn(cfg: Dict) -> Dict:
+    """Run one ``ingest_child`` subprocess and parse its payload."""
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env["KMEANS_TPU_INGEST_CFG"] = json.dumps(cfg)
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "from kmeans_tpu.benchmarks import ingest_child; "
+         "ingest_child()"],
+        env=env, capture_output=True, text=True, timeout=1800)
+    for line in proc.stdout.splitlines():
+        if line.startswith("INGEST_JSON "):
+            return json.loads(line[len("INGEST_JSON "):])
+    raise RuntimeError(
+        f"ingest child produced no payload (exit {proc.returncode}):\n"
+        f"{proc.stderr[-2000:]}")
+
+
+def bench_ingest(n: int, d: int, *, k: int = 64, max_iter: int = 4,
+                 reps: int = 3, chunk: int = None,
+                 artifact_dir: str = "artifacts") -> List[Dict]:
+    """BENCH_INGEST=1: the staged-ingest decision rows (ISSUE 18).
+
+    * ``ingest_ratio`` — interleaved mono/slab placement walls of the
+      >= 1 GB proxy in one fresh process, medians + the committed
+      ``INGEST_ADOPT_RATIO`` adoption verdict (honest rejection below
+      the bar) and the bit-parity column.
+    * ``ingest_overlap`` — fresh-process (serial, overlapped) TTFI
+      pairs with the platform's RESOLVED ``'auto'`` ingest mode (the
+      shipping path: mono on CPU after the r22 rejection, slab on
+      accelerators): the measured window < serial stage-then-compile
+      wall PASS row, plus the re-measured place/stage share of TTFI.
+    * ``ingest_host_highwater`` — load-whole-file vs streamed
+      ``from_npy`` children over the same >= 1 GB ``.npy``; committed
+      rule (saved-copy form): ``naive_maxrss - stream_maxrss >=
+      INGEST_STREAM_SAVED_MIN_FRACTION x file_bytes``.
+    * ``ingest_plan_1e9`` — the 1e9-row weak-scaling config DECLARED
+      through ``obs.memory.plan_fit``/``plan_ingest`` (no device on
+      earth holds it otherwise): per-device resident bytes + slab
+      geometry at 256 shards, with the fits-16-GB-HBM verdict.
+    """
+    import os
+    import tempfile
+
+    from kmeans_tpu.obs.memory import plan_fit, plan_ingest
+
+    os.makedirs(artifact_dir, exist_ok=True)
+    shape = f"N{n}_D{d}"
+    bytes_total = n * d * 4
+
+    _log(f"bench: INGEST pairs process ({bytes_total / 2**30:.2f} GiB "
+         f"proxy, {reps} interleaved reps)...")
+    pairs = _ingest_spawn({"task": "pairs", "n": n, "d": d,
+                           "chunk": chunk, "reps": reps})
+    mono = sorted(pairs["mono_s"])[len(pairs["mono_s"]) // 2]
+    slab = sorted(pairs["slab_s"])[len(pairs["slab_s"]) // 2]
+    ratio = mono / slab if slab else None
+
+    # The overlap row measures the SHIPPING ingest mode — what
+    # resolve_ingest('auto') picks for this platform (mono on CPU after
+    # the r22 rejection, slab on accelerators).  Forcing 'slab' on a
+    # platform that just rejected it would stack the double-buffer
+    # staging threads on top of the overlap producer and measure a
+    # configuration nothing ships.
+    from kmeans_tpu.parallel.sharding import resolve_ingest
+    ov_mode = resolve_ingest("auto")
+    _log(f"bench: INGEST overlap pairs (fresh processes, "
+         f"ingest={ov_mode})...")
+    tn, td = max(200_000, n // 8), d
+    tbase = {"n": tn, "d": td, "k": k, "max_iter": max_iter,
+             "compile_cache": False, "ingest": ov_mode}
+    ov_windows, ser_windows, ov_runs = [], [], []
+    for i in range(reps):
+        ser = _ttfi_spawn({**tbase, "overlap": 0,
+                           "aot_dir": tempfile.mkdtemp(
+                               prefix="kmeans_tpu_ing_ser_")})
+        ovl = _ttfi_spawn({**tbase, "overlap": 1,
+                           "aot_dir": tempfile.mkdtemp(
+                               prefix="kmeans_tpu_ing_ov_")})
+        ov_runs.append(ovl)
+        ser_windows.append(ser["first"]["window_s"])
+        ov_windows.append(ovl["first"]["window_s"])
+    ov_sorted, ser_sorted = sorted(ov_windows), sorted(ser_windows)
+    ov_window = ov_sorted[len(ov_sorted) // 2]
+    ov_serial = ser_sorted[len(ser_sorted) // 2]
+    ov_med = ov_runs[ov_windows.index(ov_window)]
+    stage_share = (ov_med["first"]["stage_ms"]
+                   / (ov_med["first"]["ttfi_s"] * 1e3)
+                   if ov_med["first"]["ttfi_s"] else None)
+
+    _log("bench: INGEST host high-water children (.npy proxy)...")
+    with tempfile.TemporaryDirectory(prefix="kmeans_tpu_ing_") as td_:
+        path = os.path.join(td_, "proxy.npy")
+        rng = np.random.default_rng(0)
+        np.save(path, rng.random((n, d), dtype=np.float32))
+        naive = _ingest_spawn({"task": "mem_naive", "path": path,
+                               "chunk": chunk})
+        stream = _ingest_spawn({"task": "mem_stream", "path": path,
+                                "chunk": chunk})
+    rss_ratio = stream["maxrss_mb"] / naive["maxrss_mb"] \
+        if naive["maxrss_mb"] else None
+    saved_mb = naive["maxrss_mb"] - stream["maxrss_mb"]
+    file_mb = bytes_total / 2**20
+    saved_frac = saved_mb / file_mb if file_mb else None
+
+    plan = plan_fit("kmeans", 1_000_000_000, 64, 1024,
+                    data_shards=256, chunk=65536)
+    iplan = plan_ingest(1_000_000_000, 64, data_shards=256,
+                        chunk=65536)
+    hbm = 16 << 30
+    rows = [
+        {"metric": f"ingest_ratio_{shape}", "ingest": "slab",
+         "mono_s": round(mono, 4), "slab_s": round(slab, 4),
+         "ratio": round(ratio, 3) if ratio else None,
+         "reps_mono_s": [round(v, 4) for v in sorted(pairs["mono_s"])],
+         "reps_slab_s": [round(v, 4) for v in sorted(pairs["slab_s"])],
+         "bit_parity": pairs["parity"],
+         "rule": f"adopt slab into 'auto' at >= "
+                 f"{INGEST_ADOPT_RATIO} x mono/slab",
+         "rule_pass": bool(ratio is not None
+                           and ratio >= INGEST_ADOPT_RATIO)},
+        {"metric": f"ingest_overlap_N{tn}_D{td}_k{k}",
+         "ingest": ov_mode, **_row_of(ov_med["first"]),
+         "overlap_window_s": round(ov_window, 4),
+         "serial_wall_s": round(ov_serial, 4),
+         "ttfi_stage_share": (round(stage_share, 4)
+                              if stage_share is not None else None),
+         "rule": "median overlapped window < median serial "
+                 "stage-then-compile wall",
+         "rule_pass": bool(ov_window < ov_serial)},
+        {"metric": f"ingest_host_highwater_{shape}", "ingest": "slab",
+         "naive_maxrss_mb": round(naive["maxrss_mb"], 1),
+         "stream_maxrss_mb": round(stream["maxrss_mb"], 1),
+         "rss_ratio": round(rss_ratio, 3) if rss_ratio else None,
+         "saved_mb": round(saved_mb, 1),
+         "saved_file_frac": (round(saved_frac, 3)
+                             if saved_frac is not None else None),
+         "file_mb": round(file_mb, 1),
+         "parity": naive["checksum"] == stream["checksum"],
+         "rule": f"naive - stream maxrss >= "
+                 f"{INGEST_STREAM_SAVED_MIN_FRACTION} x file bytes "
+                 f"(streamed never holds the full-file host copy)",
+         "rule_pass": bool(saved_frac is not None and
+                           saved_frac >=
+                           INGEST_STREAM_SAVED_MIN_FRACTION)},
+        {"metric": "ingest_plan_1e9_D64_k1024", "ingest": "slab",
+         "declared": True, "data_shards": 256,
+         "resident_gb": round(
+             plan["predicted_resident_bytes"] / 2**30, 2),
+         "peak_gb": round(plan["predicted_peak_bytes"] / 2**30, 2),
+         "slab_mb": round(iplan["slab_bytes"] / 2**20, 1),
+         "slabs_per_host_shard": iplan["slabs"],
+         "total_tb": round(iplan["total_bytes"] / 2**40, 2),
+         "rule": "per-device peak fits 16 GB HBM",
+         "rule_pass": bool(plan["predicted_peak_bytes"] < hbm)},
+    ]
+    for r in rows:
+        print(json.dumps(r), flush=True)
+    _log("\n| row | key figures | rule |")
+    _log("|---|---|---|")
+    for r in rows:
+        fig = ", ".join(f"{k_}={v}" for k_, v in r.items()
+                        if k_ not in ("metric", "rule", "rule_pass")
+                        and not isinstance(v, (list, dict)))
+        _log(f"| {r['metric']} | {fig} | {r.get('rule', '-')}"
+             f"{' PASS' if r.get('rule_pass') else ' FAIL'} |")
+    return rows
 
 
 def main(argv=None) -> int:

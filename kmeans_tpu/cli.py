@@ -529,8 +529,9 @@ def trace_main(argv=None) -> int:
 
     from kmeans_tpu.obs import fleet as obs_fleet
     from kmeans_tpu.obs import trace as obs_trace
-    from kmeans_tpu.obs.report import (format_phase_table, merge_cost,
-                                       time_to_first_iteration)
+    from kmeans_tpu.obs.report import (format_ingest_table,
+                                       format_phase_table, ingest_breakdown,
+                                       merge_cost, time_to_first_iteration)
     merged = None
     try:
         paths = obs_fleet.expand_fleet_paths(args.file)
@@ -565,6 +566,10 @@ def trace_main(argv=None) -> int:
         except ValueError:
             ttfi = None              # no dispatch span — summary only
     cost = merge_cost(records) if args.cost else None
+    # Per-slab ingest attribution (ISSUE 18): present whenever the
+    # trace carries slab-staged 'stage' spans (single-file AND merged
+    # fleet traces — placement is per-host work either way).
+    slabs = ingest_breakdown(records)
 
     if args.chrome:
         with open(args.chrome, "w") as f:
@@ -575,6 +580,7 @@ def trace_main(argv=None) -> int:
         from kmeans_tpu.utils.profiling import sanitize_json
         out = {"files": paths, "phases": summary,
                "time_to_first_iteration": ttfi,
+               "ingest_slabs": slabs or None,
                "chrome": args.chrome}
         if merged is not None:
             out["fleet"] = {k: merged[k] for k in
@@ -625,6 +631,9 @@ def trace_main(argv=None) -> int:
     if ttfi is not None:
         print()
         print(format_phase_table(ttfi))
+    if slabs:
+        print()
+        print(format_ingest_table(slabs))
     if args.chrome:
         print(f"\nchrome trace written to {args.chrome} "
               f"(load in chrome://tracing or ui.perfetto.dev)")
@@ -935,7 +944,7 @@ _BENCH_DEFAULT_SPREAD = 0.05
 #: per table size under a shared method label); "replicas" the
 #: BENCH_FLEET 1->N scaling rows (ISSUE 17).
 _BENCH_DISCRIMINATORS = ("batch_requests", "batch", "clients", "k",
-                         "replicas")
+                         "replicas", "ingest")
 
 
 def _ttfi_trace_rows(records) -> list:

@@ -305,6 +305,72 @@ class _ReadaheadReader:
             start = hi
 
 
+def _streamed_place(read_rows, n: int, d: int, n_pad: int, dtype,
+                    sw: Optional[np.ndarray], x_sharding, w_sharding,
+                    prefetch: int):
+    """Per-host streamed placement (ISSUE 18d): each of THIS process's
+    device shards is read and placed as one slab, staged through the
+    ``data.prefetch`` producer so slab i+1's disk read + host->device
+    copy overlap slab i's transfer completion, and assembled via
+    ``jax.make_array_from_single_device_arrays``.  Host memory
+    high-water is O(slab * (prefetch + 2)) — the producer's documented
+    block bound — NOT O(local rows): a slab's host buffer is released
+    as soon as its transfer completes.  ``prefetch=0`` is the fully
+    synchronous oracle.  Multi-host: ``addressable_devices_indices_map``
+    yields only local shards, so each host touches only its own byte
+    ranges (the module's touch-only-local-bytes contract)."""
+    from kmeans_tpu.parallel.sharding import _shard_ranges, _w_slice
+    w_devs = {}
+    for lo, hi, devs in _shard_ranges(w_sharding, (n_pad,)):
+        w_devs[(lo, hi)] = devs
+    ranges = _shard_ranges(x_sharding, (n_pad, d))
+
+    def stage(item):
+        i, (lo, hi, devs) = item
+        # Per-slab 'stage' span on the PRODUCER tid — the timeline
+        # shows the reads/copies overlapping the consumer's completion
+        # waits, and the TTFI table attributes ingest per slab.
+        with _obs_trace.span("stage", slab=i, slabs=len(ranges),
+                             rows=hi - lo,
+                             bytes=(hi - lo) * (d + 1) * dtype.itemsize):
+            real_hi = min(hi, n)
+            if hi <= n:
+                xs = np.ascontiguousarray(
+                    np.asarray(read_rows(lo, real_hi), dtype=dtype))
+            else:
+                xs = np.zeros((hi - lo, d), dtype=dtype)
+                if real_hi > lo:
+                    xs[: real_hi - lo] = read_rows(lo, real_hi)
+            ws = _w_slice(sw, lo, hi, n, dtype)
+            parts = [("x", jax.device_put(xs, dev)) for dev in devs]
+            parts += [("w", jax.device_put(ws, dev))
+                      for dev in w_devs[(lo, hi)]]
+        return parts
+
+    x_parts, w_parts, pending = [], [], []
+    it = prefetch_iter(list(enumerate(ranges)), prefetch, stage=stage)
+    try:
+        for parts in it:
+            # Await the PREVIOUS slab only now, with this slab's copies
+            # already in flight — the double-buffer schedule; the wait
+            # is what releases the previous slab's host buffer.
+            for _, arr in pending:
+                arr.block_until_ready()
+            pending = parts
+            for tag, arr in parts:
+                (x_parts if tag == "x" else w_parts).append(arr)
+        for _, arr in pending:
+            arr.block_until_ready()
+    finally:
+        close_source(it)
+    _obs_metrics.REGISTRY.counter("ingest.slabs").inc(len(ranges))
+    points = jax.make_array_from_single_device_arrays(
+        (n_pad, d), x_sharding, x_parts)
+    weights = jax.make_array_from_single_device_arrays(
+        (n_pad,), w_sharding, w_parts)
+    return points, weights
+
+
 def _sharded_from_source(read_rows, n: int, d: int, mesh: Mesh,
                          chunk: int, dtype,
                          sample_weight: Optional[np.ndarray],
@@ -312,19 +378,23 @@ def _sharded_from_source(read_rows, n: int, d: int, mesh: Mesh,
                          explicit_chunk: bool = False,
                          prefetch: int = 0,
                          io_retries: int = 0,
-                         io_backoff: float = 0.05) -> ShardedDataset:
+                         io_backoff: float = 0.05,
+                         ingest: str = "auto") -> ShardedDataset:
     """Build a ShardedDataset whose shards pull rows via ``read_rows(lo, hi)``
     — each callback materializes only its own slice.  ``prefetch > 0``
-    wraps the reader in a :class:`_ReadaheadReader` of that depth, so
-    the disk read of the next shard slice overlaps the placement of the
-    current one.  ``io_retries > 0`` retries each (idempotent) slice
-    read through the deterministic-backoff policy; the counters land on
-    the returned dataset's ``io_stats`` (fits surface them as
-    ``io_retries_used_``)."""
+    overlaps the disk read of the next shard slice with the placement
+    of the current one (``ingest='slab'``: the streamed producer;
+    ``'mono'``: a :class:`_ReadaheadReader` under the blocking
+    per-shard assembly, the parity oracle).  ``io_retries > 0`` retries
+    each (idempotent) slice read through the deterministic-backoff
+    policy; the counters land on the returned dataset's ``io_stats``
+    (fits surface them as ``io_retries_used_``)."""
+    from kmeans_tpu.parallel.sharding import check_ingest, resolve_ingest
     data_shards, _ = mesh_shape(mesh)
     dtype = np.dtype(dtype)
     io_retries, io_backoff = check_io_knobs(io_retries, io_backoff)
     io_stats = IOStats()
+    mode = resolve_ingest(check_ingest(ingest))
     if io_retries:
         # Retry INSIDE the readahead wrapper, so background-thread reads
         # recover too (a failed readahead future would otherwise only
@@ -335,9 +405,11 @@ def _sharded_from_source(read_rows, n: int, d: int, mesh: Mesh,
     # multi-host mesh belongs to ANOTHER host past this host's last
     # local shard — it would read (and pin) up to ``depth`` never-
     # consumed slices and break the module's touch-only-local-bytes
-    # contract, so it is single-process only.
+    # contract, so it is single-process only.  The streamed path has
+    # its own producer (which walks exactly the local shards), so the
+    # wrapper serves only the mono oracle.
     prefetch = check_prefetch(prefetch)
-    if prefetch and jax.process_count() == 1:
+    if prefetch and mode != "slab" and jax.process_count() == 1:
         read_rows = _ReadaheadReader(read_rows, n, prefetch)
     n_pad = math.ceil(n / (data_shards * chunk)) * (data_shards * chunk)
 
@@ -372,8 +444,23 @@ def _sharded_from_source(read_rows, n: int, d: int, mesh: Mesh,
                                    else sw[lo:real_hi])
         return out
 
-    points = jax.make_array_from_callback((n_pad, d), x_sharding, x_cb)
-    weights = jax.make_array_from_callback((n_pad,), w_sharding, w_cb)
+    # 'stage' span (ISSUE 18): the whole source->shards placement; the
+    # streamed path nests per-slab children on the producer tid.
+    with _obs_trace.span("stage", rows=n,
+                         bytes=n * (d + 1) * dtype.itemsize,
+                         ingest=mode):
+        _obs_metrics.REGISTRY.counter("ingest.bytes").inc(
+            n * (d + 1) * dtype.itemsize)
+        if mode == "slab":
+            points, weights = _streamed_place(
+                read_rows, n, d, n_pad, dtype, sw, x_sharding,
+                w_sharding, prefetch)
+        else:
+            _obs_metrics.REGISTRY.counter("ingest.slabs").inc()
+            points = jax.make_array_from_callback(
+                (n_pad, d), x_sharding, x_cb)
+            weights = jax.make_array_from_callback(
+                (n_pad,), w_sharding, w_cb)
     ds = ShardedDataset(points, weights, n, chunk, mesh,
                         host=host_handle, host_weights=sw,
                         explicit_chunk=explicit_chunk)
@@ -397,7 +484,8 @@ def from_npy(path, mesh: Mesh, *, chunk_size: Optional[int] = None,
              budget_elems: Optional[int] = None,
              sample_weight: Optional[np.ndarray] = None,
              prefetch: int = 2, io_retries: int = 0,
-             io_backoff: float = 0.05) -> ShardedDataset:
+             io_backoff: float = 0.05,
+             ingest: str = "auto") -> ShardedDataset:
     """Shard a 2-D ``.npy`` file onto the mesh without loading it whole.
 
     ``k_hint`` feeds the automatic chunk-size choice (the (chunk, k)
@@ -420,6 +508,12 @@ def from_npy(path, mesh: Mesh, *, chunk_size: Optional[int] = None,
     backoff (``io_backoff * 2**(attempt-1)`` seconds) — slice reads are
     idempotent, so a retried load is bit-identical.  Retry counts land
     on the returned dataset's ``io_stats.retries_used``.
+
+    ``ingest`` (ISSUE 18d): ``'slab'`` streams each prefetched slice
+    straight into the local device shards (host high-water O(slab),
+    not O(local rows) — multi-host included); ``'mono'`` keeps the
+    blocking per-shard-callback assembly, the bit-parity oracle;
+    ``'auto'`` applies the committed BENCH_INGEST rule.
     """
     mm = np.load(path, mmap_mode="r")
     if mm.ndim != 2:
@@ -440,7 +534,7 @@ def from_npy(path, mesh: Mesh, *, chunk_size: Optional[int] = None,
                                 sample_weight, host_handle=mm,
                                 explicit_chunk=chunk_size is not None,
                                 prefetch=prefetch, io_retries=io_retries,
-                                io_backoff=io_backoff)
+                                io_backoff=io_backoff, ingest=ingest)
 
 
 def from_raw(path, shape: Tuple[int, int], mesh: Mesh, *,
@@ -450,11 +544,13 @@ def from_raw(path, shape: Tuple[int, int], mesh: Mesh, *,
              offset: int = 0,
              sample_weight: Optional[np.ndarray] = None,
              prefetch: int = 2, io_retries: int = 0,
-             io_backoff: float = 0.05) -> ShardedDataset:
+             io_backoff: float = 0.05,
+             ingest: str = "auto") -> ShardedDataset:
     """Shard a headerless binary file of ``shape`` row-major ``file_dtype``
     values (e.g. exported feature matrices) onto the mesh, reading each
-    shard's byte range only.  ``prefetch`` reads ahead and
-    ``io_retries``/``io_backoff`` retry flaky slice reads like
+    shard's byte range only.  ``prefetch`` reads ahead,
+    ``io_retries``/``io_backoff`` retry flaky slice reads, and
+    ``ingest`` picks the streamed/mono placement path like
     :func:`from_npy`'s."""
     n, d = shape
     mm = np.memmap(path, dtype=file_dtype, mode="r", offset=offset,
@@ -473,7 +569,7 @@ def from_raw(path, shape: Tuple[int, int], mesh: Mesh, *,
                                 sample_weight, host_handle=mm,
                                 explicit_chunk=chunk_size is not None,
                                 prefetch=prefetch, io_retries=io_retries,
-                                io_backoff=io_backoff)
+                                io_backoff=io_backoff, ingest=ingest)
 
 
 def iter_npy_blocks(path, block_rows: int, *, dtype=None,

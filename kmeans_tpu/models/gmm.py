@@ -181,7 +181,7 @@ class GaussianMixture(AutoCheckpointMixin):
                     "max_iter", "n_init", "init_params", "weights_init",
                     "means_init", "precisions_init", "seed", "dtype",
                     "mesh", "model_shards", "chunk_size", "host_loop",
-                    "pipeline", "bucket", "verbose")
+                    "pipeline", "bucket", "overlap", "ingest", "verbose")
 
     _ckpt_k_attr = "n_components"    # AutoCheckpointMixin resume check
 
@@ -193,7 +193,7 @@ class GaussianMixture(AutoCheckpointMixin):
                  seed: int = 42, dtype=None, mesh: Optional[Mesh] = None,
                  model_shards: int = 1, chunk_size: Optional[int] = None,
                  host_loop: bool = True, pipeline="auto",
-                 bucket=0,
+                 bucket=0, overlap="auto", ingest: str = "auto",
                  verbose: bool = False):
         if covariance_type not in ("diag", "spherical", "tied", "full"):
             raise ValueError(
@@ -251,6 +251,20 @@ class GaussianMixture(AutoCheckpointMixin):
         # with KMeans via parallel.sharding (one definition).
         from kmeans_tpu.parallel.sharding import check_bucket
         self.bucket = check_bucket(bucket)
+        # Compile/ingest overlap (ISSUE 18; the KMeans 15c grammar):
+        # with 1, a fit on a host array stages the upload through the
+        # prefetch producer thread while THIS thread resolves (and AOT-
+        # warms) the EM step programs — bit-exact parity with 0, only
+        # WHERE the prelude runs moves.  'auto': 0 on CPU, 1 on
+        # accelerators (the KMeans resolution, one policy).
+        if overlap not in ("auto", 0, 1, True, False):
+            raise ValueError(f"overlap must be 'auto', 0, or 1; got "
+                             f"{overlap!r}")
+        self.overlap = overlap if overlap == "auto" else int(overlap)
+        # Ingest placement path (ISSUE 18): grammar shared with KMeans
+        # via parallel.sharding; 'mono' is the bit-parity oracle.
+        from kmeans_tpu.parallel.sharding import check_ingest
+        self.ingest = check_ingest(ingest)
         self.verbose = verbose
 
         # Which E-step schedule the last fit IN THIS PROCESS ran
@@ -375,7 +389,7 @@ class GaussianMixture(AutoCheckpointMixin):
         return to_device(X, mesh, chunk, self.dtype,
                          sample_weight=sample_weight,
                          explicit=self.chunk_size is not None,
-                         min_rows=n_eff)
+                         min_rows=n_eff, ingest=self.ingest)
 
     def _bucket_target(self, n: int) -> int:
         """Padded-row target of the fit-shape bucket — the one
@@ -404,6 +418,88 @@ class GaussianMixture(AutoCheckpointMixin):
                  if self.covariance_type == "full" else self.n_components)
         return ds.effective_chunk(eff_k, EM_CHUNK_BUDGET,
                                   max_chunk=EM_MAX_CHUNK)
+
+    def _resolve_overlap(self) -> int:
+        """Resolve the ``overlap`` knob (ISSUE 18; the KMeans 15c
+        policy): serial on CPU — both TTFI terms are small there —
+        overlapped on accelerators, where the staged transfer is the
+        dominant term the compile should hide behind."""
+        if self.overlap == "auto":
+            return 0 if jax.default_backend() == "cpu" else 1
+        return int(self.overlap)
+
+    def _staged_dataset(self, X, sample_weight=None) -> ShardedDataset:
+        """The EM fit's dataset prelude (ISSUE 18b): with ``overlap``
+        resolved on and a host-array input, the upload runs in the
+        prefetch producer thread (``data.prefetch``; its
+        'place'/'stage' spans land on the producer tid) while THIS
+        thread resolves — and, with an AOT store active,
+        loads-or-compiles — the E-step program for the exact padded
+        shapes the fit will dispatch (the r19 ``utils.aot`` overlap
+        entry point, now on the EM prelude too).  Bit-exact parity
+        with the serial path: only WHERE the prelude runs moves."""
+        if not self._resolve_overlap() or isinstance(X, ShardedDataset) \
+                or jax.process_count() != 1:
+            return self._dataset(X, sample_weight)
+        X = np.asarray(X, dtype=self.dtype)
+        if X.ndim != 2:
+            return self._dataset(X, sample_weight)
+        from kmeans_tpu.data.prefetch import close_source, prefetch_iter
+        it = prefetch_iter([X], 1,
+                           stage=lambda B: self._dataset(B, sample_weight))
+        try:
+            self._warm_em(*X.shape)
+            ds = next(it)
+        finally:
+            close_source(it)
+        return ds
+
+    def _warm_em(self, n: int, d: int) -> None:
+        """Resolve (and AOT-warm) the E-step program for the (n, d) fit
+        about to run — the consumer half of the overlapped prelude.
+        The chunk derivation mirrors ``_dataset`` + ``_eff_chunk``
+        exactly (the shapes are known before any data moves), so the
+        later ``_get_fns`` at the normal fit call site is a pure cache
+        hit.  Warming builds sharding-carrying ``ShapeDtypeStruct``s
+        for the diag/spherical table layout; tied/full skip the warm
+        (their tables are host-factorized per M-step) but still get
+        the program resolution overlapped with the ingest."""
+        mesh = self._resolve_mesh()
+        data_shards, _ = mesh_shape(mesh)
+        eff_k = (self.n_components * d
+                 if self.covariance_type == "full" else self.n_components)
+        n_eff = self._bucket_target(n)
+        chunk = self.chunk_size or choose_chunk_size(
+            -(-n_eff // data_shards), eff_k, d,
+            budget_elems=EM_CHUNK_BUDGET)
+        if not self.chunk_size:
+            from kmeans_tpu.parallel.sharding import clamp_chunk_for_k
+            chunk = clamp_chunk_for_k(chunk, eff_k, EM_CHUNK_BUDGET,
+                                      max_chunk=EM_MAX_CHUNK)
+        step_fn, _ = _get_fns(mesh, chunk, self.covariance_type,
+                              self._resolve_pipeline())
+        if not hasattr(step_fn, "warm") \
+                or self.covariance_type not in ("diag", "spherical"):
+            return
+        from jax.sharding import SingleDeviceSharding
+        from kmeans_tpu.parallel.mesh import DATA_AXIS
+        mult = data_shards * chunk
+        n_pad = -(-max(n_eff, n) // mult) * mult
+        k_pad = self._k_pad
+        sds = jax.ShapeDtypeStruct
+        row = NamedSharding(mesh, P(MODEL_AXIS, None))
+        vec = NamedSharding(mesh, P(MODEL_AXIS))
+        step_fn.warm(
+            sds((n_pad, d), self.dtype,
+                sharding=NamedSharding(mesh, P(DATA_AXIS, None))),
+            sds((n_pad,), self.dtype,
+                sharding=NamedSharding(mesh, P(DATA_AXIS))),
+            sds((d,), self.dtype,
+                sharding=SingleDeviceSharding(jax.devices()[0])),
+            sds((k_pad, d), self.dtype, sharding=row),
+            sds((k_pad, d), self.dtype, sharding=row),
+            sds((k_pad,), self.dtype, sharding=vec),
+            sds((k_pad,), self.dtype, sharding=vec))
 
     def _shift(self) -> np.ndarray:
         """The centering shift (data's global mean), zeros pre-fit."""
@@ -800,7 +896,7 @@ class GaussianMixture(AutoCheckpointMixin):
                                             checkpoint_path)
         self.cov_jitter_retries_ = 0
         resume = self._resolve_resume(resume)
-        ds = self._dataset(X, sample_weight)
+        ds = self._staged_dataset(X, sample_weight)
         self.io_retries_used_ = getattr(
             getattr(ds, "io_stats", None), "retries_used", 0)
         mesh = self._resolve_mesh()
@@ -2131,6 +2227,7 @@ class GaussianMixture(AutoCheckpointMixin):
             "model_shards": self.model_shards,
             "chunk_size": self.chunk_size, "host_loop": self.host_loop,
             "pipeline": self.pipeline, "bucket": self.bucket,
+            "overlap": self.overlap, "ingest": self.ingest,
             "verbose": self.verbose, "dtype": str(self.dtype),
             "weights_": np.asarray(self.weights_)
             if self.weights_ is not None else np.zeros((0,)),
@@ -2264,6 +2361,11 @@ class GaussianMixture(AutoCheckpointMixin):
                     # Pre-r19 checkpoints carry no bucket -> exact shape.
                     bucket=(lambda b: b if isinstance(b, str)
                             else int(b))(state.get("bucket", 0)),
+                    # Pre-r22 checkpoints carry neither knob -> the
+                    # per-run platform/committed-rule resolutions.
+                    overlap=(lambda o: o if isinstance(o, str)
+                             else int(o))(state.get("overlap", "auto")),
+                    ingest=str(state.get("ingest", "auto")),
                     verbose=bool(state["verbose"]),
                     dtype=np.dtype(str(state["dtype"])), **inits)
         model._restore_state(state)

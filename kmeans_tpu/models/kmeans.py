@@ -229,6 +229,15 @@ class KMeans(AutoCheckpointMixin):
         CPU (both terms are small; keeps the serial trace shape) and 1
         on accelerators, where the transfer is the dominant TTFI term
         (docs/PERFORMANCE.md).
+    ingest : 'auto' (default) | 'mono' | 'slab' — the host->device
+        placement path (ISSUE 18): 'slab' groups device shards into
+        HBM-planner-sized slabs uploaded double-buffered (slab i+1's
+        host->device copy overlaps slab i's completion), 'mono' is the
+        one-blocking-assembly parity oracle; the assembled array is
+        byte-identical either way, so fits are bit-exact across modes.
+        Both paths pad only the final shard's tail (no full-dataset
+        host pad copy).  'auto' applies the committed BENCH_INGEST
+        decision rule (docs/PERFORMANCE.md "Ingest pipeline").
     host_loop : True (reference per-iteration driver semantics: host-side
         f64 division, per-iteration logging, host empty-cluster policy) |
         False (the WHOLE fit as one device-side ``lax.while_loop``
@@ -288,6 +297,7 @@ class KMeans(AutoCheckpointMixin):
                  pipeline: Union[str, int] = "auto",
                  bucket: Union[str, int] = 0,
                  overlap: Union[str, int] = "auto",
+                 ingest: str = "auto",
                  k_shard: Union[str, int] = "auto",
                  assign: str = "auto",
                  coarse_cells: Optional[int] = None,
@@ -370,6 +380,12 @@ class KMeans(AutoCheckpointMixin):
             raise ValueError(f"overlap must be 'auto', 0, or 1; got "
                              f"{overlap!r}")
         self.overlap = overlap if overlap == "auto" else int(overlap)
+        # Ingest placement path (ISSUE 18): 'mono' is the bit-parity
+        # oracle, 'slab' the staged double-buffered path; grammar in
+        # parallel.sharding (one definition for both families, the
+        # loaders, and the CLI).
+        from kmeans_tpu.parallel.sharding import check_ingest
+        self.ingest = check_ingest(ingest)
         # Massive-k tier (ISSUE 16).  Knob grammar follows the pipeline/
         # bucket convention: ``k_shard=0`` and ``assign='dense'`` are
         # the bit-exact dense parity oracles; 'auto' resolves per fit
@@ -593,7 +609,8 @@ class KMeans(AutoCheckpointMixin):
                          self._chunk_for(*X.shape), self.dtype,
                          sample_weight=sample_weight,
                          explicit=self.chunk_size is not None,
-                         min_rows=self._bucket_target(X.shape[0]))
+                         min_rows=self._bucket_target(X.shape[0]),
+                         ingest=self.ingest)
 
     def _dataset(self, X) -> ShardedDataset:
         """Accept an (n, D) array-like or an already-cached ShardedDataset."""
@@ -2784,8 +2801,8 @@ class KMeans(AutoCheckpointMixin):
                     "init", "n_init", "compute_labels", "empty_cluster",
                     "dtype", "mesh", "model_shards", "chunk_size",
                     "distance_mode", "host_loop", "pipeline", "bucket",
-                    "overlap", "k_shard", "assign", "coarse_cells",
-                    "nprobe", "init_cap", "verbose")
+                    "overlap", "ingest", "k_shard", "assign",
+                    "coarse_cells", "nprobe", "init_cap", "verbose")
 
     def get_params(self, deep: bool = True) -> dict:
         """Constructor parameters as a dict (sklearn estimator protocol —
@@ -2912,6 +2929,7 @@ class KMeans(AutoCheckpointMixin):
             "pipeline": self.pipeline,
             "bucket": self.bucket,
             "overlap": self.overlap,
+            "ingest": self.ingest,
             "k_shard": self.k_shard,
             "assign": self.assign,
             "coarse_cells": self.coarse_cells,
@@ -3000,6 +3018,10 @@ class KMeans(AutoCheckpointMixin):
                             else int(b))(state.get("bucket", 0)),
                     overlap=(lambda o: o if isinstance(o, str)
                              else int(o))(state.get("overlap", "auto")),
+                    # Pre-r22 checkpoints have no ingest knob -> the
+                    # committed-rule default (a per-run placement
+                    # resolution, not fitted state).
+                    ingest=str(state.get("ingest", "auto")),
                     # Pre-r20 checkpoints have no massive-k knobs ->
                     # the planner-resolved ('auto') defaults.
                     k_shard=(lambda v: v if isinstance(v, str)

@@ -288,6 +288,82 @@ class MiniBatchKMeans(KMeans):
                 f"reclaim it")
         return True
 
+    def _mb_step_getter(self, mesh, bs_local: int, mode: str):
+        """The sampling-step cache accessor — ONE key construction
+        shared by the fit body and the overlapped prelude's warm
+        (duplicating the tuple risks silent divergence)."""
+        from kmeans_tpu.parallel import distributed as dist
+
+        def get_step(nc: int):
+            return _STEP_CACHE.get_or_create(
+                (mesh, bs_local, mode, nc, "mbstep"),
+                lambda: dist.make_minibatch_step_fn(
+                    mesh, batch_per_shard=bs_local, mode=mode,
+                    n_candidates=nc))
+        return get_step
+
+    def _staged_dataset(self, X):
+        """The mini-batch fit's dataset prelude (ISSUE 18b): with
+        ``overlap`` resolved on and a host-array input, the upload runs
+        in the prefetch producer thread while THIS thread resolves —
+        and, with an AOT store active, loads-or-compiles — the fused
+        sampling-step program (the r19 ``utils.aot`` overlap entry
+        point, on the mini-batch prelude too).  Bit-exact parity with
+        the serial path: only WHERE the prelude runs moves."""
+        import jax
+        from kmeans_tpu.parallel.sharding import ShardedDataset
+        if not self._resolve_overlap() or isinstance(X, ShardedDataset) \
+                or jax.process_count() != 1:
+            return self._dataset(X)
+        X = np.asarray(X, dtype=self.dtype)
+        if X.ndim != 2:
+            return self._dataset(X)
+        from kmeans_tpu.data.prefetch import close_source, prefetch_iter
+        it = prefetch_iter([X], 1, stage=self.cache)
+        try:
+            self._warm_mb(*X.shape)
+            ds = next(it)
+        finally:
+            close_source(it)
+        return ds
+
+    def _warm_mb(self, n: int, d: int) -> None:
+        """Resolve (and AOT-warm) the per-iteration sampling step for
+        the (n, d) fit about to run — the consumer half of the
+        overlapped prelude.  Derivations mirror ``_fit_device``'s
+        exactly (batch/mode from shapes known before any data moves),
+        so the fit-body cache lookups are pure hits.  Only the plain
+        step is warmed (the candidate variant and the device-loop
+        program dispatch later, off the TTFI path — the KMeans
+        warm-only-what-will-run discipline)."""
+        import jax
+        from jax.sharding import NamedSharding, SingleDeviceSharding
+        from jax.sharding import PartitionSpec as P
+        from kmeans_tpu.parallel import distributed as dist
+        from kmeans_tpu.parallel.mesh import DATA_AXIS, mesh_shape
+        mesh = self._resolve_mesh()
+        data_shards, model_shards = mesh_shape(mesh)
+        bs_local = max(8, -(-min(self.batch_size, n) // data_shards))
+        mode = self._mode(bs_local, d)
+        step_fn = self._mb_step_getter(mesh, bs_local, mode)(0)
+        if not hasattr(step_fn, "warm") or self.host_loop is False:
+            return
+        chunk = self._chunk_for(n, d)
+        mult = data_shards * chunk
+        n_pad = -(-max(self._bucket_target(n), n) // mult) * mult
+        k_pad = -(-self.k // model_shards) * model_shards
+        sds = jax.ShapeDtypeStruct
+        step_fn.warm(
+            sds((n_pad, d), self.dtype,
+                sharding=NamedSharding(mesh, P(DATA_AXIS, None))),
+            sds((n_pad,), self.dtype,
+                sharding=NamedSharding(mesh, P(DATA_AXIS))),
+            sds((k_pad, d), self.dtype,
+                sharding=dist.centroid_sharding(mesh)),
+            sds((2,), np.uint32,
+                sharding=SingleDeviceSharding(jax.devices()[0])),
+            sds((), np.int32))
+
     def _fit_device(self, X, *, resume: bool, checkpoint_every: int = 0,
                     checkpoint_path=None) -> "MiniBatchKMeans":
         """On-device sampling engine: resident dataset, one dispatch per
@@ -296,7 +372,7 @@ class MiniBatchKMeans(KMeans):
         from kmeans_tpu.parallel import distributed as dist
         from kmeans_tpu.parallel.mesh import mesh_shape
 
-        ds = self._dataset(X)                  # host copy NOT required
+        ds = self._staged_dataset(X)           # host copy NOT required
         mesh = self._resolve_mesh()
         data_shards, model_shards = mesh_shape(mesh)
         bs = min(self.batch_size, ds.n)
@@ -347,13 +423,7 @@ class MiniBatchKMeans(KMeans):
         n_cand = self.k if self.reassignment_ratio > 0 else 0
         re_every = self._reassign_every(bs_local * data_shards)
 
-        def get_step(nc: int):
-            return _STEP_CACHE.get_or_create(
-                (mesh, bs_local, mode, nc, "mbstep"),
-                lambda: dist.make_minibatch_step_fn(
-                    mesh, batch_per_shard=bs_local, mode=mode,
-                    n_candidates=nc))
-
+        get_step = self._mb_step_getter(mesh, bs_local, mode)
         step_fn = get_step(0)
         # Candidate variant dispatched ONLY on reassignment iterations —
         # the candidate Gumbel stream is keyed independently of the batch

@@ -38,8 +38,9 @@ from typing import Dict, List, Optional
 from kmeans_tpu.obs import trace as _trace
 from kmeans_tpu.obs.metrics_registry import REGISTRY
 
-__all__ = ["plan_fit", "device_memory_info", "advise_dispatch",
-           "format_plan_table", "FAMILIES"]
+__all__ = ["plan_fit", "plan_ingest", "device_memory_info",
+           "advise_dispatch", "format_plan_table", "FAMILIES",
+           "INGEST_SLAB_TARGET_BYTES"]
 
 #: Families the planner models (the five shipped fit engines; the three
 #: non-diag mixture covariance shapes ride on the ``cov_type`` knob).
@@ -157,6 +158,59 @@ def plan_fit(family: str, n: int, d: int, k: int, *,
     if observed is not None:
         plan["observed_peak_bytes"] = observed
     return plan
+
+
+#: Staged-ingest slab granularity target (ISSUE 18): how many bytes of
+#: host->device transfer the slabbed placement keeps in flight per slab.
+#: 64 MB is large enough to amortize per-transfer dispatch overhead on
+#: every PJRT backend measured and small enough that the double-buffered
+#: pair (2 slabs in flight) stays far below any chip's HBM headroom; on
+#: backends reporting allocator stats the effective target additionally
+#: caps at 1/8 of the device's free bytes, so staging can never become
+#: the allocation that OOMs the fit it feeds.
+INGEST_SLAB_TARGET_BYTES = 64 << 20
+
+
+def plan_ingest(n: int, d: int, *, data_shards: int = 1,
+                chunk: int = 1, dtype="float32") -> dict:
+    """Slab geometry for the staged ingest path (ISSUE 18): how the
+    ``ingest='slab'`` placement groups device shards into staging slabs.
+
+    Mirrors the placement arithmetic of ``parallel.sharding``: rows pad
+    to ``data_shards * chunk`` multiples and each device shard holds
+    ``n_pad / data_shards`` rows.  A slab is a group of WHOLE shards
+    (``make_array_from_single_device_arrays`` assembles per-device
+    buffers, so a shard is the smallest stageable unit); the group size
+    targets :data:`INGEST_SLAB_TARGET_BYTES`, capped at 1/8 of the
+    device's reported free bytes when the backend exposes allocator
+    stats.  Double-buffering keeps at most two slabs in flight, so the
+    transfer high-water is ``2 * slab_bytes``.
+    """
+    item = _itemsize(dtype)
+    data_shards = max(1, int(data_shards))
+    chunk = max(1, int(chunk))
+    mult = data_shards * chunk
+    n_pad = -(-int(n) // mult) * mult
+    shard_rows = n_pad // data_shards
+    shard_bytes = shard_rows * int(d) * item
+    target = INGEST_SLAB_TARGET_BYTES
+    free = device_memory_info()
+    if free.get("available") and free.get("bytes_free"):
+        target = min(target, max(free["bytes_free"] // 8, 1))
+    slab_shards = max(1, min(data_shards,
+                             target // max(shard_bytes, 1)))
+    slabs = -(-data_shards // slab_shards)
+    return {
+        "n": int(n), "d": int(d), "n_pad": n_pad,
+        "data_shards": data_shards, "chunk": chunk,
+        "dtype": str(getattr(dtype, "name", dtype)),
+        "shard_rows": shard_rows, "shard_bytes": shard_bytes,
+        "slab_shards": slab_shards, "slabs": slabs,
+        "slab_rows": slab_shards * shard_rows,
+        "slab_bytes": slab_shards * shard_bytes,
+        "target_bytes": target,
+        "total_bytes": n_pad * int(d) * item,
+    }
 
 
 #: family -> the compile-cache whose step program carries that family's

@@ -34,7 +34,8 @@ from kmeans_tpu.obs import trace as _trace
 
 __all__ = ["ttfi_ladder", "time_to_first_iteration",
            "format_phase_table", "TTFI_PHASES", "merge_cost",
-           "format_cost_table", "device_cost_report"]
+           "format_cost_table", "device_cost_report",
+           "ingest_breakdown", "format_ingest_table"]
 
 #: Lifecycle order of the pre-first-iteration phase rows.
 TTFI_PHASES = ("place", "stage", "trace", "compile", "seed")
@@ -124,6 +125,52 @@ def time_to_first_iteration(records: List[dict],
                 row["bytes_accessed"] = c["bytes_accessed"]
                 row["ai"] = c["ai"]
     return rows
+
+
+def ingest_breakdown(records: List[dict]) -> List[dict]:
+    """Per-slab ingest attribution (ISSUE 18): the ``stage`` spans
+    carrying a ``slab`` attr — one per slab-staged upload group, emitted
+    by the slab/streamed placement paths — rolled into rows of
+    ``{"slab", "slabs", "rows", "bytes", "ms"}`` in upload order.  ``ms``
+    is the span's SELF time (the host-side slice/copy + device_put issue
+    + previous-slab completion wait), so the rows sum to the ``stage``
+    phase row's slab-staged share in the TTFI table instead of hiding
+    inside one opaque number.  Empty list when the trace holds no
+    slab-attributed stage spans (mono ingest, or no ingest at all)."""
+    spans = [r for r in records if r.get("kind") == "span"]
+    selfs = _trace.self_times(records)
+    rows = []
+    for s in sorted(spans, key=lambda s: s["t0"]):
+        attrs = s.get("attrs", {}) or {}
+        if s["name"] == "stage" and "slab" in attrs:
+            rows.append({"slab": int(attrs["slab"]),
+                         "slabs": attrs.get("slabs"),
+                         "rows": attrs.get("rows"),
+                         "bytes": attrs.get("bytes"),
+                         "ms": selfs[s["id"]] * 1e3})
+    return rows
+
+
+def format_ingest_table(rows: List[dict], title: str =
+                        "ingest slabs (stage self-time per slab)") -> str:
+    """Fixed-width text rendering of an :func:`ingest_breakdown` —
+    printed under the TTFI table by ``trace summarize`` when the trace
+    carries slab-staged ingest."""
+    lines = [f"{title}:",
+             f"  {'slab':>6} {'rows':>10} {'bytes':>12} {'ms':>10}"]
+    t_rows = t_bytes = 0
+    t_ms = 0.0
+    for r in rows:
+        lines.append(f"  {r['slab']:>6} "
+                     f"{(r['rows'] if r['rows'] is not None else '-'):>10} "
+                     f"{(r['bytes'] if r['bytes'] is not None else '-'):>12} "
+                     f"{r['ms']:>10.2f}")
+        t_rows += int(r["rows"] or 0)
+        t_bytes += int(r["bytes"] or 0)
+        t_ms += r["ms"]
+    lines.append(f"  {'TOTAL':>6} {t_rows:>10} {t_bytes:>12} "
+                 f"{t_ms:>10.2f}")
+    return "\n".join(lines)
 
 
 def merge_cost(records: List[dict]) -> Dict[str, dict]:

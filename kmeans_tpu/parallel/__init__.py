@@ -20,18 +20,23 @@ analogue, a capability the reference lacks — SURVEY.md §2.3).
 """
 
 from kmeans_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
-from kmeans_tpu.parallel.sharding import (ShardedDataset, pad_points,
-                                          shard_points, to_device)
+from kmeans_tpu.parallel.sharding import (INGEST_MODES, ShardedDataset,
+                                          check_ingest, pad_points,
+                                          resolve_ingest, shard_points,
+                                          to_device)
 from kmeans_tpu.parallel.distributed import make_step_fn, make_predict_fn
 
 __all__ = [
     "DATA_AXIS",
+    "INGEST_MODES",
     "MODEL_AXIS",
     "ShardedDataset",
+    "check_ingest",
     "make_mesh",
     "make_step_fn",
     "make_predict_fn",
     "pad_points",
+    "resolve_ingest",
     "shard_points",
     "to_device",
 ]

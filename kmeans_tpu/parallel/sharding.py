@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from kmeans_tpu.obs import metrics_registry as _obs_metrics
 from kmeans_tpu.obs import trace as _obs_trace
 from kmeans_tpu.parallel.mesh import DATA_AXIS, mesh_shape
 
@@ -324,9 +325,185 @@ def pad_points(x: np.ndarray, multiple: int,
     return x, w
 
 
+#: The ingest-mode knob grammar (ISSUE 18): how host rows become
+#: mesh-sharded device arrays.  ``'mono'`` is the pinned parity oracle —
+#: one blocking per-shard assembly (``make_array_from_callback``, shard
+#: slices read as views, only the final shard's tail freshly padded);
+#: ``'slab'`` is the staged path — shards grouped into HBM-planner-sized
+#: slabs, uploaded double-buffered via
+#: ``make_array_from_single_device_arrays`` so slab i+1's host->device
+#: copy overlaps slab i's transfer completion.  The assembled array is
+#: byte-identical either way (pinned, tests/test_ingest.py), so the
+#: choice is purely a cost call; ``'auto'`` applies the committed
+#: BENCH_INGEST decision rule (see :func:`resolve_ingest`).
+INGEST_MODES = ("auto", "mono", "slab")
+
+
+def check_ingest(ingest) -> str:
+    """Validate the ``ingest`` knob grammar shared by the loaders and
+    every family constructor: ``'auto' | 'mono' | 'slab'`` — ONE
+    definition, the ``check_bucket`` convention."""
+    if ingest not in INGEST_MODES:
+        raise ValueError(f"ingest must be one of {INGEST_MODES}, "
+                         f"got {ingest!r}")
+    return ingest
+
+
+def resolve_ingest(ingest) -> str:
+    """Resolve ``ingest='auto'`` to the path that runs, per backend.
+
+    Committed decision rule (BENCH_INGEST=1, the r8/r12 measured-adopt
+    discipline): the slabbed path joins ``'auto'`` on a platform only
+    where its measured slab-vs-mono ingest ratio on the >= 1 GB proxy
+    reaches the 1.2x adopt bar.  The CPU proxy is a **measured
+    rejection** (BASELINE.md r22): median mono/slab = 1.04x on the
+    1 GiB single-core box — both paths bottleneck on the same host
+    memcpy bandwidth, and with one core the double-buffered schedule
+    has nothing to overlap against, so slab is parity, not a win.
+    Hence
+    'auto' -> 'mono' on CPU.  Accelerators keep 'auto' -> 'slab':
+    the per-slab ``device_put``s hand copies to the DMA engine, which
+    genuinely runs concurrently with the host slicing the next slab
+    (the hardware row pins the ratio at the headline shape, same
+    decision rule).  Explicit modes pass through untouched — both
+    paths assemble byte-identical arrays, so forcing either is always
+    safe and ``'mono'`` stays the reachable parity oracle.
+    """
+    if ingest == "auto":
+        return "mono" if jax.default_backend() == "cpu" else "slab"
+    return ingest
+
+
+def _shard_ranges(sharding, global_shape) -> list:
+    """Per-addressable-shard placement plan: ``[(lo, hi, [devices])]``
+    sorted by row range.  Devices sharing a row range (tensor-parallel
+    replication along the model axis) group together — each still
+    receives its own copy of the slice."""
+    by_range = {}
+    for dev, idx in sharding.addressable_devices_indices_map(
+            tuple(global_shape)).items():
+        rows = idx[0]
+        lo = rows.start or 0
+        hi = rows.stop if rows.stop is not None else global_shape[0]
+        by_range.setdefault((lo, hi), []).append(dev)
+    return [(lo, hi, devs) for (lo, hi), devs in
+            sorted(by_range.items())]
+
+
+def _x_slice(x: np.ndarray, lo: int, hi: int, n: int) -> np.ndarray:
+    """Rows [lo, hi) of the padded point matrix: a VIEW of ``x`` for
+    fully-real ranges (no host copy — the pad-last-slab contract), a
+    freshly zero-padded buffer only where the range crosses ``n``."""
+    if hi <= n:
+        return x[lo:hi]
+    out = np.zeros((hi - lo, x.shape[1]), dtype=x.dtype)
+    if lo < n:
+        out[: n - lo] = x[lo:n]
+    return out
+
+
+def _w_slice(sw: Optional[np.ndarray], lo: int, hi: int, n: int,
+             dtype) -> np.ndarray:
+    """Rows [lo, hi) of the padded weight vector.  With explicit
+    ``sample_weight`` the fully-real ranges are VIEWS of the validated
+    weight array (ISSUE 18 satellite: the weighted path used to build a
+    full-size ones buffer even when already aligned); padding tails are
+    zeros, unweighted ranges ones."""
+    if sw is not None and hi <= n:
+        return sw[lo:hi]
+    if hi <= n:
+        return np.ones(hi - lo, dtype=dtype)
+    out = np.zeros(hi - lo, dtype=dtype)
+    if lo < n:
+        out[: n - lo] = 1.0 if sw is None else sw[lo:n]
+    return out
+
+
+def _mono_place(x, sw, n, n_pad, xsh, wsh, dtype):
+    """The monolithic parity-oracle placement: one blocking per-shard
+    assembly per array; shard slices are host views except the final
+    padded tail (``_x_slice``/``_w_slice``)."""
+    d = x.shape[1]
+
+    def x_cb(index):
+        rows = index[0]
+        return np.ascontiguousarray(_x_slice(
+            x, rows.start or 0,
+            rows.stop if rows.stop is not None else n_pad, n))
+
+    def w_cb(index):
+        rows = index[0]
+        return np.ascontiguousarray(_w_slice(
+            sw, rows.start or 0,
+            rows.stop if rows.stop is not None else n_pad, n, dtype))
+
+    # Nested 'stage' span (no ``slab`` attr: this IS the unstaged
+    # oracle) — the blocking assembly lands on the ingest timeline
+    # like every placement body (the ingest-span rule).
+    with _obs_trace.span("stage", ingest="mono", rows=int(n_pad),
+                         bytes=int(n_pad) * d * x.itemsize):
+        points = jax.make_array_from_callback((n_pad, d), xsh, x_cb)
+        weights = jax.make_array_from_callback((n_pad,), wsh, w_cb)
+    return points, weights
+
+
+def _slab_place(x, sw, n, n_pad, xsh, wsh, dtype, chunk_size: int,
+                data_shards: int):
+    """The slab-staged placement (ISSUE 18 tentpole): device shards
+    grouped into HBM-planner-sized slabs (``obs.memory.plan_ingest``),
+    each slab's per-device buffers uploaded with async ``device_put``
+    and assembled once via ``make_array_from_single_device_arrays``.
+    Double-buffered: slab i's completion is awaited only AFTER slab
+    i+1's host->device copies are in flight, so transfer and completion
+    overlap while at most two slabs' buffers stay pinned."""
+    from kmeans_tpu.obs.memory import plan_ingest
+    d = x.shape[1]
+    plan = plan_ingest(n_pad, d, data_shards=data_shards,
+                       chunk=chunk_size, dtype=dtype)
+    ranges = _shard_ranges(xsh, (n_pad, d))
+    w_devs = {}
+    for lo, hi, devs in _shard_ranges(wsh, (n_pad,)):
+        w_devs[(lo, hi)] = devs
+    g = plan["slab_shards"]
+    slabs = [ranges[i: i + g] for i in range(0, len(ranges), g)]
+    x_parts, w_parts = [], []
+    pending = []
+    for i, slab in enumerate(slabs):
+        rows = sum(hi - lo for lo, hi, _ in slab)
+        # Per-slab 'stage' span (ISSUE 18 satellite): the TTFI table
+        # attributes ingest cost slab by slab instead of one opaque
+        # stage row.
+        with _obs_trace.span("stage", slab=i, slabs=len(slabs),
+                             rows=rows, bytes=rows * d * x.itemsize):
+            cur = []
+            for lo, hi, devs in slab:
+                xs = _x_slice(x, lo, hi, n)
+                ws = _w_slice(sw, lo, hi, n, dtype)
+                for dev in devs:
+                    cur.append(jax.device_put(xs, dev))
+                    x_parts.append(cur[-1])
+                for dev in w_devs[(lo, hi)]:
+                    cur.append(jax.device_put(ws, dev))
+                    w_parts.append(cur[-1])
+            # Await the PREVIOUS slab only now, with this slab's copies
+            # already in flight — the double-buffer schedule.
+            for arr in pending:
+                arr.block_until_ready()
+            pending = cur
+    for arr in pending:
+        arr.block_until_ready()
+    _obs_metrics.REGISTRY.counter("ingest.slabs").inc(len(slabs))
+    points = jax.make_array_from_single_device_arrays(
+        (n_pad, d), xsh, x_parts)
+    weights = jax.make_array_from_single_device_arrays(
+        (n_pad,), wsh, w_parts)
+    return points, weights
+
+
 def shard_points(x: np.ndarray, mesh: Optional[Mesh], chunk_size: int,
                  sample_weight: Optional[np.ndarray] = None,
-                 min_rows: int = 0) -> Tuple[jax.Array, jax.Array]:
+                 min_rows: int = 0,
+                 ingest: str = "auto") -> Tuple[jax.Array, jax.Array]:
     """Pad and place (points, weights) sharded along the mesh's data axis.
 
     ``sample_weight`` (n,) is folded into the padding mask (padding rows stay
@@ -334,24 +511,48 @@ def shard_points(x: np.ndarray, mesh: Optional[Mesh], chunk_size: int,
     the single-chip path, same downstream code.  ``min_rows`` raises the
     padding target to a shape-bucket boundary (ISSUE 15b; extra rows are
     inert zero-weight sentinels like all shard padding).
+
+    ``ingest`` (ISSUE 18) picks the placement path: ``'mono'`` — one
+    blocking per-shard assembly, the pinned parity oracle; ``'slab'`` —
+    shards grouped into HBM-planner-sized slabs uploaded double-buffered
+    so consecutive slabs' host->device copies overlap; ``'auto'`` — the
+    committed BENCH_INGEST decision rule.  Either path pads only the
+    FINAL shard's tail (real-row slices are host views), so the old
+    full-dataset host pad copy is gone, and the assembled array is
+    byte-identical across modes.
     """
     data_shards, _ = mesh_shape(mesh)
     x = np.asarray(x)
+    n = int(x.shape[0])
+    mode = resolve_ingest(check_ingest(ingest))
     # 'stage' span (ISSUE 11): one host->device staging of a block —
     # under a prefetched stream these come from the producer thread's
     # own tid, so the chrome timeline shows transfer overlapping the
-    # consumer's dispatches.
-    with _obs_trace.span("stage", rows=int(x.shape[0]),
-                         bytes=int(x.nbytes)):
-        x_pad, w_pad = pad_points(x, data_shards * chunk_size,
-                                  min_rows=min_rows)
-        if sample_weight is not None:
-            w_pad[: x.shape[0]] *= sample_weight.astype(w_pad.dtype)
+    # consumer's dispatches.  Slabbed placements nest per-slab 'stage'
+    # children under it (self-time accounting keeps the TTFI ladder
+    # double-count-free).
+    with _obs_trace.span("stage", rows=n, bytes=int(x.nbytes),
+                         ingest=mode):
+        _obs_metrics.REGISTRY.counter("ingest.bytes").inc(int(x.nbytes))
         if mesh is None:
+            x_pad, w_pad = pad_points(x, chunk_size, min_rows=min_rows)
+            if sample_weight is not None:
+                w_pad[:n] *= sample_weight.astype(w_pad.dtype)
+            _obs_metrics.REGISTRY.counter("ingest.slabs").inc()
             return jnp.asarray(x_pad), jnp.asarray(w_pad)
+        target = max(n, int(min_rows))
+        mult = data_shards * chunk_size
+        n_pad = target + ((-target) % mult)
+        sw = None
+        if sample_weight is not None:
+            sw = np.asarray(sample_weight, dtype=x.dtype)
         xsh = NamedSharding(mesh, P(DATA_AXIS, None))
         wsh = NamedSharding(mesh, P(DATA_AXIS))
-        return (jax.device_put(x_pad, xsh), jax.device_put(w_pad, wsh))
+        if mode == "slab":
+            return _slab_place(x, sw, n, n_pad, xsh, wsh, x.dtype,
+                               chunk_size, data_shards)
+        _obs_metrics.REGISTRY.counter("ingest.slabs").inc()
+        return _mono_place(x, sw, n, n_pad, xsh, wsh, x.dtype)
 
 
 
@@ -527,11 +728,18 @@ class ShardedDataset:
         sw = _validate_sample_weight(sample_weight, self.n, self.dtype)
         w_pad = np.zeros(self.points.shape[0], dtype=self.dtype)
         w_pad[: self.n] = sw
-        if self.mesh is None:
-            w_dev = jnp.asarray(w_pad)
-        else:
-            w_dev = jax.device_put(
-                w_pad, NamedSharding(self.mesh, P(DATA_AXIS)))
+        # 'stage' span (ISSUE 18 ingest-span rule): even the tiny (n,)
+        # weight re-upload is a host->device staging — attributed like
+        # every other ingest transfer.
+        with _obs_trace.span("stage", rows=int(w_pad.shape[0]),
+                             bytes=int(w_pad.nbytes)):
+            _obs_metrics.REGISTRY.counter("ingest.bytes").inc(
+                int(w_pad.nbytes))
+            if self.mesh is None:
+                w_dev = jnp.asarray(w_pad)
+            else:
+                w_dev = jax.device_put(
+                    w_pad, NamedSharding(self.mesh, P(DATA_AXIS)))
         return ShardedDataset(self.points, w_dev, self.n, self.chunk,
                               self.mesh, host=self._host, host_weights=sw,
                               explicit_chunk=self.explicit_chunk)
@@ -552,7 +760,7 @@ class ShardedDataset:
 
 def to_device(X, mesh: Optional[Mesh], chunk: int, dtype,
               sample_weight=None, explicit: bool = False,
-              min_rows: int = 0) -> ShardedDataset:
+              min_rows: int = 0, ingest: str = "auto") -> ShardedDataset:
     """Upload (n, D) host data once; pass-through if already a ShardedDataset
     on a compatible (mesh, chunk).
 
@@ -560,7 +768,8 @@ def to_device(X, mesh: Optional[Mesh], chunk: int, dtype,
     weighted counts/sums/SSE come for free from the same fused step (a
     capability the reference lacks; sklearn-style).  ``min_rows`` is the
     shape-bucket padding target (ISSUE 15b; 0 = exact-shape padding, the
-    bit-parity oracle).
+    bit-parity oracle).  ``ingest`` picks the placement path (ISSUE 18;
+    see :func:`shard_points`).
     """
     if isinstance(X, ShardedDataset):
         if mesh is not None and X.mesh is not mesh:
@@ -585,7 +794,7 @@ def to_device(X, mesh: Optional[Mesh], chunk: int, dtype,
     with _obs_trace.span("place", rows=int(X.shape[0]),
                          bytes=int(X.nbytes)):
         points, weights = shard_points(X, mesh, chunk, sample_weight=sw,
-                                       min_rows=min_rows)
+                                       min_rows=min_rows, ingest=ingest)
     return ShardedDataset(points, weights, X.shape[0], chunk, mesh, host=X,
                           host_weights=sw, explicit_chunk=explicit)
 
@@ -692,10 +901,17 @@ def from_process_local(X_local, mesh: Mesh, *,
     else:
         w_pad[:n_local] = 1.0
     n_pad_global = rows_per_proc * nproc
-    pts = jax.make_array_from_process_local_data(
-        NamedSharding(mesh, P(DATA_AXIS, None)), x_pad, (n_pad_global, d))
-    w = jax.make_array_from_process_local_data(
-        NamedSharding(mesh, P(DATA_AXIS)), w_pad, (n_pad_global,))
+    # 'stage' span (ISSUE 18 ingest-span rule): the per-process
+    # host->device assembly of the global array.
+    with _obs_trace.span("stage", rows=int(rows_per_proc),
+                         bytes=int(x_pad.nbytes + w_pad.nbytes)):
+        _obs_metrics.REGISTRY.counter("ingest.bytes").inc(
+            int(x_pad.nbytes + w_pad.nbytes))
+        pts = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P(DATA_AXIS, None)), x_pad,
+            (n_pad_global, d))
+        w = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P(DATA_AXIS)), w_pad, (n_pad_global,))
     return ShardedDataset(pts, w, n_global, chunk, mesh,
                           local_rows=n_local,
                           explicit_chunk=chunk_size is not None)

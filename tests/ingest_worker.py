@@ -1,0 +1,85 @@
+"""Worker for the multi-process streamed-ingest test (not a test module
+itself — spawned by tests/test_ingest.py).
+
+Each process streams ONLY its own local shards of the shared ``.npy``
+(``ingest='slab'``: the per-host O(slab) path), checks them bitwise
+against the blocking mono oracle and the source rows, device-synthesizes
+its shards of a second dataset against the host oracle, then fits with a
+shared explicit init and writes its centroids for the parent's
+cross-process bitwise comparison.
+"""
+
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+
+proc_id = int(sys.argv[1])
+nproc = int(sys.argv[2])
+port = sys.argv[3]
+tmp_dir = Path(sys.argv[4])
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from kmeans_tpu.parallel.multihost import initialize, is_primary  # noqa: E402
+
+initialize(coordinator_address=f"127.0.0.1:{port}",
+           num_processes=nproc, process_id=proc_id)
+assert jax.process_count() == nproc
+
+from kmeans_tpu import KMeans  # noqa: E402
+from kmeans_tpu.data import synthetic as synth  # noqa: E402
+from kmeans_tpu.data.io import from_npy  # noqa: E402
+from kmeans_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+mesh = make_mesh()
+path = tmp_dir / "global.npy"
+X = np.load(path)                         # oracle only — ingest reads mm
+
+# Streamed per-host ingest vs the blocking mono oracle: every LOCAL
+# shard must be bitwise identical (each process checks only bytes it
+# owns — the touch-only-local-bytes contract).
+ds_slab = from_npy(path, mesh, chunk_size=32, ingest="slab")
+ds_mono = from_npy(path, mesh, chunk_size=32, ingest="mono")
+assert ds_slab.n == X.shape[0]
+slab_shards = sorted(ds_slab.points.addressable_shards,
+                     key=lambda s: s.index[0].start or 0)
+mono_shards = sorted(ds_mono.points.addressable_shards,
+                     key=lambda s: s.index[0].start or 0)
+assert len(slab_shards) == len(mono_shards) > 0
+for a, b in zip(slab_shards, mono_shards):
+    assert a.index == b.index
+    np.testing.assert_array_equal(np.asarray(a.data), np.asarray(b.data))
+    lo = a.index[0].start or 0
+    hi = min(a.index[0].stop, X.shape[0])
+    if hi > lo:
+        np.testing.assert_array_equal(
+            np.asarray(a.data)[: hi - lo], X[lo:hi])
+
+# On-device synthesis: local shards equal the host oracle's rows (the
+# partition-invariant fold_in stream crosses process boundaries too).
+n_syn, d_syn = 640, 4
+ds_syn = synth.device_shards(n_syn, d_syn, mesh=mesh, kind="uniform",
+                             seed=5, chunk_size=16)
+host_syn = synth.host_equivalent(n_syn, d_syn, kind="uniform", seed=5)
+for s in ds_syn.points.addressable_shards:
+    lo = s.index[0].start or 0
+    hi = min(s.index[0].stop, n_syn)
+    if hi > lo:
+        np.testing.assert_array_equal(
+            np.asarray(s.data)[: hi - lo], host_syn[lo:hi])
+
+# Fit on the streamed dataset with a shared explicit init: every
+# process must land on identical centroids.
+rng = np.random.default_rng(1)
+init = X[rng.choice(X.shape[0], size=4, replace=False)]
+km = KMeans(k=4, max_iter=6, tolerance=1e-12, seed=0, init=init,
+            empty_cluster="keep", host_loop=False,
+            verbose=is_primary()).fit(ds_slab)
+np.save(tmp_dir / f"ingest_centroids_{proc_id}.npy",
+        np.asarray(km.centroids))
+print(f"worker {proc_id}/{nproc} OK", flush=True)

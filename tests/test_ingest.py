@@ -1,0 +1,499 @@
+"""ISSUE 18: the device-side ingest pipeline.
+
+Four pinned contracts:
+
+1. **Mode parity** — ``ingest='slab'`` (double-buffered slab staging)
+   and ``ingest='mono'`` (the blocking per-shard oracle) assemble
+   BIT-identical arrays on every mesh shape, weighted or not, through
+   every loader (``to_device``, ``from_npy``, ``from_raw``) and every
+   model family, including ``fit(resume=)`` re-ingest.
+2. **On-device synthesis** — ``data.synthetic.device_shards`` equals
+   its ``host_equivalent`` oracle bit-for-bit on any mesh (the per-row
+   ``fold_in`` partition invariance).
+3. **No resurrected host copies** — the weighted slab path stages
+   VIEWS of the caller's arrays for fully-real ranges (the ISSUE 18
+   satellite: the old path built a full-size ones buffer even when
+   aligned).
+4. **Telemetry** — per-slab ``stage`` spans feed ``ingest_breakdown``
+   and the ``ingest.bytes``/``ingest.slabs`` counters move.
+
+A real 2-process multi-host run (gated like tests/test_multihost.py)
+pins the streamed per-host path: every process touches only its own
+shard bytes yet all agree bitwise.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from conftest import jaxlib_cpu_multiprocess_skip
+from kmeans_tpu import (BisectingKMeans, GaussianMixture, KMeans,
+                        MiniBatchKMeans, SphericalKMeans, make_mesh)
+from kmeans_tpu.data import synthetic as synth
+from kmeans_tpu.data.io import from_npy, from_raw
+from kmeans_tpu.obs import memory as obs_memory
+from kmeans_tpu.obs import metrics_registry as obs_metrics
+from kmeans_tpu.obs import trace as obs_trace
+from kmeans_tpu.obs.report import format_ingest_table, ingest_breakdown
+from kmeans_tpu.parallel.sharding import (INGEST_MODES, _w_slice, _x_slice,
+                                          check_ingest, resolve_ingest,
+                                          to_device)
+from kmeans_tpu.utils import faults
+
+
+def _mesh(dp, mp=1):
+    if len(jax.devices()) < dp * mp:
+        pytest.skip(f"needs {dp * mp} devices")
+    return make_mesh(data=dp, model=mp, devices=jax.devices()[: dp * mp])
+
+
+def _data(n=1037, d=5, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(n, d)).astype(dtype)
+
+
+def _weights(n=1037, seed=1):
+    rng = np.random.default_rng(seed)
+    return rng.uniform(0.5, 2.0, size=n).astype(np.float32)
+
+
+def _assert_ds_equal(a, b):
+    np.testing.assert_array_equal(np.asarray(a.points),
+                                  np.asarray(b.points))
+    np.testing.assert_array_equal(np.asarray(a.weights),
+                                  np.asarray(b.weights))
+
+
+# ---------------------------------------------------------------------------
+# Grammar
+# ---------------------------------------------------------------------------
+
+def test_check_ingest_grammar():
+    for mode in INGEST_MODES:
+        assert check_ingest(mode) == mode
+    with pytest.raises(ValueError, match="ingest must be one of"):
+        check_ingest("bogus")
+    with pytest.raises(ValueError, match="ingest must be one of"):
+        check_ingest(None)
+
+
+def test_resolve_ingest_explicit_modes_pass_through():
+    assert resolve_ingest("mono") == "mono"
+    assert resolve_ingest("slab") == "slab"
+
+
+def test_resolve_ingest_auto_is_the_committed_platform_rule():
+    """The BENCH_INGEST r22 decision: CPU measured BELOW the 1.2x adopt
+    bar (median mono/slab 1.04x on the single-core proxy — a pinned
+    measured rejection), so 'auto' keeps the mono oracle there;
+    accelerators stage slabs (DMA transfer/compute overlap)."""
+    expected = "mono" if jax.default_backend() == "cpu" else "slab"
+    assert resolve_ingest("auto") == expected
+
+
+@pytest.mark.parametrize("ctor", [
+    lambda: KMeans(k=2, ingest="bogus"),
+    lambda: MiniBatchKMeans(k=2, ingest="bogus"),
+    lambda: GaussianMixture(n_components=2, ingest="bogus"),
+    lambda: SphericalKMeans(k=2, ingest="bogus"),
+    lambda: BisectingKMeans(k=2, ingest="bogus"),
+])
+def test_constructors_reject_bad_ingest(ctor):
+    with pytest.raises(ValueError, match="ingest must be one of"):
+        ctor()
+
+
+# ---------------------------------------------------------------------------
+# Mode parity: the mono/slab bit-exactness pin
+# ---------------------------------------------------------------------------
+
+MESHES = [(1, 1), (2, 1), (4, 1), (8, 1), (2, 2), (4, 2)]
+
+
+@pytest.mark.parametrize("weighted", [False, True],
+                         ids=["unweighted", "weighted"])
+@pytest.mark.parametrize("dp,mp", MESHES)
+def test_slab_mono_bit_parity_across_meshes(dp, mp, weighted):
+    """The acceptance pin: both placement paths assemble byte-identical
+    global arrays on every mesh shape (incl. TP replication), with the
+    padded tail (1037 % (shards*chunk) != 0) and explicit weights."""
+    mesh = _mesh(dp, mp)
+    X = _data()
+    sw = _weights() if weighted else None
+    ds_mono = to_device(X, mesh, 32, np.float32, sample_weight=sw,
+                        ingest="mono")
+    ds_slab = to_device(X, mesh, 32, np.float32, sample_weight=sw,
+                        ingest="slab")
+    _assert_ds_equal(ds_mono, ds_slab)
+    assert ds_mono.points.shape == ds_slab.points.shape
+    # Shardings agree too — parity is layout, not just values.
+    assert (ds_mono.points.sharding.spec
+            == ds_slab.points.sharding.spec)
+
+
+def test_slab_mono_parity_meshless():
+    """mesh=None single-device path: every mode collapses to the same
+    committed upload."""
+    X = _data(257, 3)
+    for mode in INGEST_MODES:
+        ds = to_device(X, None, 32, np.float32, ingest=mode)
+        np.testing.assert_array_equal(np.asarray(ds.points)[:257], X)
+
+
+def test_multi_slab_parity_and_slab_counter(monkeypatch):
+    """Shrinking the slab target to 1 byte forces one slab PER SHARD —
+    the deepest staging schedule stays bit-exact and the ingest.slabs
+    counter counts exactly the slabs."""
+    mesh = _mesh(8)
+    monkeypatch.setattr(obs_memory, "INGEST_SLAB_TARGET_BYTES", 1)
+    X = _data()
+    before = obs_metrics.REGISTRY.counter("ingest.slabs").value
+    ds_slab = to_device(X, mesh, 32, np.float32, ingest="slab")
+    assert (obs_metrics.REGISTRY.counter("ingest.slabs").value
+            - before) == 8
+    ds_mono = to_device(X, mesh, 32, np.float32, ingest="mono")
+    _assert_ds_equal(ds_mono, ds_slab)
+
+
+def test_min_rows_bucket_padding_parity():
+    """Shape-bucket padding (ISSUE 15b min_rows) rides through both
+    paths identically — bucketed warm fits may re-ingest either way."""
+    mesh = _mesh(4)
+    X = _data(500, 4)
+    ds_m = to_device(X, mesh, 32, np.float32, min_rows=1024,
+                     ingest="mono")
+    ds_s = to_device(X, mesh, 32, np.float32, min_rows=1024,
+                     ingest="slab")
+    assert ds_m.points.shape[0] >= 1024
+    _assert_ds_equal(ds_m, ds_s)
+
+
+# ---------------------------------------------------------------------------
+# Loaders: from_npy / from_raw, streamed vs oracle, prefetch=0 sync oracle
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("prefetch", [0, 2])
+def test_from_npy_ingest_modes_bit_equal(tmp_path, prefetch):
+    """Streamed (slab) and blocking (mono) file ingest agree bitwise,
+    with and without the readahead thread (prefetch=0 is the fully
+    synchronous oracle)."""
+    mesh = _mesh(4)
+    X = _data(701, 6, seed=3)
+    path = tmp_path / "x.npy"
+    np.save(path, X)
+    ds = {mode: from_npy(path, mesh, chunk_size=32, ingest=mode,
+                         prefetch=prefetch)
+          for mode in ("mono", "slab")}
+    _assert_ds_equal(ds["mono"], ds["slab"])
+    np.testing.assert_array_equal(np.asarray(ds["slab"].points)[:701], X)
+
+
+def test_from_npy_weighted_streamed_parity(tmp_path):
+    mesh = _mesh(4)
+    X = _data(400, 3, seed=5)
+    sw = _weights(400, seed=6)
+    path = tmp_path / "xw.npy"
+    np.save(path, X)
+    ds_m = from_npy(path, mesh, chunk_size=32, sample_weight=sw,
+                    ingest="mono")
+    ds_s = from_npy(path, mesh, chunk_size=32, sample_weight=sw,
+                    ingest="slab")
+    _assert_ds_equal(ds_m, ds_s)
+    np.testing.assert_array_equal(np.asarray(ds_s.weights)[:400], sw)
+
+
+def test_from_raw_ingest_modes_bit_equal(tmp_path):
+    mesh = _mesh(4)
+    X = _data(333, 4, seed=7)
+    path = tmp_path / "x.bin"
+    X.tofile(path)
+    ds_m = from_raw(path, (333, 4), mesh, chunk_size=32, ingest="mono")
+    ds_s = from_raw(path, (333, 4), mesh, chunk_size=32, ingest="slab")
+    _assert_ds_equal(ds_m, ds_s)
+
+
+# ---------------------------------------------------------------------------
+# Family fits: bit-identical datasets -> bit-identical fits
+# ---------------------------------------------------------------------------
+
+def _family_fits(mode, mesh, X):
+    """One small deterministic fit per family against a mode-ingested
+    dataset; returns the fitted arrays that must match bitwise."""
+    common = dict(seed=0, mesh=mesh, chunk_size=32, verbose=False)
+    out = {}
+    km = KMeans(k=4, max_iter=5, tolerance=1e-12, host_loop=False,
+                empty_cluster="keep", ingest=mode, **common).fit(X)
+    out["kmeans"] = (km.centroids, km.iterations_run)
+    sk = SphericalKMeans(k=4, max_iter=5, tolerance=1e-12,
+                         host_loop=False, empty_cluster="keep",
+                         ingest=mode, **common).fit(X)
+    out["spherical"] = (sk.centroids, sk.iterations_run)
+    bk = BisectingKMeans(k=3, max_iter=5, ingest=mode, **common).fit(X)
+    out["bisecting"] = (bk.centroids,)
+    mb = MiniBatchKMeans(k=4, max_iter=5, batch_size=128,
+                         sampling="device", ingest=mode, **common).fit(X)
+    out["minibatch"] = (mb.centroids,)
+    gm = GaussianMixture(n_components=3, max_iter=4, tol=0.0,
+                         host_loop=False, init_params="random",
+                         ingest=mode, **common).fit(X)
+    out["gmm"] = (gm.means_, gm.weights_, gm.covariances_)
+    return out
+
+
+def test_five_family_fit_parity_mono_vs_slab():
+    """The datasets are bit-identical across modes, so every family's
+    whole fitted state must be too — ingest mode can never leak into
+    results."""
+    mesh = _mesh(4)
+    X = _data(600, 4, seed=11)
+    fits = {mode: _family_fits(mode, mesh, X)
+            for mode in ("mono", "slab")}
+    for family in fits["mono"]:
+        for a, b in zip(fits["mono"][family], fits["slab"][family]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=family)
+
+
+# ---------------------------------------------------------------------------
+# Resume re-ingest parity
+# ---------------------------------------------------------------------------
+
+_RESUME_KW = dict(k=4, max_iter=14, tolerance=1e-12, seed=1,
+                  compute_sse=True, empty_cluster="keep",
+                  host_loop=False, verbose=False, dtype=np.float64)
+
+
+@pytest.mark.parametrize("dp", [2, 4])
+def test_resume_reingests_bit_identical_across_modes(tmp_path, dp):
+    """A checkpoint killed mid-fit resumes BIT-identical whether the
+    resuming process re-ingests mono or slab — and both match the
+    uninterrupted fit (the test_elastic pin, ingest axis)."""
+    mesh = _mesh(dp)
+    from sklearn.datasets import make_blobs
+    X, _ = make_blobs(n_samples=2000, centers=4, n_features=3,
+                      random_state=9)
+    X = X.astype(np.float32)
+    full = KMeans(mesh=mesh, ingest="mono", **_RESUME_KW).fit(X)
+    p = str(tmp_path / "ck.npz")
+    with faults.inject_kill_after_iteration(4):
+        with pytest.raises(faults.SimulatedPreemption):
+            KMeans(mesh=mesh, ingest="mono", **_RESUME_KW).fit(
+                X, checkpoint_every=2, checkpoint_path=p)
+    resumed = {}
+    for mode in ("mono", "slab"):
+        m = KMeans(mesh=mesh, ingest=mode, **_RESUME_KW)
+        m.fit(X, resume=p)
+        resumed[mode] = m
+    for m in resumed.values():
+        assert m.iterations_run == full.iterations_run
+        np.testing.assert_array_equal(m.centroids, full.centroids)
+    np.testing.assert_array_equal(resumed["mono"].centroids,
+                                  resumed["slab"].centroids)
+
+
+# ---------------------------------------------------------------------------
+# On-device synthetic shards (ISSUE 18c)
+# ---------------------------------------------------------------------------
+
+_BLOB_CENTERS = np.array([[0., 0., 0.], [5., 5., 0.], [-5., 0., 5.]],
+                         np.float32)
+
+
+def _synth_kw(kind):
+    return {"centers": _BLOB_CENTERS} if kind == "blobs" else {}
+
+
+@pytest.mark.parametrize("kind", synth.SYNTH_KINDS)
+def test_device_shards_match_host_equivalent(kind):
+    """The partition-invariance pin: rows born on their shard device
+    equal the host oracle bit-for-bit (same (seed, row) fold_in
+    stream)."""
+    mesh = _mesh(8)
+    n, d = 511, 3
+    ds = synth.device_shards(n, d, mesh=mesh, kind=kind, seed=4,
+                             chunk_size=16, **_synth_kw(kind))
+    host = synth.host_equivalent(n, d, kind=kind, seed=4,
+                                 **_synth_kw(kind))
+    np.testing.assert_array_equal(np.asarray(ds.points)[:n], host)
+    w = np.asarray(ds.weights)
+    np.testing.assert_array_equal(w[:n], np.ones(n, np.float32))
+    np.testing.assert_array_equal(w[n:], np.zeros(len(w) - n,
+                                                  np.float32))
+
+
+def test_device_shards_partition_invariant():
+    """Any mesh produces the same rows — the property that makes the
+    weak-scaling config reproducible at every worker count."""
+    n, d = 256, 4
+    a = synth.device_shards(n, d, mesh=_mesh(2), seed=7, chunk_size=16)
+    b = synth.device_shards(n, d, mesh=_mesh(8), seed=7, chunk_size=16)
+    c = synth.device_shards(n, d, mesh=None, seed=7, chunk_size=16)
+    np.testing.assert_array_equal(np.asarray(a.points)[:n],
+                                  np.asarray(b.points)[:n])
+    np.testing.assert_array_equal(np.asarray(a.points)[:n],
+                                  np.asarray(c.points)[:n])
+
+
+def test_device_shards_tp_mesh_and_fit():
+    """TP replication on the model axis + a fit on the device-born
+    dataset (no host copy exists to fall back on)."""
+    mesh = _mesh(2, 2)
+    ds = synth.device_shards(300, 4, mesh=mesh, kind="uniform", seed=2,
+                             chunk_size=16)
+    host = synth.host_equivalent(300, 4, kind="uniform", seed=2)
+    np.testing.assert_array_equal(np.asarray(ds.points)[:300], host)
+    km = KMeans(k=3, max_iter=3, seed=0, mesh=mesh, chunk_size=16,
+                host_loop=False, empty_cluster="keep",
+                verbose=False).fit(ds)
+    assert km.iterations_run >= 1
+    assert np.all(np.isfinite(km.centroids))
+
+
+def test_synthetic_error_cases():
+    with pytest.raises(ValueError, match="kind must be one of"):
+        synth.device_shards(10, 2, kind="cauchy")
+    with pytest.raises(ValueError, match="kind must be one of"):
+        synth.host_equivalent(10, 2, kind="cauchy")
+    with pytest.raises(ValueError, match="requires an explicit"):
+        synth.device_shards(10, 2, kind="blobs")
+    with pytest.raises(ValueError, match="centers must be"):
+        synth.host_equivalent(10, 2, kind="blobs",
+                              centers=np.zeros((3, 5), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# No resurrected host copies (the weighted-path satellite)
+# ---------------------------------------------------------------------------
+
+def test_slice_helpers_return_views_for_real_ranges():
+    X = _data(100, 3)
+    sw = _weights(100)
+    assert np.shares_memory(_x_slice(X, 10, 50, 100), X)
+    assert np.shares_memory(_w_slice(sw, 10, 50, 100, np.float32), sw)
+    # Tail crossing n: a fresh padded buffer, zeros past n.
+    tail = _x_slice(X, 90, 120, 100)
+    assert not np.shares_memory(tail, X)
+    np.testing.assert_array_equal(tail[10:], 0.0)
+    wt = _w_slice(sw, 90, 120, 100, np.float32)
+    np.testing.assert_array_equal(wt[:10], sw[90:])
+    np.testing.assert_array_equal(wt[10:], 0.0)
+
+
+def test_aligned_weighted_slab_ingest_allocates_no_row_scale_buffers(
+        monkeypatch):
+    """The satellite regression: an ALIGNED weighted slab ingest (n a
+    multiple of shards*chunk — no padding tail) must stage pure views;
+    the old path np.ones'd a full-size weight buffer every time."""
+    mesh = _mesh(4)
+    n = 4 * 32 * 8                      # aligned: no pad rows at all
+    X = _data(n, 3)
+    sw = _weights(n)
+    big = []
+    real_ones, real_zeros = np.ones, np.zeros
+
+    def spy(real):
+        def wrapped(shape, *a, **kw):
+            size = int(np.prod(shape))
+            if size >= n:
+                big.append(shape)
+            return real(shape, *a, **kw)
+        return wrapped
+
+    monkeypatch.setattr(np, "ones", spy(real_ones))
+    monkeypatch.setattr(np, "zeros", spy(real_zeros))
+    ds = to_device(X, mesh, 32, np.float32, sample_weight=sw,
+                   ingest="slab")
+    assert big == [], f"row-scale host allocations resurrected: {big}"
+    np.testing.assert_array_equal(np.asarray(ds.weights)[:n], sw)
+
+
+# ---------------------------------------------------------------------------
+# Telemetry: counters, per-slab spans, the breakdown table
+# ---------------------------------------------------------------------------
+
+def test_ingest_bytes_counter_counts_the_payload():
+    mesh = _mesh(2)
+    X = _data(200, 4)
+    before = obs_metrics.REGISTRY.counter("ingest.bytes").value
+    to_device(X, mesh, 32, np.float32, ingest="mono")
+    assert (obs_metrics.REGISTRY.counter("ingest.bytes").value
+            - before) == X.nbytes
+
+
+def test_per_slab_spans_feed_the_breakdown(monkeypatch):
+    """Each staged slab emits a 'stage' span with slab/rows/bytes attrs;
+    ingest_breakdown turns them into the per-slab TTFI attribution and
+    format_ingest_table renders them with a TOTAL row."""
+    mesh = _mesh(8)
+    monkeypatch.setattr(obs_memory, "INGEST_SLAB_TARGET_BYTES", 1)
+    X = _data(512, 4)
+    with obs_trace.tracing() as tr:
+        to_device(X, mesh, 32, np.float32, ingest="slab")
+    rows = ingest_breakdown(tr.records())
+    assert [r["slab"] for r in rows] == list(range(8))
+    assert all(r["slabs"] == 8 for r in rows)
+    assert sum(r["rows"] for r in rows) == 512
+    assert sum(r["bytes"] for r in rows) == 512 * 4 * 4
+    assert all(r["ms"] >= 0 for r in rows)
+    table = format_ingest_table(rows)
+    assert "TOTAL" in table and "slab" in table
+
+
+def test_mono_ingest_has_no_slab_rows():
+    mesh = _mesh(4)
+    with obs_trace.tracing() as tr:
+        to_device(_data(200, 3), mesh, 32, np.float32, ingest="mono")
+    assert ingest_breakdown(tr.records()) == []
+
+
+# ---------------------------------------------------------------------------
+# Real multi-process streamed ingest (gated like tests/test_multihost.py)
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_ingest_workers(nproc, tmp_path, timeout=420):
+    repo = Path(__file__).parent.parent
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ":".join(
+        p for p in [str(repo), env.get("PYTHONPATH")] if p)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [subprocess.Popen(
+        [sys.executable, str(repo / "tests" / "ingest_worker.py"),
+         str(i), str(nproc), str(port), str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for i in range(nproc)]
+    outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-3000:]
+
+
+@jaxlib_cpu_multiprocess_skip
+@pytest.mark.parametrize("nproc", [2, 4])
+def test_multiprocess_streamed_ingest_agrees(tmp_path, nproc):
+    """REAL jax.distributed processes: each streams only its own local
+    shards from the shared .npy (ingest='slab') yet matches the mono
+    oracle locally, device-synthesizes the same rows as the host
+    oracle, and every process fits to bitwise-identical centroids."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1536, 4)).astype(np.float32)
+    np.save(tmp_path / "global.npy", X)
+    _run_ingest_workers(nproc, tmp_path)
+    c = [np.load(tmp_path / f"ingest_centroids_{i}.npy")
+         for i in range(nproc)]
+    for i in range(1, nproc):
+        np.testing.assert_array_equal(c[0], c[i])

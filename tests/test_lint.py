@@ -55,7 +55,8 @@ def test_registry_has_the_required_rules():
     meta-rule) are registered — the >= 6 acceptance bar."""
     assert {"trace-hazard", "cache-key", "dispatch", "thread",
             "counter-reset", "dead-private", "cache-name",
-            "aot-key", "large-k", "fleet-record"} <= set(RULES)
+            "aot-key", "large-k", "fleet-record",
+            "ingest-span"} <= set(RULES)
     assert len(RULES) >= 6
     for rule in RULES.values():
         assert rule.id and rule.incident, rule
@@ -426,6 +427,92 @@ def test_obs_span_suppression_honored(tmp_path):
         "at the caller\n    return fn(pts)")
     findings = run_on(tmp_path, src, subdir="serving")
     assert [f for f in findings if f.rule == "obs-span"] == []
+
+
+# ---------------------------------------------------------------------------
+# ingest-span (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+_INGEST_BAD = """
+import jax
+import numpy as np
+
+
+def place_shards(x, sharding, n_pad, d):
+    parts = [jax.device_put(x[lo:hi], dev)
+             for lo, hi, dev in sharding]
+    return jax.make_array_from_single_device_arrays(
+        (n_pad, d), sharding, parts)
+"""
+
+_INGEST_OK = """
+import jax
+import numpy as np
+from kmeans_tpu.obs import trace as obs_trace
+
+
+def place_shards(x, sharding, n_pad, d):
+    with obs_trace.span("stage", rows=int(n_pad),
+                        bytes=int(x.nbytes)):
+        parts = [jax.device_put(x[lo:hi], dev)
+                 for lo, hi, dev in sharding]
+        return jax.make_array_from_single_device_arrays(
+            (n_pad, d), sharding, parts)
+"""
+
+
+def test_ingest_span_fires_on_unspanned_placement(tmp_path):
+    findings = run_on(tmp_path, _INGEST_BAD, subdir="data")
+    fire = [f for f in findings if f.rule == "ingest-span"]
+    assert len(fire) == 1
+    assert "place_shards()" in fire[0].message
+    assert "stage" in fire[0].message
+
+
+def test_ingest_span_silent_under_stage_span(tmp_path):
+    findings = run_on(tmp_path, _INGEST_OK, subdir="data")
+    assert [f for f in findings if f.rule == "ingest-span"] == []
+
+
+def test_ingest_span_scoped_to_data_and_sharding(tmp_path):
+    """A placement in models/ is out of scope (model-layer uploads run
+    through to_device, which is already spanned at the choke point) —
+    but the same snippet under parallel/sharding.py is in scope."""
+    findings = run_on(tmp_path, _INGEST_BAD, subdir="models")
+    assert [f for f in findings if f.rule == "ingest-span"] == []
+    findings = run_on(tmp_path, _INGEST_BAD, subdir="parallel",
+                      name="sharding.py")
+    assert [f.rule for f in findings
+            if f.rule == "ingest-span"] == ["ingest-span"]
+
+
+def test_ingest_span_nested_producer_covered_by_driver(tmp_path):
+    """A streamed producer closure's device_put counts against the
+    enclosing driver, whose stage span covers the subtree (the
+    _streamed_place shape)."""
+    src = """
+import jax
+from kmeans_tpu.obs import trace as obs_trace
+
+
+def stream_place(read_rows, plan, sharding):
+    def producer(slab):
+        return [jax.device_put(read_rows(lo, hi), dev)
+                for lo, hi, dev in slab]
+    with obs_trace.span("stage", rows=plan["n"], bytes=plan["bytes"]):
+        return [producer(s) for s in plan["slabs"]]
+"""
+    findings = run_on(tmp_path, src, subdir="data")
+    assert [f for f in findings if f.rule == "ingest-span"] == []
+
+
+def test_ingest_span_suppression_honored(tmp_path):
+    src = _INGEST_BAD.replace(
+        "    return jax.make_array_from_single_device_arrays(",
+        "    # lint: ok(ingest-span) — fixture path, spanned at the "
+        "caller\n    return jax.make_array_from_single_device_arrays(")
+    findings = run_on(tmp_path, src, subdir="data")
+    assert [f for f in findings if f.rule == "ingest-span"] == []
 
 
 # ---------------------------------------------------------------------------
