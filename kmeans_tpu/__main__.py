@@ -57,9 +57,13 @@ def main() -> int:
     if cmd == "plan":
         from kmeans_tpu.cli import plan_main
         return plan_main(rest)
+    if cmd == "autopilot":
+        from kmeans_tpu.cli import autopilot_main
+        return autopilot_main(rest)
     print(f"unknown command {cmd!r}; available: suite, bench, fit, "
           f"sweep, ckpt-info, warm, serve, report, lint, trace, "
-          f"cost-report, fleet-status, serve-status, bench-diff, plan",
+          f"cost-report, fleet-status, serve-status, bench-diff, plan, "
+          f"autopilot",
           file=sys.stderr)
     return 2
 

@@ -1179,6 +1179,94 @@ class LargeKRule(Rule):
         return atoms
 
 
+# --------------------------------------------------------- fault-path
+
+class FaultPathRule(Rule):
+    """ISSUE 19: in ``orchestrator/`` and ``parallel/``, an ``except``
+    clause catching a FAULT type — preemption/OOM/launch-flake
+    injections, transient IO, torn checkpoints, timeouts, runtime
+    device loss — must ROUTE the fault, not swallow it: the handler
+    body must re-raise (typed or bare), return a typed
+    ``policy.EXIT_*`` code for the supervisor to classify, or call
+    into the committed retry/decision machinery (``*retry*``,
+    ``*backoff*``, ``*give_up*``, ``*record*``, ``*decision*``,
+    ``*exit*``, or the ``kill``/``terminate`` escalation).  The
+    autopilot's whole robustness story is that every fault lands in
+    the typed decision log under a committed budget; one bare
+    ``except SimulatedPreemption: pass`` in a worker or launcher turns
+    a supervised preemption into a silent wrong answer."""
+
+    id = "fault-path"
+    incident = ("ISSUE 19: a swallowed fault in the supervised tree — "
+                "an except clause that catches a preemption/IO/timeout "
+                "fault type and neither re-raises, returns a typed "
+                "exit, nor routes through the committed retry policy")
+
+    #: Exception LEAF names that mean "a fault the autopilot owns".
+    _FAULT_TYPES = {
+        "SimulatedPreemption", "SimulatedOOM", "SimulatedLaunchFailure",
+        "TransientIOError", "CheckpointCorruptError", "LaunchError",
+        "TraceReadError", "OSError", "IOError", "TimeoutError",
+        "TimeoutExpired", "XlaRuntimeError",
+    }
+    #: Substrings of a called dotted name that count as routing the
+    #: fault into the committed machinery.
+    _ROUTING_MARKERS = ("retry", "backoff", "give_up", "record",
+                        "decision", "exit", "kill", "terminate")
+
+    def run(self, pkg: Package) -> Iterator[Finding]:
+        for mod in pkg:
+            p = mod.rel.replace("\\", "/")
+            if "/orchestrator/" not in p and "/parallel/" not in p:
+                continue
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                caught = self._caught_faults(node)
+                if not caught:
+                    continue
+                if self._routes(node):
+                    continue
+                yield self.finding(
+                    mod, node.lineno,
+                    f"except clause catches fault type(s) "
+                    f"{', '.join(sorted(caught))} but neither "
+                    f"re-raises, returns a typed EXIT_* code, nor "
+                    f"routes through the committed retry policy "
+                    f"(call one of *{'*/*'.join(self._ROUTING_MARKERS)}"
+                    f"*) — a swallowed fault never reaches the "
+                    f"autopilot decision log")
+
+    @classmethod
+    def _caught_faults(cls, handler: ast.ExceptHandler) -> Set[str]:
+        """Leaf names of fault types this handler catches."""
+        t = handler.type
+        if t is None:
+            return set()        # bare except: other rules' territory
+        exprs = t.elts if isinstance(t, ast.Tuple) else [t]
+        caught = set()
+        for e in exprs:
+            leaf = (dotted(e) or "").split(".")[-1]
+            if leaf in cls._FAULT_TYPES:
+                caught.add(leaf)
+        return caught
+
+    @classmethod
+    def _routes(cls, handler: ast.ExceptHandler) -> bool:
+        for n in ast.walk(handler):
+            if isinstance(n, ast.Raise):
+                return True
+            if isinstance(n, ast.Return) and n.value is not None:
+                leaf = (dotted(n.value) or "").split(".")[-1]
+                if leaf.startswith("EXIT_"):
+                    return True
+            if isinstance(n, ast.Call):
+                name = (dotted(n.func) or "").lower()
+                if any(m in name for m in cls._ROUTING_MARKERS):
+                    return True
+        return False
+
+
 # -------------------------------------------------------- suppression
 
 class SuppressionFormatRule(Rule):
@@ -1214,5 +1302,5 @@ RULES: Dict[str, Rule] = {rule.id: rule for rule in (
     FleetRecordRule(), ThreadHygieneRule(), CounterResetRule(),
     DeadPrivateRule(),
     CacheNameRule(), AotKeyRule(), LargeKRule(),
-    SuppressionFormatRule(),
+    FaultPathRule(), SuppressionFormatRule(),
 )}

@@ -652,9 +652,13 @@ def fleet_status_main(argv=None) -> int:
     those).  The report applies the committed straggler rules
     (``obs.fleet``: rows/s below ``rate_factor`` x the fleet median ->
     ``slow``; trailing the leader by >= ``behind_iters`` iterations ->
-    ``behind``; behind AND silent past the stall window ->
+    ``behind``; silent past the stall window while behind ->
     ``stalled``).  ``--now`` anchors liveness at the current wall
-    clock (live monitoring) instead of the newest record (post-hoc).
+    clock (live monitoring) instead of the newest record (post-hoc) —
+    and tightens the stall rule: a host silent past the window whose
+    last beat is mid-fit (not a terminal completion beat) flags
+    ``stalled`` even at the leader iteration, so a live-but-paused
+    fleet never reads healthy (ISSUE 19 fix).
 
     Exit 0: healthy fleet.  Exit 1: stragglers flagged (the
     orchestrator's signal).  Exit 2: unreadable/malformed inputs or no
@@ -708,6 +712,89 @@ def fleet_status_main(argv=None) -> int:
     else:
         print(obs_fleet.format_fleet_status(report))
     return 0 if report["healthy"] else 1
+
+
+def autopilot_main(argv=None) -> int:
+    """``python -m kmeans_tpu autopilot --spec spec.json --out dir
+    --world N [--json]`` — supervise a fleet of per-host ``fit(resume=)``
+    workers under the committed elastic rules (ISSUE 19,
+    ``orchestrator.policy``): relaunch the preempted from the last
+    rotating checkpoint, evict stalled hosts and shrink, grow back when
+    capacity returns, back off deterministically on launch flakes, and
+    refuse with the full typed decision log when a committed budget is
+    exhausted.  See docs/AUTOPILOT.md for the spec schema and the
+    decision-rule table.
+
+    Exit 0: converged at the target world.  Exit 1: finished but
+    degraded (a shrunk fleet completed the fit).  Exit 2: gave up
+    (``AutopilotGaveUpError`` — decision log on stderr) or bad
+    inputs."""
+    parser = argparse.ArgumentParser(
+        prog="python -m kmeans_tpu autopilot",
+        description="Elastic supervising loop over per-host fit "
+                    "workers (evict/shrink/grow/relaunch under "
+                    "committed typed rules)")
+    parser.add_argument("--spec", required=True,
+                        help="worker spec JSON (docs/AUTOPILOT.md)")
+    parser.add_argument("--out", required=True,
+                        help="run directory (checkpoints, heartbeats, "
+                             "decision log, worker artifacts)")
+    parser.add_argument("--world", type=int, required=True,
+                        help="fleet size to launch")
+    parser.add_argument("--target-world", type=int, default=None,
+                        help="world size that counts as converged "
+                             "(default: --world)")
+    parser.add_argument("--no-grow", action="store_true",
+                        help="never grow a shrunk fleet back")
+    parser.add_argument("--poll-period", type=float, default=None,
+                        help="override the committed poll period "
+                             "(seconds)")
+    parser.add_argument("--max-run-s", type=float, default=None,
+                        help="override the committed run deadline")
+    parser.add_argument("--coordinator-address", default=None,
+                        help="real jax.distributed coordinator "
+                             "(default: simulated fleet env)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable result on stdout")
+    args = parser.parse_args(argv)
+
+    from kmeans_tpu.orchestrator import Autopilot, AutopilotGaveUpError
+    kwargs = {}
+    if args.target_world is not None:
+        kwargs["target_world"] = args.target_world
+    if args.poll_period is not None:
+        kwargs["poll_period_s"] = args.poll_period
+    if args.max_run_s is not None:
+        kwargs["max_run_s"] = args.max_run_s
+    if args.coordinator_address is not None:
+        kwargs["coordinator_address"] = args.coordinator_address
+    try:
+        pilot = Autopilot(args.spec, args.out, args.world,
+                          grow=not args.no_grow, **kwargs)
+        result = pilot.run()
+    except AutopilotGaveUpError as e:
+        # Routed fault path: the typed give-up maps to the committed
+        # exit code 2, decision log rendered for the operator.
+        if args.json:
+            from kmeans_tpu.utils.profiling import sanitize_json
+            print(json.dumps(sanitize_json(
+                {"outcome": "gave-up", "exit_code": 2,
+                 "reason": e.reason,
+                 "decisions": [d.as_dict() for d in e.decisions]})))
+        print(e.report(), file=sys.stderr)
+        return 2
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        from kmeans_tpu.utils.profiling import sanitize_json
+        print(json.dumps(sanitize_json(result.as_dict())))
+    else:
+        print(f"autopilot {result.outcome}: fleet of "
+              f"{result.final_world}/{result.target_world} finished, "
+              f"{len(result.decisions)} decisions "
+              f"(log: {result.out_dir}/autopilot.decisions.jsonl)")
+    return result.exit_code
 
 
 def cost_report_main(argv=None) -> int:

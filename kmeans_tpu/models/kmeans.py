@@ -952,6 +952,13 @@ class KMeans(AutoCheckpointMixin):
                     "process's local rows")
             # compute_labels=False error state was set by _set_fit_data.
             self._fit_ds = None
+        # Terminal completion beat (ISSUE 19): the host-loop engines'
+        # last boundary beat is "iteration"/"checkpoint", which a LIVE
+        # fleet-status read (explicit --now) would eventually flag as a
+        # stall — this beat marks the fit DONE (obs.fleet
+        # TERMINAL_PHASES), so a finished host reads finished, not
+        # silent.
+        obs_note_progress(self, phase="finished")
         return self
 
     def _set_fit_data(self, ds) -> None:
@@ -1830,6 +1837,8 @@ class KMeans(AutoCheckpointMixin):
         self._labels_error = ("labels_ is not materialized by fit_stream "
                               "(the dataset never resides in memory); call "
                               "predict on each block")
+        # Terminal completion beat (ISSUE 19) — see fit().
+        obs_note_progress(self, phase="finished")
         return self
 
     def _run_restart(self, ds, mesh, model_shards, step_fn, centroids,

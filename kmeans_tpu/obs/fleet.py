@@ -71,6 +71,7 @@ __all__ = [
     "FLEET_SKEW_BOUND_S", "COMM_AGREEMENT_RTOL",
     "STRAGGLER_RATE_FACTOR", "STRAGGLER_BEHIND_ITERS",
     "STRAGGLER_STALL_FACTOR", "STRAGGLER_STALL_MIN_S",
+    "TERMINAL_PHASES",
 ]
 
 #: Committed barrier-alignment acceptance bound (seconds): the measured
@@ -93,15 +94,30 @@ COMM_AGREEMENT_RTOL = 0.10
 #: discipline).  A host flags:
 #: * ``slow``   — rows_per_sec < RATE_FACTOR x the fleet median,
 #: * ``behind`` — iteration trails the fleet leader by >= BEHIND_ITERS,
-#: * ``stalled`` — it is behind AND silent for longer than
+#: * ``stalled`` — it is silent for longer than
 #:   max(STALL_FACTOR x the fleet median beat interval, STALL_MIN_S)
 #:   (the floor keeps sub-second CPU fits from flagging on scheduler
-#:   jitter; a host that FINISHED — iteration == leader — never flags
-#:   stalled, so post-hoc analysis of a completed fleet stays silent).
+#:   jitter) AND either trails the leader, or — under an EXPLICIT
+#:   ``now`` (a live monitor's wall clock, ISSUE 19 fix) — its last
+#:   beat is not a TERMINAL one.  Post-hoc reads (``now`` defaulted to
+#:   the newest record) keep the behind-only rule: every host of a
+#:   completed fleet is "old", and flagging them all would make every
+#:   post-mortem read as a mass stall.  A live read is different: a
+#:   host at the leader iteration whose last phase is mid-fit and that
+#:   has gone silent past the window IS stalled (the whole fleet being
+#:   paused must not read healthy), while a host whose last beat is
+#:   terminal (:data:`TERMINAL_PHASES`) finished its fit and never
+#:   flags.
 STRAGGLER_RATE_FACTOR = 0.5
 STRAGGLER_BEHIND_ITERS = 2
 STRAGGLER_STALL_FACTOR = 3.0
 STRAGGLER_STALL_MIN_S = 1.0
+
+#: Heartbeat phases that mark a host's fit COMPLETE (the end-of-fit
+#: completion beats: ``finished`` from the host-loop/stream engines,
+#: ``fit`` from the one-dispatch completion record).  A terminal last
+#: beat means silence is success, not a stall.
+TERMINAL_PHASES = ("fit", "finished")
 
 
 # ------------------------------------------------------------- loading
@@ -418,6 +434,11 @@ def straggler_report(records: List[dict], *, now: Optional[float] = None,
         names.setdefault(idx, str(r.get("host", f"host{idx}")))
     if not by_host:
         raise TraceReadError("no heartbeat records to report on")
+    # An EXPLICIT now is a live monitor's wall clock; the default is
+    # post-hoc analysis anchored to the newest record.  The stall rule
+    # below is stricter under a live clock (ISSUE 19 fix): a paused
+    # fleet must not read healthy just because nobody is behind.
+    live = now is not None
     if now is None:
         now = max(r.get("ts", 0.0) for r in records)
 
@@ -438,6 +459,7 @@ def straggler_report(records: List[dict], *, now: Optional[float] = None,
             "inertia": recs[-1].get("inertia"),
             "rows_per_sec": _median(rates),
             "beat_interval_s": _median(intervals),
+            "ts": recs[-1].get("ts"),
             "last_age_s": max(0.0, now - recs[-1].get("ts", now)),
             "flags": [],
         })
@@ -460,7 +482,15 @@ def straggler_report(records: List[dict], *, now: Optional[float] = None,
             r["flags"].append("slow")
         stall_after = max(stall_factor * (fleet_interval or 0.0),
                           stall_min_s)
-        if behind > 0 and r["last_age_s"] > stall_after:
+        # Post-hoc (default now): behind-only, so a completed fleet's
+        # uniformly-old beats stay silent.  Live (explicit now): a host
+        # whose last beat is MID-FIT and silent past the window is
+        # stalled even at the leader iteration — the live-but-paused
+        # fleet the ISSUE 19 autopilot must see; terminal completion
+        # beats (TERMINAL_PHASES) exempt finished hosts.
+        mid_fit = r["phase"] not in TERMINAL_PHASES
+        if (behind > 0 or (live and mid_fit)) \
+                and r["last_age_s"] > stall_after:
             r["flags"].append("stalled")
     flagged = [r["process_index"] for r in rows if r["flags"]]
     return {"hosts": rows, "flagged": flagged,

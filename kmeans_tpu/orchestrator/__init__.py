@@ -1,0 +1,42 @@
+"""Elastic autopilot (ISSUE 19): the supervising orchestration loop
+that keeps a distributed fit running through preemption, stragglers,
+torn checkpoints and launch flakes.
+
+Layering (each importable alone):
+
+* :mod:`~kmeans_tpu.orchestrator.policy` — the COMMITTED, typed
+  decision rules: every threshold, budget and backoff schedule as a
+  module constant; pure functions; :class:`AutopilotGaveUpError`.
+* :mod:`~kmeans_tpu.orchestrator.launcher` — typed worker spawning
+  (simulated fleet env or real ``jax.distributed`` coordinator) with
+  the bounded deterministic exponential retry.
+* :mod:`~kmeans_tpu.orchestrator.worker` — one host's entry point:
+  ``fit(resume=)`` under per-process obs sinks and the typed exit-code
+  contract.
+* :mod:`~kmeans_tpu.orchestrator.autopilot` — the loop itself: launch,
+  watch merged heartbeats, evict/shrink/grow/relaunch, give up on
+  exhausted budgets; every decision a JSONL event through the r15
+  tracer/registry.
+
+See docs/AUTOPILOT.md for the decision-rule table and the exit-code
+contract (0 converged / 1 degraded-but-done / 2 gave-up).
+"""
+
+from kmeans_tpu.orchestrator.autopilot import (Autopilot,
+                                               AutopilotResult,
+                                               run_autopilot)
+from kmeans_tpu.orchestrator.launcher import (LaunchError, WorkerHandle,
+                                              launch_with_backoff,
+                                              launch_worker)
+from kmeans_tpu.orchestrator.policy import (AutopilotGaveUpError,
+                                            Decision, backoff_delay_s,
+                                            classify_exit,
+                                            select_resume)
+
+__all__ = [
+    "Autopilot", "AutopilotResult", "run_autopilot",
+    "LaunchError", "WorkerHandle", "launch_worker",
+    "launch_with_backoff",
+    "AutopilotGaveUpError", "Decision", "backoff_delay_s",
+    "classify_exit", "select_resume",
+]

@@ -1,0 +1,187 @@
+"""One host of an autopilot-supervised fleet (ISSUE 19).
+
+Spawned by ``orchestrator.launcher`` as
+``python -m kmeans_tpu.orchestrator.worker --spec ... --index i
+--world n --out dir [--resume ckpt]``.  The worker:
+
+1. resolves its fleet identity from the env the launcher set (simulated
+   ``KMEANS_TPU_*`` overrides, or a real ``jax.distributed`` handshake
+   when coordinator env is present),
+2. arms any DETERMINISTIC fault injections the shared spec requests
+   (``utils.faults`` registry hooks — the chaos matrix flows through the
+   real fit code paths, never mocks),
+3. runs ``KMeans(...).fit(X, resume=..., checkpoint_every=...,
+   checkpoint_path=<out>/ckpt.p<i>.npz)`` under per-process
+   heartbeat/trace sinks, and
+4. reports through the TYPED exit-code contract
+   (``policy.EXIT_DONE/EXIT_PREEMPTED/EXIT_CKPT_CORRUPT``) plus
+   ``centroids.p<i>.npy`` / ``result.p<i>.json`` artifacts.
+
+Spec schema (JSON)::
+
+    {"k": 4, "max_iter": 8, "tolerance": 1e-30, "seed": 0,
+     "dtype": "float64",            # f64 => bit-exact resume parity
+     "checkpoint_every": 1,
+     "data_npy": "X.npy",           # or "synthetic": {n, d, kind, seed}
+     "devices_per_host": 1,         # XLA virtual-device count
+     "mesh": false,                 # build a data mesh over the devices
+     "compute_sse": true,
+     "faults": {                    # all optional, all deterministic
+       "kill": {"process_index": 1, "after_iteration": 2,
+                "tear": "none"|"primary"|"both"},
+       "slow": {"process_index": 1, "after_iteration": 2,
+                "seconds": 600.0}}}
+
+Kill faults are ONE-SHOT PER INDEX across relaunches: firing drops a
+latch file (``fault.kill.p<i>.latch``) in the out dir, and a relaunched
+worker at the same index sees the latch and does not re-arm — a
+preempted-then-resumed host must not be preempted forever.  ``tear``
+models a preemption that also tore the checkpoint mid-copy: after the
+(durable) kill, the primary file (and with ``"both"`` the ``.prev``
+rotation too) is overwritten with garbage, so the relaunch exercises
+the real ``load_state_with_fallback`` classification.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+from pathlib import Path
+
+
+def _load_data(spec, np):
+    if spec.get("data_npy"):
+        return np.load(spec["data_npy"])
+    syn = spec["synthetic"]
+    from kmeans_tpu.data.synthetic import host_equivalent
+    kind = syn.get("kind", "uniform")
+    centers = None
+    if kind == "blobs":
+        # Deterministic well-separated centers from the spec alone, so
+        # every incarnation of every worker regenerates the same data.
+        k = int(syn.get("centers_k", spec.get("k", 3)))
+        centers = np.asarray(
+            np.random.default_rng(int(syn.get("seed", 0)))
+            .uniform(-6.0, 6.0, size=(k, int(syn["d"]))))
+    return host_equivalent(int(syn["n"]), int(syn["d"]),
+                           kind=kind, seed=int(syn.get("seed", 0)),
+                           centers=centers)
+
+
+def _tear(path, mode: str) -> None:
+    """Overwrite checkpoint file(s) with garbage — the deterministic
+    stand-in for a write torn by the preemption."""
+    from kmeans_tpu.utils.checkpoint import prev_path
+    targets = [Path(path)]
+    if mode == "both":
+        targets.append(prev_path(path))
+    for t in targets:
+        if t.exists():
+            t.write_bytes(b"torn checkpoint (injected)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kmeans_tpu.orchestrator.worker")
+    ap.add_argument("--spec", required=True)
+    ap.add_argument("--index", type=int, required=True)
+    ap.add_argument("--world", type=int, required=True)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--resume", default=None)
+    args = ap.parse_args(argv)
+
+    spec = json.loads(Path(args.spec).read_text())
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+
+    # Device topology BEFORE the jax import (the only moment it binds).
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count="
+        f"{int(spec.get('devices_per_host', 1))}")
+    import jax
+
+    if spec.get("dtype") == "float64":
+        jax.config.update("jax_enable_x64", True)
+
+    import numpy as np
+
+    from kmeans_tpu import KMeans, obs
+    from kmeans_tpu.orchestrator import policy
+    from kmeans_tpu.utils import faults
+    from kmeans_tpu.utils.checkpoint import CheckpointCorruptError
+
+    if os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        from kmeans_tpu.parallel.multihost import initialize
+        initialize()        # real jax.distributed fleet (TPU pods)
+
+    X = _load_data(spec, np)
+    mesh = None
+    if spec.get("mesh"):
+        from kmeans_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh()
+
+    dtype = np.float64 if spec.get("dtype") == "float64" else None
+    km = KMeans(k=int(spec["k"]), max_iter=int(spec.get("max_iter", 100)),
+                tolerance=float(spec.get("tolerance", 1e-4)),
+                seed=int(spec.get("seed", 0)),
+                compute_sse=bool(spec.get("compute_sse", True)),
+                empty_cluster=spec.get("empty_cluster", "keep"),
+                dtype=dtype, mesh=mesh, host_loop=True,
+                compute_labels=False, verbose=False)
+
+    ckpt = policy.checkpoint_path(out, args.index)
+    fspec = spec.get("faults") or {}
+    kill = fspec.get("kill")
+    slow = fspec.get("slow")
+    latch = out / f"fault.kill.p{args.index}.latch"
+
+    stack = contextlib.ExitStack()
+    with stack:
+        if kill and int(kill["process_index"]) == args.index \
+                and not latch.exists():
+            stack.enter_context(faults.inject_host_kill(
+                args.index,
+                after_iteration=int(kill.get("after_iteration", 0))))
+        if slow and int(slow["process_index"]) == args.index:
+            stack.enter_context(faults.inject_checkpoint_delay(
+                float(slow.get("seconds", 600.0)),
+                after_iteration=int(slow.get("after_iteration", 0))))
+        stack.enter_context(obs.tracing(out / "trace.jsonl",
+                                        per_process=True))
+        stack.enter_context(obs.heartbeat(out / "hb.jsonl",
+                                          per_process=True))
+        try:
+            km.fit(X, resume=args.resume or False,
+                   checkpoint_every=int(spec.get("checkpoint_every", 1)),
+                   checkpoint_path=ckpt)
+        except faults.SimulatedPreemption:
+            # Routed fault path: the typed exit code IS the route — the
+            # supervisor classifies it (policy.classify_exit) against
+            # the committed relaunch budget.  Latch first so a resumed
+            # worker at this index is not re-preempted forever.
+            latch.touch()
+            if kill and kill.get("tear", "none") != "none":
+                _tear(ckpt, kill["tear"])
+            return policy.EXIT_PREEMPTED
+        except CheckpointCorruptError:
+            # Routed fault path: both rotations of the resume source
+            # are torn — typed exit for the supervisor's give-up rule.
+            return policy.EXIT_CKPT_CORRUPT
+
+    np.save(out / f"centroids.p{args.index}.npy",
+            np.asarray(km.centroids))
+    result = {"index": args.index, "world": args.world,
+              "iterations_run": int(km.iterations_run),
+              "sse": (float(km.sse_history[-1])
+                      if km.sse_history else None),
+              "resumed_from": args.resume}
+    (out / f"result.p{args.index}.json").write_text(json.dumps(result))
+    print(f"worker {args.index}/{args.world} done "
+          f"({km.iterations_run} iterations)", flush=True)
+    return policy.EXIT_DONE
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,6 +87,30 @@ def is_primary() -> bool:
     return jax.process_index() == 0
 
 
+def simulated_world_env(process_index: int, process_count: int,
+                        host: Optional[str] = None) -> dict:
+    """Environment overrides that make a PLAIN subprocess a member of a
+    simulated fleet (ISSUE 19): the ``KMEANS_TPU_PROCESS_INDEX`` /
+    ``_COUNT`` / ``_HOST`` identity variables ``obs.identity`` resolves
+    before any jax probe, so per-process heartbeat/trace sinks suffix
+    correctly and host-targeted fault injection
+    (``faults.inject_host_kill``) can pick its victim — WITHOUT a
+    ``jax.distributed`` handshake.  This is the mode the autopilot's
+    launcher uses on a single machine (and in CI, where the CPU backend
+    has no cross-process collectives); on a real cluster the launcher
+    passes coordinator env instead and the same identity layer reads
+    ``jax.process_index()``."""
+    if not 0 <= process_index < process_count:
+        raise ValueError(
+            f"process_index {process_index} outside world of "
+            f"{process_count}")
+    return {
+        "KMEANS_TPU_PROCESS_INDEX": str(process_index),
+        "KMEANS_TPU_PROCESS_COUNT": str(process_count),
+        "KMEANS_TPU_HOST": host or f"sim{process_index}",
+    }
+
+
 def fleet_barrier(tag: str = "fit-start") -> None:
     """Telemetry clock anchor (ISSUE 13): a cross-host barrier + a
     ``fleet.barrier`` trace event, emitted by the fit preludes.

@@ -318,3 +318,27 @@ def describe_checkpoint(path) -> Dict[str, Any]:
         },
     })
     return out
+
+
+def classify_resume(path) -> Dict[str, Any]:
+    """Typed resume classification for the orchestration layer
+    (ISSUE 19): is ``path`` worth handing to ``fit(resume=...)``, and
+    through which rotation?
+
+    Returns ``{"resumable", "source", "iteration", "detail"}`` where
+    ``source`` is ``"primary"`` (file loads), ``"prev"`` (primary
+    torn/missing but the ``.prev`` last-good rotation reads — exactly
+    the fallback ``load_state_with_fallback`` will take), or ``None``
+    (nothing loads: both torn, or no checkpoint yet).  Built on
+    :func:`describe_checkpoint`, so a multi-GB checkpoint classifies
+    in milliseconds without materializing arrays."""
+    desc = describe_checkpoint(path)
+    source = desc.get("source")
+    if source == "prev" and desc.get("prev_loads") is False:
+        source = None
+    return {
+        "resumable": source is not None,
+        "source": source,
+        "iteration": desc.get("iteration"),
+        "detail": desc,
+    }

@@ -38,6 +38,19 @@ test.  This module is the one place those injections live:
   in-flight request fails through the engine's dispatch guard and the
   micro-batch queue's per-member isolation, and the fleet router must
   re-dispatch it on a survivor with ZERO failed requests.
+* ``inject_host_kill(process_index, after_iteration=)`` — the fleet
+  variant of ``inject_kill_after_iteration`` (ISSUE 19): same
+  checkpoint-boundary registry, but the armed hook fires ONLY on the
+  process whose fleet identity (``obs.identity.identity()``) matches
+  ``process_index`` — so every worker of an autopilot fleet can arm the
+  same shared fault spec and exactly one host dies.
+* ``inject_launch_failures(n)`` — arm the launch-attempt hook: the
+  orchestrator's launcher calls :func:`on_launch` immediately before
+  every worker spawn, and the armed hook raises
+  :class:`SimulatedLaunchFailure` for the first ``n`` attempts — the
+  deterministic stand-in for a flaky scheduler/allocator, driving the
+  autopilot's bounded exponential launch backoff through the real
+  spawn path.
 
 All state is explicit (closures / context managers); nothing here is
 active unless a test arms it, and the hooks cost one empty-list check
@@ -54,9 +67,11 @@ import numpy as np
 
 __all__ = [
     "TransientIOError", "SimulatedPreemption", "SimulatedOOM",
-    "on_checkpoint", "on_segment_dispatch",
+    "SimulatedLaunchFailure",
+    "on_checkpoint", "on_segment_dispatch", "on_launch",
     "inject_kill_after_iteration", "inject_oom_on_segment",
     "inject_checkpoint_delay", "inject_replica_kill",
+    "inject_host_kill", "inject_launch_failures",
     "fail_first_attempts", "flaky_blocks", "poison_blocks",
 ]
 
@@ -70,6 +85,13 @@ class TransientIOError(IOError):
 class SimulatedPreemption(RuntimeError):
     """Injected kill at a checkpoint boundary.  NOT an ``OSError``:
     preemptions must propagate out of the fit, never be retried."""
+
+
+class SimulatedLaunchFailure(RuntimeError):
+    """Injected worker-launch failure (ISSUE 19).  NOT an ``OSError``
+    either: the launcher classifies it through its own typed retry
+    policy (bounded deterministic exponential backoff), never through
+    an IO retry loop."""
 
 
 class SimulatedOOM(RuntimeError):
@@ -166,6 +188,87 @@ def inject_checkpoint_delay(seconds: float, *, after_iteration: int = 0):
         with _HOOK_LOCK:
             if hook in _CHECKPOINT_HOOKS:
                 _CHECKPOINT_HOOKS.remove(hook)
+
+
+@contextlib.contextmanager
+def inject_host_kill(process_index: int, *, after_iteration: int = 0):
+    """Arm a one-shot, HOST-TARGETED kill (ISSUE 19): the first
+    checkpoint boundary whose completed-iteration count is
+    >= ``after_iteration`` raises :class:`SimulatedPreemption` — but
+    only on the process whose fleet identity
+    (``obs.identity.identity()['process_index']``) equals
+    ``process_index``.  Every worker of a fleet can therefore arm the
+    SAME shared fault spec and exactly one host dies, mid-segment, with
+    its last rotating checkpoint durably on disk (the hook registry
+    fires after the write).  Yields a record dict with ``fired_at``
+    (the kill iteration on the targeted host; None elsewhere/never)."""
+    from kmeans_tpu.obs.identity import identity
+
+    record = {"fired_at": None}
+
+    def hook(iteration: int, path) -> None:
+        if record["fired_at"] is None and iteration >= after_iteration \
+                and identity()["process_index"] == process_index:
+            record["fired_at"] = iteration
+            raise SimulatedPreemption(
+                f"injected host kill on process {process_index} after "
+                f"iteration {iteration} (armed at {after_iteration}); "
+                f"last checkpoint: {path}")
+
+    with _HOOK_LOCK:
+        _CHECKPOINT_HOOKS.append(hook)
+    try:
+        yield record
+    finally:
+        with _HOOK_LOCK:
+            if hook in _CHECKPOINT_HOOKS:
+                _CHECKPOINT_HOOKS.remove(hook)
+
+
+# Launch-attempt hook registry (ISSUE 19): the orchestrator's launcher
+# calls ``on_launch(process_index, attempt)`` immediately BEFORE every
+# worker spawn (inside its typed backoff try block, so an injected
+# failure takes exactly the retry path a real scheduler flake would).
+_LAUNCH_HOOKS: List[Callable[[int, int], None]] = []
+
+
+def on_launch(process_index: int, attempt: int) -> None:
+    """Fire the launch-attempt hooks (called by the orchestrator's
+    launcher right before spawning worker ``process_index``, on its
+    ``attempt``-th try).  Production cost: one truthiness check."""
+    if _LAUNCH_HOOKS:
+        for hook in list(_LAUNCH_HOOKS):
+            hook(process_index, attempt)
+
+
+@contextlib.contextmanager
+def inject_launch_failures(n: int):
+    """Arm a deterministic launch flake: the first ``n`` launch
+    attempts (counted fleet-wide, across workers and retries) raise
+    :class:`SimulatedLaunchFailure`, then every later attempt passes.
+    With ``n < launch retry budget`` the autopilot's bounded
+    exponential backoff recovers; with ``n >=`` budget it must raise
+    its typed give-up error.  Yields a record dict with ``fired``
+    (failures raised) and ``attempts`` ((process_index, attempt) pairs
+    seen)."""
+    record = {"fired": 0, "attempts": []}
+
+    def hook(process_index: int, attempt: int) -> None:
+        record["attempts"].append((process_index, attempt))
+        if record["fired"] < n:
+            record["fired"] += 1
+            raise SimulatedLaunchFailure(
+                f"injected launch failure {record['fired']}/{n} "
+                f"(worker {process_index}, attempt {attempt})")
+
+    with _HOOK_LOCK:
+        _LAUNCH_HOOKS.append(hook)
+    try:
+        yield record
+    finally:
+        with _HOOK_LOCK:
+            if hook in _LAUNCH_HOOKS:
+                _LAUNCH_HOOKS.remove(hook)
 
 
 # Segment-dispatch hook registry (ISSUE 5): the device-loop fit engines

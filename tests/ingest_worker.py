@@ -1,12 +1,23 @@
-"""Worker for the multi-process streamed-ingest test (not a test module
+"""Worker for the multi-process streamed-ingest tests (not a test module
 itself — spawned by tests/test_ingest.py).
 
-Each process streams ONLY its own local shards of the shared ``.npy``
-(``ingest='slab'``: the per-host O(slab) path), checks them bitwise
-against the blocking mono oracle and the source rows, device-synthesizes
-its shards of a second dataset against the host oracle, then fits with a
-shared explicit init and writes its centroids for the parent's
-cross-process bitwise comparison.
+Modes (argv[5], default ``parity``):
+
+* ``parity`` — each process streams ONLY its own local shards of the
+  shared ``.npy`` (``ingest='slab'``: the per-host O(slab) path), checks
+  them bitwise against the blocking mono oracle and the source rows,
+  device-synthesizes its shards of a second dataset against the host
+  oracle, then fits with a shared explicit init and writes its centroids
+  for the parent's cross-process bitwise comparison.
+* ``kill-fit`` — streamed-ingest fit with ``checkpoint_every=1`` and a
+  deterministic ``inject_kill_after_iteration`` preemption: every
+  process dies mid-fit (exit 75) leaving the rotating checkpoint — the
+  ISSUE 19 shrink scenario's first act.
+* ``resume-fit`` — run at a SMALLER world (2 -> 1): the process must
+  re-derive its streamed block ranges for the new world (its slab
+  shards now cover ALL rows), ``fit(resume=)`` from the checkpoint the
+  larger fleet left, and land bit-exact on the uninterrupted
+  same-world oracle.
 """
 
 import os
@@ -19,11 +30,16 @@ proc_id = int(sys.argv[1])
 nproc = int(sys.argv[2])
 port = sys.argv[3]
 tmp_dir = Path(sys.argv[4])
+mode = sys.argv[5] if len(sys.argv) > 5 else "parity"
 
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+if mode != "parity":
+    # The shrink/resume matrix runs in f64: resume parity across a
+    # WORLD-SIZE change must be bitwise, like the autopilot chaos tier.
+    jax.config.update("jax_enable_x64", True)
 
 from kmeans_tpu.parallel.multihost import initialize, is_primary  # noqa: E402
 
@@ -35,10 +51,66 @@ from kmeans_tpu import KMeans  # noqa: E402
 from kmeans_tpu.data import synthetic as synth  # noqa: E402
 from kmeans_tpu.data.io import from_npy  # noqa: E402
 from kmeans_tpu.parallel.mesh import make_mesh  # noqa: E402
+from kmeans_tpu.utils import faults  # noqa: E402
 
 mesh = make_mesh()
 path = tmp_dir / "global.npy"
 X = np.load(path)                         # oracle only — ingest reads mm
+
+
+def shared_fit_model(**kw):
+    """The fit every mode shares: explicit init from the source rows so
+    all processes/worlds start identically."""
+    rng = np.random.default_rng(1)
+    init = X[rng.choice(X.shape[0], size=4, replace=False)]
+    return KMeans(k=4, max_iter=6, tolerance=1e-12, seed=0, init=init,
+                  empty_cluster="keep", host_loop=False,
+                  verbose=is_primary(), **kw)
+
+
+ckpt = tmp_dir / "ingest_ck.npz"
+
+if mode == "kill-fit":
+    ds = from_npy(path, mesh, chunk_size=32, ingest="slab")
+    with faults.inject_kill_after_iteration(3):
+        try:
+            shared_fit_model().fit(ds, checkpoint_every=1,
+                                   checkpoint_path=ckpt)
+        except faults.SimulatedPreemption:
+            print(f"worker {proc_id}/{nproc} preempted OK", flush=True)
+            sys.exit(75)
+    print(f"worker {proc_id}/{nproc} was never preempted", flush=True)
+    sys.exit(1)
+
+if mode == "resume-fit":
+    # The shrunk world re-derives its streamed block ranges from
+    # scratch: this process's slab shards must now tile ALL rows.
+    ds = from_npy(path, mesh, chunk_size=32, ingest="slab")
+    spans = sorted((s.index[0].start or 0,
+                    min(s.index[0].stop, X.shape[0]))
+                   for s in ds.points.addressable_shards)
+    covered = 0
+    for lo, hi in spans:
+        assert lo <= covered, f"gap/overlap at {lo} (covered {covered})"
+        covered = max(covered, hi)
+    assert covered == X.shape[0], (covered, X.shape[0])
+    for s in ds.points.addressable_shards:
+        lo = s.index[0].start or 0
+        hi = min(s.index[0].stop, X.shape[0])
+        if hi > lo:
+            np.testing.assert_array_equal(
+                np.asarray(s.data)[: hi - lo], X[lo:hi])
+
+    resumed = shared_fit_model().fit(ds, resume=ckpt)
+    oracle = shared_fit_model().fit(
+        from_npy(path, mesh, chunk_size=32, ingest="slab"))
+    assert resumed.iterations_run == oracle.iterations_run
+    np.testing.assert_array_equal(np.asarray(resumed.centroids),
+                                  np.asarray(oracle.centroids))
+    np.save(tmp_dir / f"resume_centroids_{proc_id}.npy",
+            np.asarray(resumed.centroids))
+    print(f"worker {proc_id}/{nproc} resume OK", flush=True)
+    sys.exit(0)
 
 # Streamed per-host ingest vs the blocking mono oracle: every LOCAL
 # shard must be bitwise identical (each process checks only bytes it
@@ -75,11 +147,7 @@ for s in ds_syn.points.addressable_shards:
 
 # Fit on the streamed dataset with a shared explicit init: every
 # process must land on identical centroids.
-rng = np.random.default_rng(1)
-init = X[rng.choice(X.shape[0], size=4, replace=False)]
-km = KMeans(k=4, max_iter=6, tolerance=1e-12, seed=0, init=init,
-            empty_cluster="keep", host_loop=False,
-            verbose=is_primary()).fit(ds_slab)
+km = shared_fit_model().fit(ds_slab)
 np.save(tmp_dir / f"ingest_centroids_{proc_id}.npy",
         np.asarray(km.centroids))
 print(f"worker {proc_id}/{nproc} OK", flush=True)

@@ -315,6 +315,64 @@ def test_straggler_report_empty_raises():
         fleet.straggler_report([])
 
 
+def test_live_paused_fleet_flags_stalled_with_explicit_now():
+    """ISSUE 19 regression: a LIVE read (explicit ``now``, the
+    ``fleet-status --now`` / autopilot path) of a fleet whose hosts all
+    sit at the SAME iteration but stopped beating mid-fit must classify
+    them ``stalled`` — under the old behind-only rule this paused fleet
+    read healthy and the autopilot could never evict it."""
+    recs = _beats(0, t0=100.0, n=8, dt=0.02) \
+        + _beats(1, t0=100.0, n=8, dt=0.02)
+    # Nobody is behind; every last beat is mid-fit and 60 s old.
+    rep = fleet.straggler_report(recs, now=100.0 + 8 * 0.02 + 60.0)
+    host_flags = {h["process_index"]: h["flags"] for h in rep["hosts"]}
+    assert "stalled" in host_flags[0]
+    assert "stalled" in host_flags[1]
+    assert not rep["healthy"]
+    # Post-hoc (default now) keeps the old behind-only semantics.
+    assert fleet.straggler_report(recs)["healthy"]
+
+
+def test_live_finished_fleet_stays_healthy_with_explicit_now():
+    """The terminal completion beat (phase='finished', emitted at the
+    end of fit()) exempts a DONE host from the live stall rule: old
+    silence after a terminal beat is completion, not a hang."""
+    recs = []
+    for idx in range(2):
+        beats = _beats(idx, t0=100.0, n=8, dt=0.02)
+        done = dict(beats[-1])
+        done["ts"] = done["mono"] = beats[-1]["ts"] + 0.01
+        done["phase"] = "finished"
+        done.pop("rows_per_sec", None)
+        recs.extend(beats + [done])
+    rep = fleet.straggler_report(recs, now=100.0 + 8 * 0.02 + 60.0)
+    assert rep["healthy"], rep
+    assert all(h["phase"] == "finished" for h in rep["hosts"])
+
+
+def test_straggler_rows_carry_last_beat_ts():
+    """Report rows expose the last beat's ``ts`` — the autopilot's
+    per-incarnation stall gate keys on it."""
+    rep = fleet.straggler_report(_beats(0, t0=100.0, n=3, dt=0.5))
+    assert rep["hosts"][0]["ts"] == pytest.approx(101.0)
+
+
+def test_fit_emits_terminal_finished_beat():
+    """A completed fit()'s LAST beat is the terminal completion beat
+    (phase='finished') the live stall rule keys on."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(256, 4)).astype(np.float32)
+    got = []
+    with obs.heartbeat(callback=got.append, min_period_s=0.0):
+        KMeans(k=3, max_iter=3, seed=0, verbose=False).fit(X)
+    beats = [r for r in got if not r.get("tick")]
+    assert beats and beats[-1]["phase"] == "finished"
+    # The terminal beat repeats the final iteration — no rate sample,
+    # so fleet rate medians are unchanged by completion.
+    assert "rows_per_sec" not in beats[-1] or \
+        beats[-1]["iteration"] == beats[-2]["iteration"]
+
+
 # ---------------------------------------------------------------------------
 # Collective-comms accounting
 # ---------------------------------------------------------------------------

@@ -56,7 +56,7 @@ def test_registry_has_the_required_rules():
     assert {"trace-hazard", "cache-key", "dispatch", "thread",
             "counter-reset", "dead-private", "cache-name",
             "aot-key", "large-k", "fleet-record",
-            "ingest-span"} <= set(RULES)
+            "ingest-span", "fault-path"} <= set(RULES)
     assert len(RULES) >= 6
     for rule in RULES.values():
         assert rule.id and rule.incident, rule
@@ -1145,6 +1145,113 @@ def used(obj):
 """
     findings = run_on(tmp_path, src, subdir="models")
     assert [f for f in findings if f.rule == "dead-private"] == []
+
+
+# ---------------------------------------------------------------------------
+# fault-path (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+_FAULT_BAD = """
+from kmeans_tpu.utils.faults import SimulatedPreemption
+
+
+def supervise(worker):
+    try:
+        worker.step()
+    except SimulatedPreemption:
+        pass                       # swallowed fault: never routed
+"""
+
+_FAULT_OK_RAISE = """
+from kmeans_tpu.utils.faults import SimulatedPreemption
+
+
+class HostPreempted(RuntimeError):
+    pass
+
+
+def supervise(worker):
+    try:
+        worker.step()
+    except SimulatedPreemption as e:
+        raise HostPreempted(str(e)) from e
+"""
+
+_FAULT_OK_ROUTED = """
+def supervise(worker, policy):
+    try:
+        worker.step()
+    except OSError as e:
+        policy.record_retry(e)     # routed into the committed policy
+"""
+
+_FAULT_OK_TYPED_EXIT = """
+from kmeans_tpu.orchestrator import policy
+
+
+def worker_main(km, data):
+    try:
+        km.fit(data)
+    except TimeoutError:
+        return policy.EXIT_PREEMPTED
+    return policy.EXIT_DONE
+"""
+
+
+def test_fault_path_fires_on_swallowed_fault(tmp_path):
+    findings = [f for f in run_on(tmp_path, _FAULT_BAD,
+                                  subdir="orchestrator")
+                if f.rule == "fault-path"]
+    assert len(findings) == 1
+    assert "SimulatedPreemption" in findings[0].message
+
+
+def test_fault_path_fires_on_tuple_catch_in_parallel(tmp_path):
+    src = _FAULT_BAD.replace("except SimulatedPreemption:",
+                             "except (ValueError, OSError):")
+    findings = [f for f in run_on(tmp_path, src, subdir="parallel")
+                if f.rule == "fault-path"]
+    assert len(findings) == 1
+    assert "OSError" in findings[0].message
+
+
+def test_fault_path_silent_on_reraise(tmp_path):
+    findings = run_on(tmp_path, _FAULT_OK_RAISE, subdir="orchestrator")
+    assert [f for f in findings if f.rule == "fault-path"] == []
+
+
+def test_fault_path_silent_when_routed_to_policy(tmp_path):
+    findings = run_on(tmp_path, _FAULT_OK_ROUTED, subdir="orchestrator")
+    assert [f for f in findings if f.rule == "fault-path"] == []
+
+
+def test_fault_path_silent_on_typed_exit_return(tmp_path):
+    findings = run_on(tmp_path, _FAULT_OK_TYPED_EXIT,
+                      subdir="orchestrator")
+    assert [f for f in findings if f.rule == "fault-path"] == []
+
+
+def test_fault_path_ignores_non_fault_types(tmp_path):
+    src = _FAULT_BAD.replace("except SimulatedPreemption:",
+                             "except KeyError:")
+    findings = run_on(tmp_path, src, subdir="orchestrator")
+    assert [f for f in findings if f.rule == "fault-path"] == []
+
+
+def test_fault_path_scoped_to_supervised_tree(tmp_path):
+    findings = run_on(tmp_path, _FAULT_BAD, subdir="serving")
+    assert [f for f in findings if f.rule == "fault-path"] == []
+    findings = run_on(tmp_path, _FAULT_BAD, subdir="models")
+    assert [f for f in findings if f.rule == "fault-path"] == []
+
+
+def test_fault_path_suppression_honored(tmp_path):
+    src = _FAULT_BAD.replace(
+        "    except SimulatedPreemption:",
+        "    # lint: ok(fault-path) — fixture proves suppression\n"
+        "    except SimulatedPreemption:")
+    findings = run_on(tmp_path, src, subdir="orchestrator")
+    assert [f for f in findings if f.rule == "fault-path"] == []
 
 
 # ---------------------------------------------------------------------------
