@@ -150,6 +150,17 @@ construction — the published property is replication-adds-no-loss;
 real scaling is a hardware row.  Env: BENCH_N/_D/_K,
 BENCH_FLEET_REPLICAS (comma list, default "1,2").
 
+BENCH_LEARN=1 switches to the SERVE-AND-LEARN p99 EXCURSION row
+(ISSUE 20): per-request serving latency measured DURING an in-place
+online update (snapshot -> clone partial_fit -> atomic swap on a
+background thread) vs a quiet engine, interleaved per-rep p99 ratio
+pairs with ZERO failed requests asserted in-bench (the chaos
+contract).  Committed rule: <= 3x median excursion
+(``serving.learn.LEARN_P99_EXCURSION_BOUND``) — the update runs off
+the dispatch lock, so a breach means update work leaked into the
+serve path.  Env: BENCH_N/_D/_K, BENCH_LEARN_BATCH (rows per
+dispatch, default 512).
+
 BENCH_COST=1 switches to the DEVICE-COST OBSERVABILITY rows (ISSUE 12):
 analytic-vs-XLA-reported FLOPs and predicted-vs-observed peak-memory
 comparisons for the kmeans and gmm-diag step programs, captured
@@ -401,6 +412,21 @@ def main() -> None:
         log(f"bench: FLEET mode backend={backend} N={fn_} D={fd} "
             f"k={fk} replicas={fr}")
         bench_fleet(fn_, fd, fk, replicas=fr)
+        return
+
+    if os.environ.get("BENCH_LEARN"):
+        # Serve-and-learn p99 excursion (ISSUE 20): serving latency
+        # during an in-place update vs quiet, interleaved per-rep
+        # ratios, committed <= 3x bound, zero failed requests asserted.
+        from kmeans_tpu.benchmarks import bench_learn
+        ln_ = int(os.environ.get("BENCH_N",
+                                 2_000_000 if on_accel else 200_000))
+        ld = int(os.environ.get("BENCH_D", 128 if on_accel else 32))
+        lk = int(os.environ.get("BENCH_K", 1024 if on_accel else 64))
+        lb = int(os.environ.get("BENCH_LEARN_BATCH", 512))
+        log(f"bench: LEARN mode backend={backend} N={ln_} D={ld} "
+            f"k={lk} batch={lb}")
+        bench_learn(ln_, ld, lk, batch=lb)
         return
 
     if os.environ.get("BENCH_COST"):

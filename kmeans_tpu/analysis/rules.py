@@ -1267,6 +1267,89 @@ class FaultPathRule(Rule):
         return False
 
 
+# -------------------------------------------------------- atomic-swap
+
+class AtomicSwapRule(Rule):
+    """ISSUE 20: serving code that rebinds a resident model's table
+    attributes (``centroids``/``means_``/... and their f64 carries) or
+    touches the identity-keyed device-cache attributes
+    (``_cents_cache``/``_params_cache`` — the ``_cents_dev``/
+    ``_params_dev`` placement state) must route through the one swap
+    helper (``serving.learn.publish_tables``).  The helper owns the
+    publication ORDER — auxiliary state first, device placement
+    pre-seeded, the ``centroids`` rebind LAST — which is what makes a
+    concurrent reader see the old table or the new one, never a torn
+    mix.  A future update path writing these attributes inline would
+    compile-correctly, pass single-threaded tests, and publish torn
+    tables under load; this rule makes that a static finding."""
+
+    id = "atomic-swap"
+    incident = ("ISSUE 20: an in-place table publication outside the "
+                "atomic swap helper — readers could observe a torn "
+                "centroid table mid-update")
+
+    #: Attribute leaves whose rebinding IS a table publication: the
+    #: model tables the serving dispatch reads (K-Means + GMM
+    #: families), their float64 carries/lifetime counts, and the
+    #: identity-keyed device caches behind ``_cents_dev``/
+    #: ``_params_dev``.
+    _SWAP_ATTRS = {
+        "centroids", "_centroids_f64", "_seen", "cluster_sizes_",
+        "_cents_cache",
+        "means_", "covariances_", "weights_", "precisions_cholesky_",
+        "_params_cache",
+    }
+    #: The designated swap helpers — the only serving/ functions
+    #: allowed to write the attributes above.
+    _SWAP_HELPERS = {"publish_tables"}
+
+    def run(self, pkg: Package) -> Iterator[Finding]:
+        for mod in pkg:
+            p = mod.rel.replace("\\", "/")
+            if "/serving/" not in p:
+                continue
+            exempt: Set[int] = set()
+            for fn in ast.walk(mod.tree):
+                if isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) \
+                        and fn.name in self._SWAP_HELPERS:
+                    for n in ast.walk(fn):
+                        exempt.add(id(n))
+            for node in ast.walk(mod.tree):
+                for line, attr in self._table_stores(node, exempt):
+                    yield self.finding(
+                        mod, line,
+                        f"rebinds model table state .{attr} outside "
+                        f"the atomic swap helper — route the "
+                        f"publication through "
+                        f"serving.learn.publish_tables() so "
+                        f"concurrent readers never see a torn table")
+
+    @classmethod
+    def _table_stores(cls, node: ast.AST, exempt: Set[int]):
+        """(line, attr) for every write/delete of a table attribute in
+        ``node`` (Assign/AugAssign/AnnAssign targets and ``del``), one
+        entry per statement, skipping the designated helpers."""
+        if id(node) in exempt:
+            return
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        elif isinstance(node, ast.Delete):
+            targets = list(node.targets)
+        for t in targets:
+            # Unpack tuple/list targets: `a.x, b.y = ...`.
+            parts = t.elts if isinstance(t, (ast.Tuple, ast.List)) \
+                else [t]
+            for part in parts:
+                if isinstance(part, ast.Attribute) \
+                        and part.attr in cls._SWAP_ATTRS:
+                    yield node.lineno, part.attr
+                    return
+
+
 # -------------------------------------------------------- suppression
 
 class SuppressionFormatRule(Rule):
@@ -1302,5 +1385,5 @@ RULES: Dict[str, Rule] = {rule.id: rule for rule in (
     FleetRecordRule(), ThreadHygieneRule(), CounterResetRule(),
     DeadPrivateRule(),
     CacheNameRule(), AotKeyRule(), LargeKRule(),
-    FaultPathRule(), SuppressionFormatRule(),
+    FaultPathRule(), AtomicSwapRule(), SuppressionFormatRule(),
 )}

@@ -2150,6 +2150,120 @@ def bench_fleet(n: int, d: int, k: int, *, reps: int = 5,
     return rows
 
 
+def bench_learn(n: int, d: int, k: int, *, reps: int = 5,
+                batch: int = 512, waves: int = 32) -> Dict:
+    """Serve-and-learn p99 excursion (ISSUE 20: ``BENCH_LEARN=1
+    python bench.py``): per-request serving latency measured DURING an
+    in-place online update vs a quiet engine, interleaved per-rep —
+    the r15/r18 overhead discipline applied to the actuator.
+
+    One MiniBatch model is held resident with ``learn`` on.  Each rep
+    runs a QUIET wave (``waves`` direct ``call`` dispatches of
+    ``batch`` rows, per-call latencies collected) and an UPDATE wave
+    (the same traffic while a forced update — snapshot, clone
+    ``partial_fit``, atomic swap — runs on a background thread; the
+    wave's traffic itself feeds the reservoir, so the measured path is
+    the real one including the reservoir copy).  The published
+    excursion is the median of per-rep p99(update)/p99(quiet) ratios.
+    Committed rule: :data:`~kmeans_tpu.serving.learn.
+    LEARN_P99_EXCURSION_BOUND` (3x) — the update runs off the dispatch
+    lock, so anything past scheduler noise means update work leaked
+    into the serve path.  ZERO failed requests is asserted IN-BENCH
+    (the chaos contract: an update must never fail a serving
+    request)."""
+    import threading
+
+    import jax
+
+    from kmeans_tpu.models.minibatch import MiniBatchKMeans
+    from kmeans_tpu.parallel.mesh import make_mesh
+    from kmeans_tpu.serving import ServingEngine
+    from kmeans_tpu.serving.learn import LEARN_P99_EXCURSION_BOUND
+
+    rng = np.random.default_rng(42)
+    X = rng.uniform(-1.0, 1.0, size=(n, d)).astype(np.float32)
+    mb = MiniBatchKMeans(k=k, max_iter=10, seed=0, batch_size=4096,
+                         verbose=False).fit(X)
+    pool = rng.uniform(-1.0, 1.0,
+                       size=(max(batch * 8, 4096), d)).astype(np.float32)
+
+    mesh = make_mesh()
+    eng = ServingEngine(mesh=mesh, quality=True, start=False,
+                        learn={"batch_rows": batch, "min_rows": batch,
+                               "max_batches": 2, "cooldown_windows": 0,
+                               "update_budget": reps + 2,
+                               "reservoir_rows": batch * 8})
+    eng.add_model("learn", mb)
+    eng.warmup()
+    ln = eng._residents["learn"].learner
+    _log(f"[learn] resident k={k} d={d}, batch={batch}, waves={waves}, "
+         f"backend={jax.default_backend()}")
+
+    n_blocks = pool.shape[0] // batch
+    failed = [0]
+
+    def wave(start: int) -> np.ndarray:
+        lats = np.empty(waves)
+        for i in range(waves):
+            j = ((start + i) % n_blocks) * batch
+            t0 = time.perf_counter()
+            try:
+                eng.call("learn", pool[j: j + batch])
+            except Exception:   # noqa: BLE001 — the contract IS zero
+                failed[0] += 1  # failed requests; count, don't mask
+            lats[i] = time.perf_counter() - t0
+        return lats
+
+    wave(0)                                     # burn-in (incl. reservoir)
+    ln.update_now(force=True, reason="bench-warm")   # warm the update step
+    ratios, applied = [], 0
+    for rep in range(reps):
+        quiet = wave(rep)
+        upd_dec = [None]
+
+        def updater():
+            upd_dec[0] = ln.update_now(force=True, reason="bench")
+
+        t = threading.Thread(target=updater)
+        t.start()
+        busy = wave(rep + reps)
+        t.join(timeout=120.0)
+        if upd_dec[0] is not None and upd_dec[0]["action"] == "update":
+            applied += 1
+        p99_q = float(np.percentile(quiet, 99))
+        p99_u = float(np.percentile(busy, 99))
+        ratios.append(p99_u / p99_q)
+        _log(f"[learn] rep {rep + 1}/{reps}: quiet p99 "
+             f"{p99_q * 1e3:.2f} ms, update p99 {p99_u * 1e3:.2f} ms "
+             f"({ratios[-1]:.3f}x, "
+             f"{'applied' if upd_dec[0] else 'skipped'})")
+    assert failed[0] == 0, f"{failed[0]} serving requests failed " \
+        "during update waves (the never-fail contract)"
+    excursion = float(np.median(ratios))
+    spread = (max(ratios) - min(ratios)) / excursion
+    status = ln.status()
+    row = {
+        "metric": f"serve_learn_p99_excursion_N{n}_D{d}_k{k}",
+        "excursion_ratio": round(excursion, 3),
+        "excursion_spread": round(spread, 3),
+        "indicative_only": bool(spread > 0.05),
+        "within_bound": bool(excursion <= LEARN_P99_EXCURSION_BOUND),
+        "rule": f"<= {LEARN_P99_EXCURSION_BOUND}x median p99 "
+                "update-wave/quiet-wave; a breach means update work "
+                "leaked into the dispatch path",
+        "batch": batch, "waves": waves, "reps": reps,
+        "updates_applied": status["updates_applied"],
+        "updates_in_measured_waves": applied,
+        "rollbacks": len(status["rollbacks"]),
+        "failed_requests": 0,               # asserted above
+        "platform": jax.default_backend(),
+        "n_devices": len(jax.devices()),
+    }
+    print(json.dumps(row), flush=True)
+    eng.close()
+    return row
+
+
 def bench_sweep(n: int, d: int, k_values, n_init: int,
                 max_iter: int, reps: int = 3) -> Dict:
     """Sweep-vs-sequential benchmark (ISSUE 7 acceptance row): the

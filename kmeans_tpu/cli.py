@@ -299,6 +299,11 @@ def serve_main(argv=None) -> int:
       snapshot (ISSUE 14: detector readings, debounced state, event
       counts; ``--quality-dir`` additionally streams the per-model
       JSONL sinks ``serve-status`` reads).
+    * ``{"learn": true}`` — with ``--learn`` (ISSUE 20 serve-and-learn
+      actuator), reply with the per-model update status (armed state,
+      budgets left, reservoir fill, pending evaluation, recent
+      decision log; per replica in fleet mode); an error line when
+      serving without ``--learn``.
     * ``{"fleet_stats": true}`` — with ``--replicas N`` (ISSUE 17:
       in-process :class:`ServingFleet` — N replica engines behind the
       SLO-aware router), reply with the fleet snapshot (per-replica
@@ -358,6 +363,13 @@ def serve_main(argv=None) -> int:
     parser.add_argument("--no-quality", action="store_true",
                         help="disable drift monitoring (the blind "
                              "r11 engine)")
+    parser.add_argument("--learn", action="store_true",
+                        help="serve-and-learn (ISSUE 20): let eligible "
+                             "resident models update in place from "
+                             "sampled traffic when their drift monitor "
+                             "fires — snapshot-before-update, atomic "
+                             "swap, rollback-on-regression; implies "
+                             "quality monitoring on")
     parser.add_argument("--json", action="store_true",
                         help="print the final stats snapshot as JSON "
                              "on stdout")
@@ -379,13 +391,23 @@ def serve_main(argv=None) -> int:
         return 2
     quality = (False if args.no_quality else True if args.quality
                else "auto")
+    if args.learn:
+        if args.no_quality:
+            print("error: --learn requires quality monitoring (the "
+                  "serve-and-learn trigger IS the drift monitor); "
+                  "drop --no-quality", file=sys.stderr)
+            return 2
+        # The learn trigger is the drift monitor, so 'auto' must not
+        # resolve quality off on CPU under --learn.
+        if quality == "auto":
+            quality = True
     fleet_mode = args.replicas > 1 or args.slo_p99_ms is not None
     if fleet_mode:
         engine = ServingFleet(
             args.replicas, buckets=buckets,
             max_wait_ms=args.max_wait_ms, quality=quality,
             fleet_dir=(None if args.no_quality else args.quality_dir),
-            slo_p99_ms=args.slo_p99_ms)
+            slo_p99_ms=args.slo_p99_ms, learn=args.learn)
         print(f"serve: fleet of {args.replicas} replicas"
               + (f", SLO p99 <= {args.slo_p99_ms} ms"
                  if args.slo_p99_ms is not None else ""),
@@ -395,7 +417,8 @@ def serve_main(argv=None) -> int:
                                max_wait_ms=args.max_wait_ms,
                                quality=quality,
                                quality_dir=(None if args.no_quality
-                                            else args.quality_dir))
+                                            else args.quality_dir),
+                               learn=args.learn)
     try:
         for i, path in enumerate(args.models):
             mid = ids[i] if i < len(ids) else None
@@ -431,6 +454,15 @@ def serve_main(argv=None) -> int:
                     continue
                 if req.get("quality"):
                     print(json.dumps(engine.quality_status()),
+                          flush=True)
+                    continue
+                if req.get("learn"):
+                    if not args.learn:
+                        raise ValueError(
+                            "learn status requires serving with "
+                            "--learn (the serve-and-learn actuator "
+                            "is off)")
+                    print(json.dumps(engine.update_status()),
                           flush=True)
                     continue
                 if req.get("fleet_stats"):
@@ -1014,7 +1046,11 @@ _BENCH_LOWER_BETTER = ("ms_per_iter", "p50_ms", "p99_ms",
                        # cold->warm regressions in time-to-first-
                        # iteration guard like ms/iter rows.
                        "ms", "ttfi_s", "compile_ms", "first_dispatch_ms",
-                       "overlap_window_s")
+                       "overlap_window_s",
+                       # Serve-and-learn (ISSUE 20): the BENCH_LEARN
+                       # p99 excursion ratio — growth means update
+                       # work leaking into the dispatch path.
+                       "excursion_ratio")
 _BENCH_HIGHER_BETTER = ("value", "pts_dims_per_s_chip", "qps",
                         "speedup_vs_sequential", "overlap_speedup",
                         "step_mfu")

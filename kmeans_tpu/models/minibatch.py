@@ -822,6 +822,54 @@ class MiniBatchKMeans(KMeans):
         self._set_fit_data(X)
         return self
 
+    def _learn_clone(self) -> "MiniBatchKMeans":
+        """Detached working copy for the serve-and-learn actuator
+        (ISSUE 20): ``partial_fit`` on the clone must never mutate THIS
+        model's state, because this model keeps serving concurrently
+        while the clone absorbs reservoir batches off the dispatch
+        path.
+
+        A shallow copy shares everything immutable-by-convention (the
+        mesh — so the clone reuses the SAME compiled step programs,
+        the zero-new-compiles contract — and the constructor config)
+        while the mutable training state gets fresh copies.  The one
+        aliasing hazard is ``_seen``: ``partial_fit`` feeds it through
+        ``np.asarray(..., float64)`` — a NO-COPY passthrough for a
+        float64 array — and ``_apply_batch_stats`` then updates it IN
+        PLACE (``seen += counts``), so a shared array would corrupt
+        the serving model's lifetime counts mid-update.
+
+        NOT ``copy.copy``: that routes through ``__getstate__``, which
+        materializes ``labels_`` — a full-dataset predict on the
+        fit-time mesh, i.e. a surprise giant dispatch inside the
+        background update (and a hard failure when the engine has
+        re-pointed ``mesh`` since fit)."""
+        if self.centroids is None:
+            raise ValueError("_learn_clone requires a fitted model")
+        clone = object.__new__(type(self))
+        clone.__dict__.update(self.__dict__)
+        clone.centroids = np.array(self.centroids, copy=True)
+        carried = getattr(self, "_centroids_f64", None)
+        clone._centroids_f64 = (np.array(carried, np.float64, copy=True)
+                                if carried is not None else None)
+        clone._seen = np.array(getattr(self, "_seen", np.zeros(self.k)),
+                               dtype=np.float64, copy=True)
+        clone.sse_history = list(self.sse_history)
+        sizes = getattr(self, "cluster_sizes_", None)
+        if sizes is not None:
+            clone.cluster_sizes_ = np.array(sizes, copy=True)
+        # The device-table cache is identity-keyed serving state, not
+        # training state: the clone places its own tables on first use
+        # and must never overwrite the serving model's entry.
+        clone._cents_cache = None
+        clone._fit_ds = None
+        clone._labels_cache = None
+        # Update batches run on a background thread while the original
+        # serves traffic; per-iteration prints there would interleave
+        # with serving output (and verbosity never touches the math).
+        clone.verbose = False
+        return clone
+
     def fit_stream(self, make_blocks, *, d=None, resume=False,
                    prefetch=2, **kwargs):
         """Blocked: the inherited exact-Lloyd ``fit_stream`` would silently

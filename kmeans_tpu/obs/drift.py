@@ -139,7 +139,13 @@ PROFILE_VERSION = 1
 
 #: Record kinds a quality JSONL sink may contain (the ``serve-status``
 #: classification rule; anything else in a stream is malformed).
-QUALITY_KINDS = ("profile", "window", "drift", "recovered")
+#: ``update``/``rollback`` are the serve-and-learn actuator's decision
+#: records (ISSUE 20): one line per in-place online update attempt and
+#: one per rollback-to-last-good, written through the SAME per-model
+#: sink the drift trigger writes — the multi-file reader aggregates
+#: trigger and actuator into one per-model row.
+QUALITY_KINDS = ("profile", "window", "drift", "recovered",
+                 "update", "rollback")
 
 
 # ------------------------------------------------------------- detectors
@@ -535,6 +541,21 @@ class QualityMonitor:
         with self._lock:
             return [dict(w) for w in self._history]
 
+    def record(self, kind: str, **fields) -> None:
+        """Append one serve-and-learn decision record (ISSUE 20) to
+        this model's quality sink: the actuator's ``update``/
+        ``rollback`` lines share the stream with the trigger's window/
+        drift records so ``serve-status`` reads one file per (model,
+        replica).  Sink-only — the caller owns its tracer events and
+        registry counters (the learner's triple-recording contract);
+        isolation and write-after-close behavior are ``_sink``'s."""
+        if kind not in ("update", "rollback"):
+            raise ValueError(
+                f"record() writes serve-and-learn decision records "
+                f"('update'/'rollback'), got kind {kind!r}")
+        self._sink({"kind": kind, "model": self.model_id,
+                    "ts": time.time(), **fields})
+
     def close(self) -> None:
         with self._sink_lock:
             if self._file is not None:
@@ -630,7 +651,9 @@ def quality_report(paths) -> dict:
         row = models.setdefault(rec["model"], {
             "model": rec["model"], "windows": 0, "rows": 0,
             "events": 0, "reference": False, "detectors": None,
-            "breaching": [], "drifting": False, "last_ts": None})
+            "breaching": [], "drifting": False, "last_ts": None,
+            "updates": 0, "update_failures": 0, "rollbacks": 0,
+            "last_update": None})
         row["last_ts"] = rec.get("ts")
         if rec["kind"] == "profile":
             row["reference"] = True
@@ -646,6 +669,21 @@ def quality_report(paths) -> dict:
             row["drifting"] = True
         elif rec["kind"] == "recovered":
             row["drifting"] = False
+        elif rec["kind"] == "update":
+            # Serve-and-learn actuator records (ISSUE 20).  Every
+            # learner decision rides the stream (the triple-recording
+            # contract), tagged by ``action``: only APPLIED updates
+            # count as updates and only failed attempts as failures —
+            # skips/evaluations are context, not actuation.
+            act = rec.get("action", "applied" if rec.get("ok", True)
+                          else "failed")
+            if act == "applied":
+                row["updates"] += 1
+                row["last_update"] = rec.get("ts")
+            elif act == "failed":
+                row["update_failures"] += 1
+        elif rec["kind"] == "rollback":
+            row["rollbacks"] += 1
     drifting = sorted(m for m, r in models.items() if r["drifting"])
     return {"files": [str(f) for f in files],
             "models": dict(sorted(models.items())),
@@ -672,6 +710,16 @@ def format_quality_status(report: dict) -> str:
         det = row.get("detectors") or {}
         state = "DRIFTING" if row["drifting"] else (
             "ok" if row.get("reference") else "no-reference")
+        # Serve-and-learn annotation (ISSUE 20): the actuator's applied
+        # updates / rollbacks ride the state column, so a drifting row
+        # also says whether the loop already acted on it.
+        learn = []
+        if row.get("updates"):
+            learn.append(f"{row['updates']}upd")
+        if row.get("rollbacks"):
+            learn.append(f"{row['rollbacks']}rb")
+        if learn:
+            state += f" ({','.join(learn)})"
         lines.append(
             f"  {mid[:16]:<16} {row['windows']:>7} {row['rows']:>9} "
             f"{_fmt(det.get('psi')):>8} {_fmt(det.get('js')):>8} "

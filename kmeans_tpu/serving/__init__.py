@@ -15,6 +15,11 @@ Entry points:
 * :class:`ServingFleet` — N replicated engines behind an SLO-aware
   router with admission control, load shedding, and pack-group-aware
   placement (``serving.fleet``, ISSUE 17).
+* :class:`ModelLearner` / :func:`publish_tables` — serve-and-learn
+  actuator (``serving.learn``, ISSUE 20): drift-triggered in-place
+  ``partial_fit`` updates with snapshot-before-update, one atomic
+  table swap, and rollback-on-regression, enabled via
+  ``ServingEngine(learn=...)`` / ``ServingFleet(learn=...)``.
 
 CLI: ``python -m kmeans_tpu serve --model <ckpt> [--model <ckpt> ...]``
 (stdin/JSONL request loop, no network dependency; ``--replicas N``
@@ -29,9 +34,12 @@ from kmeans_tpu.serving.batching import (MicroBatchQueue,
 from kmeans_tpu.serving.engine import ResidentModel, ServingEngine
 from kmeans_tpu.serving.fleet import (FleetFuture, FleetOverloadError,
                                       ReplicaDeadError, ServingFleet)
+from kmeans_tpu.serving.learn import (ModelLearner, UpdateRolledBack,
+                                      publish_tables)
 from kmeans_tpu.serving.registry import ModelRegistry, load_fitted
 
 __all__ = ["ServingEngine", "ResidentModel", "MicroBatchQueue",
            "ServingFuture", "ServingClosedError", "ModelRegistry",
            "load_fitted", "ServingFleet", "FleetFuture",
-           "FleetOverloadError", "ReplicaDeadError"]
+           "FleetOverloadError", "ReplicaDeadError", "ModelLearner",
+           "UpdateRolledBack", "publish_tables"]

@@ -127,6 +127,9 @@ class ResidentModel:
         # with quality monitoring off.  Fed exclusively with outputs
         # the dispatch already computed (engine._observe_quality).
         self.monitor: Optional[obs_drift.QualityMonitor] = None
+        # Serve-and-learn actuator (ISSUE 20); None when the engine
+        # runs without learn= or the model is not update-eligible.
+        self.learner = None
         # bucket -> registry Histogram for request latency; resolved
         # once per (model, bucket) so the per-dispatch feed skips the
         # name build + registry lock (hot-path cost, BENCH_QUALITY).
@@ -209,13 +212,26 @@ class ServingEngine:
         (ISSUE 17) sharing one ``quality_dir`` keep distinct sinks —
         the ``serve-status`` multi-file reader merges them per model.
         None (default) keeps the documented single-engine name.
+    learn : False | True | dict
+        Serve-and-learn actuator (ISSUE 20).  ``True`` attaches a
+        :class:`~kmeans_tpu.serving.learn.ModelLearner` to every
+        eligible resident (MiniBatch-style ``partial_fit`` family,
+        monitored, not PQ-compressed): the model updates IN PLACE from
+        sampled live traffic when its drift monitor fires — snapshot
+        first, one atomic table swap, rollback on regression.  A dict
+        enables learning AND overrides the committed constants per
+        engine (keys: ``dir`` for the snapshot directory — defaults to
+        ``quality_dir`` — plus any :class:`ModelLearner` budget/
+        threshold kwarg).  Requires quality monitoring to resolve ON:
+        the learn trigger IS the drift monitor.
     """
 
     def __init__(self, *, mesh=None, buckets=DEFAULT_BUCKETS,
                  max_wait_ms: float = 2.0, clock=None, start: bool = True,
                  donate="auto", quality="auto", quality_dir=None,
                  quality_window: Optional[int] = None,
-                 quality_tag: Optional[str] = None):
+                 quality_tag: Optional[str] = None,
+                 learn=False):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.buckets = check_buckets(buckets)
         self.registry = ModelRegistry()
@@ -251,6 +267,31 @@ class ServingEngine:
             if quality_window is not None else obs_drift.DRIFT_WINDOW_ROWS
         self._quality_tag = str(quality_tag) if quality_tag is not None \
             else None
+        # Serve-and-learn actuator config (ISSUE 20): False -> off,
+        # True -> committed defaults, dict -> per-engine overrides.
+        if learn in (False, None):
+            self._learn_cfg = None
+        else:
+            cfg = {} if learn is True else dict(learn)
+            if not isinstance(cfg, dict):
+                raise ValueError(f"learn must be False, True or a dict "
+                                 f"of overrides, got {learn!r}")
+            allowed = {"dir", "batch_rows", "max_batches",
+                       "reservoir_rows", "min_rows", "update_budget",
+                       "rollback_budget", "cooldown_windows",
+                       "regression_ratio", "eval_windows"}
+            unknown = set(cfg) - allowed
+            if unknown:
+                raise ValueError(f"unknown learn config keys "
+                                 f"{sorted(unknown)}; allowed: "
+                                 f"{sorted(allowed)}")
+            if not self._quality:
+                raise ValueError(
+                    "learn requires quality monitoring: the "
+                    "serve-and-learn trigger IS the drift monitor "
+                    "(pass quality=True, or a quality_dir)")
+            self._learn_cfg = cfg
+        self._learn_dir = None          # lazily resolved snapshot dir
         # Fleet glue (ISSUE 17): an optional pre-dispatch hook, called
         # with (model_id, op) before EVERY dispatch — direct, queued,
         # and packed.  The fleet's replica wrapper raises
@@ -337,8 +378,41 @@ class ServingEngine:
             rm.monitor = obs_drift.QualityMonitor(
                 model_id, spec["k"], profile=profile,
                 window_rows=self._quality_window, sink_path=sink)
+        self._attach_learner(rm)
         self._residents[model_id] = rm
         return rm
+
+    def _attach_learner(self, rm: ResidentModel) -> None:
+        """Attach the serve-and-learn actuator (ISSUE 20) when the
+        engine runs with ``learn=`` and the model is update-eligible:
+        K-Means family with a real ``partial_fit`` (the MiniBatch
+        Sculley carry IS the update engine), monitored (the trigger is
+        the drift monitor), and not ``quantize='pq'`` — the PQ codes
+        are trained against the ADD-TIME table, so an in-place swap
+        would serve stale codes against a moved table.  ``bf16`` is
+        fine (it reads the live ``_cents_dev`` placement), and a
+        two-level resident never gets here (its coarse route has no
+        ``partial_fit``).  Ineligible models serve unchanged with
+        ``update_status()[model_id] is None``."""
+        if self._learn_cfg is None or rm.monitor is None:
+            return
+        if not rm.spec.get("updatable") or rm.quantize == "pq":
+            return
+        if rm.spec.get("assign") == "two_level":
+            return
+        from kmeans_tpu.serving import learn as serve_learn
+        if self._learn_dir is None:
+            self._learn_dir = self._learn_cfg.get("dir") \
+                or self._quality_dir
+            if self._learn_dir is None:
+                import tempfile
+                self._learn_dir = tempfile.mkdtemp(prefix="kmeans-learn-")
+        kwargs = {k: v for k, v in self._learn_cfg.items() if k != "dir"}
+        rm.learner = serve_learn.ModelLearner(
+            self, rm,
+            snapshot_path=serve_learn.snapshot_path_for(
+                self._learn_dir, rm.model_id, self._quality_tag),
+            **kwargs)
 
     def load(self, path, model_id: Optional[str] = None, *,
              quantize: Optional[str] = None) -> str:
@@ -355,6 +429,13 @@ class ServingEngine:
     def remove(self, model_id: str) -> None:
         self.registry.remove(model_id)
         rm = self._residents.pop(model_id)
+        # Learner FIRST, and joined: an in-flight update must finish
+        # (or abort unpublished) BEFORE the monitor sink closes —
+        # otherwise the update's decision record is a write-after-
+        # remove to a freed sink (ISSUE 20 satellite; the
+        # QualityMonitor.close() class of bug).
+        if rm.learner is not None:
+            rm.learner.close(join=True)
         if rm.monitor is not None:
             rm.monitor.close()
         with self._lock:
@@ -443,6 +524,19 @@ class ServingEngine:
         rm.monitor.observe(rows, labels=labels, score=score,
                            near_ties=near_ties,
                            guarded_rows=guarded_rows)
+
+    def _feed_learner(self, rm: ResidentModel, rows: np.ndarray) -> None:
+        """Serve-and-learn reservoir tap (ISSUE 20): retain THIS
+        dispatch's already-materialized rows and run the O(1) trigger
+        check.  Same discipline as the quality feed it rides next to —
+        host-side only, never an extra dispatch, warmup probes
+        excluded — so learning off/idle is dispatch-count-identical to
+        learning absent."""
+        ln = rm.learner
+        if ln is None or getattr(self._tls, "warming", False):
+            return
+        ln.offer(rows)
+        ln.poke()
 
     def _kmeans_modes(self, rm: ResidentModel, B: int) -> Tuple[str, str]:
         """(assign mode, transform mode) for a bucket-B dispatch —
@@ -575,6 +669,7 @@ class ServingEngine:
             labels=out if op == "predict" else None,
             score=out if op == "score_rows" else None,
             near_ties=corrected, guarded_rows=guarded)
+        self._feed_learner(rm, rows)
         return out
 
     def _assign_bf16_guarded(self, rm: ResidentModel, buf: np.ndarray,
@@ -846,6 +941,7 @@ class ServingEngine:
         for (mid, block), lab in zip(items, results):
             self._observe_quality(rms[mid], B, dt, rows=block.shape[0],
                                   labels=lab)
+            self._feed_learner(rms[mid], block)
         return results
 
     # ----------------------------------------------- bf16 verification
@@ -1022,7 +1118,20 @@ class ServingEngine:
         # each monitor takes its own lock, and nesting them under the
         # engine's would order-couple dispatch and stats paths.
         stats["quality"] = self.quality_status()
+        if self._learn_cfg is not None:
+            stats["learn"] = self.update_status()
         return stats
+
+    def update_status(self) -> dict:
+        """Per-model serve-and-learn snapshot (ISSUE 20): armed state,
+        budgets left, reservoir fill, pending evaluation, and the
+        recent decision log.  ``{model_id: None}`` entries mean the
+        model is not update-eligible (or learning is off).  Assembled
+        outside the engine lock — each learner takes its own state
+        lock, same discipline as ``quality_status``."""
+        return {mid: (rm.learner.status() if rm.learner is not None
+                      else None)
+                for mid, rm in sorted(self._residents.items())}
 
     def quality_status(self) -> dict:
         """Per-model drift-monitor snapshot (the ``stats()`` quality
@@ -1062,7 +1171,12 @@ class ServingEngine:
 
     def close(self) -> None:
         """Drain the queue, join its worker, close the drift-monitor
-        sinks (idempotent)."""
+        sinks (idempotent).  Learners close FIRST (joining any
+        in-flight update) so an update thread can neither publish to a
+        closing engine nor write to a closed sink."""
+        for rm in list(self._residents.values()):
+            if rm.learner is not None:
+                rm.learner.close(join=True)
         self.queue.close()
         for rm in self._residents.values():
             if rm.monitor is not None:

@@ -280,6 +280,14 @@ class ServingFleet:
         replication on every replica.
     heartbeat_interval_s : min seconds between heartbeat records (and
         the base of the :meth:`reap` stall window).
+    learn : False | True | dict — forwarded to every replica engine
+        (ISSUE 20 serve-and-learn).  Replicas share the fitted model
+        OBJECTS, so their per-replica learners serialize updates on a
+        per-model lock (``serving.learn._model_update_lock``) and every
+        replica serves the swapped table the instant it publishes;
+        snapshots stay per-replica via the ``quality_tag`` filename
+        glue.  :meth:`update_status` aggregates the per-replica
+        learner state.
     """
 
     def __init__(self, n_replicas: int = 2, *, mesh=None,
@@ -289,7 +297,8 @@ class ServingFleet:
                  fleet_dir=None, slo_p99_ms: Optional[float] = None,
                  max_inflight: Optional[int] = None,
                  replication: Optional[int] = None,
-                 heartbeat_interval_s: float = 0.5):
+                 heartbeat_interval_s: float = 0.5,
+                 learn=False):
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         if replication is not None and replication < 1:
@@ -313,6 +322,7 @@ class ServingFleet:
         self._replication = int(replication) if replication is not None \
             else None
         self._hb_interval = float(heartbeat_interval_s)
+        self._learn = learn
         self.registry = ModelRegistry()     # fleet-level placement view
         self._quantize: Dict[str, Optional[str]] = {}
         self._profiles: Dict[str, Optional[dict]] = {}
@@ -342,7 +352,8 @@ class ServingFleet:
             max_wait_ms=self._max_wait_ms, clock=self._user_clock,
             start=self._start, quality=self._quality,
             quality_dir=self._fleet_dir,
-            quality_window=self._quality_window, quality_tag=name)
+            quality_window=self._quality_window, quality_tag=name,
+            learn=self._learn)
         hb = os.path.join(self._fleet_dir, f"hb.{name}.jsonl") \
             if self._fleet_dir is not None else None
         rep = _Replica(name, i, eng, hb, self._hb_interval)
@@ -846,6 +857,18 @@ class ServingFleet:
         out: Dict[str, dict] = {}
         for rep in self._replicas:
             for mid, st in rep.engine.quality_status().items():
+                out.setdefault(mid, {})[rep.name] = st
+        return out
+
+    def update_status(self) -> dict:
+        """Per-model serve-and-learn state per replica:
+        ``{model_id: {replica: status-or-None}}`` — the fleet twin of
+        ``ServingEngine.update_status`` (ISSUE 20); the merged
+        cross-replica update/rollback counts also land in
+        ``serve-status <fleet_dir>`` via the quality sinks."""
+        out: Dict[str, dict] = {}
+        for rep in self._replicas:
+            for mid, st in rep.engine.update_status().items():
                 out.setdefault(mid, {})[rep.name] = st
         return out
 

@@ -1,0 +1,743 @@
+"""Serve-and-learn actuator: in-place online updates with atomic swap,
+snapshot-before-update, and rollback-on-regression (ISSUE 20).
+
+r18 landed the TRIGGER half of ROADMAP item 4 — per-model
+:class:`~kmeans_tpu.obs.drift.QualityMonitor` with committed PSI/JS/
+score-ratio/near-tie thresholds.  This module is the ACTUATOR half: a
+resident MiniBatch-backed model updates in place from sampled live
+traffic when its drift monitor fires, Sculley-style, wrapped in the
+r10 rotating-checkpoint rollback discipline so a bad update can never
+outlive one evaluation window.  Three safety layers:
+
+* **Zero-extra-dispatch reservoir.**  A bounded per-model FIFO of
+  traffic blocks, fed ONLY by rows a serving dispatch already
+  materialized (the r18 discipline; warmup/verify probes are excluded
+  by the engine's ``_tls.warming`` guard).  Draining builds
+  fixed-size ``partial_fit`` batches of exactly
+  :data:`UPDATE_BATCH_ROWS` rows — one compiled step shape, so after
+  the first update every later one is ZERO new compiles (pinned by the
+  recompilation sentinel).  Batches are never zero-padded: padding
+  rows would enter the Sculley per-center statistics as real mass.
+* **Clone-update-swap.**  The update runs ``partial_fit`` on a
+  DETACHED working clone (``MiniBatchKMeans._learn_clone``) off the
+  dispatch lock — a failed update dies with the clone, the serving
+  model bit-identical on last-good.  Publication is ONE atomic swap
+  (:func:`publish_tables`): the device table is pre-placed and the
+  identity-keyed ``_cents_dev`` cache pre-seeded BEFORE
+  ``model.centroids`` is rebound, which is the single publication
+  point — ``_cents_dev`` reads ``self.centroids`` exactly once, so a
+  concurrent reader sees the old table or the new one, never a torn
+  mix (the torn-swap hammer in tests/test_learn.py).
+* **Snapshot + rollback.**  Every update snapshots the pre-update
+  state via ``utils.checkpoint.save_state_rotating`` first; when the
+  post-update windows regress past :data:`REGRESSION_RATIO`, the
+  learner restores last-good (``load_state_with_fallback``) and swaps
+  back through the same helper, emitting a typed
+  :class:`UpdateRolledBack` record.  Update/rollback budgets, debounce
+  (via the monitor's committed windows), and cooldown are module
+  constants in the ``orchestrator/policy.py`` committed-rules style.
+
+Every decision is recorded three ways: a ``serve.learn`` tracer event,
+a ``serve.learn.*`` registry counter, and a JSONL line in the model's
+quality sink (``QualityMonitor.record`` — kinds ``update``/
+``rollback``, aggregated by the ``serve-status`` multi-file reader).
+
+Headline invariant (pinned by tests/test_learn.py): a QUIESCED
+serve-and-learn model is bit-exact equal to the same ``partial_fit``
+batch sequence replayed offline from the pre-update snapshot — the
+float64 Sculley carry makes the trajectory reproducible — and an
+injected update failure or quality regression NEVER fails a serving
+request: the model stays on (or returns to) last-good and the engine
+keeps serving throughout.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from kmeans_tpu.obs import metrics_registry as _metrics
+from kmeans_tpu.obs import trace as _trace
+from kmeans_tpu.parallel.mesh import mesh_shape
+from kmeans_tpu.utils import checkpoint as ckpt
+from kmeans_tpu.utils import faults as _faults
+
+__all__ = [
+    "UPDATE_BATCH_ROWS", "UPDATE_MAX_BATCHES", "RESERVOIR_ROWS",
+    "UPDATE_MIN_ROWS", "UPDATE_BUDGET", "ROLLBACK_BUDGET",
+    "UPDATE_COOLDOWN_WINDOWS", "REGRESSION_RATIO",
+    "REGRESSION_EVAL_WINDOWS", "LEARN_P99_EXCURSION_BOUND",
+    "COMMITTED_LEARN_RULES",
+    "Decision", "UpdateRolledBack", "publish_tables", "ModelLearner",
+]
+
+# --------------------------------------------------------- committed rules
+
+#: Rows per ``partial_fit`` update batch.  Committed to the r19 serving
+#: bucket ladder's 512 rung — which is ALSO the drift window's row
+#: count (``obs.drift.DRIFT_WINDOW_ROWS``), so one update batch carries
+#: exactly one window's worth of evidence.  Every update batch has
+#: EXACTLY this many rows (never zero-padded — padding rows would
+#: corrupt the Sculley per-center counts), so the update step compiles
+#: once and every later update is zero new compiles.
+UPDATE_BATCH_ROWS = 512
+
+#: Update batches consumed per update step.  Bounds the off-dispatch
+#: compute burst of one update (and hence the p99 excursion the
+#: BENCH_LEARN row measures) the same way segment sizing bounds a fit
+#: dispatch.
+UPDATE_MAX_BATCHES = 4
+
+#: Reservoir capacity in rows (trimmed oldest-first at block
+#: granularity).  8 full batches: enough to decouple traffic bursts
+#: from update cadence, small enough that the retained sample is
+#: RECENT — the drifted distribution the update is meant to absorb.
+RESERVOIR_ROWS = 8 * UPDATE_BATCH_ROWS
+
+#: Minimum reservoir fill before an update may start: one full batch.
+#: An update from less would either pad (forbidden) or train on a
+#: different batch shape (a new compile per distinct fill level).
+UPDATE_MIN_ROWS = UPDATE_BATCH_ROWS
+
+#: In-place updates a learner may APPLY over its lifetime.  The
+#: actuator is a stopgap between refits, not a substitute: a model that
+#: needed 8 online updates needs retraining, and an unbounded learner
+#: chasing a moving distribution would never say so.
+UPDATE_BUDGET = 8
+
+#: Rollbacks before the learner disarms itself.  Two rolled-back
+#: updates mean live traffic is not learnable by this loop (regression
+#: every time) — continuing would oscillate the serving tables forever.
+ROLLBACK_BUDGET = 2
+
+#: Monitor windows between updates (cooldown).  Twice the drift
+#: debounce: the post-update evaluation windows must CLOSE before the
+#: next update may start, or rollback would have no clean baseline.
+UPDATE_COOLDOWN_WINDOWS = 4
+
+#: Post/pre score-per-row ratio above which an applied update is judged
+#: a regression and rolled back.  1.25 sits far below the 2.0 drift
+#: alert (an update must not merely avoid re-triggering drift — it must
+#: not make quality measurably worse than the pre-update serving
+#: baseline it was meant to improve).
+REGRESSION_RATIO = 1.25
+
+#: Monitor windows that must close after an update before it is judged
+#: (same role as the drift debounce: one window is weather).
+REGRESSION_EVAL_WINDOWS = 2
+
+#: BENCH_LEARN committed bound: the serving p99 measured DURING an
+#: in-place update wave may exceed the quiet-wave p99 by at most this
+#: factor.  The update runs off the dispatch lock on a detached clone,
+#: so the only serve-path costs are the reservoir copy and the one
+#: atomic swap — 3x leaves room for scheduler noise on a busy host
+#: while still catching an update that ever re-enters the dispatch
+#: path (which would show up as an order-of-magnitude excursion).
+LEARN_P99_EXCURSION_BOUND = 3.0
+
+#: The committed serve-and-learn decision table, exported as one dict
+#: so tests, ``update_status()``, and the docs pin the SAME numbers.
+COMMITTED_LEARN_RULES: Dict[str, float] = {
+    "batch_rows": UPDATE_BATCH_ROWS,
+    "max_batches": UPDATE_MAX_BATCHES,
+    "reservoir_rows": RESERVOIR_ROWS,
+    "min_rows": UPDATE_MIN_ROWS,
+    "update_budget": UPDATE_BUDGET,
+    "rollback_budget": ROLLBACK_BUDGET,
+    "cooldown_windows": UPDATE_COOLDOWN_WINDOWS,
+    "regression_ratio": REGRESSION_RATIO,
+    "eval_windows": REGRESSION_EVAL_WINDOWS,
+}
+
+#: Decisions retained in each learner's in-memory log (the
+#: ``update_status()`` depth; the JSONL sink keeps everything).
+DECISION_HISTORY = 64
+
+#: Registry counter per decision action (the triple-recording
+#: contract's counter leg; one fixed name per action, so dashboards
+#: never see an unbounded name space).
+_ACTION_COUNTERS = {
+    "update": "serve.learn.updates",
+    "update-failed": "serve.learn.update_failures",
+    "update-skipped": "serve.learn.skips",
+    "eval-ok": "serve.learn.eval_ok",
+    "rollback": "serve.learn.rollbacks",
+    "disabled": "serve.learn.disabled",
+}
+
+
+@dataclass
+class Decision:
+    """One serve-and-learn decision (the autopilot ``Decision``
+    discipline applied to serving): what the learner did and why, in
+    sequence order."""
+
+    seq: int
+    t_s: float
+    model: str
+    action: str          # a key of _ACTION_COUNTERS
+    reason: str
+    detail: dict = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        return {"seq": self.seq, "t_s": round(self.t_s, 3),
+                "model": self.model, "action": self.action,
+                "reason": self.reason, "detail": dict(self.detail)}
+
+
+@dataclass
+class UpdateRolledBack:
+    """Typed record of one rollback-to-last-good: which applied update
+    regressed, what the committed rule measured, and where the restored
+    state came from (``primary`` snapshot or its ``.prev`` rotation)."""
+
+    model: str
+    update_seq: int
+    reason: str
+    pre_ratio: Optional[float]
+    post_ratio: Optional[float]
+    ratio: Optional[float]
+    restored_from: str
+
+    def as_dict(self) -> dict:
+        return {"model": self.model, "update_seq": self.update_seq,
+                "reason": self.reason, "pre_ratio": self.pre_ratio,
+                "post_ratio": self.post_ratio, "ratio": self.ratio,
+                "restored_from": self.restored_from}
+
+
+# ------------------------------------------------------------ atomic swap
+
+def publish_tables(model, mesh, model_shards, *, centroids_f64, seen,
+                   iterations_run, sse_history, cluster_sizes=None
+                   ) -> float:
+    """Publish a new (or restored) centroid table to a LIVE serving
+    model through one atomic swap.  The ONLY code in serving/ allowed
+    to rebind a resident model's table attributes or touch the
+    ``_cents_dev`` identity cache (the ``atomic-swap`` lint rule).
+
+    Why this is torn-proof: ``KMeans._cents_dev`` reads
+    ``self.centroids`` exactly ONCE into a local and keys its device
+    cache on that array's identity.  Publication therefore orders the
+    writes so the ``centroids`` rebind is LAST — the auxiliary f64
+    carry/counts first, then the device placement pre-seeded into
+    ``_cents_cache`` under the NEW array's identity, then the single
+    reference assignment that makes the new table visible.  A reader
+    that snapshots ``centroids`` before the rebind serves the old table
+    end to end; one that snapshots after serves the new table with its
+    placement already warm.  The worst interleaving (a reader placing
+    the OLD table between the cache seed and the rebind, overwriting
+    the cache entry) costs one redundant re-placement on the next
+    dispatch — never a torn read, never a failed request.
+
+    Returns the swap duration in seconds (placement + rebinds — the
+    update-pause the BENCH_LEARN row reports)."""
+    t0 = time.perf_counter()
+    carry = np.asarray(centroids_f64, np.float64)
+    new_cents = carry.astype(model.dtype)
+    model._centroids_f64 = carry
+    model._seen = np.array(seen, dtype=np.float64, copy=True)
+    if cluster_sizes is not None:
+        model.cluster_sizes_ = np.asarray(cluster_sizes, np.int64)
+    model.iterations_run = int(iterations_run)
+    model.sse_history = list(sse_history)
+    # Pre-place the new table and seed the identity-keyed cache BEFORE
+    # the swap: the first post-swap reader must find its device table
+    # warm instead of paying a host->device transfer on the dispatch
+    # path.
+    dev = model._put_centroids(new_cents, mesh, model_shards)
+    model._cents_cache = (new_cents, mesh, dev)
+    model.centroids = new_cents          # THE swap: old table -> new
+    return time.perf_counter() - t0
+
+
+# One update lock per MODEL OBJECT (not per learner): fleet replicas
+# share fitted model objects (one `_cents_dev` placement — ISSUE 17),
+# so their per-replica learners must serialize updates on the shared
+# model.  Weak-keyed: a removed model's lock dies with it.
+_MODEL_LOCKS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_MODEL_LOCKS_GUARD = threading.Lock()
+
+
+def _model_update_lock(model) -> threading.Lock:
+    with _MODEL_LOCKS_GUARD:
+        lock = _MODEL_LOCKS.get(model)
+        if lock is None:
+            lock = threading.Lock()
+            _MODEL_LOCKS[model] = lock
+        return lock
+
+
+class ModelLearner:
+    """The per-(engine, resident model) serve-and-learn loop.
+
+    Lifecycle: the engine feeds ``offer(rows)`` (reservoir) and
+    ``poke()`` (trigger check) from its dispatch path — both are cheap
+    host-side calls off the compiled path — and the learner runs
+    updates/evaluations on a short-lived background thread, never on a
+    dispatch thread.  ``update_now(force=True)`` is the synchronous
+    path (tests, CLI).  ``close()`` joins any in-flight update before
+    the engine closes the model's monitor sink, so an update can never
+    write after remove (ISSUE 20 satellite)."""
+
+    def __init__(self, engine, rm, *, snapshot_path: str,
+                 batch_rows: int = UPDATE_BATCH_ROWS,
+                 max_batches: int = UPDATE_MAX_BATCHES,
+                 reservoir_rows: int = RESERVOIR_ROWS,
+                 min_rows: int = UPDATE_MIN_ROWS,
+                 update_budget: int = UPDATE_BUDGET,
+                 rollback_budget: int = ROLLBACK_BUDGET,
+                 cooldown_windows: int = UPDATE_COOLDOWN_WINDOWS,
+                 regression_ratio: float = REGRESSION_RATIO,
+                 eval_windows: int = REGRESSION_EVAL_WINDOWS):
+        self.engine = engine
+        self.rm = rm
+        self.model = rm.model
+        self.model_id = rm.model_id
+        self.monitor = rm.monitor
+        if self.monitor is None:
+            raise ValueError(
+                f"model {rm.model_id!r} has no quality monitor; the "
+                f"serve-and-learn trigger IS the drift monitor — serve "
+                f"with quality monitoring on to learn")
+        self.snapshot_path = str(snapshot_path)
+        self.batch_rows = int(batch_rows)
+        self.max_batches = int(max_batches)
+        self.reservoir_rows = int(reservoir_rows)
+        self.min_rows = max(int(min_rows), self.batch_rows)
+        self.update_budget = int(update_budget)
+        self.rollback_budget = int(rollback_budget)
+        self.cooldown_windows = int(cooldown_windows)
+        self.regression_ratio = float(regression_ratio)
+        self.eval_windows = int(eval_windows)
+
+        self._res: deque = deque()
+        self._res_rows = 0
+        self._res_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._busy = threading.Lock()        # one in-flight worker
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._armed = True
+        self._seq = 0
+        self._t0 = time.monotonic()
+        self._last_update_window = -self.cooldown_windows
+        self._pending: Optional[dict] = None
+        self.updates_applied = 0
+        self.updates_failed = 0
+        self.rollbacks: List[UpdateRolledBack] = []
+        self.decisions: deque = deque(maxlen=DECISION_HISTORY)
+        # Batches each APPLIED update consumed, newest last (the
+        # quiesced-equivalence tests replay these offline; bounded like
+        # the decision log).
+        self.applied_batches: deque = deque(maxlen=DECISION_HISTORY)
+
+    # -------------------------------------------------------- reservoir
+
+    def offer(self, rows: np.ndarray) -> None:
+        """Retain one dispatch's ALREADY-MATERIALIZED rows (a copy —
+        the dispatch buffer is sliced per request by the queue).
+        Oldest blocks fall off when the cap is exceeded (block
+        granularity: the cap bounds retention, not batch shapes)."""
+        if self._closed or not self._armed:
+            return
+        block = np.array(rows, copy=True)
+        if block.ndim != 2 or block.shape[0] == 0:
+            return
+        with self._res_lock:
+            self._res.append(block)
+            self._res_rows += block.shape[0]
+            while self._res_rows - self._res[0].shape[0] \
+                    >= self.reservoir_rows:
+                self._res_rows -= self._res.popleft().shape[0]
+
+    def _drain_batches(self) -> List[np.ndarray]:
+        """Pop the oldest ``n * batch_rows`` reservoir rows as exact
+        fixed-size batches (FIFO — arrival order, so the offline
+        replay of the same traffic reconstructs the same batches)."""
+        with self._res_lock:
+            n_batches = min(self._res_rows // self.batch_rows,
+                            self.max_batches)
+            if n_batches == 0:
+                return []
+            need = n_batches * self.batch_rows
+            taken, got = [], 0
+            while got < need:
+                block = self._res.popleft()
+                take = min(block.shape[0], need - got)
+                taken.append(block[:take])
+                if take < block.shape[0]:
+                    self._res.appendleft(block[take:])
+                got += take
+            self._res_rows -= need
+        rows = np.concatenate(taken, axis=0)
+        B = self.batch_rows
+        return [np.ascontiguousarray(rows[i * B:(i + 1) * B])
+                for i in range(n_batches)]
+
+    # -------------------------------------------------------- recording
+
+    def _decide(self, action: str, reason: str, **detail) -> Decision:
+        """Record one decision THREE ways (the ISSUE 20 contract):
+        tracer event + registry counter + JSONL line in the model's
+        quality sink."""
+        with self._state_lock:
+            self._seq += 1
+            d = Decision(seq=self._seq,
+                         t_s=time.monotonic() - self._t0,
+                         model=self.model_id, action=action,
+                         reason=reason, detail=detail)
+            self.decisions.append(d)
+        _metrics.REGISTRY.counter(_ACTION_COUNTERS[action]).inc()
+        _trace.event("serve.learn", model=self.model_id, action=action,
+                     reason=reason)
+        if not self._closed:
+            kind = "rollback" if action == "rollback" else "update"
+            sink_action = {"update": "applied",
+                           "update-failed": "failed",
+                           "update-skipped": "skipped",
+                           "eval-ok": "eval-ok",
+                           "rollback": "rollback",
+                           "disabled": "disabled"}[action]
+            self.monitor.record(kind, action=sink_action, seq=d.seq,
+                                reason=reason, **detail)
+        return d
+
+    # ---------------------------------------------------------- trigger
+
+    def _update_due(self) -> bool:
+        if not self._armed or self._closed or self._pending is not None:
+            return False
+        if self.updates_applied >= self.update_budget:
+            return False
+        if self._res_rows < self.min_rows:
+            return False
+        if not self.monitor.drifting:
+            return False
+        return (self.monitor.windows - self._last_update_window
+                >= self.cooldown_windows)
+
+    def _eval_due(self) -> bool:
+        p = self._pending
+        return (p is not None
+                and self.monitor.windows >= p["eval_after_window"])
+
+    def poke(self) -> None:
+        """Cheap post-dispatch trigger check; spawns the background
+        worker when an update or a pending evaluation is due.  Called
+        by the engine after every quality feed — must stay O(1) reads
+        on the common path."""
+        if self._closed or not self._armed or self._busy.locked():
+            return
+        if not (self._eval_due() or self._update_due()):
+            return
+        if not self._busy.acquire(blocking=False):
+            return
+        try:
+            # lint: ok(thread) — joined at close(): the handle is kept
+            # on self._thread and ModelLearner.close() joins it before
+            # the engine tears down the model's sinks
+            t = threading.Thread(target=self._worker,
+                                 name=f"learn-{self.model_id}",
+                                 daemon=True)
+            self._thread = t
+            t.start()
+        except BaseException:
+            self._busy.release()
+            raise
+
+    def _worker(self) -> None:
+        try:
+            if self._eval_due():
+                self._evaluate()
+            elif self._update_due():
+                self._run_update(force=False, reason="drift")
+        except Exception as e:  # noqa: BLE001 — actuator isolation:
+            # a learner bug must never take the serving engine down.
+            self._decide("update-failed", f"internal: {e}",
+                         error=type(e).__name__, ok=False)
+        finally:
+            self._busy.release()
+
+    # ----------------------------------------------------------- update
+
+    def evaluate_now(self, *, force: bool = True) -> None:
+        """Synchronously judge the pending update (test / CLI path);
+        ``force=True`` judges on whatever windows exist instead of
+        waiting out the committed evaluation debounce."""
+        with self._busy:
+            self._evaluate(force=force)
+
+    def update_now(self, *, force: bool = True,
+                   reason: str = "manual") -> Optional[dict]:
+        """Synchronous update (the test / CLI path): runs any due
+        evaluation first, then one update step on the CALLING thread.
+        ``force=True`` bypasses the drift trigger and cooldown (never
+        the budgets or the min-fill rule).  Returns the update
+        decision's dict (None when nothing ran)."""
+        with self._busy:
+            if self._pending is not None:
+                self._evaluate(force=force)
+            d = self._run_update(force=force, reason=reason)
+        return d.as_dict() if d is not None else None
+
+    def _run_update(self, *, force: bool,
+                    reason: str) -> Optional[Decision]:
+        """One update step.  Caller holds ``_busy``."""
+        if self._closed or not self._armed:
+            return None
+        if self.updates_applied >= self.update_budget:
+            return self._decide("update-skipped", "update-budget-exhausted",
+                                budget=self.update_budget, ok=False)
+        if not force and not self._update_due():
+            return None
+        mlock = _model_update_lock(self.model)
+        if not mlock.acquire(blocking=False):
+            # A fleet peer's learner is updating the SHARED model.
+            return self._decide("update-skipped", "peer-updating",
+                                ok=False)
+        try:
+            return self._run_update_locked(reason)
+        finally:
+            mlock.release()
+
+    def _run_update_locked(self, reason: str) -> Optional[Decision]:
+        batches = self._drain_batches()
+        if not batches:
+            return self._decide("update-skipped", "reservoir-underfilled",
+                                rows=self._res_rows,
+                                min_rows=self.min_rows, ok=False)
+        # Pre-update baseline for the regression rule, measured BEFORE
+        # anything changes: the recent informative windows' score
+        # ratio under the OLD table.
+        pre_ratio = self._recent_score_ratio(after_window=None)
+        pre_sizes = np.array(self.model.cluster_sizes_, copy=True) \
+            if getattr(self.model, "cluster_sizes_", None) is not None \
+            else None
+        # 1. Snapshot-before-update (rotating: the previous snapshot
+        #    survives at .prev, so even a torn snapshot write leaves a
+        #    restorable last-good).
+        try:
+            ckpt.save_state_rotating(self.snapshot_path,
+                                     self.model._state_dict())
+        except Exception as e:  # noqa: BLE001 — typed by record
+            self.updates_failed += 1
+            return self._decide("update-failed", f"snapshot: {e}",
+                                error=type(e).__name__, ok=False)
+        # 2. partial_fit on a detached clone, OFF the dispatch lock —
+        #    the serving model is untouched until the swap.
+        t_fit = time.perf_counter()
+        try:
+            clone = self.model._learn_clone()
+            for i, batch in enumerate(batches):
+                _faults.on_update_step(self.model_id, i)
+                clone.partial_fit(batch)
+        except Exception as e:  # noqa: BLE001 — any failure here
+            # leaves the serving model bit-identical on last-good.
+            self.updates_failed += 1
+            # Cooldown anyway: a deterministic failure must not retry
+            # in a hot loop on every window close.
+            self._last_update_window = self.monitor.windows
+            return self._decide("update-failed", str(e),
+                                error=type(e).__name__,
+                                n_batches=len(batches), ok=False)
+        fit_s = time.perf_counter() - t_fit
+        if self._closed:
+            # remove()/close() raced the update: the model (and its
+            # sinks) may already be torn down — never publish.
+            return None
+        # 3. ONE atomic swap publishes the clone's tables.
+        swap_s = publish_tables(
+            self.model, self.engine.mesh,
+            mesh_shape(self.engine.mesh)[1],
+            centroids_f64=clone._centroids_f64,
+            seen=clone._seen,
+            cluster_sizes=clone.cluster_sizes_,
+            iterations_run=clone.iterations_run,
+            sse_history=clone.sse_history)
+        self.updates_applied += 1
+        self._last_update_window = self.monitor.windows
+        self.applied_batches.append(batches)
+        self._pending = {
+            "update_seq": self._seq + 1,
+            "window": self.monitor.windows,
+            "eval_after_window": self.monitor.windows + self.eval_windows,
+            "pre_ratio": pre_ratio,
+            "pre_cluster_sizes": pre_sizes,
+        }
+        return self._decide(
+            "update", reason, ok=True, n_batches=len(batches),
+            rows=len(batches) * self.batch_rows,
+            fit_ms=round(fit_s * 1e3, 3),
+            swap_ms=round(swap_s * 1e3, 3),
+            budget_left=self.update_budget - self.updates_applied,
+            snapshot=self.snapshot_path)
+
+    # ------------------------------------------------------- evaluation
+
+    def _recent_score_ratio(self, *, after_window: Optional[int]
+                            ) -> Optional[float]:
+        """Median ``score_ratio`` over the newest informative windows
+        (at most ``eval_windows`` of them), optionally restricted to
+        windows closed AFTER ``after_window``.  None when no window
+        carried a score reading."""
+        vals = [w["detectors"].get("score_ratio")
+                for w in self.monitor.history()
+                if (after_window is None or w["window"] > after_window)]
+        vals = [v for v in vals if v is not None]
+        if not vals:
+            return None
+        return float(np.median(vals[-self.eval_windows:]))
+
+    def _evaluate(self, *, force: bool = False) -> None:
+        """Judge the pending update against the committed regression
+        rule; roll back to the snapshot on breach.  Caller holds
+        ``_busy``."""
+        p = self._pending
+        if p is None or self._closed:
+            return
+        if not force and not self._eval_due():
+            return
+        post = self._recent_score_ratio(after_window=p["window"])
+        pre = p["pre_ratio"]
+        ratio = (post / pre) if (post is not None and pre) else None
+        # Injection point (utils.faults.inject_quality_regression):
+        # armed hooks may override the measured ratio, driving the
+        # rollback branch through the real restore + swap path.
+        ratio = _faults.on_update_eval(self.model_id, ratio)
+        self._pending = None
+        if ratio is None or ratio <= self.regression_ratio:
+            self._decide("eval-ok",
+                         "no-score-signal" if ratio is None
+                         else "within-threshold",
+                         update_seq=p["update_seq"],
+                         pre_ratio=pre, post_ratio=post, ratio=ratio,
+                         ok=True)
+            return
+        self._rollback(p, pre=pre, post=post, ratio=ratio)
+
+    def _rollback(self, pending: dict, *, pre, post, ratio) -> None:
+        """Restore the pre-update snapshot and swap it back in —
+        the same atomic publication as the update itself."""
+        try:
+            state, used_fallback = ckpt.load_state_with_fallback(
+                self.snapshot_path)
+        except Exception as e:  # noqa: BLE001 — both rotations torn:
+            # record loudly, disarm; the model keeps serving the
+            # (regressed but functional) updated table — a failed
+            # restore must never take serving down.
+            self._armed = False
+            self._decide("disabled", f"rollback-restore-failed: {e}",
+                         error=type(e).__name__, ok=False)
+            return
+        carry = state.get("centroids_f64")
+        if carry is None:
+            carry = np.asarray(state["centroids"], np.float64)
+        if self._closed:
+            return
+        swap_s = publish_tables(
+            self.model, self.engine.mesh,
+            mesh_shape(self.engine.mesh)[1],
+            centroids_f64=carry,
+            seen=state["seen_counts"],
+            cluster_sizes=pending.get("pre_cluster_sizes"),
+            iterations_run=int(state["iterations_run"]),
+            sse_history=list(state["sse_history"]))
+        restored_from = "prev" if used_fallback else "primary"
+        rec = UpdateRolledBack(
+            model=self.model_id, update_seq=pending["update_seq"],
+            reason=f"score regression {ratio:.3f} > "
+                   f"{self.regression_ratio} over {self.eval_windows} "
+                   f"windows",
+            pre_ratio=pre, post_ratio=post, ratio=float(ratio),
+            restored_from=restored_from)
+        self.rollbacks.append(rec)
+        self._last_update_window = self.monitor.windows
+        self._decide("rollback", rec.reason, ok=True,
+                     update_seq=pending["update_seq"],
+                     pre_ratio=pre, post_ratio=post, ratio=float(ratio),
+                     restored_from=restored_from,
+                     swap_ms=round(swap_s * 1e3, 3))
+        if len(self.rollbacks) >= self.rollback_budget:
+            self._armed = False
+            self._decide("disabled", "rollback-budget-exhausted",
+                         rollbacks=len(self.rollbacks),
+                         budget=self.rollback_budget, ok=False)
+
+    # ------------------------------------------------------------ status
+
+    def status(self) -> dict:
+        """The ``update_status()`` payload for this model: armed state,
+        budgets, reservoir fill, pending evaluation, and the recent
+        decision log."""
+        with self._state_lock:
+            p = self._pending
+            return {
+                "model": self.model_id,
+                "armed": self._armed and not self._closed,
+                "closed": self._closed,
+                "updates_applied": self.updates_applied,
+                "updates_failed": self.updates_failed,
+                "rollbacks": [r.as_dict() for r in self.rollbacks],
+                "update_budget_left":
+                    max(self.update_budget - self.updates_applied, 0),
+                "rollback_budget_left":
+                    max(self.rollback_budget - len(self.rollbacks), 0),
+                "reservoir_rows": self._res_rows,
+                "pending_eval": ({
+                    "update_seq": p["update_seq"],
+                    "eval_after_window": p["eval_after_window"],
+                    "pre_ratio": p["pre_ratio"],
+                } if p is not None else None),
+                "snapshot": self.snapshot_path,
+                "rules": {
+                    "batch_rows": self.batch_rows,
+                    "max_batches": self.max_batches,
+                    "reservoir_rows": self.reservoir_rows,
+                    "min_rows": self.min_rows,
+                    "update_budget": self.update_budget,
+                    "rollback_budget": self.rollback_budget,
+                    "cooldown_windows": self.cooldown_windows,
+                    "regression_ratio": self.regression_ratio,
+                    "eval_windows": self.eval_windows,
+                },
+                "decisions": [d.as_dict() for d in self.decisions],
+            }
+
+    # --------------------------------------------------------- lifecycle
+
+    def close(self, *, join: bool = True) -> None:
+        """Stop learning and JOIN any in-flight update before the
+        caller tears down the model's sinks — an update thread must
+        never publish to a removed model or write to a closed sink
+        (ISSUE 20 satellite: the remove()-vs-update race).
+        Idempotent."""
+        with self._state_lock:
+            if self._closed:
+                return
+            self._closed = True
+        t = self._thread
+        if join and t is not None and t.is_alive() \
+                and t is not threading.current_thread():
+            t.join(timeout=60.0)
+        with self._res_lock:
+            self._res.clear()
+            self._res_rows = 0
+
+
+def snapshot_path_for(learn_dir: str, model_id: str,
+                      tag: Optional[str] = None) -> str:
+    """The rotating pre-update snapshot path for one (model, replica):
+    ``learn.<model_id>[.<tag>].npz`` next to the quality sinks, so the
+    whole serve-and-learn paper trail of a model lives in one
+    directory."""
+    name = f"learn.{model_id}.npz" if tag is None \
+        else f"learn.{model_id}.{tag}.npz"
+    return os.path.join(learn_dir, name)

@@ -77,6 +77,16 @@ class ModelRegistry:
             raise ValueError(f"model id {model_id!r} already resident; "
                              f"remove() it first or pick another id")
         spec = model.fitted_state()      # raises if not fitted
+        # Serve-and-learn eligibility (ISSUE 20) is a registry-level
+        # fact of the model CLASS, recorded once at registration so the
+        # engine's learner attach and ``update_status()`` agree on it:
+        # in-place online updates require a real incremental path (the
+        # MiniBatch Sculley carry), and only the K-Means family has
+        # the atomic-swap publication contract.
+        spec.setdefault(
+            "updatable",
+            spec.get("family") == "kmeans"
+            and callable(getattr(model, "partial_fit", None)))
         self._models[model_id] = model
         self._specs[model_id] = spec
         return spec

@@ -51,6 +51,18 @@ test.  This module is the one place those injections live:
   deterministic stand-in for a flaky scheduler/allocator, driving the
   autopilot's bounded exponential launch backoff through the real
   spawn path.
+* ``inject_update_failure(...)`` — arm the serve-and-learn update-step
+  hook (ISSUE 20): the learner calls :func:`on_update_step` right
+  before each ``partial_fit`` batch of an in-place online update, and
+  the armed hook raises :class:`SimulatedUpdateFailure` — proving
+  through the real update path that a failed update NEVER touches the
+  serving model (the clone dies, the engine keeps serving last-good).
+* ``inject_quality_regression(...)`` — arm the post-update evaluation
+  hook (ISSUE 20): the learner calls :func:`on_update_eval` with the
+  measured post/pre score ratio when it judges an applied update, and
+  the armed hook overrides the ratio past the committed regression
+  threshold — driving the snapshot-restore rollback through the real
+  evaluation/restore/swap path, no mocks.
 
 All state is explicit (closures / context managers); nothing here is
 active unless a test arms it, and the hooks cost one empty-list check
@@ -67,11 +79,13 @@ import numpy as np
 
 __all__ = [
     "TransientIOError", "SimulatedPreemption", "SimulatedOOM",
-    "SimulatedLaunchFailure",
+    "SimulatedLaunchFailure", "SimulatedUpdateFailure",
     "on_checkpoint", "on_segment_dispatch", "on_launch",
+    "on_update_step", "on_update_eval",
     "inject_kill_after_iteration", "inject_oom_on_segment",
     "inject_checkpoint_delay", "inject_replica_kill",
     "inject_host_kill", "inject_launch_failures",
+    "inject_update_failure", "inject_quality_regression",
     "fail_first_attempts", "flaky_blocks", "poison_blocks",
 ]
 
@@ -92,6 +106,13 @@ class SimulatedLaunchFailure(RuntimeError):
     either: the launcher classifies it through its own typed retry
     policy (bounded deterministic exponential backoff), never through
     an IO retry loop."""
+
+
+class SimulatedUpdateFailure(RuntimeError):
+    """Injected failure inside a serve-and-learn in-place update
+    (ISSUE 20).  NOT an ``OSError``: an update failure is classified by
+    the learner's own typed policy (record the failed attempt, keep the
+    serving model on last-good), never by an IO retry loop."""
 
 
 class SimulatedOOM(RuntimeError):
@@ -312,6 +333,107 @@ def inject_oom_on_segment(j: int, times: int = 1):
         with _HOOK_LOCK:
             if hook in _SEGMENT_HOOKS:
                 _SEGMENT_HOOKS.remove(hook)
+
+
+# Serve-and-learn hook registries (ISSUE 20).  The learner calls
+# ``on_update_step(model_id, batch_index)`` right before feeding each
+# reservoir batch to the working clone's ``partial_fit`` (inside the
+# learner's try block, so an injected failure takes exactly the
+# record-and-keep-serving path a real one would), and
+# ``on_update_eval(model_id, ratio)`` when judging an applied update
+# against the committed regression threshold — armed hooks may OVERRIDE
+# the measured post/pre score ratio, forcing the rollback branch
+# through the real restore + atomic-swap code.
+_UPDATE_HOOKS: List[Callable[[str, int], None]] = []
+_UPDATE_EVAL_HOOKS: List[Callable[[str, Optional[float]],
+                                  Optional[float]]] = []
+
+
+def on_update_step(model_id: str, batch_index: int) -> None:
+    """Fire the update-step hooks (called by the serve-and-learn
+    actuator right before batch ``batch_index`` of an in-place update
+    for ``model_id``).  Production cost: one truthiness check."""
+    if _UPDATE_HOOKS:
+        for hook in list(_UPDATE_HOOKS):
+            hook(model_id, batch_index)
+
+
+def on_update_eval(model_id: str, ratio):
+    """Fire the post-update evaluation hooks: each armed hook receives
+    (and may override) the post/pre score ratio the learner measured;
+    the last hook's return value is what the committed regression rule
+    judges.  Production cost: one truthiness check."""
+    if _UPDATE_EVAL_HOOKS:
+        for hook in list(_UPDATE_EVAL_HOOKS):
+            ratio = hook(model_id, ratio)
+    return ratio
+
+
+@contextlib.contextmanager
+def inject_update_failure(model_id: Optional[str] = None, *,
+                          on_batch: int = 0, times: int = 1):
+    """Arm a deterministic in-place-update failure: the first ``times``
+    times the serve-and-learn actuator reaches ``partial_fit`` batch
+    ``on_batch`` of an update for ``model_id`` (any model when None),
+    :class:`SimulatedUpdateFailure` is raised from the real update
+    path.  The learner must record the failed attempt and leave the
+    serving model bit-identical on last-good — the chaos tests pin
+    zero failed serving requests while this is armed.  Yields a record
+    dict with ``fired`` (count) and ``models`` (the model ids hit)."""
+    record = {"fired": 0, "models": []}
+
+    def hook(mid: str, batch_index: int) -> None:
+        if model_id is not None and mid != model_id:
+            return
+        if batch_index == on_batch and record["fired"] < times:
+            record["fired"] += 1
+            record["models"].append(mid)
+            raise SimulatedUpdateFailure(
+                f"injected update failure for model {mid!r} at batch "
+                f"{batch_index} (failure {record['fired']}/{times})")
+
+    with _HOOK_LOCK:
+        _UPDATE_HOOKS.append(hook)
+    try:
+        yield record
+    finally:
+        with _HOOK_LOCK:
+            if hook in _UPDATE_HOOKS:
+                _UPDATE_HOOKS.remove(hook)
+
+
+@contextlib.contextmanager
+def inject_quality_regression(model_id: Optional[str] = None, *,
+                              ratio: float = 10.0, times: int = 1):
+    """Arm a deterministic post-update quality regression: the first
+    ``times`` evaluations of an applied update for ``model_id`` (any
+    model when None) report ``ratio`` as the post/pre score ratio —
+    far past the committed :data:`~kmeans_tpu.serving.learn
+    .REGRESSION_RATIO` by default — regardless of what the traffic
+    measured, so the learner's rollback-to-last-good runs through the
+    real snapshot-restore + atomic-swap path.  Yields a record dict
+    with ``fired`` (count) and ``measured`` (the ratios that were
+    overridden, None entries for updates whose traffic gave no score
+    reading)."""
+    record = {"fired": 0, "measured": []}
+
+    def hook(mid: str, measured):
+        if model_id is not None and mid != model_id:
+            return measured
+        if record["fired"] < times:
+            record["fired"] += 1
+            record["measured"].append(measured)
+            return float(ratio)
+        return measured
+
+    with _HOOK_LOCK:
+        _UPDATE_EVAL_HOOKS.append(hook)
+    try:
+        yield record
+    finally:
+        with _HOOK_LOCK:
+            if hook in _UPDATE_EVAL_HOOKS:
+                _UPDATE_EVAL_HOOKS.remove(hook)
 
 
 @contextlib.contextmanager

@@ -1,0 +1,603 @@
+"""Serve-and-learn actuator acceptance (ISSUE 20).
+
+The headline invariants, pinned end to end through the REAL code paths
+(the ``utils.faults`` injectors — no mocks):
+
+* QUIESCED EQUIVALENCE: after an in-place online update, the serving
+  model is bit-exact equal to the same ``partial_fit`` batch sequence
+  replayed offline from the pre-update snapshot, across {1,2,4,8}-way
+  meshes — the float64 Sculley carry makes the trajectory reproducible.
+* NEVER A FAILED REQUEST: an injected update failure leaves the model
+  bit-identical on last-good; an injected quality regression rolls the
+  model back to the snapshot (f32 table, f64 carry, and lifetime
+  counts all bit-exact) — and the engine serves throughout both.
+* NEVER A TORN TABLE: concurrent readers hammering the identity-keyed
+  ``_cents_dev`` cache during repeated atomic swaps always see exactly
+  one published table version, never a mix.
+* ZERO NEW COMPILES: fixed-size update batches reuse the warm step
+  programs — the second update runs inside the recompilation sentinel.
+
+Plus the decision surface (``update_status``, triple recording,
+``serve-status`` aggregation, budgets/disarm), the ``remove()``-vs-
+in-flight-update hammer, fleet aggregation, and the CLI.
+"""
+
+import io
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+
+import jax
+
+from kmeans_tpu.models.minibatch import MiniBatchKMeans
+from kmeans_tpu.obs import metrics_registry as obs_metrics
+from kmeans_tpu.obs.drift import format_quality_status, quality_report
+from kmeans_tpu.parallel.mesh import make_mesh
+from kmeans_tpu.serving import ServingEngine, ServingFleet, publish_tables
+from kmeans_tpu.serving.learn import (COMMITTED_LEARN_RULES,
+                                      UpdateRolledBack)
+from kmeans_tpu.utils import faults
+from kmeans_tpu.utils.profiling import recompilation_sentinel
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    obs_metrics.REGISTRY.reset()
+    yield
+    obs_metrics.REGISTRY.reset()
+
+
+@pytest.fixture(scope="module")
+def data():
+    X, _ = make_blobs(n_samples=6000, centers=4, n_features=8,
+                      cluster_std=0.5, center_box=(-40, 40),
+                      random_state=7)
+    return X.astype(np.float32)
+
+
+def _fitted(data, seed=0):
+    return MiniBatchKMeans(k=4, seed=seed, batch_size=256, max_iter=8,
+                           verbose=False).fit(data[:3000])
+
+
+@pytest.fixture(scope="module")
+def mb(data):
+    model = _fitted(data)
+    model.mesh = None                   # engine re-points to its mesh
+    return model
+
+
+#: Fast-test learner config: small exact batches, no cooldown.
+_LEARN = {"batch_rows": 128, "min_rows": 128, "max_batches": 2,
+          "cooldown_windows": 0}
+
+
+def _engine(model, tmp_path, *, mesh=None, learn=None, **kw):
+    eng = ServingEngine(mesh=mesh, quality=True,
+                        quality_dir=str(tmp_path), start=False,
+                        learn=dict(_LEARN, **(learn or {})), **kw)
+    eng.add_model("m", model)
+    return eng
+
+
+def _feed(eng, data, n_blocks=4, rows=128, model_id="m"):
+    for i in range(n_blocks):
+        eng.call(model_id, data[3000 + i * rows: 3000 + (i + 1) * rows],
+                 op="predict")
+
+
+# ------------------------------------------------------------- surface
+
+
+def test_learn_requires_quality_monitoring(tmp_path):
+    with pytest.raises(ValueError, match="drift monitor"):
+        ServingEngine(quality=False, learn=True, start=False)
+
+
+def test_learn_rejects_unknown_config_keys(tmp_path):
+    with pytest.raises(ValueError, match="unknown learn config"):
+        ServingEngine(quality=True, learn={"batch_size": 9},
+                      start=False)
+
+
+def test_learner_attach_and_update_status(data, mb, tmp_path):
+    """Eligible MiniBatch residents get a learner whose status carries
+    the committed rules; ineligible families report None."""
+    from kmeans_tpu import KMeans
+    km = KMeans(k=4, seed=0, verbose=False, max_iter=5).fit(data[:2000])
+    km.mesh = None
+    eng = _engine(mb, tmp_path)
+    try:
+        eng.add_model("plain", km)      # no partial_fit -> no learner
+        st = eng.update_status()
+        assert st["plain"] is None
+        assert st["m"]["armed"] and st["m"]["updates_applied"] == 0
+        # Overrides land in the effective rules; untouched knobs keep
+        # the committed module constants.
+        assert st["m"]["rules"]["batch_rows"] == 128
+        assert st["m"]["rules"]["regression_ratio"] == \
+            COMMITTED_LEARN_RULES["regression_ratio"]
+        assert eng.registry.spec("m")["updatable"] is True
+        assert eng.registry.spec("plain")["updatable"] is False
+        assert "learn" in eng.stats()
+    finally:
+        eng.close()
+
+
+def test_update_skipped_on_empty_reservoir(data, mb, tmp_path):
+    eng = _engine(mb, tmp_path)
+    try:
+        ln = eng._residents["m"].learner
+        dec = ln.update_now(force=True)
+        assert dec["action"] == "update-skipped"
+        assert dec["reason"] == "reservoir-underfilled"
+    finally:
+        eng.close()
+
+
+# ------------------------------------------------- quiesced equivalence
+
+
+@pytest.mark.parametrize("width", [1, 2, 4, 8])
+def test_quiesced_update_equals_offline_replay(data, width, tmp_path):
+    """THE headline invariant: a quiesced serve-and-learn model is
+    bit-exact equal to the same ``partial_fit`` sequence replayed
+    offline from the pre-update snapshot — f32 table, f64 Sculley
+    carry, lifetime counts, and iteration counter — on every mesh
+    width (the device reduction order is part of the trajectory, so
+    online and offline run the SAME mesh)."""
+    if len(jax.devices()) < width:
+        pytest.skip(f"needs {width} devices")
+    mesh = make_mesh(data=width, model=1, devices=jax.devices()[:width])
+    model = _fitted(data)
+    eng = _engine(model, tmp_path / f"w{width}", mesh=mesh)
+    try:
+        blocks = [data[3000 + i * 128: 3000 + (i + 1) * 128]
+                  for i in range(4)]
+        for b in blocks:
+            eng.call("m", b, op="predict")
+        ln = eng._residents["m"].learner
+        dec = ln.update_now(force=True)
+        assert dec["action"] == "update"
+        batches = ln.applied_batches[-1]
+        # The drained batches ARE the retained traffic in arrival
+        # (FIFO) order — the offline replay needs no side channel.
+        np.testing.assert_array_equal(
+            np.concatenate(batches),
+            np.concatenate(blocks)[: 2 * 128].astype(model.dtype))
+        off = MiniBatchKMeans.load(ln.snapshot_path)
+        off.mesh = mesh
+        for b in batches:
+            off.partial_fit(b)
+        assert model.centroids.dtype == off.centroids.dtype
+        np.testing.assert_array_equal(model.centroids, off.centroids)
+        np.testing.assert_array_equal(model._centroids_f64,
+                                      off._centroids_f64)
+        np.testing.assert_array_equal(model._seen, off._seen)
+        assert model.iterations_run == off.iterations_run
+        # And the served labels agree with the replayed model's own.
+        q = data[4000:4100]
+        np.testing.assert_array_equal(eng.call("m", q, op="predict"),
+                                      off.predict(q))
+    finally:
+        eng.close()
+
+
+def test_second_update_is_zero_new_compiles(data, tmp_path):
+    """Fixed exact-size update batches hit one compiled step shape:
+    after the first update warms it, a further update (and the serving
+    traffic around it) adds ZERO cache entries."""
+    model = _fitted(data)
+    eng = _engine(model, tmp_path)
+    try:
+        ln = eng._residents["m"].learner
+        _feed(eng, data)
+        assert ln.update_now(force=True)["action"] == "update"
+        _feed(eng, data)
+        with recompilation_sentinel():
+            assert ln.update_now(force=True)["action"] == "update"
+            eng.call("m", data[3000:3128], op="predict")
+    finally:
+        eng.close()
+
+
+# ------------------------------------------------------ torn-swap hammer
+
+
+def test_concurrent_readers_never_see_torn_table(data, mb):
+    """N reader threads hammer ``_cents_dev`` while the main thread
+    publishes a sequence of KNOWN tables through the atomic swap
+    helper: every table a reader observes must be bit-equal to exactly
+    one published version — never a mix of two."""
+    mesh = make_mesh()
+    model = _fitted(data)
+    model.mesh = mesh
+    k, d = model.centroids.shape
+    rng = np.random.default_rng(0)
+    versions = [np.asarray(model.centroids, np.float64)]
+    versions += [versions[0] + rng.normal(scale=0.1, size=(k, d))
+                 for _ in range(12)]
+    expected = [v.astype(model.dtype) for v in versions]
+    seen = np.asarray(model._seen, np.float64)
+    stop = threading.Event()
+    errors: list = []
+
+    def reader():
+        try:
+            while not stop.is_set():
+                dev = model._cents_dev(mesh, 1)
+                host = np.asarray(dev)[:k]
+                if not any(np.array_equal(host, v) for v in expected):
+                    errors.append("torn table observed")
+                    return
+        except Exception as e:  # noqa: BLE001 — the assertion IS
+            errors.append(repr(e))  # "no reader ever fails"
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for i, v in enumerate(versions[1:], start=1):
+            publish_tables(model, mesh, 1, centroids_f64=v, seen=seen,
+                           iterations_run=i, sse_history=[])
+            time.sleep(0.002)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert errors == []
+
+
+def test_serving_requests_survive_update_storm(data, tmp_path):
+    """Engine-level chaos: readers keep dispatching while updates and
+    swaps run concurrently — zero failed requests, and every label
+    batch matches the argmin oracle of SOME published table version
+    (well-separated blobs: the oracle is tie-free)."""
+    model = _fitted(data)
+    eng = _engine(model, tmp_path)
+    versions = [np.asarray(model.centroids, np.float64)]
+    q = data[4000:4128]
+    stop = threading.Event()
+    errors: list = []
+
+    def oracle(table):
+        dist = (np.sum(q.astype(np.float64) ** 2, axis=1)[:, None]
+                - 2.0 * q.astype(np.float64) @ table.T
+                + np.sum(table ** 2, axis=1)[None, :])
+        return np.argmin(dist, axis=1)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                lab = eng.call("m", q, op="predict")
+                if not any(np.array_equal(lab, oracle(v))
+                           for v in versions):
+                    errors.append("labels match no published table")
+                    return
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=reader) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        ln = eng._residents["m"].learner
+        for _ in range(4):
+            _feed(eng, data)
+            dec = ln.update_now(force=True)
+            assert dec["action"] == "update"
+            versions.append(np.asarray(model._centroids_f64, np.float64))
+            ln._pending = None          # next forced update, no eval
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+        eng.close()
+    assert errors == []
+
+
+# ------------------------------------------------------- chaos injection
+
+
+def test_injected_update_failure_never_fails_serving(data, tmp_path):
+    """A failed update dies with the working clone: the serving model
+    stays IDENTICAL (same array object — nothing was published), the
+    request path never notices, and the failure is recorded all three
+    ways (decision log + counter + JSONL line)."""
+    model = _fitted(data)
+    eng = _engine(model, tmp_path)
+    try:
+        ln = eng._residents["m"].learner
+        _feed(eng, data)
+        before = model.centroids
+        with faults.inject_update_failure("m") as rec:
+            dec = ln.update_now(force=True)
+        assert rec["fired"] == 1
+        assert dec["action"] == "update-failed"
+        assert "SimulatedUpdateFailure" in dec["detail"]["error"]
+        assert model.centroids is before          # nothing published
+        assert ln.status()["updates_applied"] == 0
+        assert ln.status()["updates_failed"] == 1
+        # Zero failed serving requests, on last-good.
+        lab = eng.call("m", data[4000:4032], op="predict")
+        assert lab.shape == (32,)
+        assert obs_metrics.REGISTRY.counter(
+            "serve.learn.update_failures").value == 1
+    finally:
+        eng.close()
+    rep = quality_report([tmp_path / "quality.m.jsonl"])
+    assert rep["models"]["m"]["update_failures"] == 1
+    assert rep["models"]["m"]["updates"] == 0
+
+
+def test_injected_regression_rolls_back_to_last_good(data, tmp_path):
+    """The full rollback story: update applies (tables move), the
+    injected regression verdict breaches the committed ratio, and the
+    learner restores the pre-update snapshot BIT-EXACT (f32 table, f64
+    carry, lifetime counts) through the same atomic swap — typed
+    ``UpdateRolledBack`` record, full decision log, serving alive
+    throughout."""
+    model = _fitted(data)
+    eng = _engine(model, tmp_path)
+    try:
+        ln = eng._residents["m"].learner
+        _feed(eng, data)
+        pre_f32 = np.array(model.centroids, copy=True)
+        pre_f64 = np.array(model._centroids_f64, copy=True)
+        pre_seen = np.array(model._seen, copy=True)
+        pre_sizes = np.array(model.cluster_sizes_, copy=True)
+        assert ln.update_now(force=True)["action"] == "update"
+        assert not np.array_equal(model.centroids, pre_f32)
+        with faults.inject_quality_regression("m", ratio=10.0) as rec:
+            ln.evaluate_now(force=True)
+        assert rec["fired"] == 1
+        np.testing.assert_array_equal(model.centroids, pre_f32)
+        np.testing.assert_array_equal(model._centroids_f64, pre_f64)
+        np.testing.assert_array_equal(model._seen, pre_seen)
+        np.testing.assert_array_equal(model.cluster_sizes_, pre_sizes)
+        [rb] = ln.rollbacks
+        assert isinstance(rb, UpdateRolledBack)
+        assert rb.ratio == 10.0 and rb.restored_from == "primary"
+        actions = [d["action"] for d in ln.status()["decisions"]]
+        assert actions == ["update", "rollback"]
+        assert obs_metrics.REGISTRY.counter(
+            "serve.learn.rollbacks").value == 1
+        # Zero failed requests, back on last-good.
+        lab = eng.call("m", data[4000:4032], op="predict")
+        np.testing.assert_array_equal(
+            lab, eng._residents["m"].model.predict(data[4000:4032]))
+    finally:
+        eng.close()
+    rep = quality_report([tmp_path / "quality.m.jsonl"])
+    row = rep["models"]["m"]
+    assert row["updates"] == 1 and row["rollbacks"] == 1
+    assert "1upd,1rb" in format_quality_status(rep)
+
+
+def test_rollback_budget_disarms_the_learner(data, tmp_path):
+    """Two rolled-back updates mean live traffic is not learnable by
+    this loop: the learner disarms itself (committed ROLLBACK_BUDGET)
+    with an explicit 'disabled' decision, and further updates are
+    refused while serving continues."""
+    model = _fitted(data)
+    eng = _engine(model, tmp_path, learn={"rollback_budget": 2})
+    try:
+        ln = eng._residents["m"].learner
+        for _ in range(2):
+            _feed(eng, data)
+            assert ln.update_now(force=True)["action"] == "update"
+            with faults.inject_quality_regression("m", ratio=10.0):
+                ln.evaluate_now(force=True)
+        st = ln.status()
+        assert st["armed"] is False
+        assert st["rollback_budget_left"] == 0
+        assert [d["action"] for d in st["decisions"]][-1] == "disabled"
+        assert ln.update_now(force=True) is None
+        assert eng.call("m", data[4000:4016], op="predict").shape == (16,)
+    finally:
+        eng.close()
+
+
+def test_update_budget_exhaustion_is_an_explicit_skip(data, tmp_path):
+    model = _fitted(data)
+    eng = _engine(model, tmp_path, learn={"update_budget": 1})
+    try:
+        ln = eng._residents["m"].learner
+        _feed(eng, data)
+        assert ln.update_now(force=True)["action"] == "update"
+        ln._pending = None
+        _feed(eng, data)
+        dec = ln.update_now(force=True)
+        assert dec["action"] == "update-skipped"
+        assert dec["reason"] == "update-budget-exhausted"
+    finally:
+        eng.close()
+
+
+# --------------------------------------------- drift-triggered automation
+
+
+def test_drift_fires_the_update_automatically(data, tmp_path):
+    """The closed loop, end to end on the real trigger: single-cluster
+    traffic drifts the monitor (PSI debounced), the post-dispatch poke
+    spawns the background update, and the decision log shows
+    reason='drift' — no manual update_now anywhere."""
+    model = _fitted(data)
+    eng = _engine(model, tmp_path, quality_window=128)
+    try:
+        ln = eng._residents["m"].learner
+        one = data[np.argsort(model.predict(data[:3000]))[:1500]]
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            for i in range(8):
+                eng.call("m", one[i * 128:(i + 1) * 128], op="predict")
+            if ln.status()["updates_applied"] >= 1:
+                break
+        st = ln.status()
+        assert st["updates_applied"] >= 1
+        ups = [d for d in st["decisions"] if d["action"] == "update"]
+        assert ups and ups[0]["reason"] == "drift"
+    finally:
+        eng.close()
+
+
+# ------------------------------------------------- remove()-vs-update
+
+
+def test_remove_mid_update_joins_cleanly(data, tmp_path):
+    """Removing a model with an update in flight must JOIN the update
+    (or let it abort unpublished) before the sinks close — no
+    write-after-remove, no crash, valid sink JSON (hammered)."""
+    for rep in range(6):
+        model = _fitted(data, seed=rep)
+        eng = _engine(model, tmp_path / f"rep{rep}")
+        ln = eng._residents["m"].learner
+        _feed(eng, data)
+        t = threading.Thread(
+            target=lambda: ln.update_now(force=True, reason="hammer"))
+        t.start()
+        eng.remove("m")
+        t.join(timeout=30.0)
+        assert not t.is_alive()
+        assert ln._closed
+        worker = ln._thread
+        assert worker is None or not worker.is_alive()
+        eng.close()
+        sink = tmp_path / f"rep{rep}" / "quality.m.jsonl"
+        if sink.exists():
+            for line in sink.read_text().splitlines():
+                json.loads(line)                  # every record intact
+
+
+def test_engine_close_joins_learners(data, tmp_path):
+    model = _fitted(data)
+    eng = _engine(model, tmp_path)
+    ln = eng._residents["m"].learner
+    _feed(eng, data)
+    t = threading.Thread(
+        target=lambda: ln.update_now(force=True, reason="close-race"))
+    t.start()
+    eng.close()
+    t.join(timeout=30.0)
+    assert not t.is_alive() and ln._closed
+
+
+# ---------------------------------------------------------------- fleet
+
+
+def test_fleet_learn_shared_model_and_aggregation(data, tmp_path):
+    """Fleet replicas share the fitted model object: one replica's
+    applied update is served by EVERY replica the instant it publishes,
+    per-replica learners serialize on the per-model lock, and
+    ``update_status`` / ``serve-status`` aggregate the per-replica
+    state."""
+    model = _fitted(data)
+    model.mesh = None
+    fdir = tmp_path / "fleet"
+    fleet = ServingFleet(2, quality=True, fleet_dir=str(fdir),
+                         start=False, learn=_LEARN, max_wait_ms=1.0)
+    try:
+        fleet.add_model("m", model)
+        fleet.warmup(prewarm=False)
+        for i in range(8):
+            fleet.call("m", data[3000 + i * 128: 3000 + (i + 1) * 128])
+        st = fleet.update_status()
+        assert set(st["m"]) == {"r0", "r1"}
+        reps = [r for r in fleet._replicas
+                if r.engine._residents["m"].learner.status()
+                ["reservoir_rows"] >= 256]
+        assert reps, "router starved both learners"
+        ln = reps[0].engine._residents["m"].learner
+        pre = np.array(model.centroids, copy=True)
+        assert ln.update_now(force=True)["action"] == "update"
+        assert not np.array_equal(model.centroids, pre)
+        # Every replica serves the swapped table (shared model object).
+        q = data[4000:4064]
+        want = model.predict(q)
+        for rep in fleet._replicas:
+            np.testing.assert_array_equal(
+                rep.engine.call("m", q, op="predict"), want)
+        agg = fleet.update_status()["m"]
+        assert sum(s["updates_applied"] for s in agg.values()) == 1
+    finally:
+        fleet.close()
+    rep = quality_report(sorted(fdir.glob("quality.m.*.jsonl")))
+    assert rep["models"]["m"]["updates"] == 1
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def test_serve_cli_learn_surface(data, mb, tmp_path, monkeypatch,
+                                 capsys):
+    from kmeans_tpu.cli import serve_main
+    mb.save(tmp_path / "mb.npz")
+    lines = [
+        json.dumps({"x": data[:3].tolist(), "id": "r1"}),
+        json.dumps({"learn": True}),
+    ]
+    monkeypatch.setattr("sys.stdin",
+                        io.StringIO("\n".join(lines) + "\n"))
+    rc = serve_main(["--model", str(tmp_path / "mb.npz"), "--learn",
+                     "--no-warmup", "--quality-dir",
+                     str(tmp_path / "q")])
+    assert rc == 0
+    out = [json.loads(ln) for ln in
+           capsys.readouterr().out.strip().splitlines()]
+    assert out[0]["id"] == "r1" and len(out[0]["result"]) == 3
+    st = out[1]["mb"]
+    assert st["armed"] is True and st["updates_applied"] == 0
+    assert st["rules"]["batch_rows"] == \
+        COMMITTED_LEARN_RULES["batch_rows"]
+
+
+def test_serve_cli_learn_requires_quality(data, mb, tmp_path, capsys):
+    from kmeans_tpu.cli import serve_main
+    mb.save(tmp_path / "mb.npz")
+    rc = serve_main(["--model", str(tmp_path / "mb.npz"), "--learn",
+                     "--no-quality"])
+    assert rc == 2
+    assert "--learn requires quality" in capsys.readouterr().err
+
+
+def test_serve_cli_learn_status_needs_learn_flag(data, mb, tmp_path,
+                                                 monkeypatch, capsys):
+    from kmeans_tpu.cli import serve_main
+    mb.save(tmp_path / "mb.npz")
+    monkeypatch.setattr("sys.stdin",
+                        io.StringIO(json.dumps({"learn": True}) + "\n"))
+    rc = serve_main(["--model", str(tmp_path / "mb.npz"),
+                     "--no-warmup", "--no-quality"])
+    assert rc == 0                          # per-request error, loop on
+    out = [json.loads(ln) for ln in
+           capsys.readouterr().out.strip().splitlines()]
+    assert "error" in out[0] and "--learn" in out[0]["error"]
+
+
+# ----------------------------------------------------------- bench-diff
+
+
+def test_bench_diff_guards_the_excursion_row(tmp_path, capsys):
+    """The BENCH_LEARN p99-excursion row is a guarded bench-diff
+    metric: growth past the recorded spread flags (update work leaking
+    into the dispatch path), shrinkage never does."""
+    from kmeans_tpu.cli import bench_diff_main
+
+    def doc(name, ratio):
+        p = tmp_path / name
+        p.write_text(json.dumps({"parsed": {
+            "metric": "serve_learn_p99_excursion_N200000_D32_k64",
+            "excursion_ratio": ratio, "excursion_spread": 0.10}}))
+        return str(p)
+
+    old = doc("old.json", 1.8)
+    assert bench_diff_main([old, doc("same.json", 1.9)]) == 0  # in spread
+    capsys.readouterr()
+    assert bench_diff_main([old, doc("worse.json", 2.6)]) == 1
+    assert "REGRESSION" in capsys.readouterr().out
+    assert bench_diff_main([old, doc("better.json", 1.2)]) == 0

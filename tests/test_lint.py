@@ -56,7 +56,7 @@ def test_registry_has_the_required_rules():
     assert {"trace-hazard", "cache-key", "dispatch", "thread",
             "counter-reset", "dead-private", "cache-name",
             "aot-key", "large-k", "fleet-record",
-            "ingest-span", "fault-path"} <= set(RULES)
+            "ingest-span", "fault-path", "atomic-swap"} <= set(RULES)
     assert len(RULES) >= 6
     for rule in RULES.values():
         assert rule.id and rule.incident, rule
@@ -732,6 +732,98 @@ def test_fleet_record_suppression_honored(tmp_path):
         "        raise FleetOverloadError(model_id)")
     findings = run_on(tmp_path, src, subdir="serving")
     assert [f for f in findings if f.rule == "fleet-record"] == []
+
+
+# ---------------------------------------------------------------------------
+# atomic-swap (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+_SWAP_BAD = """
+class Updater:
+    def apply(self, model, new_cents):
+        model.centroids = new_cents
+
+    def invalidate(self, model):
+        model._cents_cache = None
+"""
+
+_SWAP_OK = """
+import numpy as np
+
+
+def publish_tables(model, mesh, shards, *, centroids_f64, seen):
+    model._centroids_f64 = np.asarray(centroids_f64)
+    model._seen = np.array(seen, copy=True)
+    new_cents = model._centroids_f64.astype(model.dtype)
+    dev = model._put_centroids(new_cents, mesh, shards)
+    model._cents_cache = (new_cents, mesh, dev)
+    model.centroids = new_cents
+
+
+class Updater:
+    def apply(self, model, mesh, shards, cents, seen):
+        publish_tables(model, mesh, shards,
+                       centroids_f64=cents, seen=seen)
+"""
+
+
+def test_atomic_swap_fires_on_inline_table_rebind(tmp_path):
+    findings = run_on(tmp_path, _SWAP_BAD, subdir="serving")
+    fires = [f for f in findings if f.rule == "atomic-swap"]
+    assert len(fires) == 2
+    assert ".centroids" in fires[0].message
+    assert "publish_tables" in fires[0].message
+    assert "._cents_cache" in fires[1].message
+
+
+def test_atomic_swap_silent_inside_the_helper(tmp_path):
+    findings = run_on(tmp_path, _SWAP_OK, subdir="serving")
+    assert [f for f in findings if f.rule == "atomic-swap"] == []
+
+
+def test_atomic_swap_covers_gmm_tables_and_del(tmp_path):
+    # The GMM family's tables and a `del`-style cache invalidation are
+    # the same incident class: the _params_dev identity cache must not
+    # be torn out from under a concurrent reader either.
+    src = """
+class Updater:
+    def apply(self, model, means):
+        model.means_ = means
+
+    def drop(self, model):
+        del model._params_cache
+"""
+    findings = run_on(tmp_path, src, subdir="serving")
+    fires = [f for f in findings if f.rule == "atomic-swap"]
+    assert len(fires) == 2
+
+
+def test_atomic_swap_scoped_to_serving(tmp_path):
+    # models/ code (fit loops, partial_fit, _learn_clone) legitimately
+    # writes its own tables — only serving/ publication is in scope.
+    findings = run_on(tmp_path, _SWAP_BAD, subdir="models")
+    assert [f for f in findings if f.rule == "atomic-swap"] == []
+
+
+def test_atomic_swap_suppression_honored(tmp_path):
+    src = _SWAP_BAD.replace(
+        "        model.centroids = new_cents",
+        "        # lint: ok(atomic-swap) — add-time init, model not "
+        "yet resident\n"
+        "        model.centroids = new_cents").replace(
+        "        model._cents_cache = None",
+        "        # lint: ok(atomic-swap) — teardown after remove()\n"
+        "        model._cents_cache = None")
+    findings = run_on(tmp_path, src, subdir="serving")
+    assert [f for f in findings if f.rule == "atomic-swap"] == []
+
+
+def test_atomic_swap_shipped_serving_tree_clean():
+    # The real serving/ package routes every table publication through
+    # serving.learn.publish_tables — the satellite's shipped-tree bar.
+    findings = lint_paths(
+        sorted((PKG_DIR / "serving").glob("*.py"))).findings
+    assert [f for f in findings if f.rule == "atomic-swap"] == []
 
 
 # ---------------------------------------------------------------------------
